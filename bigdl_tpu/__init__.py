@@ -1,0 +1,21 @@
+"""bigdl_tpu: a TPU-native deep-learning framework with the capabilities of BigDL.
+
+Re-designed from scratch for TPU (JAX/XLA/Pallas/pjit):
+
+- ``bigdl_tpu.nn``       -- Torch-style module zoo (functional core + imperative facade).
+                            Reference surface: spark/dl/src/main/scala/com/intel/analytics/bigdl/nn/
+- ``bigdl_tpu.optim``    -- OptimMethods, Triggers, ValidationMethods, Local/Distri optimizers.
+                            Reference: .../bigdl/optim/
+- ``bigdl_tpu.dataset``  -- DataSet / Transformer / Sample / MiniBatch pipeline.
+                            Reference: .../bigdl/dataset/
+- ``bigdl_tpu.parallel`` -- Mesh management, sharded train steps, ZeRO-1 flat-parameter
+                            chunking (the TPU-native replacement for BigDL's
+                            AllReduceParameter BlockManager parameter server).
+- ``bigdl_tpu.utils``    -- Engine runtime config, RNG, file IO, directed graph.
+- ``bigdl_tpu.models``   -- LeNet5 / VGG / ResNet / RNN model zoo with Train entry points.
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.random_generator import RNG

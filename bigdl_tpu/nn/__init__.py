@@ -1,0 +1,44 @@
+"""Torch-style NN module zoo, TPU-native.
+
+Reference surface: spark/dl/src/main/scala/com/intel/analytics/bigdl/nn/.
+"""
+
+from bigdl_tpu.nn.module import Module, Container, Criterion, Identity, child_rng
+from bigdl_tpu.nn.containers import (
+    Sequential, Concat, ConcatTable, ParallelTable, MapTable,
+    CAddTable, CMulTable, CSubTable, CDivTable, CMaxTable, CMinTable,
+    JoinTable, SelectTable, FlattenTable,
+)
+from bigdl_tpu.nn.graph import Graph, Node, Input
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.conv import (
+    SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
+    TemporalConvolution, Conv1D,
+)
+from bigdl_tpu.nn.pooling import (
+    SpatialMaxPooling, SpatialAveragePooling,
+    GlobalAveragePooling2D, GlobalMaxPooling2D,
+)
+from bigdl_tpu.nn.normalization import (
+    BatchNormalization, SpatialBatchNormalization, LayerNorm, RMSNorm,
+    Dropout, SpatialCrossMapLRN, Normalize,
+)
+from bigdl_tpu.nn.activations import (
+    ReLU, Tanh, Sigmoid, SoftMax, SoftMin, LogSoftMax, HardTanh, Clamp,
+    ReLU6, ELU, SoftPlus, SoftSign, LeakyReLU, Threshold, HardSigmoid,
+    LogSigmoid, TanhShrink, SoftShrink, HardShrink, Power, Square, Sqrt,
+    Abs, Exp, Log, Negative, MulConstant, AddConstant, GELU, SiLU, PReLU,
+)
+from bigdl_tpu.nn.reshape import (
+    Reshape, View, InferReshape, Flatten, Squeeze, Unsqueeze, Transpose,
+    Permute, Select, Narrow, Contiguous, Padding, Replicate,
+)
+from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.criterion import (
+    ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
+    BCECriterion, BCEWithLogitsCriterion, SmoothL1Criterion,
+    DistKLDivCriterion, MarginCriterion, HingeEmbeddingCriterion, L1Cost,
+    CosineEmbeddingCriterion, KullbackLeiblerDivergenceCriterion,
+    MultiLabelSoftMarginCriterion, MultiCriterion, ParallelCriterion,
+    TimeDistributedCriterion,
+)

@@ -1,0 +1,253 @@
+"""Element-wise activation / math layers.
+
+Reference files: nn/ReLU.scala, nn/Tanh.scala, nn/Sigmoid.scala,
+nn/SoftMax.scala, nn/LogSoftMax.scala, nn/HardTanh.scala, nn/ELU.scala,
+nn/SoftPlus.scala, nn/SoftSign.scala, nn/LeakyReLU.scala, nn/ReLU6.scala,
+nn/Threshold.scala, nn/HardSigmoid.scala, nn/LogSigmoid.scala,
+nn/TanhShrink.scala, nn/SoftShrink.scala, nn/HardShrink.scala,
+nn/Power.scala, nn/Square.scala, nn/Sqrt.scala, nn/Abs.scala, nn/Clamp.scala,
+nn/Exp.scala, nn/Log.scala, nn/Negative.scala, nn/MulConstant.scala,
+nn/AddConstant.scala, nn/PReLU.scala.
+
+All are stateless jnp expressions; XLA fuses them into neighbouring matmuls,
+which is the TPU-native replacement for MKL VML calls
+(tensor/TensorNumeric.scala:100-115) and MKL-DNN eltwise post-op fusion
+(nn/mkldnn/Fusion.scala).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import ConstInitMethod
+from bigdl_tpu.nn.module import Module
+
+
+class _Elementwise(Module):
+    def fn(self, x):
+        raise NotImplementedError
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.tree.map(self.fn, input), state
+
+
+class ReLU(_Elementwise):
+    def fn(self, x):
+        return jax.nn.relu(x)
+
+
+class Tanh(_Elementwise):
+    def fn(self, x):
+        return jnp.tanh(x)
+
+
+class Sigmoid(_Elementwise):
+    def fn(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class SoftMax(Module):
+    """Softmax over the last dimension (reference: nn/SoftMax.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.softmax(input, axis=-1), state
+
+
+class SoftMin(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.softmax(-input, axis=-1), state
+
+
+class LogSoftMax(Module):
+    """Log-softmax over the last dimension (reference: nn/LogSoftMax.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jax.nn.log_softmax(input, axis=-1), state
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value=-1.0, max_value=1.0, name=None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    def __init__(self, min_value, max_value, name=None):
+        super().__init__(min_value, max_value, name)
+
+
+class ReLU6(HardTanh):
+    def __init__(self, name=None):
+        super().__init__(0.0, 6.0, name)
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha=1.0, name=None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def fn(self, x):
+        return jax.nn.elu(x, self.alpha)
+
+
+class SoftPlus(_Elementwise):
+    def __init__(self, beta=1.0, name=None):
+        super().__init__(name)
+        self.beta = beta
+
+    def fn(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    def fn(self, x):
+        return x / (1.0 + jnp.abs(x))
+
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, negval=0.01, name=None):
+        super().__init__(name)
+        self.negval = negval
+
+    def fn(self, x):
+        return jax.nn.leaky_relu(x, self.negval)
+
+
+class Threshold(_Elementwise):
+    def __init__(self, threshold=1e-6, value=0.0, name=None):
+        super().__init__(name)
+        self.threshold, self.value = threshold, value
+
+    def fn(self, x):
+        return jnp.where(x > self.threshold, x, self.value)
+
+
+class HardSigmoid(_Elementwise):
+    def fn(self, x):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class LogSigmoid(_Elementwise):
+    def fn(self, x):
+        return jax.nn.log_sigmoid(x)
+
+
+class TanhShrink(_Elementwise):
+    def fn(self, x):
+        return x - jnp.tanh(x)
+
+
+class SoftShrink(_Elementwise):
+    def __init__(self, lam=0.5, name=None):
+        super().__init__(name)
+        self.lam = lam
+
+    def fn(self, x):
+        return jnp.where(
+            x > self.lam, x - self.lam, jnp.where(x < -self.lam, x + self.lam, 0.0)
+        )
+
+
+class HardShrink(_Elementwise):
+    def __init__(self, lam=0.5, name=None):
+        super().__init__(name)
+        self.lam = lam
+
+    def fn(self, x):
+        return jnp.where(jnp.abs(x) > self.lam, x, 0.0)
+
+
+class Power(_Elementwise):
+    """(shift + scale * x) ** power (reference: nn/Power.scala)."""
+
+    def __init__(self, power, scale=1.0, shift=0.0, name=None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def fn(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Square(_Elementwise):
+    def fn(self, x):
+        return jnp.square(x)
+
+
+class Sqrt(_Elementwise):
+    def fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Abs(_Elementwise):
+    def fn(self, x):
+        return jnp.abs(x)
+
+
+class Exp(_Elementwise):
+    def fn(self, x):
+        return jnp.exp(x)
+
+
+class Log(_Elementwise):
+    def fn(self, x):
+        return jnp.log(x)
+
+
+class Negative(_Elementwise):
+    def fn(self, x):
+        return -x
+
+
+class MulConstant(_Elementwise):
+    def __init__(self, scalar, name=None):
+        super().__init__(name)
+        self.scalar = scalar
+
+    def fn(self, x):
+        return x * self.scalar
+
+
+class AddConstant(_Elementwise):
+    def __init__(self, constant, name=None):
+        super().__init__(name)
+        self.constant = constant
+
+    def fn(self, x):
+        return x + self.constant
+
+
+class GELU(_Elementwise):
+    """Not in the reference (pre-transformer codebase); provided for the
+    transformer/long-context stack."""
+
+    def fn(self, x):
+        return jax.nn.gelu(x)
+
+
+class SiLU(_Elementwise):
+    """SwiGLU building block for the transformer stack (not in the reference)."""
+
+    def fn(self, x):
+        return jax.nn.silu(x)
+
+
+class PReLU(Module):
+    """Learnable leaky slope (reference: nn/PReLU.scala).
+
+    ``n_output_plane=0`` -> one shared slope; otherwise one per channel
+    (channel = last axis, NHWC convention).
+    """
+
+    def __init__(self, n_output_plane=0, name=None):
+        super().__init__(name)
+        self.n_output_plane = n_output_plane
+
+    def setup(self, rng, input_spec):
+        n = self.n_output_plane if self.n_output_plane > 0 else 1
+        return {"weight": jnp.full((n,), 0.25, jnp.float32)}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w = params["weight"].astype(input.dtype)
+        return jnp.where(input >= 0, input, w * input), state

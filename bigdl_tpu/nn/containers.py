@@ -1,0 +1,224 @@
+"""Containers and table ops.
+
+Reference: nn/Sequential.scala:31, nn/Concat.scala, nn/ConcatTable.scala,
+nn/ParallelTable.scala, nn/CAddTable.scala and friends.  Tables are Python
+tuples of arrays.  All dimension indices are 0-based (Python idiom; the
+reference is 1-based Torch convention).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Container, Module, child_rng
+
+
+class Sequential(Container):
+    """Feed-forward chain (reference: nn/Sequential.scala:31)."""
+
+    def setup(self, rng, input_spec):
+        params, state = {}, {}
+        spec = input_spec
+        for i, layer in enumerate(self.modules):
+            p, s = layer.setup(child_rng(rng, i), spec)
+            params[str(i)], state[str(i)] = p, s
+            spec = layer.output_spec(p, s, spec)
+        return params, state
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        new_state = dict(state)
+        x = input
+        for i, layer in enumerate(self.modules):
+            x, s = layer.apply(
+                params[str(i)], state[str(i)], x,
+                training=training, rng=child_rng(rng, i),
+            )
+            new_state[str(i)] = s
+        return x, new_state
+
+
+class _Branching(Container):
+    """Shared setup for containers whose children all see the same spec."""
+
+    def _branch_spec(self, input_spec, i):
+        raise NotImplementedError
+
+    def setup(self, rng, input_spec):
+        params, state = {}, {}
+        for i, layer in enumerate(self.modules):
+            p, s = layer.setup(child_rng(rng, i), self._branch_spec(input_spec, i))
+            params[str(i)], state[str(i)] = p, s
+        return params, state
+
+
+class ConcatTable(_Branching):
+    """Each branch sees the whole input; output is the table of branch outputs.
+
+    Reference: nn/ConcatTable.scala.
+    """
+
+    def _branch_spec(self, input_spec, i):
+        return input_spec
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], dict(state)
+        for i, layer in enumerate(self.modules):
+            y, s = layer.apply(
+                params[str(i)], state[str(i)], input,
+                training=training, rng=child_rng(rng, i),
+            )
+            outs.append(y)
+            new_state[str(i)] = s
+        return tuple(outs), new_state
+
+
+class ParallelTable(_Branching):
+    """Branch i consumes input[i] (reference: nn/ParallelTable.scala)."""
+
+    def _branch_spec(self, input_spec, i):
+        return input_spec[i]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], dict(state)
+        for i, layer in enumerate(self.modules):
+            y, s = layer.apply(
+                params[str(i)], state[str(i)], input[i],
+                training=training, rng=child_rng(rng, i),
+            )
+            outs.append(y)
+            new_state[str(i)] = s
+        return tuple(outs), new_state
+
+
+class MapTable(Container):
+    """One shared module applied to every table element (reference: nn/MapTable.scala).
+
+    Weight sharing is free in the functional core: one params pytree, applied
+    to each element.
+    """
+
+    def __init__(self, module: Module, name=None):
+        super().__init__(name)
+        self.add(module)
+
+    def setup(self, rng, input_spec):
+        return self.modules[0].setup(rng, input_spec[0])
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs = []
+        s = state
+        for i, x in enumerate(input):
+            y, s = self.modules[0].apply(
+                params, state, x, training=training, rng=child_rng(rng, i)
+            )
+            outs.append(y)
+        return tuple(outs), s
+
+
+class Concat(_Branching):
+    """ConcatTable + join along ``dimension`` (reference: nn/Concat.scala)."""
+
+    def __init__(self, dimension: int, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def _branch_spec(self, input_spec, i):
+        return input_spec
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], dict(state)
+        for i, layer in enumerate(self.modules):
+            y, s = layer.apply(
+                params[str(i)], state[str(i)], input,
+                training=training, rng=child_rng(rng, i),
+            )
+            outs.append(y)
+            new_state[str(i)] = s
+        return jnp.concatenate(outs, axis=self.dimension), new_state
+
+
+# --------------------------------------------------------------------------- #
+# Table element-wise ops (parameter-free layers).
+# --------------------------------------------------------------------------- #
+
+
+class CAddTable(Module):
+    """Sum of table elements (reference: nn/CAddTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = out + x
+        return out, state
+
+
+class CMulTable(Module):
+    """Product of table elements (reference: nn/CMulTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = out * x
+        return out, state
+
+
+class CSubTable(Module):
+    """input[0] - input[1] (reference: nn/CSubTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input[0] - input[1], state
+
+
+class CDivTable(Module):
+    """input[0] / input[1] (reference: nn/CDivTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input[0] / input[1], state
+
+
+class CMaxTable(Module):
+    """Element-wise max over the table (reference: nn/CMaxTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = jnp.maximum(out, x)
+        return out, state
+
+
+class CMinTable(Module):
+    """Element-wise min over the table (reference: nn/CMinTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = jnp.minimum(out, x)
+        return out, state
+
+
+class JoinTable(Module):
+    """Concatenate table elements along ``dimension`` (reference: nn/JoinTable.scala)."""
+
+    def __init__(self, dimension: int, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.concatenate(list(input), axis=self.dimension), state
+
+
+class SelectTable(Module):
+    """Pick element ``index`` of the input table (reference: nn/SelectTable.scala)."""
+
+    def __init__(self, index: int, name=None):
+        super().__init__(name)
+        self.index = index
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input[self.index], state
+
+
+class FlattenTable(Module):
+    """Flatten a nested table into a flat tuple (reference: nn/FlattenTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return tuple(jax.tree.leaves(input)), state

@@ -1,0 +1,247 @@
+"""Convolution layers.
+
+Reference: nn/SpatialConvolution.scala:54 (im2col + MKL gemm,
+NNPrimitive.im2col at :613-624).  TPU-native redesign: one
+``lax.conv_general_dilated`` -- XLA lowers it straight onto the MXU; there is
+no im2col, no layout juggling, no JNI.  Weights are stored HWIO and compute
+prefers NHWC (TPU-native); an NCHW facade is kept because the reference
+defaults to NCHW (nn/abstractnn/DataFormat.scala) -- conversion happens once
+at the module boundary.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.initialization import Xavier, Zeros
+from bigdl_tpu.nn.module import Module, child_rng
+
+
+class SpatialConvolution(Module):
+    """2-D convolution over NCHW or NHWC batches.
+
+    Constructor mirrors the reference signature
+    (nInputPlane, nOutputPlane, kW, kH, dW, dH, padW, padH, nGroup).
+    """
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        kernel_w: int,
+        kernel_h: int,
+        stride_w: int = 1,
+        stride_h: int = 1,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        n_group: int = 1,
+        dilation_w: int = 1,
+        dilation_h: int = 1,
+        with_bias: bool = True,
+        data_format: str = "NHWC",
+        weight_init=None,
+        bias_init=None,
+        name=None,
+    ):
+        super().__init__(name)
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        assert data_format in ("NHWC", "NCHW")
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.dilation = (dilation_h, dilation_w)
+        self.n_group = n_group
+        self.with_bias = with_bias
+        self.data_format = data_format
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def setup(self, rng, input_spec):
+        kh, kw = self.kernel
+        cin_g = self.n_input_plane // self.n_group
+        fan_in = cin_g * kh * kw
+        fan_out = (self.n_output_plane // self.n_group) * kh * kw
+        params = {
+            "weight": self.weight_init.init(
+                child_rng(rng, 0), (kh, kw, cin_g, self.n_output_plane),
+                fan_in, fan_out,
+            )
+        }
+        if self.with_bias:
+            params["bias"] = self.bias_init.init(
+                child_rng(rng, 1), (self.n_output_plane,), fan_in, fan_out
+            )
+        return params, ()
+
+    def _padding(self):
+        ph, pw = self.pad
+        if ph == -1 and pw == -1:  # reference convention: -1 => SAME
+            return "SAME"
+        return ((ph, ph), (pw, pw))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        y = lax.conv_general_dilated(
+            x,
+            params["weight"].astype(x.dtype),
+            window_strides=self.stride,
+            padding=self._padding(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y, state
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """Reference: nn/SpatialDilatedConvolution.scala."""
+
+    def __init__(
+        self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+        stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+        dilation_w=1, dilation_h=1, **kw,
+    ):
+        super().__init__(
+            n_input_plane, n_output_plane, kernel_w, kernel_h, stride_w,
+            stride_h, pad_w, pad_h, dilation_w=dilation_w,
+            dilation_h=dilation_h, **kw,
+        )
+
+
+class SpatialFullConvolution(Module):
+    """Transposed convolution (reference: nn/SpatialFullConvolution.scala).
+
+    Implemented with input dilation (``lhs_dilation``) so XLA emits the
+    canonical transposed-conv HLO for the MXU.
+    """
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        kernel_w: int,
+        kernel_h: int,
+        stride_w: int = 1,
+        stride_h: int = 1,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        adj_w: int = 0,
+        adj_h: int = 0,
+        with_bias: bool = True,
+        data_format: str = "NHWC",
+        weight_init=None,
+        bias_init=None,
+        name=None,
+    ):
+        super().__init__(name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.adj = (adj_h, adj_w)
+        self.with_bias = with_bias
+        self.data_format = data_format
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def setup(self, rng, input_spec):
+        kh, kw = self.kernel
+        fan_in = self.n_input_plane * kh * kw
+        fan_out = self.n_output_plane * kh * kw
+        params = {
+            "weight": self.weight_init.init(
+                child_rng(rng, 0), (kh, kw, self.n_input_plane, self.n_output_plane),
+                fan_in, fan_out,
+            )
+        }
+        if self.with_bias:
+            params["bias"] = self.bias_init.init(
+                child_rng(rng, 1), (self.n_output_plane,), fan_in, fan_out
+            )
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        ah, aw = self.adj
+        # Transposed conv = conv with lhs dilation; padding chosen so the
+        # output size is s*(i-1) + k - 2p + adj, matching the reference.
+        pad = ((kh - 1 - ph, kh - 1 - ph + ah), (kw - 1 - pw, kw - 1 - pw + aw))
+        w = params["weight"].astype(x.dtype)
+        # Flip spatial dims: transposed conv correlates with the flipped kernel.
+        w = w[::-1, ::-1, :, :]
+        y = lax.conv_general_dilated(
+            x, w,
+            window_strides=(1, 1),
+            padding=pad,
+            lhs_dilation=(sh, sw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y, state
+
+
+class Conv1D(Module):
+    """Temporal convolution over (N, T, C) (reference: nn/TemporalConvolution.scala)."""
+
+    def __init__(
+        self, input_frame_size, output_frame_size, kernel_w, stride_w=1,
+        pad_w=0, with_bias=True, weight_init=None, bias_init=None, name=None,
+    ):
+        super().__init__(name)
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.pad_w = pad_w
+        self.with_bias = with_bias
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def setup(self, rng, input_spec):
+        fan_in = self.input_frame_size * self.kernel_w
+        fan_out = self.output_frame_size * self.kernel_w
+        params = {
+            "weight": self.weight_init.init(
+                child_rng(rng, 0),
+                (self.kernel_w, self.input_frame_size, self.output_frame_size),
+                fan_in, fan_out,
+            )
+        }
+        if self.with_bias:
+            params["bias"] = self.bias_init.init(
+                child_rng(rng, 1), (self.output_frame_size,), fan_in, fan_out
+            )
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y = lax.conv_general_dilated(
+            input,
+            params["weight"].astype(input.dtype),
+            window_strides=(self.stride_w,),
+            padding=((self.pad_w, self.pad_w),),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+
+TemporalConvolution = Conv1D

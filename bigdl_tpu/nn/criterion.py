@@ -1,0 +1,279 @@
+"""Loss functions.
+
+Reference: the ~30 criterions under nn/ (ClassNLLCriterion.scala,
+CrossEntropyCriterion.scala, MSECriterion.scala, AbsCriterion.scala,
+BCECriterion.scala, SmoothL1Criterion.scala, DistKLDivCriterion.scala,
+MarginCriterion.scala, MultiCriterion.scala, ParallelCriterion.scala,
+TimeDistributedCriterion.scala, MultiLabelSoftMarginCriterion.scala,
+CosineEmbeddingCriterion.scala, HingeEmbeddingCriterion.scala,
+L1Cost.scala, KullbackLeiblerDivergenceCriterion.scala).
+
+Class labels are 0-based integers (the reference uses 1-based Torch
+convention).  ``size_average=True`` averages over the batch, else sums.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Criterion
+
+
+def _reduce(loss_per_sample, size_average):
+    return jnp.mean(loss_per_sample) if size_average else jnp.sum(loss_per_sample)
+
+
+class ClassNLLCriterion(Criterion):
+    """Negative log-likelihood over log-probabilities (reference: nn/ClassNLLCriterion.scala).
+
+    ``input``: (N, C) log-probs (pair with LogSoftMax); ``target``: (N,) int.
+    Optional per-class ``weights``; ``padding_value`` rows contribute 0 loss
+    (the reference uses paddingValue=-1 to mask).
+    """
+
+    def __init__(self, weights=None, size_average=True, padding_value=None):
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+        self.padding_value = padding_value
+
+    def apply(self, input, target):
+        target = target.astype(jnp.int32)
+        safe_t = jnp.clip(target, 0, input.shape[-1] - 1)
+        nll = -jnp.take_along_axis(input, safe_t[..., None], axis=-1)[..., 0]
+        w = jnp.ones_like(nll)
+        if self.weights is not None:
+            w = self.weights[safe_t].astype(nll.dtype)
+        if self.padding_value is not None:
+            w = jnp.where(target == self.padding_value, 0.0, w)
+        total = jnp.sum(nll * w)
+        if self.size_average:
+            denom = jnp.maximum(jnp.sum(w), 1e-8)
+            return total / denom
+        return total
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (reference: nn/CrossEntropyCriterion.scala).
+
+    ``input``: (N, C) raw logits.
+    """
+
+    def __init__(self, weights=None, size_average=True):
+        self.inner = ClassNLLCriterion(weights, size_average)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        return self.inner.apply(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class MSECriterion(Criterion):
+    """Mean squared error (reference: nn/MSECriterion.scala).
+
+    sizeAverage divides by the *element* count, matching the reference.
+    """
+
+    def __init__(self, size_average=True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        se = jnp.square(input - target)
+        return jnp.mean(se) if self.size_average else jnp.sum(se)
+
+
+class AbsCriterion(Criterion):
+    """Mean absolute error (reference: nn/AbsCriterion.scala)."""
+
+    def __init__(self, size_average=True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        ae = jnp.abs(input - target)
+        return jnp.mean(ae) if self.size_average else jnp.sum(ae)
+
+
+class BCECriterion(Criterion):
+    """Binary cross-entropy over probabilities (reference: nn/BCECriterion.scala)."""
+
+    def __init__(self, weights=None, size_average=True, eps=1e-12):
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+        self.eps = eps
+
+    def apply(self, input, target):
+        x = jnp.clip(input, self.eps, 1.0 - self.eps)
+        ce = -(target * jnp.log(x) + (1.0 - target) * jnp.log(1.0 - x))
+        if self.weights is not None:
+            ce = ce * self.weights
+        return jnp.mean(ce) if self.size_average else jnp.sum(ce)
+
+
+class BCEWithLogitsCriterion(Criterion):
+    """Numerically-stable sigmoid + BCE (TPU-friendly fused form)."""
+
+    def __init__(self, size_average=True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        ce = jnp.maximum(input, 0) - input * target + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        return jnp.mean(ce) if self.size_average else jnp.sum(ce)
+
+
+class SmoothL1Criterion(Criterion):
+    """Huber loss with delta=1 (reference: nn/SmoothL1Criterion.scala)."""
+
+    def __init__(self, size_average=True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        d = jnp.abs(input - target)
+        loss = jnp.where(d < 1.0, 0.5 * jnp.square(d), d - 0.5)
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL divergence, input = log-probs, target = probs
+    (reference: nn/DistKLDivCriterion.scala)."""
+
+    def __init__(self, size_average=True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        kl = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-30)) - input), 0.0)
+        total = jnp.sum(kl)
+        return total / input.shape[0] if self.size_average else total
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss max(0, margin - y*x) (reference: nn/MarginCriterion.scala)."""
+
+    def __init__(self, margin=1.0, size_average=True, squared=False):
+        self.margin = margin
+        self.size_average = size_average
+        self.squared = squared
+
+    def apply(self, input, target):
+        h = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            h = jnp.square(h)
+        return jnp.mean(h) if self.size_average else jnp.sum(h)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    """Reference: nn/HingeEmbeddingCriterion.scala (target in {1, -1})."""
+
+    def __init__(self, margin=1.0, size_average=True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        loss = jnp.where(target > 0, input, jnp.maximum(0.0, self.margin - input))
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class L1Cost(Criterion):
+    """Sum of |input| (reference: nn/L1Cost.scala; target ignored)."""
+
+    def apply(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """Reference: nn/CosineEmbeddingCriterion.scala.
+
+    ``input``: table (x1, x2); ``target``: (N,) in {1, -1}.
+    """
+
+    def __init__(self, margin=0.0, size_average=True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x1, x2 = input
+        cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12
+        )
+        loss = jnp.where(target > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    """Probabilities-in variant (reference: nn/KullbackLeiblerDivergenceCriterion.scala)."""
+
+    def apply(self, input, target):
+        x = jnp.clip(input, 1e-7, 1.0)
+        t = jnp.clip(target, 1e-7, 1.0)
+        return jnp.mean(jnp.sum(t * jnp.log(t / x), axis=-1))
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    """Reference: nn/MultiLabelSoftMarginCriterion.scala."""
+
+    def __init__(self, weights=None, size_average=True):
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        ce = jnp.maximum(input, 0) - input * target + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        if self.weights is not None:
+            ce = ce * self.weights
+        per_sample = jnp.mean(ce, axis=-1)
+        return jnp.mean(per_sample) if self.size_average else jnp.sum(per_sample)
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same (input, target)
+    (reference: nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        self.criterions = []
+        self.cweights = []
+
+    def add(self, criterion, weight=1.0):
+        self.criterions.append(criterion)
+        self.cweights.append(weight)
+        return self
+
+    def apply(self, input, target):
+        return sum(
+            w * c.apply(input, target)
+            for w, c in zip(self.cweights, self.criterions)
+        )
+
+
+class ParallelCriterion(Criterion):
+    """criterion[i] applied to (input[i], target[i]), weighted sum
+    (reference: nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target=False):
+        self.criterions = []
+        self.cweights = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion, weight=1.0):
+        self.criterions.append(criterion)
+        self.cweights.append(weight)
+        return self
+
+    def apply(self, input, target):
+        total = 0.0
+        for i, (w, c) in enumerate(zip(self.cweights, self.criterions)):
+            t = target if self.repeat_target else target[i]
+            total = total + w * c.apply(input[i], t)
+        return total
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of (N, T, ...) input
+    (reference: nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, criterion, size_average=True):
+        self.criterion = criterion
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        n, t = input.shape[0], input.shape[1]
+        flat_in = input.reshape((n * t,) + input.shape[2:])
+        flat_t = target.reshape((n * t,) + target.shape[2:])
+        loss = self.criterion.apply(flat_in, flat_t)
+        if self.size_average:
+            return loss  # inner criterion already averages over N*T
+        return loss

@@ -1,0 +1,47 @@
+"""Embedding layers.
+
+Reference: nn/LookupTable.scala (gather + optional max-norm),
+nn/LookupTableSparse.scala.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import RandomNormal
+from bigdl_tpu.nn.module import Module
+
+
+class LookupTable(Module):
+    """Embedding lookup (reference: nn/LookupTable.scala).
+
+    ``input``: int indices (0-based), any shape; output gains a trailing
+    ``n_output`` dim.  The gather lowers to a one-hot matmul or dynamic-gather
+    depending on XLA's choice -- both TPU-native.
+    """
+
+    def __init__(self, n_index, n_output, padding_value=None, max_norm=None,
+                 norm_type=2.0, weight_init=None, name=None):
+        super().__init__(name)
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.weight_init = weight_init or RandomNormal(0.0, 1.0)
+
+    def setup(self, rng, input_spec):
+        w = self.weight_init.init(
+            rng, (self.n_index, self.n_output), self.n_index, self.n_output
+        )
+        return {"weight": w}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w = params["weight"]
+        if self.max_norm is not None:
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=-1, keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-12))
+        idx = input.astype(jnp.int32)
+        y = jnp.take(w, jnp.clip(idx, 0, self.n_index - 1), axis=0)
+        if self.padding_value is not None:
+            y = jnp.where((idx == self.padding_value)[..., None], 0.0, y)
+        return y, state

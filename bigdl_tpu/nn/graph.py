@@ -1,0 +1,112 @@
+"""Graph container: build arbitrary DAGs by calling modules on nodes.
+
+Reference: nn/Graph.scala:72 (ModuleNode DAG + buildBackwardGraph),
+nn/StaticGraph.scala:38 (topological-order execution),
+utils/DirectedGraph.scala:36 (topologySort).
+
+TPU-native: the graph is traced once in topological order inside ``apply``;
+XLA sees one flat program and fuses across node boundaries, so there is no
+scheduler / FrameManager analogue (nn/DynamicGraph.scala) -- data-dependent
+control flow belongs in lax.cond/scan inside a module instead.
+
+Usage (mirrors the reference)::
+
+    inp = Input()
+    h = Linear(10, 20)(inp)
+    a = ReLU()(h)
+    b = Tanh()(h)
+    out = CAddTable()(a, b)
+    model = Graph([inp], [out])
+"""
+
+from typing import List
+
+from bigdl_tpu.nn.module import Container, Module, child_rng
+
+
+class Node:
+    """A module applied to the outputs of other nodes (reference: ModuleNode)."""
+
+    def __init__(self, module, inputs: List["Node"]):
+        self.module = module
+        self.inputs = inputs
+
+
+def Input(name=None) -> Node:
+    """Placeholder node (reference: nn/Graph.scala Input())."""
+    return Node(None, [])
+
+
+class Graph(Container):
+    """Static DAG executed in topological order (reference: nn/StaticGraph.scala:38)."""
+
+    def __init__(self, inputs, outputs, name=None):
+        super().__init__(name)
+        self.input_nodes = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.output_nodes = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        self._topo = self._topo_sort()
+        for node in self._topo:
+            if node.module is not None:
+                self.add(node.module)
+
+    def _topo_sort(self) -> List[Node]:
+        """DFS post-order topological sort (reference: DirectedGraph.topologySort)."""
+        order, seen, on_stack = [], set(), set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            if id(node) in on_stack:
+                raise ValueError("Graph contains a cycle")
+            on_stack.add(id(node))
+            for parent in node.inputs:
+                visit(parent)
+            on_stack.discard(id(node))
+            seen.add(id(node))
+            order.append(node)
+
+        for out in self.output_nodes:
+            visit(out)
+        for inp in self.input_nodes:
+            if id(inp) not in seen:
+                raise ValueError("An input node is not connected to any output")
+        return order
+
+    def _gather(self, node, values):
+        ins = [values[id(p)] for p in node.inputs]
+        return ins[0] if len(ins) == 1 else tuple(ins)
+
+    def setup(self, rng, input_spec):
+        specs = {}
+        in_specs = (
+            [input_spec] if len(self.input_nodes) == 1 else list(input_spec)
+        )
+        for node, spec in zip(self.input_nodes, in_specs):
+            specs[id(node)] = spec
+        params, state = {}, {}
+        for i, node in enumerate(self._topo):
+            if node.module is None:
+                continue
+            node_in = self._gather(node, specs)
+            p, s = node.module.setup(child_rng(rng, i), node_in)
+            params[str(i)], state[str(i)] = p, s
+            specs[id(node)] = node.module.output_spec(p, s, node_in)
+        return params, state
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        values = {}
+        ins = [input] if len(self.input_nodes) == 1 else list(input)
+        for node, x in zip(self.input_nodes, ins):
+            values[id(node)] = x
+        new_state = dict(state)
+        for i, node in enumerate(self._topo):
+            if node.module is None:
+                continue
+            y, s = node.module.apply(
+                params[str(i)], state[str(i)], self._gather(node, values),
+                training=training, rng=child_rng(rng, i),
+            )
+            values[id(node)] = y
+            new_state[str(i)] = s
+        outs = [values[id(n)] for n in self.output_nodes]
+        return (outs[0] if len(outs) == 1 else tuple(outs)), new_state

@@ -1,0 +1,72 @@
+"""Weight initialization methods (reference: nn/InitializationMethod.scala)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class InitializationMethod:
+    def init(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def init(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class Ones(InitializationMethod):
+    def init(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.ones(shape, dtype)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value):
+        self.value = value
+
+    def init(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+class RandomUniform(InitializationMethod):
+    """U(lower, upper); defaults to the Torch fan-in heuristic U(-1/sqrt(fan_in), ...)."""
+
+    def __init__(self, lower=None, upper=None):
+        self.lower, self.upper = lower, upper
+
+    def init(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        if self.lower is None:
+            bound = 1.0 / math.sqrt(max(fan_in, 1))
+            lo, hi = -bound, bound
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, dtype, minval=lo, maxval=hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean=0.0, stdv=1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def init(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, dtype)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform: U(+-sqrt(6/(fan_in+fan_out))) (reference default for conv/linear)."""
+
+    def init(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        bound = math.sqrt(6.0 / max(fan_in + fan_out, 1))
+        return jax.random.uniform(rng, shape, dtype, minval=-bound, maxval=bound)
+
+
+class MsraFiller(InitializationMethod):
+    """He/MSRA normal init (reference: nn/InitializationMethod.scala MsraFiller)."""
+
+    def __init__(self, variance_norm_average=True):
+        self.variance_norm_average = variance_norm_average
+
+    def init(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        n = (fan_in + fan_out) / 2.0 if self.variance_norm_average else fan_in
+        std = math.sqrt(2.0 / max(n, 1))
+        return std * jax.random.normal(rng, shape, dtype)

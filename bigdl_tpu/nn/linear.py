@@ -1,0 +1,53 @@
+"""Linear / fully-connected layers.
+
+Reference: nn/Linear.scala (weight (out, in), bias (out), default Xavier).
+The matmul lowers to ``lax.dot_general`` -> MXU.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import RandomUniform, Xavier, Zeros
+from bigdl_tpu.nn.module import Module, child_rng
+
+
+class Linear(Module):
+    """y = x W^T + b.  Weight layout (out_features, in_features) as in the reference."""
+
+    def __init__(
+        self,
+        input_size: Optional[int] = None,
+        output_size: int = None,
+        with_bias: bool = True,
+        weight_init=None,
+        bias_init=None,
+        name=None,
+    ):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def setup(self, rng, input_spec):
+        in_size = self.input_size or input_spec.shape[-1]
+        self.input_size = in_size
+        params = {
+            "weight": self.weight_init.init(
+                child_rng(rng, 0), (self.output_size, in_size), in_size,
+                self.output_size,
+            )
+        }
+        if self.with_bias:
+            params["bias"] = self.bias_init.init(
+                child_rng(rng, 1), (self.output_size,), in_size, self.output_size
+            )
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y = input @ params["weight"].astype(input.dtype).T
+        if self.with_bias:
+            y = y + params["bias"].astype(input.dtype)
+        return y, state

@@ -1,0 +1,256 @@
+"""Module system core: functional layers with a Torch-style imperative facade.
+
+Reference contract: ``AbstractModule[A, B, T]``
+(nn/abstractnn/AbstractModule.scala:59) -- every layer has mutable
+``output``/``gradInput``, template methods ``updateOutput`` /
+``updateGradInput`` / ``accGradParameters`` and a ``parameters()`` accessor.
+
+TPU-native redesign: the *core* of every layer is a pair of pure functions
+
+    setup(rng, input_spec)                  -> (params, state)
+    apply(params, state, input, training, rng) -> (output, new_state)
+
+``params`` / ``state`` are pytrees of jax Arrays; ``input``/``output`` are
+activities (a single array or a nested tuple -- the analogue of the
+reference's ``Activity = Tensor | Table``).  The backward pass is autodiff
+(``jax.vjp``) instead of hand-written ``updateGradInput`` -- there is nothing
+to hand-derive, and XLA fuses the whole step.
+
+The imperative facade (``forward``/``backward``/``parameters``/
+``zero_grad_parameters``/``training``/``evaluate``) reproduces the reference
+API surface for tests and interactive use.  The hot path -- Local/Distri
+optimizers -- never uses the facade: they extract ``setup``/``apply`` and jit
+one fused train step (see optim/local_optimizer.py).
+"""
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.utils.random_generator import RNG
+from bigdl_tpu.utils.shape import spec_of, tree_add
+
+Params = Any
+State = Any
+Activity = Any
+
+_name_counters = {}
+
+
+def _auto_name(cls_name: str) -> str:
+    n = _name_counters.get(cls_name, 0)
+    _name_counters[cls_name] = n + 1
+    return f"{cls_name}{n}"
+
+
+def child_rng(rng, index: int):
+    """Deterministic per-child key derivation (traceable)."""
+    if rng is None:
+        return None
+    return jax.random.fold_in(rng, index)
+
+
+class Module:
+    """Base class of every layer (reference: AbstractModule.scala:59)."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or _auto_name(type(self).__name__)
+        self.train_mode: bool = True
+        # facade state
+        self.output: Activity = None
+        self.grad_input: Activity = None
+        self._params: Params = None
+        self._state: State = None
+        self._grads: Params = None
+        self._last_rng = None
+
+    # ------------------------------------------------------------------ #
+    # Functional contract -- override these two in every layer.
+    # ------------------------------------------------------------------ #
+    def setup(self, rng, input_spec) -> Tuple[Params, State]:
+        """Create (params, state) for the given abstract input spec."""
+        return (), ()
+
+    def apply(
+        self, params: Params, state: State, input: Activity, *, training: bool = False,
+        rng=None,
+    ) -> Tuple[Activity, State]:
+        raise NotImplementedError(type(self).__name__)
+
+    def output_spec(self, params, state, input_spec, training: bool = False):
+        out, _ = jax.eval_shape(
+            lambda p, s, x: self.apply(p, s, x, training=training, rng=None),
+            params, state, input_spec,
+        )
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Imperative facade (reference API surface).
+    # ------------------------------------------------------------------ #
+    def is_built(self) -> bool:
+        return self._params is not None or self._state is not None
+
+    def build(self, input_spec, rng=None) -> "Module":
+        """Materialise params/state for an input spec (lazy in forward())."""
+        if rng is None:
+            rng = RNG.next_key()
+        self._params, self._state = self.setup(rng, input_spec)
+        self._grads = None
+        return self
+
+    def _ensure_built(self, input: Activity):
+        if not self.is_built():
+            self.build(spec_of(input))
+
+    def forward(self, input: Activity) -> Activity:
+        """Reference: AbstractModule.forward (AbstractModule.scala:255)."""
+        self._ensure_built(input)
+        self._last_rng = RNG.next_key() if self.train_mode else None
+        self.output, self._state = self.apply(
+            self._params, self._state, input,
+            training=self.train_mode, rng=self._last_rng,
+        )
+        return self.output
+
+    def backward(self, input: Activity, grad_output: Activity) -> Activity:
+        """updateGradInput + accGradParameters fused via jax.vjp.
+
+        Reference: AbstractModule.backward (AbstractModule.scala:282).
+        Gradients accumulate into the module until zero_grad_parameters(),
+        matching accGradParameters semantics.
+        """
+        self._ensure_built(input)
+        rng, training = self._last_rng, self.train_mode
+
+        def f(p, x):
+            y, _ = self.apply(p, self._state, x, training=training, rng=rng)
+            return y
+
+        _, vjp = jax.vjp(f, self._params, input)
+        gparams, ginput = vjp(grad_output)
+        self._grads = tree_add(self._grads, gparams)
+        self.grad_input = ginput
+        return ginput
+
+    def parameters(self) -> Tuple[Params, Params]:
+        """(weights, gradWeights) pytrees (reference: parameters(), :347)."""
+        if self._grads is None and self._params is not None:
+            self._grads = jax.tree.map(jnp.zeros_like, self._params)
+        return self._params, self._grads
+
+    def set_parameters(self, params: Params):
+        self._params = params
+
+    def get_parameters(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Flat (weights, grads) 1-D views (reference: getParameters).
+
+        Unlike the reference there is no storage aliasing -- these are packed
+        copies (SURVEY.md: don't replicate strided aliasing).
+        """
+        from jax.flatten_util import ravel_pytree
+
+        p, g = self.parameters()
+        flat_p, _ = ravel_pytree(p)
+        flat_g, _ = ravel_pytree(g)
+        return flat_p, flat_g
+
+    def zero_grad_parameters(self):
+        if self._params is not None:
+            self._grads = jax.tree.map(jnp.zeros_like, self._params)
+
+    def training(self) -> "Module":
+        self.train_mode = True
+        for m in self.children():
+            m.training()
+        return self
+
+    def evaluate(self) -> "Module":
+        self.train_mode = False
+        for m in self.children():
+            m.evaluate()
+        return self
+
+    def children(self):
+        return []
+
+    def state(self) -> State:
+        return self._state
+
+    def set_state(self, state: State):
+        self._state = state
+
+    # Graph building: calling a module on Node(s) creates a new graph node
+    # (reference: ModuleNode / Graph, nn/Graph.scala:72).
+    def __call__(self, *args):
+        from bigdl_tpu.nn.graph import Node
+
+        if args and all(isinstance(a, Node) for a in args):
+            return Node(self, list(args))
+        if len(args) == 1:
+            return self.forward(args[0])
+        raise TypeError(
+            "Module(...) expects graph Nodes (to build a Graph) or a single "
+            "activity (to run forward)."
+        )
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+class Container(Module):
+    """Base for modules that own sub-modules (reference: nn/Container.scala:40)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.modules = []
+
+    def add(self, module: Module) -> "Container":
+        self.modules.append(module)
+        return self
+
+    def children(self):
+        return list(self.modules)
+
+    def training(self):
+        self.train_mode = True
+        for m in self.modules:
+            m.training()
+        return self
+
+    def evaluate(self):
+        self.train_mode = False
+        for m in self.modules:
+            m.evaluate()
+        return self
+
+
+class Criterion:
+    """Loss base (reference: AbstractCriterion.scala).
+
+    Core: pure ``apply(input, target) -> scalar loss``.  Facade ``forward`` /
+    ``backward`` mirror the reference; backward is ``jax.grad`` wrt input.
+    """
+
+    size_average: bool = True
+
+    def apply(self, input: Activity, target: Activity) -> jnp.ndarray:
+        raise NotImplementedError(type(self).__name__)
+
+    def forward(self, input: Activity, target: Activity):
+        self.output = self.apply(input, target)
+        return self.output
+
+    def backward(self, input: Activity, target: Activity):
+        self.grad_input = jax.grad(lambda x: self.apply(x, target))(input)
+        return self.grad_input
+
+    def __call__(self, input, target):
+        return self.forward(input, target)
+
+
+class Identity(Module):
+    """Reference: nn/Identity.scala."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state

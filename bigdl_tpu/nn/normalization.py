@@ -1,0 +1,178 @@
+"""Normalization and regularization layers.
+
+Reference: nn/BatchNormalization.scala:51, nn/SpatialBatchNormalization.scala,
+nn/Dropout.scala, nn/SpatialCrossMapLRN.scala, nn/Normalize.scala.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+class BatchNormalization(Module):
+    """Batch norm over (N, C) inputs (reference: nn/BatchNormalization.scala:51).
+
+    Running stats follow the reference/Torch update:
+    ``running = (1 - momentum) * running + momentum * batch`` with the
+    *unbiased* batch variance feeding the running estimate while the biased
+    one normalises the batch.
+    """
+
+    reduce_axes = (0,)
+
+    def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True, name=None):
+        super().__init__(name)
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+
+    def setup(self, rng, input_spec):
+        params = {}
+        if self.affine:
+            params = {
+                "weight": jnp.ones((self.n_output,), jnp.float32),
+                "bias": jnp.zeros((self.n_output,), jnp.float32),
+            }
+        state = {
+            "running_mean": jnp.zeros((self.n_output,), jnp.float32),
+            "running_var": jnp.ones((self.n_output,), jnp.float32),
+        }
+        return params, state
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x32 = input.astype(jnp.float32)
+        if training:
+            mean = jnp.mean(x32, axis=self.reduce_axes)
+            var = jnp.var(x32, axis=self.reduce_axes)
+            n = x32.size // x32.shape[-1]
+            unbiased = var * n / max(n - 1, 1)
+            m = self.momentum
+            state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+        inv = lax.rsqrt(var + self.eps)
+        scale, shift = inv, -mean * inv
+        if self.affine:
+            scale = scale * params["weight"]
+            shift = shift * params["weight"] + params["bias"]
+        y = x32 * scale + shift
+        return y.astype(input.dtype), state
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """Batch norm over NHWC images, per-channel (reference: nn/SpatialBatchNormalization.scala)."""
+
+    reduce_axes = (0, 1, 2)
+
+
+class LayerNorm(Module):
+    """Layer norm over the last dim.  Not in the reference (pre-transformer);
+    required by the transformer/long-context stack."""
+
+    def __init__(self, n_output, eps=1e-6, name=None):
+        super().__init__(name)
+        self.n_output = n_output
+        self.eps = eps
+
+    def setup(self, rng, input_spec):
+        return {
+            "weight": jnp.ones((self.n_output,), jnp.float32),
+            "bias": jnp.zeros((self.n_output,), jnp.float32),
+        }, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x32 = input.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * lax.rsqrt(var + self.eps)
+        y = y * params["weight"] + params["bias"]
+        return y.astype(input.dtype), state
+
+
+class RMSNorm(Module):
+    """RMS norm (transformer stack; not in the reference)."""
+
+    def __init__(self, n_output, eps=1e-6, name=None):
+        super().__init__(name)
+        self.n_output = n_output
+        self.eps = eps
+
+    def setup(self, rng, input_spec):
+        return {"weight": jnp.ones((self.n_output,), jnp.float32)}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x32 = input.astype(jnp.float32)
+        y = x32 * lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) + self.eps)
+        return (y * params["weight"]).astype(input.dtype), state
+
+
+class Dropout(Module):
+    """Inverted dropout (reference: nn/Dropout.scala -- scales by 1/(1-p) at train)."""
+
+    def __init__(self, init_p=0.5, name=None):
+        super().__init__(name)
+        self.p = init_p
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return input, state
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, jnp.shape(input))
+        return jnp.where(mask, input / keep, 0.0).astype(input.dtype), state
+
+
+class SpatialCrossMapLRN(Module):
+    """Local response normalization across channels (reference: nn/SpatialCrossMapLRN.scala).
+
+    NHWC layout: channel window sum via a 1-D reduce_window over the last axis.
+    """
+
+    def __init__(self, size=5, alpha=1.0, beta=0.75, k=1.0, data_format="NHWC", name=None):
+        super().__init__(name)
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.data_format = data_format
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        half = (self.size - 1) // 2
+        sq = jnp.square(x.astype(jnp.float32))
+        window_sum = lax.reduce_window(
+            sq, 0.0, lax.add,
+            (1, 1, 1, self.size), (1, 1, 1, 1),
+            ((0, 0), (0, 0), (0, 0), (half, self.size - 1 - half)),
+        )
+        denom = jnp.power(self.k + self.alpha / self.size * window_sum, self.beta)
+        y = (x.astype(jnp.float32) / denom).astype(input.dtype)
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y, state
+
+
+class Normalize(Module):
+    """L_p normalisation over the last dim (reference: nn/Normalize.scala)."""
+
+    def __init__(self, p=2.0, eps=1e-10, name=None):
+        super().__init__(name)
+        self.p = p
+        self.eps = eps
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(input), axis=-1, keepdims=True)
+        else:
+            norm = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(input), self.p), axis=-1, keepdims=True),
+                1.0 / self.p,
+            )
+        return input / (norm + self.eps), state

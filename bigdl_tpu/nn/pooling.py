@@ -1,0 +1,118 @@
+"""Pooling layers.
+
+Reference: nn/SpatialMaxPooling.scala, nn/SpatialAveragePooling.scala.
+Implemented with ``lax.reduce_window`` -- XLA maps these to the VPU with
+fused padding; no explicit im2col-style buffers.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+def _pool_pads(in_size, k, s, p, ceil_mode):
+    """(lo, hi) padding per spatial dim honoring the reference's floor/ceil modes."""
+    if ceil_mode:
+        out = int(np.ceil((in_size + 2 * p - k) / s)) + 1
+        # Torch/BigDL rule: last window must start inside the (left-)padded input.
+        if (out - 1) * s >= in_size + p:
+            out -= 1
+    else:
+        out = int(np.floor((in_size + 2 * p - k) / s)) + 1
+    hi = max((out - 1) * s + k - in_size - p, p)
+    return (p, hi)
+
+
+class _SpatialPool(Module):
+    def __init__(
+        self, kernel_w, kernel_h, stride_w=None, stride_h=None, pad_w=0,
+        pad_h=0, ceil_mode=False, data_format="NHWC", name=None,
+    ):
+        super().__init__(name)
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h or kernel_h, stride_w or kernel_w)
+        self.pad = (pad_h, pad_w)
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def _window(self, x):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        pads_h = _pool_pads(x.shape[1], kh, sh, ph, self.ceil_mode)
+        pads_w = _pool_pads(x.shape[2], kw, sw, pw, self.ceil_mode)
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        padding = ((0, 0), pads_h, pads_w, (0, 0))
+        return dims, strides, padding
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        y = self._pool(x)
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y, state
+
+
+class SpatialMaxPooling(_SpatialPool):
+    """Reference: nn/SpatialMaxPooling.scala (floor mode default, .ceil() to switch)."""
+
+    def _pool(self, x):
+        dims, strides, padding = self._window(x)
+        return lax.reduce_window(
+            x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else
+            jnp.iinfo(x.dtype).min,
+            lax.max, dims, strides, padding,
+        )
+
+
+class SpatialAveragePooling(_SpatialPool):
+    """Reference: nn/SpatialAveragePooling.scala.
+
+    ``count_include_pad=True`` (the reference/Torch default) divides by the
+    full kernel size; otherwise by the number of valid elements.
+    """
+
+    def __init__(self, *args, count_include_pad=True, **kw):
+        super().__init__(*args, **kw)
+        self.count_include_pad = count_include_pad
+
+    def _pool(self, x):
+        dims, strides, padding = self._window(x)
+        summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+        if self.count_include_pad:
+            return summed / (self.kernel[0] * self.kernel[1])
+        ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+        return summed / counts
+
+
+class GlobalAveragePooling2D(Module):
+    """Mean over spatial dims (keras-layer analogue: nn/keras/GlobalAveragePooling2D.scala)."""
+
+    def __init__(self, data_format="NHWC", name=None):
+        super().__init__(name)
+        self.data_format = data_format
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axes = (1, 2) if self.data_format == "NHWC" else (2, 3)
+        return jnp.mean(input, axis=axes), state
+
+
+class GlobalMaxPooling2D(Module):
+    def __init__(self, data_format="NHWC", name=None):
+        super().__init__(name)
+        self.data_format = data_format
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axes = (1, 2) if self.data_format == "NHWC" else (2, 3)
+        return jnp.max(input, axis=axes), state

@@ -1,0 +1,168 @@
+"""Shape-manipulation layers.
+
+Reference: nn/Reshape.scala, nn/View.scala, nn/Squeeze.scala,
+nn/Unsqueeze.scala, nn/Transpose.scala, nn/Select.scala, nn/Narrow.scala,
+nn/InferReshape.scala, nn/Contiguous.scala, nn/Padding.scala.
+All dims 0-based; batch axis is 0.
+"""
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class Reshape(Module):
+    """Reshape the non-batch dims to ``size`` (reference: nn/Reshape.scala).
+
+    ``batch_mode=None`` mirrors the reference's auto behaviour: the batch dim
+    is preserved; with ``batch_mode=False`` the whole tensor (incl. batch) is
+    reshaped.
+    """
+
+    def __init__(self, size, batch_mode=None, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self.batch_mode is False:
+            return jnp.reshape(input, self.size), state
+        return jnp.reshape(input, (input.shape[0],) + self.size), state
+
+
+class View(Reshape):
+    """Reference: nn/View.scala -- same as Reshape with -1 inference allowed."""
+
+
+class InferReshape(Module):
+    """Reshape with -1 (infer) and 0 (copy input dim) entries
+    (reference: nn/InferReshape.scala)."""
+
+    def __init__(self, size, batch_mode=False, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        in_shape = input.shape[1:] if self.batch_mode else input.shape
+        out = [in_shape[i] if s == 0 else s for i, s in enumerate(self.size)]
+        if self.batch_mode:
+            out = [input.shape[0]] + out
+        return jnp.reshape(input, tuple(out)), state
+
+
+class Flatten(Module):
+    """Collapse all non-batch dims (keras analogue; nn/keras/Flatten.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.reshape(input, (input.shape[0], -1)), state
+
+
+class Squeeze(Module):
+    def __init__(self, dim=None, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.squeeze(input, axis=self.dim), state
+
+
+class Unsqueeze(Module):
+    def __init__(self, dim, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.expand_dims(input, axis=self.dim), state
+
+
+class Transpose(Module):
+    """Swap listed axis pairs in order (reference: nn/Transpose.scala)."""
+
+    def __init__(self, permutations, name=None):
+        super().__init__(name)
+        self.permutations = permutations
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        for a, b in self.permutations:
+            x = jnp.swapaxes(x, a, b)
+        return x, state
+
+
+class Permute(Module):
+    """Full axis permutation (keras analogue)."""
+
+    def __init__(self, dims, name=None):
+        super().__init__(name)
+        self.dims = tuple(dims)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.transpose(input, self.dims), state
+
+
+class Select(Module):
+    """Select index ``index`` along ``dim`` (reference: nn/Select.scala)."""
+
+    def __init__(self, dim, index, name=None):
+        super().__init__(name)
+        self.dim = dim
+        self.index = index
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.take(input, self.index, axis=self.dim), state
+
+
+class Narrow(Module):
+    """Slice ``length`` elements from ``offset`` along ``dim`` (reference: nn/Narrow.scala)."""
+
+    def __init__(self, dim, offset, length, name=None):
+        super().__init__(name)
+        self.dim = dim
+        self.offset = offset
+        self.length = length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        length = self.length
+        if length < 0:
+            length = input.shape[self.dim] - self.offset + 1 + length
+        idx = [slice(None)] * input.ndim
+        idx[self.dim] = slice(self.offset, self.offset + length)
+        return input[tuple(idx)], state
+
+
+class Contiguous(Module):
+    """No-op on TPU (reference: nn/Contiguous.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class Padding(Module):
+    """Zero-pad ``pad`` entries along ``dim`` (neg = before, pos = after)
+    (reference: nn/Padding.scala)."""
+
+    def __init__(self, dim, pad, value=0.0, name=None):
+        super().__init__(name)
+        self.dim = dim
+        self.pad = pad
+        self.value = value
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        cfg = [(0, 0)] * input.ndim
+        cfg[self.dim] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, cfg, constant_values=self.value), state
+
+
+class Replicate(Module):
+    """Repeat the tensor ``n_features`` times along a new ``dim``
+    (reference: nn/Replicate.scala)."""
+
+    def __init__(self, n_features, dim=0, name=None):
+        super().__init__(name)
+        self.n_features = n_features
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.repeat(jnp.expand_dims(input, self.dim), self.n_features,
+                          axis=self.dim), state

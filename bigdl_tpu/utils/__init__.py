@@ -1,0 +1,3 @@
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.random_generator import RNG, RandomGenerator
+from bigdl_tpu.utils.shape import spec_of, tree_add, tree_zeros_like

@@ -1,0 +1,109 @@
+"""Runtime bring-up: device discovery and mesh construction.
+
+The reference's ``Engine`` (utils/Engine.scala:105) parses the Spark conf to
+learn node/core counts, selects an engine type (MklBlas vs MklDnn) and owns
+the thread pools.  On TPU the runtime is the XLA client: ``Engine.init``
+optionally calls ``jax.distributed.initialize`` for multi-host, discovers the
+device grid, and builds the ``jax.sharding.Mesh`` that every distributed
+component (DistriOptimizer, ZeRO-1 chunking, sequence parallelism) shards
+over.  There are no thread pools to manage -- XLA owns device threading --
+so the Engine is mostly mesh bookkeeping plus global config.
+"""
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class Engine:
+    """Singleton runtime configuration (reference: utils/Engine.scala)."""
+
+    _initialized = False
+    _mesh: Optional[Mesh] = None
+    _node_number: int = 1
+    _core_number: int = 1  # devices per host on TPU
+
+    #: axis names used by the default data-parallel mesh
+    DATA_AXIS = "data"
+    MODEL_AXIS = "model"
+
+    @classmethod
+    def init(
+        cls,
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+        mesh_shape: Optional[Tuple[int, ...]] = None,
+        axis_names: Sequence[str] = ("data",),
+    ) -> "Engine":
+        """Initialise the runtime.
+
+        Single-host: just discovers local devices.  Multi-host: pass the
+        coordinator address (the analogue of the reference's Spark-conf
+        executor discovery, utils/Engine.scala:113-116) and JAX's distributed
+        runtime handles rendezvous; collectives then ride ICI within a slice
+        and DCN across slices automatically.
+        """
+        if coordinator_address is not None and not cls._initialized:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        cls._node_number = jax.process_count()
+        cls._core_number = jax.local_device_count()
+        cls._mesh = cls.build_mesh(mesh_shape, axis_names)
+        cls._initialized = True
+        return cls
+
+    @classmethod
+    def build_mesh(
+        cls,
+        mesh_shape: Optional[Tuple[int, ...]] = None,
+        axis_names: Sequence[str] = ("data",),
+    ) -> Mesh:
+        """Build a Mesh over all devices.
+
+        Default: a 1-D data-parallel mesh over every chip -- the analogue of
+        the reference's one-model-replica-per-core layout.  Pass a
+        ``mesh_shape`` like ``(2, 4)`` with ``axis_names=("data", "model")``
+        for hybrid data+model parallelism.
+        """
+        devices = np.asarray(jax.devices())
+        if mesh_shape is None:
+            mesh_shape = (devices.size,)
+        if int(np.prod(mesh_shape)) != devices.size:
+            raise ValueError(
+                f"mesh_shape {mesh_shape} does not cover {devices.size} devices"
+            )
+        return Mesh(devices.reshape(mesh_shape), axis_names=tuple(axis_names))
+
+    @classmethod
+    def mesh(cls) -> Mesh:
+        if cls._mesh is None:
+            cls._mesh = cls.build_mesh()
+        return cls._mesh
+
+    @classmethod
+    def set_mesh(cls, mesh: Mesh):
+        cls._mesh = mesh
+
+    @classmethod
+    def node_number(cls) -> int:
+        return cls._node_number if cls._initialized else jax.process_count()
+
+    @classmethod
+    def core_number(cls) -> int:
+        return cls._core_number if cls._initialized else jax.local_device_count()
+
+    @classmethod
+    def device_count(cls) -> int:
+        return jax.device_count()
+
+    @classmethod
+    def reset(cls):
+        cls._initialized = False
+        cls._mesh = None

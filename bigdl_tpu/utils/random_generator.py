@@ -1,0 +1,51 @@
+"""Deterministic RNG for reproducible init across hosts.
+
+The reference ships its own Mersenne-Twister so every node derives identical
+weights from a seed (utils/RandomGenerator.scala:23,56,116).  On TPU the same
+guarantee comes for free from JAX's counter-based threefry PRNG: every host
+that calls ``RNG.set_seed(s)`` and then draws the same sequence of keys gets
+bitwise-identical results, with no communication.
+"""
+
+import threading
+
+import jax
+
+
+class RandomGenerator:
+    """A splittable PRNG stream with global-seed semantics.
+
+    ``set_seed`` resets the stream; ``next_key`` returns a fresh ``jax.random``
+    key, advancing the stream.  Thread-safe (the reference keeps a thread-local
+    generator; a lock is simpler and the facade is not hot-path).
+    """
+
+    def __init__(self, seed: int = 1):
+        self._lock = threading.Lock()
+        self.set_seed(seed)
+
+    def set_seed(self, seed: int) -> "RandomGenerator":
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(self._seed)
+        return self
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def uniform(self, shape, low=0.0, high=1.0, dtype="float32"):
+        return jax.random.uniform(
+            self.next_key(), shape, minval=low, maxval=high, dtype=dtype
+        )
+
+    def normal(self, shape, mean=0.0, stdv=1.0, dtype="float32"):
+        return mean + stdv * jax.random.normal(self.next_key(), shape, dtype=dtype)
+
+
+#: Global generator, mirroring ``RandomGenerator.RNG`` in the reference.
+RNG = RandomGenerator()

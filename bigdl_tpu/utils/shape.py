@@ -1,0 +1,33 @@
+"""Pytree / shape helpers shared across the framework.
+
+Activities (layer inputs/outputs) are either a single ``jax.Array`` or a
+nested tuple/list of arrays -- the TPU-native analogue of the reference's
+``Activity = Tensor | Table`` (nn/abstractnn/Activity.scala).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def spec_of(activity):
+    """Abstract ShapeDtypeStruct pytree for a concrete (or abstract) activity."""
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a)), activity
+    )
+
+
+def tree_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_size(tree):
+    """Total number of elements over all leaves."""
+    return sum(x.size for x in jax.tree.leaves(tree))

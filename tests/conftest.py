@@ -1,0 +1,34 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax import.
+
+Mirrors the reference's "distributed tests without a cluster" strategy
+(local[N] SparkContext, SURVEY.md section 4.4): multi-chip behaviour is
+exercised on 8 virtual CPU devices via
+``--xla_force_host_platform_device_count``.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+# Golden tests compare against torch fp32; disable any reduced-precision
+# matmul path (the perf path opts into bf16 explicitly instead).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    from bigdl_tpu.utils.random_generator import RNG
+
+    RNG.set_seed(42)
+    np.random.seed(42)
+    yield

@@ -1,0 +1,264 @@
+"""Golden tests vs torch CPU for conv / pooling / normalization layers."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+
+
+def assert_close(a, b, rtol=1e-4, atol=1e-4):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def hwio_to_oihw(w):
+    return np.transpose(np.asarray(w), (3, 2, 0, 1))
+
+
+class TestSpatialConvolution:
+    @pytest.mark.parametrize("stride,pad", [(1, 0), (2, 1), (1, 2)])
+    def test_forward_vs_torch_nchw(self, stride, pad):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        conv = nn.SpatialConvolution(3, 5, 3, 3, stride, stride, pad, pad,
+                                     data_format="NCHW")
+        y = conv.forward(jnp.asarray(x))
+        tw = torch.tensor(hwio_to_oihw(conv._params["weight"]))
+        tb = torch.tensor(np.asarray(conv._params["bias"]))
+        ref = F.conv2d(torch.tensor(x), tw, tb, stride=stride, padding=pad)
+        assert_close(y, ref.detach().numpy())
+
+    def test_nhwc_matches_nchw(self):
+        x = np.random.randn(2, 4, 6, 6).astype(np.float32)
+        conv_nchw = nn.SpatialConvolution(4, 6, 3, 3, data_format="NCHW")
+        y1 = conv_nchw.forward(jnp.asarray(x))
+        conv_nhwc = nn.SpatialConvolution(4, 6, 3, 3, data_format="NHWC")
+        conv_nhwc.build(jnp.ones((2, 6, 6, 4)))
+        conv_nhwc._params = conv_nchw._params
+        y2 = conv_nhwc.forward(jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
+        assert_close(y1, np.transpose(np.asarray(y2), (0, 3, 1, 2)), atol=1e-4)
+
+    def test_groups(self):
+        x = np.random.randn(1, 4, 5, 5).astype(np.float32)
+        conv = nn.SpatialConvolution(4, 6, 3, 3, n_group=2, data_format="NCHW")
+        y = conv.forward(jnp.asarray(x))
+        ref = F.conv2d(torch.tensor(x),
+                       torch.tensor(hwio_to_oihw(conv._params["weight"])),
+                       torch.tensor(np.asarray(conv._params["bias"])), groups=2)
+        assert_close(y, ref.detach().numpy())
+
+    def test_dilation(self):
+        x = np.random.randn(1, 2, 9, 9).astype(np.float32)
+        conv = nn.SpatialDilatedConvolution(2, 3, 3, 3, dilation_w=2,
+                                            dilation_h=2, data_format="NCHW")
+        y = conv.forward(jnp.asarray(x))
+        ref = F.conv2d(torch.tensor(x),
+                       torch.tensor(hwio_to_oihw(conv._params["weight"])),
+                       torch.tensor(np.asarray(conv._params["bias"])), dilation=2)
+        assert_close(y, ref.detach().numpy())
+
+    def test_backward_grads(self):
+        x = np.random.randn(2, 3, 6, 6).astype(np.float32)
+        conv = nn.SpatialConvolution(3, 4, 3, 3, data_format="NCHW")
+        y = conv.forward(jnp.asarray(x))
+        g = np.random.randn(*y.shape).astype(np.float32)
+        gx = conv.backward(jnp.asarray(x), jnp.asarray(g))
+
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(hwio_to_oihw(conv._params["weight"]), requires_grad=True)
+        tb = torch.tensor(np.asarray(conv._params["bias"]), requires_grad=True)
+        F.conv2d(tx, tw, tb).backward(torch.tensor(g))
+        assert_close(gx, tx.grad.numpy(), atol=1e-3)
+        _, grads = conv.parameters()
+        assert_close(hwio_to_oihw(grads["weight"]), tw.grad.numpy(), atol=1e-3)
+        assert_close(grads["bias"], tb.grad.numpy(), atol=1e-3)
+
+
+class TestSpatialFullConvolution:
+    @pytest.mark.parametrize("stride,pad,adj", [(2, 0, 0), (2, 1, 1), (1, 1, 0)])
+    def test_vs_torch(self, stride, pad, adj):
+        x = np.random.randn(1, 3, 5, 5).astype(np.float32)
+        deconv = nn.SpatialFullConvolution(3, 4, 3, 3, stride, stride, pad, pad,
+                                           adj, adj, data_format="NCHW")
+        y = deconv.forward(jnp.asarray(x))
+        # torch conv_transpose2d weight layout: (in, out, kh, kw)
+        w = np.transpose(np.asarray(deconv._params["weight"]), (2, 3, 0, 1))
+        ref = F.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w),
+            torch.tensor(np.asarray(deconv._params["bias"])),
+            stride=stride, padding=pad, output_padding=adj)
+        assert_close(y, ref.detach().numpy(), atol=1e-4)
+
+
+class TestTemporalConvolution:
+    def test_vs_torch(self):
+        x = np.random.randn(2, 10, 6).astype(np.float32)  # N, T, C
+        conv = nn.TemporalConvolution(6, 8, 3)
+        y = conv.forward(jnp.asarray(x))
+        # torch conv1d: input (N, C, T), weight (out, in, k)
+        w = np.transpose(np.asarray(conv._params["weight"]), (2, 1, 0))
+        ref = F.conv1d(torch.tensor(np.transpose(x, (0, 2, 1))), torch.tensor(w),
+                       torch.tensor(np.asarray(conv._params["bias"])))
+        assert_close(y, np.transpose(ref.detach().numpy(), (0, 2, 1)))
+
+
+class TestPooling:
+    @pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1), (3, 1, 1)])
+    def test_maxpool_vs_torch(self, k, s, p):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        pool = nn.SpatialMaxPooling(k, k, s, s, p, p, data_format="NCHW")
+        y = pool.forward(jnp.asarray(x))
+        ref = F.max_pool2d(torch.tensor(x), k, s, p)
+        assert_close(y, ref.numpy())
+
+    @pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1)])
+    def test_maxpool_ceil(self, k, s, p):
+        x = np.random.randn(2, 3, 7, 7).astype(np.float32)
+        pool = nn.SpatialMaxPooling(k, k, s, s, p, p, data_format="NCHW").ceil()
+        y = pool.forward(jnp.asarray(x))
+        ref = F.max_pool2d(torch.tensor(x), k, s, p, ceil_mode=True)
+        assert_close(y, ref.numpy())
+
+    @pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1)])
+    def test_avgpool_vs_torch(self, k, s, p):
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        pool = nn.SpatialAveragePooling(k, k, s, s, p, p, data_format="NCHW")
+        y = pool.forward(jnp.asarray(x))
+        ref = F.avg_pool2d(torch.tensor(x), k, s, p)
+        assert_close(y, ref.numpy())
+
+    def test_global_pool(self):
+        x = np.random.randn(2, 5, 5, 3).astype(np.float32)
+        y = nn.GlobalAveragePooling2D().forward(jnp.asarray(x))
+        assert_close(y, x.mean(axis=(1, 2)))
+
+
+class TestBatchNorm:
+    def test_train_eval_vs_torch(self):
+        x = np.random.randn(8, 5).astype(np.float32)
+        bn = nn.BatchNormalization(5)
+        tbn = torch.nn.BatchNorm1d(5)
+        y = bn.forward(jnp.asarray(x))
+        ty = tbn(torch.tensor(x))
+        assert_close(y, ty.detach().numpy(), atol=1e-4)
+        assert_close(bn._state["running_mean"], tbn.running_mean.numpy(), atol=1e-5)
+        assert_close(bn._state["running_var"], tbn.running_var.numpy(), atol=1e-4)
+
+        bn.evaluate()
+        tbn.eval()
+        x2 = np.random.randn(4, 5).astype(np.float32)
+        assert_close(bn.forward(jnp.asarray(x2)),
+                     tbn(torch.tensor(x2)).detach().numpy(), atol=1e-4)
+
+    def test_spatial_bn_vs_torch(self):
+        x = np.random.randn(4, 3, 6, 6).astype(np.float32)
+        bn = nn.SpatialBatchNormalization(3)
+        tbn = torch.nn.BatchNorm2d(3)
+        y = bn.forward(jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
+        ty = tbn(torch.tensor(x))
+        assert_close(np.transpose(np.asarray(y), (0, 3, 1, 2)),
+                     ty.detach().numpy(), atol=1e-4)
+        assert_close(bn._state["running_var"], tbn.running_var.numpy(), atol=1e-4)
+
+
+class TestLRN:
+    def test_vs_torch(self):
+        x = np.random.randn(2, 7, 5, 5).astype(np.float32)
+        lrn = nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0, data_format="NCHW")
+        y = lrn.forward(jnp.asarray(x))
+        ref = F.local_response_norm(torch.tensor(x), 5, 1.0, 0.75, 1.0)
+        assert_close(y, ref.numpy(), atol=1e-4)
+
+
+class TestDropout:
+    def test_train_scales(self):
+        x = jnp.ones((1000,))
+        drop = nn.Dropout(0.3)
+        y = np.asarray(drop.forward(x))
+        kept = y > 0
+        assert 0.6 < kept.mean() < 0.8
+        np.testing.assert_allclose(y[kept], 1.0 / 0.7, rtol=1e-5)
+
+    def test_eval_identity(self):
+        drop = nn.Dropout(0.5).evaluate()
+        x = jnp.ones((10,))
+        assert_close(drop.forward(x), np.ones(10))
+
+
+class TestCriterions:
+    def test_class_nll(self):
+        logp = np.log(np.random.dirichlet(np.ones(4), 6)).astype(np.float32)
+        t = np.random.randint(0, 4, 6)
+        loss = nn.ClassNLLCriterion().forward(jnp.asarray(logp), jnp.asarray(t))
+        ref = F.nll_loss(torch.tensor(logp), torch.tensor(t))
+        assert_close(loss, ref.numpy())
+
+    def test_cross_entropy(self):
+        logits = np.random.randn(6, 4).astype(np.float32)
+        t = np.random.randint(0, 4, 6)
+        loss = nn.CrossEntropyCriterion().forward(jnp.asarray(logits), jnp.asarray(t))
+        ref = F.cross_entropy(torch.tensor(logits), torch.tensor(t))
+        assert_close(loss, ref.numpy())
+        g = nn.CrossEntropyCriterion().backward(jnp.asarray(logits), jnp.asarray(t))
+        tl = torch.tensor(logits, requires_grad=True)
+        F.cross_entropy(tl, torch.tensor(t)).backward()
+        assert_close(g, tl.grad.numpy())
+
+    def test_mse_abs_smooth(self):
+        x = np.random.randn(5, 3).astype(np.float32)
+        t = np.random.randn(5, 3).astype(np.float32)
+        assert_close(nn.MSECriterion().forward(jnp.asarray(x), jnp.asarray(t)),
+                     F.mse_loss(torch.tensor(x), torch.tensor(t)).numpy())
+        assert_close(nn.AbsCriterion().forward(jnp.asarray(x), jnp.asarray(t)),
+                     F.l1_loss(torch.tensor(x), torch.tensor(t)).numpy())
+        assert_close(nn.SmoothL1Criterion().forward(jnp.asarray(x), jnp.asarray(t)),
+                     F.smooth_l1_loss(torch.tensor(x), torch.tensor(t)).numpy())
+
+    def test_bce(self):
+        x = np.random.uniform(0.05, 0.95, (4, 3)).astype(np.float32)
+        t = np.random.randint(0, 2, (4, 3)).astype(np.float32)
+        assert_close(nn.BCECriterion().forward(jnp.asarray(x), jnp.asarray(t)),
+                     F.binary_cross_entropy(torch.tensor(x), torch.tensor(t)).numpy())
+        logits = np.random.randn(4, 3).astype(np.float32)
+        assert_close(
+            nn.BCEWithLogitsCriterion().forward(jnp.asarray(logits), jnp.asarray(t)),
+            F.binary_cross_entropy_with_logits(torch.tensor(logits),
+                                               torch.tensor(t)).numpy())
+
+    def test_kl_div(self):
+        logp = np.log(np.random.dirichlet(np.ones(4), 5)).astype(np.float32)
+        t = np.random.dirichlet(np.ones(4), 5).astype(np.float32)
+        assert_close(
+            nn.DistKLDivCriterion().forward(jnp.asarray(logp), jnp.asarray(t)),
+            F.kl_div(torch.tensor(logp), torch.tensor(t),
+                     reduction="batchmean").numpy())
+
+    def test_padding_mask(self):
+        logp = np.log(np.random.dirichlet(np.ones(4), 4)).astype(np.float32)
+        t = np.array([1, 2, -1, -1])
+        loss = nn.ClassNLLCriterion(padding_value=-1).forward(
+            jnp.asarray(logp), jnp.asarray(t))
+        expect = -(logp[0, 1] + logp[1, 2]) / 2
+        assert_close(loss, expect, rtol=1e-5)
+
+    def test_parallel_multi(self):
+        x = np.random.randn(4, 3).astype(np.float32)
+        t = np.random.randn(4, 3).astype(np.float32)
+        pc = nn.ParallelCriterion().add(nn.MSECriterion(), 0.5).add(
+            nn.AbsCriterion(), 2.0)
+        got = pc.forward((jnp.asarray(x), jnp.asarray(x)),
+                         (jnp.asarray(t), jnp.asarray(t)))
+        want = (0.5 * F.mse_loss(torch.tensor(x), torch.tensor(t))
+                + 2.0 * F.l1_loss(torch.tensor(x), torch.tensor(t))).numpy()
+        assert_close(got, want)
+
+    def test_time_distributed(self):
+        x = np.random.randn(2, 5, 4).astype(np.float32)
+        t = np.random.randint(0, 4, (2, 5))
+        tdc = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        got = tdc.forward(jnp.asarray(x), jnp.asarray(t))
+        ref = F.cross_entropy(torch.tensor(x.reshape(10, 4)),
+                              torch.tensor(t.reshape(10)))
+        assert_close(got, ref.numpy())

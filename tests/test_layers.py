@@ -1,0 +1,231 @@
+"""Unit + golden tests for core layers.
+
+Strategy mirrors the reference (SURVEY.md section 4): golden-reference
+numerics vs an external engine -- here torch CPU replaces Torch7/Keras --
+plus finite-difference gradient checks (GradientChecker analogue).
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.random_generator import RNG
+
+
+def t2n(t):
+    return t.detach().numpy()
+
+
+def assert_close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def grad_check(module, x, eps=1e-3, tol=2e-2):
+    """Finite-difference gradient check (reference: GradientChecker)."""
+    module.build(jax.ShapeDtypeStruct(x.shape, jnp.float32))
+    module.evaluate()
+
+    def loss(xx):
+        y, _ = module.apply(module._params, module._state, xx, training=False)
+        return jnp.sum(y * jnp.cos(jnp.arange(y.size).reshape(y.shape) * 0.1))
+
+    analytic = jax.grad(loss)(jnp.asarray(x))
+    flat = x.reshape(-1).copy()
+    num = np.zeros_like(flat)
+    for i in range(min(flat.size, 24)):
+        up, dn = flat.copy(), flat.copy()
+        up[i] += eps
+        dn[i] -= eps
+        num[i] = (loss(jnp.asarray(up.reshape(x.shape)))
+                  - loss(jnp.asarray(dn.reshape(x.shape)))) / (2 * eps)
+    np.testing.assert_allclose(
+        np.asarray(analytic).reshape(-1)[:24], num[:24], rtol=tol, atol=tol
+    )
+
+
+class TestLinear:
+    def test_forward_vs_torch(self):
+        x = np.random.randn(4, 7).astype(np.float32)
+        layer = nn.Linear(7, 5)
+        y = layer.forward(jnp.asarray(x))
+        w, b = layer._params["weight"], layer._params["bias"]
+        ref = F.linear(torch.tensor(x), torch.tensor(np.asarray(w)),
+                       torch.tensor(np.asarray(b)))
+        assert_close(y, t2n(ref))
+
+    def test_backward_matches_torch(self):
+        x = np.random.randn(3, 6).astype(np.float32)
+        g = np.random.randn(3, 4).astype(np.float32)
+        layer = nn.Linear(6, 4)
+        y = layer.forward(jnp.asarray(x))
+        gx = layer.backward(jnp.asarray(x), jnp.asarray(g))
+
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(np.asarray(layer._params["weight"]), requires_grad=True)
+        tb = torch.tensor(np.asarray(layer._params["bias"]), requires_grad=True)
+        ty = F.linear(tx, tw, tb)
+        ty.backward(torch.tensor(g))
+        assert_close(gx, t2n(tx.grad))
+        _, grads = layer.parameters()
+        assert_close(grads["weight"], t2n(tw.grad))
+        assert_close(grads["bias"], t2n(tb.grad))
+
+    def test_grad_accumulation(self):
+        x = jnp.ones((2, 3))
+        layer = nn.Linear(3, 2)
+        layer.forward(x)
+        layer.backward(x, jnp.ones((2, 2)))
+        g1 = np.asarray(layer.parameters()[1]["weight"])
+        layer.backward(x, jnp.ones((2, 2)))
+        g2 = np.asarray(layer.parameters()[1]["weight"])
+        assert_close(g2, 2 * g1)
+        layer.zero_grad_parameters()
+        assert_close(layer.parameters()[1]["weight"], np.zeros_like(g1))
+
+
+class TestActivations:
+    @pytest.mark.parametrize(
+        "mod,tfn",
+        [
+            (nn.ReLU(), F.relu),
+            (nn.Tanh(), torch.tanh),
+            (nn.Sigmoid(), torch.sigmoid),
+            (nn.ELU(), F.elu),
+            (nn.SoftPlus(), F.softplus),
+            (nn.SoftSign(), F.softsign),
+            (nn.LeakyReLU(0.1), lambda t: F.leaky_relu(t, 0.1)),
+            (nn.HardTanh(), F.hardtanh),
+            (nn.ReLU6(), F.relu6),
+            (nn.LogSigmoid(), F.logsigmoid),
+            (nn.SoftShrink(0.5), lambda t: F.softshrink(t, 0.5)),
+            (nn.HardShrink(0.5), lambda t: F.hardshrink(t, 0.5)),
+        ],
+    )
+    def test_vs_torch(self, mod, tfn):
+        x = np.random.randn(3, 8).astype(np.float32)
+        assert_close(mod.forward(jnp.asarray(x)), t2n(tfn(torch.tensor(x))), atol=2e-4)
+
+    def test_softmax_family(self):
+        x = np.random.randn(3, 10).astype(np.float32)
+        assert_close(nn.SoftMax().forward(jnp.asarray(x)),
+                     t2n(F.softmax(torch.tensor(x), -1)))
+        assert_close(nn.LogSoftMax().forward(jnp.asarray(x)),
+                     t2n(F.log_softmax(torch.tensor(x), -1)))
+
+    def test_prelu(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        y = nn.PReLU().forward(jnp.asarray(x))
+        assert_close(y, t2n(F.prelu(torch.tensor(x), torch.tensor([0.25]))))
+
+
+class TestContainers:
+    def test_sequential(self):
+        model = nn.Sequential().add(nn.Linear(4, 8)).add(nn.ReLU()).add(nn.Linear(8, 2))
+        x = jnp.asarray(np.random.randn(5, 4).astype(np.float32))
+        y = model.forward(x)
+        assert y.shape == (5, 2)
+        gx = model.backward(x, jnp.ones((5, 2)))
+        assert gx.shape == x.shape
+        params, grads = model.parameters()
+        assert set(params.keys()) == {"0", "1", "2"}
+        assert np.abs(np.asarray(grads["0"]["weight"])).sum() > 0
+
+    def test_concat_table_and_cadd(self):
+        model = nn.Sequential().add(
+            nn.ConcatTable().add(nn.Identity()).add(nn.MulConstant(2.0))
+        ).add(nn.CAddTable())
+        x = jnp.ones((2, 3))
+        assert_close(model.forward(x), 3 * np.ones((2, 3)))
+
+    def test_parallel_table(self):
+        model = nn.ParallelTable().add(nn.MulConstant(2.0)).add(nn.MulConstant(3.0))
+        y = model.forward((jnp.ones((2,)), jnp.ones((3,))))
+        assert_close(y[0], 2 * np.ones(2))
+        assert_close(y[1], 3 * np.ones(3))
+
+    def test_concat_joins(self):
+        model = nn.Concat(1).add(nn.Identity()).add(nn.MulConstant(0.0))
+        y = model.forward(jnp.ones((2, 3)))
+        assert y.shape == (2, 6)
+
+    def test_table_ops(self):
+        a, b = jnp.asarray([4.0, 9.0]), jnp.asarray([2.0, 3.0])
+        assert_close(nn.CSubTable().forward((a, b)), [2.0, 6.0])
+        assert_close(nn.CDivTable().forward((a, b)), [2.0, 3.0])
+        assert_close(nn.CMaxTable().forward((a, b)), [4.0, 9.0])
+        assert_close(nn.CMinTable().forward((a, b)), [2.0, 3.0])
+        assert_close(nn.CMulTable().forward((a, b)), [8.0, 27.0])
+        assert_close(nn.SelectTable(1).forward((a, b)), [2.0, 3.0])
+        j = nn.JoinTable(0).forward((a, b))
+        assert j.shape == (4,)
+
+
+class TestGraph:
+    def test_residual_graph(self):
+        inp = nn.Input()
+        h = nn.Linear(4, 4)(inp)
+        r = nn.ReLU()(h)
+        out = nn.CAddTable()(r, inp)
+        model = nn.Graph([inp], [out])
+        x = jnp.asarray(np.random.randn(2, 4).astype(np.float32))
+        y = model.forward(x)
+        assert y.shape == (2, 4)
+        gx = model.backward(x, jnp.ones((2, 4)))
+        assert gx.shape == (2, 4)
+
+    def test_multi_output(self):
+        inp = nn.Input()
+        a = nn.MulConstant(2.0)(inp)
+        b = nn.MulConstant(3.0)(inp)
+        model = nn.Graph([inp], [a, b])
+        y = model.forward(jnp.ones((2,)))
+        assert_close(y[0], 2 * np.ones(2))
+        assert_close(y[1], 3 * np.ones(2))
+
+
+class TestReshape:
+    def test_reshape_batch(self):
+        y = nn.Reshape((2, 2)).forward(jnp.arange(8.0).reshape(2, 4))
+        assert y.shape == (2, 2, 2)
+
+    def test_various(self):
+        x = jnp.arange(24.0).reshape(2, 3, 4)
+        assert nn.Flatten().forward(x).shape == (2, 12)
+        assert nn.Squeeze(1).forward(jnp.ones((2, 1, 3))).shape == (2, 3)
+        assert nn.Unsqueeze(1).forward(jnp.ones((2, 3))).shape == (2, 1, 3)
+        assert nn.Transpose([(1, 2)]).forward(x).shape == (2, 4, 3)
+        assert nn.Permute((2, 0, 1)).forward(x).shape == (4, 2, 3)
+        assert nn.Select(1, 0).forward(x).shape == (2, 4)
+        assert nn.Narrow(1, 1, 2).forward(x).shape == (2, 2, 4)
+        assert nn.Padding(1, 2).forward(x).shape == (2, 5, 4)
+        assert nn.Replicate(3, 1).forward(jnp.ones((2, 4))).shape == (2, 3, 4)
+
+
+class TestEmbedding:
+    def test_lookup_vs_torch(self):
+        table = nn.LookupTable(10, 6)
+        idx = np.array([[1, 2], [3, 9]])
+        y = table.forward(jnp.asarray(idx))
+        w = np.asarray(table._params["weight"])
+        assert_close(y, w[idx])
+
+    def test_padding_value(self):
+        table = nn.LookupTable(10, 4, padding_value=0)
+        y = table.forward(jnp.asarray([0, 1]))
+        assert np.abs(np.asarray(y[0])).sum() == 0
+
+
+class TestGradChecks:
+    @pytest.mark.parametrize(
+        "mod",
+        [nn.Tanh(), nn.Sigmoid(), nn.SoftPlus(), nn.ELU(), nn.SoftMax(),
+         nn.LogSoftMax(), nn.Normalize(2.0)],
+    )
+    def test_finite_difference(self, mod):
+        x = np.random.randn(2, 6).astype(np.float32)
+        grad_check(mod, x)
