@@ -1,0 +1,11 @@
+from bigdl_tpu.dataset.minibatch import (
+    Sample, MiniBatch, PaddingParam, samples_to_minibatch,
+)
+from bigdl_tpu.dataset.transformer import (
+    Transformer, ChainedTransformer, FnTransformer, SampleToMiniBatch,
+    Normalizer,
+)
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, LocalDataSet, TransformedDataSet, DistributedDataSet,
+    array_dataset,
+)

@@ -1,0 +1,110 @@
+"""DataSet abstractions.
+
+Reference: dataset/DataSet.scala:49 (``LocalDataSet``: data(train) iterator +
+size + shuffle), :113/:167 (``DistributedDataSet`` over RDDs, cached per
+partition).
+
+TPU-native: the host feeds one global batch per step; under data parallelism
+each host materialises only its shard (DistributedDataSet below), matching
+the reference's one-task-per-node ingest (ZippedPartitionsWithLocalityRDD).
+No Spark dependency -- any indexable source works; a Spark RDD can be
+adapted by collecting partition iterators host-side.
+"""
+
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.minibatch import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class AbstractDataSet:
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self):
+        pass
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self, transformer)
+
+    def __rshift__(self, transformer: Transformer):
+        """Reference's ``->`` composition (dataset/DataSet.scala:87)."""
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """In-memory dataset over a list/array of elements (reference:
+    dataset/DataSet.scala:49, LocalArrayDataSet)."""
+
+    def __init__(self, data: Sequence, shuffle_on_epoch: bool = True, seed: int = 0):
+        self._data = list(data)
+        self._index = np.arange(len(self._data))
+        self.shuffle_on_epoch = shuffle_on_epoch
+        self._rng = np.random.default_rng(seed)
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def shuffle(self):
+        self._rng.shuffle(self._index)
+
+    def data(self, train: bool) -> Iterator:
+        if train:
+            # infinite looping iterator like the reference's train=true path
+            def gen():
+                while True:
+                    for i in self._index:
+                        yield self._data[i]
+            return gen()
+        return (self._data[i] for i in range(len(self._data)))
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self):
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+
+    def data(self, train: bool):
+        return self.transformer.apply(self.base.data(train))
+
+
+class DistributedDataSet(LocalDataSet):
+    """Host-sharded dataset for multi-host training.
+
+    Each process keeps records where ``index % num_shards == shard`` -- the
+    analogue of the reference's cached per-partition arrays
+    (dataset/DataSet.scala:243 CachedDistriDataSet).  ``size`` reports the
+    *global* count so epoch accounting matches the reference.
+    """
+
+    def __init__(self, data: Sequence, shard: int = 0, num_shards: int = 1,
+                 shuffle_on_epoch: bool = True, seed: int = 0):
+        self._global_size = len(data)
+        local = [x for i, x in enumerate(data) if i % num_shards == shard]
+        super().__init__(local, shuffle_on_epoch, seed + shard)
+        self.shard = shard
+        self.num_shards = num_shards
+
+    def size(self):
+        return self._global_size
+
+
+def array_dataset(features: np.ndarray, labels: Optional[np.ndarray] = None,
+                  **kw) -> LocalDataSet:
+    """DataSet.array analogue (reference: dataset/DataSet.scala:322)."""
+    if labels is None:
+        samples = [Sample(f) for f in features]
+    else:
+        samples = [Sample(f, l) for f, l in zip(features, labels)]
+    return LocalDataSet(samples, **kw)

@@ -1,0 +1,67 @@
+"""MNIST ingestion (reference: models/lenet/Train.scala + dataset/DataSet
+SeqFileFolder/mnist loaders; python analogue pyspark/bigdl/dataset/mnist.py).
+
+Reads the standard idx-ubyte files when present; ``synthetic_mnist``
+generates a deterministic class-separable stand-in for tests/benchmarks in
+environments with no dataset access.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+TRAIN_MEAN, TRAIN_STD = 0.13066047740239506, 0.3081078
+
+_FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-images-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+def load_mnist(folder: str, train: bool = True):
+    """-> (images (N,28,28) float32 in [0,1], labels (N,) int32)."""
+    key = "train" if train else "test"
+    imgs = labels = None
+    for suffix in ("", ".gz"):
+        ipath = os.path.join(folder, _FILES[f"{key}_images"] + suffix)
+        lpath = os.path.join(folder, _FILES[f"{key}_labels"] + suffix)
+        if os.path.exists(ipath) and os.path.exists(lpath):
+            imgs, labels = _read_idx(ipath), _read_idx(lpath)
+            break
+    if imgs is None:
+        raise FileNotFoundError(f"MNIST idx files not found under {folder}")
+    return imgs.astype(np.float32) / 255.0, labels.astype(np.int32)
+
+
+def synthetic_mnist(n: int = 2048, num_classes: int = 10, seed: int = 7):
+    """Deterministic separable digits: class-specific Gaussian blobs.
+
+    Each class lights up a distinct 2-D Gaussian bump on the 28x28 canvas
+    plus noise -- learnable by LeNet in a handful of steps, which is what the
+    convergence tests need.
+    """
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, n).astype(np.int32)
+    yy, xx = np.mgrid[0:28, 0:28].astype(np.float32)
+    images = np.empty((n, 28, 28), np.float32)
+    for c in range(num_classes):
+        cy, cx = 6 + 3 * (c // 5) * 4, 4 + (c % 5) * 5
+        bump = np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 18.0)
+        mask = labels == c
+        k = int(mask.sum())
+        images[mask] = bump[None] + 0.3 * rng.standard_normal(
+            (k, 28, 28)).astype(np.float32)
+    return np.clip(images, 0.0, 1.0), labels

@@ -1,0 +1,87 @@
+"""Transformer chain: composable Iterator -> Iterator stages.
+
+Reference: dataset/Transformer.scala:44 (``Transformer[A, B] =
+Iterator[A] => Iterator[B]`` with ``->`` composition) and the
+SampleToMiniBatch batcher (:211).
+"""
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.minibatch import (PaddingParam, Sample,
+                                         samples_to_minibatch)
+
+
+class Transformer:
+    """apply(iterator) -> iterator; compose with ``a >> b`` (reference ``->``)."""
+
+    def apply(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def __call__(self, it):
+        return self.apply(it)
+
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        return ChainedTransformer(self, other)
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, first, second):
+        self.first, self.second = first, second
+
+    def apply(self, it):
+        return self.second.apply(self.first.apply(it))
+
+
+class FnTransformer(Transformer):
+    """Wrap a per-element function."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, it):
+        return (self.fn(x) for x in it)
+
+
+class SampleToMiniBatch(Transformer):
+    """Group Samples into MiniBatches (reference: Transformer.scala:211).
+
+    Incomplete trailing batches are dropped when ``drop_remainder`` -- the
+    distributed path requires static shapes for jit, matching the
+    reference's fixed batchSize contract.
+    """
+
+    def __init__(self, batch_size: int, feature_padding: Optional[PaddingParam] = None,
+                 label_padding: Optional[PaddingParam] = None,
+                 drop_remainder: bool = True):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.drop_remainder = drop_remainder
+
+    def apply(self, it):
+        buf = []
+        for sample in it:
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                yield samples_to_minibatch(buf, self.feature_padding,
+                                           self.label_padding)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield samples_to_minibatch(buf, self.feature_padding,
+                                       self.label_padding)
+
+
+class Normalizer(Transformer):
+    """(x - mean) / std on Sample features (image-pipeline analogue:
+    dataset/image/BGRImgNormalizer.scala)."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def apply(self, it):
+        for s in it:
+            yield Sample((np.asarray(s.feature, np.float32) - self.mean)
+                         / self.std, s.label)

@@ -1,0 +1,42 @@
+"""LeNet-5 (reference: models/lenet/LeNet5.scala).
+
+Built NHWC (TPU-preferred layout); input (N, 28, 28, 1).
+"""
+
+import bigdl_tpu.nn as nn
+
+
+def LeNet5(class_num: int = 10) -> nn.Sequential:
+    return (
+        nn.Sequential()
+        .add(nn.Reshape((28, 28, 1)))
+        .add(nn.SpatialConvolution(1, 6, 5, 5, name="conv1_5x5"))
+        .add(nn.Tanh())
+        .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        .add(nn.SpatialConvolution(6, 12, 5, 5, name="conv2_5x5"))
+        .add(nn.Tanh())
+        .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        .add(nn.Reshape((12 * 4 * 4,)))
+        .add(nn.Linear(12 * 4 * 4, 100, name="fc1"))
+        .add(nn.Tanh())
+        .add(nn.Linear(100, class_num, name="fc2"))
+        .add(nn.LogSoftMax())
+    )
+
+
+def LeNet5Graph(class_num: int = 10) -> "nn.Graph":
+    """Graph-API variant (reference: LeNet5.scala graph())."""
+    inp = nn.Input()
+    x = nn.Reshape((28, 28, 1))(inp)
+    x = nn.SpatialConvolution(1, 6, 5, 5)(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.SpatialConvolution(6, 12, 5, 5)(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.Reshape((12 * 4 * 4,))(x)
+    x = nn.Linear(12 * 4 * 4, 100)(x)
+    x = nn.Tanh()(x)
+    x = nn.Linear(100, class_num)(x)
+    out = nn.LogSoftMax()(x)
+    return nn.Graph([inp], [out])

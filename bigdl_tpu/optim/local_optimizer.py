@@ -1,0 +1,237 @@
+"""Single-device training orchestration.
+
+Reference: optim/LocalOptimizer.scala:45 (replica threads + lock-free grad
+aggregation) and the Optimizer facade (optim/Optimizer.scala:47: builder
+setters for validation/checkpoint/summary/clipping/end-trigger).
+
+TPU-native: no replica threads -- one jitted step fuses fwd/bwd/update and
+saturates the chip; the host loop only feeds batches and evaluates triggers.
+"""
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.optim.optim_method import OptimMethod, SGD
+from bigdl_tpu.optim.train_step import make_eval_step, make_train_step
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.utils import file_io
+from bigdl_tpu.utils.random_generator import RNG
+from bigdl_tpu.utils.shape import spec_of
+
+log = logging.getLogger("bigdl_tpu.optim")
+
+
+def _device_batch(batch):
+    x = jax.tree.map(jnp.asarray, batch.get_input())
+    t = batch.get_target()
+    return x, (None if t is None else jax.tree.map(jnp.asarray, t))
+
+
+class BaseOptimizer:
+    """Builder facade shared by Local/Distri optimizers
+    (reference: optim/Optimizer.scala:47)."""
+
+    def __init__(self, model, dataset: AbstractDataSet, criterion,
+                 optim_method: Optional[OptimMethod] = None):
+        self.model = model
+        self.dataset = dataset
+        self.criterion = criterion
+        self.optim_method = optim_method or SGD()
+        self.end_trigger = Trigger.max_epoch(1)
+        self.validation_trigger = None
+        self.validation_dataset = None
+        self.validation_methods: List[ValidationMethod] = []
+        self.checkpoint_path = None
+        self.checkpoint_trigger = None
+        self.train_summary = None
+        self.validation_summary = None
+        self.compute_dtype = None
+        self.clip_value = None
+        self.clip_norm = None
+        self.driver_state: Dict = {"epoch": 1, "neval": 1,
+                                   "record_count": 0}
+
+    # ----- builder setters (names mirror the reference) ------------------- #
+    def set_end_when(self, trigger: Trigger):
+        self.end_trigger = trigger
+        return self
+
+    def set_validation(self, trigger: Trigger, dataset: AbstractDataSet,
+                       methods: List[ValidationMethod]):
+        self.validation_trigger = trigger
+        self.validation_dataset = dataset
+        self.validation_methods = methods
+        return self
+
+    def set_checkpoint(self, path: str, trigger: Trigger):
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        return self
+
+    def set_train_summary(self, summary):
+        self.train_summary = summary
+        return self
+
+    def set_validation_summary(self, summary):
+        self.validation_summary = summary
+        return self
+
+    def set_gradient_clipping_by_value(self, min_value, max_value):
+        """Reference: Optimizer.setConstantGradientClipping."""
+        self.clip_value = (min_value, max_value)
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, max_norm):
+        """Reference: Optimizer.setGradientClippingByl2Norm."""
+        self.clip_norm = max_norm
+        return self
+
+    def set_compute_dtype(self, dtype):
+        """bf16 mixed precision (TPU-native; no reference analogue)."""
+        self.compute_dtype = dtype
+        return self
+
+    def resume_from_checkpoint(self, path: Optional[str] = None):
+        """Reference resume semantics: Module.load + OptimMethod.load
+        (models/lenet/Train.scala:48-69); iteration-accurate via driver state."""
+        ckpt_file = file_io.latest_checkpoint(path or self.checkpoint_path)
+        if ckpt_file is None:
+            return self
+        snap = file_io.load(ckpt_file)
+        self._resume = snap
+        log.info("Resuming from %s (state %s)", ckpt_file, snap["driver_state"])
+        return self
+
+    # ----- shared helpers -------------------------------------------------- #
+    def _init_model(self, example_batch):
+        x, _ = _device_batch(example_batch)
+        if not self.model.is_built():
+            self.model.build(spec_of(x))
+        return self.model.parameters()[0], self.model.state()
+
+    def _checkpoint(self, params, mstate, opt_state):
+        file_io.save_checkpoint(
+            self.checkpoint_path, self.driver_state["neval"], params, mstate,
+            opt_state, self.driver_state)
+
+    def _log_progress(self, loss, throughput):
+        s = self.driver_state
+        log.info(
+            "Epoch %d [iteration %d] loss %.6f, %.1f records/s",
+            s["epoch"], s["neval"], loss, throughput)
+
+
+class LocalOptimizer(BaseOptimizer):
+    """Reference: optim/LocalOptimizer.scala:45."""
+
+    def optimize(self):
+        train_iter = self.dataset.data(train=True)
+        first_batch = next(train_iter)
+        params, mstate = self._init_model(first_batch)
+        opt_state = self.optim_method.init_state(params)
+
+        if getattr(self, "_resume", None):
+            snap = self._resume
+            params = jax.tree.map(jnp.asarray, snap["model_params"])
+            mstate = jax.tree.map(jnp.asarray, snap["model_state"])
+            opt_state = jax.tree.map(jnp.asarray, snap["opt_state"])
+            self.driver_state.update(snap["driver_state"])
+
+        step = jax.jit(make_train_step(
+            self.model, self.criterion, self.optim_method,
+            compute_dtype=self.compute_dtype, clip_value=self.clip_value,
+            clip_norm=self.clip_norm), donate_argnums=(0, 1, 2))
+
+        epoch_size = self.dataset.size()
+        state = self.driver_state
+        batch = first_batch
+        while not self.end_trigger(state):
+            t0 = time.time()
+            x, target = _device_batch(batch)
+            params, mstate, opt_state, loss = step(
+                params, mstate, opt_state, x, target, RNG.next_key())
+            loss = float(loss)
+            n = batch.size()
+            dt = time.time() - t0
+            state["loss"] = loss
+            state["record_count"] += n
+            state["throughput"] = n / max(dt, 1e-9)
+            self._log_progress(loss, state["throughput"])
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss, state["neval"])
+                self.train_summary.add_scalar(
+                    "Throughput", state["throughput"], state["neval"])
+                self.train_summary.add_scalar(
+                    "LearningRate",
+                    float(self.optim_method.get_learning_rate(opt_state)),
+                    state["neval"])
+            state["neval"] += 1
+            if state["record_count"] >= epoch_size:
+                state["epoch"] += 1
+                state["record_count"] = 0
+                self.dataset.shuffle()
+                train_iter = self.dataset.data(train=True)
+
+            if (self.validation_trigger is not None
+                    and self.validation_trigger(state)):
+                self._validate(params, mstate, state)
+            if (self.checkpoint_trigger is not None
+                    and self.checkpoint_trigger(state)):
+                self._checkpoint(params, mstate, opt_state)
+
+            if not self.end_trigger(state):
+                batch = next(train_iter)
+
+        self.model.set_parameters(params)
+        self.model.set_state(mstate)
+        return self.model
+
+    def _validate(self, params, mstate, state):
+        results = validate(self.model, params, mstate, self.validation_dataset,
+                           self.validation_methods, self.compute_dtype)
+        for method, res in zip(self.validation_methods, results):
+            value, _ = res.result()
+            log.info("Validation %s: %s", method.name, res)
+            if method.name in ("Top1Accuracy", "Top5Accuracy"):
+                state["score"] = value
+            if self.validation_summary is not None:
+                self.validation_summary.add_scalar(
+                    method.name, value, state["neval"])
+        return results
+
+
+def validate(model, params, mstate, dataset, methods, compute_dtype=None):
+    """Shared evaluation loop (reference: optim/Evaluator.scala /
+    DistriValidator)."""
+    eval_step = jax.jit(make_eval_step(model, compute_dtype))
+    totals: List[Optional[ValidationResult]] = [None] * len(methods)
+    for batch in dataset.data(train=False):
+        x = jax.tree.map(jnp.asarray, batch.get_input())
+        target = jax.tree.map(jnp.asarray, batch.get_target())
+        out = eval_step(params, mstate, x)
+        for i, m in enumerate(methods):
+            r = m(out, target)
+            totals[i] = r if totals[i] is None else totals[i] + r
+    return totals
+
+
+class Optimizer:
+    """Factory mirroring the reference (optim/Optimizer.scala:476,602-676):
+    picks Local vs Distri based on the dataset/devices."""
+
+    def __new__(cls, model=None, dataset=None, criterion=None,
+                optim_method=None, distributed: Optional[bool] = None):
+        from bigdl_tpu.dataset.dataset import DistributedDataSet
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+        if distributed is None:
+            distributed = isinstance(dataset, DistributedDataSet)
+        klass = DistriOptimizer if distributed else LocalOptimizer
+        return klass(model, dataset, criterion, optim_method)

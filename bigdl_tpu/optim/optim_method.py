@@ -1,0 +1,428 @@
+"""Optimization methods + learning-rate schedules.
+
+Reference: optim/OptimMethod.scala, optim/SGD.scala (with its 10 LR
+schedules), optim/Adam.scala, optim/Adagrad.scala, optim/Adadelta.scala,
+optim/RMSprop.scala, optim/Adamax.scala, optim/Ftrl.scala.
+
+TPU-native contract: each method is a *pure* transform
+
+    init_state(params)                  -> opt_state pytree
+    update(grads, opt_state, params)    -> (new_params, new_opt_state)
+
+so it can run inside jit -- whole-model on one chip, or on a ZeRO-1 flat
+chunk per device exactly like the reference updates only the chunk each node
+owns (parameters/AllReduceParameter.scala:307-320).  ``opt_state`` always
+carries an integer step counter ``neval`` (the reference keeps it in the
+state Table).
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------- #
+# Learning-rate schedules (reference: optim/SGD.scala LearningRateSchedule).
+# All are pure fns of the 0-based step count -> traceable under jit.
+# --------------------------------------------------------------------------- #
+
+
+class LearningRateSchedule:
+    def __call__(self, step, base_lr):
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + step * decay) (reference SGD.Default)."""
+
+    def __init__(self, learning_rate_decay=0.0):
+        self.decay = learning_rate_decay
+
+    def __call__(self, step, base_lr):
+        return base_lr / (1.0 + step * self.decay)
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^floor(step/step_size) (reference SGD.Step)."""
+
+    def __init__(self, step_size, gamma):
+        self.step_size, self.gamma = step_size, gamma
+
+    def __call__(self, step, base_lr):
+        return base_lr * jnp.power(self.gamma, jnp.floor(step / self.step_size))
+
+
+class MultiStep(LearningRateSchedule):
+    """lr * gamma^(#milestones passed) (reference SGD.MultiStep)."""
+
+    def __init__(self, step_sizes, gamma):
+        self.step_sizes = jnp.asarray(step_sizes)
+        self.gamma = gamma
+
+    def __call__(self, step, base_lr):
+        passed = jnp.sum(step >= self.step_sizes)
+        return base_lr * jnp.power(self.gamma, passed)
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - step/max_iteration)^power (reference SGD.Poly)."""
+
+    def __init__(self, power, max_iteration):
+        self.power, self.max_iteration = power, max_iteration
+
+    def __call__(self, step, base_lr):
+        frac = jnp.clip(step / self.max_iteration, 0.0, 1.0)
+        return jnp.where(step > self.max_iteration, 0.0,
+                         base_lr * jnp.power(1.0 - frac, self.power))
+
+
+class Exponential(LearningRateSchedule):
+    """lr * decay_rate^(step/decay_step) (reference SGD.Exponential)."""
+
+    def __init__(self, decay_step, decay_rate, stair_case=False):
+        self.decay_step, self.decay_rate, self.stair_case = (
+            decay_step, decay_rate, stair_case)
+
+    def __call__(self, step, base_lr):
+        e = step / self.decay_step
+        if self.stair_case:
+            e = jnp.floor(e)
+        return base_lr * jnp.power(self.decay_rate, e)
+
+
+class NaturalExp(LearningRateSchedule):
+    def __init__(self, decay_step, gamma):
+        self.decay_step, self.gamma = decay_step, gamma
+
+    def __call__(self, step, base_lr):
+        return base_lr * jnp.exp(-self.gamma * jnp.floor(step / self.decay_step))
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp adding ``delta`` per step (reference SGD.Warmup; used inside
+    SequentialSchedule for the ResNet-50 warmup recipe)."""
+
+    def __init__(self, delta):
+        self.delta = delta
+
+    def __call__(self, step, base_lr):
+        return base_lr + step * self.delta
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for ``iterations`` steps
+    (reference SGD.SequentialSchedule)."""
+
+    def __init__(self):
+        self.schedules = []
+        self.durations = []
+
+    def add(self, schedule, max_iteration):
+        self.schedules.append(schedule)
+        self.durations.append(max_iteration)
+        return self
+
+    def __call__(self, step, base_lr):
+        lr = base_lr
+        offset = 0
+        result = None
+        for sched, dur in zip(self.schedules, self.durations):
+            local = jnp.clip(step - offset, 0, dur)
+            candidate = sched(local, base_lr)
+            active = step >= offset
+            result = candidate if result is None else jnp.where(active, candidate, result)
+            offset += dur
+        return result if result is not None else lr
+
+
+# --------------------------------------------------------------------------- #
+# Optim methods.
+# --------------------------------------------------------------------------- #
+
+
+class OptimMethod:
+    """Base (reference: optim/OptimMethod.scala)."""
+
+    learning_rate: float = 1e-3
+
+    def init_state(self, params):
+        return {"neval": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params):
+        raise NotImplementedError
+
+    # facade mirroring reference optimize(feval, x): single tensor in/out
+    def optimize(self, feval, x):
+        loss, grad = feval(x)
+        if not hasattr(self, "_state") or self._state is None:
+            self._state = self.init_state(x)
+        new_x, self._state = self.update(grad, self._state, x)
+        return new_x, loss
+
+    def get_learning_rate(self, state):
+        return self.learning_rate
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/nesterov/weight-decay + pluggable LR schedule
+    (reference: optim/SGD.scala, Torch semantics)."""
+
+    def __init__(self, learning_rate=1e-3, learning_rate_decay=0.0,
+                 weight_decay=0.0, momentum=0.0, dampening=None,
+                 nesterov=False, learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        self.learning_rate = learning_rate
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires momentum > 0 and dampening = 0")
+        self.schedule = learning_rate_schedule or Default(learning_rate_decay)
+
+    def init_state(self, params):
+        state = {"neval": jnp.zeros((), jnp.int32)}
+        if self.momentum > 0:
+            state["velocity"] = jax.tree.map(jnp.zeros_like, params)
+        return state
+
+    def update(self, grads, state, params):
+        lr = self.schedule(state["neval"].astype(jnp.float32), self.learning_rate)
+        wd, mu, damp = self.weight_decay, self.momentum, self.dampening
+
+        if wd != 0:
+            grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
+        if mu > 0:
+            new_vel = jax.tree.map(lambda v, g: mu * v + (1 - damp) * g,
+                                   state["velocity"], grads)
+            if self.nesterov:
+                eff = jax.tree.map(lambda g, v: g + mu * v, grads, new_vel)
+            else:
+                eff = new_vel
+            new_params = jax.tree.map(lambda p, g: p - lr * g, params, eff)
+            new_state = {"neval": state["neval"] + 1, "velocity": new_vel}
+        else:
+            new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            new_state = {"neval": state["neval"] + 1}
+        return new_params, new_state
+
+    def get_learning_rate(self, state):
+        return self.schedule(state["neval"].astype(jnp.float32), self.learning_rate)
+
+
+class Adam(OptimMethod):
+    """Reference: optim/Adam.scala (Kingma-Ba with bias correction)."""
+
+    def __init__(self, learning_rate=1e-3, learning_rate_decay=0.0,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, weight_decay=0.0):
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "m": jax.tree.map(jnp.zeros_like, params),
+            "v": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        t = state["neval"].astype(jnp.float32) + 1.0
+        lr = self.learning_rate / (1.0 + state["neval"].astype(jnp.float32)
+                                   * self.learning_rate_decay)
+        b1, b2 = self.beta1, self.beta2
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+
+        if self.weight_decay != 0:
+            grads = jax.tree.map(lambda g, p: g + self.weight_decay * p,
+                                 grads, params)
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                         state["v"], grads)
+        new_params = jax.tree.map(
+            lambda p, m_, v_: p - lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2)
+                                                     + self.epsilon),
+            params, m, v)
+        return new_params, {"neval": state["neval"] + 1, "m": m, "v": v}
+
+
+class Adagrad(OptimMethod):
+    """Reference: optim/Adagrad.scala."""
+
+    def __init__(self, learning_rate=1e-3, learning_rate_decay=0.0,
+                 weight_decay=0.0):
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "accum": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        lr = self.learning_rate / (1.0 + state["neval"].astype(jnp.float32)
+                                   * self.learning_rate_decay)
+        if self.weight_decay != 0:
+            grads = jax.tree.map(lambda g, p: g + self.weight_decay * p,
+                                 grads, params)
+        accum = jax.tree.map(lambda a, g: a + g * g, state["accum"], grads)
+        new_params = jax.tree.map(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10),
+            params, grads, accum)
+        return new_params, {"neval": state["neval"] + 1, "accum": accum}
+
+
+class Adadelta(OptimMethod):
+    """Reference: optim/Adadelta.scala."""
+
+    def __init__(self, decay_rate=0.9, epsilon=1e-10):
+        self.rho, self.epsilon = decay_rate, epsilon
+        self.learning_rate = 1.0
+
+    def init_state(self, params):
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "accum_g": jax.tree.map(jnp.zeros_like, params),
+            "accum_dx": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        rho, eps = self.rho, self.epsilon
+        accum_g = jax.tree.map(lambda a, g: rho * a + (1 - rho) * g * g,
+                               state["accum_g"], grads)
+        delta = jax.tree.map(
+            lambda g, ag, adx: g * jnp.sqrt(adx + eps) / jnp.sqrt(ag + eps),
+            grads, accum_g, state["accum_dx"])
+        accum_dx = jax.tree.map(lambda a, d: rho * a + (1 - rho) * d * d,
+                                state["accum_dx"], delta)
+        new_params = jax.tree.map(lambda p, d: p - d, params, delta)
+        return new_params, {"neval": state["neval"] + 1, "accum_g": accum_g,
+                            "accum_dx": accum_dx}
+
+
+class RMSprop(OptimMethod):
+    """Reference: optim/RMSprop.scala."""
+
+    def __init__(self, learning_rate=1e-2, learning_rate_decay=0.0,
+                 decay_rate=0.99, epsilon=1e-8):
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.rho, self.epsilon = decay_rate, epsilon
+
+    def init_state(self, params):
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "accum": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        lr = self.learning_rate / (1.0 + state["neval"].astype(jnp.float32)
+                                   * self.learning_rate_decay)
+        accum = jax.tree.map(lambda a, g: self.rho * a + (1 - self.rho) * g * g,
+                             state["accum"], grads)
+        new_params = jax.tree.map(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + self.epsilon),
+            params, grads, accum)
+        return new_params, {"neval": state["neval"] + 1, "accum": accum}
+
+
+class Adamax(OptimMethod):
+    """Reference: optim/Adamax.scala."""
+
+    def __init__(self, learning_rate=2e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-38):
+        self.learning_rate = learning_rate
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "m": jax.tree.map(jnp.zeros_like, params),
+            "u": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        t = state["neval"].astype(jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        u = jax.tree.map(
+            lambda u_, g: jnp.maximum(b2 * u_, jnp.abs(g) + self.epsilon),
+            state["u"], grads)
+        lr_t = self.learning_rate / (1.0 - jnp.power(b1, t))
+        new_params = jax.tree.map(lambda p, m_, u_: p - lr_t * m_ / u_,
+                                  params, m, u)
+        return new_params, {"neval": state["neval"] + 1, "m": m, "u": u}
+
+
+class Ftrl(OptimMethod):
+    """Reference: optim/Ftrl.scala (FTRL-proximal)."""
+
+    def __init__(self, learning_rate=1e-3, learning_rate_power=-0.5,
+                 initial_accumulator_value=0.1, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0,
+                 l2_shrinkage_regularization_strength=0.0):
+        self.learning_rate = learning_rate
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+        self.l2_shrinkage = l2_shrinkage_regularization_strength
+
+    def init_state(self, params):
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "accum": jax.tree.map(
+                lambda p: jnp.full_like(p, self.init_accum), params),
+            "linear": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        lr, lrp = self.learning_rate, self.lr_power
+
+        new_accum = jax.tree.map(lambda n, g: n + g * g, state["accum"], grads)
+        new_linear = jax.tree.map(
+            lambda z, g, p, n, n_new: (
+                z + (g + 2 * self.l2_shrinkage * p)
+                - (jnp.power(n_new, -lrp) - jnp.power(n, -lrp)) / lr * p),
+            state["linear"], grads, params, state["accum"], new_accum)
+        new_params = jax.tree.map(
+            lambda z_new, n_new: jnp.where(
+                jnp.abs(z_new) > self.l1,
+                -(z_new - jnp.sign(z_new) * self.l1)
+                / (jnp.power(n_new, -lrp) / lr + 2 * self.l2),
+                0.0),
+            new_linear, new_accum)
+        return new_params, {"neval": state["neval"] + 1, "accum": new_accum,
+                            "linear": new_linear}
+
+
+# --------------------------------------------------------------------------- #
+# Gradient clipping (reference: parameters/ParameterOperations.scala:33-89;
+# wired via Optimizer.setGradientClipping*, optim/Optimizer.scala:440-460).
+# Pure grad transforms, usable inside jit across ZeRO chunks: the global-norm
+# variant takes an optional precomputed global sq-norm so the distributed path
+# can psum partial norms first (mirrors L2NormClippingProcessor).
+# --------------------------------------------------------------------------- #
+
+
+def clip_by_value(grads, min_value, max_value):
+    return jax.tree.map(lambda g: jnp.clip(g, min_value, max_value), grads)
+
+
+def global_sq_norm(grads):
+    leaves = jax.tree.leaves(grads)
+    return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+
+
+def clip_by_global_norm(grads, max_norm, sq_norm=None):
+    if sq_norm is None:
+        sq_norm = global_sq_norm(grads)
+    norm = jnp.sqrt(sq_norm)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: g * scale, grads)

@@ -1,0 +1,79 @@
+"""Fused train/eval step builders.
+
+This replaces the reference's per-iteration choreography
+(optim/DistriOptimizer.scala:191-443: fetch weights -> replica fwd/bwd
+threads -> grad aggregation -> chunk optimize -> send weights) with ONE
+XLA program: forward + backward + (collective) + optimizer update, compiled
+once by ``jax.jit`` and executed per step.  Replica threading, fp16
+compression and straggler dropping have no TPU analogue -- XLA owns the
+chip and collectives are synchronous on ICI.
+"""
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.optim.optim_method import (OptimMethod, clip_by_global_norm,
+                                          clip_by_value)
+
+
+def _cast_tree(tree, dtype):
+    if dtype is None:
+        return tree
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree,
+    )
+
+
+def make_train_step(
+    model,
+    criterion,
+    optim_method: OptimMethod,
+    compute_dtype=None,
+    clip_value: Optional[tuple] = None,
+    clip_norm: Optional[float] = None,
+    grad_transform: Optional[Callable] = None,
+):
+    """Single-device fused step: (params, mstate, opt_state, input, target, rng)
+    -> (params, mstate, opt_state, loss).
+
+    ``compute_dtype=jnp.bfloat16`` gives mixed precision: fp32 master params,
+    bf16 forward/backward (MXU-native), fp32 update.
+    """
+
+    def train_step(params, mstate, opt_state, input, target, rng):
+        def loss_fn(p):
+            cp = _cast_tree(p, compute_dtype)
+            x = _cast_tree(input, compute_dtype)
+            out, new_mstate = model.apply(cp, mstate, x, training=True, rng=rng)
+            out32 = _cast_tree(out, jnp.float32)
+            return criterion.apply(out32, target), new_mstate
+
+        (loss, new_mstate), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = _cast_tree(grads, jnp.float32)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
+        if clip_value is not None:
+            grads = clip_by_value(grads, *clip_value)
+        if clip_norm is not None:
+            grads = clip_by_global_norm(grads, clip_norm)
+        new_params, new_opt_state = optim_method.update(grads, opt_state, params)
+        return new_params, new_mstate, new_opt_state, loss
+
+    return train_step
+
+
+def make_eval_step(model, compute_dtype=None):
+    """(params, mstate, input) -> output (eval mode, no state update)."""
+
+    def eval_step(params, mstate, input):
+        cp = _cast_tree(params, compute_dtype)
+        x = _cast_tree(input, compute_dtype)
+        out, _ = model.apply(cp, mstate, x, training=False, rng=None)
+        return _cast_tree(out, jnp.float32)
+
+    return eval_step

@@ -1,0 +1,69 @@
+"""Composable triggers for ending training / firing validation & checkpoints.
+
+Reference: optim/Trigger.scala:30-120.  A trigger is a predicate over the
+driver state dict (keys: "epoch", "neval", "loss", "score",
+"record_count" ...), evaluated on host between steps -- never inside jit.
+"""
+
+
+class Trigger:
+    def __call__(self, state) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def max_epoch(n):
+        return _Lambda(lambda s: s.get("epoch", 1) > n)
+
+    @staticmethod
+    def max_iteration(n):
+        return _Lambda(lambda s: s.get("neval", 1) > n)
+
+    @staticmethod
+    def every_epoch():
+        return _EveryEpoch()
+
+    @staticmethod
+    def several_iteration(interval):
+        return _Lambda(lambda s: s.get("neval", 1) % interval == 0)
+
+    @staticmethod
+    def max_score(max_score):
+        return _Lambda(lambda s: s.get("score", float("-inf")) > max_score)
+
+    @staticmethod
+    def min_loss(min_loss):
+        return _Lambda(lambda s: s.get("loss", float("inf")) < min_loss)
+
+    @staticmethod
+    def and_(first, *others):
+        return _Lambda(lambda s: first(s) and all(o(s) for o in others))
+
+    @staticmethod
+    def or_(first, *others):
+        return _Lambda(lambda s: first(s) or any(o(s) for o in others))
+
+
+class _Lambda(Trigger):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, state):
+        return bool(self.fn(state))
+
+
+class _EveryEpoch(Trigger):
+    """Fires when the epoch counter advances past the last fire
+    (reference: Trigger.everyEpoch)."""
+
+    def __init__(self):
+        self.last_epoch = None
+
+    def __call__(self, state):
+        epoch = state.get("epoch", 1)
+        if self.last_epoch is None:
+            self.last_epoch = epoch
+            return False
+        if epoch > self.last_epoch:
+            self.last_epoch = epoch
+            return True
+        return False

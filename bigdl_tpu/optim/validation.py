@@ -1,0 +1,122 @@
+"""Validation metrics.
+
+Reference: optim/ValidationMethod.scala (Top1Accuracy, Top5Accuracy, Loss,
+MAE, HitRatio, NDCG) and optim/ValidationResult (mergeable partial results).
+
+Each method has a pure, jit-able kernel ``batch_result(output, target) ->
+(numerator, denominator)``; results merge with ``+`` across batches and
+devices (a psum on the distributed path).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    """Mergeable (numerator, denominator) pair (reference: AccuracyResult)."""
+
+    def __init__(self, numerator, denominator, fmt="Accuracy"):
+        self.numerator = float(numerator)
+        self.denominator = float(denominator)
+        self.fmt = fmt
+
+    def result(self):
+        value = self.numerator / max(self.denominator, 1e-12)
+        return value, int(self.denominator)
+
+    def __add__(self, other):
+        assert self.fmt == other.fmt
+        return ValidationResult(self.numerator + other.numerator,
+                                self.denominator + other.denominator, self.fmt)
+
+    def __repr__(self):
+        value, count = self.result()
+        return f"{self.fmt}: {value:.6f} (count {count})"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def batch_result(self, output, target):
+        """Pure kernel -> (numerator, denominator) scalars."""
+        raise NotImplementedError
+
+    def __call__(self, output, target) -> ValidationResult:
+        num, den = self.batch_result(output, target)
+        return ValidationResult(float(num), float(den), self.name)
+
+
+class Top1Accuracy(ValidationMethod):
+    """Reference: optim/ValidationMethod.scala Top1Accuracy."""
+
+    name = "Top1Accuracy"
+
+    def batch_result(self, output, target):
+        pred = jnp.argmax(output, axis=-1)
+        correct = jnp.sum(pred == target.astype(pred.dtype))
+        return correct, target.shape[0]
+
+
+class Top5Accuracy(ValidationMethod):
+    name = "Top5Accuracy"
+
+    def batch_result(self, output, target):
+        top5 = jnp.argsort(output, axis=-1)[..., -5:]
+        correct = jnp.sum(jnp.any(top5 == target[..., None].astype(top5.dtype),
+                                  axis=-1))
+        return correct, target.shape[0]
+
+
+class Loss(ValidationMethod):
+    """Mean criterion value (reference: ValidationMethod Loss)."""
+
+    name = "Loss"
+
+    def __init__(self, criterion):
+        self.criterion = criterion
+
+    def batch_result(self, output, target):
+        return self.criterion.apply(output, target) * target.shape[0], target.shape[0]
+
+
+class MAE(ValidationMethod):
+    name = "MAE"
+
+    def batch_result(self, output, target):
+        return jnp.sum(jnp.abs(output - target)), output.size
+
+
+class HitRatio(ValidationMethod):
+    """HR@k for recommendation (reference: ValidationMethod HitRatio).
+
+    ``output``: (N, n_items) scores; ``target``: (N,) index of the positive
+    item.  A hit = positive item within the top-k scores.
+    """
+
+    name = "HitRatio"
+
+    def __init__(self, k=10, neg_num=100):
+        self.k = k
+
+    def batch_result(self, output, target):
+        topk = jnp.argsort(output, axis=-1)[..., -self.k:]
+        hits = jnp.sum(jnp.any(topk == target[..., None].astype(topk.dtype),
+                               axis=-1))
+        return hits, target.shape[0]
+
+
+class NDCG(ValidationMethod):
+    """NDCG@k with a single positive item (reference: ValidationMethod NDCG)."""
+
+    name = "NDCG"
+
+    def __init__(self, k=10, neg_num=100):
+        self.k = k
+
+    def batch_result(self, output, target):
+        order = jnp.argsort(output, axis=-1)[..., ::-1][..., : self.k]
+        match = order == target[..., None].astype(order.dtype)
+        ranks = jnp.argmax(match, axis=-1)
+        has_hit = jnp.any(match, axis=-1)
+        gains = jnp.where(has_hit, 1.0 / jnp.log2(ranks + 2.0), 0.0)
+        return jnp.sum(gains), target.shape[0]

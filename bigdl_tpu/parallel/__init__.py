@@ -1,0 +1,1 @@
+from bigdl_tpu.parallel.zero import FlatParamSpace

@@ -1,0 +1,58 @@
+"""ZeRO-1 flat-parameter chunking.
+
+Reference: parameters/AllReduceParameter.scala:84 -- BigDL flattens all
+weights into one 1-D tensor, splits it into ``partitionNum`` chunks, and
+each node owns the optimizer update for exactly one chunk
+(optim/DistriOptimizer.scala:361-387).  That *is* ZeRO-1 (SURVEY.md
+section 2.4), and we keep the same ownership layout on the TPU mesh:
+
+- gradients:  ``reduce_scatter`` over the data axis -> each device holds the
+  mean gradient for its chunk (the analogue of aggregateGradientPartition's
+  fetch + fp16 tree-sum, AllReduceParameter.scala:228-270);
+- update:     OptimMethod runs on the chunk only, so optimizer state
+  (momentum/Adam moments) is sharded 1/N per device;
+- weights:    ``all_gather`` rebuilds the replicated flat vector (the
+  analogue of sendWeightPartition + getWeights, :193-220, :307-320).
+
+fp16 wire compression is unnecessary on ICI (bf16 compute is native); XLA
+picks the collective algorithm.
+"""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+
+class FlatParamSpace:
+    """Bijection between a params pytree and a padded flat fp32 vector.
+
+    ``num_chunks`` devices each own ``chunk_size`` contiguous elements,
+    mirroring the reference's chunk ownership
+    (AllReduceParameter.scala:147-167).
+    """
+
+    def __init__(self, params_tree: Any, num_chunks: int):
+        flat, self._unravel = ravel_pytree(params_tree)
+        self.true_size = int(flat.size)
+        self.num_chunks = int(num_chunks)
+        self.padded_size = (
+            (self.true_size + num_chunks - 1) // num_chunks * num_chunks
+        )
+        self.chunk_size = self.padded_size // num_chunks
+        self.dtype = flat.dtype
+
+    def flatten(self, params_tree) -> jnp.ndarray:
+        """Pytree -> padded flat vector.  Traceable."""
+        flat, _ = ravel_pytree(params_tree)
+        return jnp.pad(flat, (0, self.padded_size - self.true_size))
+
+    def unflatten(self, flat: jnp.ndarray):
+        """Padded flat vector -> pytree.  Traceable."""
+        return self._unravel(flat[: self.true_size])
+
+    def chunk(self, flat: jnp.ndarray, index) -> jnp.ndarray:
+        return jax.lax.dynamic_slice(
+            flat, (index * self.chunk_size,), (self.chunk_size,))

@@ -1,0 +1,63 @@
+"""Checkpoint persistence.
+
+Reference: utils/File.scala:27-130 (Java serialization + HDFS/S3),
+optim/AbstractOptimizer.scala:206-226 (checkpoint of model.<neval> +
+optimMethod.<neval>).
+
+Format: a pickle of numpy-ified pytrees -- portable, no JVM.  (The
+protobuf bigdl.proto-compatible model format is a separate interop layer;
+see SURVEY.md section 2.6.)
+"""
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _to_numpy(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def save(obj: Any, path: str):
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy(obj), f)
+
+
+def load(path: str) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_checkpoint(path: str, tag, model_params, model_state, opt_state,
+                    driver_state):
+    """One training snapshot (model + optimizer + loop counters), resumable."""
+    save(
+        {
+            "model_params": model_params,
+            "model_state": model_state,
+            "opt_state": opt_state,
+            "driver_state": dict(driver_state),
+        },
+        os.path.join(path, f"checkpoint.{tag}.pkl"),
+    )
+
+
+def latest_checkpoint(path: str):
+    if not os.path.isdir(path):
+        return None
+    snaps = [f for f in os.listdir(path)
+             if f.startswith("checkpoint.") and f.endswith(".pkl")]
+    if not snaps:
+        return None
+
+    def tag(f):
+        try:
+            return int(f.split(".")[1])
+        except ValueError:
+            return -1
+
+    return os.path.join(path, max(snaps, key=tag))

@@ -20,6 +20,11 @@ import pytest
 
 import jax
 
+# The axon sitecustomize (TPU tunnel) force-sets jax_platforms="axon,cpu" at
+# interpreter start, overriding the env var -- override it back so tests are
+# hermetic CPU and never touch the single shared TPU chip.
+jax.config.update("jax_platforms", "cpu")
+
 # Golden tests compare against torch fp32; disable any reduced-precision
 # matmul path (the perf path opts into bf16 explicitly instead).
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,190 @@
+"""OptimMethod golden tests vs torch.optim + schedule/trigger unit tests."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from bigdl_tpu import optim
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def run_both(method, torch_opt_fn, steps=5, shape=(7,)):
+    """Run our method and torch's on identical quadratic grads."""
+    rng = np.random.default_rng(0)
+    w0 = rng.standard_normal(shape).astype(np.float32)
+    gs = [rng.standard_normal(shape).astype(np.float32) for _ in range(steps)]
+
+    p = jnp.asarray(w0)
+    st = method.init_state(p)
+    for g in gs:
+        p, st = method.update(jnp.asarray(g), st, p)
+
+    tp = torch.tensor(w0, requires_grad=True)
+    topt = torch_opt_fn([tp])
+    for g in gs:
+        topt.zero_grad()
+        tp.grad = torch.tensor(g)
+        topt.step()
+    return p, tp.detach().numpy()
+
+
+class TestOptimMethods:
+    def test_sgd_plain(self):
+        p, tp = run_both(optim.SGD(learning_rate=0.1),
+                         lambda ps: torch.optim.SGD(ps, lr=0.1))
+        assert_close(p, tp)
+
+    def test_sgd_momentum_wd(self):
+        p, tp = run_both(
+            optim.SGD(learning_rate=0.05, momentum=0.9, dampening=0.0,
+                      weight_decay=1e-3),
+            lambda ps: torch.optim.SGD(ps, lr=0.05, momentum=0.9,
+                                       weight_decay=1e-3))
+        assert_close(p, tp)
+
+    def test_sgd_nesterov(self):
+        p, tp = run_both(
+            optim.SGD(learning_rate=0.05, momentum=0.9, dampening=0.0,
+                      nesterov=True),
+            lambda ps: torch.optim.SGD(ps, lr=0.05, momentum=0.9,
+                                       nesterov=True))
+        assert_close(p, tp)
+
+    def test_adam(self):
+        p, tp = run_both(optim.Adam(learning_rate=1e-2),
+                         lambda ps: torch.optim.Adam(ps, lr=1e-2))
+        assert_close(p, tp, atol=1e-5)
+
+    def test_adagrad(self):
+        p, tp = run_both(optim.Adagrad(learning_rate=1e-2),
+                         lambda ps: torch.optim.Adagrad(ps, lr=1e-2))
+        assert_close(p, tp, atol=1e-5)
+
+    def test_rmsprop(self):
+        p, tp = run_both(
+            optim.RMSprop(learning_rate=1e-2, decay_rate=0.99, epsilon=1e-8),
+            lambda ps: torch.optim.RMSprop(ps, lr=1e-2, alpha=0.99, eps=1e-8))
+        assert_close(p, tp, atol=1e-5)
+
+    def test_adadelta(self):
+        p, tp = run_both(optim.Adadelta(decay_rate=0.9, epsilon=1e-6),
+                         lambda ps: torch.optim.Adadelta(ps, lr=1.0, rho=0.9,
+                                                         eps=1e-6))
+        assert_close(p, tp, atol=1e-5)
+
+    def test_adamax(self):
+        p, tp = run_both(optim.Adamax(learning_rate=2e-3),
+                         lambda ps: torch.optim.Adamax(ps, lr=2e-3, eps=0.0))
+        assert_close(p, tp, atol=1e-5)
+
+    def test_ftrl_runs(self):
+        m = optim.Ftrl(learning_rate=0.1, l1_regularization_strength=0.01)
+        p = jnp.ones((5,))
+        st = m.init_state(p)
+        for _ in range(3):
+            p, st = m.update(0.1 * jnp.ones((5,)), st, p)
+        assert np.all(np.isfinite(np.asarray(p)))
+
+    def test_update_on_pytree(self):
+        m = optim.SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+        params = {"a": jnp.ones((3,)), "b": {"w": jnp.zeros((2, 2))}}
+        st = m.init_state(params)
+        grads = {"a": jnp.ones((3,)), "b": {"w": jnp.ones((2, 2))}}
+        p2, st2 = m.update(grads, st, params)
+        assert_close(p2["a"], 0.9 * np.ones(3))
+        assert int(st2["neval"]) == 1
+
+
+class TestSchedules:
+    def test_default(self):
+        s = optim.Default(0.1)
+        assert_close(s(0.0, 1.0), 1.0)
+        assert_close(s(10.0, 1.0), 0.5)
+
+    def test_step(self):
+        s = optim.Step(10, 0.5)
+        assert_close(s(0.0, 1.0), 1.0)
+        assert_close(s(10.0, 1.0), 0.5)
+        assert_close(s(25.0, 1.0), 0.25)
+
+    def test_multistep(self):
+        s = optim.MultiStep([10, 20], 0.1)
+        assert_close(s(5.0, 1.0), 1.0)
+        assert_close(s(15.0, 1.0), 0.1)
+        assert_close(s(25.0, 1.0), 0.01, rtol=1e-4)
+
+    def test_poly(self):
+        s = optim.Poly(2.0, 100)
+        assert_close(s(0.0, 1.0), 1.0)
+        assert_close(s(50.0, 1.0), 0.25)
+        assert_close(s(101.0, 1.0), 0.0)
+
+    def test_warmup_sequential(self):
+        # ResNet-50 recipe: warmup 5 steps 0.1 -> 0.6, then poly decay
+        s = (optim.SequentialSchedule()
+             .add(optim.Warmup(0.1), 5)
+             .add(optim.Poly(1.0, 10), 10))
+        assert_close(s(0.0, 0.1), 0.1)
+        assert_close(s(5.0, 0.1), 0.1)   # poly takes over at local step 0
+        assert_close(s(3.0, 0.1), 0.4)
+
+    def test_exponential(self):
+        s = optim.Exponential(10, 0.5)
+        assert_close(s(10.0, 1.0), 0.5)
+        s2 = optim.Exponential(10, 0.5, stair_case=True)
+        assert_close(s2(19.0, 1.0), 0.5)
+
+
+class TestTriggers:
+    def test_max_epoch_iteration(self):
+        assert optim.Trigger.max_epoch(3)({"epoch": 4})
+        assert not optim.Trigger.max_epoch(3)({"epoch": 3})
+        assert optim.Trigger.max_iteration(10)({"neval": 11})
+
+    def test_every_epoch(self):
+        t = optim.Trigger.every_epoch()
+        assert not t({"epoch": 1})
+        assert not t({"epoch": 1})
+        assert t({"epoch": 2})
+        assert not t({"epoch": 2})
+
+    def test_several_iteration(self):
+        t = optim.Trigger.several_iteration(5)
+        assert t({"neval": 5})
+        assert not t({"neval": 6})
+
+    def test_combinators(self):
+        t = optim.Trigger.and_(optim.Trigger.max_epoch(1),
+                               optim.Trigger.min_loss(0.5))
+        assert t({"epoch": 2, "loss": 0.1})
+        assert not t({"epoch": 2, "loss": 0.9})
+        t2 = optim.Trigger.or_(optim.Trigger.max_epoch(1),
+                               optim.Trigger.min_loss(0.5))
+        assert t2({"epoch": 0, "loss": 0.1})
+
+
+class TestValidationMethods:
+    def test_top1_top5(self):
+        out = jnp.asarray(np.eye(10, dtype=np.float32)[[1, 3, 5]])
+        target = jnp.asarray([1, 3, 2])
+        r = optim.Top1Accuracy()(out, target)
+        assert r.result()[0] == pytest.approx(2 / 3)
+        r5 = optim.Top5Accuracy()(out, target)
+        assert r5.result()[0] >= 2 / 3
+
+    def test_result_merge(self):
+        a = optim.ValidationResult(3, 4)
+        b = optim.ValidationResult(1, 4)
+        assert (a + b).result() == (0.5, 8)
+
+    def test_clipping(self):
+        g = {"w": jnp.asarray([3.0, 4.0])}
+        clipped = optim.clip_by_global_norm(g, 1.0)
+        assert_close(np.linalg.norm(np.asarray(clipped["w"])), 1.0, rtol=1e-5)
+        cv = optim.clip_by_value(g, -2.0, 2.0)
+        assert_close(cv["w"], [2.0, 2.0])
