@@ -34,6 +34,10 @@ from bigdl_tpu.nn.reshape import (
     Permute, Select, Narrow, Contiguous, Padding, Replicate,
 )
 from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.recurrent import (
+    Cell, RnnCell, LSTM, GRU, MultiRNNCell, Recurrent, BiRecurrent,
+    RecurrentDecoder, TimeDistributed,
+)
 from bigdl_tpu.nn.criterion import (
     ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
     BCECriterion, BCEWithLogitsCriterion, SmoothL1Criterion,
