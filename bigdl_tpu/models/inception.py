@@ -1,0 +1,60 @@
+"""Inception v1 (GoogLeNet).
+
+Reference: models/inception/Inception_v1.scala (Concat of 1x1 / 3x3 / 5x5 /
+pool towers).  The tower fan-out uses Concat over the channel axis, exactly
+the reference's structure; NHWC so the concat axis is -1.
+"""
+
+import bigdl_tpu.nn as nn
+
+
+def _conv(n_in, n_out, k, stride=1, pad=0, name=None):
+    return (nn.Sequential(name=name)
+            .add(nn.SpatialConvolution(n_in, n_out, k, k, stride, stride,
+                                       pad, pad, data_format="NHWC"))
+            .add(nn.ReLU()))
+
+
+def inception_module(n_in, c1, c3r, c3, c5r, c5, pool_proj):
+    """One inception block (reference: Inception_v1.scala inception())."""
+    concat = nn.Concat(3)
+    concat.add(_conv(n_in, c1, 1))
+    concat.add(nn.Sequential().add(_conv(n_in, c3r, 1))
+               .add(_conv(c3r, c3, 3, 1, 1)))
+    concat.add(nn.Sequential().add(_conv(n_in, c5r, 1))
+               .add(_conv(c5r, c5, 5, 1, 2)))
+    concat.add(nn.Sequential()
+               .add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1))
+               .add(_conv(n_in, pool_proj, 1)))
+    return concat
+
+
+def InceptionV1NoAuxClassifier(class_num=1000):
+    """Input (N, 224, 224, 3)
+    (reference: Inception_v1_NoAuxClassifier.scala)."""
+    return (
+        nn.Sequential()
+        .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, data_format="NHWC"))
+        .add(nn.ReLU())
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+        .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+        .add(_conv(64, 64, 1))
+        .add(_conv(64, 192, 3, 1, 1))
+        .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+        .add(inception_module(192, 64, 96, 128, 16, 32, 32))
+        .add(inception_module(256, 128, 128, 192, 32, 96, 64))
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+        .add(inception_module(480, 192, 96, 208, 16, 48, 64))
+        .add(inception_module(512, 160, 112, 224, 24, 64, 64))
+        .add(inception_module(512, 128, 128, 256, 24, 64, 64))
+        .add(inception_module(512, 112, 144, 288, 32, 64, 64))
+        .add(inception_module(528, 256, 160, 320, 32, 128, 128))
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+        .add(inception_module(832, 256, 160, 320, 32, 128, 128))
+        .add(inception_module(832, 384, 192, 384, 48, 128, 128))
+        .add(nn.GlobalAveragePooling2D())
+        .add(nn.Dropout(0.4))
+        .add(nn.Linear(1024, class_num))
+        .add(nn.LogSoftMax())
+    )

@@ -1,0 +1,103 @@
+"""ResNet (reference: models/resnet/ResNet.scala -- cifar and imagenet
+variants with basic/bottleneck blocks built from Sequential/ConcatTable/
+CAddTable).
+
+NHWC end-to-end (TPU-preferred; SURVEY.md section 7: convert at the model
+boundary, never per-op).  The residual add is CAddTable over a ConcatTable,
+structurally matching the reference.
+"""
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.initialization import MsraFiller, Zeros
+
+
+def _conv(n_in, n_out, k, stride=1, pad=0):
+    return nn.SpatialConvolution(
+        n_in, n_out, k, k, stride, stride, pad, pad, with_bias=False,
+        data_format="NHWC", weight_init=MsraFiller(False))
+
+
+def _bn(n):
+    return nn.SpatialBatchNormalization(n)
+
+
+def _shortcut(n_in, n_out, stride):
+    if n_in != n_out or stride != 1:
+        return nn.Sequential().add(_conv(n_in, n_out, 1, stride)).add(_bn(n_out))
+    return nn.Identity()
+
+
+def basic_block(n_in, n_out, stride=1):
+    """3x3 + 3x3 residual block (reference: ResNet.scala basicBlock)."""
+    main = (nn.Sequential()
+            .add(_conv(n_in, n_out, 3, stride, 1)).add(_bn(n_out)).add(nn.ReLU())
+            .add(_conv(n_out, n_out, 3, 1, 1)).add(_bn(n_out)))
+    return (nn.Sequential()
+            .add(nn.ConcatTable().add(main).add(_shortcut(n_in, n_out, stride)))
+            .add(nn.CAddTable())
+            .add(nn.ReLU()))
+
+
+def bottleneck(n_in, planes, stride=1, expansion=4):
+    """1x1 -> 3x3 -> 1x1 block (reference: ResNet.scala bottleneck)."""
+    n_out = planes * expansion
+    main = (nn.Sequential()
+            .add(_conv(n_in, planes, 1)).add(_bn(planes)).add(nn.ReLU())
+            .add(_conv(planes, planes, 3, stride, 1)).add(_bn(planes)).add(nn.ReLU())
+            .add(_conv(planes, n_out, 1)).add(_bn(n_out)))
+    return (nn.Sequential()
+            .add(nn.ConcatTable().add(main).add(_shortcut(n_in, n_out, stride)))
+            .add(nn.CAddTable())
+            .add(nn.ReLU()))
+
+
+_IMAGENET_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def ResNet(depth=50, class_num=1000):
+    """ImageNet ResNet; input (N, 224, 224, 3)
+    (reference: ResNet.scala apply with DatasetType.ImageNet)."""
+    kind, layout = _IMAGENET_CFG[depth]
+    model = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
+                                        with_bias=False, data_format="NHWC",
+                                        weight_init=MsraFiller(False)))
+             .add(_bn(64)).add(nn.ReLU())
+             .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)))
+    n_in = 64
+    planes = [64, 128, 256, 512]
+    for stage, (p, count) in enumerate(zip(planes, layout)):
+        for i in range(count):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            if kind == "basic":
+                model.add(basic_block(n_in, p, stride))
+                n_in = p
+            else:
+                model.add(bottleneck(n_in, p, stride))
+                n_in = p * 4
+    model.add(nn.GlobalAveragePooling2D())
+    model.add(nn.Linear(n_in, class_num))
+    return model
+
+
+def ResNetCifar(depth=20, class_num=10):
+    """CIFAR ResNet: 6n+2 layers (reference: ResNet.scala DatasetType.CIFAR10)."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    model = (nn.Sequential()
+             .add(_conv(3, 16, 3, 1, 1)).add(_bn(16)).add(nn.ReLU()))
+    n_in = 16
+    for stage, p in enumerate([16, 32, 64]):
+        for i in range(n):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            model.add(basic_block(n_in, p, stride))
+            n_in = p
+    model.add(nn.GlobalAveragePooling2D())
+    model.add(nn.Linear(64, class_num))
+    return model

@@ -1,0 +1,44 @@
+"""SimpleRNN language model + Autoencoder.
+
+Reference: models/rnn/SimpleRNN.scala (LookupTable-free one-hot LM:
+Recurrent(RnnCell) + TimeDistributed(Linear)), models/autoencoder/
+Autoencoder.scala (784 -> 32 -> 784 MLP).
+"""
+
+import bigdl_tpu.nn as nn
+
+
+def SimpleRNN(input_size, hidden_size, output_size):
+    """(N, T) int tokens -> (N, T, output_size) log-probs
+    (reference: models/rnn/SimpleRNN.scala)."""
+    return (
+        nn.Sequential()
+        .add(nn.LookupTable(input_size, hidden_size))
+        .add(nn.Recurrent(nn.RnnCell(hidden_size, hidden_size)))
+        .add(nn.TimeDistributed(nn.Linear(hidden_size, output_size)))
+        .add(nn.LogSoftMax())
+    )
+
+
+def LSTMLanguageModel(vocab_size, embed_size, hidden_size):
+    """PTB-style LSTM LM (reference: example/languagemodel PTBModel)."""
+    return (
+        nn.Sequential()
+        .add(nn.LookupTable(vocab_size, embed_size))
+        .add(nn.Recurrent(nn.LSTM(embed_size, hidden_size)))
+        .add(nn.Recurrent(nn.LSTM(hidden_size, hidden_size)))
+        .add(nn.TimeDistributed(nn.Linear(hidden_size, vocab_size)))
+        .add(nn.LogSoftMax())
+    )
+
+
+def Autoencoder(class_num=32):
+    """784 -> 32 -> 784 (reference: models/autoencoder/Autoencoder.scala)."""
+    return (
+        nn.Sequential()
+        .add(nn.Reshape((784,)))
+        .add(nn.Linear(784, class_num))
+        .add(nn.ReLU())
+        .add(nn.Linear(class_num, 784))
+        .add(nn.Sigmoid())
+    )

@@ -1,0 +1,75 @@
+"""VGG-16/19 + CIFAR variant.
+
+Reference: models/vgg/Vgg_16.scala, Vgg_19.scala, VggForCifar10.scala.
+NHWC layout.
+"""
+
+import bigdl_tpu.nn as nn
+
+_CFG = {
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _features(cfg, batch_norm=False):
+    seq = nn.Sequential()
+    n_in = 3
+    for v in cfg:
+        if v == "M":
+            seq.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            seq.add(nn.SpatialConvolution(n_in, v, 3, 3, 1, 1, 1, 1,
+                                          data_format="NHWC"))
+            if batch_norm:
+                seq.add(nn.SpatialBatchNormalization(v))
+            seq.add(nn.ReLU())
+            n_in = v
+    return seq
+
+
+def Vgg16(class_num=1000, batch_norm=False):
+    """Input (N, 224, 224, 3) (reference: models/vgg/Vgg_16.scala)."""
+    return (
+        _features(_CFG[16], batch_norm)
+        .add(nn.Reshape((512 * 7 * 7,)))
+        .add(nn.Linear(512 * 7 * 7, 4096)).add(nn.ReLU()).add(nn.Dropout(0.5))
+        .add(nn.Linear(4096, 4096)).add(nn.ReLU()).add(nn.Dropout(0.5))
+        .add(nn.Linear(4096, class_num))
+    )
+
+
+def Vgg19(class_num=1000, batch_norm=False):
+    return (
+        _features(_CFG[19], batch_norm)
+        .add(nn.Reshape((512 * 7 * 7,)))
+        .add(nn.Linear(512 * 7 * 7, 4096)).add(nn.ReLU()).add(nn.Dropout(0.5))
+        .add(nn.Linear(4096, 4096)).add(nn.ReLU()).add(nn.Dropout(0.5))
+        .add(nn.Linear(4096, class_num))
+    )
+
+
+def VggForCifar10(class_num=10):
+    """Input (N, 32, 32, 3) (reference: models/vgg/VggForCifar10.scala --
+    conv+BN stacks then 512-unit classifier)."""
+    def block(n_in, n_out):
+        return (nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1,
+                                      data_format="NHWC"),
+                nn.SpatialBatchNormalization(n_out), nn.ReLU())
+
+    seq = nn.Sequential()
+    n_in = 3
+    for v in [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"]:
+        if v == "M":
+            seq.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            for m in block(n_in, v):
+                seq.add(m)
+            n_in = v
+    return (seq.add(nn.Reshape((512,)))
+            .add(nn.Linear(512, 512)).add(nn.BatchNormalization(512))
+            .add(nn.ReLU()).add(nn.Dropout(0.5))
+            .add(nn.Linear(512, class_num)).add(nn.LogSoftMax()))

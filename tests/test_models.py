@@ -1,0 +1,96 @@
+"""Model-zoo smoke tests: shapes, backward, and a tiny train step each."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.models.inception import InceptionV1NoAuxClassifier
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.models.resnet import ResNet, ResNetCifar
+from bigdl_tpu.models.rnn import Autoencoder, LSTMLanguageModel, SimpleRNN
+from bigdl_tpu.models.vgg import Vgg16, VggForCifar10
+
+
+def one_train_step(model, x, target, criterion):
+    from bigdl_tpu.optim.train_step import make_train_step
+
+    model.build(jax.ShapeDtypeStruct(x.shape, x.dtype))
+    params, mstate = model.parameters()[0], model.state()
+    method = optim.SGD(learning_rate=0.01)
+    step = jax.jit(make_train_step(model, criterion, method))
+    p2, _, _, loss = step(params, mstate, method.init_state(params), x,
+                          target, jax.random.key(0))
+    assert np.isfinite(float(loss))
+    return float(loss)
+
+
+class TestVision:
+    def test_resnet_cifar_shapes(self):
+        model = ResNetCifar(depth=20)
+        x = jnp.zeros((2, 32, 32, 3))
+        y = model.forward(x)
+        assert y.shape == (2, 10)
+
+    def test_resnet50_imagenet_param_count(self):
+        model = ResNet(depth=50, class_num=1000)
+        model.build(jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32))
+        n_params = sum(p.size for p in jax.tree.leaves(model.parameters()[0]))
+        # torchvision resnet50: 25.557M params
+        assert abs(n_params - 25.557e6) / 25.557e6 < 0.01, n_params
+
+    def test_resnet50_forward_shape(self):
+        model = ResNet(depth=50, class_num=1000)
+        y = model.forward(jnp.zeros((1, 64, 64, 3)))  # any spatial size /32
+        assert y.shape == (1, 1000)
+
+    def test_resnet_cifar_train_step(self):
+        model = ResNetCifar(depth=8)
+        one_train_step(model, jnp.zeros((4, 32, 32, 3)),
+                       jnp.zeros((4,), jnp.int32), nn.CrossEntropyCriterion())
+
+    def test_vgg_cifar_shapes(self):
+        model = VggForCifar10()
+        y = model.forward(jnp.zeros((2, 32, 32, 3)))
+        assert y.shape == (2, 10)
+
+    def test_vgg16_param_count(self):
+        model = Vgg16(class_num=1000)
+        model.build(jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32))
+        n_params = sum(p.size for p in jax.tree.leaves(model.parameters()[0]))
+        # torchvision vgg16: 138.358M
+        assert abs(n_params - 138.358e6) / 138.358e6 < 0.01, n_params
+
+    def test_inception_v1_shapes(self):
+        model = InceptionV1NoAuxClassifier(class_num=100)
+        y = model.forward(jnp.zeros((1, 224, 224, 3)))
+        assert y.shape == (1, 100)
+
+
+class TestSequence:
+    def test_simple_rnn(self):
+        model = SimpleRNN(input_size=50, hidden_size=16, output_size=50)
+        x = jnp.asarray(np.random.randint(0, 50, (2, 7)))
+        y = model.forward(x)
+        assert y.shape == (2, 7, 50)
+
+    def test_lstm_lm_train_step(self):
+        model = LSTMLanguageModel(vocab_size=30, embed_size=8, hidden_size=16)
+        x = jnp.asarray(np.random.randint(0, 30, (2, 5)))
+        t = jnp.asarray(np.random.randint(0, 30, (2, 5)))
+        loss = one_train_step(
+            model, x, t,
+            nn.TimeDistributedCriterion(nn.ClassNLLCriterion()))
+        assert loss < 10
+
+    def test_autoencoder(self):
+        model = Autoencoder()
+        x = jnp.asarray(np.random.rand(4, 28, 28).astype(np.float32))
+        y = model.forward(x)
+        assert y.shape == (4, 784)
+        loss = one_train_step(model, x,
+                              x.reshape(4, 784), nn.MSECriterion())
+        assert loss < 1.0
