@@ -1,0 +1,95 @@
+"""Headline benchmark: ResNet-50 training throughput on one TPU chip.
+
+Mirrors the reference's perf harnesses (models/utils/DistriOptimizerPerf.scala,
+nn/mkldnn/Perf.scala: imgs/sec on synthetic data) with the BASELINE.json
+north-star metric: ResNet-50 images/sec/chip and MFU.
+
+vs_baseline = achieved_MFU / 0.35 (the >=35% MFU target from BASELINE.md;
+the reference publishes no absolute imgs/sec for its Xeon clusters).
+
+Prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim.train_step import make_train_step
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+
+    model = ResNet(depth=50, class_num=1000)
+    model.build(jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16))
+    params, mstate = model.parameters()[0], model.state()
+    method = optim.SGD(learning_rate=0.02, momentum=0.9, dampening=0.0,
+                       weight_decay=1e-4)
+    opt_state = method.init_state(params)
+
+    step = jax.jit(
+        make_train_step(model, CrossEntropyCriterion(), method,
+                        compute_dtype=jnp.bfloat16),
+        donate_argnums=(0, 1, 2))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),
+                    dtype=jnp.bfloat16)
+    t = jnp.asarray(rng.integers(0, 1000, batch), dtype=jnp.int32)
+    key = jax.random.key(0)
+
+    lowered = step.lower(params, mstate, opt_state, x, t, key)
+    compiled = lowered.compile()
+    try:
+        flops_per_step = float(compiled.cost_analysis()["flops"])
+    except Exception:
+        flops_per_step = 3 * 2 * 4.09e9 * batch  # 3x fwd MAC*2 estimate
+
+    # warmup (donated buffers: re-feed outputs)
+    for _ in range(3):
+        params, mstate, opt_state, loss = compiled(
+            params, mstate, opt_state, x, t, key)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, mstate, opt_state, loss = compiled(
+            params, mstate, opt_state, x, t, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * steps / dt
+    # v5e peak: 197 TFLOP/s bf16
+    peak = 197e12 if platform != "cpu" else 1e12
+    mfu = (flops_per_step * steps / dt) / peak
+
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {
+            "platform": platform,
+            "batch": batch,
+            "mfu": round(mfu, 4),
+            "flops_per_step": flops_per_step,
+            "loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
