@@ -180,6 +180,25 @@ class Module:
     def set_state(self, state: State):
         self._state = state
 
+    def predict(self, data, batch_size: int = 128):
+        """Batch inference sugar (reference: AbstractModule.predict :637)."""
+        from bigdl_tpu.optim.predictor import Predictor
+
+        return Predictor(self, batch_size).predict(data)
+
+    def predict_class(self, data, batch_size: int = 128):
+        from bigdl_tpu.optim.predictor import Predictor
+
+        return Predictor(self, batch_size).predict_class(data)
+
+    def evaluate_on(self, dataset, methods, compute_dtype=None):
+        """Run validation methods over a dataset
+        (reference: AbstractModule.evaluate :855; named evaluate_on because
+        evaluate() toggles eval mode, as in the reference)."""
+        from bigdl_tpu.optim.predictor import evaluate
+
+        return evaluate(self, dataset, methods, compute_dtype)
+
     # Graph building: calling a module on Node(s) creates a new graph node
     # (reference: ModuleNode / Graph, nn/Graph.scala:72).
     def __call__(self, *args):

@@ -16,3 +16,4 @@ from bigdl_tpu.optim.local_optimizer import (
 from bigdl_tpu.optim.distri_optimizer import (
     DistriOptimizer, make_distri_train_step,
 )
+from bigdl_tpu.optim.predictor import Predictor, PredictionService, evaluate

@@ -121,6 +121,22 @@ class BaseOptimizer:
             self.checkpoint_path, self.driver_state["neval"], params, mstate,
             opt_state, self.driver_state)
 
+    def _histograms(self, params, state):
+        """Parameter/gradient histograms per summary trigger (reference:
+        AbstractOptimizer.saveSummary, optim/AbstractOptimizer.scala:47-91)."""
+        getter = getattr(self.train_summary, "get_summary_trigger", None)
+        if getter is None:
+            return
+        trig = getter("Parameters")
+        if trig is not None and trig(state):
+            from jax.tree_util import tree_flatten_with_path, keystr
+
+            leaves, _ = tree_flatten_with_path(params)
+            for path, leaf in leaves:
+                self.train_summary.add_histogram(
+                    "Parameters" + keystr(path), np.asarray(leaf),
+                    state["neval"])
+
     def _log_progress(self, loss, throughput):
         s = self.driver_state
         log.info(
@@ -172,6 +188,7 @@ class LocalOptimizer(BaseOptimizer):
                     "LearningRate",
                     float(self.optim_method.get_learning_rate(opt_state)),
                     state["neval"])
+                self._histograms(params, state)
             state["neval"] += 1
             if state["record_count"] >= epoch_size:
                 state["epoch"] += 1

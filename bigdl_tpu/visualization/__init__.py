@@ -1,0 +1,52 @@
+"""Training visualization (reference: visualization/TrainSummary.scala:32,
+ValidationSummary.scala; hooked by the optimizers per trigger at
+optim/AbstractOptimizer.scala:47-91)."""
+
+import os
+
+from bigdl_tpu.visualization.tensorboard import FileWriter, read_scalar
+
+
+class Summary:
+    def __init__(self, log_dir: str, app_name: str, sub_dir: str):
+        self.log_dir = os.path.join(log_dir, app_name, sub_dir)
+        self.writer = FileWriter(self.log_dir)
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self.writer.add_scalar(tag, value, step)
+        return self
+
+    def add_histogram(self, tag: str, values, step: int):
+        self.writer.add_histogram(tag, values, step)
+        return self
+
+    def read_scalar(self, tag: str):
+        """-> [(step, value, wall_time)] (reference: TrainSummary.readScalar)."""
+        return read_scalar(self.log_dir, tag)
+
+    def close(self):
+        self.writer.close()
+
+
+class TrainSummary(Summary):
+    """Reference: visualization/TrainSummary.scala:32."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "train")
+        self._triggers = {}
+
+    def set_summary_trigger(self, name: str, trigger):
+        """Enable 'Parameters'/'Gradients' histograms per trigger
+        (reference: TrainSummary.setSummaryTrigger)."""
+        self._triggers[name] = trigger
+        return self
+
+    def get_summary_trigger(self, name: str):
+        return self._triggers.get(name)
+
+
+class ValidationSummary(Summary):
+    """Reference: visualization/ValidationSummary.scala."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "validation")

@@ -1,0 +1,113 @@
+"""TensorBoard writer round-trip + Predictor/PredictionService tests."""
+
+import os
+import threading
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+from bigdl_tpu.dataset.minibatch import Sample
+from bigdl_tpu.dataset.mnist import synthetic_mnist
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.optim import (LocalOptimizer, PredictionService, Predictor,
+                             Top1Accuracy, Trigger)
+from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+from bigdl_tpu.visualization.tensorboard import crc32c
+
+
+class TestTensorboard:
+    def test_crc32c_known_vectors(self):
+        # RFC 3720 test vector: 32 bytes of zeros -> 0x8A9136AA
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert crc32c(b"123456789") == 0xE3069283
+
+    def test_scalar_roundtrip(self, tmp_path):
+        s = TrainSummary(str(tmp_path), "app")
+        s.add_scalar("Loss", 1.5, 1)
+        s.add_scalar("Loss", 0.5, 2)
+        s.add_scalar("Throughput", 100.0, 1)
+        s.close()
+        got = s.read_scalar("Loss")
+        assert [(st, v) for st, v, _ in got] == [(1, 1.5), (2, 0.5)]
+        assert len(s.read_scalar("Throughput")) == 1
+
+    def test_histogram_writes(self, tmp_path):
+        s = TrainSummary(str(tmp_path), "app")
+        s.add_histogram("weights", np.random.randn(100), 1)
+        s.close()
+        assert os.path.getsize(s.writer.path) > 100
+
+    def test_optimizer_writes_summaries(self, tmp_path):
+        x, y = synthetic_mnist(64)
+        train = array_dataset(x, y) >> SampleToMiniBatch(32)
+        model = LeNet5()
+        summary = TrainSummary(str(tmp_path), "lenet")
+        opt = LocalOptimizer(model, train, nn.ClassNLLCriterion(),
+                             optim.SGD(learning_rate=0.1))
+        opt.set_train_summary(summary)
+        opt.set_end_when(Trigger.max_iteration(3))
+        opt.optimize()
+        losses = summary.read_scalar("Loss")
+        assert len(losses) == 3
+        lrs = summary.read_scalar("LearningRate")
+        assert abs(lrs[0][1] - 0.1) < 1e-6
+
+
+class TestPredictor:
+    def _trained_model(self):
+        x, y = synthetic_mnist(256)
+        train = array_dataset(x, y) >> SampleToMiniBatch(64)
+        model = LeNet5()
+        opt = LocalOptimizer(model, train, nn.ClassNLLCriterion(),
+                             optim.SGD(learning_rate=0.3, momentum=0.9,
+                                       dampening=0.0))
+        opt.set_end_when(Trigger.max_iteration(20))
+        opt.optimize()
+        return model, x, y
+
+    def test_predict_and_class(self):
+        model, x, y = self._trained_model()
+        samples = [Sample(f) for f in x[:40]]
+        outs = model.predict(samples, batch_size=16)
+        assert len(outs) == 40 and outs[0].shape == (10,)
+        classes = model.predict_class(samples, batch_size=16)
+        acc = np.mean([c == t for c, t in zip(classes, y[:40])])
+        assert acc > 0.8
+
+    def test_evaluate_facade(self):
+        model, x, y = self._trained_model()
+        val = array_dataset(x[:64], y[:64]) >> SampleToMiniBatch(32)
+        res = model.evaluate_on(val, [Top1Accuracy()])
+        assert res[0].result()[0] > 0.8
+
+    def test_prediction_service_concurrent(self):
+        model, x, y = self._trained_model()
+        svc = PredictionService(model, num_threads=2)
+        results = {}
+
+        def worker(i):
+            results[i] = int(np.argmax(svc.predict(x[i])))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        acc = np.mean([results[i] == y[i] for i in range(8)])
+        assert acc >= 0.5
+
+    def test_prediction_service_bytes(self):
+        model, x, y = self._trained_model()
+        svc = PredictionService(model)
+        import io
+
+        buf = io.BytesIO()
+        np.savez(buf, x=x[0])
+        out = svc.predict_bytes(buf.getvalue())
+        arrs = np.load(io.BytesIO(out))
+        assert arrs["out0"].shape == (10,)
