@@ -1,0 +1,187 @@
+"""Attention and transformer blocks.
+
+No reference analogue -- the reference is a pre-transformer codebase
+(SURVEY.md section 5 'Long-context: Absent') -- but the north star requires
+sequence-scale capability, so the transformer stack is first-class here.
+Distribution: see parallel/ring_attention.py (sequence parallelism) and
+parallel/tp.py (tensor parallelism).
+
+Layout: (N, T, D); heads split last.  bf16-friendly: softmax in fp32.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import Xavier, Zeros
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Container, Module, child_rng
+from bigdl_tpu.nn.normalization import Dropout, LayerNorm
+
+
+def dot_product_attention(q, k, v, causal=False, mask=None, scale=None):
+    """Plain attention; q,k,v (..., T, H, Dh) with heads on axis -2.
+
+    Softmax runs in fp32 regardless of input dtype (bf16-safe).
+    """
+    *_, tq, h, d = q.shape
+    scale = scale or (1.0 / math.sqrt(d))
+    scores = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32)
+    scores = scores * scale
+    if causal:
+        tk = k.shape[-3]
+        qpos = jnp.arange(tq)[:, None]
+        kpos = jnp.arange(tk)[None, :]
+        scores = jnp.where(kpos <= qpos, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("...hqk,...khd->...qhd", weights, v)
+
+
+class MultiHeadAttention(Module):
+    """Self-attention with fused qkv projection (one big MXU matmul)."""
+
+    def __init__(self, hidden_size: int, num_heads: int, causal: bool = False,
+                 dropout: float = 0.0, seq_axis_name: Optional[str] = None,
+                 name=None):
+        super().__init__(name)
+        assert hidden_size % num_heads == 0
+        self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.causal = causal
+        self.dropout = dropout
+        #: when set, apply() is assumed to run inside shard_map with the
+        #: sequence sharded over this mesh axis -> ring attention.
+        self.seq_axis_name = seq_axis_name
+
+    def setup(self, rng, input_spec):
+        d = self.hidden_size
+        init = Xavier()
+        return {
+            "qkv_weight": init.init(child_rng(rng, 0), (3 * d, d), d, d),
+            "qkv_bias": jnp.zeros((3 * d,), jnp.float32),
+            "out_weight": init.init(child_rng(rng, 1), (d, d), d, d),
+            "out_bias": jnp.zeros((d,), jnp.float32),
+        }, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        n, t, d = input.shape
+        dt = input.dtype
+        qkv = input @ params["qkv_weight"].astype(dt).T + params["qkv_bias"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (n, t, self.num_heads, self.head_dim)
+        if self.seq_axis_name is not None:
+            from bigdl_tpu.parallel.ring_attention import ring_self_attention
+
+            y = ring_self_attention(q.reshape(shape), k.reshape(shape),
+                                    v.reshape(shape), self.seq_axis_name,
+                                    causal=self.causal)
+        else:
+            y = dot_product_attention(q.reshape(shape), k.reshape(shape),
+                                      v.reshape(shape), causal=self.causal)
+        y = y.reshape(n, t, d)
+        y = y @ params["out_weight"].astype(dt).T + params["out_bias"].astype(dt)
+        if training and self.dropout > 0 and rng is not None:
+            keep = 1.0 - self.dropout
+            y = jnp.where(jax.random.bernoulli(rng, keep, y.shape),
+                          y / keep, 0.0).astype(dt)
+        return y, state
+
+
+class TransformerBlock(Container):
+    """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    def __init__(self, hidden_size, num_heads, mlp_ratio=4, causal=True,
+                 dropout=0.0, seq_axis_name=None, name=None):
+        super().__init__(name)
+        self.ln1 = LayerNorm(hidden_size)
+        self.attn = MultiHeadAttention(hidden_size, num_heads, causal, dropout,
+                                       seq_axis_name)
+        self.ln2 = LayerNorm(hidden_size)
+        self.fc1 = Linear(hidden_size, mlp_ratio * hidden_size)
+        self.fc2 = Linear(mlp_ratio * hidden_size, hidden_size)
+        for m in (self.ln1, self.attn, self.ln2, self.fc1, self.fc2):
+            self.add(m)
+
+    def setup(self, rng, input_spec):
+        params = {}
+        for i, (key, m) in enumerate(
+                [("ln1", self.ln1), ("attn", self.attn), ("ln2", self.ln2),
+                 ("fc1", self.fc1), ("fc2", self.fc2)]):
+            p, _ = m.setup(child_rng(rng, i), input_spec)
+            params[key] = p
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        h, _ = self.ln1.apply(params["ln1"], (), input)
+        a, _ = self.attn.apply(params["attn"], (), h, training=training,
+                               rng=child_rng(rng, 0))
+        x = input + a
+        h, _ = self.ln2.apply(params["ln2"], (), x)
+        h, _ = self.fc1.apply(params["fc1"], (), h)
+        h = jax.nn.gelu(h)
+        h, _ = self.fc2.apply(params["fc2"], (), h)
+        return x + h, state
+
+
+class TransformerLM(Container):
+    """Decoder-only LM: embed + blocks + LN + tied-free head.
+
+    The long-context flagship; pairs with sequence parallelism
+    (parallel/ring_attention.py) for T beyond one chip's HBM.
+    """
+
+    def __init__(self, vocab_size, hidden_size, num_heads, num_layers,
+                 max_len=2048, mlp_ratio=4, seq_axis_name=None, name=None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.max_len = max_len
+        self.seq_axis_name = seq_axis_name
+        self.blocks = [TransformerBlock(hidden_size, num_heads, mlp_ratio,
+                                        seq_axis_name=seq_axis_name)
+                       for _ in range(num_layers)]
+        self.ln_f = LayerNorm(hidden_size)
+        for b in self.blocks:
+            self.add(b)
+        self.add(self.ln_f)
+
+    def setup(self, rng, input_spec):
+        d = self.hidden_size
+        params = {
+            "wte": 0.02 * jax.random.normal(child_rng(rng, 0),
+                                            (self.vocab_size, d)),
+            "wpe": 0.01 * jax.random.normal(child_rng(rng, 1),
+                                            (self.max_len, d)),
+            "head": 0.02 * jax.random.normal(child_rng(rng, 2),
+                                             (self.vocab_size, d)),
+        }
+        hid_spec = jax.ShapeDtypeStruct(
+            (input_spec.shape[0], input_spec.shape[1], d), jnp.float32)
+        for i, b in enumerate(self.blocks):
+            p, _ = b.setup(child_rng(rng, 3 + i), hid_spec)
+            params[f"block{i}"] = p
+        params["ln_f"], _ = self.ln_f.setup(child_rng(rng, 99), hid_spec)
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t = input.shape[1]
+        x = jnp.take(params["wte"], input.astype(jnp.int32), axis=0)
+        if self.seq_axis_name is not None:
+            # inside shard_map the block holds T_local tokens; use global
+            # positions derived from the device's ring index
+            offset = jax.lax.axis_index(self.seq_axis_name) * t
+            pos = offset + jnp.arange(t)
+            x = x + jnp.take(params["wpe"], pos, axis=0)[None]
+        else:
+            x = x + params["wpe"][:t][None]
+        for i, b in enumerate(self.blocks):
+            x, _ = b.apply(params[f"block{i}"], (), x, training=training,
+                           rng=child_rng(rng, i))
+        x, _ = self.ln_f.apply(params["ln_f"], (), x)
+        logits = x @ params["head"].astype(x.dtype).T
+        return logits, state
