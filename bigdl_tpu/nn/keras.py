@@ -1,0 +1,123 @@
+"""Keras-style training facade: compile / fit / evaluate / predict.
+
+Reference: nn/keras/Topology.scala:35-165 (KerasModel.compile/fit/evaluate/
+predict wrapping the Optimizer machinery; Sequential:262, Model:165).
+
+These wrap any bigdl_tpu module (not just keras-defined ones), matching the
+reference where KerasModel delegates to Local/Distri optimizers.
+"""
+
+from typing import List, Optional, Union
+
+import numpy as np
+
+import jax
+
+from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.nn import containers as _containers
+from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
+                                    MSECriterion, AbsCriterion, BCECriterion)
+from bigdl_tpu.nn.graph import Graph as _Graph
+from bigdl_tpu.nn.module import Criterion
+from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+from bigdl_tpu.optim.optim_method import (SGD, Adam, Adagrad, Adadelta,
+                                          OptimMethod, RMSprop)
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (Loss, Top1Accuracy, Top5Accuracy,
+                                        ValidationMethod, MAE)
+
+_OPTIMIZERS = {
+    "sgd": lambda: SGD(learning_rate=0.01),
+    "adam": Adam,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+    "rmsprop": RMSprop,
+}
+
+_LOSSES = {
+    "categorical_crossentropy": CrossEntropyCriterion,
+    "sparse_categorical_crossentropy": CrossEntropyCriterion,
+    "nll": ClassNLLCriterion,
+    "mse": MSECriterion,
+    "mean_squared_error": MSECriterion,
+    "mae": AbsCriterion,
+    "mean_absolute_error": AbsCriterion,
+    "binary_crossentropy": BCECriterion,
+}
+
+_METRICS = {
+    "accuracy": Top1Accuracy,
+    "top1": Top1Accuracy,
+    "top5": Top5Accuracy,
+    "mae": MAE,
+}
+
+
+class _KerasMixin:
+    """compile/fit/evaluate/predict (reference: KerasModel, Topology.scala:35)."""
+
+    def compile(self, optimizer: Union[str, OptimMethod],
+                loss: Union[str, Criterion],
+                metrics: Optional[List[Union[str, ValidationMethod]]] = None):
+        self._optim = (_OPTIMIZERS[optimizer.lower()]()
+                       if isinstance(optimizer, str) else optimizer)
+        self._loss = _LOSSES[loss.lower()]() if isinstance(loss, str) else loss
+        self._metrics = [
+            _METRICS[m.lower()]() if isinstance(m, str) else m
+            for m in (metrics or [])
+        ]
+        return self
+
+    def _to_dataset(self, x, y, batch_size) -> AbstractDataSet:
+        if isinstance(x, AbstractDataSet):
+            return x
+        return array_dataset(np.asarray(x),
+                             None if y is None else np.asarray(y)) >> \
+            SampleToMiniBatch(batch_size)
+
+    def fit(self, x, y=None, batch_size=32, nb_epoch=10,
+            validation_data=None, distributed=False):
+        """Reference: KerasModel.fit (Topology.scala:89)."""
+        assert getattr(self, "_optim", None) is not None, "call compile() first"
+        dataset = self._to_dataset(x, y, batch_size)
+        if distributed:
+            from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+            opt = DistriOptimizer(self, dataset, self._loss, self._optim)
+        else:
+            opt = LocalOptimizer(self, dataset, self._loss, self._optim)
+        opt.set_end_when(Trigger.max_epoch(nb_epoch))
+        if validation_data is not None:
+            vx, vy = validation_data
+            methods = self._metrics or [Loss(self._loss)]
+            opt.set_validation(Trigger.every_epoch(),
+                               self._to_dataset(vx, vy, batch_size), methods)
+        opt.optimize()
+        return self
+
+    def evaluate(self, x=None, y=None, batch_size=32):
+        """Keras-style evaluate; with no args, flips eval mode like the base
+        Module.evaluate() (reference behaviour is the latter)."""
+        if x is None:
+            return super().evaluate()
+        methods = self._metrics or [Loss(self._loss)]
+        res = self.evaluate_on(self._to_dataset(x, y, batch_size), methods)
+        return [r.result()[0] for r in res]
+
+    def predict(self, x, batch_size=32, distributed=False):
+        """Reference: KerasModel.predict (Topology.scala:127)."""
+        if isinstance(x, AbstractDataSet):
+            return super().predict(x, batch_size)
+        from bigdl_tpu.dataset.minibatch import Sample
+
+        samples = [Sample(np.asarray(f)) for f in x]
+        return np.stack(super().predict(samples, batch_size))
+
+
+class Sequential(_KerasMixin, _containers.Sequential):
+    """Keras-style Sequential (reference: Topology.scala:262)."""
+
+
+class Model(_KerasMixin, _Graph):
+    """Keras-style functional Model (reference: Topology.scala:165)."""

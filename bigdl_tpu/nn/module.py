@@ -180,6 +180,32 @@ class Module:
     def set_state(self, state: State):
         self._state = state
 
+    def save(self, path: str):
+        """Persist architecture + weights (reference: AbstractModule.save /
+        saveModule)."""
+        from bigdl_tpu.utils.serializer import save_module
+
+        save_module(self, path)
+        return self
+
+    @staticmethod
+    def load(path: str) -> "Module":
+        """Reference: Module.load / ModuleLoader.loadFromFile."""
+        from bigdl_tpu.utils.serializer import load_module
+
+        return load_module(path)
+
+    def save_weights(self, path: str):
+        from bigdl_tpu.utils.serializer import save_weights
+
+        save_weights(self, path)
+        return self
+
+    def load_weights(self, path: str):
+        from bigdl_tpu.utils.serializer import load_weights
+
+        return load_weights(self, path)
+
     def predict(self, data, batch_size: int = 128):
         """Batch inference sugar (reference: AbstractModule.predict :637)."""
         from bigdl_tpu.optim.predictor import Predictor

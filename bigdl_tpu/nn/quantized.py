@@ -1,0 +1,154 @@
+"""Int8 quantized inference.
+
+Reference: nn/quantized/Quantizer.scala:27 (graph rewrite swapping
+Linear/SpatialConvolution for int8 variants), tensor/QuantizedTensor.scala
+(int8 storage + per-window scales, BigQuant JNI kernels).
+
+TPU-native: int8 x int8 -> int32 matmul/conv is native on the MXU
+(``preferred_element_type=jnp.int32``); no JNI, no descriptors.  Weights are
+quantized per output channel (symmetric, like BigQuant); activations are
+quantized dynamically per tensor at run time (the reference's runtime
+min/max behaviour).  Expected wins match the reference whitepaper
+(docs/whitepaper.md:192): ~4x model size, up to ~2x inference speed,
+<1% accuracy loss.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.conv import SpatialConvolution
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Container, Module
+
+
+def quantize_weights_per_channel(w, channel_axis: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 per-output-channel quantization -> (w_int8, scale)."""
+    reduce_axes = tuple(a for a in range(w.ndim) if a != channel_axis)
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    w_q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return w_q, scale.astype(jnp.float32)
+
+
+def _quantize_activation(x):
+    """Dynamic symmetric per-tensor activation quant -> (x_int8, scale)."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(x32)), 1e-8) / 127.0
+    x_q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return x_q, scale
+
+
+class QuantizedLinear(Module):
+    """Int8 linear (reference: nn/quantized/Linear.scala)."""
+
+    def __init__(self, linear: Linear, params, name=None):
+        super().__init__(name or linear.name + "_int8")
+        self.output_size = linear.output_size
+        self.with_bias = linear.with_bias
+        w_q, scale = quantize_weights_per_channel(params["weight"], 0)
+        self._params = {"weight_q": w_q, "scale": scale[:, 0]}
+        if self.with_bias:
+            self._params["bias"] = params["bias"]
+        self._state = ()
+
+    def setup(self, rng, input_spec):
+        return self._params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x_q, x_scale = _quantize_activation(input)
+        acc = lax.dot_general(
+            x_q, params["weight_q"],
+            (((x_q.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (params["scale"] * x_scale)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y.astype(input.dtype), state
+
+
+class QuantizedSpatialConvolution(Module):
+    """Int8 conv (reference: nn/quantized/SpatialConvolution.scala).
+
+    Weight HWIO quantized per output channel (axis 3).
+    """
+
+    def __init__(self, conv: SpatialConvolution, params, name=None):
+        super().__init__(name or conv.name + "_int8")
+        self.conv = conv
+        w_q, scale = quantize_weights_per_channel(params["weight"], 3)
+        self._params = {"weight_q": w_q, "scale": scale.reshape(-1)}
+        if conv.with_bias:
+            self._params["bias"] = params["bias"]
+        self._state = ()
+
+    def setup(self, rng, input_spec):
+        return self._params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        c = self.conv
+        x = input
+        if c.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        x_q, x_scale = _quantize_activation(x)
+        acc = lax.conv_general_dilated(
+            x_q, params["weight_q"],
+            window_strides=c.stride,
+            padding=c._padding(),
+            rhs_dilation=c.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c.n_group,
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (params["scale"] * x_scale)
+        if c.with_bias:
+            y = y + params["bias"]
+        y = y.astype(input.dtype)
+        if c.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y, state
+
+
+def quantize(model: Module) -> Module:
+    """Rewrite a built model for int8 inference
+    (reference: nn/quantized/Quantizer.scala Quantizer.quantize).
+
+    Walks Sequential-style containers (children keyed "0".."n") and swaps
+    every Linear / SpatialConvolution for its int8 twin, quantizing the
+    trained weights in place.  Returns the model (mutated), in eval mode.
+    """
+    if not model.is_built():
+        raise ValueError("quantize() expects a built (trained/loaded) model")
+    _quantize_children(model)
+    return model.evaluate()
+
+
+def _quantize_children(module: Module):
+    if not isinstance(module, Container):
+        return
+    params = module._params
+    for i, child in enumerate(module.modules):
+        key = str(i)
+        child_params = params.get(key) if isinstance(params, dict) else None
+        if isinstance(child, Linear) and child_params:
+            q = QuantizedLinear(child, child_params)
+            module.modules[i] = q
+            params[key] = q._params
+        elif isinstance(child, SpatialConvolution) and child_params and \
+                type(child) is SpatialConvolution:
+            q = QuantizedSpatialConvolution(child, child_params)
+            module.modules[i] = q
+            params[key] = q._params
+        elif isinstance(child, Container):
+            # push params down so nested containers rewrite their dicts
+            sub_params = params.get(key) if isinstance(params, dict) else None
+            if isinstance(sub_params, dict):
+                child._params = sub_params
+                _quantize_children(child)
+                child._params = None
+
+
+def model_bytes(params) -> int:
+    return sum(leaf.size * leaf.dtype.itemsize
+               for leaf in jax.tree.leaves(params))

@@ -1,0 +1,81 @@
+"""Model persistence.
+
+Reference: utils/serializer/ (protobuf bigdl.proto model format with storage
+dedup + big-model separate weight file), utils/File.scala (legacy Java
+serialization).
+
+Round-1 format: a single pickle containing (a) the module object graph --
+plain Python objects, no compiled state -- and (b) params/state pytrees as
+numpy.  ``save_weights``/``load_weights`` additionally give an npz flat-
+tensor format for interop.  (A bigdl.proto-compatible exporter is a later
+interop layer; see SURVEY.md section 2.6.)
+"""
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+
+def _numpyify(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def save_module(module, path: str):
+    """Persist architecture + weights + state (reference:
+    ModulePersister.saveToFile, utils/serializer/ModuleLoader.scala:219)."""
+    params, state = module._params, module._state
+    payload = {
+        "format": "bigdl_tpu.module.v1",
+        "module": module,          # architecture (python object graph)
+        "params": _numpyify(params),
+        "state": _numpyify(state),
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    # strip live arrays off the module object before pickling
+    saved = module._params, module._state, module._grads
+    module._params = module._state = module._grads = None
+    try:
+        with open(path, "wb") as f:
+            pickle.dump(payload, f)
+    finally:
+        module._params, module._state, module._grads = saved
+
+
+def load_module(path: str):
+    """-> module with params/state restored (reference: ModuleLoader.loadFromFile)."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    assert payload.get("format") == "bigdl_tpu.module.v1", "unknown format"
+    module = payload["module"]
+    module._params = payload["params"]
+    module._state = payload["state"]
+    return module
+
+
+def save_weights(module, path: str):
+    """Flat npz of weights keyed by tree path (interop-friendly)."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    leaves, _ = tree_flatten_with_path(module._params)
+    arrays = {keystr(p): np.asarray(l) for p, l in leaves}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    np.savez(path, **arrays)
+
+
+def load_weights(module, path: str):
+    """Load npz weights into a built module (shapes must match)."""
+    from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
+    arrays = np.load(path)
+    leaves, treedef = tree_flatten_with_path(module._params)
+    new = []
+    for p, old in leaves:
+        arr = arrays[keystr(p)]
+        assert arr.shape == old.shape, (keystr(p), arr.shape, old.shape)
+        new.append(arr.astype(old.dtype))
+    module._params = tree_unflatten(treedef, new)
+    return module

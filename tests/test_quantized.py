@@ -1,0 +1,76 @@
+"""Int8 quantized inference tests (reference whitepaper targets: ~4x size,
+small accuracy loss)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+from bigdl_tpu.dataset.mnist import synthetic_mnist
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.nn.quantized import (QuantizedLinear, model_bytes, quantize,
+                                    quantize_weights_per_channel)
+from bigdl_tpu.optim import LocalOptimizer, Top1Accuracy, Trigger
+
+
+class TestQuantizedOps:
+    def test_weight_quant_roundtrip(self):
+        w = jnp.asarray(np.random.randn(8, 16).astype(np.float32))
+        w_q, scale = quantize_weights_per_channel(w, 0)
+        assert w_q.dtype == jnp.int8
+        recon = w_q.astype(jnp.float32) * scale
+        np.testing.assert_allclose(np.asarray(recon), np.asarray(w),
+                                   atol=float(np.abs(w).max()) / 100)
+
+    def test_quantized_linear_close(self):
+        lin = nn.Linear(64, 32)
+        x = jnp.asarray(np.random.randn(4, 64).astype(np.float32))
+        y_fp = lin.forward(x)
+        qlin = QuantizedLinear(lin, lin._params)
+        y_q, _ = qlin.apply(qlin._params, (), x)
+        err = np.abs(np.asarray(y_q) - np.asarray(y_fp)).max()
+        rng_span = np.abs(np.asarray(y_fp)).max()
+        assert err / rng_span < 0.05, err
+
+    def test_quantized_conv_close(self):
+        conv = nn.SpatialConvolution(3, 8, 3, 3, data_format="NHWC")
+        x = jnp.asarray(np.random.randn(2, 8, 8, 3).astype(np.float32))
+        y_fp = conv.forward(x)
+        from bigdl_tpu.nn.quantized import QuantizedSpatialConvolution
+
+        qconv = QuantizedSpatialConvolution(conv, conv._params)
+        y_q, _ = qconv.apply(qconv._params, (), x)
+        err = np.abs(np.asarray(y_q) - np.asarray(y_fp)).max()
+        assert err / np.abs(np.asarray(y_fp)).max() < 0.05
+
+
+class TestQuantizeModel:
+    def test_lenet_quantized_accuracy_and_size(self):
+        x, y = synthetic_mnist(512)
+        train = array_dataset(x, y) >> SampleToMiniBatch(64)
+        val = array_dataset(x[:256], y[:256]) >> SampleToMiniBatch(64)
+        model = LeNet5()
+        opt = LocalOptimizer(model, train, nn.ClassNLLCriterion(),
+                             optim.SGD(learning_rate=0.3, momentum=0.9,
+                                       dampening=0.0))
+        opt.set_end_when(Trigger.max_iteration(30))
+        opt.optimize()
+        acc_fp = model.evaluate_on(val, [Top1Accuracy()])[0].result()[0]
+        size_fp = model_bytes(model._params)
+
+        qmodel = quantize(model)
+        acc_q = qmodel.evaluate_on(val, [Top1Accuracy()])[0].result()[0]
+        size_q = model_bytes(qmodel._params)
+
+        assert acc_fp - acc_q < 0.03, (acc_fp, acc_q)
+        assert size_fp / size_q > 3.0, (size_fp, size_q)
+
+    def test_int8_dtypes_in_tree(self):
+        model = LeNet5()
+        model.build(jax.ShapeDtypeStruct((1, 28, 28), jnp.float32))
+        quantize(model)
+        dtypes = {str(l.dtype) for l in jax.tree.leaves(model._params)}
+        assert "int8" in dtypes

@@ -1,0 +1,94 @@
+"""Module save/load round-trips + Keras-style compile/fit API."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset.mnist import synthetic_mnist
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.nn.keras import Model, Sequential
+
+
+class TestSerializer:
+    def test_save_load_roundtrip(self, tmp_path):
+        model = LeNet5()
+        x = jnp.asarray(np.random.rand(2, 28, 28).astype(np.float32))
+        y1 = model.forward(x)
+        path = str(tmp_path / "lenet.bigdl")
+        model.save(path)
+
+        loaded = nn.Module.load(path)
+        y2 = loaded.forward(x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_save_load_with_bn_state(self, tmp_path):
+        model = nn.Sequential().add(nn.Linear(4, 8)).add(
+            nn.BatchNormalization(8))
+        x = jnp.asarray(np.random.randn(16, 4).astype(np.float32))
+        model.forward(x)  # updates running stats
+        path = str(tmp_path / "bn.bigdl")
+        model.save(path)
+        loaded = nn.Module.load(path)
+        np.testing.assert_allclose(
+            np.asarray(loaded._state["1"]["running_mean"]),
+            np.asarray(model._state["1"]["running_mean"]))
+
+    def test_weights_npz_roundtrip(self, tmp_path):
+        model = LeNet5()
+        x = jnp.asarray(np.random.rand(2, 28, 28).astype(np.float32))
+        y1 = model.forward(x)
+        path = str(tmp_path / "w.npz")
+        model.save_weights(path)
+
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(123)  # different init
+        model2 = LeNet5()
+        model2.build(jax.ShapeDtypeStruct((2, 28, 28), jnp.float32))
+        model2.load_weights(path)
+        y2 = model2.forward(x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_graph_model_roundtrip(self, tmp_path):
+        inp = nn.Input()
+        out = nn.CAddTable()(nn.ReLU()(nn.Linear(4, 4)(inp)), inp)
+        model = nn.Graph([inp], [out])
+        x = jnp.asarray(np.random.randn(2, 4).astype(np.float32))
+        y1 = model.forward(x)
+        model.save(str(tmp_path / "g.bigdl"))
+        loaded = nn.Module.load(str(tmp_path / "g.bigdl"))
+        np.testing.assert_allclose(np.asarray(y1),
+                                   np.asarray(loaded.forward(x)), rtol=1e-6)
+
+
+class TestKerasAPI:
+    def test_compile_fit_evaluate_predict(self):
+        x, y = synthetic_mnist(256)
+        model = (Sequential()
+                 .add(nn.Reshape((784,)))
+                 .add(nn.Linear(784, 64)).add(nn.ReLU())
+                 .add(nn.Linear(64, 10)))
+        model.compile(optimizer="adam", loss="categorical_crossentropy",
+                      metrics=["accuracy"])
+        model.fit(x, y, batch_size=64, nb_epoch=4,
+                  validation_data=(x[:128], y[:128]))
+        acc = model.evaluate(x[:128], y[:128], batch_size=64)[0]
+        assert acc > 0.8, acc
+        preds = model.predict(x[:10])
+        assert preds.shape == (10, 10)
+
+    def test_functional_model(self):
+        x, y = synthetic_mnist(128)
+        inp = nn.Input()
+        h = nn.Reshape((784,))(inp)
+        h = nn.Linear(784, 32)(h)
+        h = nn.ReLU()(h)
+        out = nn.Linear(32, 10)(h)
+        model = Model([inp], [out])
+        model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+        model.fit(x, y, batch_size=32, nb_epoch=1)
+        assert model.predict(x[:4]).shape == (4, 10)
