@@ -1,0 +1,131 @@
+"""Text pipeline: tokenization, dictionary, sentence -> Sample.
+
+Reference: dataset/text/ (Dictionary.scala, SentenceTokenizer.scala (OpenNLP),
+LabeledSentence.scala, LabeledSentenceToSample.scala, SentenceBiPadding,
+TextToLabeledSentence).  OpenNLP is replaced by a regex tokenizer -- same
+pipeline contract, no JVM.
+"""
+
+import re
+from collections import Counter
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.minibatch import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class SentenceTokenizer(Transformer):
+    """Lowercase word tokenizer (reference: SentenceTokenizer.scala)."""
+
+    def __init__(self, pattern=r"[A-Za-z']+|[0-9]+|[^\sA-Za-z0-9]"):
+        self.pattern = re.compile(pattern)
+
+    def tokenize(self, sentence: str) -> List[str]:
+        return self.pattern.findall(sentence.lower())
+
+    def apply(self, it):
+        return (self.tokenize(s) for s in it)
+
+
+class SentenceBiPadding(Transformer):
+    """Wrap sentences in SENTENCESTART/SENTENCEEND markers
+    (reference: SentenceBiPadding.scala)."""
+
+    START, END = "SENTENCESTART", "SENTENCEEND"
+
+    def apply(self, it):
+        return ([self.START] + list(tokens) + [self.END] for tokens in it)
+
+
+class Dictionary:
+    """Token <-> index vocabulary (reference: Dictionary.scala).
+
+    ``vocab_size`` keeps the most frequent tokens; everything else maps to
+    one unknown index (= vocab_size, as in the reference's discard handling).
+    """
+
+    def __init__(self, sentences: Optional[Iterable[List[str]]] = None,
+                 vocab_size: Optional[int] = None):
+        self.word2index: Dict[str, int] = {}
+        self.index2word: List[str] = []
+        if sentences is not None:
+            counts = Counter(t for s in sentences for t in s)
+            most = counts.most_common(vocab_size)
+            for i, (w, _) in enumerate(most):
+                self.word2index[w] = i
+                self.index2word.append(w)
+
+    def vocab_size(self) -> int:
+        return len(self.index2word)
+
+    def get_index(self, word: str) -> int:
+        return self.word2index.get(word, len(self.index2word))
+
+    def get_word(self, index: int) -> str:
+        if 0 <= index < len(self.index2word):
+            return self.index2word[index]
+        return "<unk>"
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            for w in self.index2word:
+                f.write(w + "\n")
+
+    @staticmethod
+    def load(path: str) -> "Dictionary":
+        d = Dictionary()
+        with open(path) as f:
+            for i, line in enumerate(f):
+                w = line.rstrip("\n")
+                d.word2index[w] = i
+                d.index2word.append(w)
+        return d
+
+
+class LabeledSentence:
+    """Token-index sequence + target sequence (reference: LabeledSentence.scala)."""
+
+    def __init__(self, data: np.ndarray, label: np.ndarray):
+        self.data = np.asarray(data, np.int32)
+        self.label = np.asarray(label, np.int32)
+
+
+class TextToLabeledSentence(Transformer):
+    """Next-token LM pairs: data = s[:-1], label = s[1:]
+    (reference: TextToLabeledSentence.scala)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def apply(self, it):
+        for tokens in it:
+            idx = np.asarray([self.dictionary.get_index(t) for t in tokens],
+                             np.int32)
+            if len(idx) < 2:
+                continue
+            yield LabeledSentence(idx[:-1], idx[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """LabeledSentence -> Sample, padded/truncated to fixed_length
+    (reference: LabeledSentenceToSample.scala)."""
+
+    def __init__(self, fixed_length: Optional[int] = None, padding_value=0):
+        self.fixed_length = fixed_length
+        self.padding_value = padding_value
+
+    def apply(self, it):
+        for ls in it:
+            data, label = ls.data, ls.label
+            if self.fixed_length is not None:
+                t = self.fixed_length
+                if len(data) >= t:
+                    data, label = data[:t], label[:t]
+                else:
+                    pad = t - len(data)
+                    data = np.pad(data, (0, pad),
+                                  constant_values=self.padding_value)
+                    label = np.pad(label, (0, pad), constant_values=-1)
+            yield Sample(data, label)

@@ -1,0 +1,281 @@
+"""Vision pipeline: ImageFeature/ImageFrame + composable augmentations.
+
+Reference: transform/vision/image/ImageFeature.scala:36 (hash-map of stages),
+ImageFrame.scala (local/distributed containers), FeatureTransformer.scala
+(composable augs), augmentation/ (18 transforms: Resize, CenterCrop,
+RandomCrop, HFlip, ChannelNormalize, Brightness, Contrast, Saturation,
+PixelNormalizer, RandomTransformer, ...).
+
+Host-side (CPU) numpy implementations -- TPUs don't decode images
+(SURVEY.md section 2.8: keep the image pipeline pure host-side).  Layout
+HWC float32; the pipeline ends in Samples feeding SampleToMiniBatch.
+"""
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.minibatch import Sample
+
+
+class ImageFeature(dict):
+    """Mutable per-image state dict (reference: ImageFeature.scala:36).
+
+    Well-known keys: 'image' (HWC float32), 'label', 'path',
+    'original_size'.
+    """
+
+    def __init__(self, image=None, label=None, path=None):
+        super().__init__()
+        if image is not None:
+            self["image"] = np.asarray(image, np.float32)
+            self["original_size"] = self["image"].shape
+        if label is not None:
+            self["label"] = label
+        if path is not None:
+            self["path"] = path
+
+    @property
+    def image(self):
+        return self["image"]
+
+    @image.setter
+    def image(self, v):
+        self["image"] = v
+
+
+class FeatureTransformer:
+    """Composable ImageFeature -> ImageFeature stage
+    (reference: FeatureTransformer.scala; compose with ``>>``)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        raise NotImplementedError
+
+    def __call__(self, feature):
+        return self.transform(feature)
+
+    def __rshift__(self, other):
+        return _Chained(self, other)
+
+
+class _Chained(FeatureTransformer):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def transform(self, feature):
+        return self.b(self.a(feature))
+
+
+class Resize(FeatureTransformer):
+    """Bilinear resize (reference: augmentation/Resize.scala)."""
+
+    def __init__(self, height: int, width: int):
+        self.h, self.w = height, width
+
+    def transform(self, feature):
+        feature["image"] = bilinear_resize(feature["image"], self.h, self.w)
+        return feature
+
+
+class AspectScale(FeatureTransformer):
+    """Scale the short side to ``scale`` keeping aspect
+    (reference: augmentation/AspectScale.scala)."""
+
+    def __init__(self, scale: int, max_size: int = 1000):
+        self.scale, self.max_size = scale, max_size
+
+    def transform(self, feature):
+        img = feature["image"]
+        h, w = img.shape[:2]
+        ratio = self.scale / min(h, w)
+        if max(h, w) * ratio > self.max_size:
+            ratio = self.max_size / max(h, w)
+        feature["image"] = bilinear_resize(
+            img, int(round(h * ratio)), int(round(w * ratio)))
+        return feature
+
+
+class CenterCrop(FeatureTransformer):
+    def __init__(self, height: int, width: int):
+        self.h, self.w = height, width
+
+    def transform(self, feature):
+        img = feature["image"]
+        h, w = img.shape[:2]
+        y0, x0 = (h - self.h) // 2, (w - self.w) // 2
+        feature["image"] = img[y0:y0 + self.h, x0:x0 + self.w]
+        return feature
+
+
+class RandomCrop(FeatureTransformer):
+    def __init__(self, height: int, width: int, seed: Optional[int] = None):
+        self.h, self.w = height, width
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        img = feature["image"]
+        h, w = img.shape[:2]
+        y0 = int(self.rng.integers(0, h - self.h + 1))
+        x0 = int(self.rng.integers(0, w - self.w + 1))
+        feature["image"] = img[y0:y0 + self.h, x0:x0 + self.w]
+        return feature
+
+
+class HFlip(FeatureTransformer):
+    """Horizontal flip (reference: augmentation/HFlip.scala)."""
+
+    def transform(self, feature):
+        feature["image"] = feature["image"][:, ::-1]
+        return feature
+
+
+class RandomHFlip(FeatureTransformer):
+    def __init__(self, prob=0.5, seed: Optional[int] = None):
+        self.prob = prob
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        if self.rng.random() < self.prob:
+            feature["image"] = feature["image"][:, ::-1]
+        return feature
+
+
+class ChannelNormalize(FeatureTransformer):
+    """(x - mean) / std per channel (reference: augmentation/ChannelNormalize.scala)."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def transform(self, feature):
+        feature["image"] = (feature["image"] - self.mean) / self.std
+        return feature
+
+
+class PixelNormalizer(FeatureTransformer):
+    """Subtract a full mean image (reference: augmentation/PixelNormalizer.scala)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def transform(self, feature):
+        feature["image"] = feature["image"] - self.means
+        return feature
+
+
+class Brightness(FeatureTransformer):
+    def __init__(self, delta_low, delta_high, seed: Optional[int] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        feature["image"] = feature["image"] + self.rng.uniform(self.low,
+                                                               self.high)
+        return feature
+
+
+class Contrast(FeatureTransformer):
+    def __init__(self, delta_low, delta_high, seed: Optional[int] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        feature["image"] = feature["image"] * self.rng.uniform(self.low,
+                                                               self.high)
+        return feature
+
+
+class Saturation(FeatureTransformer):
+    """Blend with the grayscale image (reference: augmentation/Saturation.scala)."""
+
+    def __init__(self, delta_low, delta_high, seed: Optional[int] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        img = feature["image"]
+        gray = img.mean(axis=-1, keepdims=True)
+        alpha = self.rng.uniform(self.low, self.high)
+        feature["image"] = gray + alpha * (img - gray)
+        return feature
+
+
+class ChannelScaledNormalizer(FeatureTransformer):
+    def __init__(self, scale: float):
+        self.scale = scale
+
+    def transform(self, feature):
+        feature["image"] = feature["image"] * self.scale
+        return feature
+
+
+class RandomTransformer(FeatureTransformer):
+    """Apply inner transformer with probability ``prob``
+    (reference: augmentation/RandomTransformer.scala)."""
+
+    def __init__(self, inner: FeatureTransformer, prob: float,
+                 seed: Optional[int] = None):
+        self.inner = inner
+        self.prob = prob
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        if self.rng.random() < self.prob:
+            return self.inner(feature)
+        return feature
+
+
+class MatToSample(FeatureTransformer):
+    """Terminal stage: ImageFeature -> Sample
+    (reference: ImageFrameToSample / MatToTensor)."""
+
+    def transform(self, feature):
+        feature["sample"] = Sample(feature["image"], feature.get("label"))
+        return feature
+
+
+class ImageFrame:
+    """Local collection of ImageFeatures (reference: ImageFrame.scala
+    LocalImageFrame; the distributed variant shards like
+    DistributedDataSet)."""
+
+    def __init__(self, features: List[ImageFeature]):
+        self.features = features
+
+    @staticmethod
+    def from_arrays(images, labels=None):
+        labels = labels if labels is not None else [None] * len(images)
+        return ImageFrame([ImageFeature(im, lb)
+                           for im, lb in zip(images, labels)])
+
+    def transform(self, transformer: FeatureTransformer) -> "ImageFrame":
+        self.features = [transformer(f) for f in self.features]
+        return self
+
+    def __rshift__(self, transformer):
+        return self.transform(transformer)
+
+    def to_samples(self) -> List[Sample]:
+        self.transform(MatToSample())
+        return [f["sample"] for f in self.features]
+
+
+def bilinear_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Pure-numpy bilinear resize, align_corners=False (OpenCV-compatible
+    sampling grid)."""
+    h, w = img.shape[:2]
+    if (h, w) == (out_h, out_w):
+        return img
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    img = img if img.ndim == 3 else img[..., None]
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(np.float32)

@@ -1,0 +1,100 @@
+"""Vision + text pipeline tests."""
+
+import numpy as np
+import torch
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.transform.text import (Dictionary, LabeledSentenceToSample,
+                                      SentenceBiPadding, SentenceTokenizer,
+                                      TextToLabeledSentence)
+from bigdl_tpu.transform.vision import (AspectScale, Brightness, CenterCrop,
+                                        ChannelNormalize, FeatureTransformer,
+                                        HFlip, ImageFeature, ImageFrame,
+                                        RandomCrop, RandomHFlip,
+                                        RandomTransformer, Resize,
+                                        bilinear_resize)
+
+
+class TestVision:
+    def test_bilinear_matches_torch(self):
+        img = np.random.rand(17, 23, 3).astype(np.float32)
+        out = bilinear_resize(img, 8, 11)
+        t = torch.nn.functional.interpolate(
+            torch.tensor(img).permute(2, 0, 1)[None], size=(8, 11),
+            mode="bilinear", align_corners=False)
+        want = t[0].permute(1, 2, 0).numpy()
+        np.testing.assert_allclose(out, want, atol=1e-5)
+
+    def test_crop_flip_normalize_chain(self):
+        img = np.random.rand(32, 32, 3).astype(np.float32)
+        chain = (Resize(28, 28) >> CenterCrop(24, 24) >> HFlip()
+                 >> ChannelNormalize([0.5, 0.5, 0.5], [0.25, 0.25, 0.25]))
+        f = chain(ImageFeature(img, label=3))
+        assert f["image"].shape == (24, 24, 3)
+        assert f["label"] == 3
+
+    def test_random_transforms_deterministic_seed(self):
+        img = np.random.rand(16, 16, 1).astype(np.float32)
+        rc = RandomCrop(8, 8, seed=0)
+        a = rc(ImageFeature(img))["image"]
+        rc2 = RandomCrop(8, 8, seed=0)
+        b = rc2(ImageFeature(img))["image"]
+        np.testing.assert_array_equal(a, b)
+
+    def test_aspect_scale(self):
+        img = np.zeros((100, 200, 3), np.float32)
+        f = AspectScale(50)(ImageFeature(img))
+        assert f["image"].shape[:2] == (50, 100)
+
+    def test_image_frame_to_samples(self):
+        imgs = np.random.rand(4, 12, 12, 3).astype(np.float32)
+        frame = ImageFrame.from_arrays(imgs, labels=[0, 1, 2, 3])
+        frame.transform(CenterCrop(8, 8))
+        samples = frame.to_samples()
+        assert len(samples) == 4
+        assert samples[0].feature.shape == (8, 8, 3)
+        assert samples[2].label == 2
+
+    def test_random_transformer_prob(self):
+        img = np.random.rand(8, 8, 1).astype(np.float32)
+        never = RandomTransformer(HFlip(), 0.0)
+        out = never(ImageFeature(img.copy()))["image"]
+        np.testing.assert_array_equal(out, img)
+
+
+class TestText:
+    CORPUS = ["The quick brown fox jumps over the lazy dog.",
+              "The dog barks.",
+              "A quick brown dog."]
+
+    def test_tokenize_and_dictionary(self):
+        tok = SentenceTokenizer()
+        sents = list(tok.apply(iter(self.CORPUS)))
+        assert sents[1] == ["the", "dog", "barks", "."]
+        d = Dictionary(sents, vocab_size=5)
+        assert d.vocab_size() == 5
+        assert d.get_index("the") == 0  # most frequent
+        assert d.get_index("zebra") == 5  # unk
+        assert d.get_word(0) == "the"
+
+    def test_dictionary_save_load(self, tmp_path):
+        d = Dictionary([["a", "b", "a"]])
+        p = str(tmp_path / "vocab.txt")
+        d.save(p)
+        d2 = Dictionary.load(p)
+        assert d2.get_index("a") == d.get_index("a")
+
+    def test_lm_pipeline(self):
+        tok = SentenceTokenizer()
+        sents = list(tok.apply(iter(self.CORPUS)))
+        d = Dictionary(sents)
+        pipeline = (SentenceBiPadding() >> TextToLabeledSentence(d)
+                    >> LabeledSentenceToSample(fixed_length=8))
+        samples = list(pipeline.apply(tok.apply(iter(self.CORPUS))))
+        assert len(samples) == 3
+        s = samples[0]
+        assert s.feature.shape == (8,) and s.label.shape == (8,)
+        # next-token alignment: label[i] == feature[i+1] in unpadded region
+        assert s.label[0] == s.feature[1]
+        # padding labels are masked with -1 for ClassNLL padding_value
+        assert (s.label[-1] == -1) or len(samples[0].feature) == 8
