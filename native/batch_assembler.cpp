@@ -1,0 +1,93 @@
+// Native host-side batch assembler.
+//
+// Reference: the multi-threaded batch builders MTLabeledBGRImgToBatch
+// (dataset/image/MTLabeledBGRImgToBatch.scala) and MTImageFeatureToBatch
+// (transform/vision/image/MTImageFeatureToBatch.scala), which fan sample
+// copy/normalize work across JVM threads before feeding the optimizer.
+//
+// TPU-native equivalent: the device never sees this path -- it is pure host
+// work feeding the jit'd step, so it is written as a small C++ kernel
+// (std::thread fan-out, no JVM, no OpenCV JNI).  Exposed to Python via
+// ctypes (no pybind11 in the image).  The ctypes call releases the GIL, so
+// Python-side prefetch threads get true parallelism.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libbatch_assembler.so \
+//            batch_assembler.cpp -lpthread
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Copy + normalize one sample: out = (src - mean) / std, channel-wise.
+void assemble_one(const float* src, float* out, int64_t sample_size,
+                  const float* mean, const float* stdv, int channels) {
+  if (channels <= 0) {
+    std::memcpy(out, src, sample_size * sizeof(float));
+    return;
+  }
+  const int64_t pixels = sample_size / channels;
+  for (int64_t p = 0; p < pixels; ++p) {
+    const float* s = src + p * channels;
+    float* d = out + p * channels;
+    for (int c = 0; c < channels; ++c) {
+      d[c] = (s[c] - mean[c]) / stdv[c];
+    }
+  }
+}
+
+void run_range(const float* src, const int64_t* indices, int64_t begin,
+               int64_t end, int64_t sample_size, const float* mean,
+               const float* stdv, int channels, float* out) {
+  for (int64_t i = begin; i < end; ++i) {
+    assemble_one(src + indices[i] * sample_size, out + i * sample_size,
+                 sample_size, mean, stdv, channels);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather samples by index from a contiguous pool and channel-normalize into
+// a batch buffer, fanning the work over n_threads.
+//   src:      (pool_size, sample_size) float32, C-contiguous
+//   indices:  (batch,) int64 rows to gather
+//   out:      (batch, sample_size) float32, preallocated
+//   mean/stdv:(channels,) or channels==0 for plain copy
+void bigdl_gather_normalize(const float* src, const int64_t* indices,
+                            int64_t batch, int64_t sample_size,
+                            const float* mean, const float* stdv,
+                            int channels, float* out, int n_threads) {
+  n_threads = std::max(1, std::min<int>(n_threads, (int)batch));
+  if (n_threads == 1) {
+    run_range(src, indices, 0, batch, sample_size, mean, stdv, channels, out);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  const int64_t chunk = (batch + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    const int64_t b = t * chunk;
+    const int64_t e = std::min(batch, b + chunk);
+    if (b >= e) break;
+    workers.emplace_back(run_range, src, indices, b, e, sample_size, mean,
+                         stdv, channels, out);
+  }
+  for (auto& w : workers) w.join();
+}
+
+// int labels gather (no normalize).
+void bigdl_gather_labels(const int32_t* src, const int64_t* indices,
+                         int64_t batch, int64_t label_size, int32_t* out) {
+  for (int64_t i = 0; i < batch; ++i) {
+    std::memcpy(out + i * label_size, src + indices[i] * label_size,
+                label_size * sizeof(int32_t));
+  }
+}
+
+}  // extern "C"
