@@ -1,0 +1,1 @@
+from bigdl_tpu.ops.flash_attention import flash_attention

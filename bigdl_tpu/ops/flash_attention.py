@@ -1,0 +1,94 @@
+"""Fused flash-attention forward kernel in Pallas (Mosaic/TPU).
+
+The TPU-native analogue of the reference's hand-tuned native kernels
+(bigdl-core MKL-DNN primitives, SURVEY.md section 2.8): where XLA's fusion
+isn't enough, drop to Pallas.  Attention is the one op where manual tiling
+pays -- the (T, T) score matrix never materialises in HBM; each (block_q,
+block_k) tile lives in VMEM with a flash-style online softmax.
+
+Layout: q/k/v (BH, T, D) fp32/bf16; softmax state fp32.  Causal masking by
+global position.  Grid: (BH, T/block_q); the k-loop is a lax.fori_loop
+inside the kernel.  ``interpret=True`` runs on CPU for tests.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                 scale: float):
+    block_q, d = q_ref.shape
+    t = k_ref.shape[0]
+    iq = pl.program_id(1)
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    nk = t // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        kblk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = q @ kblk.T  # (block_q, block_k)
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = kpos <= qpos
+            s = jnp.where(mask, s, -jnp.inf)
+        bm = jnp.max(s, axis=1)
+        new_m = jnp.maximum(m, bm)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(s - safe_m[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * corr + jnp.sum(p, axis=1)
+        acc = acc * corr[:, None] + p @ vblk
+        return acc, new_m, l
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """q, k, v: (B, T, H, D) -> (B, T, H, D).
+
+    T must be a multiple of the block sizes (pad upstream; the reference
+    pipeline pads too -- dataset/MiniBatch.scala:523 PaddingParam).
+    """
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0, (t, block_q, block_k)
+    scale = 1.0 / math.sqrt(d)
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, block_k=block_k, causal=causal,
+                          scale=scale),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

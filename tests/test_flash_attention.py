@@ -1,0 +1,42 @@
+"""Pallas flash-attention kernel vs plain attention (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.attention import dot_product_attention
+from bigdl_tpu.ops import flash_attention
+
+
+def rand(b=2, t=64, h=4, d=16, seed=0):
+    r = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(r.standard_normal((b, t, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_plain(self, causal):
+        q, k, v = rand()
+        want = dot_product_attention(q, k, v, causal=causal)
+        got = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_single_block(self):
+        q, k, v = rand(t=16)
+        want = dot_product_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_uneven_blocks(self):
+        q, k, v = rand(t=96)
+        want = dot_product_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, causal=True, block_q=32, block_k=16,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
