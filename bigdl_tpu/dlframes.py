@@ -1,0 +1,104 @@
+"""DataFrame-style estimator/transformer API.
+
+Reference: dlframes/DLEstimator.scala:163 (Spark ML Estimator whose fit()
+returns a DLModel transformer), DLClassifier.scala:37.
+
+Without a JVM/Spark the same contract is exposed sklearn-style: ``fit(X, y)``
+returns a fitted ``DLModel`` whose ``transform(X)`` appends predictions.
+Accepts numpy arrays or any sequence of rows (the reference supports
+Vector/Array/Double feature columns -- here any array-like of fixed shape).
+"""
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+from bigdl_tpu.dataset.minibatch import Sample
+from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+from bigdl_tpu.optim.optim_method import SGD, OptimMethod
+from bigdl_tpu.optim.trigger import Trigger
+
+
+class DLModel:
+    """Fitted transformer (reference: DLModel, dlframes/DLEstimator.scala:362)."""
+
+    def __init__(self, model: nn.Module, feature_size: Sequence[int],
+                 batch_size: int = 128):
+        self.model = model
+        self.feature_size = tuple(feature_size)
+        self.batch_size = batch_size
+
+    def transform(self, X) -> np.ndarray:
+        """-> predictions, one row per input row."""
+        X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
+        samples = [Sample(x) for x in X]
+        return np.stack(self.model.predict(samples, self.batch_size))
+
+
+class DLClassifierModel(DLModel):
+    def transform(self, X) -> np.ndarray:
+        """-> class indices (reference: DLClassifierModel argmax semantics)."""
+        return np.argmax(super().transform(X), axis=-1)
+
+
+class DLEstimator:
+    """Reference: dlframes/DLEstimator.scala:163."""
+
+    model_cls = DLModel
+
+    def __init__(self, model: nn.Module, criterion, feature_size: Sequence[int],
+                 label_size: Sequence[int] = ()):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(feature_size)
+        self.label_size = tuple(label_size)
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.optim_method: OptimMethod = SGD(learning_rate=0.01)
+
+    # builder setters mirroring the reference Params
+    def set_batch_size(self, n):
+        self.batch_size = n
+        return self
+
+    def set_max_epoch(self, n):
+        self.max_epoch = n
+        return self
+
+    def set_learning_rate(self, lr):
+        self.optim_method.learning_rate = lr
+        return self
+
+    def set_optim_method(self, method: OptimMethod):
+        self.optim_method = method
+        return self
+
+    def _prepare_labels(self, y):
+        return np.asarray(y)
+
+    def fit(self, X, y) -> DLModel:
+        X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
+        y = self._prepare_labels(y)
+        dataset = array_dataset(X, y) >> SampleToMiniBatch(
+            self.batch_size, drop_remainder=False)
+        opt = LocalOptimizer(self.model, dataset, self.criterion,
+                             self.optim_method)
+        opt.set_end_when(Trigger.max_epoch(self.max_epoch))
+        opt.optimize()
+        return self.model_cls(self.model, self.feature_size, self.batch_size)
+
+
+class DLClassifier(DLEstimator):
+    """Reference: dlframes/DLClassifier.scala:37 -- int labels, argmax out."""
+
+    model_cls = DLClassifierModel
+
+    def __init__(self, model: nn.Module, criterion=None,
+                 feature_size: Sequence[int] = ()):
+        super().__init__(model, criterion or nn.CrossEntropyCriterion(),
+                         feature_size)
+
+    def _prepare_labels(self, y):
+        return np.asarray(y, np.int32)

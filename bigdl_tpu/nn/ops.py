@@ -1,0 +1,290 @@
+"""TF-style forward-only operations.
+
+Reference: nn/ops/Operation.scala:32 (Operation = forward-only module whose
+backward raises) + the 71-file op zoo under nn/ops/ (arithmetic, comparison,
+logical, array, reduction ops) and nn/tf/ stateless ops.
+
+Each op is a thin Module over the matching jnp/lax primitive -- XLA fuses
+them; there is no per-op kernel to manage.  All are usable inside Graph /
+Sequential like any layer.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class Operation(Module):
+    """Forward-only marker (reference: nn/ops/Operation.scala:32)."""
+
+    def backward(self, input, grad_output):
+        raise RuntimeError("Operation does not support backward "
+                           "(reference semantics)")
+
+
+class _Binary(Operation):
+    def fn(self, a, b):
+        raise NotImplementedError
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        a, b = input
+        return self.fn(a, b), state
+
+
+class Add(_Binary):
+    def fn(self, a, b):
+        return a + b
+
+
+class Subtract(_Binary):
+    def fn(self, a, b):
+        return a - b
+
+
+class Multiply(_Binary):
+    def fn(self, a, b):
+        return a * b
+
+
+class Divide(_Binary):
+    def fn(self, a, b):
+        return a / b
+
+
+class FloorDiv(_Binary):
+    def fn(self, a, b):
+        return jnp.floor_divide(a, b)
+
+
+class Mod(_Binary):
+    def fn(self, a, b):
+        return jnp.mod(a, b)
+
+
+class Maximum(_Binary):
+    def fn(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class Minimum(_Binary):
+    def fn(self, a, b):
+        return jnp.minimum(a, b)
+
+
+class Pow(_Binary):
+    def fn(self, a, b):
+        return jnp.power(a, b)
+
+
+class Greater(_Binary):
+    def fn(self, a, b):
+        return a > b
+
+
+class GreaterEqual(_Binary):
+    def fn(self, a, b):
+        return a >= b
+
+
+class Less(_Binary):
+    def fn(self, a, b):
+        return a < b
+
+
+class LessEqual(_Binary):
+    def fn(self, a, b):
+        return a <= b
+
+
+class Equal(_Binary):
+    def fn(self, a, b):
+        return a == b
+
+
+class NotEqual(_Binary):
+    def fn(self, a, b):
+        return a != b
+
+
+class LogicalAnd(_Binary):
+    def fn(self, a, b):
+        return jnp.logical_and(a, b)
+
+
+class LogicalOr(_Binary):
+    def fn(self, a, b):
+        return jnp.logical_or(a, b)
+
+
+class LogicalNot(Operation):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.logical_not(input), state
+
+
+class _Reduce(Operation):
+    def __init__(self, axis=None, keep_dims=False, name=None):
+        super().__init__(name)
+        self.axis = axis
+        self.keep_dims = keep_dims
+
+    def fn(self, x):
+        raise NotImplementedError
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self.fn(input), state
+
+
+class ReduceSum(_Reduce):
+    def fn(self, x):
+        return jnp.sum(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class ReduceMean(_Reduce):
+    def fn(self, x):
+        return jnp.mean(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class ReduceMax(_Reduce):
+    def fn(self, x):
+        return jnp.max(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class ReduceMin(_Reduce):
+    def fn(self, x):
+        return jnp.min(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class ReduceProd(_Reduce):
+    def fn(self, x):
+        return jnp.prod(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class All(_Reduce):
+    def fn(self, x):
+        return jnp.all(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class Any(_Reduce):
+    def fn(self, x):
+        return jnp.any(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class ArgMax(Operation):
+    def __init__(self, axis=-1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.argmax(input, axis=self.axis), state
+
+
+class TopK(Operation):
+    """-> (values, indices) table (reference: nn/ops/TopK.scala)."""
+
+    def __init__(self, k, name=None):
+        super().__init__(name)
+        self.k = k
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        vals, idx = jax.lax.top_k(input, self.k)
+        return (vals, idx), state
+
+
+class OneHot(Operation):
+    def __init__(self, depth, on_value=1.0, off_value=0.0, name=None):
+        super().__init__(name)
+        self.depth = depth
+        self.on_value, self.off_value = on_value, off_value
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        oh = jax.nn.one_hot(input.astype(jnp.int32), self.depth)
+        return oh * (self.on_value - self.off_value) + self.off_value, state
+
+
+class Cast(Operation):
+    def __init__(self, dtype, name=None):
+        super().__init__(name)
+        self.dtype = dtype
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input.astype(self.dtype), state
+
+
+class Floor(Operation):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.floor(input), state
+
+
+class Ceil(Operation):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.ceil(input), state
+
+
+class Round(Operation):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.round(input), state
+
+
+class Sign(Operation):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.sign(input), state
+
+
+class Select(Operation):
+    """(cond, x, y) -> where(cond, x, y) (reference: nn/ops/Select.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        cond, x, y = input
+        return jnp.where(cond, x, y), state
+
+
+class Tile(Operation):
+    def __init__(self, multiples, name=None):
+        super().__init__(name)
+        self.multiples = tuple(multiples)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.tile(input, self.multiples), state
+
+
+class Gather(Operation):
+    """(params_array, indices) -> gathered (reference: nn/ops/Gather.scala)."""
+
+    def __init__(self, axis=0, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        arr, idx = input
+        return jnp.take(arr, idx.astype(jnp.int32), axis=self.axis), state
+
+
+class Slice(Operation):
+    def __init__(self, begin, size, name=None):
+        super().__init__(name)
+        self.begin, self.size = begin, size
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        idx = tuple(slice(b, b + s) for b, s in zip(self.begin, self.size))
+        return input[idx], state
+
+
+class Rank(Operation):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.asarray(input.ndim), state
+
+
+class Shape(Operation):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.asarray(input.shape), state
+
+
+class IsNan(Operation):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.isnan(input), state
+
+
+class IsInf(Operation):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.isinf(input), state

@@ -1,0 +1,65 @@
+"""Training metrics + profiling.
+
+Reference: optim/Metrics.scala:31 (set/add/summary over Spark accumulators,
+populated per iteration at optim/DistriOptimizer.scala:194-202) and the
+per-module ns timers in AbstractModule.getTimes.
+
+TPU-native: host-side counters (no Spark); device-side profiling goes
+through ``jax.profiler`` traces (TensorBoard-viewable), which is strictly
+more than the reference offers (SURVEY.md section 5: 'no sampling profiler,
+no chrome-trace').
+"""
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict
+
+
+class Metrics:
+    """Aggregating named counters (reference: optim/Metrics.scala:31)."""
+
+    def __init__(self):
+        self._sums: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    def set(self, name: str, value: float):
+        self._sums[name] = float(value)
+        self._counts[name] = 1
+
+    def add(self, name: str, value: float):
+        self._sums[name] += float(value)
+        self._counts[name] += 1
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def value(self, name: str) -> float:
+        c = self._counts.get(name, 0)
+        return self._sums.get(name, 0.0) / c if c else 0.0
+
+    def summary(self) -> str:
+        """Reference: Metrics.summary -- one line of name: mean pairs."""
+        parts = [f"{k}: {self.value(k):.6f}" for k in sorted(self._sums)]
+        return ", ".join(parts)
+
+    def reset(self):
+        self._sums.clear()
+        self._counts.clear()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a device trace viewable in TensorBoard / Perfetto."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,117 @@
+"""nn.ops zoo, Metrics, and DLEstimator/DLClassifier tests."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dlframes import DLClassifier, DLEstimator
+from bigdl_tpu.dataset.mnist import synthetic_mnist
+from bigdl_tpu.nn import ops
+from bigdl_tpu.optim.metrics import Metrics
+
+
+class TestOps:
+    def test_binary_ops(self):
+        a = jnp.asarray([4.0, 9.0])
+        b = jnp.asarray([2.0, 3.0])
+        assert np.allclose(ops.Add().forward((a, b)), [6, 12])
+        assert np.allclose(ops.Subtract().forward((a, b)), [2, 6])
+        assert np.allclose(ops.Multiply().forward((a, b)), [8, 27])
+        assert np.allclose(ops.Divide().forward((a, b)), [2, 3])
+        assert np.allclose(ops.Pow().forward((a, b)), [16, 729])
+        assert np.allclose(ops.Maximum().forward((a, b)), [4, 9])
+        assert np.all(np.asarray(ops.Greater().forward((a, b))))
+
+    def test_comparisons_and_logical(self):
+        a = jnp.asarray([1, 2, 3])
+        b = jnp.asarray([2, 2, 2])
+        assert list(np.asarray(ops.Equal().forward((a, b)))) == [False, True, False]
+        assert list(np.asarray(ops.LessEqual().forward((a, b)))) == [True, True, False]
+        t = jnp.asarray([True, False])
+        f = jnp.asarray([True, True])
+        assert list(np.asarray(ops.LogicalAnd().forward((t, f)))) == [True, False]
+        assert list(np.asarray(ops.LogicalNot().forward(t))) == [False, True]
+
+    def test_reductions(self):
+        x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        assert float(ops.ReduceSum().forward(x)) == 10
+        assert np.allclose(ops.ReduceMean(axis=0).forward(x), [2, 3])
+        assert float(ops.ReduceMax().forward(x)) == 4
+        assert float(ops.ReduceProd().forward(x)) == 24
+
+    def test_array_ops(self):
+        x = jnp.asarray([[0.1, 0.9, 0.0]])
+        assert int(ops.ArgMax().forward(x)[0]) == 1
+        vals, idx = ops.TopK(2).forward(x)
+        assert list(np.asarray(idx[0])) == [1, 0]
+        oh = ops.OneHot(3).forward(jnp.asarray([2]))
+        assert np.allclose(oh, [[0, 0, 1]])
+        assert ops.Cast(jnp.int32).forward(jnp.asarray([1.7])).dtype == jnp.int32
+        sel = ops.Select().forward((jnp.asarray([True, False]),
+                                    jnp.asarray([1.0, 1.0]),
+                                    jnp.asarray([2.0, 2.0])))
+        assert list(np.asarray(sel)) == [1.0, 2.0]
+        g = ops.Gather().forward((jnp.arange(10.0), jnp.asarray([3, 5])))
+        assert list(np.asarray(g)) == [3.0, 5.0]
+        assert ops.Tile((2, 1)).forward(jnp.ones((1, 3))).shape == (2, 3)
+        assert ops.Slice((0, 1), (1, 2)).forward(jnp.ones((2, 4))).shape == (1, 2)
+
+    def test_operation_backward_raises(self):
+        op = ops.Add()
+        with pytest.raises(RuntimeError):
+            op.backward((jnp.ones(2), jnp.ones(2)), jnp.ones(2))
+
+    def test_ops_inside_graph(self):
+        inp = nn.Input()
+        top = ops.ReduceMean(axis=-1)(inp)
+        model = nn.Graph([inp], [top])
+        y = model.forward(jnp.asarray([[1.0, 3.0]]))
+        assert float(y[0]) == 2.0
+
+
+class TestMetrics:
+    def test_set_add_summary(self):
+        m = Metrics()
+        m.set("loss", 2.0)
+        m.add("time", 0.5)
+        m.add("time", 1.5)
+        assert m.value("loss") == 2.0
+        assert m.value("time") == 1.0
+        assert "loss" in m.summary() and "time" in m.summary()
+
+    def test_timer(self):
+        import time
+
+        m = Metrics()
+        with m.timer("step"):
+            time.sleep(0.01)
+        assert m.value("step") >= 0.01
+
+
+class TestDLFrames:
+    def test_classifier_fit_transform(self):
+        x, y = synthetic_mnist(256)
+        model = (nn.Sequential().add(nn.Reshape((784,)))
+                 .add(nn.Linear(784, 32)).add(nn.ReLU())
+                 .add(nn.Linear(32, 10)))
+        clf = DLClassifier(model, feature_size=(28, 28))
+        clf.set_batch_size(64).set_max_epoch(3).set_learning_rate(0.5)
+        fitted = clf.fit(x, y)
+        preds = fitted.transform(x[:64])
+        assert preds.shape == (64,)
+        assert (preds == y[:64]).mean() > 0.7
+
+    def test_estimator_regression(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((128, 4)).astype(np.float32)
+        w = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+        Y = X @ w[:, None]
+        est = DLEstimator(nn.Sequential().add(nn.Linear(4, 1)),
+                          nn.MSECriterion(), feature_size=(4,),
+                          label_size=(1,))
+        est.set_batch_size(32).set_max_epoch(30).set_learning_rate(0.1)
+        fitted = est.fit(X, Y)
+        pred = fitted.transform(X[:16])
+        assert np.abs(pred - Y[:16]).mean() < 0.2
