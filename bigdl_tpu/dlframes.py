@@ -40,7 +40,10 @@ class DLModel:
 class DLClassifierModel(DLModel):
     def transform(self, X) -> np.ndarray:
         """-> class indices (reference: DLClassifierModel argmax semantics)."""
-        return np.argmax(super().transform(X), axis=-1)
+        X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
+        samples = [Sample(x) for x in X]
+        return np.asarray(
+            self.model.predict_class(samples, self.batch_size))
 
 
 class DLEstimator:
@@ -48,10 +51,12 @@ class DLEstimator:
 
     model_cls = DLModel
 
-    def __init__(self, model: nn.Module, criterion, feature_size: Sequence[int],
+    def __init__(self, model: nn.Module, criterion,
+                 feature_size: Sequence[int] = (),
                  label_size: Sequence[int] = ()):
         self.model = model
         self.criterion = criterion
+        #: empty -> inferred from X.shape[1:] at fit() time
         self.feature_size = tuple(feature_size)
         self.label_size = tuple(label_size)
         self.batch_size = 32
@@ -79,8 +84,13 @@ class DLEstimator:
         return np.asarray(y)
 
     def fit(self, X, y) -> DLModel:
-        X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
+        X = np.asarray(X, np.float32)
+        if not self.feature_size:
+            self.feature_size = X.shape[1:]
+        X = X.reshape((-1,) + self.feature_size)
         y = self._prepare_labels(y)
+        if self.label_size:
+            y = y.reshape((-1,) + self.label_size)
         dataset = array_dataset(X, y) >> SampleToMiniBatch(
             self.batch_size, drop_remainder=False)
         opt = LocalOptimizer(self.model, dataset, self.criterion,

@@ -1,0 +1,188 @@
+"""Mixture-of-Experts layers (Switch/Mixtral-style top-k routing).
+
+No reference analogue (SURVEY.md section 2.4: expert parallelism absent) --
+built the canonical TPU way: expert parameters are *stacked* on a leading
+expert dimension and the dispatch/compute/combine path is three dense
+einsums with a static capacity, so the whole layer is MXU-shaped with no
+dynamic shapes.  Sharding the expert dimension over an ``expert`` mesh axis
+(parallel/ep.py) turns the dispatch/combine einsums into XLA all-to-alls
+over ICI -- expert parallelism falls out of GSPMD annotations.
+
+Routing: top-k gating with softmax probs, capacity ``C = ceil(T/E * cf)``
+per expert; overflowing tokens are dropped (standard Switch behaviour) and
+the load-balancing auxiliary loss (Shazeer et al.) keeps the router honest.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import Xavier
+from bigdl_tpu.nn.module import Module, child_rng
+from bigdl_tpu.nn.normalization import LayerNorm
+
+
+class MoE(Module):
+    """Top-k routed expert MLP: (N, T, D) -> (N, T, D).
+
+    apply() returns ``(out, {"aux_loss": scalar})`` -- the train step adds
+    ``aux_weight * aux_loss`` to the task loss.
+    """
+
+    def __init__(self, hidden_size: int, num_experts: int, k: int = 2,
+                 mlp_ratio: int = 4, capacity_factor: float = 1.25,
+                 name=None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.k = min(k, num_experts)
+        self.mlp_ratio = mlp_ratio
+        self.capacity_factor = capacity_factor
+
+    def setup(self, rng, input_spec):
+        d, f, e = (self.hidden_size, self.mlp_ratio * self.hidden_size,
+                   self.num_experts)
+        init = Xavier()
+        w1 = jnp.stack([init.init(child_rng(rng, 2 + i), (d, f), d, f)
+                        for i in range(e)])
+        w2 = jnp.stack([init.init(child_rng(rng, 100 + i), (f, d), f, d)
+                        for i in range(e)])
+        return {
+            "gate": init.init(child_rng(rng, 0), (d, e), d, e),
+            "w1": w1,                      # (E, D, F) expert-stacked
+            "b1": jnp.zeros((e, f), jnp.float32),
+            "w2": w2,                      # (E, F, D)
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }, ()
+
+    def _capacity(self, tokens: int) -> int:
+        return max(
+            self.k,
+            int(math.ceil(tokens / self.num_experts * self.capacity_factor)))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        n, t, d = input.shape
+        e, k = self.num_experts, self.k
+        tokens = n * t
+        cap = self._capacity(tokens)
+        x = input.reshape(tokens, d)
+
+        logits = (x @ params["gate"].astype(x.dtype)).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)              # (T, E)
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)       # (T, k)
+        gate_vals = gate_vals / jnp.clip(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        # position of each (token, choice) within its expert's capacity
+        sel = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, k, E)
+        # rank within expert: cumulative count over (token, choice) pairs in
+        # routing priority order (choice-major so 1st choices beat 2nd)
+        flat_sel = sel.transpose(1, 0, 2).reshape(k * tokens, e)
+        pos = jnp.cumsum(flat_sel, axis=0) - flat_sel          # (k*T, E)
+        pos = (pos * flat_sel).sum(-1)                         # (k*T,)
+        fits = pos < cap
+        pos = pos.reshape(k, tokens).transpose(1, 0)           # (T, k)
+        fits = fits.reshape(k, tokens).transpose(1, 0)
+
+        gate_vals = gate_vals * fits.astype(jnp.float32)
+        # dispatch/combine tensors (T, E, C)
+        combine = jnp.einsum(
+            "tk,tke,tkc->tec", gate_vals, sel,
+            jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32) *
+            fits[..., None].astype(jnp.float32))
+        dispatch = (combine > 0).astype(x.dtype)
+
+        # expert compute, all MXU einsums over the stacked expert dim
+        ex_in = jnp.einsum("tec,td->ecd", dispatch, x)
+        h = jnp.einsum("ecd,edf->ecf", ex_in,
+                       params["w1"].astype(x.dtype))
+        h = h + params["b1"][:, None, :].astype(x.dtype)
+        h = jax.nn.gelu(h)
+        h = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(x.dtype))
+        h = h + params["b2"][:, None, :].astype(x.dtype)
+        out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), h)
+
+        # load-balance aux loss: E * mean(fraction_routed) . mean(prob)
+        frac = sel[:, 0, :].mean(0)            # first-choice assignment share
+        mean_prob = probs.mean(0)
+        aux = (frac * mean_prob).sum() * e
+        return out.reshape(n, t, d), {"aux_loss": aux}
+
+
+class MoETransformerBlock(Module):
+    """Pre-LN block with MoE in place of the dense MLP."""
+
+    def __init__(self, hidden_size, num_heads, num_experts, k=2,
+                 mlp_ratio=4, capacity_factor=1.25, causal=True, name=None):
+        super().__init__(name)
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        self.ln1 = LayerNorm(hidden_size)
+        self.attn = MultiHeadAttention(hidden_size, num_heads, causal)
+        self.ln2 = LayerNorm(hidden_size)
+        self.moe = MoE(hidden_size, num_experts, k, mlp_ratio,
+                       capacity_factor)
+
+    def setup(self, rng, input_spec):
+        params = {}
+        for i, (key, m) in enumerate([("ln1", self.ln1), ("attn", self.attn),
+                                      ("ln2", self.ln2), ("moe", self.moe)]):
+            p, _ = m.setup(child_rng(rng, i), input_spec)
+            params[key] = p
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        h, _ = self.ln1.apply(params["ln1"], (), input)
+        a, _ = self.attn.apply(params["attn"], (), h, training=training,
+                               rng=child_rng(rng, 0))
+        x = input + a
+        h, _ = self.ln2.apply(params["ln2"], (), x)
+        h, st = self.moe.apply(params["moe"], (), h, training=training)
+        return x + h, st
+
+
+class MoETransformerLM(Module):
+    """Decoder-only MoE LM; apply() -> (logits, {"aux_loss": total})."""
+
+    def __init__(self, vocab_size, hidden_size, num_heads, num_layers,
+                 num_experts, k=2, max_len=2048, mlp_ratio=4,
+                 capacity_factor=1.25, name=None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.max_len = max_len
+        self.blocks = [
+            MoETransformerBlock(hidden_size, num_heads, num_experts, k,
+                                mlp_ratio, capacity_factor)
+            for _ in range(num_layers)]
+        self.ln_f = LayerNorm(hidden_size)
+
+    def setup(self, rng, input_spec):
+        d = self.hidden_size
+        params = {
+            "wte": 0.02 * jax.random.normal(child_rng(rng, 0),
+                                            (self.vocab_size, d)),
+            "wpe": 0.01 * jax.random.normal(child_rng(rng, 1),
+                                            (self.max_len, d)),
+            "head": 0.02 * jax.random.normal(child_rng(rng, 2),
+                                             (self.vocab_size, d)),
+        }
+        hid_spec = jax.ShapeDtypeStruct(
+            (input_spec.shape[0], input_spec.shape[1], d), jnp.float32)
+        for i, b in enumerate(self.blocks):
+            params[f"block{i}"], _ = b.setup(child_rng(rng, 3 + i), hid_spec)
+        params["ln_f"], _ = self.ln_f.setup(child_rng(rng, 99), hid_spec)
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t = input.shape[1]
+        x = jnp.take(params["wte"], input.astype(jnp.int32), axis=0)
+        x = x + params["wpe"][:t][None]
+        aux = jnp.float32(0.0)
+        for i, b in enumerate(self.blocks):
+            x, st = b.apply(params[f"block{i}"], (), x, training=training,
+                            rng=child_rng(rng, i))
+            aux = aux + st["aux_loss"]
+        x, _ = self.ln_f.apply(params["ln_f"], (), x)
+        logits = x @ params["head"].astype(x.dtype).T
+        return logits, {"aux_loss": aux}

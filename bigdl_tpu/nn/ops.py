@@ -266,7 +266,9 @@ class Slice(Operation):
         self.begin, self.size = begin, size
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        idx = tuple(slice(b, b + s) for b, s in zip(self.begin, self.size))
+        # size == -1 takes the remainder of the axis (TF tf.slice convention)
+        idx = tuple(slice(b, None if s == -1 else b + s)
+                    for b, s in zip(self.begin, self.size))
         return input[idx], state
 
 

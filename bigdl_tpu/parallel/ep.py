@@ -1,0 +1,95 @@
+"""Expert parallelism: MoE expert-stacked params sharded over an ``expert``
+mesh axis via GSPMD annotations.
+
+No reference analogue (SURVEY.md section 2.4: expert parallelism absent).
+The MoE layer (nn/moe.py) keeps experts stacked on a leading dimension; here
+that dimension is annotated with ``NamedSharding(P("expert", ...))`` and the
+batch with ``P("data")``.  XLA's SPMD partitioner then turns the
+dispatch/combine einsums (``tec,td->ecd`` / ``tec,ecd->td``) into
+all-to-all + local expert matmuls -- the same comm pattern hand-written EP
+implementations build with ``lax.all_to_all``, derived automatically.
+"""
+
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
+#: expert-stacked leaves: leading dim sharded over the expert axis.
+MOE_EP_RULES = [
+    (r"moe'\]\['w1", ("expert", None, None)),
+    (r"moe'\]\['w2", ("expert", None, None)),
+    (r"moe'\]\['b1", ("expert", None)),
+    (r"moe'\]\['b2", ("expert", None)),
+]
+
+
+def ep_sharding_for_params(params, mesh, rules=MOE_EP_RULES):
+    leaves, treedef = tree_flatten_with_path(params)
+    out = []
+    for path, leaf in leaves:
+        name = keystr(path)
+        spec = P()
+        for pattern, dims in rules:
+            if re.search(pattern, name):
+                if len(dims) == getattr(leaf, "ndim", 0):
+                    spec = P(*dims)
+                break
+        out.append(NamedSharding(mesh, spec))
+    return tree_unflatten(treedef, out)
+
+
+def ep_shard_params(params, mesh, rules=MOE_EP_RULES):
+    return jax.tree.map(jax.device_put, params,
+                        ep_sharding_for_params(params, mesh, rules))
+
+
+def make_ep_train_step(model, criterion, optim_method, mesh,
+                       data_axis: Optional[str] = "data",
+                       aux_weight: float = 0.01, rules=MOE_EP_RULES):
+    """-> compile_for(params) -> jitted step with expert-parallel params.
+
+    Task loss + ``aux_weight``  x  router load-balance loss; expert params
+    (and their optimizer moments) updated where their shard lives.
+    """
+
+    def step(params, opt_state, x, y, rng):
+        def loss_fn(p):
+            logits, st = model.apply(p, (), x, training=True, rng=rng)
+            task = criterion.apply(logits.astype(jnp.float32), y)
+            return task + aux_weight * st["aux_loss"], task
+
+        (loss, task), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        new_params, new_opt = optim_method.update(grads, opt_state, params)
+        return new_params, new_opt, task
+
+    def compile_for(params):
+        ps = ep_sharding_for_params(params, mesh, rules)
+        batch_sh = NamedSharding(mesh, P(data_axis))
+        return jax.jit(
+            step,
+            in_shardings=(ps, None, batch_sh, batch_sh,
+                          NamedSharding(mesh, P())),
+            out_shardings=(ps, None, NamedSharding(mesh, P())),
+            donate_argnums=(0, 1),
+        )
+
+    return compile_for
+
+
+def init_ep_opt_state(optim_method, params, mesh, rules=MOE_EP_RULES):
+    """Optimizer moments sharded like their params; scalars replicated."""
+    ps = ep_sharding_for_params(params, mesh, rules)
+    state = optim_method.init_state(params)
+    rep = NamedSharding(mesh, P())
+    out = {}
+    for key, val in state.items():
+        try:
+            out[key] = jax.tree.map(jax.device_put, val, ps)
+        except ValueError:
+            out[key] = jax.tree.map(lambda a: jax.device_put(a, rep), val)
+    return out

@@ -1,0 +1,203 @@
+"""Pipeline parallelism (GPipe schedule) over a ``pipe`` mesh axis.
+
+No reference analogue (SURVEY.md section 2.4: pipeline parallelism absent) --
+built the canonical TPU way: transformer blocks are split into ``n_stages``
+contiguous stages whose parameters are *stacked* on a leading stage dimension
+and sharded over the ``pipe`` mesh axis.  Inside ``shard_map`` every device
+runs its own stage; activations move stage->stage with a single
+``lax.ppermute`` hop per schedule tick (nearest-neighbour on the ICI ring,
+the cheapest collective there is).  The schedule is the classic GPipe loop:
+``n_micro + n_stages - 1`` ticks, each device computing every tick (bubble
+ticks compute garbage that is masked out), microbatch *t* entering stage 0 at
+tick *t* and leaving the last stage at tick ``t + n_stages - 1``.
+
+Autodiff runs straight through the schedule: the transpose of ``ppermute`` is
+the reverse-ring ``ppermute``, so ``jax.grad`` of the shard_map'd loss *is*
+the 1F1B-ish backward pipeline -- no hand-written backward schedule.
+
+Embedding and the LM head are computed replicated (they are cheap relative
+to the blocks); only the block stack is pipelined.  Composes with data
+parallelism via a 2-D ``(data, pipe)`` mesh: the batch is sharded over
+``data`` and shard_map's transpose machinery inserts the gradient psums.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.nn.module import child_rng
+
+
+def stack_stage_params(model, n_stages: int):
+    """Split a built TransformerLM's blocks into ``n_stages`` stacked stages.
+
+    -> dict with
+       ``embed``:  {wte, wpe}                       (replicated)
+       ``stages``: {layer{j}: block-params-stacked} (leading dim = stage)
+       ``tail``:   {ln_f, head}                     (replicated)
+    """
+    params = model._params
+    n_layers = len(model.blocks)
+    assert n_layers % n_stages == 0, (n_layers, n_stages)
+    lps = n_layers // n_stages
+    stages = {}
+    for j in range(lps):
+        per_stage = [params[f"block{s * lps + j}"] for s in range(n_stages)]
+        stages[f"layer{j}"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *per_stage)
+    return {
+        "embed": {"wte": params["wte"], "wpe": params["wpe"]},
+        "stages": stages,
+        "tail": {"ln_f": params["ln_f"], "head": params["head"]},
+    }
+
+
+def unstack_stage_params(model, pp_params):
+    """Inverse of stack_stage_params -> plain TransformerLM params dict."""
+    out = {"wte": pp_params["embed"]["wte"], "wpe": pp_params["embed"]["wpe"],
+           "ln_f": pp_params["tail"]["ln_f"],
+           "head": pp_params["tail"]["head"]}
+    stages = pp_params["stages"]
+    lps = len(stages)
+    n_stages = jax.tree.leaves(stages["layer0"])[0].shape[0]
+    for s in range(n_stages):
+        for j in range(lps):
+            out[f"block{s * lps + j}"] = jax.tree.map(
+                lambda a: a[s], stages[f"layer{j}"])
+    return out
+
+
+def pp_shardings(pp_params, mesh, pipe_axis="pipe"):
+    """NamedShardings: stage-stacked leaves sharded on dim 0, rest replicated."""
+    rep = NamedSharding(mesh, P())
+    staged = NamedSharding(mesh, P(pipe_axis))
+    return {
+        "embed": jax.tree.map(lambda _: rep, pp_params["embed"]),
+        "stages": jax.tree.map(lambda _: staged, pp_params["stages"]),
+        "tail": jax.tree.map(lambda _: rep, pp_params["tail"]),
+    }
+
+
+def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
+                    pipe_axis: str = "pipe",
+                    data_axis: Optional[str] = None):
+    """-> loss(pp_params, x_tokens, y_tokens) with the GPipe schedule inside.
+
+    ``x``/``y``: int32 (batch, T); batch must divide n_microbatches (times
+    the data-axis size when present).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    lps = len(model.blocks) // n_stages
+
+    def stage_fn(stage_params, x, rng):
+        for j in range(lps):
+            x, _ = model.blocks[0].apply(
+                stage_params[f"layer{j}"], (), x, training=True,
+                rng=child_rng(rng, j))
+        return x
+
+    def per_device(pp_params, x, y, rng):
+        # x, y: (n_micro, mb_local, T) on this device
+        stage = lax.axis_index(pipe_axis)
+        sp = jax.tree.map(lambda a: a[0], pp_params["stages"])
+        emb = pp_params["embed"]
+        n_micro, mb, t = x.shape
+
+        def embed(tok):
+            h = jnp.take(emb["wte"], tok, axis=0)
+            return h + emb["wpe"][:t][None]
+
+        d = emb["wte"].shape[1]
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, tk):
+            recv, outs = carry
+            mb_idx = jnp.clip(tk, 0, n_micro - 1)
+            inp = jnp.where(stage == 0, embed(x[mb_idx]), recv)
+            out = stage_fn(sp, inp, child_rng(rng, 7))
+            out_idx = tk - (n_stages - 1)
+            valid = (stage == n_stages - 1) & (out_idx >= 0)
+            widx = jnp.clip(out_idx, 0, n_micro - 1)
+            outs = outs.at[widx].set(jnp.where(valid, out, outs[widx]))
+            send = lax.ppermute(out, pipe_axis, fwd_perm)
+            return (send, outs), None
+
+        init = (jnp.zeros((mb, t, d), jnp.float32),
+                jnp.zeros((n_micro, mb, t, d), jnp.float32))
+        (_, outs), _ = lax.scan(tick, init,
+                                jnp.arange(n_micro + n_stages - 1))
+        # replicated tail on the collected last-stage activations
+        h = outs.reshape(n_micro * mb, t, d)
+        h, _ = model.ln_f.apply(pp_params["tail"]["ln_f"], (), h)
+        logits = h @ pp_params["tail"]["head"].astype(h.dtype).T
+        loss_local = criterion.apply(logits.astype(jnp.float32),
+                                     y.reshape(n_micro * mb, t))
+        loss = lax.psum(
+            jnp.where(stage == n_stages - 1, loss_local, 0.0), pipe_axis)
+        if data_axis is not None:
+            loss = lax.pmean(loss, data_axis)
+        return loss
+
+    batch_spec = P(None, data_axis) if data_axis else P()
+    smapped = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=({"embed": P(), "stages": P(pipe_axis), "tail": P()},
+                  batch_spec, batch_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss_fn(pp_params, x, y, rng=None):
+        n, t = x.shape
+        assert n % n_microbatches == 0, (n, n_microbatches)
+        if data_axis is not None:
+            mb = n // n_microbatches
+            assert mb % mesh.shape[data_axis] == 0, (
+                f"microbatch size {mb} must divide over the "
+                f"'{data_axis}' axis ({mesh.shape[data_axis]} devices)")
+        xm = x.reshape(n_microbatches, n // n_microbatches, t)
+        ym = y.reshape(n_microbatches, n // n_microbatches, t)
+        if rng is None:
+            rng = jax.random.key(0)
+        return smapped(pp_params, xm, ym, rng)
+
+    return loss_fn
+
+
+def make_pp_train_step(model, criterion, optim_method, mesh,
+                       n_microbatches: int, pipe_axis: str = "pipe",
+                       data_axis: Optional[str] = None):
+    """-> jitted step(pp_params, opt_state, x, y, rng) -> (params', opt', loss).
+
+    Stage-stacked params (and their optimizer moments) live sharded over the
+    ``pipe`` axis; the update runs where the shard lives (optimizer-state
+    parallelism, the pipeline analogue of the reference's chunk ownership in
+    parameters/AllReduceParameter.scala:84).
+    """
+    loss_fn = make_pp_loss_fn(model, criterion, mesh, n_microbatches,
+                              pipe_axis, data_axis)
+
+    def step(pp_params, opt_state, x, y, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(pp_params, x, y, rng)
+        new_params, new_opt = optim_method.update(grads, opt_state, pp_params)
+        return new_params, new_opt, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def init_pp_opt_state(optim_method, pp_params, mesh, pipe_axis="pipe"):
+    """Optimizer state device_put with the same shardings as its params."""
+    ps = pp_shardings(pp_params, mesh, pipe_axis)
+    state = optim_method.init_state(pp_params)
+    rep = NamedSharding(mesh, P())
+    out = {}
+    for key, val in state.items():
+        try:
+            out[key] = jax.tree.map(jax.device_put, val, ps)
+        except ValueError:
+            out[key] = jax.tree.map(
+                lambda a: jax.device_put(a, rep), val)
+    return out
