@@ -40,10 +40,7 @@ class DLModel:
 class DLClassifierModel(DLModel):
     def transform(self, X) -> np.ndarray:
         """-> class indices (reference: DLClassifierModel argmax semantics)."""
-        X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
-        samples = [Sample(x) for x in X]
-        return np.asarray(
-            self.model.predict_class(samples, self.batch_size))
+        return np.argmax(super().transform(X), axis=-1)
 
 
 class DLEstimator:
@@ -85,9 +82,9 @@ class DLEstimator:
 
     def fit(self, X, y) -> DLModel:
         X = np.asarray(X, np.float32)
-        if not self.feature_size:
-            self.feature_size = X.shape[1:]
-        X = X.reshape((-1,) + self.feature_size)
+        # infer locally -- a later fit() with a new shape must re-infer
+        feature_size = self.feature_size or X.shape[1:]
+        X = X.reshape((-1,) + feature_size)
         y = self._prepare_labels(y)
         if self.label_size:
             y = y.reshape((-1,) + self.label_size)
@@ -97,7 +94,7 @@ class DLEstimator:
                              self.optim_method)
         opt.set_end_when(Trigger.max_epoch(self.max_epoch))
         opt.optimize()
-        return self.model_cls(self.model, self.feature_size, self.batch_size)
+        return self.model_cls(self.model, feature_size, self.batch_size)
 
 
 class DLClassifier(DLEstimator):

@@ -38,6 +38,11 @@ from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, GRU, MultiRNNCell, Recurrent, BiRecurrent,
     RecurrentDecoder, TimeDistributed,
 )
+from bigdl_tpu.nn.detection import (
+    PriorBox, Anchor, Proposal, Nms, NormalizeScale,
+    DetectionOutputSSD, DetectionOutputFrcnn,
+    bbox_transform_inv, clip_boxes, decode_boxes, nms,
+)
 from bigdl_tpu.nn.criterion import (
     ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
     BCECriterion, BCEWithLogitsCriterion, SmoothL1Criterion,

@@ -1,0 +1,554 @@
+"""Object-detection heads: SSD / Faster-RCNN post-processing, TPU-native.
+
+Reference surface (all under spark/dl/src/main/scala/com/intel/analytics/bigdl/):
+  nn/PriorBox.scala:43          -- multibox prior generation
+  nn/Anchor.scala:25            -- RPN anchor grid
+  nn/Nms.scala:26               -- greedy non-maximum suppression
+  nn/Proposal.scala:34          -- RPN proposal layer
+  nn/NormalizeScale.scala:37    -- L2-normalise + learned per-channel scale
+  nn/DetectionOutputSSD.scala:48   -- SSD decode + per-class NMS
+  nn/DetectionOutputFrcnn.scala:48 -- Faster-RCNN post-process
+  transform/vision/image/util/BboxUtil.scala -- box decode/clip helpers
+
+TPU-native redesign: the reference runs scalar while-loops over boxes; here
+every box op is vectorised. NMS is the one sequential algorithm -- it is
+expressed as a `lax.fori_loop` over a precomputed pairwise-IoU matrix
+(static shapes, mask semantics), so the whole detection head can live under
+`jit` on device; ragged final assembly (variable #detections per image)
+happens host-side, as in the reference (which runs this on CPU threads).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+# --------------------------------------------------------------------------- #
+# Box utilities (reference: BboxUtil.scala)
+# --------------------------------------------------------------------------- #
+
+def bbox_transform_inv(boxes, deltas):
+    """Apply (dx, dy, dw, dh) deltas to corner boxes.
+
+    boxes: (N, 4) [x1, y1, x2, y2]; deltas: (N, 4a).
+    Reference: BboxUtil.bboxTransformInv (BboxUtil.scala:53) -- widths use
+    the pixel +1 convention.
+    """
+    boxes = jnp.asarray(boxes, jnp.float32)
+    deltas = jnp.asarray(deltas, jnp.float32)
+    n, cols = deltas.shape
+    d = deltas.reshape(n, cols // 4, 4)
+    x1, y1 = boxes[:, 0:1], boxes[:, 1:2]
+    w = boxes[:, 2:3] - x1 + 1.0
+    h = boxes[:, 3:4] - y1 + 1.0
+    ctr_x = d[..., 0] * w + x1 + w / 2
+    ctr_y = d[..., 1] * h + y1 + h / 2
+    half_w = jnp.exp(d[..., 2]) * w / 2
+    half_h = jnp.exp(d[..., 3]) * h / 2
+    out = jnp.stack(
+        [ctr_x - half_w, ctr_y - half_h, ctr_x + half_w, ctr_y + half_h], axis=-1
+    )
+    return out.reshape(n, cols)
+
+
+def clip_boxes(boxes, height, width, min_h=0.0, min_w=0.0, scores=None):
+    """Clip boxes to [0, width-1] x [0, height-1]; optionally zero the score
+    of boxes smaller than (min_h, min_w).
+
+    Reference: BboxUtil.clipBoxes (BboxUtil.scala:108).
+    Returns (boxes, scores) -- scores unchanged if None.
+    """
+    n, cols = boxes.shape
+    b = boxes.reshape(n, cols // 4, 4)
+    x1 = jnp.clip(b[..., 0], 0.0, width - 1.0)
+    y1 = jnp.clip(b[..., 1], 0.0, height - 1.0)
+    x2 = jnp.clip(b[..., 2], 0.0, width - 1.0)
+    y2 = jnp.clip(b[..., 3], 0.0, height - 1.0)
+    out = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, cols)
+    if scores is not None:
+        keep = jnp.all(
+            (x2 - x1 + 1 >= min_w) & (y2 - y1 + 1 >= min_h), axis=-1
+        )
+        scores = jnp.where(keep, scores, 0.0)
+    return out, scores
+
+
+def decode_boxes(prior_boxes, prior_variances, bboxes,
+                 variance_encoded_in_target=False, clip=False):
+    """SSD box decode: priors (P,4) + variances (P,4) + loc preds (P,4) -> (P,4).
+
+    Reference: BboxUtil.decodeBoxes / decodeSingleBbox (BboxUtil.scala:283,303).
+    """
+    p = jnp.asarray(prior_boxes, jnp.float32)
+    v = jnp.asarray(prior_variances, jnp.float32)
+    b = jnp.asarray(bboxes, jnp.float32)
+    pw = p[:, 2] - p[:, 0]
+    ph = p[:, 3] - p[:, 1]
+    pcx = (p[:, 0] + p[:, 2]) / 2
+    pcy = (p[:, 1] + p[:, 3]) / 2
+    if variance_encoded_in_target:
+        cx = b[:, 0] * pw + pcx
+        cy = b[:, 1] * ph + pcy
+        w = jnp.exp(b[:, 2]) * pw
+        h = jnp.exp(b[:, 3]) * ph
+    else:
+        cx = v[:, 0] * b[:, 0] * pw + pcx
+        cy = v[:, 1] * b[:, 1] * ph + pcy
+        w = jnp.exp(v[:, 2] * b[:, 2]) * pw
+        h = jnp.exp(v[:, 3] * b[:, 3]) * ph
+    out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _pairwise_iou(boxes, normalized):
+    """(N, 4) -> (N, N) IoU matrix. normalized=True uses [0,1]-range box
+    areas (no +1), matching Nms.getAreas (Nms.scala:186)."""
+    off = 0.0 if normalized else 1.0
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = (x2 - x1 + off) * (y2 - y1 + off)
+    iw = jnp.minimum(x2[:, None], x2[None, :]) - jnp.maximum(x1[:, None], x1[None, :]) + off
+    ih = jnp.minimum(y2[:, None], y2[None, :]) - jnp.maximum(y1[:, None], y1[None, :]) + off
+    inter = jnp.maximum(iw, 0.0) * jnp.maximum(ih, 0.0)
+    union = areas[:, None] + areas[None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+def nms(boxes, scores, iou_threshold, score_threshold=None, topk=-1,
+        normalized=False, sorted_input=False):
+    """Greedy NMS, XLA-native: static shapes, returns (order, keep_mask).
+
+    `order` is the score-descending candidate order and `keep_mask[i]` says
+    whether candidate `order[i]` survives. Greedy suppression (a box is
+    dropped if it overlaps an already-kept higher-scoring box above
+    `iou_threshold`) is a `lax.fori_loop` over a precomputed pairwise-IoU
+    matrix, so it jits and runs on device -- the TPU answer to the scalar
+    suppression loop in Nms.scala:95-110.
+    """
+    boxes = jnp.asarray(boxes, jnp.float32)
+    scores = jnp.asarray(scores, jnp.float32)
+    n = scores.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), jnp.int32), jnp.zeros((0,), bool)
+    if sorted_input:
+        order = jnp.arange(n, dtype=jnp.int32)
+        sboxes, sscores = boxes, scores
+    else:
+        order = jnp.argsort(-scores).astype(jnp.int32)
+        sboxes, sscores = boxes[order], scores[order]
+    # candidates beyond the topk prefix can neither be kept nor suppress
+    # anything, so drop them BEFORE the O(n^2) IoU matrix (static shapes)
+    if topk is not None and topk > 0 and topk < n:
+        n = topk
+        order, sboxes, sscores = order[:n], sboxes[:n], sscores[:n]
+    valid = jnp.ones((n,), bool)
+    if score_threshold is not None:
+        valid &= sscores >= score_threshold
+    ious = _pairwise_iou(sboxes, normalized)
+    idx = jnp.arange(n)
+
+    def body(i, keep):
+        suppressed = jnp.any(keep & (ious[:, i] > iou_threshold) & (idx < i))
+        return keep.at[i].set(valid[i] & ~suppressed)
+
+    keep = lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+    return order, keep
+
+
+class Nms:
+    """Object-style facade over :func:`nms` (reference: nn/Nms.scala:26)."""
+
+    def nms(self, scores, boxes, thresh, sorted=False):
+        """-> numpy array of kept indices (0-based), score-descending."""
+        order, keep = nms(boxes, scores, thresh, sorted_input=sorted)
+        order, keep = np.asarray(order), np.asarray(keep)
+        return order[keep]
+
+    def nms_fast(self, scores, boxes, nms_thresh, score_thresh, topk=-1,
+                 normalized=True):
+        """Reference: Nms.nmsFast (Nms.scala:131) with eta=1."""
+        order, keep = nms(
+            boxes, scores, nms_thresh, score_threshold=score_thresh,
+            topk=topk, normalized=normalized,
+        )
+        order, keep = np.asarray(order), np.asarray(keep)
+        return order[keep]
+
+
+# --------------------------------------------------------------------------- #
+# PriorBox (reference: nn/PriorBox.scala:43)
+# --------------------------------------------------------------------------- #
+
+class PriorBox(Module):
+    """Generate multibox priors over a feature map.
+
+    Output (1, 2, H*W*num_priors*4): channel 0 = prior corner coords
+    normalised by image size, channel 1 = variances -- the exact layout of
+    PriorBox.updateOutput (PriorBox.scala:125-144). Priors are computed with
+    one broadcasted expression instead of the reference's scalar fill loop.
+    """
+
+    def __init__(self, min_sizes, max_sizes=None, aspect_ratios=None,
+                 is_flip=True, is_clip=False, variances=None, offset=0.5,
+                 img_h=0, img_w=0, img_size=0, step_h=0.0, step_w=0.0,
+                 step=0.0, name=None):
+        super().__init__(name)
+        self.min_sizes = list(min_sizes)
+        self.max_sizes = list(max_sizes) if max_sizes else []
+        if self.max_sizes:
+            assert len(self.max_sizes) == len(self.min_sizes)
+        # dedup'd ratio list starting at 1, optionally flipped
+        # (PriorBox.init, PriorBox.scala:55-72)
+        ars = [1.0]
+        for ar in (aspect_ratios or []):
+            if not any(abs(ar - a) < 1e-6 for a in ars):
+                ars.append(float(ar))
+                if is_flip:
+                    ars.append(1.0 / float(ar))
+        self.aspect_ratios = ars
+        self.num_priors = len(ars) * len(self.min_sizes) + len(self.max_sizes)
+        self.is_clip = is_clip
+        self.variances = list(variances) if variances is not None else [0.1]
+        if len(self.variances) > 1:
+            assert len(self.variances) == 4, "must provide exactly 4 variances"
+        self.offset = offset
+        self.img_h = img_h or img_size
+        self.img_w = img_w or img_size
+        self.step_h = step_h or step
+        self.step_w = step_w or step
+
+    def _cell_templates(self):
+        """Per-cell (half_w, half_h) templates in reference prior order:
+        for each min_size: unit box, [sqrt(min*max) box], then each ar != 1."""
+        half = []
+        for s, mn in enumerate(self.min_sizes):
+            mn_i = float(int(mn))
+            half.append((mn_i / 2, mn_i / 2))
+            if self.max_sizes:
+                mx = float(int(self.max_sizes[s]))
+                hw = float(np.sqrt(mn_i * mx) / 2)
+                half.append((hw, hw))
+            for ar in self.aspect_ratios:
+                if abs(ar - 1.0) >= 1e-6:
+                    v = float(np.sqrt(ar))
+                    half.append((mn_i * v / 2, mn_i / v / 2))
+        return np.asarray(half, np.float32)  # (P, 2)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        feat = input[0] if isinstance(input, (tuple, list)) else input
+        layer_h, layer_w = feat.shape[2], feat.shape[3]
+        assert self.img_w > 0 and self.img_h > 0, "imgW and imgH must > 0"
+        step_w = self.step_w or self.img_w / float(layer_w)
+        step_h = self.step_h or self.img_h / float(layer_h)
+
+        half = self._cell_templates()                       # (P, 2)
+        cx = (np.arange(layer_w, dtype=np.float32) + self.offset) * step_w
+        cy = (np.arange(layer_h, dtype=np.float32) + self.offset) * step_h
+        # (H, W, P, 4) ordered (h, w, prior) like the reference fill loop
+        cx = cx[None, :, None]
+        cy = cy[:, None, None]
+        hw = half[None, None, :, 0]
+        hh = half[None, None, :, 1]
+        boxes = np.stack(
+            [
+                np.broadcast_to((cx - hw) / self.img_w, (layer_h, layer_w, hw.shape[-1])),
+                np.broadcast_to((cy - hh) / self.img_h, (layer_h, layer_w, hw.shape[-1])),
+                np.broadcast_to((cx + hw) / self.img_w, (layer_h, layer_w, hw.shape[-1])),
+                np.broadcast_to((cy + hh) / self.img_h, (layer_h, layer_w, hw.shape[-1])),
+            ],
+            axis=-1,
+        )
+        dim = layer_h * layer_w * self.num_priors * 4
+        flat = boxes.reshape(dim)
+        if self.is_clip:
+            flat = np.clip(flat, 0.0, 1.0)
+        if len(self.variances) == 1:
+            var = np.full((dim,), self.variances[0], np.float32)
+        else:
+            var = np.tile(np.asarray(self.variances, np.float32), dim // 4)
+        out = jnp.asarray(np.stack([flat, var])[None, :, :])
+        return out, state
+
+
+# --------------------------------------------------------------------------- #
+# Anchor (reference: nn/Anchor.scala:25)
+# --------------------------------------------------------------------------- #
+
+class Anchor:
+    """Regular grid of multi-scale / multi-aspect anchors for RPN."""
+
+    def __init__(self, ratios, scales, base_size=16.0):
+        self.ratios = np.asarray(ratios, np.float32)
+        self.scales = np.asarray(scales, np.float32)
+        self.anchor_num = len(ratios) * len(scales)
+        self.basic_anchors = self._generate_basic(base_size)  # (A, 4)
+
+    def _generate_basic(self, base_size):
+        # ratio enumeration around the (0, 0, base-1, base-1) window with the
+        # reference's round-to-int semantics (Anchor.ratioEnum, Anchor.scala:195)
+        w = h = base_size
+        x_ctr = y_ctr = (base_size - 1) / 2
+        area = w * h
+        ws = np.round(np.sqrt(area / self.ratios))
+        hs = np.round(ws * self.ratios)
+        ratio_anchors = self._mk_anchors(ws, hs, x_ctr, y_ctr)
+        out = []
+        for ra in ratio_anchors:
+            aw = ra[2] - ra[0] + 1
+            ah = ra[3] - ra[1] + 1
+            acx = ra[0] + 0.5 * (aw - 1)
+            acy = ra[1] + 0.5 * (ah - 1)
+            out.append(self._mk_anchors(self.scales * aw, self.scales * ah, acx, acy))
+        return np.concatenate(out, axis=0).astype(np.float32)
+
+    @staticmethod
+    def _mk_anchors(ws, hs, x_ctr, y_ctr):
+        w = ws / 2 - 0.5
+        h = hs / 2 - 0.5
+        return np.stack([x_ctr - w, y_ctr - h, x_ctr + w, y_ctr + h], axis=-1)
+
+    def generate_anchors(self, width, height, feat_stride=16.0):
+        """All anchors over a (height, width) feature map, ordered
+        (y, x, anchor) like Anchor.getAllAnchors (Anchor.scala:76-115)."""
+        shift_x = np.arange(width, dtype=np.float32) * feat_stride
+        shift_y = np.arange(height, dtype=np.float32) * feat_stride
+        shifts = np.stack(
+            np.broadcast_arrays(
+                shift_x[None, :, None], shift_y[:, None, None],
+                shift_x[None, :, None], shift_y[:, None, None],
+            ),
+            axis=-1,
+        )  # (H, W, 1, 4)
+        all_anchors = shifts + self.basic_anchors[None, None, :, :]
+        return all_anchors.reshape(-1, 4)
+
+
+# --------------------------------------------------------------------------- #
+# Proposal (reference: nn/Proposal.scala:34)
+# --------------------------------------------------------------------------- #
+
+class Proposal(Module):
+    """RPN proposal layer: anchors + deltas -> scored, NMS'd RoIs.
+
+    Input table: (cls scores (1, 2A, H, W), bbox deltas (1, 4A, H, W),
+    im_info (1, 4) = [height, width, scale_h, scale_w]).
+    Output (K, 5): rows [batch_idx=0, x1, y1, x2, y2].
+    Forward-only (updateGradInput returns null in the reference).
+    """
+
+    def __init__(self, pre_nms_topn, post_nms_topn, ratios, scales,
+                 rpn_pre_nms_topn_train=12000, rpn_post_nms_topn_train=2000,
+                 min_size=16.0, name=None):
+        super().__init__(name)
+        self.pre_nms_topn = pre_nms_topn
+        self.post_nms_topn = post_nms_topn
+        self.rpn_pre_nms_topn_train = rpn_pre_nms_topn_train
+        self.rpn_post_nms_topn_train = rpn_post_nms_topn_train
+        self.anchor = Anchor(ratios, scales)
+        self.min_size = min_size
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        scores_in, deltas_in, im_info = input
+        assert scores_in.shape[0] == 1, "currently only support single batch"
+        a = self.anchor.anchor_num
+        h, w = scores_in.shape[2], scores_in.shape[3]
+        # (1, 4A, H, W) -> (H*W*A, 4), row order (h, w, a)
+        # (Proposal.transposeAndReshape, Proposal.scala:155)
+        deltas = jnp.transpose(
+            jnp.reshape(deltas_in[0], (a, 4, h, w)), (2, 3, 0, 1)
+        ).reshape(-1, 4)
+        # foreground scores = channels [A, 2A)
+        scores = jnp.transpose(scores_in[0, a:], (1, 2, 0)).reshape(-1)
+
+        anchors = self.anchor.generate_anchors(w, h)
+        proposals = bbox_transform_inv(anchors, deltas)
+        min_box_h = self.min_size * im_info[0, 2]
+        min_box_w = self.min_size * im_info[0, 3]
+        proposals, scores = clip_boxes(
+            proposals, im_info[0, 0], im_info[0, 1], min_box_h, min_box_w, scores
+        )
+        pre_topn = self.rpn_pre_nms_topn_train if training else self.pre_nms_topn
+        post_topn = self.rpn_post_nms_topn_train if training else self.post_nms_topn
+
+        order, keep = nms(proposals, scores, 0.7, topk=pre_topn)
+        order, keep = np.asarray(order), np.asarray(keep)
+        kept = order[keep]
+        if post_topn > 0:
+            kept = kept[:post_topn]
+        boxes = np.asarray(proposals)[kept]
+        out = jnp.asarray(
+            np.concatenate([np.zeros((boxes.shape[0], 1), np.float32), boxes], axis=1)
+        )
+        return out, state
+
+
+# --------------------------------------------------------------------------- #
+# NormalizeScale (reference: nn/NormalizeScale.scala:37)
+# --------------------------------------------------------------------------- #
+
+class NormalizeScale(Module):
+    """L_p-normalise across the channel dim then multiply a learned
+    per-channel scale (caffe Normalize; used for SSD conv4_3)."""
+
+    def __init__(self, p=2.0, eps=1e-10, scale=20.0, size=None, name=None):
+        super().__init__(name)
+        self.p = p
+        self.eps = eps
+        self.init_scale = scale
+        self.size = tuple(size) if size is not None else None
+
+    def setup(self, rng, input_spec):
+        size = self.size
+        if size is None:
+            size = (1, input_spec.shape[1], 1, 1)
+        w = jnp.full(size, self.init_scale, jnp.float32)
+        return {"weight": w}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        if self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True)) + self.eps
+        else:
+            norm = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(x), self.p), axis=1, keepdims=True),
+                1.0 / self.p,
+            ) + self.eps
+        return (x / norm) * params["weight"], state
+
+
+# --------------------------------------------------------------------------- #
+# DetectionOutputSSD (reference: nn/DetectionOutputSSD.scala:48)
+# --------------------------------------------------------------------------- #
+
+class DetectionOutputSSD(Module):
+    """SSD post-processing: decode loc preds against priors, per-class NMS,
+    global keep-topk.
+
+    Input table: (loc (B, P*4), conf (B, P*nClasses) logits, prior (1, 2, P*4)).
+    Output (B, 1 + maxDet*6): per image [nDet, (label, score, x1, y1, x2, y2)*].
+    In training mode passes input through, like the reference.
+    """
+
+    def __init__(self, n_classes=21, share_location=True, bg_label=0,
+                 nms_thresh=0.45, nms_topk=400, keep_topk=200,
+                 conf_thresh=0.01, variance_encoded_in_target=False,
+                 conf_post_process=True, name=None):
+        super().__init__(name)
+        assert share_location, "only shareLocation=true is used by the zoo"
+        self.n_classes = n_classes
+        self.bg_label = bg_label
+        self.nms_thresh = nms_thresh
+        self.nms_topk = nms_topk
+        self.keep_topk = keep_topk
+        self.conf_thresh = conf_thresh
+        self.variance_encoded_in_target = variance_encoded_in_target
+        self.conf_post_process = conf_post_process
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if training:
+            return input, state
+        loc, conf, prior = input
+        batch = loc.shape[0]
+        n_priors = prior.shape[2] // 4
+        if self.conf_post_process:
+            conf = jax.nn.softmax(
+                conf.reshape(batch, n_priors, self.n_classes), axis=-1
+            )
+        else:
+            conf = conf.reshape(batch, n_priors, self.n_classes)
+        prior_boxes = prior[0, 0].reshape(n_priors, 4)
+        prior_var = prior[0, 1].reshape(n_priors, 4)
+        loc = loc.reshape(batch, n_priors, 4)
+
+        # vectorised decode for the whole batch (device), then per-class NMS
+        decoded = jax.vmap(
+            lambda l: decode_boxes(
+                prior_boxes, prior_var, l,
+                variance_encoded_in_target=self.variance_encoded_in_target,
+            )
+        )(loc)
+        decoded_np = np.asarray(decoded)
+        conf_np = np.asarray(conf)
+
+        results = []  # per image: list of (label, score, box) already NMS'd
+        for b in range(batch):
+            dets = []
+            for c in range(self.n_classes):
+                if c == self.bg_label:
+                    continue
+                scores_c = conf_np[b, :, c]
+                kept = Nms().nms_fast(
+                    scores_c, decoded_np[b], self.nms_thresh,
+                    self.conf_thresh, topk=self.nms_topk, normalized=True,
+                )
+                for i in kept:
+                    dets.append((c, scores_c[i], decoded_np[b, i]))
+            if self.keep_topk > -1 and len(dets) > self.keep_topk:
+                dets.sort(key=lambda t: -t[1])
+                dets = dets[: self.keep_topk]
+                # reference regroups by class after topk (stable class order)
+                dets.sort(key=lambda t: t[0])
+            results.append(dets)
+
+        max_det = max((len(d) for d in results), default=0)
+        out = np.zeros((batch, 1 + max_det * 6), np.float32)
+        for b, dets in enumerate(results):
+            out[b, 0] = len(dets)
+            off = 1
+            for (c, s, box) in dets:
+                out[b, off:off + 6] = [c, s, box[0], box[1], box[2], box[3]]
+                off += 6
+        return jnp.asarray(out), state
+
+
+class DetectionOutputFrcnn(Module):
+    """Faster-RCNN post-processing (reference: nn/DetectionOutputFrcnn.scala:48).
+
+    Input table: (cls scores (N, nClasses) softmax'd, bbox preds (N, 4*nClasses),
+    rois (N, 5) [batch, x1, y1, x2, y2], im_info (1, 4)).
+    Output (1, 1 + nDet*6) in the same layout as DetectionOutputSSD.
+    """
+
+    def __init__(self, nms_thresh=0.3, n_classes=21, bbox_vote=False,
+                 max_per_image=100, thresh=0.05, name=None):
+        super().__init__(name)
+        assert not bbox_vote, "bboxVote not supported in the TPU build yet"
+        self.nms_thresh = nms_thresh
+        self.n_classes = n_classes
+        self.max_per_image = max_per_image
+        self.thresh = thresh
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        scores, box_deltas, rois, im_info = input
+        boxes = rois[:, 1:5]
+        pred = bbox_transform_inv(boxes, box_deltas)
+        pred, _ = clip_boxes(pred, im_info[0, 0], im_info[0, 1])
+        scores_np = np.asarray(scores)
+        pred_np = np.asarray(pred).reshape(scores_np.shape[0], -1, 4)
+
+        dets = []
+        for c in range(1, self.n_classes):  # skip background class 0
+            sc = scores_np[:, c]
+            inds = np.where(sc > self.thresh)[0]
+            if inds.size == 0:
+                continue
+            kept = Nms().nms(sc[inds], pred_np[inds, c], self.nms_thresh)
+            for i in kept:
+                dets.append((c, sc[inds[i]], pred_np[inds[i], c]))
+        if self.max_per_image > 0 and len(dets) > self.max_per_image:
+            dets.sort(key=lambda t: -t[1])
+            dets = dets[: self.max_per_image]
+            dets.sort(key=lambda t: t[0])
+
+        out = np.zeros((1, 1 + len(dets) * 6), np.float32)
+        out[0, 0] = len(dets)
+        off = 1
+        for (c, s, box) in dets:
+            out[0, off:off + 6] = [c, s, box[0], box[1], box[2], box[3]]
+            off += 6
+        return jnp.asarray(out), state

@@ -57,9 +57,11 @@ class MoE(Module):
         }, ()
 
     def _capacity(self, tokens: int) -> int:
+        # k*tokens routing assignments share E expert slots
         return max(
             self.k,
-            int(math.ceil(tokens / self.num_experts * self.capacity_factor)))
+            int(math.ceil(
+                self.k * tokens / self.num_experts * self.capacity_factor)))
 
     def apply(self, params, state, input, *, training=False, rng=None):
         n, t, d = input.shape

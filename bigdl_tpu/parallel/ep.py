@@ -83,13 +83,7 @@ def make_ep_train_step(model, criterion, optim_method, mesh,
 
 def init_ep_opt_state(optim_method, params, mesh, rules=MOE_EP_RULES):
     """Optimizer moments sharded like their params; scalars replicated."""
+    from bigdl_tpu.parallel.zero import shard_opt_state
+
     ps = ep_sharding_for_params(params, mesh, rules)
-    state = optim_method.init_state(params)
-    rep = NamedSharding(mesh, P())
-    out = {}
-    for key, val in state.items():
-        try:
-            out[key] = jax.tree.map(jax.device_put, val, ps)
-        except ValueError:
-            out[key] = jax.tree.map(lambda a: jax.device_put(a, rep), val)
-    return out
+    return shard_opt_state(optim_method, params, ps, mesh)

@@ -117,7 +117,7 @@ def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
             recv, outs = carry
             mb_idx = jnp.clip(tk, 0, n_micro - 1)
             inp = jnp.where(stage == 0, embed(x[mb_idx]), recv)
-            out = stage_fn(sp, inp, child_rng(rng, 7))
+            out = stage_fn(sp, inp, child_rng(child_rng(rng, 7), tk))
             out_idx = tk - (n_stages - 1)
             valid = (stage == n_stages - 1) & (out_idx >= 0)
             widx = jnp.clip(out_idx, 0, n_micro - 1)
@@ -190,14 +190,7 @@ def make_pp_train_step(model, criterion, optim_method, mesh,
 
 def init_pp_opt_state(optim_method, pp_params, mesh, pipe_axis="pipe"):
     """Optimizer state device_put with the same shardings as its params."""
+    from bigdl_tpu.parallel.zero import shard_opt_state
+
     ps = pp_shardings(pp_params, mesh, pipe_axis)
-    state = optim_method.init_state(pp_params)
-    rep = NamedSharding(mesh, P())
-    out = {}
-    for key, val in state.items():
-        try:
-            out[key] = jax.tree.map(jax.device_put, val, ps)
-        except ValueError:
-            out[key] = jax.tree.map(
-                lambda a: jax.device_put(a, rep), val)
-    return out
+    return shard_opt_state(optim_method, pp_params, ps, mesh)

@@ -94,22 +94,7 @@ def init_opt_state_sharded(optim_method, params, mesh,
                            rules=TRANSFORMER_TP_RULES):
     """Optimizer state placed with the same shardings as its params
     (moments shard like weights; scalars replicated)."""
+    from bigdl_tpu.parallel.zero import shard_opt_state
+
     ps = sharding_for_params(params, mesh, rules)
-    state = optim_method.init_state(params)
-
-    def place(leaf):
-        if getattr(leaf, "ndim", 0) == 0:
-            return jax.device_put(leaf, NamedSharding(mesh, P()))
-        return leaf
-
-    # momentum/velocity subtrees mirror the params tree exactly; map them
-    out = {}
-    for key, val in state.items():
-        if key == "neval":
-            out[key] = jax.device_put(val, NamedSharding(mesh, P()))
-        else:
-            try:
-                out[key] = jax.tree.map(jax.device_put, val, ps)
-            except ValueError:
-                out[key] = jax.tree.map(place, val)
-    return out
+    return shard_opt_state(optim_method, params, ps, mesh)

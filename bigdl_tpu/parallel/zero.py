@@ -56,3 +56,25 @@ class FlatParamSpace:
     def chunk(self, flat: jnp.ndarray, index) -> jnp.ndarray:
         return jax.lax.dynamic_slice(
             flat, (index * self.chunk_size,), (self.chunk_size,))
+
+
+def shard_opt_state(optim_method, params, param_shardings, mesh):
+    """Optimizer state placed with the same shardings as its params.
+
+    Moment subtrees (momentum/velocity/...) mirror the params tree, so they
+    take the param shardings; anything else (step counters, scalars) is
+    replicated.  Shared by the tp/pp/ep engines -- the analogue of the
+    reference owning OptimMethod state per weight chunk
+    (optim/DistriOptimizer.scala:383).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    state = optim_method.init_state(params)
+    rep = NamedSharding(mesh, P())
+    out = {}
+    for key, val in state.items():
+        try:
+            out[key] = jax.tree.map(jax.device_put, val, param_shardings)
+        except ValueError:
+            out[key] = jax.tree.map(lambda a: jax.device_put(a, rep), val)
+    return out

@@ -1,0 +1,223 @@
+"""Detection heads: NMS, PriorBox, Anchor, Proposal, DetectionOutputSSD/Frcnn.
+
+Golden strategy (SURVEY.md section 4): NMS is checked against an
+independent scalar numpy implementation transliterated from the published
+greedy-NMS algorithm; PriorBox/Anchor against hand-computable invariants
+and small closed-form cases.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import (
+    PriorBox, Anchor, Proposal, Nms, NormalizeScale,
+    DetectionOutputSSD, DetectionOutputFrcnn,
+    bbox_transform_inv, clip_boxes, decode_boxes,
+)
+
+
+def ref_nms(boxes, scores, thresh, normalized=False):
+    """Scalar greedy NMS, independent of the jax implementation."""
+    off = 0.0 if normalized else 1.0
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = (x2 - x1 + off) * (y2 - y1 + off)
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(scores), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if suppressed[j] or j == i:
+                continue
+            iw = min(x2[i], x2[j]) - max(x1[i], x1[j]) + off
+            ih = min(y2[i], y2[j]) - max(y1[i], y1[j]) + off
+            if iw > 0 and ih > 0:
+                inter = iw * ih
+                if inter / (areas[i] + areas[j] - inter) > thresh:
+                    suppressed[j] = True
+    return keep
+
+
+def test_nms_matches_scalar_reference():
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        n = 60
+        ctr = rng.uniform(10, 90, (n, 2))
+        wh = rng.uniform(5, 30, (n, 2))
+        boxes = np.concatenate([ctr - wh / 2, ctr + wh / 2], 1).astype(np.float32)
+        scores = rng.uniform(0.1, 1, n).astype(np.float32)
+        got = list(Nms().nms(scores, boxes, 0.5))
+        assert got == ref_nms(boxes, scores, 0.5)
+
+
+def test_nms_fast_score_thresh_and_topk():
+    boxes = np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60], [80, 80, 90, 90]],
+        np.float32,
+    )
+    scores = np.array([0.9, 0.8, 0.7, 0.01], np.float32)
+    kept = Nms().nms_fast(scores, boxes, 0.5, score_thresh=0.05, normalized=True)
+    # box 1 suppressed by box 0 (high overlap), box 3 below score thresh
+    assert list(kept) == [0, 2]
+    kept = Nms().nms_fast(scores, boxes, 0.5, score_thresh=0.05, topk=1)
+    assert list(kept) == [0]
+
+
+def test_priorbox_layout_and_values():
+    # single min_size, no extra ratios: 1 prior/cell, closed form
+    pb = PriorBox(min_sizes=[40.0], img_h=100, img_w=100, variances=[0.1, 0.1, 0.2, 0.2])
+    feat = jnp.zeros((1, 8, 2, 2))  # H=W=2 -> step=50
+    out = np.asarray(pb.forward(feat))
+    assert out.shape == (1, 2, 2 * 2 * 1 * 4)
+    # cell (0,0): center (25, 25), half box 20 -> [5, 5, 45, 45] / 100
+    np.testing.assert_allclose(out[0, 0, :4], [0.05, 0.05, 0.45, 0.45], atol=1e-6)
+    # cell (0,1): center (75, 25)
+    np.testing.assert_allclose(out[0, 0, 4:8], [0.55, 0.05, 0.95, 0.45], atol=1e-6)
+    # variances tile every 4
+    np.testing.assert_allclose(out[0, 1, :8], [0.1, 0.1, 0.2, 0.2] * 2, atol=1e-6)
+
+
+def test_priorbox_num_priors():
+    pb = PriorBox(
+        min_sizes=[30.0], max_sizes=[60.0], aspect_ratios=[2.0], is_flip=True,
+        img_size=300,
+    )
+    # priors/cell = ratios{1,2,1/2} * 1 min + 1 max = 4
+    assert pb.num_priors == 4
+    out = np.asarray(pb.forward(jnp.zeros((1, 3, 3, 3))))
+    assert out.shape == (1, 2, 3 * 3 * 4 * 4)
+
+
+def test_anchor_basic():
+    a = Anchor(ratios=[1.0], scales=[8.0])
+    # ratio 1 on 16x16 base: ws=hs=16, scaled by 8 -> 128x128 centered at 7.5
+    np.testing.assert_allclose(
+        a.basic_anchors, [[7.5 - 63.5, 7.5 - 63.5, 7.5 + 63.5, 7.5 + 63.5]]
+    )
+    grid = a.generate_anchors(2, 2, feat_stride=16.0)
+    assert grid.shape == (4, 4)
+    # row order (y, x): second anchor is x-shifted by 16
+    np.testing.assert_allclose(grid[1] - grid[0], [16, 0, 16, 0])
+    np.testing.assert_allclose(grid[2] - grid[0], [0, 16, 0, 16])
+
+
+def test_bbox_transform_inv_identity():
+    boxes = np.array([[10, 10, 20, 30]], np.float32)
+    out = np.asarray(bbox_transform_inv(boxes, np.zeros((1, 4), np.float32)))
+    # zero deltas: center preserved, size preserved (pixel +1 convention)
+    w, h = 11.0, 21.0
+    cx, cy = 10 + w / 2, 10 + h / 2
+    np.testing.assert_allclose(
+        out[0], [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], rtol=1e-6
+    )
+
+
+def test_decode_boxes_roundtrip():
+    # encode a known box against a prior, then decode it back
+    prior = np.array([[0.1, 0.1, 0.5, 0.5]], np.float32)
+    var = np.array([[0.1, 0.1, 0.2, 0.2]], np.float32)
+    gt = np.array([[0.2, 0.25, 0.6, 0.55]], np.float32)
+    pw, ph = 0.4, 0.4
+    pcx, pcy = 0.3, 0.3
+    gw, gh = gt[0, 2] - gt[0, 0], gt[0, 3] - gt[0, 1]
+    gcx, gcy = (gt[0, 0] + gt[0, 2]) / 2, (gt[0, 1] + gt[0, 3]) / 2
+    enc = np.array([[
+        (gcx - pcx) / pw / 0.1, (gcy - pcy) / ph / 0.1,
+        np.log(gw / pw) / 0.2, np.log(gh / ph) / 0.2,
+    ]], np.float32)
+    dec = np.asarray(decode_boxes(prior, var, enc))
+    np.testing.assert_allclose(dec, gt, atol=1e-5)
+
+
+def test_clip_boxes_zeroes_small_scores():
+    boxes = np.array([[-5, -5, 50, 50, ], [0, 0, 2, 2]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    clipped, s = clip_boxes(jnp.asarray(boxes), 40, 40, min_h=5, min_w=5,
+                            scores=jnp.asarray(scores))
+    clipped, s = np.asarray(clipped), np.asarray(s)
+    np.testing.assert_allclose(clipped[0], [0, 0, 39, 39])
+    assert s[0] > 0 and s[1] == 0  # 2nd box smaller than min size
+
+
+def test_normalize_scale():
+    m = NormalizeScale(p=2.0, scale=20.0)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 8, 4, 4).astype(np.float32))
+    y = np.asarray(m.forward(x))
+    norms = np.linalg.norm(y, axis=1)
+    np.testing.assert_allclose(norms, 20.0, rtol=1e-4)
+    # scale is learnable
+    p, g = m.parameters()
+    assert p["weight"].shape == (1, 8, 1, 1)
+
+
+def test_proposal_shapes():
+    a = 9  # 3 ratios x 3 scales
+    h, w = 4, 5
+    rng = np.random.RandomState(2)
+    scores = jnp.asarray(rng.rand(1, 2 * a, h, w).astype(np.float32))
+    deltas = jnp.asarray((rng.rand(1, 4 * a, h, w).astype(np.float32) - 0.5) * 0.1)
+    im_info = jnp.asarray([[64.0, 80.0, 1.0, 1.0]], jnp.float32)
+    prop = Proposal(
+        pre_nms_topn=50, post_nms_topn=10,
+        ratios=[0.5, 1.0, 2.0], scales=[4.0, 8.0, 16.0],
+    ).evaluate()
+    out = np.asarray(prop.forward((scores, deltas, im_info)))
+    assert out.ndim == 2 and out.shape[1] == 5 and out.shape[0] <= 10
+    assert np.all(out[:, 0] == 0)
+    # proposals are clipped to the image
+    assert np.all(out[:, 1] >= 0) and np.all(out[:, 3] <= 79)
+    assert np.all(out[:, 2] >= 0) and np.all(out[:, 4] <= 63)
+
+
+def test_detection_output_ssd():
+    n_classes, n_priors = 3, 8
+    rng = np.random.RandomState(3)
+    # priors on a grid
+    pb = PriorBox(min_sizes=[50.0], img_size=100, variances=[0.1, 0.1, 0.2, 0.2])
+    prior = pb.forward(jnp.zeros((1, 4, 2, 4)))  # 2x4 feat -> 8 priors
+    loc = jnp.asarray((rng.rand(2, n_priors * 4).astype(np.float32) - 0.5) * 0.2)
+    conf = jnp.asarray(rng.rand(2, n_priors * n_classes).astype(np.float32) * 4)
+    det = DetectionOutputSSD(n_classes=n_classes, keep_topk=5).evaluate()
+    out = np.asarray(det.forward((loc, conf, prior)))
+    assert out.shape[0] == 2 and (out.shape[1] - 1) % 6 == 0
+    for b in range(2):
+        n = int(out[b, 0])
+        assert 0 <= n <= 5
+        for k in range(n):
+            label, score = out[b, 1 + 6 * k], out[b, 2 + 6 * k]
+            assert label in (1, 2)  # background class 0 excluded
+            assert 0.0 <= score <= 1.0
+
+
+def test_detection_output_ssd_training_passthrough():
+    det = DetectionOutputSSD(n_classes=3)
+    det.train_mode = True
+    inp = (jnp.zeros((1, 4)), jnp.zeros((1, 6)), jnp.zeros((1, 2, 4)))
+    out = det.forward(inp)
+    assert out is inp
+
+
+def test_detection_output_frcnn():
+    rng = np.random.RandomState(4)
+    n, n_classes = 12, 4
+    scores = rng.rand(n, n_classes).astype(np.float32)
+    scores /= scores.sum(1, keepdims=True)
+    deltas = ((rng.rand(n, 4 * n_classes) - 0.5) * 0.1).astype(np.float32)
+    rois = np.concatenate(
+        [np.zeros((n, 1)), rng.rand(n, 2) * 30, 40 + rng.rand(n, 2) * 30], 1
+    ).astype(np.float32)
+    im_info = np.array([[100.0, 100.0, 1.0, 1.0]], np.float32)
+    det = DetectionOutputFrcnn(n_classes=n_classes, max_per_image=6).evaluate()
+    out = np.asarray(det.forward(
+        (jnp.asarray(scores), jnp.asarray(deltas), jnp.asarray(rois),
+         jnp.asarray(im_info))
+    ))
+    n_det = int(out[0, 0])
+    assert out.shape == (1, 1 + n_det * 6)
+    assert n_det <= 6
+    labels = out[0, 1::6][:n_det]
+    assert np.all(labels >= 1)
