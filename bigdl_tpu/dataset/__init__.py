@@ -1,5 +1,5 @@
 from bigdl_tpu.dataset.minibatch import (
-    Sample, MiniBatch, PaddingParam, samples_to_minibatch,
+    Sample, MiniBatch, SparseMiniBatch, PaddingParam, samples_to_minibatch,
 )
 from bigdl_tpu.dataset.transformer import (
     Transformer, ChainedTransformer, FnTransformer, SampleToMiniBatch,

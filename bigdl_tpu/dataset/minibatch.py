@@ -105,3 +105,33 @@ def samples_to_minibatch(
         else:
             target = _stack([np.asarray(s.label) for s in samples], label_padding)
     return MiniBatch(input, target)
+
+
+class SparseMiniBatch(MiniBatch):
+    """MiniBatch whose features are batched into padded-COO SparseTensors
+    (reference: dataset/MiniBatch.scala:588 SparseMiniBatch).
+
+    ``capacity`` fixes the nnz padding so every batch reuses one compiled
+    program; default is the dense element count of the batch.
+    """
+
+    @staticmethod
+    def of(samples: List[Sample], capacity: Optional[int] = None,
+           sparse_feature: bool = True) -> "SparseMiniBatch":
+        from bigdl_tpu.nn.sparse import sparse_stack
+
+        first = samples[0]
+        if sparse_feature:
+            if isinstance(first.feature, (tuple, list)):
+                input = tuple(
+                    sparse_stack([s.feature[i] for s in samples], capacity)
+                    for i in range(len(first.feature))
+                )
+            else:
+                input = sparse_stack([s.feature for s in samples], capacity)
+        else:
+            input = _stack([s.feature for s in samples], None)
+        target = None
+        if first.label is not None:
+            target = _stack([np.asarray(s.label) for s in samples], None)
+        return SparseMiniBatch(input, target)

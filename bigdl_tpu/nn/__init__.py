@@ -36,7 +36,13 @@ from bigdl_tpu.nn.reshape import (
 from bigdl_tpu.nn.embedding import LookupTable
 from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, GRU, MultiRNNCell, Recurrent, BiRecurrent,
-    RecurrentDecoder, TimeDistributed,
+    RecurrentDecoder, TimeDistributed, LSTMPeephole, ConvLSTMPeephole,
+    ConvLSTMPeephole3D,
+)
+from bigdl_tpu.nn.tree import BinaryTreeLSTM
+from bigdl_tpu.nn.sparse import (
+    SparseTensor, DenseToSparse, LookupTableSparse, SparseLinear,
+    sparse_join, sparse_stack,
 )
 from bigdl_tpu.nn.detection import (
     PriorBox, Anchor, Proposal, Nms, NormalizeScale,

@@ -1,0 +1,265 @@
+"""Sparse tensors and sparse layers, TPU-native.
+
+Reference surface:
+  tensor/SparseTensor.scala        -- COO-ish sparse tensor (1463 LoC)
+  nn/LookupTableSparse.scala:47    -- embedding_lookup_sparse (sum/mean/sqrtn)
+  nn/SparseLinear.scala:45         -- Linear on sparse input
+  nn/SparseJoinTable.scala:36      -- concat sparse tensors on dim 2
+  nn/DenseToSparse.scala           -- conversion layer
+  dataset/MiniBatch.scala:588      -- SparseMiniBatch
+
+TPU-native redesign: XLA wants static shapes, so :class:`SparseTensor` is a
+*padded* COO — `indices (cap, ndim)`, `values (cap,)` and a validity count,
+where `cap` is a fixed capacity (the analogue of the reference's nnz, but
+padded so the same compiled program serves every batch).  Invalid slots
+carry index 0 / value 0 and are masked.  Sparse ops become
+`jax.ops.segment_sum` over the row coordinate — a scatter-add that XLA
+lowers natively — instead of the reference's scalar CSR loops.  The class
+is a pytree, so sparse activities flow through jit/grad like any array.
+"""
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.initialization import RandomNormal
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseTensor:
+    """Padded-COO sparse tensor (reference: tensor/SparseTensor.scala).
+
+    indices: (cap, ndim) int32, row-major sorted by construction;
+    values:  (cap,) — float or int;
+    nnz:     scalar int32, number of valid leading entries;
+    shape:   static dense shape tuple.
+    """
+
+    def __init__(self, indices, values, shape: Tuple[int, ...], nnz=None):
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.shape = tuple(int(s) for s in shape)
+        self.nnz = jnp.asarray(
+            self.values.shape[0] if nnz is None else nnz, jnp.int32)
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.indices, self.values, self.nnz), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, leaves):
+        indices, values, nnz = leaves
+        obj = cls.__new__(cls)
+        obj.indices, obj.values, obj.nnz, obj.shape = indices, values, nnz, shape
+        return obj
+
+    # -- construction ------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def valid_mask(self):
+        return jnp.arange(self.capacity) < self.nnz
+
+    @staticmethod
+    def from_dense(x, capacity: Optional[int] = None) -> "SparseTensor":
+        """Densify host-side into padded COO (row-major entry order)."""
+        x = np.asarray(x)
+        idx = np.argwhere(x != 0)
+        vals = x[tuple(idx.T)] if idx.size else np.zeros((0,), x.dtype)
+        nnz = idx.shape[0]
+        cap = capacity or max(nnz, 1)
+        assert cap >= nnz, f"capacity {cap} < nnz {nnz}"
+        pad = cap - nnz
+        idx = np.concatenate([idx, np.zeros((pad, x.ndim), np.int64)])
+        vals = np.concatenate([vals, np.zeros((pad,), x.dtype)])
+        return SparseTensor(idx, vals, x.shape, nnz)
+
+    @staticmethod
+    def coo(indices, values, shape, nnz=None) -> "SparseTensor":
+        return SparseTensor(indices, values, shape, nnz)
+
+    def to_dense(self):
+        mask = self.valid_mask()
+        flat_idx = jnp.zeros((self.capacity,), jnp.int32)
+        stride = 1
+        for d in range(self.ndim - 1, -1, -1):
+            flat_idx = flat_idx + self.indices[:, d] * stride
+            stride *= self.shape[d]
+        flat_idx = jnp.where(mask, flat_idx, stride)  # park invalid out of range
+        dense = jnp.zeros((int(np.prod(self.shape)) + 1,), self.values.dtype)
+        dense = dense.at[flat_idx].add(jnp.where(mask, self.values, 0))
+        return dense[:-1].reshape(self.shape)
+
+    def n_nonzero_by_row(self):
+        """(rows,) count of valid entries per leading index
+        (reference: SparseTensor.numNonZeroByRow)."""
+        rows = self.shape[0]
+        seg = jnp.where(self.valid_mask(), self.indices[:, 0], rows)
+        return jax.ops.segment_sum(
+            jnp.ones((self.capacity,), jnp.int32), seg, num_segments=rows + 1
+        )[:rows]
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, cap={self.capacity})")
+
+
+def sparse_join(tensors: Sequence[SparseTensor]) -> SparseTensor:
+    """Concatenate 2-D sparse tensors along dim 2 (column-wise).
+
+    Reference: nn/SparseJoinTable.scala:36.  Entry order becomes
+    (tensor-major within row) which densifies identically.
+    """
+    assert all(t.ndim == 2 for t in tensors)
+    rows = tensors[0].shape[0]
+    assert all(t.shape[0] == rows for t in tensors)
+    col_off = np.cumsum([0] + [t.shape[1] for t in tensors])
+    parts_idx, parts_val = [], []
+    for off, t in zip(col_off, tensors):
+        mask = t.valid_mask()
+        idx = t.indices + jnp.asarray([0, off], jnp.int32)
+        # park invalid entries at row `rows` so a final sort groups them last
+        idx = jnp.where(mask[:, None], idx, jnp.asarray([rows, 0], jnp.int32))
+        parts_idx.append(idx)
+        parts_val.append(jnp.where(mask, t.values, 0))
+    indices = jnp.concatenate(parts_idx)
+    values = jnp.concatenate(parts_val)
+    # stable row-major sort so rows stay contiguous
+    order = jnp.argsort(indices[:, 0], stable=True)
+    nnz = sum(t.nnz for t in tensors)
+    return SparseTensor(
+        indices[order], values[order], (rows, int(col_off[-1])), nnz)
+
+
+def sparse_stack(samples: Sequence[np.ndarray], capacity=None) -> SparseTensor:
+    """Stack dense host rows into one batched SparseTensor — the
+    SparseMiniBatch batching path (reference: dataset/MiniBatch.scala:588).
+
+    Default capacity is the batch's full dense element count, so every
+    same-shaped batch yields identical array shapes and reuses one compiled
+    program regardless of its nnz."""
+    batch = np.stack([np.asarray(s) for s in samples])
+    if capacity is None:
+        capacity = int(np.prod(batch.shape))
+    return SparseTensor.from_dense(batch, capacity)
+
+
+class DenseToSparse(Module):
+    """Conversion layer (reference: nn/DenseToSparse.scala). Capacity is the
+    full element count, keeping shapes static under jit."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = jnp.asarray(input)
+        flat = x.reshape(-1)
+        mask = flat != 0
+        # stable order of original positions, valid entries first
+        order = jnp.argsort(~mask, stable=True)
+        idx = jnp.stack(jnp.unravel_index(order, x.shape), axis=1)
+        values = flat[order] * mask[order]
+        idx = jnp.where(mask[order][:, None], idx, 0)
+        return SparseTensor.coo(idx, values, x.shape, jnp.sum(mask)), state
+
+
+class LookupTableSparse(Module):
+    """embedding_lookup_sparse (reference: nn/LookupTableSparse.scala:47).
+
+    Input: a 2-D :class:`SparseTensor` of positive integer ids (1-based like
+    the reference), or a (ids, weights) tuple of SparseTensors with matching
+    sparsity. Output: (batch, n_output) combined embeddings.
+
+    combiner: 'sum' | 'mean' | 'sqrtn'; max_norm: l2-clip each embedding
+    row before combining.  The combine is one segment_sum over rows.
+    """
+
+    def __init__(self, n_index, n_output, combiner="sum", max_norm=-1.0,
+                 weight_init=None, name=None):
+        super().__init__(name)
+        assert combiner in ("sum", "mean", "sqrtn"), combiner
+        self.n_index = n_index
+        self.n_output = n_output
+        self.combiner = combiner
+        self.max_norm = max_norm
+        self.weight_init = weight_init or RandomNormal(0.0, 1.0)
+
+    def setup(self, rng, input_spec):
+        w = self.weight_init.init(
+            rng, (self.n_index, self.n_output), self.n_index, self.n_output)
+        return {"weight": w}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if isinstance(input, (tuple, list)):
+            ids_sp, w_sp = input
+            sp_weights = w_sp.values.astype(jnp.float32)
+        else:
+            ids_sp, sp_weights = input, None
+        mask = ids_sp.valid_mask()
+        rows = ids_sp.indices[:, 0]
+        batch = ids_sp.shape[0]
+        ids = jnp.clip(ids_sp.values.astype(jnp.int32) - 1, 0, self.n_index - 1)
+
+        w = params["weight"]
+        emb = jnp.take(w, ids, axis=0)                      # (cap, D)
+        if self.max_norm > 0:
+            norms = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+            emb = emb * jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-12))
+        sw = sp_weights if sp_weights is not None else jnp.ones_like(
+            emb[:, 0])
+        sw = jnp.where(mask, sw, 0.0)
+
+        seg = jnp.where(mask, rows, batch)
+        summed = jax.ops.segment_sum(
+            emb * sw[:, None], seg, num_segments=batch + 1)[:batch]
+        if self.combiner == "sum":
+            return summed, state
+        if self.combiner == "mean":
+            denom = jax.ops.segment_sum(sw, seg, num_segments=batch + 1)[:batch]
+        else:  # sqrtn
+            denom = jnp.sqrt(
+                jax.ops.segment_sum(sw * sw, seg, num_segments=batch + 1)[:batch])
+        return summed / jnp.maximum(denom, 1e-12)[:, None], state
+
+
+class SparseLinear(Linear):
+    """Linear over a 2-D SparseTensor input (reference: nn/SparseLinear.scala:45).
+
+    y[b] = sum over entries (b, c, v) of v * W[:, c] + bias — a gather of
+    weight columns plus one segment_sum; the backward to W is the matching
+    scatter, derived by autodiff.
+    """
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not isinstance(input, SparseTensor):
+            return super().apply(params, state, input, training=training, rng=rng)
+        assert input.ndim == 2, "SparseLinear input must be 2-D"
+        w = params["weight"]                     # (out, in)
+        mask = input.valid_mask()
+        rows = jnp.where(mask, input.indices[:, 0], input.shape[0])
+        cols = input.indices[:, 1]
+        vals = jnp.where(mask, input.values.astype(w.dtype), 0)
+        contrib = vals[:, None] * w.T[cols]      # (cap, out)
+        y = jax.ops.segment_sum(
+            contrib, rows, num_segments=input.shape[0] + 1)[: input.shape[0]]
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+    def setup(self, rng, input_spec):
+        if isinstance(input_spec, SparseTensor) or hasattr(input_spec, "shape"):
+            shape = getattr(input_spec, "shape", None)
+            if self.input_size is None and shape is not None:
+                self.input_size = shape[-1]
+        return super().setup(rng, _DenseSpec((1, self.input_size)))
+
+
+class _DenseSpec:
+    def __init__(self, shape):
+        self.shape = shape

@@ -1,0 +1,166 @@
+"""Sparse tensor + sparse layers.
+
+Goldens: LookupTableSparse checked against a dense embedding-bag computed
+with plain numpy; SparseLinear against dense Linear on the densified input.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.dataset import Sample, SparseMiniBatch
+from bigdl_tpu.nn import (
+    DenseToSparse, Linear, LookupTableSparse, SparseLinear, SparseTensor,
+    sparse_join, sparse_stack,
+)
+
+
+def rand_sparse(rng, shape, density=0.3, capacity=None):
+    dense = (rng.rand(*shape) < density) * rng.randn(*shape)
+    return SparseTensor.from_dense(dense.astype(np.float32), capacity), dense
+
+
+def test_from_dense_roundtrip():
+    rng = np.random.RandomState(0)
+    sp, dense = rand_sparse(rng, (5, 7), capacity=40)
+    np.testing.assert_allclose(np.asarray(sp.to_dense()), dense, rtol=1e-6)
+    assert sp.capacity == 40
+
+
+def test_roundtrip_under_jit():
+    rng = np.random.RandomState(1)
+    sp, dense = rand_sparse(rng, (4, 6), capacity=30)
+    out = jax.jit(lambda s: s.to_dense())(sp)  # SparseTensor is a pytree
+    np.testing.assert_allclose(np.asarray(out), dense, rtol=1e-6)
+
+
+def test_n_nonzero_by_row():
+    x = np.array([[1, 0, 2], [0, 0, 0], [3, 4, 5]], np.float32)
+    sp = SparseTensor.from_dense(x, capacity=12)
+    np.testing.assert_array_equal(np.asarray(sp.n_nonzero_by_row()), [2, 0, 3])
+
+
+def test_sparse_join():
+    rng = np.random.RandomState(2)
+    a_sp, a = rand_sparse(rng, (4, 3), capacity=15)
+    b_sp, b = rand_sparse(rng, (4, 5), capacity=25)
+    joined = sparse_join([a_sp, b_sp])
+    assert joined.shape == (4, 8)
+    np.testing.assert_allclose(
+        np.asarray(joined.to_dense()), np.concatenate([a, b], 1), rtol=1e-6)
+
+
+def test_dense_to_sparse_layer():
+    x = jnp.asarray(np.array([[0.0, 2.0], [3.0, 0.0]], np.float32))
+    sp = DenseToSparse().forward(x)
+    assert isinstance(sp, SparseTensor)
+    np.testing.assert_allclose(np.asarray(sp.to_dense()), np.asarray(x))
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+def test_lookup_table_sparse_combiners(combiner):
+    # ids are 1-based as in the reference
+    ids_dense = np.array([[3, 1, 0, 0], [2, 0, 0, 0], [4, 4, 1, 0]], np.float32)
+    sp = SparseTensor.from_dense(ids_dense, capacity=12)
+    m = LookupTableSparse(4, 5, combiner=combiner)
+    out = np.asarray(m.forward(sp))
+    w = np.asarray(m.parameters()[0]["weight"])
+    expected = np.zeros((3, 5), np.float32)
+    for b, row in enumerate([[3, 1], [2], [4, 4, 1]]):
+        vecs = np.stack([w[i - 1] for i in row])
+        s = vecs.sum(0)
+        if combiner == "mean":
+            s /= len(row)
+        elif combiner == "sqrtn":
+            s /= np.sqrt(len(row))
+        expected[b] = s
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_lookup_table_sparse_weighted():
+    ids = SparseTensor.from_dense(
+        np.array([[2, 1], [3, 0]], np.float32), capacity=6)
+    wts = SparseTensor.from_dense(
+        np.array([[0.5, 2.0], [3.0, 0]], np.float32), capacity=6)
+    m = LookupTableSparse(3, 4, combiner="mean")
+    out = np.asarray(m.forward((ids, wts)))
+    w = np.asarray(m.parameters()[0]["weight"])
+    exp0 = (0.5 * w[1] + 2.0 * w[0]) / 2.5
+    exp1 = 3.0 * w[2] / 3.0
+    np.testing.assert_allclose(out, np.stack([exp0, exp1]), rtol=1e-5)
+
+
+def test_lookup_table_sparse_max_norm():
+    ids = SparseTensor.from_dense(np.array([[1.0]], np.float32), capacity=2)
+    m = LookupTableSparse(2, 8, combiner="sum", max_norm=0.5,
+                          )
+    out = np.asarray(m.forward(ids))
+    assert np.linalg.norm(out) <= 0.5 + 1e-5
+
+
+def test_sparse_linear_matches_dense():
+    rng = np.random.RandomState(3)
+    sp, dense = rand_sparse(rng, (6, 10), capacity=64)
+    m = SparseLinear(10, 4)
+    y_sparse = np.asarray(m.forward(sp))
+    # dense path through the same params
+    dense_lin = Linear(10, 4)
+    dense_lin.build(jax.ShapeDtypeStruct((6, 10), jnp.float32))
+    dense_lin.set_parameters(m.parameters()[0])
+    y_dense = np.asarray(dense_lin.forward(jnp.asarray(dense)))
+    np.testing.assert_allclose(y_sparse, y_dense, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_linear_grad():
+    rng = np.random.RandomState(4)
+    sp, dense = rand_sparse(rng, (5, 8), capacity=40)
+    m = SparseLinear(8, 3)
+    y = m.forward(sp)
+    g = m.backward(sp, jnp.ones_like(y))
+    _, grads = m.parameters()
+    # grad wrt weight equals dense formulation: dL/dW = 1^T . x
+    expected_gw = np.ones((5, 3)).T @ dense
+    np.testing.assert_allclose(
+        np.asarray(grads["weight"]), expected_gw, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_minibatch():
+    samples = [Sample(np.eye(3, dtype=np.float32)[i], np.float32(i))
+               for i in range(3)]
+    mb = SparseMiniBatch.of(samples, capacity=9)
+    assert isinstance(mb.get_input(), SparseTensor)
+    assert mb.get_input().shape == (3, 3)
+    np.testing.assert_allclose(
+        np.asarray(mb.get_input().to_dense()), np.eye(3), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(mb.get_target()), [0, 1, 2])
+
+
+def test_wide_and_deep_style_pipeline():
+    """SparseLinear (wide) + LookupTableSparse (deep) jointly, jitted."""
+    rng = np.random.RandomState(5)
+    wide_sp, _ = rand_sparse(rng, (4, 20), density=0.2, capacity=32)
+    ids = SparseTensor.from_dense(
+        (rng.randint(0, 2, (4, 6)) * rng.randint(1, 11, (4, 6))).astype(np.float32),
+        capacity=24)
+    wide = SparseLinear(20, 2)
+    deep_emb = LookupTableSparse(10, 8, combiner="mean")
+    wide.forward(wide_sp)
+    deep_emb.forward(ids)
+
+    def fused(wp, dp, w_in, d_in):
+        yw, _ = wide.apply(wp, (), w_in)
+        yd, _ = deep_emb.apply(dp, (), d_in)
+        return yw + yd @ jnp.ones((8, 2), jnp.float32)
+
+    out = jax.jit(fused)(wide.parameters()[0], deep_emb.parameters()[0],
+                         wide_sp, ids)
+    assert out.shape == (4, 2)
+
+
+def test_sparse_stack_capacity_default_static():
+    # two batches with different nnz must produce identical shapes
+    a = sparse_stack([np.eye(3, dtype=np.float32)[i] for i in range(3)])
+    b = sparse_stack([np.zeros(3, np.float32) for _ in range(3)])
+    assert a.indices.shape == b.indices.shape == (9, 2)
