@@ -286,3 +286,144 @@ class TimeDistributed(Container):
         y, new_state = self.module.apply(params, state, flat,
                                          training=training, rng=rng)
         return y.reshape((n, t) + y.shape[1:]), new_state
+
+
+class LSTMPeephole(Cell):
+    """LSTM with peephole connections (reference: nn/LSTMPeephole.scala:29).
+
+    Each of the input/forget/output gates additionally sees the *previous*
+    cell state through a learned diagonal (per-unit) weight -- the CMul in
+    buildGate (LSTMPeephole.scala:109).  Gate order i, f, g, o as in the
+    reference's narrow offsets (:120-136).
+    """
+
+    def __init__(self, input_size, hidden_size, with_peephole=True, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.with_peephole = with_peephole
+
+    def setup(self, rng, input_spec):
+        init = RandomUniform()
+        h, i = self.hidden_size, self.input_size
+        params = {
+            "weight_ih": init.init(child_rng(rng, 0), (4 * h, i), h, h),
+            "weight_hh": init.init(child_rng(rng, 1), (4 * h, h), h, h),
+            "bias": init.init(child_rng(rng, 2), (4 * h,), h, h),
+        }
+        if self.with_peephole:
+            params["peep_i"] = jnp.zeros((h,), jnp.float32)
+            params["peep_f"] = jnp.zeros((h,), jnp.float32)
+            params["peep_o"] = jnp.zeros((h,), jnp.float32)
+        return params, ()
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        return (jnp.zeros((batch_size, self.hidden_size), dtype),
+                jnp.zeros((batch_size, self.hidden_size), dtype))
+
+    def step(self, params, x_t, hidden):
+        h, c = hidden
+        dt = x_t.dtype
+        gates = (x_t @ params["weight_ih"].astype(dt).T
+                 + h @ params["weight_hh"].astype(dt).T
+                 + params["bias"].astype(dt))
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if self.with_peephole:
+            i = i + c * params["peep_i"].astype(dt)
+            f = f + c * params["peep_f"].astype(dt)
+            o = o + c * params["peep_o"].astype(dt)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class _ConvLSTMBase(Cell):
+    """Shared conv-LSTM machinery for 2-D and 3-D variants."""
+
+    ndim: int  # spatial dims
+
+    def __init__(self, input_size, output_size, kernel_i, kernel_c,
+                 stride=1, with_peephole=True, name=None):
+        super().__init__(name)
+        assert stride == 1, "SAME-padding conv-LSTM keeps spatial dims (stride 1)"
+        self.input_size = input_size
+        self.output_size = output_size
+        self.hidden_size = output_size
+        self.kernel_i = kernel_i
+        self.kernel_c = kernel_c
+        self.with_peephole = with_peephole
+        self._spatial = None  # bound at setup from the input spec
+
+    def _dn(self):
+        if self.ndim == 2:
+            return ("NCHW", "OIHW", "NCHW")
+        return ("NCDHW", "OIDHW", "NCDHW")
+
+    def _conv(self, x, w, b=None):
+        y = jax.lax.conv_general_dilated(
+            x, w.astype(x.dtype), (1,) * self.ndim, "SAME",
+            dimension_numbers=self._dn())
+        if b is not None:
+            y = y + b.astype(x.dtype).reshape((1, -1) + (1,) * self.ndim)
+        return y
+
+    def setup(self, rng, input_spec):
+        # input spec: (N, C, *spatial)
+        self._spatial = tuple(input_spec.shape[2:])
+        init = RandomUniform()
+        o, i = self.output_size, self.input_size
+        ki = (self.kernel_i,) * self.ndim
+        kc = (self.kernel_c,) * self.ndim
+        fan_i = i * self.kernel_i ** self.ndim
+        fan_c = o * self.kernel_c ** self.ndim
+        params = {
+            # 4 gates stacked on the output-channel axis (i, f, g, o)
+            "weight_ih": init.init(child_rng(rng, 0), (4 * o, i) + ki, fan_i, o),
+            "weight_hh": init.init(child_rng(rng, 1), (4 * o, o) + kc, fan_c, o),
+            "bias": jnp.zeros((4 * o,), jnp.float32),
+        }
+        if self.with_peephole:
+            # per-channel peephole (CMul(Array(1, outputSize, 1, 1)))
+            for k in ("peep_i", "peep_f", "peep_o"):
+                params[k] = jnp.zeros((o,), jnp.float32)
+        return params, ()
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        shape = (batch_size, self.output_size) + self._spatial
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    def step(self, params, x_t, hidden):
+        h, c = hidden
+        gates = (self._conv(x_t, params["weight_ih"], params["bias"])
+                 + self._conv(h, params["weight_hh"]))
+        i, f, g, o = jnp.split(gates, 4, axis=1)
+
+        def peep(name):
+            return (c * params[name].astype(c.dtype)
+                    .reshape((1, -1) + (1,) * self.ndim))
+
+        if self.with_peephole:
+            i = i + peep("peep_i")
+            f = f + peep("peep_f")
+            o = o + peep("peep_o")
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class ConvLSTMPeephole(_ConvLSTMBase):
+    """2-D convolutional LSTM with peepholes
+    (reference: nn/ConvLSTMPeephole.scala:54). Input (N, C, H, W) per step;
+    the recurrence convolves both input and hidden state, peepholes are
+    per-channel."""
+
+    ndim = 2
+
+
+class ConvLSTMPeephole3D(_ConvLSTMBase):
+    """3-D (volumetric) variant (reference: nn/ConvLSTMPeephole3D.scala).
+    Input (N, C, D, H, W) per step."""
+
+    ndim = 3

@@ -1,0 +1,136 @@
+"""Tree-structured LSTM (reference: nn/BinaryTreeLSTM.scala:40).
+
+The reference builds one leaf-module / composer clone per tree node and
+recurses over the parse tree in Scala (recursiveForward,
+BinaryTreeLSTM.scala:265).  TPU-native redesign: trees are data, not
+control flow -- every sweep computes leaf states AND composed states for
+ALL nodes of ALL trees in one batched matmul, reading children states from
+a node-state buffer; after ``depth`` sweeps (bounded by node count) every
+node has its fixed point.  The whole thing is `lax.fori_loop` over sweeps,
+so a batch of ragged trees is one static-shape XLA program.
+
+Tree encoding matches TensorTree (BinaryTreeLSTM.scala:513): trees
+(B, nNodes, 3) rows [leftChild, rightChild, marker] with 1-based node ids;
+marker > 0 = leaf holding 1-based word position, marker -1 = root flag,
+children 0 = absent. Output (B, nNodes, hidden) of per-node h states.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.initialization import RandomUniform
+from bigdl_tpu.nn.module import Module, child_rng
+
+
+class BinaryTreeLSTM(Module):
+    """Binary tree LSTM for e.g. constituency-parse sentiment.
+
+    Input: (embeddings (B, seq, input_size), trees (B, nNodes, 3)).
+    Output: (B, nNodes, hidden_size) node hidden states.
+    """
+
+    def __init__(self, input_size, hidden_size, gate_output=True,
+                 max_depth=None, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.gate_output = gate_output
+        self.max_depth = max_depth
+
+    def setup(self, rng, input_spec):
+        init = RandomUniform()
+        h, i = self.hidden_size, self.input_size
+        k = iter(range(100))
+        params = {
+            # leaf module (createLeafModuleWithGraph, BinaryTreeLSTM.scala:63)
+            "leaf_c_w": init.init(child_rng(rng, next(k)), (h, i), i, h),
+            "leaf_c_b": jnp.zeros((h,), jnp.float32),
+            # composer: 4or5 gates, each Linear(lh)+Linear(rh)
+            # (createComposerWithGraph, BinaryTreeLSTM.scala:82)
+            "comp_l_w": init.init(child_rng(rng, next(k)), (5 * h, h), h, h),
+            "comp_l_b": jnp.zeros((5 * h,), jnp.float32),
+            "comp_r_w": init.init(child_rng(rng, next(k)), (5 * h, h), h, h),
+            "comp_r_b": jnp.zeros((5 * h,), jnp.float32),
+        }
+        if self.gate_output:
+            params["leaf_o_w"] = init.init(child_rng(rng, next(k)), (h, i), i, h)
+            params["leaf_o_b"] = jnp.zeros((h,), jnp.float32)
+        return params, ()
+
+    @staticmethod
+    def root_hidden(output, trees):
+        """Gather each tree's ROOT hidden state: (B, nNodes, H) + trees ->
+        (B, H).  The root is the node whose marker column is -1."""
+        marker = trees[..., 2].astype(jnp.int32)
+        root = jnp.argmax(marker == -1, axis=-1)            # (B,)
+        return jnp.take_along_axis(
+            output, root[:, None, None], axis=1)[:, 0]
+
+    def _leaf_states(self, params, emb):
+        """emb (..., input_size) -> (c, h)"""
+        dt = emb.dtype
+        c = emb @ params["leaf_c_w"].astype(dt).T + params["leaf_c_b"].astype(dt)
+        if self.gate_output:
+            o = jax.nn.sigmoid(
+                emb @ params["leaf_o_w"].astype(dt).T
+                + params["leaf_o_b"].astype(dt))
+            h = o * jnp.tanh(c)
+        else:
+            h = jnp.tanh(c)
+        return c, h
+
+    def _compose(self, params, lc, lh, rc, rh):
+        dt = lh.dtype
+        gates = (lh @ params["comp_l_w"].astype(dt).T
+                 + params["comp_l_b"].astype(dt)
+                 + rh @ params["comp_r_w"].astype(dt).T
+                 + params["comp_r_b"].astype(dt))
+        i, lf, rf, update, o = jnp.split(gates, 5, axis=-1)
+        c = (jax.nn.sigmoid(i) * jnp.tanh(update)
+             + jax.nn.sigmoid(lf) * lc + jax.nn.sigmoid(rf) * rc)
+        if self.gate_output:
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        else:
+            h = jnp.tanh(c)
+        return c, h
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        emb, trees = input
+        trees = trees.astype(jnp.int32)
+        b, n_nodes = trees.shape[0], trees.shape[1]
+        h_dim = self.hidden_size
+        depth = self.max_depth or n_nodes
+
+        left = trees[..., 0]                       # (B, N) 1-based, 0 = none
+        right = trees[..., 1]
+        marker = trees[..., 2]
+        is_leaf = marker > 0
+        is_internal = left > 0
+        # leaf embeddings: word position is 1-based into the sequence
+        word = jnp.clip(marker - 1, 0, emb.shape[1] - 1)
+        leaf_emb = jnp.take_along_axis(
+            emb, word[..., None], axis=1)          # (B, N, input)
+        leaf_c, leaf_h = self._leaf_states(params, leaf_emb)
+        zero = jnp.zeros((b, 1, h_dim), emb.dtype)  # slot 0 = absent child
+
+        def sweep(_, bufs):
+            cbuf, hbuf = bufs                       # (B, N+1, H), slot 0 zeros
+
+            def child(buf, idx):
+                return jnp.take_along_axis(buf, idx[..., None], axis=1)
+
+            lc, lh = child(cbuf, left), child(hbuf, left)
+            rc, rh = child(cbuf, right), child(hbuf, right)
+            comp_c, comp_h = self._compose(params, lc, lh, rc, rh)
+            new_c = jnp.where(is_leaf[..., None], leaf_c,
+                              jnp.where(is_internal[..., None], comp_c, 0.0))
+            new_h = jnp.where(is_leaf[..., None], leaf_h,
+                              jnp.where(is_internal[..., None], comp_h, 0.0))
+            return (jnp.concatenate([zero, new_c], axis=1),
+                    jnp.concatenate([zero, new_h], axis=1))
+
+        init = (jnp.zeros((b, n_nodes + 1, h_dim), emb.dtype),
+                jnp.zeros((b, n_nodes + 1, h_dim), emb.dtype))
+        _, hbuf = lax.fori_loop(0, depth, sweep, init)
+        return hbuf[:, 1:], state

@@ -1,13 +1,14 @@
 from bigdl_tpu.optim.optim_method import (
-    OptimMethod, SGD, Adam, Adagrad, Adadelta, RMSprop, Adamax, Ftrl,
+    OptimMethod, SGD, Adam, ParallelAdam, Adagrad, Adadelta, RMSprop, Adamax, Ftrl,
     LearningRateSchedule, Default, Step, MultiStep, Poly, Exponential,
     NaturalExp, Warmup, SequentialSchedule,
     clip_by_value, clip_by_global_norm,
 )
+from bigdl_tpu.optim.lbfgs import LBFGS, line_search_wolfe
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss,
-    MAE, HitRatio, NDCG,
+    MAE, HitRatio, NDCG, TreeNNAccuracy,
 )
 from bigdl_tpu.optim.train_step import make_train_step, make_eval_step
 from bigdl_tpu.optim.local_optimizer import (

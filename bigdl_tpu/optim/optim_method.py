@@ -426,3 +426,15 @@ def clip_by_global_norm(grads, max_norm, sq_norm=None):
     norm = jnp.sqrt(sq_norm)
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
     return jax.tree.map(lambda g: g * scale, grads)
+
+
+class ParallelAdam(Adam):
+    """Adam whose update is expected to run sharded (reference:
+    optim/ParallelAdam.scala -- a thread-pool Adam over parameter chunks).
+
+    On TPU the chunk-parallelism seam is the mesh, not a thread pool: the
+    identical update math is partitioned by XLA when the params/opt-state
+    carry shardings (see parallel/zero.py shard_opt_state and the ZeRO-1
+    flat-chunk layout), so this class is the same pure transform with the
+    reference's name kept for API parity.
+    """

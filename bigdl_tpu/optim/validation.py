@@ -120,3 +120,31 @@ class NDCG(ValidationMethod):
         has_hit = jnp.any(match, axis=-1)
         gains = jnp.where(has_hit, 1.0 / jnp.log2(ranks + 2.0), 0.0)
         return jnp.sum(gains), target.shape[0]
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Accuracy of the tree ROOT prediction, for tree-LSTM sentiment
+    (reference: optim/ValidationMethod.scala:118, which scores node 1).
+
+    output (B, nNodes, C); target (B, nNodes) or (B,) root labels (0-based,
+    matching the framework convention).  ``root_index`` selects which node
+    is the root -- the TensorTree encoding allows the root anywhere, so
+    either order trees root-first (the reference's convention) or pass the
+    root position; for data-dependent root positions gather the root state
+    with :meth:`bigdl_tpu.nn.BinaryTreeLSTM.root_hidden` before scoring.
+    """
+
+    name = "TreeNNAccuracy"
+
+    def __init__(self, root_index: int = 0):
+        self.root_index = root_index
+
+    def batch_result(self, output, target):
+        root = output[:, self.root_index]
+        if root.shape[-1] == 1:
+            pred = (root[..., 0] >= 0.5).astype(jnp.int32)
+        else:
+            pred = jnp.argmax(root, axis=-1)
+        tgt = target[:, self.root_index] if target.ndim > 1 else target
+        correct = jnp.sum(pred == tgt.astype(pred.dtype))
+        return correct, root.shape[0]

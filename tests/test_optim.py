@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 import torch
 
+import jax
 import jax.numpy as jnp
 
 from bigdl_tpu import optim
@@ -188,3 +189,64 @@ class TestValidationMethods:
         assert_close(np.linalg.norm(np.asarray(clipped["w"])), 1.0, rtol=1e-5)
         cv = optim.clip_by_value(g, -2.0, 2.0)
         assert_close(cv["w"], [2.0, 2.0])
+
+
+class TestLBFGS:
+    def test_quadratic(self):
+        from bigdl_tpu.optim import LBFGS
+        A = jnp.asarray(np.diag([1.0, 10.0, 100.0]).astype(np.float32))
+        b = jnp.asarray([1.0, -2.0, 3.0])
+
+        def feval(x):
+            f = 0.5 * x @ A @ x - b @ x
+            return f, A @ x - b
+        x0 = jnp.zeros(3)
+        opt = LBFGS(max_iter=50)
+        x, hist = opt.optimize(feval, x0)
+        expected = np.linalg.solve(np.asarray(A), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(x), expected, atol=1e-4)
+        assert hist[-1] < hist[0]
+
+    def test_rosenbrock(self):
+        from bigdl_tpu.optim import LBFGS
+
+        def rosen(x):
+            f = 100.0 * (x[1] - x[0] ** 2) ** 2 + (1 - x[0]) ** 2
+            return f, jax.grad(lambda v: 100.0 * (v[1] - v[0] ** 2) ** 2
+                               + (1 - v[0]) ** 2)(x)
+        opt = LBFGS(max_iter=100, tolerance_fun=0.0, tolerance_x=1e-12)
+        x, hist = opt.optimize(rosen, jnp.asarray([-1.2, 1.0]))
+        np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=1e-3)
+
+    def test_no_line_search(self):
+        from bigdl_tpu.optim import LBFGS
+
+        def feval(x):
+            return jnp.sum(x ** 2), 2 * x
+        opt = LBFGS(max_iter=30, line_search=False, learning_rate=0.3)
+        x, hist = opt.optimize(feval, jnp.asarray([4.0, -3.0]))
+        assert hist[-1] < 1e-3
+
+
+def test_parallel_adam_matches_adam():
+    from bigdl_tpu.optim import Adam, ParallelAdam
+    params = {"w": jnp.asarray([1.0, 2.0]), "b": jnp.asarray([0.5])}
+    grads = {"w": jnp.asarray([0.1, -0.2]), "b": jnp.asarray([0.3])}
+    a, pa = Adam(learning_rate=0.1), ParallelAdam(learning_rate=0.1)
+    sa, spa = a.init_state(params), pa.init_state(params)
+    na, _ = a.update(grads, sa, params)
+    npa, _ = pa.update(grads, spa, params)
+    np.testing.assert_allclose(np.asarray(na["w"]), np.asarray(npa["w"]))
+
+
+def test_line_search_unbracketed_returns_consistent_point():
+    from bigdl_tpu.optim import line_search_wolfe
+    # unbounded descent: expansion never brackets
+    feval = lambda x: (-jnp.sum(x), -jnp.ones_like(x))
+    x = jnp.zeros(2)
+    d = jnp.ones(2)
+    f0, g0 = feval(x)
+    f, g, t, n = line_search_wolfe(feval, x, 1.0, d, f0, g0,
+                                   float(jnp.vdot(g0, d)), max_iter=5)
+    fe, _ = feval(x + t * d)
+    np.testing.assert_allclose(float(f), float(fe))

@@ -1,0 +1,193 @@
+"""LSTMPeephole / ConvLSTMPeephole / BinaryTreeLSTM + TreeNNAccuracy.
+
+Goldens: peephole cells degenerate to the plain LSTM when peephole weights
+are zero -- checked against the existing (torch-golden-tested) LSTM cell;
+BinaryTreeLSTM is checked against a scalar python recursion over the same
+params.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import (
+    LSTM, LSTMPeephole, ConvLSTMPeephole, ConvLSTMPeephole3D,
+    BinaryTreeLSTM, Recurrent,
+)
+from bigdl_tpu.optim import TreeNNAccuracy
+
+
+def test_lstm_peephole_zero_peep_matches_lstm():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(3, 5, 4).astype(np.float32))
+    peep = Recurrent(LSTMPeephole(4, 6))
+    y_p = peep.forward(x)
+    # zero peepholes == plain LSTM with bias folded (bias_ih + bias_hh)
+    plain = Recurrent(LSTM(4, 6))
+    plain.forward(x)
+    pp = peep.parameters()[0]
+    plain.set_parameters({
+        "weight_ih": pp["weight_ih"], "weight_hh": pp["weight_hh"],
+        "bias_ih": pp["bias"], "bias_hh": jnp.zeros_like(pp["bias"]),
+    })
+    y_l = plain.forward(x)
+    assert np.asarray(pp["peep_i"]).max() == 0  # init is zero
+    np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_l), atol=1e-6)
+
+
+def test_lstm_peephole_nonzero_changes_output():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 4, 3).astype(np.float32))
+    m = Recurrent(LSTMPeephole(3, 5))
+    y0 = np.asarray(m.forward(x))
+    p = m.parameters()[0]
+    p["peep_i"] = jnp.ones((5,)) * 0.5
+    m.set_parameters(p)
+    y1 = np.asarray(m.forward(x))
+    assert not np.allclose(y0, y1)
+
+
+def test_conv_lstm_peephole_shapes_and_recurrence():
+    rng = np.random.RandomState(2)
+    # (N, T, C, H, W) unrolled manually through the cell
+    cell = ConvLSTMPeephole(3, 8, kernel_i=3, kernel_c=3)
+    x0 = jnp.asarray(rng.randn(2, 3, 6, 6).astype(np.float32))
+    cell.build(jax.ShapeDtypeStruct((2, 3, 6, 6), jnp.float32))
+    h = cell.init_hidden(2)
+    params = cell.parameters()[0]
+    out, (h1, c1) = cell.step(params, x0, h)
+    assert out.shape == (2, 8, 6, 6) and c1.shape == (2, 8, 6, 6)
+    # second step depends on the first's state
+    out2a, _ = cell.step(params, x0, (h1, c1))
+    out2b, _ = cell.step(params, x0, cell.init_hidden(2))
+    assert not np.allclose(np.asarray(out2a), np.asarray(out2b))
+
+
+def test_conv_lstm_in_recurrent_container():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 4, 3, 5, 5).astype(np.float32))  # (N,T,C,H,W)
+    m = Recurrent(ConvLSTMPeephole(3, 6, 3, 3))
+    y = m.forward(x)
+    assert y.shape == (2, 4, 6, 5, 5)
+
+
+def test_conv_lstm_3d():
+    rng = np.random.RandomState(4)
+    cell = ConvLSTMPeephole3D(2, 4, kernel_i=3, kernel_c=3)
+    x0 = jnp.asarray(rng.randn(1, 2, 3, 4, 4).astype(np.float32))
+    cell.build(jax.ShapeDtypeStruct(x0.shape, jnp.float32))
+    out, _ = cell.step(cell.parameters()[0], x0, cell.init_hidden(1))
+    assert out.shape == (1, 4, 3, 4, 4)
+
+
+def make_tree():
+    """5 leaves, 4 internal; root = node 9.
+
+    Tree over words 1..5:  ((1 2) ((3 4) 5))
+    nodes: 1..5 leaves; 6=(1,2); 7=(3,4); 8=(7,5); 9=(6,8) root
+    """
+    t = np.zeros((9, 3), np.float32)
+    for i in range(5):
+        t[i] = [0, 0, i + 1]
+    t[5] = [1, 2, 0]
+    t[6] = [3, 4, 0]
+    t[7] = [7, 5, 0]
+    t[8] = [6, 8, -1]
+    return t
+
+
+def scalar_tree_lstm(params, emb, tree, hidden, gate_output=True):
+    """Independent python recursion over the same params."""
+    def leaf(x):
+        c = x @ np.asarray(params["leaf_c_w"]).T + np.asarray(params["leaf_c_b"])
+        o = 1 / (1 + np.exp(-(x @ np.asarray(params["leaf_o_w"]).T
+                              + np.asarray(params["leaf_o_b"]))))
+        return c, o * np.tanh(c)
+
+    def compose(lc, lh, rc, rh):
+        g = (lh @ np.asarray(params["comp_l_w"]).T + np.asarray(params["comp_l_b"])
+             + rh @ np.asarray(params["comp_r_w"]).T + np.asarray(params["comp_r_b"]))
+        i, lf, rf, u, o = np.split(g, 5)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        c = sig(i) * np.tanh(u) + sig(lf) * lc + sig(rf) * rc
+        return c, sig(o) * np.tanh(c)
+
+    states = {}
+
+    def rec(node):  # 1-based
+        row = tree[node - 1]
+        if row[2] > 0:
+            states[node] = leaf(emb[int(row[2]) - 1])
+        else:
+            lc, lh = rec(int(row[0]))
+            rc, rh = rec(int(row[1]))
+            states[node] = compose(lc, lh, rc, rh)
+        return states[node]
+
+    # root = node with marker -1
+    root = int(np.where(tree[:, 2] == -1)[0][0]) + 1
+    rec(root)
+    out = np.zeros((tree.shape[0], hidden), np.float32)
+    for node, (c, h) in states.items():
+        out[node - 1] = h
+    return out
+
+
+def test_binary_tree_lstm_matches_scalar_recursion():
+    rng = np.random.RandomState(5)
+    tree = make_tree()
+    emb = rng.randn(5, 4).astype(np.float32)
+    m = BinaryTreeLSTM(4, 6)
+    out = np.asarray(m.forward((jnp.asarray(emb[None]), jnp.asarray(tree[None]))))
+    expected = scalar_tree_lstm(m.parameters()[0], emb, tree, 6)
+    np.testing.assert_allclose(out[0], expected, rtol=1e-4, atol=1e-5)
+
+
+def test_binary_tree_lstm_batch_and_grad():
+    rng = np.random.RandomState(6)
+    tree = make_tree()
+    trees = jnp.asarray(np.stack([tree, tree]))
+    emb = jnp.asarray(rng.randn(2, 5, 4).astype(np.float32))
+    m = BinaryTreeLSTM(4, 6)
+    y = m.forward((emb, trees))
+    assert y.shape == (2, 9, 6)
+    g = m.backward((emb, trees), jnp.ones_like(y))
+    _, grads = m.parameters()
+    assert float(jnp.abs(grads["comp_l_w"]).sum()) > 0
+    assert g[0].shape == emb.shape
+
+
+def test_tree_nn_accuracy():
+    out = jnp.asarray(np.array([
+        [[0.1, 0.9], [0.8, 0.2]],   # root pred 1
+        [[0.7, 0.3], [0.1, 0.9]],   # root pred 0
+    ], np.float32))
+    tgt = jnp.asarray(np.array([[1, 0], [1, 0]], np.float32))
+    res = TreeNNAccuracy()(out, tgt)
+    v, n = res.result()
+    assert n == 2 and abs(v - 0.5) < 1e-9
+
+
+def test_root_hidden_gather():
+    tree = make_tree()
+    trees = jnp.asarray(np.stack([tree, tree]))
+    emb = jnp.asarray(np.random.RandomState(7).randn(2, 5, 4).astype(np.float32))
+    m = BinaryTreeLSTM(4, 6)
+    out = m.forward((emb, trees))
+    root = np.asarray(BinaryTreeLSTM.root_hidden(out, trees))
+    # root of make_tree is node 9 (index 8)
+    np.testing.assert_allclose(root, np.asarray(out)[:, 8], rtol=1e-6)
+
+
+def test_tree_nn_accuracy_root_index():
+    out = jnp.asarray(np.array([
+        [[0.1, 0.9], [0.8, 0.2]],
+        [[0.7, 0.3], [0.1, 0.9]],
+    ], np.float32))
+    # node-1 preds: [0.8,0.2]->0 and [0.1,0.9]->1; node-1 targets 0, 1
+    tgt = jnp.asarray(np.array([[1, 0], [1, 1]], np.float32))
+    res = TreeNNAccuracy(root_index=1)(out, tgt)
+    v, n = res.result()
+    assert n == 2 and abs(v - 1.0) < 1e-9
