@@ -1,0 +1,81 @@
+"""AlexNet.
+
+Reference: example/loadmodel/AlexNet.scala — two variants:
+``AlexNet`` (original Krizhevsky net: LRN + grouped convolutions, groups=2
+on conv2/4/5) and ``AlexNet_OWT`` ("one weird trick" variant: no LRN, no
+groups).  TPU-native: NHWC layout, conv via lax.conv_general_dilated with
+``feature_group_count`` for the grouped convs (maps straight onto the MXU —
+no im2col, no split/concat emulation of groups).
+"""
+
+import bigdl_tpu.nn as nn
+
+
+def _flatten_classifier(model, class_num, has_dropout):
+    model.add(nn.Flatten())
+    model.add(nn.Linear(256 * 6 * 6, 4096, name="fc6"))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, 4096, name="fc7"))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, class_num, name="fc8"))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def AlexNet(class_num=1000, has_dropout=True):
+    """Original AlexNet, input (N, 227, 227, 3).
+
+    Reference: example/loadmodel/AlexNet.scala ``object AlexNet`` (grouped
+    conv2/conv4/conv5, LRN after conv1/conv2).
+    """
+    model = (
+        nn.Sequential()
+        .add(nn.SpatialConvolution(3, 96, 11, 11, 4, 4, 0, 0, name="conv1"))
+        .add(nn.ReLU())
+        .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2))
+        .add(nn.SpatialConvolution(96, 256, 5, 5, 1, 1, 2, 2, n_group=2,
+                                   name="conv2"))
+        .add(nn.ReLU())
+        .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2))
+        .add(nn.SpatialConvolution(256, 384, 3, 3, 1, 1, 1, 1, name="conv3"))
+        .add(nn.ReLU())
+        .add(nn.SpatialConvolution(384, 384, 3, 3, 1, 1, 1, 1, n_group=2,
+                                   name="conv4"))
+        .add(nn.ReLU())
+        .add(nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, n_group=2,
+                                   name="conv5"))
+        .add(nn.ReLU())
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    )
+    return _flatten_classifier(model, class_num, has_dropout)
+
+
+def AlexNetOWT(class_num=1000, has_dropout=True):
+    """"One weird trick" AlexNet, input (N, 224, 224, 3).
+
+    Reference: example/loadmodel/AlexNet.scala ``object AlexNet_OWT``
+    (no LRN, no conv groups).
+    """
+    model = (
+        nn.Sequential()
+        .add(nn.SpatialConvolution(3, 64, 11, 11, 4, 4, 2, 2, name="conv1"))
+        .add(nn.ReLU())
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2))
+        .add(nn.SpatialConvolution(64, 192, 5, 5, 1, 1, 2, 2, name="conv2"))
+        .add(nn.ReLU())
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2))
+        .add(nn.SpatialConvolution(192, 384, 3, 3, 1, 1, 1, 1, name="conv3"))
+        .add(nn.ReLU())
+        .add(nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, name="conv4"))
+        .add(nn.ReLU())
+        .add(nn.SpatialConvolution(256, 256, 3, 3, 1, 1, 1, 1, name="conv5"))
+        .add(nn.ReLU())
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    )
+    return _flatten_classifier(model, class_num, has_dropout)

@@ -29,6 +29,85 @@ def inception_module(n_in, c1, c3r, c3, c5r, c5, pool_proj):
     return concat
 
 
+def _conv_bn(n_in, n_out, k, stride=1, pad=0):
+    """conv + BN + ReLU unit (reference: Inception_v2.scala conv/bn/sc/relu
+    triples)."""
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(n_in, n_out, k, k, stride, stride,
+                                       pad, pad, data_format="NHWC"))
+            .add(nn.SpatialBatchNormalization(n_out, 1e-3))
+            .add(nn.ReLU()))
+
+
+def inception_layer_v2(n_in, c1, c3, c3xx, pool_spec):
+    """One Inception-v2 (BN-Inception) block.
+
+    Reference: Inception_v2.scala ``Inception_Layer_v2.apply`` — four towers:
+    optional 1x1 (c1=0 drops it), 3x3 (stride 2 when the pool tower is a
+    stride-2 max pool with no projection), double-3x3, and a pool tower
+    (``("avg"|"max", proj)``; proj=0 means stride-2 pass-through, no conv).
+    """
+    pool_kind, pool_proj = pool_spec
+    downsample = pool_kind == "max" and pool_proj == 0
+    concat = nn.Concat(3)
+    if c1 != 0:
+        concat.add(_conv_bn(n_in, c1, 1))
+    c3r, c3o = c3
+    tower3 = nn.Sequential().add(_conv_bn(n_in, c3r, 1))
+    tower3.add(_conv_bn(c3r, c3o, 3, 2 if downsample else 1, 1))
+    concat.add(tower3)
+    cxr, cxo = c3xx
+    towerx = (nn.Sequential()
+              .add(_conv_bn(n_in, cxr, 1))
+              .add(_conv_bn(cxr, cxo, 3, 1, 1))
+              .add(_conv_bn(cxo, cxo, 3, 2 if downsample else 1, 1)))
+    concat.add(towerx)
+    pool = nn.Sequential()
+    if pool_kind == "max":
+        if pool_proj != 0:
+            pool.add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+        else:
+            pool.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    else:
+        pool.add(nn.SpatialAveragePooling(3, 3, 1, 1, 1, 1).ceil())
+    if pool_proj != 0:
+        pool.add(_conv_bn(n_in, pool_proj, 1))
+    concat.add(pool)
+    return concat
+
+
+def InceptionV2(class_num=1000):
+    """BN-Inception (Inception v2), input (N, 224, 224, 3).
+
+    Reference: Inception_v2.scala ``Inception_v2_NoAuxClassifier.apply``
+    (:186-227; the aux-classifier variant differs only in training heads).
+    """
+    return (
+        nn.Sequential()
+        .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
+                                   data_format="NHWC", name="conv1/7x7_s2"))
+        .add(nn.SpatialBatchNormalization(64, 1e-3))
+        .add(nn.ReLU())
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+        .add(_conv_bn(64, 64, 1))
+        .add(_conv_bn(64, 192, 3, 1, 1))
+        .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+        .add(inception_layer_v2(192, 64, (64, 64), (64, 96), ("avg", 32)))
+        .add(inception_layer_v2(256, 64, (64, 96), (64, 96), ("avg", 64)))
+        .add(inception_layer_v2(320, 0, (128, 160), (64, 96), ("max", 0)))
+        .add(inception_layer_v2(576, 224, (64, 96), (96, 128), ("avg", 128)))
+        .add(inception_layer_v2(576, 192, (96, 128), (96, 128), ("avg", 128)))
+        .add(inception_layer_v2(576, 160, (128, 160), (128, 160), ("avg", 96)))
+        .add(inception_layer_v2(576, 96, (128, 192), (160, 192), ("avg", 96)))
+        .add(inception_layer_v2(576, 0, (128, 192), (192, 256), ("max", 0)))
+        .add(inception_layer_v2(1024, 352, (192, 320), (160, 224), ("avg", 128)))
+        .add(inception_layer_v2(1024, 352, (192, 320), (192, 224), ("max", 128)))
+        .add(nn.GlobalAveragePooling2D())
+        .add(nn.Linear(1024, class_num, name="loss3/classifier"))
+        .add(nn.LogSoftMax())
+    )
+
+
 def InceptionV1NoAuxClassifier(class_num=1000):
     """Input (N, 224, 224, 3)
     (reference: Inception_v1_NoAuxClassifier.scala)."""

@@ -1,0 +1,241 @@
+"""CLI Train/Test entry points for the model zoo.
+
+The reference ships one scopt ``Train``/``Test`` main per model
+(models/lenet/Train.scala:35, models/inception/Train.scala,
+models/resnet/TrainCIFAR10.scala, models/autoencoder/Train.scala,
+models/rnn/Train.scala); this is the argparse equivalent as subcommands:
+
+    python -m bigdl_tpu.models.run lenet-train  -f <mnist-dir> -b 64
+    python -m bigdl_tpu.models.run lenet-test   -f <mnist-dir> --model lenet.bigdl
+    python -m bigdl_tpu.models.run vgg-train    -b 128 --dataset cifar-synth
+    python -m bigdl_tpu.models.run resnet-train -b 128 --depth 20
+    python -m bigdl_tpu.models.run autoencoder-train -f <mnist-dir>
+
+When no data folder is given a deterministic synthetic dataset is used so
+every main runs self-contained (the reference requires downloaded MNIST /
+CIFAR; synthetic keeps the path exercisable in CI).
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def _mnist(folder, n=2048):
+    from bigdl_tpu.dataset import mnist
+    if folder and os.path.exists(os.path.join(folder, "train-images-idx3-ubyte")):
+        return mnist.load_mnist(folder, train=True), mnist.load_mnist(folder, train=False)
+    x, y = mnist.synthetic_mnist(n)
+    return (x, y), (x[: n // 4], y[: n // 4])
+
+
+def _synthetic_images(n, h, w, c, classes, seed=11):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, size=n)
+    x = rng.normal(size=(n, h, w, c)).astype(np.float32)
+    # class-dependent mean shift so accuracy can move off chance
+    x += ((y[:, None, None, None] + 1) / classes).astype(np.float32)
+    return x, y
+
+
+def _to_dataset(x, y, batch):
+    from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+    return array_dataset(x, y) >> SampleToMiniBatch(batch)
+
+
+def _build_optimizer(args, model, train_ds, val_ds, criterion, method,
+                     val_methods):
+    import bigdl_tpu.nn as nn  # noqa: F401  (registers layers for load)
+    from bigdl_tpu.optim import Optimizer, Trigger
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()
+    opt = Optimizer(model=model, dataset=train_ds, criterion=criterion,
+                    optim_method=method,
+                    distributed=args.distributed)
+    opt.set_end_when(Trigger.max_epoch(args.max_epoch)
+                     if args.max_iteration is None
+                     else Trigger.max_iteration(args.max_iteration))
+    if val_ds is not None and val_methods:
+        opt.set_validation(Trigger.every_epoch(), val_ds, val_methods)
+    if args.checkpoint:
+        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    if args.summary_dir:
+        from bigdl_tpu.visualization import TrainSummary
+        opt.set_train_summary(TrainSummary(args.summary_dir, args.app_name))
+    return opt
+
+
+def _common_flags(p, default_epochs=5):
+    p.add_argument("-f", "--folder", default=None,
+                   help="data folder (synthetic data when absent)")
+    p.add_argument("-b", "--batchSize", type=int, default=64, dest="batch")
+    p.add_argument("--learningRate", type=float, default=0.05, dest="lr")
+    p.add_argument("--maxEpoch", type=int, default=default_epochs,
+                   dest="max_epoch")
+    p.add_argument("--maxIteration", type=int, default=None,
+                   dest="max_iteration")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--summaryDir", default=None, dest="summary_dir")
+    p.add_argument("--appName", default="bigdl_tpu", dest="app_name")
+    p.add_argument("--distributed", action="store_true",
+                   help="DistriOptimizer over the device mesh")
+    p.add_argument("--model", default=None,
+                   help="snapshot to load (resume / test)")
+    p.add_argument("--synthN", type=int, default=2048, dest="synth_n")
+
+
+def cmd_lenet_train(args):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.utils import serializer
+
+    (xtr, ytr), (xte, yte) = _mnist(args.folder, args.synth_n)
+    model = serializer.load_module(args.model) if args.model else LeNet5()
+    opt = _build_optimizer(
+        args, model, _to_dataset(xtr, ytr, args.batch),
+        _to_dataset(xte, yte, args.batch), nn.ClassNLLCriterion(),
+        optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0),
+        [optim.Top1Accuracy()])
+    opt.optimize()
+    if args.checkpoint:
+        serializer.save_module(model, os.path.join(args.checkpoint, "lenet.bigdl"))
+
+
+def cmd_lenet_test(args):
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim.local_optimizer import validate
+    from bigdl_tpu.utils import serializer
+
+    import jax
+
+    _, (xte, yte) = _mnist(args.folder, args.synth_n)
+    model = serializer.load_module(args.model) if args.model else LeNet5()
+    model.build(jax.ShapeDtypeStruct(xte[: args.batch].shape, xte.dtype))
+    results = validate(model, model.parameters()[0], model.state(),
+                       _to_dataset(xte, yte, args.batch),
+                       [optim.Top1Accuracy(), optim.Top5Accuracy()])
+    for r in results:
+        print(r)
+
+
+def cmd_vgg_train(args):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.vgg import VggForCifar10
+
+    x, y = _synthetic_images(args.synth_n, 32, 32, 3, 10)
+    model = VggForCifar10()
+    opt = _build_optimizer(
+        args, model, _to_dataset(x, y, args.batch),
+        _to_dataset(x[:256], y[:256], args.batch), nn.ClassNLLCriterion(),
+        optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0,
+                  weight_decay=5e-4),
+        [optim.Top1Accuracy()])
+    opt.optimize()
+
+
+def cmd_resnet_train(args):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.resnet import ResNetCifar
+
+    x, y = _synthetic_images(args.synth_n, 32, 32, 3, 10)
+    model = ResNetCifar(depth=args.depth)
+    opt = _build_optimizer(
+        args, model, _to_dataset(x, y, args.batch),
+        _to_dataset(x[:256], y[:256], args.batch),
+        nn.CrossEntropyCriterion(),
+        optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0,
+                  weight_decay=1e-4, nesterov=True),
+        [optim.Top1Accuracy()])
+    opt.optimize()
+
+
+def cmd_inception_train(args):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.inception import (InceptionV1NoAuxClassifier,
+                                            InceptionV2)
+
+    x, y = _synthetic_images(max(args.synth_n // 8, args.batch * 2),
+                             224, 224, 3, args.classes)
+    model = (InceptionV2(args.classes) if args.version == "v2"
+             else InceptionV1NoAuxClassifier(args.classes))
+    opt = _build_optimizer(
+        args, model, _to_dataset(x, y, args.batch), None,
+        nn.ClassNLLCriterion(),
+        optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0), [])
+    opt.optimize()
+
+
+def cmd_autoencoder_train(args):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+    from bigdl_tpu.models.rnn import Autoencoder
+
+    (xtr, _), _ = _mnist(args.folder, args.synth_n)
+    flat = xtr.reshape(len(xtr), -1)
+    ds = array_dataset(xtr, flat) >> SampleToMiniBatch(args.batch)
+    opt = _build_optimizer(args, Autoencoder(32), ds, None,
+                           nn.MSECriterion(),
+                           optim.Adam(learning_rate=args.lr), [])
+    opt.optimize()
+
+
+def cmd_rnn_train(args):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+
+    from bigdl_tpu.models.rnn import SimpleRNN
+
+    rng = np.random.default_rng(3)
+    vocab, seq = args.vocab, args.seq_len
+    tokens = rng.integers(0, vocab, size=(args.synth_n, seq + 1))
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    model = SimpleRNN(vocab, 40, vocab)
+    opt = _build_optimizer(
+        args, model, _to_dataset(x, y, args.batch), None,
+        nn.TimeDistributedCriterion(nn.ClassNLLCriterion()),
+        optim.SGD(learning_rate=args.lr), [])
+    opt.optimize()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="bigdl_tpu.models.run")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    specs = {
+        "lenet-train": (cmd_lenet_train, 5, []),
+        "lenet-test": (cmd_lenet_test, 1, []),
+        "vgg-train": (cmd_vgg_train, 2, []),
+        "resnet-train": (cmd_resnet_train, 2,
+                         [("--depth", dict(type=int, default=20))]),
+        "inception-train": (cmd_inception_train, 1,
+                            [("--version", dict(default="v1",
+                                                choices=["v1", "v2"])),
+                             ("--classes", dict(type=int, default=100))]),
+        "autoencoder-train": (cmd_autoencoder_train, 2, []),
+        "rnn-train": (cmd_rnn_train, 2,
+                      [("--vocab", dict(type=int, default=100)),
+                       ("--seq-len", dict(type=int, default=20,
+                                          dest="seq_len"))]),
+    }
+    for name, (fn, epochs, extra) in specs.items():
+        p = sub.add_parser(name)
+        _common_flags(p, default_epochs=epochs)
+        for flag, kw in extra:
+            p.add_argument(flag, **kw)
+        p.set_defaults(fn=fn)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

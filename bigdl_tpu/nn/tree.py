@@ -95,12 +95,40 @@ class BinaryTreeLSTM(Module):
             h = jnp.tanh(c)
         return c, h
 
+    @staticmethod
+    def _height_bound(trees, n_nodes):
+        """Sweep count needed for the fixed point = max tree height.
+
+        With concrete (non-traced) trees the exact height is computed on the
+        host, so the compose loop is O(N * height) instead of O(N^2); under a
+        tracer fall back to the safe N-sweeps bound (or set ``max_depth``).
+        """
+        import numpy as np
+        from jax.core import Tracer
+        if isinstance(trees, Tracer):
+            return n_nodes
+        t = np.asarray(trees)
+        left, right = t[..., 0], t[..., 1]
+        # height[i] = 1 for leaves; 1 + max(children) for internal; iterate
+        # to fixed point (bounded by true height)
+        height = np.ones(t.shape[:2], np.int64)
+        for _ in range(n_nodes):
+            lh = np.where(left > 0, np.take_along_axis(
+                height, np.maximum(left - 1, 0), axis=1), 0)
+            rh = np.where(right > 0, np.take_along_axis(
+                height, np.maximum(right - 1, 0), axis=1), 0)
+            new = np.where(left > 0, 1 + np.maximum(lh, rh), 1)
+            if (new == height).all():
+                break
+            height = new
+        return max(int(height.max()), 1)
+
     def apply(self, params, state, input, *, training=False, rng=None):
         emb, trees = input
         trees = trees.astype(jnp.int32)
         b, n_nodes = trees.shape[0], trees.shape[1]
         h_dim = self.hidden_size
-        depth = self.max_depth or n_nodes
+        depth = self.max_depth or self._height_bound(trees, n_nodes)
 
         left = trees[..., 0]                       # (B, N) 1-based, 0 = none
         right = trees[..., 1]

@@ -50,7 +50,7 @@ def line_search_wolfe(feval, x, t, d, f0, g0, gtd0,
                        gtd_prev, gtd_new)
             break
         t_prev, f_prev, g_prev, gtd_prev = t, f_new, g_new, gtd_new
-        t = min(10.0 * t, 2.0 * t + t)  # expand
+        t = 3.0 * t  # geometric expansion (reference lswolfe caps in [2t, 10t])
 
     if bracket is None:
         # expansion exhausted: (f_new, g_new) belong to the LAST evaluated
@@ -113,9 +113,6 @@ class LBFGS:
         self.learning_rate = learning_rate
         self.line_search = line_search
         self._state = None
-
-    def init_state(self, params):
-        return {"neval": jnp.zeros((), jnp.int32)}
 
     def clear_history(self):
         """Drop curvature history (call before optimizing a new objective)."""

@@ -1,0 +1,41 @@
+"""AlexNet / Inception-v2 and the CLI Train mains (models/run.py, perf.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from bigdl_tpu.models.alexnet import AlexNet, AlexNetOWT
+from bigdl_tpu.models.inception import InceptionV2
+
+
+class TestAlexNet:
+    def test_alexnet_grouped_forward(self):
+        # original AlexNet: grouped conv2/4/5, LRN; input 227
+        y = AlexNet(10, has_dropout=False).forward(jnp.zeros((1, 227, 227, 3)))
+        assert y.shape == (1, 10)
+
+    def test_alexnet_owt_param_count(self):
+        import jax
+        m = AlexNetOWT(1000, has_dropout=False)
+        m.build(jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32))
+        n = sum(p.size for p in jax.tree.leaves(m.parameters()[0]))
+        # torchvision alexnet (OWT): 61.1M params
+        assert abs(n - 61.1e6) / 61.1e6 < 0.01, n
+
+
+class TestInceptionV2:
+    def test_forward_shape(self):
+        y = InceptionV2(7).forward(jnp.zeros((1, 224, 224, 3)))
+        assert y.shape == (1, 7)
+
+
+class TestCliMains:
+    def test_lenet_train_and_test_main(self, tmp_path):
+        from bigdl_tpu.models import run
+        run.main(["lenet-train", "--synthN", "128", "-b", "32",
+                  "--maxIteration", "2"])
+        run.main(["lenet-test", "--synthN", "128", "-b", "32"])
+
+    def test_perf_driver(self):
+        from bigdl_tpu.models import perf
+        rate = perf.run_perf("lenet", batch=16, iterations=2)
+        assert rate > 0
