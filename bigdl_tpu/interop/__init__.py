@@ -1,0 +1,7 @@
+"""Interop layer: BigDL protobuf model format, Caffe, TensorFlow GraphDef.
+
+Reference: utils/serializer/ (bigdl.proto), utils/caffe/, utils/tf/
+(SURVEY.md section 2.6).
+"""
+
+from bigdl_tpu.interop import bigdl_pb2  # noqa: F401

@@ -1,0 +1,556 @@
+"""BigDL protobuf model format: save/load `bigdl_tpu` modules wire-compatibly.
+
+Reference: utils/serializer/ModuleSerializer.scala:34 (registry),
+ModuleLoader.scala:37,219 (load / save with optional separate weight file),
+schema spark/dl/src/main/resources/serialization/bigdl.proto.
+
+Design: each supported layer has a converter pair
+``to_attrs(module) -> (attrs, params)`` / ``from_attrs(attrs, params)``
+registered under the reference's fully-qualified Scala class name, so
+``moduleType`` and the attribute names match what the reference's
+reflection-based serializer emits (constructor parameter names).  Weight
+layouts are converted between our TPU-native layouts (Linear (out, in) --
+same as the reference -- and conv HWIO) and the reference's
+``(nGroup, out/g, in/g, kH, kW)`` conv layout.
+
+Storage dedup: every distinct ndarray gets one ``TensorStorage`` id; the
+loader caches by id (reference: BigDLTensor.id / TensorStorage.id sharing).
+"""
+
+import os
+
+import numpy as np
+
+from bigdl_tpu.interop import bigdl_pb2 as pb
+
+_NN = "com.intel.analytics.bigdl.nn."
+_TPU = "bigdl_tpu.nn."
+
+
+# --------------------------------------------------------------------------- #
+# tensor <-> proto
+# --------------------------------------------------------------------------- #
+
+
+class _Ctx:
+    """Per-file storage-id space (storage dedup)."""
+
+    def __init__(self):
+        self.next_id = 1
+        self.by_obj = {}     # id(ndarray) -> storage id  (save)
+        self.by_id = {}      # storage id -> ndarray      (load)
+
+
+def _contiguous_strides(shape):
+    strides, acc = [], 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    return list(reversed(strides))
+
+
+def _encode_tensor(arr, ctx: _Ctx, msg=None):
+    arr = np.ascontiguousarray(arr)
+    t = msg if msg is not None else pb.BigDLTensor()
+    t.datatype = pb.FLOAT if arr.dtype != np.float64 else pb.DOUBLE
+    t.size.extend(int(s) for s in arr.shape)
+    t.stride.extend(_contiguous_strides(arr.shape))
+    t.offset = 0
+    t.dimension = arr.ndim
+    t.nElements = int(arr.size)
+    t.isScalar = arr.ndim == 0
+    t.id = ctx.next_id
+    ctx.next_id += 1
+    t.storage.datatype = t.datatype
+    t.storage.id = t.id
+    flat = arr.astype(np.float64 if t.datatype == pb.DOUBLE else np.float32
+                      ).ravel()
+    if t.datatype == pb.DOUBLE:
+        t.storage.double_data.extend(flat.tolist())
+    else:
+        t.storage.float_data.extend(flat.tolist())
+    return t
+
+
+def _decode_tensor(t, ctx: _Ctx):
+    if t.storage.float_data:
+        data = np.asarray(t.storage.float_data, np.float32)
+    elif t.storage.double_data:
+        data = np.asarray(t.storage.double_data, np.float64)
+    elif t.storage.int_data:
+        data = np.asarray(t.storage.int_data, np.int32)
+    elif t.storage.id in ctx.by_id:
+        data = ctx.by_id[t.storage.id]
+    else:
+        data = np.zeros(max(t.nElements, 0), np.float32)
+    if t.storage.id:
+        ctx.by_id[t.storage.id] = data
+    shape = tuple(t.size)
+    n = int(np.prod(shape)) if shape else 1
+    off = t.offset if data.size >= n + t.offset else 0
+    return data[off:off + n].reshape(shape)
+
+
+# --------------------------------------------------------------------------- #
+# attr helpers
+# --------------------------------------------------------------------------- #
+
+
+def _set_attr(attrs, key, value, ctx):
+    a = attrs[key]
+    if isinstance(value, bool):
+        a.dataType = pb.BOOL
+        a.boolValue = value
+    elif isinstance(value, (int, np.integer)):
+        a.dataType = pb.INT32
+        a.int32Value = int(value)
+    elif isinstance(value, (float, np.floating)):
+        a.dataType = pb.DOUBLE
+        a.doubleValue = float(value)
+    elif isinstance(value, str):
+        a.dataType = pb.STRING
+        a.stringValue = value
+    elif isinstance(value, np.ndarray):
+        a.dataType = pb.TENSOR
+        _encode_tensor(value, ctx, a.tensorValue)
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, (int, np.integer)) for v in value):
+        a.dataType = pb.ARRAY_VALUE
+        a.arrayValue.datatype = pb.INT32
+        a.arrayValue.size = len(value)
+        a.arrayValue.i32.extend(int(v) for v in value)
+    else:
+        raise TypeError(f"unsupported attr {key}: {type(value)}")
+
+
+def _get_attr(mod_pb, key, default=None, ctx=None):
+    if key not in mod_pb.attr:
+        return default
+    a = mod_pb.attr[key]
+    which = a.WhichOneof("value")
+    if which is None:
+        return default
+    v = getattr(a, which)
+    if which == "tensorValue":
+        return _decode_tensor(v, ctx or _Ctx())
+    if which == "arrayValue":
+        return list(v.i32) or list(v.i64) or list(v.flt) or list(v.dbl)
+    return v
+
+
+# --------------------------------------------------------------------------- #
+# layer converters
+# --------------------------------------------------------------------------- #
+
+_SAVERS = {}    # our class name -> (module_type, to_attrs)
+_LOADERS = {}   # module_type   -> from_pb
+
+
+def _register(our_name, module_type, to_attrs, from_attrs):
+    _SAVERS[our_name] = (module_type, to_attrs)
+    _LOADERS[module_type] = from_attrs
+
+
+def _conv_weight_to_bigdl(m, w):
+    """HWIO (kh, kw, in/g, out) -> (nGroup, out/g, in/g, kH, kW)."""
+    kh, kw = m.kernel
+    g = m.n_group
+    cin_g = m.n_input_plane // g
+    out_g = m.n_output_plane // g
+    return (w.reshape(kh, kw, cin_g, g, out_g)
+            .transpose(3, 4, 2, 0, 1))
+
+
+def _conv_weight_from_bigdl(w, kh, kw, cin_g, g, out_g):
+    return (w.reshape(g, out_g, cin_g, kh, kw)
+            .transpose(3, 4, 2, 0, 1).reshape(kh, kw, cin_g, g * out_g))
+
+
+def _save_linear(m, p):
+    return ({"inputSize": m.input_size, "outputSize": m.output_size,
+             "withBias": m.with_bias},
+            [np.asarray(p["weight"])]
+            + ([np.asarray(p["bias"])] if m.with_bias else []))
+
+
+def _load_linear(attrs, params, ctx):
+    import bigdl_tpu.nn as nn
+    m = nn.Linear(attrs("inputSize"), attrs("outputSize"),
+                  with_bias=attrs("withBias", True))
+    pt = {"weight": params[0]}
+    if attrs("withBias", True) and len(params) > 1:
+        pt["bias"] = params[1]
+    return m, pt
+
+
+def _save_conv(m, p):
+    attrs = {"nInputPlane": m.n_input_plane, "nOutputPlane": m.n_output_plane,
+             "kernelW": m.kernel[1], "kernelH": m.kernel[0],
+             "strideW": m.stride[1], "strideH": m.stride[0],
+             "padW": m.pad[1], "padH": m.pad[0], "nGroup": m.n_group,
+             "withBias": m.with_bias}
+    params = [_conv_weight_to_bigdl(m, np.asarray(p["weight"]))]
+    if m.with_bias:
+        params.append(np.asarray(p["bias"]))
+    return attrs, params
+
+
+def _load_conv(attrs, params, ctx):
+    import bigdl_tpu.nn as nn
+    g = attrs("nGroup", 1)
+    cin, cout = attrs("nInputPlane"), attrs("nOutputPlane")
+    kh, kw = attrs("kernelH"), attrs("kernelW")
+    m = nn.SpatialConvolution(
+        cin, cout, kw, kh, attrs("strideW", 1), attrs("strideH", 1),
+        attrs("padW", 0), attrs("padH", 0), n_group=g,
+        with_bias=attrs("withBias", True))
+    w = _conv_weight_from_bigdl(params[0], kh, kw, cin // g, g, cout // g)
+    pt = {"weight": w}
+    if attrs("withBias", True) and len(params) > 1:
+        pt["bias"] = params[1]
+    return m, pt
+
+
+def _save_pool(m, p):
+    return ({"kW": m.kernel[1], "kH": m.kernel[0],
+             "dW": m.stride[1], "dH": m.stride[0],
+             "padW": m.pad[1], "padH": m.pad[0],
+             "ceilMode": bool(getattr(m, "ceil_mode", False))}, [])
+
+
+def _make_pool_loader(cls_name):
+    def load(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        m = getattr(nn, cls_name)(
+            attrs("kW"), attrs("kH"), attrs("dW", 1), attrs("dH", 1),
+            attrs("padW", 0), attrs("padH", 0))
+        if attrs("ceilMode", False):
+            m.ceil()
+        return m, {}
+    return load
+
+
+def _save_bn(m, p):
+    attrs = {"nOutput": m.n_output, "eps": m.eps, "momentum": m.momentum,
+             "affine": m.affine}
+    params = ([np.asarray(p["weight"]), np.asarray(p["bias"])]
+              if m.affine else [])
+    return attrs, params
+
+
+def _make_bn_loader(cls_name):
+    def load(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        m = getattr(nn, cls_name)(attrs("nOutput"), attrs("eps", 1e-5),
+                                  attrs("momentum", 0.1),
+                                  affine=attrs("affine", True))
+        pt = {}
+        if attrs("affine", True) and len(params) >= 2:
+            pt = {"weight": params[0], "bias": params[1]}
+        return m, pt
+    return load
+
+
+def _save_lookup(m, p):
+    return ({"nIndex": m.n_index, "nOutput": m.n_output},
+            [np.asarray(p["weight"])])
+
+
+def _load_lookup(attrs, params, ctx):
+    import bigdl_tpu.nn as nn
+    return nn.LookupTable(attrs("nIndex"), attrs("nOutput")), \
+        {"weight": params[0]}
+
+
+def _noarg(cls_name):
+    def save(m, p):
+        return {}, []
+
+    def load(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        return getattr(nn, cls_name)(), {}
+    return save, load
+
+
+def _register_all():
+    for name in ["ReLU", "Tanh", "Sigmoid", "LogSoftMax", "SoftMax",
+                 "ReLU6", "ELU", "SoftPlus", "SoftSign", "Abs", "Exp",
+                 "Square", "Sqrt", "Identity", "FlattenTable", "GELU",
+                 "SiLU"]:
+        save, load = _noarg(name)
+        _register(name, _NN + name, save, load)
+
+    _register("Linear", _NN + "Linear", _save_linear, _load_linear)
+    _register("SpatialConvolution", _NN + "SpatialConvolution",
+              _save_conv, _load_conv)
+    _register("SpatialMaxPooling", _NN + "SpatialMaxPooling", _save_pool,
+              _make_pool_loader("SpatialMaxPooling"))
+    _register("SpatialAveragePooling", _NN + "SpatialAveragePooling",
+              _save_pool, _make_pool_loader("SpatialAveragePooling"))
+    _register("BatchNormalization", _NN + "BatchNormalization", _save_bn,
+              _make_bn_loader("BatchNormalization"))
+    _register("SpatialBatchNormalization", _NN + "SpatialBatchNormalization",
+              _save_bn, _make_bn_loader("SpatialBatchNormalization"))
+    _register("LookupTable", _NN + "LookupTable", _save_lookup, _load_lookup)
+
+    def save_dropout(m, p):
+        return {"initP": m.p}, []
+
+    def load_dropout(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        return nn.Dropout(attrs("initP", 0.5)), {}
+    _register("Dropout", _NN + "Dropout", save_dropout, load_dropout)
+
+    def save_lrn(m, p):
+        return {"size": m.size, "alpha": m.alpha, "beta": m.beta, "k": m.k}, []
+
+    def load_lrn(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        return nn.SpatialCrossMapLRN(attrs("size", 5), attrs("alpha", 1.0),
+                                     attrs("beta", 0.75), attrs("k", 1.0)), {}
+    _register("SpatialCrossMapLRN", _NN + "SpatialCrossMapLRN",
+              save_lrn, load_lrn)
+
+    def save_reshape(m, p):
+        return {"size": list(m.size)}, []
+
+    def load_reshape(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        return nn.Reshape(tuple(attrs("size"))), {}
+    _register("Reshape", _NN + "Reshape", save_reshape, load_reshape)
+
+    def save_flatten(m, p):
+        return {}, []
+
+    def load_flatten(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        return nn.Flatten(), {}
+    _register("Flatten", _TPU + "Flatten", save_flatten, load_flatten)
+
+    def save_cadd(m, p):
+        return {}, []
+
+    def load_cadd(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        return nn.CAddTable(), {}
+    _register("CAddTable", _NN + "CAddTable", save_cadd, load_cadd)
+
+    def save_join(m, p):
+        return {"dimension": m.dimension + 1}, []   # reference is 1-based
+
+    def load_join(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        return nn.JoinTable(attrs("dimension", 1) - 1), {}
+    _register("JoinTable", _NN + "JoinTable", save_join, load_join)
+
+
+_register_all()
+
+
+# --------------------------------------------------------------------------- #
+# module tree <-> BigDLModule
+# --------------------------------------------------------------------------- #
+
+
+def _module_to_pb(module, params, state, ctx: _Ctx):
+    """params/state are THIS module's subtrees (root owns the full tree)."""
+    import bigdl_tpu.nn as nn
+
+    msg = pb.BigDLModule()
+    msg.name = module.name or type(module).__name__
+    msg.version = "0.8.0"
+    msg.train = bool(getattr(module, "train_mode", True))
+
+    cls = type(module).__name__
+    params = params if isinstance(params, dict) else {}
+    state = state if isinstance(state, dict) else {}
+    if isinstance(module, (nn.Sequential, nn.Concat)):
+        msg.moduleType = _NN + cls
+        if isinstance(module, nn.Concat):
+            _set_attr(msg.attr, "dimension", module.dimension + 1, ctx)
+        for i, child in enumerate(module.modules):
+            msg.subModules.append(_module_to_pb(
+                child, params.get(str(i), {}), state.get(str(i), {}), ctx))
+    elif cls in _SAVERS:
+        module_type, to_attrs = _SAVERS[cls]
+        msg.moduleType = module_type
+        attrs, plist = to_attrs(module, params)
+        for k, v in attrs.items():
+            _set_attr(msg.attr, k, v, ctx)
+        if plist:
+            msg.hasParameters = True
+            for arr in plist:
+                _encode_tensor(arr, ctx, msg.parameters.add())
+        # BN running stats ride as attrs (reference: BatchNormalization's
+        # own serializer stores runningMean/runningStd)
+        if "running_mean" in state:
+            _set_attr(msg.attr, "runningMean",
+                      np.asarray(state["running_mean"]), ctx)
+            _set_attr(msg.attr, "runningVar",
+                      np.asarray(state["running_var"]), ctx)
+    else:
+        raise NotImplementedError(
+            f"{cls} has no BigDL-format converter; use "
+            f"bigdl_tpu.utils.serializer for the native format")
+    return msg
+
+
+def _module_from_pb(msg, ctx: _Ctx, path, installs):
+    """-> module; appends (path, key, array, is_state) weight installs."""
+    import bigdl_tpu.nn as nn
+
+    mt = msg.moduleType
+    short = mt.rsplit(".", 1)[-1]
+    if short in ("Sequential", "Concat"):
+        if short == "Concat":
+            node = nn.Concat(_get_attr(msg, "dimension", 1, ctx) - 1)
+        else:
+            node = nn.Sequential()
+        node.name = msg.name or node.name
+        for i, sub in enumerate(msg.subModules):
+            node.add(_module_from_pb(sub, ctx, path + (str(i),), installs))
+        return node
+    if mt not in _LOADERS:
+        raise NotImplementedError(f"no loader for module type {mt}")
+
+    params = [_decode_tensor(t, ctx) for t in msg.parameters]
+    if not params and msg.HasField("weight"):
+        params.append(_decode_tensor(msg.weight, ctx))
+        if msg.HasField("bias"):
+            params.append(_decode_tensor(msg.bias, ctx))
+
+    def attrs(key, default=None):
+        return _get_attr(msg, key, default, ctx)
+
+    m, ptree = _LOADERS[mt](attrs, params, ctx)
+    if msg.name:
+        m.name = msg.name
+    for k, v in (ptree or {}).items():
+        installs.append((path, k, np.asarray(v, np.float32), False))
+    rm = _get_attr(msg, "runningMean", None, ctx)
+    if rm is not None:
+        installs.append((path, "running_mean",
+                         np.asarray(rm, np.float32), True))
+        installs.append((path, "running_var",
+                         np.asarray(_get_attr(msg, "runningVar", None, ctx),
+                                    np.float32), True))
+    return m
+
+
+def _install(module, installs):
+    """Overwrite built params/state leaves with deserialized values."""
+    import jax.numpy as jnp
+    for path, key, value, is_state in installs:
+        node = module._state if is_state else module._params
+        for p in path:
+            node = node[p]
+        if key not in node:
+            raise KeyError(
+                f"deserialized weight {'/'.join(path)}/{key} has no slot in "
+                f"the built module")
+        if tuple(node[key].shape) != tuple(value.shape):
+            raise ValueError(
+                f"shape mismatch at {'/'.join(path)}/{key}: file "
+                f"{value.shape} vs module {tuple(node[key].shape)}")
+        node[key] = jnp.asarray(value)
+
+
+def _strip_storages(msg, store):
+    """Move storage payloads out of the proto into ``store`` (npz dict)."""
+    for t in list(msg.parameters):
+        if t.storage.float_data or t.storage.double_data:
+            store[str(t.storage.id)] = (
+                np.asarray(t.storage.float_data, np.float32)
+                if t.storage.float_data
+                else np.asarray(t.storage.double_data, np.float64))
+            t.storage.ClearField("float_data")
+            t.storage.ClearField("double_data")
+    for a in msg.attr.values():
+        if a.WhichOneof("value") == "tensorValue":
+            t = a.tensorValue
+            if t.storage.float_data or t.storage.double_data:
+                store[str(t.storage.id)] = (
+                    np.asarray(t.storage.float_data, np.float32)
+                    if t.storage.float_data
+                    else np.asarray(t.storage.double_data, np.float64))
+                t.storage.ClearField("float_data")
+                t.storage.ClearField("double_data")
+    for sub in msg.subModules:
+        _strip_storages(sub, store)
+
+
+def _restore_storages(msg, store):
+    for t in list(msg.parameters):
+        key = str(t.storage.id)
+        if key in store and not (t.storage.float_data
+                                 or t.storage.double_data):
+            arr = store[key]
+            if arr.dtype == np.float64:
+                t.storage.double_data.extend(arr.tolist())
+            else:
+                t.storage.float_data.extend(arr.tolist())
+    for a in msg.attr.values():
+        if a.WhichOneof("value") == "tensorValue":
+            key = str(a.tensorValue.storage.id)
+            if key in store:
+                a.tensorValue.storage.float_data.extend(
+                    store[key].astype(np.float32).tolist())
+    for sub in msg.subModules:
+        _restore_storages(sub, store)
+
+
+def save_bigdl(module, path, overwrite=True, weight_path=None):
+    """ModulePersister.saveToFile equivalent (protobuf BigDLModule file).
+
+    ``weight_path``: big-model support — tensor storages go to a separate
+    npz keyed by storage id and the proto keeps only metadata (reference:
+    ModuleLoader.scala:219 saveToFile(definitionPath, weightPath)).
+    """
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(path)
+    ctx = _Ctx()
+    msg = _module_to_pb(module, module._params or {}, module._state or {},
+                        ctx)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    if weight_path is not None:
+        store = {}
+        _strip_storages(msg, store)
+        np.savez(weight_path, **store)
+    with open(path, "wb") as f:
+        f.write(msg.SerializeToString())
+
+
+def load_bigdl(path, input_spec=None, weight_path=None):
+    """ModuleLoader.loadFromFile equivalent.
+
+    Returns the module; when ``input_spec`` (a jax.ShapeDtypeStruct or an
+    example array) is given the module is built immediately and the stored
+    weights installed; otherwise they install at the module's first build
+    (triggered by ``forward``).
+    """
+    msg = pb.BigDLModule()
+    with open(path, "rb") as f:
+        msg.ParseFromString(f.read())
+    if weight_path is not None:
+        store = dict(np.load(weight_path))
+        _restore_storages(msg, store)
+    ctx = _Ctx()
+    installs = []
+    module = _module_from_pb(msg, ctx, (), installs)
+
+    orig_build = module.build
+
+    def build_and_install(spec, rng=None):
+        out = orig_build(spec, rng=rng)
+        _install(module, installs)
+        return out
+    module.build = build_and_install
+
+    if input_spec is not None:
+        import jax
+        if not isinstance(input_spec, jax.ShapeDtypeStruct):
+            arr = np.asarray(input_spec)
+            input_spec = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+        module.build(input_spec)
+    return module

@@ -25,10 +25,17 @@ import numpy as np
 
 def _mnist(folder, n=2048):
     from bigdl_tpu.dataset import mnist
-    if folder and os.path.exists(os.path.join(folder, "train-images-idx3-ubyte")):
-        return mnist.load_mnist(folder, train=True), mnist.load_mnist(folder, train=False)
+    if folder:
+        base = os.path.join(folder, "train-images-idx3-ubyte")
+        if os.path.exists(base) or os.path.exists(base + ".gz"):
+            return (mnist.load_mnist(folder, train=True),
+                    mnist.load_mnist(folder, train=False))
+        print(f"[warn] no MNIST idx files under {folder}; "
+              "falling back to synthetic data")
     x, y = mnist.synthetic_mnist(n)
-    return (x, y), (x[: n // 4], y[: n // 4])
+    # held-out tail as the synthetic "test" split
+    k = n - n // 4
+    return (x[:k], y[:k]), (x[k:], y[k:])
 
 
 def _synthetic_images(n, h, w, c, classes, seed=11):
@@ -131,8 +138,8 @@ def cmd_vgg_train(args):
     x, y = _synthetic_images(args.synth_n, 32, 32, 3, 10)
     model = VggForCifar10()
     opt = _build_optimizer(
-        args, model, _to_dataset(x, y, args.batch),
-        _to_dataset(x[:256], y[:256], args.batch), nn.ClassNLLCriterion(),
+        args, model, _to_dataset(x[:-256], y[:-256], args.batch),
+        _to_dataset(x[-256:], y[-256:], args.batch), nn.ClassNLLCriterion(),
         optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0,
                   weight_decay=5e-4),
         [optim.Top1Accuracy()])
@@ -147,8 +154,8 @@ def cmd_resnet_train(args):
     x, y = _synthetic_images(args.synth_n, 32, 32, 3, 10)
     model = ResNetCifar(depth=args.depth)
     opt = _build_optimizer(
-        args, model, _to_dataset(x, y, args.batch),
-        _to_dataset(x[:256], y[:256], args.batch),
+        args, model, _to_dataset(x[:-256], y[:-256], args.batch),
+        _to_dataset(x[-256:], y[-256:], args.batch),
         nn.CrossEntropyCriterion(),
         optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0,
                   weight_decay=1e-4, nesterov=True),
