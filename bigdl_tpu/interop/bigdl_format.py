@@ -39,6 +39,7 @@ class _Ctx:
         self.next_id = 1
         self.by_obj = {}     # id(ndarray) -> storage id  (save)
         self.by_id = {}      # storage id -> ndarray      (load)
+        self.keep = []       # keeps saved arrays alive so id() stays unique
 
 
 def _contiguous_strides(shape):
@@ -50,6 +51,7 @@ def _contiguous_strides(shape):
 
 
 def _encode_tensor(arr, ctx: _Ctx, msg=None):
+    orig = arr
     arr = np.ascontiguousarray(arr)
     t = msg if msg is not None else pb.BigDLTensor()
     t.datatype = pb.FLOAT if arr.dtype != np.float64 else pb.DOUBLE
@@ -59,10 +61,21 @@ def _encode_tensor(arr, ctx: _Ctx, msg=None):
     t.dimension = arr.ndim
     t.nElements = int(arr.size)
     t.isScalar = arr.ndim == 0
+    shared = ctx.by_obj.get(id(orig))
+    if shared is not None:
+        # storage dedup: shared ndarray -> one payload, later tensors
+        # reference it by id only (reference: TensorStorage.id sharing)
+        t.id = ctx.next_id
+        ctx.next_id += 1
+        t.storage.datatype = t.datatype
+        t.storage.id = shared
+        return t
     t.id = ctx.next_id
     ctx.next_id += 1
     t.storage.datatype = t.datatype
     t.storage.id = t.id
+    ctx.by_obj[id(orig)] = t.id
+    ctx.keep.append(orig)
     flat = arr.astype(np.float64 if t.datatype == pb.DOUBLE else np.float32
                       ).ravel()
     if t.datatype == pb.DOUBLE:
@@ -81,8 +94,12 @@ def _decode_tensor(t, ctx: _Ctx):
         data = np.asarray(t.storage.int_data, np.int32)
     elif t.storage.id in ctx.by_id:
         data = ctx.by_id[t.storage.id]
+    elif t.nElements > 0:
+        raise ValueError(
+            f"tensor storage {t.storage.id} has no payload -- was this "
+            f"model saved with a separate weight file?  Pass weight_path=")
     else:
-        data = np.zeros(max(t.nElements, 0), np.float32)
+        data = np.zeros(0, np.float32)
     if t.storage.id:
         ctx.by_id[t.storage.id] = data
     shape = tuple(t.size)
@@ -382,7 +399,8 @@ def _module_to_pb(module, params, state, ctx: _Ctx):
             for arr in plist:
                 _encode_tensor(arr, ctx, msg.parameters.add())
         # BN running stats ride as attrs (reference: BatchNormalization's
-        # own serializer stores runningMean/runningStd)
+        # own serializer stores runningMean/runningVar attrs,
+        # BatchNormalization.scala:430-436)
         if "running_mean" in state:
             _set_attr(msg.attr, "runningMean",
                       np.asarray(state["running_mean"]), ctx)
@@ -508,6 +526,10 @@ def save_bigdl(module, path, overwrite=True, weight_path=None):
     """
     if os.path.exists(path) and not overwrite:
         raise FileExistsError(path)
+    if not module.is_built():
+        raise RuntimeError(
+            "module has no parameters yet -- call build()/forward() before "
+            "save_bigdl (reference models are always materialised)")
     ctx = _Ctx()
     msg = _module_to_pb(module, module._params or {}, module._state or {},
                         ctx)
@@ -516,6 +538,8 @@ def save_bigdl(module, path, overwrite=True, weight_path=None):
     if weight_path is not None:
         store = {}
         _strip_storages(msg, store)
+        if not weight_path.endswith(".npz"):
+            weight_path += ".npz"   # np.savez appends it anyway
         np.savez(weight_path, **store)
     with open(path, "wb") as f:
         f.write(msg.SerializeToString())
@@ -533,6 +557,8 @@ def load_bigdl(path, input_spec=None, weight_path=None):
     with open(path, "rb") as f:
         msg.ParseFromString(f.read())
     if weight_path is not None:
+        if not weight_path.endswith(".npz") and not os.path.exists(weight_path):
+            weight_path += ".npz"
         store = dict(np.load(weight_path))
         _restore_storages(msg, store)
     ctx = _Ctx()

@@ -1,0 +1,544 @@
+"""Caffe model import/export.
+
+Reference: utils/caffe/CaffeLoader.scala:57,531-561 (``load`` copies weights
+into an existing net; ``loadCaffe`` builds the graph from the prototxt),
+utils/caffe/Converter.scala / V1LayerConverter.scala (~50 layer-type
+mappings), utils/caffe/CaffePersister.scala (export).
+
+TPU-native notes: Caffe is NCHW; our convs/pools run NHWC.  Weights are
+transposed at import ((out, in/g, kH, kW) -> HWIO) and an NCHW-ordered
+flatten is inserted before InnerProduct layers so fully-connected weights
+copy verbatim.
+"""
+
+import warnings
+
+import numpy as np
+
+from bigdl_tpu.interop import caffe_pb2
+from google.protobuf import text_format
+
+
+class _FlattenNCHW:
+    """Flatten a NHWC activation in caffe's (C,H,W) feature order so
+    imported InnerProduct weights apply unchanged."""
+
+    def __new__(cls):
+        from bigdl_tpu.nn.module import Module
+
+        class FlattenNCHW(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                import jax.numpy as jnp
+                if input.ndim == 4:
+                    input = jnp.transpose(input, (0, 3, 1, 2))
+                return input.reshape(input.shape[0], -1), state
+
+        return FlattenNCHW()
+
+
+def _read_net(path, binary):
+    net = caffe_pb2.NetParameter()
+    if binary:
+        with open(path, "rb") as f:
+            net.ParseFromString(f.read())
+    else:
+        with open(path) as f:
+            text_format.Parse(f.read(), net, allow_unknown_field=True)
+    return net
+
+
+_V1_TYPE_NAMES = {
+    v: k for k, v in caffe_pb2.V1LayerParameter.LayerType.items()
+}
+
+_V1_TO_NEW = {
+    "CONVOLUTION": "Convolution", "INNER_PRODUCT": "InnerProduct",
+    "POOLING": "Pooling", "RELU": "ReLU", "TANH": "TanH",
+    "SIGMOID": "Sigmoid", "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "SoftmaxWithLoss", "LRN": "LRN", "DROPOUT": "Dropout",
+    "CONCAT": "Concat", "ELTWISE": "Eltwise", "FLATTEN": "Flatten",
+    "SPLIT": "Split", "SLICE": "Slice", "POWER": "Power",
+    "THRESHOLD": "Threshold", "ABSVAL": "AbsVal", "EXP": "Exp",
+    "BNLL": "BNLL", "DATA": "Data", "DECONVOLUTION": "Deconvolution",
+}
+
+
+def _layers(net):
+    """Normalized (name, type_str, bottoms, tops, layer_pb) across the new
+    ``layer`` and legacy ``layers`` (V1) fields."""
+    out = []
+    for l in net.layer:
+        out.append((l.name, l.type, list(l.bottom), list(l.top), l))
+    for l in net.layers:
+        tname = _V1_TYPE_NAMES.get(l.type, str(l.type))
+        out.append((l.name, _V1_TO_NEW.get(tname, tname),
+                    list(l.bottom), list(l.top), l))
+    return out
+
+
+_DATA_TYPES = {"Data", "ImageData", "HDF5Data", "MemoryData", "WindowData",
+               "DummyData", "Input"}
+_LOSS_TYPES = {"SoftmaxWithLoss", "EuclideanLoss", "HingeLoss",
+               "SigmoidCrossEntropyLoss", "InfogainLoss", "ContrastiveLoss",
+               "MultinomialLogisticLoss", "Accuracy", "Silence"}
+
+
+def _hw(param, field, default=None):
+    """kernel/stride/pad may be repeated, _h/_w, or absent."""
+    raw = getattr(param, field)
+    # conv params are repeated; pooling params are scalar
+    rep = list(raw) if hasattr(raw, "__len__") else ([int(raw)] if raw else [])
+    base = field[:-5] if field.endswith("_size") else field  # kernel_size -> kernel_h
+    h = getattr(param, base + "_h", 0)
+    w = getattr(param, base + "_w", 0)
+    if h or w:
+        return int(h), int(w)
+    if rep:
+        return (int(rep[0]), int(rep[0])) if len(rep) == 1 \
+            else (int(rep[0]), int(rep[1]))
+    return default
+
+
+def _build_module(type_str, lpb, in_channels, customized):
+    """caffe layer -> (module, out_channels) (reference: Converter.scala
+    per-type ``toCaffe*`` mappings)."""
+    import bigdl_tpu.nn as nn
+
+    if type_str == "Convolution":
+        p = lpb.convolution_param
+        kh, kw = _hw(p, "kernel_size")
+        sh, sw = _hw(p, "stride", (1, 1))
+        ph, pw = _hw(p, "pad", (0, 0))
+        dil = list(p.dilation)
+        dh = dw = int(dil[0]) if dil else 1
+        nout = int(p.num_output)
+        m = nn.SpatialConvolution(
+            in_channels, nout, kw, kh, sw, sh, pw, ph,
+            n_group=int(p.group), dilation_w=dw, dilation_h=dh,
+            with_bias=bool(p.bias_term))
+        return m, nout
+    if type_str == "InnerProduct":
+        p = lpb.inner_product_param
+        nout = int(p.num_output)
+        seq = (nn.Sequential()
+               .add(_FlattenNCHW())
+               .add(nn.Linear(None, nout, with_bias=bool(p.bias_term))))
+        return seq, nout
+    if type_str == "Pooling":
+        p = lpb.pooling_param
+        kh, kw = _hw(p, "kernel_size", (2, 2))
+        sh, sw = _hw(p, "stride", (1, 1))
+        ph, pw = _hw(p, "pad", (0, 0))
+        if p.global_pooling:
+            cls = (nn.GlobalMaxPooling2D
+                   if p.pool == caffe_pb2.PoolingParameter.MAX
+                   else nn.GlobalAveragePooling2D)
+            return cls(), in_channels
+        cls = (nn.SpatialMaxPooling
+               if p.pool == caffe_pb2.PoolingParameter.MAX
+               else nn.SpatialAveragePooling)
+        m = cls(kw, kh, sw, sh, pw, ph)
+        if p.round_mode == caffe_pb2.PoolingParameter.CEIL:
+            m.ceil()          # caffe default rounding
+        return m, in_channels
+    if type_str == "ReLU":
+        slope = float(lpb.relu_param.negative_slope) \
+            if lpb.HasField("relu_param") else 0.0
+        return (nn.LeakyReLU(slope) if slope else nn.ReLU()), in_channels
+    if type_str == "TanH":
+        return nn.Tanh(), in_channels
+    if type_str == "Sigmoid":
+        return nn.Sigmoid(), in_channels
+    if type_str == "AbsVal":
+        return nn.Abs(), in_channels
+    if type_str == "Exp":
+        return nn.Exp(), in_channels
+    if type_str == "ELU":
+        return nn.ELU(float(lpb.elu_param.alpha)), in_channels
+    if type_str == "Softmax":
+        return nn.SoftMax(), in_channels
+    if type_str == "LRN":
+        p = lpb.lrn_param
+        # caffe divides alpha by the window size; the reference maps
+        # directly (CaffeLoader uses alpha as-is into SpatialCrossMapLRN)
+        return nn.SpatialCrossMapLRN(int(p.local_size), float(p.alpha),
+                                     float(p.beta), float(p.k)), in_channels
+    if type_str == "Dropout":
+        return nn.Dropout(float(lpb.dropout_param.dropout_ratio)), \
+            in_channels
+    if type_str == "BatchNorm":
+        eps = float(lpb.batch_norm_param.eps) \
+            if lpb.HasField("batch_norm_param") else 1e-5
+        return nn.SpatialBatchNormalization(in_channels, eps, affine=False), \
+            in_channels
+    if type_str == "Scale":
+        p = lpb.scale_param
+        return _ChannelAffine(in_channels, bool(p.bias_term)), in_channels
+    if type_str == "Concat":
+        # channel concat in NCHW axis 1 == our NHWC axis 3 (handled by
+        # caller: Concat is an n-ary node)
+        raise AssertionError("Concat handled by caller")
+    if type_str == "Flatten":
+        seq = nn.Sequential().add(_FlattenNCHW())
+        return seq, in_channels
+    if type_str == "Power":
+        p = lpb.power_param
+        return nn.Power(float(p.power), float(p.scale), float(p.shift)), \
+            in_channels
+    if type_str == "Threshold":
+        return nn.Threshold(float(lpb.threshold_param.threshold)), \
+            in_channels
+    if customized and type_str in customized:
+        return customized[type_str](lpb), in_channels
+    raise NotImplementedError(
+        f"caffe layer type {type_str} has no converter "
+        f"(pass customized_layers={{'{type_str}': fn}})")
+
+
+def _ChannelAffine(n, with_bias):
+    """caffe Scale layer: per-channel multiply (+ optional bias)."""
+    from bigdl_tpu.nn.module import Module
+    import jax.numpy as jnp
+
+    class ChannelAffine(Module):
+        def setup(self, rng, input_spec):
+            params = {"weight": jnp.ones((n,), jnp.float32)}
+            if with_bias:
+                params["bias"] = jnp.zeros((n,), jnp.float32)
+            return params, ()
+
+        def apply(self, params, state, input, *, training=False, rng=None):
+            y = input * params["weight"]
+            if with_bias:
+                y = y + params["bias"]
+            return y, state
+
+    return ChannelAffine()
+
+
+def load_caffe(prototxt_path, model_path=None, input_shape=None,
+               customized_layers=None):
+    """Build a bigdl_tpu Graph from a prototxt (+ optional .caffemodel
+    weights).  Reference: CaffeLoader.loadCaffe (CaffeLoader.scala:531).
+
+    ``input_shape``: NHWC tuple overriding the prototxt input_dim.
+    Train-phase-only and loss/data layers are skipped (reference keeps the
+    inference subgraph).
+    """
+    import jax
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.graph import Graph, Input, Node
+
+    net = _read_net(prototxt_path, binary=False)
+    weights = {}
+    if model_path is not None:
+        wnet = _read_net(model_path, binary=True)
+        for name, _, _, _, lpb in _layers(wnet):
+            if lpb.blobs:
+                weights[name] = [_blob_to_array(b) for b in lpb.blobs]
+
+    # input spec
+    if net.input_dim:
+        n, c, h, w = list(net.input_dim)[:4]
+        nchw_shape = (n, c, h, w)
+    elif net.input_shape:
+        nchw_shape = tuple(int(d) for d in net.input_shape[0].dim)
+    else:
+        nchw_shape = None
+    if input_shape is None:
+        if nchw_shape is None:
+            raise ValueError("no input shape in prototxt; pass input_shape=")
+        n, c, h, w = nchw_shape
+        input_shape = (n, h, w, c)
+
+    inp = Input()
+    top_nodes = {}
+    channels = {}
+    if net.input:
+        top_nodes[net.input[0]] = inp
+        channels[net.input[0]] = input_shape[-1]
+    module_blobs = []      # (module, blob list) in construction order
+
+    first_data = True
+    for name, type_str, bottoms, tops, lpb in _layers(net):
+        include = list(getattr(lpb, "include", []))
+        if any(r.HasField("phase") and r.phase == caffe_pb2.TRAIN
+               for r in include):
+            continue
+        if type_str in _LOSS_TYPES:
+            continue
+        if type_str in _DATA_TYPES:
+            # the (first) data layer's top becomes the graph input
+            if first_data and tops:
+                top_nodes[tops[0]] = inp
+                channels[tops[0]] = input_shape[-1]
+                first_data = False
+            continue
+        if type_str == "Split":
+            for t in tops:
+                top_nodes[t] = top_nodes[bottoms[0]]
+                channels[t] = channels[bottoms[0]]
+            continue
+        if type_str == "Concat":
+            p = lpb.concat_param
+            axis = int(p.axis)
+            # NCHW (0,1,2,3) -> NHWC (0,3,1,2)
+            our_axis = {0: 0, 1: 3, 2: 1, 3: 2}.get(axis, axis)
+            mod = nn.JoinTable(our_axis)
+            parents = [top_nodes[b] for b in bottoms]
+            node = Node(mod, parents)
+            top_nodes[tops[0]] = node
+            channels[tops[0]] = sum(channels[b] for b in bottoms)
+            module_blobs.append((mod, None))
+            continue
+        if type_str == "Eltwise":
+            op = lpb.eltwise_param.operation
+            mod = {caffe_pb2.EltwiseParameter.SUM: nn.CAddTable,
+                   caffe_pb2.EltwiseParameter.PROD: nn.CMulTable,
+                   caffe_pb2.EltwiseParameter.MAX: nn.CMaxTable}[op]()
+            parents = [top_nodes[b] for b in bottoms]
+            node = Node(mod, parents)
+            top_nodes[tops[0]] = node
+            channels[tops[0]] = channels[bottoms[0]]
+            module_blobs.append((mod, None))
+            continue
+
+        bottom = bottoms[0]
+        cin = channels.get(bottom, input_shape[-1])
+        mod, cout = _build_module(type_str, lpb, cin,
+                                  customized_layers or {})
+        node = Node(mod, [top_nodes[bottom]])
+        top_nodes[tops[0] if tops else name] = node
+        channels[tops[0] if tops else name] = cout
+        module_blobs.append((mod, weights.get(name)))
+
+    # terminal nodes = tops never consumed as bottoms
+    consumed = set()
+    for _, type_str, bottoms, tops, lpb in _layers(net):
+        if type_str in _LOSS_TYPES or type_str in _DATA_TYPES:
+            continue
+        for b in bottoms:
+            if b not in tops:          # in-place layers don't consume
+                consumed.add(b)
+    outs = [node for t, node in top_nodes.items()
+            if t not in consumed and node is not inp]
+    graph = Graph([inp], outs if len(outs) > 1 else outs[:1])
+
+    spec = jax.ShapeDtypeStruct(tuple(input_shape), np.float32)
+    graph.build(spec)
+    if weights:
+        _install_weights(graph, module_blobs)
+    return graph
+
+
+def _blob_to_array(b):
+    data = np.asarray(b.double_data or b.data, np.float32)
+    if b.shape.dim:
+        return data.reshape(tuple(int(d) for d in b.shape.dim))
+    legacy = [d for d in (b.num, b.channels, b.height, b.width)]
+    while legacy and legacy[0] in (0, 1) and int(np.prod(
+            [max(x, 1) for x in legacy[1:]])) == data.size:
+        legacy = legacy[1:]
+    return data.reshape(tuple(max(d, 1) for d in legacy) or (data.size,))
+
+
+def _install_weights(graph, module_blobs):
+    """Copy caffe blobs into the built graph's params (layout-converted)."""
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+
+    mod_to_idx = {}
+    for i, node in enumerate(graph._topo):
+        if node.module is not None:
+            mod_to_idx[id(node.module)] = str(i)
+
+    for mod, blobs in module_blobs:
+        if not blobs:
+            continue
+        key = mod_to_idx[id(mod)]
+        tgt = graph._params[key]
+        if isinstance(mod, nn.SpatialConvolution):
+            w = blobs[0]                       # (out, in/g, kh, kw)
+            tgt["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))
+            if len(blobs) > 1 and "bias" in tgt:
+                tgt["bias"] = jnp.asarray(blobs[1])
+        elif isinstance(mod, nn.Sequential):   # InnerProduct wrapper
+            lin = mod.modules[-1]
+            sub = tgt[str(len(mod.modules) - 1)]
+            if tuple(sub["weight"].shape) != tuple(blobs[0].shape):
+                raise ValueError(
+                    f"InnerProduct weight shape {blobs[0].shape} vs "
+                    f"{tuple(sub['weight'].shape)}")
+            sub["weight"] = jnp.asarray(blobs[0])
+            if len(blobs) > 1 and "bias" in sub:
+                sub["bias"] = jnp.asarray(blobs[1])
+        elif isinstance(mod, nn.SpatialBatchNormalization):
+            # caffe BatchNorm blobs: mean, var, scale_factor
+            scale = float(blobs[2][0]) if len(blobs) > 2 and blobs[2].size \
+                else 1.0
+            scale = 1.0 / scale if scale != 0 else 1.0
+            st = graph._state[key]
+            st["running_mean"] = jnp.asarray(blobs[0] * scale)
+            st["running_var"] = jnp.asarray(blobs[1] * scale)
+        elif type(mod).__name__ == "ChannelAffine":
+            tgt["weight"] = jnp.asarray(blobs[0].reshape(-1))
+            if len(blobs) > 1 and "bias" in tgt:
+                tgt["bias"] = jnp.asarray(blobs[1].reshape(-1))
+        else:
+            warnings.warn(f"blobs for unhandled module {type(mod).__name__}")
+
+
+def save_caffe(model, prototxt_path, model_path, input_shape):
+    """Export a Sequential of supported layers to prototxt + caffemodel
+    (reference: utils/caffe/CaffePersister.scala).
+
+    ``input_shape``: NHWC; written as caffe NCHW input_dim.
+    """
+    import bigdl_tpu.nn as nn
+
+    net = caffe_pb2.NetParameter()
+    net.name = model.name or "bigdl_tpu"
+    n, h, w, c = input_shape
+    net.input.append("data")
+    net.input_dim.extend([n, c, h, w])
+
+    def emit(mod, params, prev_top):
+        l = net.layer.add()
+        l.name = mod.name
+        l.bottom.append(prev_top)
+        top = mod.name
+        l.top.append(top)
+        if isinstance(mod, nn.SpatialConvolution):
+            l.type = "Convolution"
+            p = l.convolution_param
+            p.num_output = mod.n_output_plane
+            p.kernel_h, p.kernel_w = mod.kernel
+            p.stride_h, p.stride_w = mod.stride
+            p.pad_h, p.pad_w = mod.pad
+            p.group = mod.n_group
+            p.bias_term = mod.with_bias
+            wb = l.blobs.add()
+            warr = np.asarray(params["weight"]).transpose(3, 2, 0, 1)
+            wb.shape.dim.extend(warr.shape)
+            wb.data.extend(warr.ravel().tolist())
+            if mod.with_bias:
+                bb = l.blobs.add()
+                bb.shape.dim.extend(params["bias"].shape)
+                bb.data.extend(np.asarray(params["bias"]).ravel().tolist())
+        elif isinstance(mod, nn.Linear):
+            l.type = "InnerProduct"
+            p = l.inner_product_param
+            p.num_output = mod.output_size
+            p.bias_term = mod.with_bias
+            wb = l.blobs.add()
+            wb.shape.dim.extend(params["weight"].shape)
+            wb.data.extend(np.asarray(params["weight"]).ravel().tolist())
+            if mod.with_bias:
+                bb = l.blobs.add()
+                bb.shape.dim.extend(params["bias"].shape)
+                bb.data.extend(np.asarray(params["bias"]).ravel().tolist())
+        elif isinstance(mod, (nn.SpatialMaxPooling,
+                              nn.SpatialAveragePooling)):
+            l.type = "Pooling"
+            p = l.pooling_param
+            p.pool = (caffe_pb2.PoolingParameter.MAX
+                      if isinstance(mod, nn.SpatialMaxPooling)
+                      else caffe_pb2.PoolingParameter.AVE)
+            p.kernel_h, p.kernel_w = mod.kernel
+            p.stride_h, p.stride_w = mod.stride
+            p.pad_h, p.pad_w = mod.pad
+            p.round_mode = (caffe_pb2.PoolingParameter.CEIL
+                            if mod.ceil_mode
+                            else caffe_pb2.PoolingParameter.FLOOR)
+        elif isinstance(mod, nn.ReLU):
+            l.type = "ReLU"
+        elif isinstance(mod, nn.Tanh):
+            l.type = "TanH"
+        elif isinstance(mod, nn.Sigmoid):
+            l.type = "Sigmoid"
+        elif isinstance(mod, (nn.SoftMax, nn.LogSoftMax)):
+            l.type = "Softmax"   # LogSoftMax exported as Softmax (+log note)
+        elif isinstance(mod, nn.SpatialCrossMapLRN):
+            l.type = "LRN"
+            p = l.lrn_param
+            p.local_size = mod.size
+            p.alpha, p.beta, p.k = mod.alpha, mod.beta, mod.k
+        elif isinstance(mod, nn.Dropout):
+            l.type = "Dropout"
+            l.dropout_param.dropout_ratio = mod.p
+        elif type(mod).__name__ == "FlattenNCHW" or \
+                isinstance(mod, nn.Flatten):
+            l.type = "Flatten"
+        else:
+            raise NotImplementedError(
+                f"caffe export: unsupported layer {type(mod).__name__}")
+        return top
+
+    if not isinstance(model, nn.Sequential):
+        raise NotImplementedError("caffe export supports Sequential models")
+    top = "data"
+    params = model._params or {}
+
+    def walk_seq(seq, params, top):
+        for i, child in enumerate(seq.modules):
+            sub = params.get(str(i), {})
+            if isinstance(child, nn.Sequential):
+                top = walk_seq(child, sub, top)
+            else:
+                top = emit(child, sub, top)
+        return top
+
+    walk_seq(model, params, top)
+
+    with open(prototxt_path, "w") as f:
+        # definition only (blobs stripped)
+        defn = caffe_pb2.NetParameter()
+        defn.CopyFrom(net)
+        for l in defn.layer:
+            del l.blobs[:]
+        f.write(text_format.MessageToString(defn))
+    with open(model_path, "wb") as f:
+        f.write(net.SerializeToString())
+
+
+def load(model, prototxt_path, model_path, match_all=True):
+    """Copy caffe weights into an EXISTING bigdl_tpu model by layer name
+    (reference: CaffeLoader.load, CaffeLoader.scala:57).
+
+    The model must be built.  Matching: module.name == caffe layer name.
+    """
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+
+    wnet = _read_net(model_path, binary=True)
+    blobs_by_name = {}
+    for name, _, _, _, lpb in _layers(wnet):
+        if lpb.blobs:
+            blobs_by_name[name] = [_blob_to_array(b) for b in lpb.blobs]
+
+    copied = set()
+
+    def walk(mod, params):
+        for i, child in enumerate(getattr(mod, "modules", [])):
+            sub = params.get(str(i), {}) if isinstance(params, dict) else {}
+            blobs = blobs_by_name.get(child.name)
+            if blobs:
+                if isinstance(child, nn.SpatialConvolution):
+                    sub["weight"] = jnp.asarray(blobs[0].transpose(2, 3, 1, 0))
+                    if len(blobs) > 1 and "bias" in sub:
+                        sub["bias"] = jnp.asarray(blobs[1])
+                    copied.add(child.name)
+                elif isinstance(child, nn.Linear):
+                    sub["weight"] = jnp.asarray(blobs[0])
+                    if len(blobs) > 1 and "bias" in sub:
+                        sub["bias"] = jnp.asarray(blobs[1])
+                    copied.add(child.name)
+            walk(child, sub)
+
+    walk(model, model._params)
+    missing = set(blobs_by_name) - copied
+    if match_all and missing:
+        raise ValueError(f"unmatched caffe layers: {sorted(missing)}")
+    return model

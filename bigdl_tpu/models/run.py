@@ -136,10 +136,11 @@ def cmd_vgg_train(args):
     from bigdl_tpu.models.vgg import VggForCifar10
 
     x, y = _synthetic_images(args.synth_n, 32, 32, 3, 10)
+    holdout = min(256, len(x) // 4)
     model = VggForCifar10()
     opt = _build_optimizer(
-        args, model, _to_dataset(x[:-256], y[:-256], args.batch),
-        _to_dataset(x[-256:], y[-256:], args.batch), nn.ClassNLLCriterion(),
+        args, model, _to_dataset(x[:-holdout], y[:-holdout], args.batch),
+        _to_dataset(x[-holdout:], y[-holdout:], args.batch), nn.ClassNLLCriterion(),
         optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0,
                   weight_decay=5e-4),
         [optim.Top1Accuracy()])
@@ -152,10 +153,11 @@ def cmd_resnet_train(args):
     from bigdl_tpu.models.resnet import ResNetCifar
 
     x, y = _synthetic_images(args.synth_n, 32, 32, 3, 10)
+    holdout = min(256, len(x) // 4)
     model = ResNetCifar(depth=args.depth)
     opt = _build_optimizer(
-        args, model, _to_dataset(x[:-256], y[:-256], args.batch),
-        _to_dataset(x[-256:], y[-256:], args.batch),
+        args, model, _to_dataset(x[:-holdout], y[:-holdout], args.batch),
+        _to_dataset(x[-holdout:], y[-holdout:], args.batch),
         nn.CrossEntropyCriterion(),
         optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0,
                   weight_decay=1e-4, nesterov=True),
