@@ -337,11 +337,12 @@ def _blob_to_array(b):
     data = np.asarray(b.double_data or b.data, np.float32)
     if b.shape.dim:
         return data.reshape(tuple(int(d) for d in b.shape.dim))
-    legacy = [d for d in (b.num, b.channels, b.height, b.width)]
-    while legacy and legacy[0] in (0, 1) and int(np.prod(
-            [max(x, 1) for x in legacy[1:]])) == data.size:
-        legacy = legacy[1:]
-    return data.reshape(tuple(max(d, 1) for d in legacy) or (data.size,))
+    # legacy 4-d (num, channels, height, width) kept in full: consumers
+    # reshape to the rank they need (conv weights 4-d, biases 1-d) so a
+    # num_output=1 conv blob is never mis-squeezed
+    legacy = tuple(max(d, 1) for d in (b.num, b.channels, b.height, b.width))
+    return data.reshape(legacy if int(np.prod(legacy)) == data.size
+                        else (data.size,))
 
 
 def _install_weights(graph, module_blobs):
@@ -360,28 +361,29 @@ def _install_weights(graph, module_blobs):
         key = mod_to_idx[id(mod)]
         tgt = graph._params[key]
         if isinstance(mod, nn.SpatialConvolution):
-            w = blobs[0]                       # (out, in/g, kh, kw)
+            w = blobs[0].reshape(blobs[0].shape[-4:])  # (out, in/g, kh, kw)
             tgt["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))
             if len(blobs) > 1 and "bias" in tgt:
-                tgt["bias"] = jnp.asarray(blobs[1])
+                tgt["bias"] = jnp.asarray(blobs[1].reshape(-1))
         elif isinstance(mod, nn.Sequential):   # InnerProduct wrapper
             lin = mod.modules[-1]
             sub = tgt[str(len(mod.modules) - 1)]
-            if tuple(sub["weight"].shape) != tuple(blobs[0].shape):
+            w = blobs[0].reshape(blobs[0].shape[-2:])
+            if tuple(sub["weight"].shape) != tuple(w.shape):
                 raise ValueError(
-                    f"InnerProduct weight shape {blobs[0].shape} vs "
+                    f"InnerProduct weight shape {w.shape} vs "
                     f"{tuple(sub['weight'].shape)}")
-            sub["weight"] = jnp.asarray(blobs[0])
+            sub["weight"] = jnp.asarray(w)
             if len(blobs) > 1 and "bias" in sub:
-                sub["bias"] = jnp.asarray(blobs[1])
+                sub["bias"] = jnp.asarray(blobs[1].reshape(-1))
         elif isinstance(mod, nn.SpatialBatchNormalization):
             # caffe BatchNorm blobs: mean, var, scale_factor
             scale = float(blobs[2][0]) if len(blobs) > 2 and blobs[2].size \
                 else 1.0
             scale = 1.0 / scale if scale != 0 else 1.0
             st = graph._state[key]
-            st["running_mean"] = jnp.asarray(blobs[0] * scale)
-            st["running_var"] = jnp.asarray(blobs[1] * scale)
+            st["running_mean"] = jnp.asarray(blobs[0].reshape(-1) * scale)
+            st["running_var"] = jnp.asarray(blobs[1].reshape(-1) * scale)
         elif type(mod).__name__ == "ChannelAffine":
             tgt["weight"] = jnp.asarray(blobs[0].reshape(-1))
             if len(blobs) > 1 and "bias" in tgt:
@@ -403,6 +405,12 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
     n, h, w, c = input_shape
     net.input.append("data")
     net.input_dim.extend([n, c, h, w])
+
+    # spec tracking: pre_flat[0] holds the (H, W, C) of the activation that
+    # the most recent Flatten collapsed, so Linear columns can be permuted
+    # into caffe's (C, H, W) flatten order
+    pre_flat = [None]
+    cur_spec = [tuple(input_shape)]
 
     def emit(mod, params, prev_top):
         l = net.layer.add()
@@ -433,8 +441,18 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
             p.num_output = mod.output_size
             p.bias_term = mod.with_bias
             wb = l.blobs.add()
-            wb.shape.dim.extend(params["weight"].shape)
-            wb.data.extend(np.asarray(params["weight"]).ravel().tolist())
+            warr = np.asarray(params["weight"])
+            if pre_flat[0] is not None:
+                hh, ww, cc = pre_flat[0]
+                if hh * ww * cc == warr.shape[1] and (hh > 1 or ww > 1):
+                    # NHWC-flat columns -> caffe (C,H,W)-flat columns
+                    perm = (np.arange(hh * ww * cc)
+                            .reshape(hh, ww, cc)
+                            .transpose(2, 0, 1).ravel())
+                    warr = warr[:, perm]
+                pre_flat[0] = None
+            wb.shape.dim.extend(warr.shape)
+            wb.data.extend(warr.ravel().tolist())
             if mod.with_bias:
                 bb = l.blobs.add()
                 bb.shape.dim.extend(params["bias"].shape)
@@ -471,6 +489,13 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
         elif type(mod).__name__ == "FlattenNCHW" or \
                 isinstance(mod, nn.Flatten):
             l.type = "Flatten"
+            spec = cur_spec[0]
+            if spec is not None and len(spec) == 4:
+                # our nn.Flatten collapses NHWC order; remember the spatial
+                # shape so the following Linear's columns get permuted
+                # (FlattenNCHW needs no permutation -- it is already C,H,W)
+                if isinstance(mod, nn.Flatten):
+                    pre_flat[0] = (spec[1], spec[2], spec[3])
         else:
             raise NotImplementedError(
                 f"caffe export: unsupported layer {type(mod).__name__}")
@@ -481,16 +506,27 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
     top = "data"
     params = model._params or {}
 
-    def walk_seq(seq, params, top):
+    def _advance_spec(child, sub, substate):
+        import jax
+        try:
+            spec_in = jax.ShapeDtypeStruct(cur_spec[0], np.float32)
+            out = child.output_spec(sub, substate, spec_in)
+            cur_spec[0] = tuple(out.shape)
+        except Exception:
+            cur_spec[0] = None   # spec tracking is best-effort
+
+    def walk_seq(seq, params, state, top):
         for i, child in enumerate(seq.modules):
             sub = params.get(str(i), {})
+            substate = state.get(str(i), {}) if isinstance(state, dict) else {}
             if isinstance(child, nn.Sequential):
-                top = walk_seq(child, sub, top)
+                top = walk_seq(child, sub, substate, top)
             else:
                 top = emit(child, sub, top)
+                _advance_spec(child, sub, substate)
         return top
 
-    walk_seq(model, params, top)
+    walk_seq(model, params, model._state or {}, top)
 
     with open(prototxt_path, "w") as f:
         # definition only (blobs stripped)
@@ -508,6 +544,10 @@ def load(model, prototxt_path, model_path, match_all=True):
     (reference: CaffeLoader.load, CaffeLoader.scala:57).
 
     The model must be built.  Matching: module.name == caffe layer name.
+    Caveat: InnerProduct blobs are copied verbatim, i.e. with caffe's
+    (C,H,W)-order columns -- a model whose flatten is NHWC-ordered
+    (``nn.Flatten``) needs the importer's graph path (``load_caffe``)
+    instead, which inserts an NCHW-ordered flatten.
     """
     import jax.numpy as jnp
     import bigdl_tpu.nn as nn
@@ -526,14 +566,16 @@ def load(model, prototxt_path, model_path, match_all=True):
             blobs = blobs_by_name.get(child.name)
             if blobs:
                 if isinstance(child, nn.SpatialConvolution):
-                    sub["weight"] = jnp.asarray(blobs[0].transpose(2, 3, 1, 0))
+                    w = blobs[0].reshape(blobs[0].shape[-4:])
+                    sub["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))
                     if len(blobs) > 1 and "bias" in sub:
-                        sub["bias"] = jnp.asarray(blobs[1])
+                        sub["bias"] = jnp.asarray(blobs[1].reshape(-1))
                     copied.add(child.name)
                 elif isinstance(child, nn.Linear):
-                    sub["weight"] = jnp.asarray(blobs[0])
+                    sub["weight"] = jnp.asarray(
+                        blobs[0].reshape(blobs[0].shape[-2:]))
                     if len(blobs) > 1 and "bias" in sub:
-                        sub["bias"] = jnp.asarray(blobs[1])
+                        sub["bias"] = jnp.asarray(blobs[1].reshape(-1))
                     copied.add(child.name)
             walk(child, sub)
 

@@ -1,0 +1,599 @@
+"""TensorFlow GraphDef import/export.
+
+Reference: utils/tf/TensorflowLoader.scala:43 (load(pb, inputs, outputs):
+parse GraphDef, pattern-match subgraphs via the per-op loaders in
+utils/tf/loaders/, buildBigDLModel at :358) and utils/tf/TensorflowSaver.scala
+(export).
+
+TPU-native notes: TF is natively NHWC with HWIO conv kernels — identical to
+our layouts, so conv weights install verbatim; only MatMul weights transpose
+((in, out) -> our (out, in)).  Pattern folding: BiasAdd over Conv2D/MatMul
+becomes the module bias (the reference does the same via subgraph patterns,
+e.g. loaders/Conv2D.scala).
+"""
+
+import numpy as np
+
+from bigdl_tpu.interop import tensorflow_pb2 as tfpb
+from google.protobuf import text_format
+
+_DT_NP = {
+    tfpb.DT_FLOAT: np.float32, tfpb.DT_DOUBLE: np.float64,
+    tfpb.DT_INT32: np.int32, tfpb.DT_INT64: np.int64,
+    tfpb.DT_BOOL: np.bool_, tfpb.DT_INT8: np.int8,
+    tfpb.DT_UINT8: np.uint8, tfpb.DT_INT16: np.int16,
+}
+
+
+def read_graph(path, binary=None):
+    """Parse a GraphDef from .pb (binary) or .pbtxt (text)."""
+    g = tfpb.GraphDef()
+    if binary is None:
+        binary = not (path.endswith(".pbtxt") or path.endswith(".pbtxt.txt"))
+    if binary:
+        with open(path, "rb") as f:
+            g.ParseFromString(f.read())
+    else:
+        with open(path) as f:
+            text_format.Parse(f.read(), g, allow_unknown_field=True)
+    return g
+
+
+def _tensor_to_np(t):
+    dtype = _DT_NP.get(t.dtype, np.float32)
+    shape = tuple(int(d.size) for d in t.tensor_shape.dim)
+    n = int(np.prod(shape)) if shape else 1
+    if t.tensor_content:
+        arr = np.frombuffer(t.tensor_content, dtype=dtype)
+    elif t.float_val:
+        arr = np.asarray(t.float_val, dtype)
+    elif t.double_val:
+        arr = np.asarray(t.double_val, dtype)
+    elif t.int_val:
+        arr = np.asarray(t.int_val, dtype)
+    elif t.int64_val:
+        arr = np.asarray(t.int64_val, dtype)
+    elif t.bool_val:
+        arr = np.asarray(t.bool_val, dtype)
+    else:
+        arr = np.zeros(n, dtype)
+    if arr.size == 1 and n > 1:
+        arr = np.full(n, arr.ravel()[0], dtype)   # splat encoding
+    return arr.reshape(shape)
+
+
+def _clean(name):
+    name = name.lstrip("^")
+    return name.split(":")[0]
+
+
+class _GraphCtx:
+    def __init__(self, nodes):
+        self.nodes = nodes          # name -> NodeDef
+        self.memo = {}              # name -> ("const", np) | ("node", Node)
+        self.module_blobs = []      # (module, install_fn) pairs
+        self.input_nodes = {}       # placeholder name -> Input node
+
+
+def _const_of(ctx, name):
+    kind, val = _convert(ctx, name)
+    if kind != "const":
+        raise NotImplementedError(
+            f"expected constant input {name}, got graph node")
+    return val
+
+
+def _node_of(ctx, name):
+    kind, val = _convert(ctx, name)
+    if kind != "node":
+        raise NotImplementedError(
+            f"{name} resolves to a constant where an activation is expected")
+    return val
+
+
+def _same_pads(size, k, s):
+    """TF SAME padding totals (may be asymmetric)."""
+    if size is None or size < 0:
+        # unknown spatial extent: assume evenly divisible
+        total = max(k - s, 0)
+    else:
+        out = -(-size // s)
+        total = max((out - 1) * s + k - size, 0)
+    return total // 2, total - total // 2
+
+
+def _pool_module(ndef, cls):
+    import bigdl_tpu.nn as nn
+    ks = list(ndef.attr["ksize"].list.i)
+    st = list(ndef.attr["strides"].list.i)
+    kh, kw = int(ks[1]), int(ks[2])
+    sh, sw = int(st[1]), int(st[2])
+    pad = ndef.attr["padding"].s.decode()
+    if pad == "VALID":
+        return cls(kw, kh, sw, sh, 0, 0)
+    # SAME: symmetric when (k - s) even; our pooling pads symmetrically
+    ph = (kh - sh + 1) // 2 if kh > sh else 0
+    pw = (kw - sw + 1) // 2 if kw > sw else 0
+    m = cls(kw, kh, sw, sh, pw, ph)
+    m.ceil()
+    return m
+
+
+def _convert(ctx, name):
+    name = _clean(name)
+    if name in ctx.memo:
+        return ctx.memo[name]
+    if name not in ctx.nodes:
+        raise KeyError(f"node {name} not in graph")
+    ndef = ctx.nodes[name]
+    result = _convert_node(ctx, ndef)
+    ctx.memo[name] = result
+    return result
+
+
+def _convert_node(ctx, ndef):
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn import ops as nnops
+    from bigdl_tpu.nn.graph import Node
+    from bigdl_tpu.nn.module import Module
+
+    op = ndef.op
+    ins = [i for i in ndef.input if not i.startswith("^")]
+
+    if op == "Const":
+        return "const", _tensor_to_np(ndef.attr["value"].tensor)
+    if op in ("Identity", "StopGradient", "CheckNumerics", "PreventGradient"):
+        return _convert(ctx, ins[0])
+    if op in ("Placeholder", "PlaceholderV2"):
+        from bigdl_tpu.nn.graph import Input
+        node = ctx.input_nodes.get(ndef.name)
+        if node is None:
+            node = Input()
+            ctx.input_nodes[ndef.name] = node
+        return "node", node
+
+    if op == "MatMul":
+        x = _node_of(ctx, ins[0])
+        w = _const_of(ctx, ins[1])        # (in, out)
+        if ndef.attr["transpose_a"].b:
+            raise NotImplementedError("MatMul transpose_a")
+        if ndef.attr["transpose_b"].b:
+            w = w.T
+        mod = nn.Linear(w.shape[0], w.shape[1], with_bias=True)
+        node = Node(mod, [x])
+
+        def install(params, w=w):
+            params["weight"] = jnp.asarray(w.T)     # ours is (out, in)
+            params["bias"] = jnp.zeros((w.shape[1],), jnp.float32)
+        ctx.module_blobs.append((mod, install))
+        return "node", node
+
+    if op == "Conv2D":
+        x = _node_of(ctx, ins[0])
+        k = _const_of(ctx, ins[1])        # HWIO
+        st = list(ndef.attr["strides"].list.i)
+        sh, sw = int(st[1]), int(st[2])
+        pad = ndef.attr["padding"].s.decode()
+        kh, kw, cin, cout = k.shape
+        if pad == "VALID":
+            ph = pw = 0
+        else:
+            ph0, ph1 = _same_pads(None, kh, sh)
+            pw0, pw1 = _same_pads(None, kw, sw)
+            ph, pw = max(ph0, ph1), max(pw0, pw1)
+        mod = nn.SpatialConvolution(cin, cout, kw, kh, sw, sh, pw, ph,
+                                    with_bias=True)
+        node = Node(mod, [x])
+
+        def install(params, k=k, cout=cout):
+            params["weight"] = jnp.asarray(k)       # HWIO verbatim
+            params["bias"] = jnp.zeros((cout,), jnp.float32)
+        ctx.module_blobs.append((mod, install))
+        return "node", node
+
+    if op == "BiasAdd" or (op in ("Add", "AddV2") and len(ins) == 2):
+        a_kind, a_val = _convert(ctx, ins[0])
+        b_kind, b_val = _convert(ctx, ins[1])
+        if a_kind == "node" and b_kind == "const":
+            # fold into the producing conv/linear bias when 1-D
+            prod = a_val
+            if (b_val.ndim == 1 and prod.module is not None
+                    and isinstance(prod.module,
+                                   (nn.Linear, nn.SpatialConvolution))
+                    and not getattr(prod.module, "_tf_bias_set", False)):
+                mod = prod.module
+                mod._tf_bias_set = True
+
+                def install(params, b=b_val):
+                    params["bias"] = jnp.asarray(b)
+                ctx.module_blobs.append((mod, install))
+                return "node", prod
+            class _AddConst(Module):
+                def __init__(self, c):
+                    super().__init__()
+                    self.c = c
+
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    return input + jnp.asarray(self.c), state
+
+            node = Node(_AddConst(b_val), [prod])
+            ctx.module_blobs.append((node.module, None))
+            return "node", node
+        if a_kind == "node" and b_kind == "node":
+            node = Node(nn.CAddTable(), [a_val, b_val])
+            return "node", node
+        if a_kind == "const" and b_kind == "node":
+            class _AddConstL(Module):
+                def __init__(self, c):
+                    super().__init__()
+                    self.c = c
+
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    return input + jnp.asarray(self.c), state
+            return "node", Node(_AddConstL(a_val), [b_val])
+        return "const", a_val + b_val
+
+    if op in ("Sub", "Mul", "RealDiv", "Maximum", "Minimum"):
+        a_kind, a_val = _convert(ctx, ins[0])
+        b_kind, b_val = _convert(ctx, ins[1])
+        table = {"Sub": nn.CSubTable, "Mul": nn.CMulTable,
+                 "RealDiv": nn.CDivTable, "Maximum": nn.CMaxTable,
+                 "Minimum": nn.CMinTable}
+        npop = {"Sub": np.subtract, "Mul": np.multiply,
+                "RealDiv": np.divide, "Maximum": np.maximum,
+                "Minimum": np.minimum}
+        if a_kind == "const" and b_kind == "const":
+            return "const", npop[op](a_val, b_val)
+        if a_kind == "node" and b_kind == "node":
+            return "node", Node(table[op](), [a_val, b_val])
+        const = b_val if b_kind == "const" else a_val
+        x = a_val if a_kind == "node" else b_val
+        if op == "Mul":
+            return "node", Node(nn.MulConstant(float(const)
+                                               if const.ndim == 0
+                                               else const), [x])
+
+        class _Affine(Module):
+            def __init__(self, c, op_name, const_first):
+                super().__init__()
+                self.c, self.op_name, self.const_first = c, op_name, \
+                    const_first
+
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                c = jnp.asarray(self.c)
+                f = {"Sub": jnp.subtract, "RealDiv": jnp.divide,
+                     "Maximum": jnp.maximum, "Minimum": jnp.minimum}[
+                         self.op_name]
+                return (f(c, input) if self.const_first
+                        else f(input, c)), state
+
+        return "node", Node(_Affine(const, op, a_kind == "const"), [x])
+
+    if op in ("Relu", "Relu6", "Tanh", "Sigmoid", "Softmax", "Elu",
+              "Softplus", "Softsign", "LogSoftmax", "Rsqrt", "Sqrt", "Exp",
+              "Log", "Abs", "Neg", "Square", "Floor"):
+        x = _node_of(ctx, ins[0])
+        m = {"Relu": nn.ReLU, "Relu6": nn.ReLU6, "Tanh": nn.Tanh,
+             "Sigmoid": nn.Sigmoid, "Softmax": nn.SoftMax, "Elu": nn.ELU,
+             "Softplus": nn.SoftPlus, "Softsign": nn.SoftSign,
+             "LogSoftmax": nn.LogSoftMax, "Sqrt": nn.Sqrt, "Exp": nn.Exp,
+             "Abs": nn.Abs, "Negative": nn.Negative, "Neg": nn.Negative,
+             "Square": nn.Square, "Floor": nnops.Floor}
+        if op == "Rsqrt":
+            class _Rsqrt(Module):
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    return 1.0 / jnp.sqrt(input), state
+            return "node", Node(_Rsqrt(), [x])
+        return "node", Node(m[op](), [x])
+
+    if op == "MaxPool":
+        return "node", Node(_pool_module(ndef, nn.SpatialMaxPooling),
+                            [_node_of(ctx, ins[0])])
+    if op == "AvgPool":
+        return "node", Node(_pool_module(ndef, nn.SpatialAveragePooling),
+                            [_node_of(ctx, ins[0])])
+
+    if op == "Reshape":
+        x = _node_of(ctx, ins[0])
+        shape = [int(v) for v in _const_of(ctx, ins[1]).ravel()]
+        if shape and shape[0] == -1:
+            return "node", Node(nn.Reshape(tuple(shape[1:])), [x])
+        return "node", Node(nn.Reshape(tuple(shape), batch_mode=False), [x])
+
+    if op == "Squeeze":
+        x = _node_of(ctx, ins[0])
+        dims = tuple(int(i) for i in ndef.attr["squeeze_dims"].list.i)
+        return "node", Node(nn.Squeeze(dims or None), [x])
+
+    if op == "Mean":
+        x = _node_of(ctx, ins[0])
+        axes = tuple(int(v) for v in _const_of(ctx, ins[1]).ravel())
+        keep = bool(ndef.attr["keep_dims"].b)
+        if axes == (1, 2) and not keep:
+            return "node", Node(nn.GlobalAveragePooling2D(), [x])
+        return "node", Node(nnops.ReduceMean(axes, keep_dims=keep), [x])
+
+    if op in ("ConcatV2", "Concat"):
+        if op == "ConcatV2":
+            parts, axis = ins[:-1], int(_const_of(ctx, ins[-1]).ravel()[0])
+        else:
+            axis, parts = int(_const_of(ctx, ins[0]).ravel()[0]), ins[1:]
+        nodes = [_node_of(ctx, p) for p in parts]
+        return "node", Node(nn.JoinTable(axis), nodes)
+
+    if op == "Pad":
+        x = _node_of(ctx, ins[0])
+        pads = _const_of(ctx, ins[1]).astype(int)
+
+        class _Pad(Module):
+            def __init__(self, cfg):
+                super().__init__()
+                self.cfg = [tuple(r) for r in cfg]
+
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                return jnp.pad(input, self.cfg), state
+
+        return "node", Node(_Pad(pads), [x])
+
+    if op == "LRN":
+        x = _node_of(ctx, ins[0])
+        r = int(ndef.attr["depth_radius"].i or 5)
+        bias = float(ndef.attr["bias"].f or 1.0)
+        alpha = float(ndef.attr["alpha"].f or 1.0)
+        beta = float(ndef.attr["beta"].f or 0.5)
+        size = 2 * r + 1
+        # TF: (bias + alpha*sum)^beta; ours (caffe): (k + alpha/size*sum)^beta
+        return "node", Node(
+            nn.SpatialCrossMapLRN(size, alpha * size, beta, bias), [x])
+
+    if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+        x = _node_of(ctx, ins[0])
+        scale = _const_of(ctx, ins[1])
+        offset = _const_of(ctx, ins[2])
+        mean = _const_of(ctx, ins[3])
+        var = _const_of(ctx, ins[4])
+        eps = float(ndef.attr["epsilon"].f or 1e-3)
+        mod = nn.SpatialBatchNormalization(scale.shape[0], eps)
+        node = Node(mod, [x])
+
+        def install(params, s=scale, o=offset):
+            params["weight"] = jnp.asarray(s)
+            params["bias"] = jnp.asarray(o)
+
+        def install_state(state, m=mean, v=var):
+            state["running_mean"] = jnp.asarray(m)
+            state["running_var"] = jnp.asarray(v)
+        ctx.module_blobs.append((mod, install))
+        ctx.module_blobs.append((mod, ("state", install_state)))
+        return "node", node
+
+    if op == "Cast":
+        return _convert(ctx, ins[0])
+    if op == "Shape":
+        raise NotImplementedError(
+            "dynamic Shape op (import the inference subgraph only)")
+    raise NotImplementedError(f"TF op {op} has no converter")
+
+
+def load_tf(path, inputs, outputs, binary=None, input_specs=None):
+    """TensorflowLoader.load equivalent: extract the inference subgraph
+    between ``inputs`` (placeholder names) and ``outputs`` (node names) and
+    build a bigdl_tpu Graph.  Reference: TensorflowLoader.scala:43,358.
+
+    ``input_specs``: dict name -> (shape NHWC, dtype) to build immediately.
+    """
+    import jax
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    gdef = read_graph(path, binary)
+    nodes = {n.name: n for n in gdef.node}
+    ctx = _GraphCtx(nodes)
+    for name in inputs:
+        ctx.input_nodes[_clean(name)] = Input()
+
+    out_nodes = []
+    for name in outputs:
+        kind, val = _convert(ctx, name)
+        if kind != "node":
+            raise ValueError(f"output {name} folded to a constant")
+        out_nodes.append(val)
+
+    in_nodes = [ctx.input_nodes[_clean(n)] for n in inputs]
+    graph = Graph(in_nodes, out_nodes)
+
+    if input_specs:
+        specs = [jax.ShapeDtypeStruct(tuple(input_specs[n][0]),
+                                      input_specs[n][1]) for n in inputs]
+        graph.build(specs[0] if len(specs) == 1 else tuple(specs))
+        _install(graph, ctx.module_blobs)
+    else:
+        orig_build = graph.build
+
+        def build_and_install(spec, rng=None):
+            out = orig_build(spec, rng=rng)
+            _install(graph, ctx.module_blobs)
+            return out
+        graph.build = build_and_install
+    return graph
+
+
+def _install(graph, module_blobs):
+    idx = {id(n.module): str(i) for i, n in enumerate(graph._topo)
+           if n.module is not None}
+    for mod, fn in module_blobs:
+        if fn is None:
+            continue
+        key = idx[id(mod)]
+        if isinstance(fn, tuple) and fn[0] == "state":
+            fn[1](graph._state[key])
+        else:
+            fn(graph._params[key])
+
+
+# --------------------------------------------------------------------------- #
+# export (TensorflowSaver analogue)
+# --------------------------------------------------------------------------- #
+
+
+def save_tf(model, path, input_shape, input_name="input",
+            output_name="output"):
+    """Export a built Sequential to a frozen GraphDef
+    (reference: utils/tf/TensorflowSaver.scala).
+    """
+    import bigdl_tpu.nn as nn
+
+    g = tfpb.GraphDef()
+    g.versions.producer = 21
+
+    def add_const(name, arr):
+        n = g.node.add()
+        n.name = name
+        n.op = "Const"
+        n.attr["dtype"].type = tfpb.DT_FLOAT
+        t = n.attr["value"].tensor
+        t.dtype = tfpb.DT_FLOAT
+        for d in arr.shape:
+            t.tensor_shape.dim.add().size = d
+        t.tensor_content = np.ascontiguousarray(
+            arr, np.float32).tobytes()
+        return name
+
+    ph = g.node.add()
+    ph.name = input_name
+    ph.op = "Placeholder"
+    ph.attr["dtype"].type = tfpb.DT_FLOAT
+    for d in input_shape:
+        ph.attr["shape"].shape.dim.add().size = d if d else -1
+
+    cur = input_name
+    counter = [0]
+
+    def fresh(prefix):
+        counter[0] += 1
+        return f"{prefix}_{counter[0]}"
+
+    def emit(mod, params, cur):
+        if isinstance(mod, nn.Sequential):
+            for i, ch in enumerate(mod.modules):
+                cur = emit(ch, params.get(str(i), {}), cur)
+            return cur
+        if isinstance(mod, nn.SpatialConvolution):
+            if mod.pad != (0, 0):
+                # encode as explicit Pad + VALID conv (TF SAME cannot
+                # represent arbitrary symmetric pads)
+                pname = fresh("pad")
+                pc = add_const(pname + "/paddings", np.asarray(
+                    [[0, 0], [mod.pad[0], mod.pad[0]],
+                     [mod.pad[1], mod.pad[1]], [0, 0]], np.float32))
+                n = g.node.add()
+                n.name = pname
+                n.op = "Pad"
+                n.input.extend([cur, pc])
+                cur = pname
+            kname = add_const(fresh("kernel"), np.asarray(params["weight"]))
+            n = g.node.add()
+            n.name = fresh("conv2d")
+            n.op = "Conv2D"
+            n.input.extend([cur, kname])
+            n.attr["strides"].list.i.extend(
+                [1, mod.stride[0], mod.stride[1], 1])
+            n.attr["padding"].s = b"VALID"
+            n.attr["data_format"].s = b"NHWC"
+            cur = n.name
+            if mod.with_bias:
+                bname = add_const(fresh("bias"), np.asarray(params["bias"]))
+                nb = g.node.add()
+                nb.name = fresh("biasadd")
+                nb.op = "BiasAdd"
+                nb.input.extend([cur, bname])
+                cur = nb.name
+            return cur
+        if isinstance(mod, nn.Linear):
+            wname = add_const(fresh("weight"),
+                              np.asarray(params["weight"]).T)
+            n = g.node.add()
+            n.name = fresh("matmul")
+            n.op = "MatMul"
+            n.input.extend([cur, wname])
+            cur = n.name
+            if mod.with_bias:
+                bname = add_const(fresh("bias"), np.asarray(params["bias"]))
+                nb = g.node.add()
+                nb.name = fresh("biasadd")
+                nb.op = "BiasAdd"
+                nb.input.extend([cur, bname])
+                cur = nb.name
+            return cur
+        simple = {nn.ReLU: "Relu", nn.Tanh: "Tanh", nn.Sigmoid: "Sigmoid",
+                  nn.SoftMax: "Softmax", nn.LogSoftMax: "LogSoftmax",
+                  nn.ReLU6: "Relu6"}
+        for cls, opname in simple.items():
+            if type(mod) is cls:
+                n = g.node.add()
+                n.name = fresh(opname.lower())
+                n.op = opname
+                n.input.append(cur)
+                return n.name
+        if isinstance(mod, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+            n = g.node.add()
+            n.name = fresh("pool")
+            n.op = ("MaxPool" if isinstance(mod, nn.SpatialMaxPooling)
+                    else "AvgPool")
+            n.input.append(cur)
+            n.attr["ksize"].list.i.extend([1, mod.kernel[0],
+                                           mod.kernel[1], 1])
+            n.attr["strides"].list.i.extend([1, mod.stride[0],
+                                             mod.stride[1], 1])
+            if mod.pad == (0, 0):
+                n.attr["padding"].s = b"VALID"
+            else:
+                same_ph = (mod.kernel[0] - mod.stride[0] + 1) // 2 \
+                    if mod.kernel[0] > mod.stride[0] else 0
+                same_pw = (mod.kernel[1] - mod.stride[1] + 1) // 2 \
+                    if mod.kernel[1] > mod.stride[1] else 0
+                if mod.pad != (same_ph, same_pw):
+                    raise NotImplementedError(
+                        f"tf export: pooling pad {mod.pad} is not "
+                        f"SAME-representable (expected {(same_ph, same_pw)})")
+                n.attr["padding"].s = b"SAME"
+            n.attr["data_format"].s = b"NHWC"
+            return n.name
+        if isinstance(mod, nn.Reshape):
+            cname = fresh("shape")
+            cn = g.node.add()
+            cn.name = cname
+            cn.op = "Const"
+            cn.attr["dtype"].type = tfpb.DT_INT32
+            t = cn.attr["value"].tensor
+            t.dtype = tfpb.DT_INT32
+            shape = [-1] + [int(v) for v in mod.size]
+            t.tensor_shape.dim.add().size = len(shape)
+            t.tensor_content = np.asarray(shape, np.int32).tobytes()
+            rn = g.node.add()
+            rn.name = fresh("reshape")
+            rn.op = "Reshape"
+            rn.input.extend([cur, cname])
+            return rn.name
+        if isinstance(mod, nn.Dropout):
+            return cur                     # inference graph: identity
+        raise NotImplementedError(
+            f"tf export: unsupported layer {type(mod).__name__}")
+
+    if not isinstance(model, nn.Sequential):
+        raise NotImplementedError("tf export supports Sequential models")
+    cur = emit(model, model._params or {}, cur)
+
+    out = g.node.add()
+    out.name = output_name
+    out.op = "Identity"
+    out.input.append(cur)
+
+    with open(path, "wb") as f:
+        f.write(g.SerializeToString())
+    return path

@@ -136,7 +136,7 @@ def cmd_vgg_train(args):
     from bigdl_tpu.models.vgg import VggForCifar10
 
     x, y = _synthetic_images(args.synth_n, 32, 32, 3, 10)
-    holdout = min(256, len(x) // 4)
+    holdout = max(1, min(256, len(x) // 4))
     model = VggForCifar10()
     opt = _build_optimizer(
         args, model, _to_dataset(x[:-holdout], y[:-holdout], args.batch),
@@ -153,7 +153,7 @@ def cmd_resnet_train(args):
     from bigdl_tpu.models.resnet import ResNetCifar
 
     x, y = _synthetic_images(args.synth_n, 32, 32, 3, 10)
-    holdout = min(256, len(x) // 4)
+    holdout = max(1, min(256, len(x) // 4))
     model = ResNetCifar(depth=args.depth)
     opt = _build_optimizer(
         args, model, _to_dataset(x[:-holdout], y[:-holdout], args.batch),

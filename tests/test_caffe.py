@@ -104,6 +104,30 @@ class TestCaffeExportRoundTrip:
         np.testing.assert_allclose(
             np.asarray(m2._params["0"]["weight"]),
             np.asarray(m._params["0"]["weight"]), atol=1e-6)
+        # IP columns are stored in caffe (C,H,W) order: copied-back weights
+        # equal the original under the NHWC->CHW column permutation
+        perm = (np.arange(6 * 6 * 4).reshape(6, 6, 4)
+                .transpose(2, 0, 1).ravel())
         np.testing.assert_allclose(
             np.asarray(m2._params["2"]["weight"]),
-            np.asarray(m._params["2"]["weight"]), atol=1e-6)
+            np.asarray(m._params["2"]["weight"])[:, perm], atol=1e-6)
+
+    def test_flatten_linear_column_order(self, tmp_path):
+        """Exported IP weights must be caffe-ordered: reimport through the
+        graph path (which inserts FlattenNCHW) reproduces the outputs."""
+        m = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 4, 3, 3, name="cv"))
+             .add(nn.Flatten())
+             .add(nn.Linear(4 * 6 * 6, 2, name="fc")))
+        x = jnp.asarray(np.random.default_rng(7).normal(size=(2, 8, 8, 3)),
+                        jnp.float32)
+        m.forward(x)
+        m.evaluate()
+        y = m.forward(x)
+        proto = str(tmp_path / "f.prototxt")
+        cmodel = str(tmp_path / "f.caffemodel")
+        save_caffe(m, proto, cmodel, input_shape=(1, 8, 8, 3))
+        g = load_caffe(proto, cmodel)
+        g.evaluate()
+        np.testing.assert_allclose(np.asarray(y), np.asarray(g.forward(x)),
+                                   atol=1e-5)
