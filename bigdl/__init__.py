@@ -1,0 +1,14 @@
+"""`bigdl` — pyspark-BigDL-compatible namespace over bigdl_tpu.
+
+Reference: pyspark/bigdl/ (py4j bridge to the JVM, SURVEY.md section 2.7).
+Here there is no JVM: the same module paths and class names
+(bigdl.nn.layer.Linear, bigdl.optim.optimizer.Optimizer, ...) map straight
+onto the TPU-native framework, so reference user code like
+
+    from bigdl.nn.layer import Sequential, Linear, ReLU
+    from bigdl.nn.criterion import ClassNLLCriterion
+    from bigdl.optim.optimizer import Optimizer, SGD, MaxEpoch
+    from bigdl.util.common import init_engine, Sample
+
+runs unchanged (RDDs are replaced by plain lists of Sample).
+"""

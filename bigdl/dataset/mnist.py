@@ -1,0 +1,13 @@
+"""bigdl.dataset.mnist — reference: pyspark/bigdl/dataset/mnist.py
+(read_data_sets).  Falls back to the synthetic set when idx files are
+absent so examples stay runnable offline."""
+
+from bigdl_tpu.dataset.mnist import load_mnist, synthetic_mnist  # noqa: F401
+
+
+def read_data_sets(folder, kind="train"):
+    import os
+    base = os.path.join(folder or ".", "train-images-idx3-ubyte")
+    if folder and (os.path.exists(base) or os.path.exists(base + ".gz")):
+        return load_mnist(folder, train=(kind == "train"))
+    return synthetic_mnist(2048 if kind == "train" else 512)

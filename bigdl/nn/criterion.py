@@ -1,0 +1,13 @@
+"""bigdl.nn.criterion — criterions re-exported from bigdl_tpu.nn.
+
+Reference: pyspark/bigdl/nn/criterion.py.
+"""
+
+from bigdl_tpu.nn import (  # noqa: F401
+    AbsCriterion, BCECriterion, BCEWithLogitsCriterion, ClassNLLCriterion,
+    CosineEmbeddingCriterion, CrossEntropyCriterion, DistKLDivCriterion,
+    HingeEmbeddingCriterion, KullbackLeiblerDivergenceCriterion, L1Cost,
+    MarginCriterion, MSECriterion, MultiCriterion,
+    MultiLabelSoftMarginCriterion, ParallelCriterion, SmoothL1Criterion,
+    TimeDistributedCriterion,
+)

@@ -1,0 +1,12 @@
+"""bigdl.nn.layer — layer names re-exported from bigdl_tpu.nn.
+
+Reference: pyspark/bigdl/nn/layer.py:118 (class Layer), :696 (Model).
+The pyspark package constructs JVM layers over py4j; here the classes ARE
+the TPU-native modules, same constructor argument order as the reference
+(positional args follow the Scala constructors).
+"""
+
+from bigdl_tpu.nn import *          # noqa: F401,F403
+from bigdl_tpu.nn import Module as Layer  # noqa: F401
+from bigdl_tpu.nn import Graph as Model   # noqa: F401
+from bigdl_tpu.nn.graph import Input, Node  # noqa: F401

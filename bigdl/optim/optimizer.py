@@ -1,0 +1,159 @@
+"""bigdl.optim.optimizer — pyspark-compatible Optimizer facade.
+
+Reference: pyspark/bigdl/optim/optimizer.py (Optimizer :814,
+DistriOptimizer :927, LocalOptimizer :967, triggers :135-220, OptimMethods,
+validation methods :41-133).
+
+``training_rdd``/``training_set`` is a list of ``bigdl.util.common.Sample``
+(or an ``(X, y)`` ndarray pair); batching happens through the TPU-native
+DataSet pipeline.
+"""
+
+import numpy as np
+
+from bigdl_tpu import optim as _optim
+from bigdl_tpu.optim import Trigger as _Trigger
+
+# OptimMethods (constructor args follow the reference pyspark signatures)
+SGD = _optim.SGD
+Adam = _optim.Adam
+Adagrad = _optim.Adagrad
+Adadelta = _optim.Adadelta
+Adamax = _optim.Adamax
+RMSprop = _optim.RMSprop
+Ftrl = _optim.Ftrl
+ParallelAdam = _optim.ParallelAdam
+
+# LR schedules
+Default = _optim.Default
+Step = _optim.Step
+MultiStep = _optim.MultiStep
+Poly = _optim.Poly
+Exponential = _optim.Exponential
+Warmup = _optim.Warmup
+SequentialSchedule = _optim.SequentialSchedule
+
+# validation methods
+Top1Accuracy = _optim.Top1Accuracy
+Top5Accuracy = _optim.Top5Accuracy
+Loss = _optim.Loss
+MAE = _optim.MAE
+HitRatio = _optim.HitRatio
+NDCG = _optim.NDCG
+TreeNNAccuracy = _optim.TreeNNAccuracy
+
+
+# trigger factories (reference classes MaxIteration :135 etc.)
+def MaxIteration(n):
+    return _Trigger.max_iteration(n)
+
+
+def MaxEpoch(n):
+    return _Trigger.max_epoch(n)
+
+
+def EveryEpoch():
+    return _Trigger.every_epoch()
+
+
+def SeveralIteration(n):
+    return _Trigger.several_iteration(n)
+
+
+class TrainSummary:
+    def __new__(cls, log_dir, app_name):
+        from bigdl_tpu.visualization import TrainSummary as TS
+        return TS(log_dir, app_name)
+
+
+class ValidationSummary:
+    def __new__(cls, log_dir, app_name):
+        from bigdl_tpu.visualization import ValidationSummary as VS
+        return VS(log_dir, app_name)
+
+
+def _to_dataset(data, batch_size):
+    from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+    from bigdl.util.common import Sample, samples_to_arrays
+
+    if isinstance(data, tuple) and len(data) == 2:
+        x, y = data
+    elif isinstance(data, (list,)) and data and isinstance(data[0], Sample):
+        x, y = samples_to_arrays(data)
+    else:
+        raise TypeError(
+            "training data must be a list of bigdl.util.common.Sample "
+            "or an (X, y) ndarray pair")
+    return array_dataset(np.asarray(x), np.asarray(y)) >> \
+        SampleToMiniBatch(batch_size)
+
+
+class Optimizer:
+    """Reference: optimizer.py:814 (and `create` :848)."""
+
+    def __init__(self, model, training_rdd, criterion, end_trigger=None,
+                 batch_size=32, optim_method=None, bigdl_type="float"):
+        from bigdl_tpu.optim import LocalOptimizer
+        self._opt = LocalOptimizer(
+            model, _to_dataset(training_rdd, batch_size), criterion,
+            optim_method or SGD())
+        self._opt.set_end_when(end_trigger or MaxEpoch(1))
+        self.model = model
+
+    @staticmethod
+    def create(model, training_set, criterion, end_trigger=None,
+               batch_size=32, optim_method=None, cores=None,
+               bigdl_type="float"):
+        return Optimizer(model, training_set, criterion, end_trigger,
+                         batch_size, optim_method, bigdl_type)
+
+    def set_validation(self, batch_size, val_rdd, trigger, val_method=None):
+        self._opt.set_validation(
+            trigger, _to_dataset(val_rdd, batch_size),
+            val_method or [Top1Accuracy()])
+        return self
+
+    def set_checkpoint(self, checkpoint_trigger, checkpoint_path,
+                       isOverWrite=True):
+        self._opt.set_checkpoint(checkpoint_path, checkpoint_trigger)
+        return self
+
+    def set_train_summary(self, summary):
+        self._opt.set_train_summary(summary)
+        return self
+
+    def set_val_summary(self, summary):
+        self._opt.set_validation_summary(summary)
+        return self
+
+    def set_gradclip_const(self, min_value, max_value):
+        self._opt.set_gradient_clipping_by_value(min_value, max_value)
+        return self
+
+    def set_gradclip_l2norm(self, clip_norm):
+        self._opt.set_gradient_clipping_by_l2_norm(clip_norm)
+        return self
+
+    def set_end_when(self, end_trigger):
+        self._opt.set_end_when(end_trigger)
+        return self
+
+    def optimize(self):
+        self._opt.optimize()
+        return self.model
+
+
+class DistriOptimizer(Optimizer):
+    """Reference: optimizer.py:927 — mesh-sharded variant."""
+
+    def __init__(self, model, training_rdd, criterion, end_trigger=None,
+                 batch_size=32, optim_method=None, bigdl_type="float"):
+        from bigdl_tpu.optim import DistriOptimizer as _D
+        self._opt = _D(model, _to_dataset(training_rdd, batch_size),
+                       criterion, optim_method or SGD())
+        self._opt.set_end_when(end_trigger or MaxEpoch(1))
+        self.model = model
+
+
+class LocalOptimizer(Optimizer):
+    """Reference: optimizer.py:967 — explicit local variant."""

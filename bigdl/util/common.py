@@ -1,0 +1,66 @@
+"""bigdl.util.common — engine bootstrap + Sample/JTensor.
+
+Reference: pyspark/bigdl/util/common.py (init_engine :417, Sample :291,
+JTensor :200).  No py4j here: JTensor is a thin ndarray holder and
+``callBigDlFunc`` intentionally does not exist (there is no JVM to call).
+"""
+
+import numpy as np
+
+
+class JTensor:
+    """ndarray + shape holder (reference: common.py JTensor)."""
+
+    def __init__(self, storage, shape, bigdl_type="float"):
+        self.storage = np.asarray(storage)
+        self.shape = tuple(shape)
+        self.bigdl_type = bigdl_type
+
+    @classmethod
+    def from_ndarray(cls, a, bigdl_type="float"):
+        a = np.asarray(a)
+        return cls(a.ravel(), a.shape, bigdl_type)
+
+    def to_ndarray(self):
+        return np.asarray(self.storage).reshape(self.shape)
+
+
+class Sample:
+    """One (features, labels) record (reference: common.py:291)."""
+
+    def __init__(self, features, labels, bigdl_type="float"):
+        self.features = features
+        self.labels = labels
+        self.feature = features[0]
+        self.label = labels[0]
+        self.bigdl_type = bigdl_type
+
+    @classmethod
+    def from_ndarray(cls, features, labels, bigdl_type="float"):
+        if not isinstance(features, list):
+            features = [features]
+        if not isinstance(labels, (list,)):
+            labels = [labels]
+        return cls([JTensor.from_ndarray(np.asarray(f)) for f in features],
+                   [JTensor.from_ndarray(np.asarray(l)) for l in labels],
+                   bigdl_type)
+
+
+def init_engine(bigdl_type="float"):
+    """Reference: common.py init_engine -> Engine.init."""
+    from bigdl_tpu.utils.engine import Engine
+    Engine.init()
+
+
+def get_node_and_core_number(bigdl_type="float"):
+    import jax
+    return 1, jax.device_count()
+
+
+def samples_to_arrays(samples):
+    """list[Sample] -> (features ndarray, labels ndarray) stacked batches."""
+    feats = np.stack([s.feature.to_ndarray() for s in samples])
+    labs = np.stack([s.label.to_ndarray() for s in samples])
+    if labs.ndim == 2 and labs.shape[1] == 1:
+        labs = labs[:, 0]
+    return feats, labs

@@ -73,6 +73,11 @@ class _GraphCtx:
         self.memo = {}              # name -> ("const", np) | ("node", Node)
         self.module_blobs = []      # (module, install_fn) pairs
         self.input_nodes = {}       # placeholder name -> Input node
+        self.consumers = {}         # name -> number of consuming nodes
+        for n in nodes.values():
+            for i in n.input:
+                key = _clean(i)
+                self.consumers[key] = self.consumers.get(key, 0) + 1
 
 
 def _const_of(ctx, name):
@@ -91,32 +96,63 @@ def _node_of(ctx, name):
     return val
 
 
-def _same_pads(size, k, s):
-    """TF SAME padding totals (may be asymmetric)."""
-    if size is None or size < 0:
-        # unknown spatial extent: assume evenly divisible
-        total = max(k - s, 0)
-    else:
-        out = -(-size // s)
-        total = max((out - 1) * s + k - size, 0)
-    return total // 2, total - total // 2
+def _tf_conv_module(k_shape, strides, dilations, with_same_pad):
+    """TF-exact conv: lax's string padding reproduces TF SAME including
+    its input-size-dependent asymmetric pads (no symmetric approximation)."""
+    from bigdl_tpu.nn.module import Module
+    import jax.numpy as jnp
+    from jax import lax
+
+    kh, kw, cin, cout = k_shape
+    sh, sw = strides
+    dh, dw = dilations
+
+    class TfConv2D(Module):
+        n_input_plane, n_output_plane = cin, cout
+
+        def setup(self, rng, input_spec):
+            return {"weight": jnp.zeros((kh, kw, cin, cout), jnp.float32),
+                    "bias": jnp.zeros((cout,), jnp.float32)}, ()
+
+        def apply(self, params, state, input, *, training=False, rng=None):
+            y = lax.conv_general_dilated(
+                input, params["weight"].astype(input.dtype),
+                window_strides=(sh, sw),
+                padding="SAME" if with_same_pad else "VALID",
+                rhs_dilation=(dh, dw),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return y + params["bias"].astype(y.dtype), state
+
+    return TfConv2D()
 
 
-def _pool_module(ndef, cls):
-    import bigdl_tpu.nn as nn
+def _pool_module(ndef, kind):
+    """TF-exact pooling: reduce_window with lax string padding (SAME
+    matches TF's asymmetric pads; avg excludes padded cells like TF)."""
+    from bigdl_tpu.nn.module import Module
+    import jax.numpy as jnp
+    from jax import lax
+
     ks = list(ndef.attr["ksize"].list.i)
     st = list(ndef.attr["strides"].list.i)
     kh, kw = int(ks[1]), int(ks[2])
     sh, sw = int(st[1]), int(st[2])
     pad = ndef.attr["padding"].s.decode()
-    if pad == "VALID":
-        return cls(kw, kh, sw, sh, 0, 0)
-    # SAME: symmetric when (k - s) even; our pooling pads symmetrically
-    ph = (kh - sh + 1) // 2 if kh > sh else 0
-    pw = (kw - sw + 1) // 2 if kw > sw else 0
-    m = cls(kw, kh, sw, sh, pw, ph)
-    m.ceil()
-    return m
+
+    class TfPool(Module):
+        def apply(self, params, state, input, *, training=False, rng=None):
+            dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+            if kind == "max":
+                return lax.reduce_window(
+                    input, -jnp.inf, lax.max, dims, strides, pad), state
+            ones = jnp.ones_like(input)
+            total = lax.reduce_window(input, 0.0, lax.add, dims, strides,
+                                      pad)
+            count = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                      pad)
+            return total / count, state
+
+    return TfPool()
 
 
 def _convert(ctx, name):
@@ -170,25 +206,19 @@ def _convert_node(ctx, ndef):
         return "node", node
 
     if op == "Conv2D":
+        if ndef.attr["data_format"].s.decode() not in ("", "NHWC"):
+            raise NotImplementedError("Conv2D data_format NCHW")
         x = _node_of(ctx, ins[0])
         k = _const_of(ctx, ins[1])        # HWIO
         st = list(ndef.attr["strides"].list.i)
-        sh, sw = int(st[1]), int(st[2])
+        dil = list(ndef.attr["dilations"].list.i) or [1, 1, 1, 1]
         pad = ndef.attr["padding"].s.decode()
-        kh, kw, cin, cout = k.shape
-        if pad == "VALID":
-            ph = pw = 0
-        else:
-            ph0, ph1 = _same_pads(None, kh, sh)
-            pw0, pw1 = _same_pads(None, kw, sw)
-            ph, pw = max(ph0, ph1), max(pw0, pw1)
-        mod = nn.SpatialConvolution(cin, cout, kw, kh, sw, sh, pw, ph,
-                                    with_bias=True)
+        mod = _tf_conv_module(k.shape, (int(st[1]), int(st[2])),
+                              (int(dil[1]), int(dil[2])), pad == "SAME")
         node = Node(mod, [x])
 
-        def install(params, k=k, cout=cout):
+        def install(params, k=k):
             params["weight"] = jnp.asarray(k)       # HWIO verbatim
-            params["bias"] = jnp.zeros((cout,), jnp.float32)
         ctx.module_blobs.append((mod, install))
         return "node", node
 
@@ -196,11 +226,13 @@ def _convert_node(ctx, ndef):
         a_kind, a_val = _convert(ctx, ins[0])
         b_kind, b_val = _convert(ctx, ins[1])
         if a_kind == "node" and b_kind == "const":
-            # fold into the producing conv/linear bias when 1-D
+            # fold into the producing conv/linear bias when 1-D and the
+            # producer's raw output feeds ONLY this BiasAdd
             prod = a_val
-            if (b_val.ndim == 1 and prod.module is not None
-                    and isinstance(prod.module,
-                                   (nn.Linear, nn.SpatialConvolution))
+            sole = ctx.consumers.get(_clean(ins[0]), 0) <= 1
+            if (b_val.ndim == 1 and sole and prod.module is not None
+                    and (isinstance(prod.module, nn.Linear)
+                         or type(prod.module).__name__ == "TfConv2D")
                     and not getattr(prod.module, "_tf_bias_set", False)):
                 mod = prod.module
                 mod._tf_bias_set = True
@@ -282,7 +314,7 @@ def _convert_node(ctx, ndef):
              "Softplus": nn.SoftPlus, "Softsign": nn.SoftSign,
              "LogSoftmax": nn.LogSoftMax, "Sqrt": nn.Sqrt, "Exp": nn.Exp,
              "Abs": nn.Abs, "Negative": nn.Negative, "Neg": nn.Negative,
-             "Square": nn.Square, "Floor": nnops.Floor}
+             "Square": nn.Square, "Floor": nnops.Floor, "Log": nn.Log}
         if op == "Rsqrt":
             class _Rsqrt(Module):
                 def apply(self, params, state, input, *, training=False,
@@ -292,10 +324,14 @@ def _convert_node(ctx, ndef):
         return "node", Node(m[op](), [x])
 
     if op == "MaxPool":
-        return "node", Node(_pool_module(ndef, nn.SpatialMaxPooling),
+        if ndef.attr["data_format"].s.decode() not in ("", "NHWC"):
+            raise NotImplementedError("MaxPool data_format NCHW")
+        return "node", Node(_pool_module(ndef, "max"),
                             [_node_of(ctx, ins[0])])
     if op == "AvgPool":
-        return "node", Node(_pool_module(ndef, nn.SpatialAveragePooling),
+        if ndef.attr["data_format"].s.decode() not in ("", "NHWC"):
+            raise NotImplementedError("AvgPool data_format NCHW")
+        return "node", Node(_pool_module(ndef, "avg"),
                             [_node_of(ctx, ins[0])])
 
     if op == "Reshape":
@@ -451,17 +487,18 @@ def save_tf(model, path, input_shape, input_name="input",
     g = tfpb.GraphDef()
     g.versions.producer = 21
 
-    def add_const(name, arr):
+    def add_const(name, arr, dtype=None):
         n = g.node.add()
         n.name = name
         n.op = "Const"
-        n.attr["dtype"].type = tfpb.DT_FLOAT
+        tf_dtype = tfpb.DT_INT32 if dtype == np.int32 else tfpb.DT_FLOAT
+        np_dtype = np.int32 if dtype == np.int32 else np.float32
+        n.attr["dtype"].type = tf_dtype
         t = n.attr["value"].tensor
-        t.dtype = tfpb.DT_FLOAT
+        t.dtype = tf_dtype
         for d in arr.shape:
             t.tensor_shape.dim.add().size = d
-        t.tensor_content = np.ascontiguousarray(
-            arr, np.float32).tobytes()
+        t.tensor_content = np.ascontiguousarray(arr, np_dtype).tobytes()
         return name
 
     ph = g.node.add()
@@ -490,19 +527,24 @@ def save_tf(model, path, input_shape, input_name="input",
                 pname = fresh("pad")
                 pc = add_const(pname + "/paddings", np.asarray(
                     [[0, 0], [mod.pad[0], mod.pad[0]],
-                     [mod.pad[1], mod.pad[1]], [0, 0]], np.float32))
+                     [mod.pad[1], mod.pad[1]], [0, 0]], np.int32),
+                    dtype=np.int32)
                 n = g.node.add()
                 n.name = pname
                 n.op = "Pad"
                 n.input.extend([cur, pc])
+                n.attr["T"].type = tfpb.DT_FLOAT
+                n.attr["Tpaddings"].type = tfpb.DT_INT32
                 cur = pname
             kname = add_const(fresh("kernel"), np.asarray(params["weight"]))
             n = g.node.add()
             n.name = fresh("conv2d")
             n.op = "Conv2D"
             n.input.extend([cur, kname])
+            n.attr["T"].type = tfpb.DT_FLOAT
             n.attr["strides"].list.i.extend(
                 [1, mod.stride[0], mod.stride[1], 1])
+            n.attr["dilations"].list.i.extend([1, 1, 1, 1])
             n.attr["padding"].s = b"VALID"
             n.attr["data_format"].s = b"NHWC"
             cur = n.name
@@ -512,6 +554,8 @@ def save_tf(model, path, input_shape, input_name="input",
                 nb.name = fresh("biasadd")
                 nb.op = "BiasAdd"
                 nb.input.extend([cur, bname])
+                nb.attr["T"].type = tfpb.DT_FLOAT
+                nb.attr["data_format"].s = b"NHWC"
                 cur = nb.name
             return cur
         if isinstance(mod, nn.Linear):
@@ -521,6 +565,9 @@ def save_tf(model, path, input_shape, input_name="input",
             n.name = fresh("matmul")
             n.op = "MatMul"
             n.input.extend([cur, wname])
+            n.attr["T"].type = tfpb.DT_FLOAT
+            n.attr["transpose_a"].b = False
+            n.attr["transpose_b"].b = False
             cur = n.name
             if mod.with_bias:
                 bname = add_const(fresh("bias"), np.asarray(params["bias"]))
@@ -528,6 +575,7 @@ def save_tf(model, path, input_shape, input_name="input",
                 nb.name = fresh("biasadd")
                 nb.op = "BiasAdd"
                 nb.input.extend([cur, bname])
+                nb.attr["T"].type = tfpb.DT_FLOAT
                 cur = nb.name
             return cur
         simple = {nn.ReLU: "Relu", nn.Tanh: "Tanh", nn.Sigmoid: "Sigmoid",
@@ -539,6 +587,7 @@ def save_tf(model, path, input_shape, input_name="input",
                 n.name = fresh(opname.lower())
                 n.op = opname
                 n.input.append(cur)
+                n.attr["T"].type = tfpb.DT_FLOAT
                 return n.name
         if isinstance(mod, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
             n = g.node.add()
@@ -546,6 +595,7 @@ def save_tf(model, path, input_shape, input_name="input",
             n.op = ("MaxPool" if isinstance(mod, nn.SpatialMaxPooling)
                     else "AvgPool")
             n.input.append(cur)
+            n.attr["T"].type = tfpb.DT_FLOAT
             n.attr["ksize"].list.i.extend([1, mod.kernel[0],
                                            mod.kernel[1], 1])
             n.attr["strides"].list.i.extend([1, mod.stride[0],
@@ -579,6 +629,8 @@ def save_tf(model, path, input_shape, input_name="input",
             rn.name = fresh("reshape")
             rn.op = "Reshape"
             rn.input.extend([cur, cname])
+            rn.attr["T"].type = tfpb.DT_FLOAT
+            rn.attr["Tshape"].type = tfpb.DT_INT32
             return rn.name
         if isinstance(mod, nn.Dropout):
             return cur                     # inference graph: identity
@@ -593,6 +645,7 @@ def save_tf(model, path, input_shape, input_name="input",
     out.name = output_name
     out.op = "Identity"
     out.input.append(cur)
+    out.attr["T"].type = tfpb.DT_FLOAT
 
     with open(path, "wb") as f:
         f.write(g.SerializeToString())

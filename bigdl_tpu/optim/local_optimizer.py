@@ -214,6 +214,12 @@ class LocalOptimizer(BaseOptimizer):
         results = validate(self.model, params, mstate, self.validation_dataset,
                            self.validation_methods, self.compute_dtype)
         for method, res in zip(self.validation_methods, results):
+            if res is None:
+                log.warning(
+                    "validation dataset produced no full batches; skipping "
+                    "%s (reduce batch size or grow the validation split)",
+                    method.name)
+                continue
             value, _ = res.result()
             log.info("Validation %s: %s", method.name, res)
             if method.name in ("Top1Accuracy", "Top5Accuracy"):

@@ -1,0 +1,241 @@
+"""Torch7 .t7 binary serialization (read/write).
+
+Reference: utils/TorchFile.scala — little-endian stream of typed objects:
+type ids TYPE_NIL=0 / NUMBER=1 (f64) / STRING=2 (i32 len + bytes) /
+TABLE=3 (i32 memo index, i32 count, key/value objects) /
+TORCH=4 (i32 memo index, version string "V 1", class name, payload) /
+BOOLEAN=5 (i32).  Tensor payload: i32 ndim, i64[ndim] sizes, i64[ndim]
+strides, i64 storageOffset (1-based), storage object; storage payload:
+i64 length + raw elements (TorchFile.scala:710-719 readDoubleStorage,
+:398-421 writeDoubleTensor).
+
+Scope: numbers, booleans, strings, tables (<-> dict), numpy arrays
+(<-> torch.FloatTensor / DoubleTensor / LongTensor).  nn.* module objects
+are read into plain dicts with a ``__torch_class__`` key; writing module
+objects is not supported (use the BigDL protobuf or caffe interop for
+model exchange).
+"""
+
+import struct
+
+import numpy as np
+
+TYPE_NIL = 0
+TYPE_NUMBER = 1
+TYPE_STRING = 2
+TYPE_TABLE = 3
+TYPE_TORCH = 4
+TYPE_BOOLEAN = 5
+TYPE_FUNCTION = 6
+LEGACY_TYPE_RECUR_FUNCTION = 7
+TYPE_RECUR_FUNCTION = 8
+
+_TENSOR_DTYPES = {
+    "torch.FloatTensor": np.float32, "torch.DoubleTensor": np.float64,
+    "torch.LongTensor": np.int64, "torch.IntTensor": np.int32,
+    "torch.ByteTensor": np.uint8, "torch.CudaTensor": np.float32,
+    "torch.CudaDoubleTensor": np.float64, "torch.CudaLongTensor": np.int64,
+}
+_STORAGE_DTYPES = {
+    "torch.FloatStorage": np.float32, "torch.DoubleStorage": np.float64,
+    "torch.LongStorage": np.int64, "torch.IntStorage": np.int32,
+    "torch.ByteStorage": np.uint8, "torch.CudaStorage": np.float32,
+    "torch.CudaDoubleStorage": np.float64,
+    "torch.CudaLongStorage": np.int64,
+}
+_NP_TO_TENSOR = {
+    np.dtype(np.float32): ("torch.FloatTensor", "torch.FloatStorage", "<f4"),
+    np.dtype(np.float64): ("torch.DoubleTensor", "torch.DoubleStorage",
+                           "<f8"),
+    np.dtype(np.int64): ("torch.LongTensor", "torch.LongStorage", "<i8"),
+    np.dtype(np.int32): ("torch.IntTensor", "torch.IntStorage", "<i4"),
+}
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+        self.memo = {}
+
+    def _unpack(self, fmt, size):
+        v = struct.unpack_from(fmt, self.data, self.pos)[0]
+        self.pos += size
+        return v
+
+    def i32(self):
+        return self._unpack("<i", 4)
+
+    def i64(self):
+        return self._unpack("<q", 8)
+
+    def f64(self):
+        return self._unpack("<d", 8)
+
+    def string(self):
+        n = self.i32()
+        s = self.data[self.pos:self.pos + n].decode("latin-1")
+        self.pos += n
+        return s
+
+    def raw(self, dtype, count):
+        arr = np.frombuffer(self.data, dtype=dtype, count=count,
+                            offset=self.pos).copy()
+        self.pos += arr.itemsize * count
+        return arr
+
+    def obj(self):
+        tid = self.i32()
+        if tid == TYPE_NIL:
+            return None
+        if tid == TYPE_NUMBER:
+            v = self.f64()
+            return int(v) if v == int(v) and abs(v) < 2 ** 53 else v
+        if tid == TYPE_STRING:
+            return self.string()
+        if tid == TYPE_BOOLEAN:
+            return bool(self.i32())
+        if tid == TYPE_TABLE:
+            idx = self.i32()
+            if idx in self.memo:
+                return self.memo[idx]
+            n = self.i32()
+            out = {}
+            self.memo[idx] = out
+            for _ in range(n):
+                k = self.obj()
+                v = self.obj()
+                out[k] = v
+            return out
+        if tid == TYPE_TORCH:
+            idx = self.i32()
+            if idx in self.memo:
+                return self.memo[idx]
+            version = self.string()
+            cls = self.string() if version.startswith("V ") else version
+            result = self._torch_object(cls)
+            self.memo[idx] = result
+            return result
+        raise NotImplementedError(f".t7 type id {tid}")
+
+    def _torch_object(self, cls):
+        if cls in _TENSOR_DTYPES:
+            ndim = self.i32()
+            sizes = [self.i64() for _ in range(ndim)]
+            strides = [self.i64() for _ in range(ndim)]
+            offset = self.i64()          # 1-based
+            storage = self.obj()
+            if storage is None:
+                return np.zeros(sizes, _TENSOR_DTYPES[cls])
+            flat = np.asarray(storage)
+            return np.lib.stride_tricks.as_strided(
+                flat[offset - 1:],
+                shape=sizes,
+                strides=[s * flat.itemsize for s in strides]).copy()
+        if cls in _STORAGE_DTYPES:
+            n = self.i64()
+            return self.raw(np.dtype(_STORAGE_DTYPES[cls]).newbyteorder("<"),
+                            n)
+        # unknown torch class (e.g. nn.Linear): payload is a table
+        payload = self.obj()
+        if isinstance(payload, dict):
+            payload["__torch_class__"] = cls
+            return payload
+        return {"__torch_class__": cls, "value": payload}
+
+
+class _Writer:
+    def __init__(self):
+        self.chunks = []
+        self.index = 0
+
+    def i32(self, v):
+        self.chunks.append(struct.pack("<i", int(v)))
+
+    def i64(self, v):
+        self.chunks.append(struct.pack("<q", int(v)))
+
+    def f64(self, v):
+        self.chunks.append(struct.pack("<d", float(v)))
+
+    def string(self, s):
+        b = s.encode("latin-1")
+        self.i32(len(b))
+        self.chunks.append(b)
+
+    def obj(self, value):
+        if value is None:
+            self.i32(TYPE_NIL)
+        elif isinstance(value, bool):
+            self.i32(TYPE_BOOLEAN)
+            self.i32(1 if value else 0)
+        elif isinstance(value, (int, float, np.integer, np.floating)):
+            self.i32(TYPE_NUMBER)
+            self.f64(value)
+        elif isinstance(value, str):
+            self.i32(TYPE_STRING)
+            self.string(value)
+        elif isinstance(value, dict):
+            self.i32(TYPE_TABLE)
+            self.index += 1
+            self.i32(self.index)
+            self.i32(len(value))
+            for k, v in value.items():
+                self.obj(k)
+                self.obj(v)
+        elif isinstance(value, (list, tuple)):
+            # lua convention: 1-based integer-keyed table
+            self.obj({i + 1: v for i, v in enumerate(value)})
+        elif isinstance(value, np.ndarray):
+            self._tensor(value)
+        else:
+            raise NotImplementedError(
+                f".t7 write: unsupported type {type(value)}")
+
+    def _tensor(self, arr):
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype not in _NP_TO_TENSOR:
+            arr = arr.astype(np.float32)
+        tcls, scls, wire = _NP_TO_TENSOR[arr.dtype]
+        self.i32(TYPE_TORCH)
+        self.index += 1
+        self.i32(self.index)
+        self.string("V 1")
+        self.string(tcls)
+        self.i32(arr.ndim)
+        strides, acc = [], 1
+        for s in reversed(arr.shape):
+            strides.append(acc)
+            acc *= s
+        strides = list(reversed(strides))
+        for s in arr.shape:
+            self.i64(s)
+        for s in strides:
+            self.i64(s)
+        self.i64(1)                      # storageOffset, 1-based
+        # storage object
+        self.i32(TYPE_TORCH)
+        self.index += 1
+        self.i32(self.index)
+        self.string("V 1")
+        self.string(scls)
+        self.i64(arr.size)
+        self.chunks.append(arr.astype(wire).tobytes())
+
+
+def load_t7(path):
+    """Read a .t7 file -> python value (reference: TorchFile.load)."""
+    with open(path, "rb") as f:
+        return _Reader(f.read()).obj()
+
+
+def save_t7(value, path, overwrite=True):
+    """Write numbers/strings/bools/dicts/ndarrays as .t7
+    (reference: TorchFile.save)."""
+    import os
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(path)
+    w = _Writer()
+    w.obj(value)
+    with open(path, "wb") as f:
+        f.write(b"".join(w.chunks))

@@ -1,0 +1,47 @@
+"""Concurrent serving with PredictionService.
+
+Reference: example/udfpredictor (SQL UDF serving) +
+optim/PredictionService.scala:56 (thread-safe model-instance pool).  Here a
+thread pool fires concurrent single-record predictions against the service.
+
+    python examples/udf_predictor.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS"):
+    # the site bootstrap force-selects the tunneled TPU; honor the env var
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+
+def main():
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim.predictor import PredictionService
+    from bigdl_tpu.models.lenet import LeNet5
+
+    model = LeNet5()
+    model.forward(jnp.zeros((1, 28, 28, 1)))   # build
+    model.evaluate()
+    service = PredictionService(model, num_threads=4)
+
+    rng = np.random.default_rng(0)
+    queries = [jnp.asarray(rng.normal(size=(1, 28, 28, 1)), jnp.float32)
+               for _ in range(32)]
+    with ThreadPoolExecutor(8) as pool:
+        results = list(pool.map(service.predict, queries))
+    preds = [int(np.asarray(r).argmax()) for r in results]
+    print("served", len(preds), "predictions:", preds[:10])
+
+
+if __name__ == "__main__":
+    main()

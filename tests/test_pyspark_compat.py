@@ -1,0 +1,58 @@
+"""pyspark-bigdl compat namespace: reference user code runs unchanged.
+
+Reference: pyspark/bigdl/ package layout (nn/layer.py, nn/criterion.py,
+optim/optimizer.py, util/common.py — SURVEY.md section 2.7).
+"""
+
+import numpy as np
+
+
+class TestCompatNamespace:
+    def test_reference_style_training_script(self):
+        # this is the reference's canonical usage pattern, verbatim imports
+        from bigdl.nn.layer import (Linear, LogSoftMax, ReLU, Reshape,
+                                    Sequential)
+        from bigdl.nn.criterion import ClassNLLCriterion
+        from bigdl.optim.optimizer import (EveryEpoch, MaxIteration,
+                                           Optimizer, SGD, Top1Accuracy)
+        from bigdl.util.common import Sample, init_engine
+
+        init_engine()
+        rng = np.random.default_rng(0)
+        ys = rng.integers(0, 3, size=192)
+        samples = [
+            Sample.from_ndarray(
+                rng.normal(size=(28, 28)).astype(np.float32) + y,
+                np.asarray([y], np.float32))
+            for y in ys
+        ]
+        model = (Sequential()
+                 .add(Reshape((784,)))
+                 .add(Linear(784, 16)).add(ReLU())
+                 .add(Linear(16, 3)).add(LogSoftMax()))
+        opt = Optimizer(model=model, training_rdd=samples,
+                        criterion=ClassNLLCriterion(),
+                        optim_method=SGD(learning_rate=0.1),
+                        end_trigger=MaxIteration(12), batch_size=32)
+        opt.set_validation(32, samples[:64], EveryEpoch(), [Top1Accuracy()])
+        trained = opt.optimize()
+        assert trained is model
+
+    def test_jtensor_round_trip(self):
+        from bigdl.util.common import JTensor
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        jt = JTensor.from_ndarray(a)
+        np.testing.assert_array_equal(jt.to_ndarray(), a)
+
+    def test_dataset_mnist_fallback(self):
+        from bigdl.dataset import mnist
+        x, y = mnist.read_data_sets(None, "train")
+        assert x.shape[1:] in ((28, 28), (28, 28, 1)) and len(x) == len(y)
+
+    def test_trigger_factories(self):
+        from bigdl.optim.optimizer import (EveryEpoch, MaxEpoch,
+                                           MaxIteration, SeveralIteration)
+        t = MaxIteration(5)
+        assert t({"neval": 6, "epoch": 1}) and not t({"neval": 3, "epoch": 1})
+        assert MaxEpoch(2)({"epoch": 3, "neval": 0})
+        assert EveryEpoch() is not None and SeveralIteration(4) is not None

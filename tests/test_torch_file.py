@@ -1,0 +1,54 @@
+"""Torch7 .t7 serialization (utils/torch_file.py).
+
+Golden: the reference's torch-generated fixtures
+spark/dl/src/test/resources/torch/*.t7 (preprocessed ImageNet tensors
+written by genPreprocessRefTensors.lua).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.torch_file import load_t7, save_t7
+
+FIX = "/root/reference/spark/dl/src/test/resources/torch/n02110063_11239.t7"
+
+
+@pytest.mark.skipif(not os.path.exists(FIX), reason="fixture missing")
+def test_read_real_torch_tensor():
+    t = load_t7(FIX)
+    assert isinstance(t, np.ndarray)
+    assert t.shape == (3, 224, 224)
+    assert t.dtype == np.float32
+    assert np.isfinite(t).all()
+
+
+def test_round_trip_mixed_table(tmp_path):
+    v = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+         "d": np.linspace(0, 1, 5),
+         "l": np.asarray([3, 1, 2], np.int64),
+         "n": 5, "pi": 3.5, "s": "hello", "b": True, "none": None,
+         "nested": {"x": np.ones((2, 2), np.float64)}}
+    p = str(tmp_path / "t.t7")
+    save_t7(v, p)
+    v2 = load_t7(p)
+    np.testing.assert_array_equal(v2["w"], v["w"])
+    np.testing.assert_allclose(v2["d"], v["d"])
+    np.testing.assert_array_equal(v2["l"], v["l"])
+    assert v2["n"] == 5 and v2["pi"] == 3.5 and v2["s"] == "hello"
+    assert v2["b"] is True and v2["none"] is None
+    np.testing.assert_array_equal(v2["nested"]["x"], np.ones((2, 2)))
+
+
+def test_list_becomes_lua_table(tmp_path):
+    p = str(tmp_path / "l.t7")
+    save_t7([10, 20], p)
+    assert load_t7(p) == {1: 10, 2: 20}
+
+
+def test_overwrite_guard(tmp_path):
+    p = str(tmp_path / "x.t7")
+    save_t7(1, p)
+    with pytest.raises(FileExistsError):
+        save_t7(2, p, overwrite=False)
