@@ -11,3 +11,12 @@ from bigdl_tpu.nn import (  # noqa: F401
     MultiLabelSoftMarginCriterion, ParallelCriterion, SmoothL1Criterion,
     TimeDistributedCriterion,
 )
+from bigdl_tpu.nn import (  # noqa: F401,E402
+    CategoricalCrossEntropy, ClassSimplexCriterion, CosineDistanceCriterion,
+    CosineProximityCriterion, DiceCoefficientCriterion, DotProductCriterion,
+    GaussianCriterion, KLDCriterion, L1HingeEmbeddingCriterion,
+    MarginRankingCriterion, MeanAbsolutePercentageCriterion,
+    MeanSquaredLogarithmicCriterion, MultiLabelMarginCriterion,
+    MultiMarginCriterion, PoissonCriterion, SoftMarginCriterion,
+    TimeDistributedMaskCriterion, TransformerCriterion,
+)

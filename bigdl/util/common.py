@@ -58,9 +58,22 @@ def get_node_and_core_number(bigdl_type="float"):
 
 
 def samples_to_arrays(samples):
-    """list[Sample] -> (features ndarray, labels ndarray) stacked batches."""
+    """list[Sample] -> (features ndarray, labels ndarray) stacked batches.
+
+    Reference pyspark scripts use Torch's 1-BASED class labels (e.g. the
+    mnist example trains with label+1); bigdl_tpu criterions are 0-based,
+    so integral scalar labels with min >= 1 are shifted down by one here.
+    """
+    if any(len(s.features) > 1 or len(s.labels) > 1 for s in samples):
+        raise NotImplementedError(
+            "multi-tensor Samples are not supported by the compat facade; "
+            "use bigdl_tpu.dataset directly with tuple activities")
     feats = np.stack([s.feature.to_ndarray() for s in samples])
     labs = np.stack([s.label.to_ndarray() for s in samples])
     if labs.ndim == 2 and labs.shape[1] == 1:
         labs = labs[:, 0]
+    if (labs.ndim == 1 and np.issubdtype(labs.dtype, np.floating)
+            and np.all(labs == np.round(labs)) and labs.size
+            and labs.min() >= 1):
+        labs = labs - 1      # Torch 1-based -> 0-based
     return feats, labs

@@ -57,3 +57,40 @@ from bigdl_tpu.nn.criterion import (
     MultiLabelSoftMarginCriterion, MultiCriterion, ParallelCriterion,
     TimeDistributedCriterion,
 )
+from bigdl_tpu.nn.table_ops import (
+    SplitTable, BifurcateSplitTable, NarrowTable, MixtureTable, DotProduct,
+    CosineDistance, PairwiseDistance, MM, MV, CrossProduct, Index, Pack,
+    CAveTable, Bottle, SparseJoinTable,
+)
+from bigdl_tpu.nn.simple_layers import (
+    CAdd, CMul, Mul, Scale, Bilinear, Cosine, Euclidean, Maxout, Highway,
+    LocallyConnected1D, LocallyConnected2D, RReLU, SReLU, BinaryThreshold,
+    GaussianDropout, GaussianNoise, GradientReversal, Masking, MaskedSelect,
+    L1Penalty, ActivityRegularization, NegativeEntropyPenalty, Echo,
+    SpatialDropout1D, SpatialDropout2D, SpatialDropout3D, Sum, Mean, Max,
+    Min, Reverse, GaussianSampler,
+)
+from bigdl_tpu.nn.spatial_extras import (
+    SpatialZeroPadding, Cropping2D, Cropping3D, UpSampling1D, UpSampling2D,
+    UpSampling3D, ResizeBilinear, SpatialShareConvolution,
+    SpatialSeparableConvolution, SpatialWithinChannelLRN,
+    SpatialSubtractiveNormalization, SpatialDivisiveNormalization,
+    SpatialContrastiveNormalization, RoiPooling, TemporalMaxPooling,
+    VolumetricConvolution, VolumetricFullConvolution, VolumetricMaxPooling,
+    VolumetricAveragePooling,
+)
+from bigdl_tpu.nn.criterion_extras import (
+    SmoothL1CriterionWithWeights, SoftmaxWithCriterion, PGCriterion,
+    CategoricalCrossEntropy, CosineDistanceCriterion,
+    CosineProximityCriterion, DiceCoefficientCriterion, DotProductCriterion,
+    L1HingeEmbeddingCriterion, MarginRankingCriterion,
+    MeanAbsolutePercentageCriterion, MeanSquaredLogarithmicCriterion,
+    MultiLabelMarginCriterion, MultiMarginCriterion, PoissonCriterion,
+    SoftMarginCriterion, KLDCriterion, GaussianCriterion,
+    TransformerCriterion, TimeDistributedMaskCriterion,
+    ClassSimplexCriterion,
+)
+
+# reference-name aliases (the underlying class covers the same surface)
+from bigdl_tpu.nn.recurrent import RnnCell as RNN  # noqa: E402
+from bigdl_tpu.nn.graph import Graph as StaticGraph  # noqa: E402

@@ -1,0 +1,351 @@
+"""Criterion breadth: the remaining reference loss functions.
+
+Reference: nn/CategoricalCrossEntropy.scala, CosineDistanceCriterion.scala,
+CosineProximityCriterion.scala, DiceCoefficientCriterion.scala,
+DotProductCriterion.scala, L1HingeEmbeddingCriterion.scala,
+MarginRankingCriterion.scala, MeanAbsolutePercentageCriterion.scala,
+MeanSquaredLogarithmicCriterion.scala, MultiLabelMarginCriterion.scala,
+MultiMarginCriterion.scala, PoissonCriterion.scala,
+SoftMarginCriterion.scala, KLDCriterion.scala, GaussianCriterion.scala,
+TransformerCriterion.scala, TimeDistributedMaskCriterion.scala,
+ClassSimplexCriterion.scala.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.criterion import Criterion
+
+
+class CategoricalCrossEntropy(Criterion):
+    """-sum(target * log(prob)) with probability inputs
+    (reference: nn/CategoricalCrossEntropy.scala; keras semantics)."""
+
+    def __init__(self, epsilon=1e-8):
+        self.epsilon = epsilon
+
+    def apply(self, input, target):
+        p = jnp.clip(input, self.epsilon, 1.0)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        return -jnp.mean(jnp.sum(target * jnp.log(p), axis=-1))
+
+
+class CosineDistanceCriterion(Criterion):
+    """mean(1 - cos(input, target))
+    (reference: nn/CosineDistanceCriterion.scala)."""
+
+    def __init__(self, size_average=True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        num = jnp.sum(input * target, axis=-1)
+        den = jnp.maximum(jnp.linalg.norm(input, axis=-1)
+                          * jnp.linalg.norm(target, axis=-1), 1e-12)
+        loss = 1.0 - num / den
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class CosineProximityCriterion(Criterion):
+    """-mean(cos of l2-normalized input/target)
+    (reference: nn/CosineProximityCriterion.scala; keras cosine_proximity)."""
+
+    def apply(self, input, target):
+        xn = input / jnp.maximum(
+            jnp.linalg.norm(input, axis=-1, keepdims=True), 1e-12)
+        yn = target / jnp.maximum(
+            jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-12)
+        return -jnp.mean(jnp.sum(xn * yn, axis=-1))
+
+
+class DiceCoefficientCriterion(Criterion):
+    """1 - 2|X∩Y| / (|X|+|Y|) (reference:
+    nn/DiceCoefficientCriterion.scala)."""
+
+    def __init__(self, size_average=True, epsilon=1.0):
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def apply(self, input, target):
+        axes = tuple(range(1, input.ndim))
+        inter = jnp.sum(input * target, axis=axes)
+        union = jnp.sum(input, axis=axes) + jnp.sum(target, axis=axes)
+        loss = 1.0 - (2.0 * inter + self.epsilon) / (union + self.epsilon)
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class DotProductCriterion(Criterion):
+    """-sum(input * target) (reference: nn/DotProductCriterion.scala)."""
+
+    def __init__(self, size_average=False):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        dots = jnp.sum(input * target, axis=-1)
+        return -(jnp.mean(dots) if self.size_average else jnp.sum(dots))
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """Table input (x1, x2), y in {1, -1}: L1 distance hinge
+    (reference: nn/L1HingeEmbeddingCriterion.scala)."""
+
+    def __init__(self, margin=1.0):
+        self.margin = margin
+
+    def apply(self, input, target):
+        x1, x2 = input
+        d = jnp.sum(jnp.abs(x1 - x2), axis=-1)
+        y = jnp.reshape(target, d.shape)
+        loss = jnp.where(y > 0, d, jnp.maximum(0.0, self.margin - d))
+        return jnp.mean(loss)
+
+
+class MarginRankingCriterion(Criterion):
+    """Table input (x1, x2), y: max(0, -y*(x1-x2) + margin)
+    (reference: nn/MarginRankingCriterion.scala)."""
+
+    def __init__(self, margin=1.0, size_average=True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x1, x2 = input
+        y = jnp.reshape(target, jnp.shape(x1))
+        loss = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    """100 * mean(|x - y| / clip(|y|))
+    (reference: nn/MeanAbsolutePercentageCriterion.scala)."""
+
+    def apply(self, input, target):
+        diff = jnp.abs(input - target) / jnp.clip(jnp.abs(target), 1e-7,
+                                                  None)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    """mean((log(y+1) - log(x+1))^2)
+    (reference: nn/MeanSquaredLogarithmicCriterion.scala)."""
+
+    def apply(self, input, target):
+        a = jnp.log(jnp.clip(input, 1e-7, None) + 1.0)
+        b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
+        return jnp.mean(jnp.square(a - b))
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-label hinge: targets are 0-padded lists of class indices
+    (0-based here; reference nn/MultiLabelMarginCriterion.scala is 1-based
+    with 0 as the stop marker -- here -1 marks padding)."""
+
+    def __init__(self, size_average=True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        n, c = input.shape
+        tgt = target.astype(jnp.int32)
+        valid = tgt >= 0
+        safe = jnp.clip(tgt, 0, c - 1)
+        is_target = jnp.sum(
+            jax.nn.one_hot(safe, c) * valid[:, :, None], axis=1) > 0
+        x_t = jnp.take_along_axis(input, safe, axis=1)     # (n, k)
+        margins = 1.0 - (x_t[:, :, None] - input[:, None, :])   # (n,k,c)
+        mask = (valid[:, :, None] & ~is_target[:, None, :])
+        loss = jnp.sum(jnp.maximum(0.0, margins) * mask, axis=(1, 2)) / c
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class MultiMarginCriterion(Criterion):
+    """Single-label margin hinge: sum_j max(0, margin - x_y + x_j)^p / C
+    (reference: nn/MultiMarginCriterion.scala)."""
+
+    def __init__(self, p=1, weights=None, margin=1.0, size_average=True):
+        self.p = p
+        self.weights = weights
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        n, c = input.shape
+        t = jnp.clip(target.astype(jnp.int32), 0, c - 1)
+        x_t = jnp.take_along_axis(input, t[:, None], axis=1)
+        m = jnp.maximum(0.0, self.margin - x_t + input) ** self.p
+        if self.weights is not None:
+            m = m * jnp.asarray(self.weights)[t][:, None]
+        m = m * (1.0 - jax.nn.one_hot(t, c))
+        loss = jnp.sum(m, axis=1) / c
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class PoissonCriterion(Criterion):
+    """mean(input - target * log(input))
+    (reference: nn/PoissonCriterion.scala)."""
+
+    def apply(self, input, target):
+        return jnp.mean(input - target
+                        * jnp.log(jnp.clip(input, 1e-7, None)))
+
+
+class SoftMarginCriterion(Criterion):
+    """mean(log(1 + exp(-y * x))) (reference: nn/SoftMarginCriterion.scala)."""
+
+    def __init__(self, size_average=True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        loss = jnp.log1p(jnp.exp(-target * input))
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class KLDCriterion(Criterion):
+    """KL(N(mu, sigma^2) || N(0, 1)) from (mean, log_var) table input — the
+    VAE regularizer (reference: nn/KLDCriterion.scala)."""
+
+    def __init__(self, size_average=True):
+        self.size_average = size_average
+
+    def apply(self, input, target=None):
+        mean, log_var = input
+        kld = 0.5 * jnp.sum(
+            jnp.square(mean) + jnp.exp(log_var) - 1.0 - log_var, axis=-1)
+        return jnp.mean(kld) if self.size_average else jnp.sum(kld)
+
+
+class GaussianCriterion(Criterion):
+    """Negative log-likelihood of target under N(mean, exp(log_var))
+    given a (mean, log_var) table input
+    (reference: nn/GaussianCriterion.scala)."""
+
+    def apply(self, input, target):
+        mean, log_var = input
+        nll = 0.5 * (jnp.log(2.0 * jnp.pi) + log_var
+                     + jnp.square(target - mean) / jnp.exp(log_var))
+        return jnp.sum(nll)
+
+
+class TransformerCriterion(Criterion):
+    """Wrap a criterion with input/target transformer modules
+    (reference: nn/TransformerCriterion.scala)."""
+
+    def __init__(self, criterion, input_transformer=None,
+                 target_transformer=None):
+        self.criterion = criterion
+        self.input_transformer = input_transformer
+        self.target_transformer = target_transformer
+
+    def _run(self, mod, x):
+        if mod is None:
+            return x
+        if not mod.is_built():
+            from bigdl_tpu.utils.shape import spec_of
+            mod.build(spec_of(x))
+        y, _ = mod.apply(mod._params, mod._state, x)
+        return y
+
+    def apply(self, input, target):
+        return self.criterion.apply(
+            self._run(self.input_transformer, input),
+            self._run(self.target_transformer, target))
+
+
+class TimeDistributedMaskCriterion(Criterion):
+    """Per-timestep criterion with a padding mask: entries where target ==
+    ``padding_value`` contribute nothing
+    (reference: nn/TimeDistributedMaskCriterion.scala)."""
+
+    def __init__(self, criterion, padding_value=0):
+        self.criterion = criterion
+        self.padding_value = padding_value
+
+    def apply(self, input, target):
+        n, t = target.shape[0], target.shape[1]
+        flat_in = input.reshape((n * t,) + input.shape[2:])
+        flat_t = target.reshape((n * t,) + target.shape[2:])
+        mask = (flat_t != self.padding_value).astype(flat_in.dtype)
+        per = jax.vmap(
+            lambda x, y: self.criterion.apply(x[None], y[None]))(
+                flat_in, flat_t)
+        m = mask.reshape(per.shape) if mask.ndim == per.ndim else mask
+        return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against a regular simplex embedding of the classes
+    (reference: nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes):
+        import numpy as np
+        self.n_classes = n_classes
+        # orthonormal corner embedding (the reference's simplex up to
+        # rotation; targets map to distinct equidistant vertices)
+        self.simplex = jnp.asarray(np.eye(n_classes, dtype=np.float32))
+
+    def apply(self, input, target):
+        t = jnp.clip(target.astype(jnp.int32), 0, self.n_classes - 1)
+        goal = self.simplex[t]
+        k = goal.shape[-1]
+        return jnp.mean(jnp.sum(jnp.square(input[..., :k] - goal), axis=-1))
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """Smooth-L1 with per-element inside/outside weights, as used by the
+    Fast-RCNN bbox head (reference: nn/SmoothL1CriterionWithWeights.scala).
+
+    ``target`` is (targets, inside_w, outside_w) or a plain tensor (weights
+    default to 1)."""
+
+    def __init__(self, sigma=1.0, num=0):
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def apply(self, input, target):
+        if isinstance(target, tuple):
+            tgt, w_in, w_out = target
+        else:
+            tgt = target
+            w_in = w_out = jnp.ones_like(input)
+        d = w_in * (input - tgt)
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / self.sigma2,
+                         0.5 * self.sigma2 * d * d,
+                         ad - 0.5 / self.sigma2)
+        total = jnp.sum(w_out * loss)
+        return total / self.num if self.num > 0 else total
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Softmax + NLL over NHWC spatial maps, with optional label ignore —
+    caffe's SoftmaxWithLoss (reference: nn/SoftmaxWithCriterion.scala)."""
+
+    def __init__(self, ignore_label=None, normalize_mode="VALID"):
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def apply(self, input, target):
+        logp = jax.nn.log_softmax(input, axis=-1)
+        t = jnp.clip(target.astype(jnp.int32), 0, input.shape[-1] - 1)
+        nll = -jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
+        if self.ignore_label is not None:
+            mask = (target != self.ignore_label).astype(nll.dtype)
+            nll = nll * mask
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            denom = nll.size
+        if self.normalize_mode == "NONE":
+            return jnp.sum(nll)
+        return jnp.sum(nll) / denom
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient criterion: -sum(target * log prob) with the target
+    carrying (one-hot action * advantage)
+    (reference: nn/PGCriterion.scala)."""
+
+    def __init__(self, size_average=False):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        logp = jnp.log(jnp.clip(input, 1e-8, 1.0))
+        loss = -jnp.sum(target * logp, axis=-1)
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)

@@ -1,0 +1,620 @@
+"""Parameterized small layers, stochastic regularizers, penalties, reducers.
+
+Reference: nn/CAdd.scala, CMul.scala, Mul.scala, Scale.scala,
+Bilinear.scala, Cosine.scala, Euclidean.scala, Maxout.scala, Highway.scala,
+LocallyConnected{1D,2D}.scala, RReLU.scala, SReLU.scala,
+BinaryThreshold.scala, GaussianDropout.scala, GaussianNoise.scala,
+GradientReversal.scala, Masking.scala, MaskedSelect.scala, L1Penalty.scala,
+ActivityRegularization.scala, NegativeEntropyPenalty.scala, Echo.scala,
+SpatialDropout{1D,2D,3D}.scala, Sum.scala, Mean.scala, Max.scala,
+Min.scala, Reverse.scala, GaussianSampler.scala.
+
+TPU-native notes: penalties (L1Penalty & co.) are identity maps whose
+regularization enters through ``jax.custom_vjp`` (the reference mutates
+gradInput in ``updateGradInput``); stochastic layers consume the traced
+``rng`` key.  All dims 0-based.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import RandomUniform, Xavier, Zeros
+from bigdl_tpu.nn.module import Module, child_rng
+
+
+class CAdd(Module):
+    """Learnable broadcast bias of shape ``size``
+    (reference: nn/CAdd.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def setup(self, rng, input_spec):
+        return {"bias": jnp.zeros(self.size, jnp.float32)}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input + params["bias"].astype(input.dtype), state
+
+
+class CMul(Module):
+    """Learnable broadcast scale of shape ``size``
+    (reference: nn/CMul.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def setup(self, rng, input_spec):
+        fan = max(int(jnp.prod(jnp.asarray(self.size))), 1)
+        w = RandomUniform(-1.0 / fan ** 0.5, 1.0 / fan ** 0.5).init(
+            rng, self.size, fan, fan)
+        return {"weight": w}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input * params["weight"].astype(input.dtype), state
+
+
+class Mul(Module):
+    """Single learnable scalar multiplier (reference: nn/Mul.scala)."""
+
+    def setup(self, rng, input_spec):
+        return {"weight": jnp.ones((), jnp.float32)}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input * params["weight"].astype(input.dtype), state
+
+
+class Scale(Module):
+    """CMul then CAdd (reference: nn/Scale.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def setup(self, rng, input_spec):
+        return {"weight": jnp.ones(self.size, jnp.float32),
+                "bias": jnp.zeros(self.size, jnp.float32)}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return (input * params["weight"].astype(input.dtype)
+                + params["bias"].astype(input.dtype)), state
+
+
+class Bilinear(Module):
+    """(x1, x2) -> x1 W x2 + b, output ``output_size``
+    (reference: nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1, input_size2, output_size, bias_res=True,
+                 name=None):
+        super().__init__(name)
+        self.d1, self.d2, self.out = input_size1, input_size2, output_size
+        self.bias_res = bias_res
+
+    def setup(self, rng, input_spec):
+        k = 1.0 / self.d1 ** 0.5
+        w = RandomUniform(-k, k).init(
+            rng, (self.out, self.d1, self.d2), self.d1, self.out)
+        params = {"weight": w}
+        if self.bias_res:
+            params["bias"] = jnp.zeros((self.out,), jnp.float32)
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x1, x2 = input
+        w = params["weight"].astype(x1.dtype)
+        y = jnp.einsum("ni,oij,nj->no", x1, w, x2)
+        if self.bias_res:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+
+class Cosine(Module):
+    """Cosine similarity of the input to each of ``output_size`` weight rows
+    (reference: nn/Cosine.scala)."""
+
+    def __init__(self, input_size, output_size, name=None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+
+    def setup(self, rng, input_spec):
+        w = Xavier().init(rng, (self.output_size, self.input_size),
+                          self.input_size, self.output_size)
+        return {"weight": w}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w = params["weight"].astype(input.dtype)
+        xn = input / jnp.maximum(
+            jnp.linalg.norm(input, axis=-1, keepdims=True), 1e-12)
+        wn = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True),
+                             1e-12)
+        return xn @ wn.T, state
+
+
+class Euclidean(Module):
+    """Euclidean distance of the input to each weight row
+    (reference: nn/Euclidean.scala)."""
+
+    def __init__(self, input_size, output_size, name=None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+
+    def setup(self, rng, input_spec):
+        w = Xavier().init(rng, (self.output_size, self.input_size),
+                          self.input_size, self.output_size)
+        return {"weight": w}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w = params["weight"].astype(input.dtype)
+        diff = input[:, None, :] - w[None, :, :]
+        return jnp.linalg.norm(diff, axis=-1), state
+
+
+class Maxout(Module):
+    """Linear to pool*out features, max over each pool group
+    (reference: nn/Maxout.scala)."""
+
+    def __init__(self, input_size, output_size, maxout_number, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.maxout_number = maxout_number
+
+    def setup(self, rng, input_spec):
+        n_out = self.output_size * self.maxout_number
+        w = Xavier().init(rng, (n_out, self.input_size), self.input_size,
+                          n_out)
+        return {"weight": w, "bias": jnp.zeros((n_out,), jnp.float32)}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y = input @ params["weight"].astype(input.dtype).T \
+            + params["bias"].astype(input.dtype)
+        y = y.reshape(y.shape[:-1] + (self.maxout_number, self.output_size))
+        return jnp.max(y, axis=-2), state
+
+
+class Highway(Module):
+    """y = t * g(Wx+b) + (1-t) * x with t = sigmoid(Wt x + bt)
+    (reference: nn/Highway.scala)."""
+
+    def __init__(self, size, with_bias=True, activation=None, name=None):
+        super().__init__(name)
+        self.size = size
+        self.with_bias = with_bias
+        self.activation = activation
+
+    def setup(self, rng, input_spec):
+        w1 = Xavier().init(child_rng(rng, 0), (self.size, self.size),
+                           self.size, self.size)
+        w2 = Xavier().init(child_rng(rng, 1), (self.size, self.size),
+                           self.size, self.size)
+        params = {"w_t": w1, "w_h": w2}
+        if self.with_bias:
+            # gate bias < 0 biases toward carry at init (keras convention)
+            params["b_t"] = jnp.full((self.size,), -1.0, jnp.float32)
+            params["b_h"] = jnp.zeros((self.size,), jnp.float32)
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t = input @ params["w_t"].astype(input.dtype).T
+        h = input @ params["w_h"].astype(input.dtype).T
+        if self.with_bias:
+            t = t + params["b_t"].astype(input.dtype)
+            h = h + params["b_h"].astype(input.dtype)
+        t = jax.nn.sigmoid(t)
+        if self.activation is not None:
+            h, _ = self.activation.apply((), (), h)
+        else:
+            h = jnp.tanh(h)
+        return t * h + (1.0 - t) * input, state
+
+
+class LocallyConnected2D(Module):
+    """Unshared 2-D convolution: one kernel per output position
+    (reference: nn/LocallyConnected2D.scala).  NHWC; implemented as
+    patch-extraction + per-position einsum, which XLA maps to batched
+    matmuls on the MXU."""
+
+    def __init__(self, n_input_plane, input_width, input_height,
+                 n_output_plane, kernel_w, kernel_h, stride_w=1, stride_h=1,
+                 pad_w=0, pad_h=0, with_bias=True, name=None):
+        super().__init__(name)
+        self.cin = n_input_plane
+        self.cout = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.with_bias = with_bias
+        self.in_hw = (input_height, input_width)
+
+    def _out_hw(self):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        h, w = self.in_hw
+        return ((h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1)
+
+    def setup(self, rng, input_spec):
+        kh, kw = self.kernel
+        oh, ow = self._out_hw()
+        fan_in = self.cin * kh * kw
+        w = Xavier().init(rng, (oh, ow, kh * kw * self.cin, self.cout),
+                          fan_in, self.cout)
+        params = {"weight": w}
+        if self.with_bias:
+            params["bias"] = jnp.zeros((oh, ow, self.cout), jnp.float32)
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from jax import lax
+        kh, kw = self.kernel
+        patches = lax.conv_general_dilated_patches(
+            input, (kh, kw), self.stride,
+            [(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # patches: (N, OH, OW, C*kh*kw) with channel-major ordering; weight
+        # stored to match
+        y = jnp.einsum("nhwk,hwko->nhwo", patches,
+                       params["weight"].astype(input.dtype))
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+
+class LocallyConnected1D(Module):
+    """Unshared temporal convolution over (N, T, C)
+    (reference: nn/LocallyConnected1D.scala)."""
+
+    def __init__(self, n_input_frame, input_frame_size, output_frame_size,
+                 kernel_w, stride_w=1, with_bias=True, name=None):
+        super().__init__(name)
+        self.n_input_frame = n_input_frame
+        self.cin = input_frame_size
+        self.cout = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.with_bias = with_bias
+
+    def setup(self, rng, input_spec):
+        ot = (self.n_input_frame - self.kernel_w) // self.stride_w + 1
+        fan_in = self.cin * self.kernel_w
+        w = Xavier().init(rng, (ot, self.kernel_w * self.cin, self.cout),
+                          fan_in, self.cout)
+        params = {"weight": w}
+        if self.with_bias:
+            params["bias"] = jnp.zeros((ot, self.cout), jnp.float32)
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ot = (input.shape[1] - self.kernel_w) // self.stride_w + 1
+        idx = (jnp.arange(ot)[:, None] * self.stride_w
+               + jnp.arange(self.kernel_w)[None, :])
+        windows = input[:, idx, :]                  # (N, OT, kW, C)
+        windows = windows.reshape(windows.shape[0], ot, -1)
+        y = jnp.einsum("ntk,tko->nto", windows,
+                       params["weight"].astype(input.dtype))
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU: slope ~ U(lower, upper) at train, the mean
+    slope at eval (reference: nn/RReLU.scala)."""
+
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__(name)
+        self.lower, self.upper = lower, upper
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, input.shape, input.dtype,
+                                   self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(input >= 0, input, a * input), state
+
+
+class SReLU(Module):
+    """S-shaped ReLU with 4 learnable per-channel params
+    (reference: nn/SReLU.scala, keras SReLU)."""
+
+    def __init__(self, shared_axes=None, name=None):
+        super().__init__(name)
+        self.shared_axes = shared_axes
+
+    def setup(self, rng, input_spec):
+        shape = list(input_spec.shape[1:])
+        if self.shared_axes:
+            for ax in self.shared_axes:
+                shape[ax - 1] = 1
+        shape = tuple(shape)
+        return {"t_left": jnp.zeros(shape, jnp.float32),
+                "a_left": jnp.zeros(shape, jnp.float32),
+                "t_right": jnp.ones(shape, jnp.float32),
+                "a_right": jnp.ones(shape, jnp.float32)}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        tl = params["t_left"].astype(input.dtype)
+        al = params["a_left"].astype(input.dtype)
+        tr = params["t_right"].astype(input.dtype)
+        ar = params["a_right"].astype(input.dtype)
+        y = jnp.where(input <= tl, tl + al * (input - tl), input)
+        return jnp.where(y >= tr, tr + ar * (y - tr), y), state
+
+
+class BinaryThreshold(Module):
+    """x > th ? 1 : 0 (reference: nn/BinaryThreshold.scala)."""
+
+    def __init__(self, th=1e-6, name=None):
+        super().__init__(name)
+        self.th = th
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return (input > self.th).astype(input.dtype), state
+
+
+class GaussianDropout(Module):
+    """Multiply by N(1, rate/(1-rate)) at train
+    (reference: nn/GaussianDropout.scala)."""
+
+    def __init__(self, rate, name=None):
+        super().__init__(name)
+        self.rate = rate
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or rng is None or self.rate <= 0:
+            return input, state
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + std * jax.random.normal(rng, input.shape, input.dtype)
+        return input * noise, state
+
+
+class GaussianNoise(Module):
+    """Additive N(0, stddev) noise at train
+    (reference: nn/GaussianNoise.scala)."""
+
+    def __init__(self, stddev, name=None):
+        super().__init__(name)
+        self.stddev = stddev
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or rng is None:
+            return input, state
+        return input + self.stddev * jax.random.normal(
+            rng, input.shape, input.dtype), state
+
+
+class GradientReversal(Module):
+    """Identity forward, gradient scaled by ``-lambda`` backward
+    (reference: nn/GradientReversal.scala)."""
+
+    def __init__(self, the_lambda=1.0, name=None):
+        super().__init__(name)
+        self.the_lambda = the_lambda
+
+        @jax.custom_vjp
+        def rev(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, g):
+            return (-self.the_lambda * g,)
+
+        rev.defvjp(fwd, bwd)
+        self._rev = rev
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self._rev(input), state
+
+
+class Masking(Module):
+    """Zero every timestep whose features all equal ``mask_value``
+    (reference: nn/Masking.scala)."""
+
+    def __init__(self, mask_value=0.0, name=None):
+        super().__init__(name)
+        self.mask_value = mask_value
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        keep = jnp.any(input != self.mask_value, axis=-1, keepdims=True)
+        return input * keep.astype(input.dtype), state
+
+
+class MaskedSelect(Module):
+    """(tensor, mask) -> selected elements.  Dynamic output size: the
+    reference returns a 1-D tensor of the mask's true entries
+    (nn/MaskedSelect.scala); under jit this is not traceable, so eager use
+    only (guarded with a clear error)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t, mask = input
+        if isinstance(t, jax.core.Tracer):
+            raise NotImplementedError(
+                "MaskedSelect produces a data-dependent shape; use it "
+                "eagerly (outside jit), or mask with where() instead")
+        import numpy as np
+        return jnp.asarray(np.asarray(t)[np.asarray(mask).astype(bool)]), \
+            state
+
+
+def _identity_with_penalty_grad(penalty_grad_fn):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(x, g):
+        return (g + penalty_grad_fn(x),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+class L1Penalty(Module):
+    """Identity whose backward adds ``l1weight * sign(x)``
+    (reference: nn/L1Penalty.scala adds the penalty in updateGradInput)."""
+
+    def __init__(self, l1weight, size_average=False, provide_output=True,
+                 name=None):
+        super().__init__(name)
+        self.l1weight = l1weight
+        self.size_average = size_average
+        self._f = _identity_with_penalty_grad(self._grad)
+
+    def _grad(self, x):
+        w = self.l1weight / x.size if self.size_average else self.l1weight
+        return w * jnp.sign(x)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self._f(input) if training else input, state
+
+
+class ActivityRegularization(Module):
+    """Identity + (l1 |x| + l2 x^2) penalty gradient
+    (reference: nn/ActivityRegularization.scala)."""
+
+    def __init__(self, l1=0.0, l2=0.0, name=None):
+        super().__init__(name)
+        self.l1, self.l2 = l1, l2
+        self._f = _identity_with_penalty_grad(
+            lambda x: self.l1 * jnp.sign(x) + 2.0 * self.l2 * x)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self._f(input) if training else input, state
+
+
+class NegativeEntropyPenalty(Module):
+    """Identity + beta * d(-H(p))/dp penalty gradient over probabilities
+    (reference: nn/NegativeEntropyPenalty.scala)."""
+
+    def __init__(self, beta=0.01, name=None):
+        super().__init__(name)
+        self.beta = beta
+        self._f = _identity_with_penalty_grad(
+            lambda p: self.beta * (jnp.log(jnp.maximum(p, 1e-12)) + 1.0))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self._f(input) if training else input, state
+
+
+class Echo(Module):
+    """Identity that logs the activation shape when traced
+    (reference: nn/Echo.scala prints to stdout)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import logging
+        logging.getLogger("bigdl_tpu").info(
+            "Echo %s: shape %s dtype %s", self.name, input.shape, input.dtype)
+        return input, state
+
+
+class _SpatialDropoutBase(Module):
+    drop_axes = ()
+
+    def __init__(self, init_p=0.5, name=None):
+        super().__init__(name)
+        self.p = init_p
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or rng is None or self.p <= 0:
+            return input, state
+        shape = list(input.shape)
+        for ax in self.drop_axes:
+            shape[ax] = 1
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, tuple(shape))
+        return input * keep.astype(input.dtype) / (1.0 - self.p), state
+
+
+class SpatialDropout1D(_SpatialDropoutBase):
+    """Drop whole channels of (N, T, C)
+    (reference: nn/SpatialDropout1D.scala)."""
+    drop_axes = (1,)
+
+
+class SpatialDropout2D(_SpatialDropoutBase):
+    """Drop whole channels of (N, H, W, C)
+    (reference: nn/SpatialDropout2D.scala)."""
+    drop_axes = (1, 2)
+
+
+class SpatialDropout3D(_SpatialDropoutBase):
+    """Drop whole channels of (N, D, H, W, C)
+    (reference: nn/SpatialDropout3D.scala)."""
+    drop_axes = (1, 2, 3)
+
+
+class _ReduceDim(Module):
+    def __init__(self, dimension=0, squeeze=True, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.squeeze = squeeze
+
+    def fn(self, x, axis, keepdims):
+        raise NotImplementedError
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self.fn(input, self.dimension, not self.squeeze), state
+
+
+class Sum(_ReduceDim):
+    """Sum over ``dimension`` (reference: nn/Sum.scala)."""
+
+    def __init__(self, dimension=0, squeeze=True, size_average=False,
+                 name=None):
+        super().__init__(dimension, squeeze, name)
+        self.size_average = size_average
+
+    def fn(self, x, axis, keepdims):
+        y = jnp.sum(x, axis=axis, keepdims=keepdims)
+        if self.size_average:
+            y = y / x.shape[axis]
+        return y
+
+
+class Mean(_ReduceDim):
+    """Mean over ``dimension`` (reference: nn/Mean.scala)."""
+
+    def fn(self, x, axis, keepdims):
+        return jnp.mean(x, axis=axis, keepdims=keepdims)
+
+
+class Max(_ReduceDim):
+    """Max over ``dimension`` (reference: nn/Max.scala)."""
+
+    def fn(self, x, axis, keepdims):
+        return jnp.max(x, axis=axis, keepdims=keepdims)
+
+
+class Min(_ReduceDim):
+    """Min over ``dimension`` (reference: nn/Min.scala)."""
+
+    def fn(self, x, axis, keepdims):
+        return jnp.min(x, axis=axis, keepdims=keepdims)
+
+
+class Reverse(Module):
+    """Flip along ``dimension`` (reference: nn/Reverse.scala)."""
+
+    def __init__(self, dimension=0, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.flip(input, axis=self.dimension), state
+
+
+class GaussianSampler(Module):
+    """(mean, log_var) -> mean + exp(log_var/2) * eps — the VAE
+    reparameterization (reference: nn/GaussianSampler.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        mean, log_var = input
+        if rng is None:
+            return mean, state
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(log_var * 0.5) * eps, state

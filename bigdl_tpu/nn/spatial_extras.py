@@ -1,0 +1,440 @@
+"""Spatial, temporal and volumetric layer extras.
+
+Reference: nn/SpatialZeroPadding.scala, Cropping{2D,3D}.scala,
+UpSampling{1D,2D,3D}.scala, ResizeBilinear.scala,
+SpatialSeparableConvolution.scala, SpatialShareConvolution.scala,
+SpatialWithinChannelLRN.scala, SpatialSubtractiveNormalization.scala,
+SpatialDivisiveNormalization.scala, SpatialContrastiveNormalization.scala,
+RoiPooling.scala, TemporalMaxPooling.scala,
+Volumetric{Convolution,MaxPooling,AveragePooling,FullConvolution}.scala.
+
+Layout: NHWC for 2-D, NDHWC for 3-D (TPU-native); reference is NCHW/NCDHW.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.conv import SpatialConvolution
+from bigdl_tpu.nn.initialization import Xavier
+from bigdl_tpu.nn.module import Module, child_rng
+
+
+class SpatialZeroPadding(Module):
+    """Zero-pad H/W (reference: nn/SpatialZeroPadding.scala; negatives
+    crop)."""
+
+    def __init__(self, pad_left, pad_right=None, pad_top=None,
+                 pad_bottom=None, name=None):
+        super().__init__(name)
+        self.pads = (pad_left,
+                     pad_left if pad_right is None else pad_right,
+                     pad_left if pad_top is None else pad_top,
+                     pad_left if pad_bottom is None else pad_bottom)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        l, r, t, b = self.pads
+        x = input
+        if min(self.pads) < 0:
+            h, w = x.shape[1], x.shape[2]
+            x = x[:, max(-t, 0):h - max(-b, 0),
+                  max(-l, 0):w - max(-r, 0), :]
+        cfg = [(0, 0), (max(t, 0), max(b, 0)), (max(l, 0), max(r, 0)),
+               (0, 0)]
+        return jnp.pad(x, cfg), state
+
+
+class Cropping2D(Module):
+    """Crop H/W (reference: nn/Cropping2D.scala)."""
+
+    def __init__(self, height_crop=(0, 0), width_crop=(0, 0), name=None):
+        super().__init__(name)
+        self.hc, self.wc = tuple(height_crop), tuple(width_crop)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        h, w = input.shape[1], input.shape[2]
+        return input[:, self.hc[0]:h - self.hc[1],
+                     self.wc[0]:w - self.wc[1], :], state
+
+
+class Cropping3D(Module):
+    """Crop D/H/W of NDHWC (reference: nn/Cropping3D.scala)."""
+
+    def __init__(self, dim1_crop=(0, 0), dim2_crop=(0, 0), dim3_crop=(0, 0),
+                 name=None):
+        super().__init__(name)
+        self.c1, self.c2, self.c3 = (tuple(dim1_crop), tuple(dim2_crop),
+                                     tuple(dim3_crop))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        d, h, w = input.shape[1], input.shape[2], input.shape[3]
+        return input[:, self.c1[0]:d - self.c1[1],
+                     self.c2[0]:h - self.c2[1],
+                     self.c3[0]:w - self.c3[1], :], state
+
+
+class UpSampling1D(Module):
+    """Repeat timesteps ``length`` times (reference: nn/UpSampling1D.scala)."""
+
+    def __init__(self, length=2, name=None):
+        super().__init__(name)
+        self.length = length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.repeat(input, self.length, axis=1), state
+
+
+class UpSampling2D(Module):
+    """Nearest-neighbour upsample H/W (reference: nn/UpSampling2D.scala)."""
+
+    def __init__(self, size=(2, 2), name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = jnp.repeat(input, self.size[0], axis=1)
+        return jnp.repeat(x, self.size[1], axis=2), state
+
+
+class UpSampling3D(Module):
+    """Nearest-neighbour upsample D/H/W (reference: nn/UpSampling3D.scala)."""
+
+    def __init__(self, size=(2, 2, 2), name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = jnp.repeat(input, self.size[0], axis=1)
+        x = jnp.repeat(x, self.size[1], axis=2)
+        return jnp.repeat(x, self.size[2], axis=3), state
+
+
+class ResizeBilinear(Module):
+    """Bilinear resize to (out_height, out_width)
+    (reference: nn/ResizeBilinear.scala; align_corners semantics)."""
+
+    def __init__(self, out_height, out_width, align_corners=False,
+                 name=None):
+        super().__init__(name)
+        self.out_hw = (out_height, out_width)
+        self.align_corners = align_corners
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        n, _, _, c = input.shape
+        if self.align_corners:
+            h, w = input.shape[1], input.shape[2]
+            oh, ow = self.out_hw
+            ys = jnp.linspace(0, h - 1, oh)
+            xs = jnp.linspace(0, w - 1, ow)
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs).astype(jnp.int32)
+            y1 = jnp.minimum(y0 + 1, h - 1)
+            x1 = jnp.minimum(x0 + 1, w - 1)
+            wy = (ys - y0)[None, :, None, None]
+            wx = (xs - x0)[None, None, :, None]
+            g = input
+            out = ((1 - wy) * (1 - wx) * g[:, y0][:, :, x0]
+                   + (1 - wy) * wx * g[:, y0][:, :, x1]
+                   + wy * (1 - wx) * g[:, y1][:, :, x0]
+                   + wy * wx * g[:, y1][:, :, x1])
+            return out, state
+        out = jax.image.resize(input, (n,) + self.out_hw + (c,), "bilinear")
+        return out, state
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Alias of SpatialConvolution: the reference variant shares im2col
+    buffers across replicas (nn/SpatialShareConvolution.scala), a concern
+    XLA's buffer assignment makes moot."""
+
+
+class SpatialSeparableConvolution(Module):
+    """Depthwise conv (multiplier per channel) + 1x1 pointwise
+    (reference: nn/SpatialSeparableConvolution.scala)."""
+
+    def __init__(self, n_input_channel, n_output_channel, depth_multiplier,
+                 kernel_w, kernel_h, stride_w=1, stride_h=1, pad_w=0,
+                 pad_h=0, with_bias=True, name=None):
+        super().__init__(name)
+        self.cin = n_input_channel
+        self.cout = n_output_channel
+        self.mult = depth_multiplier
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.with_bias = with_bias
+
+    def setup(self, rng, input_spec):
+        kh, kw = self.kernel
+        mid = self.cin * self.mult
+        dw = Xavier().init(child_rng(rng, 0), (kh, kw, 1, mid),
+                           kh * kw, self.mult)
+        pw = Xavier().init(child_rng(rng, 1), (1, 1, mid, self.cout),
+                           mid, self.cout)
+        params = {"depth_weight": dw, "point_weight": pw}
+        if self.with_bias:
+            params["bias"] = jnp.zeros((self.cout,), jnp.float32)
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ph, pw_ = self.pad
+        y = lax.conv_general_dilated(
+            input, params["depth_weight"].astype(input.dtype),
+            self.stride, [(ph, ph), (pw_, pw_)],
+            feature_group_count=self.cin,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = lax.conv_general_dilated(
+            y, params["point_weight"].astype(y.dtype), (1, 1),
+            [(0, 0), (0, 0)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+
+def _spatial_avg_window(x, size):
+    """Mean over a size x size spatial window, SAME padding, per channel."""
+    dims, strides = (1, size, size, 1), (1, 1, 1, 1)
+    total = lax.reduce_window(x, 0.0, lax.add, dims, strides, "SAME")
+    count = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides,
+                              "SAME")
+    return total / count
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN over a spatial window within each channel
+    (reference: nn/SpatialWithinChannelLRN.scala)."""
+
+    def __init__(self, size=5, alpha=1.0, beta=0.75, name=None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x32 = input.astype(jnp.float32)
+        mean_sq = _spatial_avg_window(jnp.square(x32), self.size)
+        denom = jnp.power(1.0 + self.alpha * mean_sq, self.beta)
+        return (x32 / denom).astype(input.dtype), state
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract the local (kernel-weighted) mean
+    (reference: nn/SpatialSubtractiveNormalization.scala; uniform kernel)."""
+
+    def __init__(self, n_input_plane=1, kernel_size=9, name=None):
+        super().__init__(name)
+        self.size = kernel_size
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input - _spatial_avg_window(input, self.size), state
+
+
+class SpatialDivisiveNormalization(Module):
+    """Divide by the local std (reference:
+    nn/SpatialDivisiveNormalization.scala; threshold at the global mean
+    std like the reference)."""
+
+    def __init__(self, n_input_plane=1, kernel_size=9, threshold=1e-4,
+                 name=None):
+        super().__init__(name)
+        self.size = kernel_size
+        self.threshold = threshold
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        local_sq = _spatial_avg_window(jnp.square(input), self.size)
+        local_std = jnp.sqrt(jnp.maximum(local_sq, 0.0))
+        mean_std = jnp.mean(local_std, axis=(1, 2, 3), keepdims=True)
+        denom = jnp.maximum(jnp.maximum(local_std, mean_std), self.threshold)
+        return input / denom, state
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization
+    (reference: nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane=1, kernel_size=9, threshold=1e-4,
+                 name=None):
+        super().__init__(name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane,
+                                                   kernel_size)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel_size,
+                                                threshold)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y, _ = self.sub.apply((), (), input)
+        return self.div.apply((), (), y)[0], state
+
+
+class RoiPooling(Module):
+    """ROI max pooling: (features NHWC, rois (R, 5) [batch, x1, y1, x2, y2])
+    -> (R, pooled_h, pooled_w, C) (reference: nn/RoiPooling.scala).
+
+    Implemented as a vectorized bin-assignment + segment max — static
+    shapes, no gather loops, jit-safe.
+    """
+
+    def __init__(self, pooled_w, pooled_h, spatial_scale=1.0, name=None):
+        super().__init__(name)
+        self.pw, self.ph = pooled_w, pooled_h
+        self.scale = spatial_scale
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        feats, rois = input
+        n, h, w, c = feats.shape
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+
+        def one_roi(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.scale)
+            y1 = jnp.round(roi[2] * self.scale)
+            x2 = jnp.round(roi[3] * self.scale)
+            y2 = jnp.round(roi[4] * self.scale)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            bin_y = jnp.floor((ys - y1) * self.ph / rh)
+            bin_x = jnp.floor((xs - x1) * self.pw / rw)
+            in_y = (ys >= y1) & (ys <= y2)
+            in_x = (xs >= x1) & (xs <= x2)
+            by = jnp.where(in_y, jnp.clip(bin_y, 0, self.ph - 1), -1) \
+                .astype(jnp.int32)                 # (H,), -1 = outside roi
+            bx = jnp.where(in_x, jnp.clip(bin_x, 0, self.pw - 1), -1) \
+                .astype(jnp.int32)                 # (W,)
+            fmap = feats[b]                        # (H, W, C)
+
+            # per-bin masked max via fori_loop: O(H*W*C) peak memory
+            def bin_body(i, acc):
+                iy, ix = i // self.pw, i % self.pw
+                mask = ((by == iy)[:, None] & (bx == ix)[None, :])[..., None]
+                val = jnp.max(jnp.where(mask, fmap, -jnp.inf), axis=(0, 1))
+                val = jnp.where(jnp.isfinite(val), val, 0.0)
+                return acc.at[iy, ix].set(val)
+
+            init = jnp.zeros((self.ph, self.pw, c), fmap.dtype)
+            return lax.fori_loop(0, self.ph * self.pw, bin_body, init)
+
+        return jax.vmap(one_roi)(rois.astype(feats.dtype)), state
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pooling over (N, T, C)
+    (reference: nn/TemporalMaxPooling.scala)."""
+
+    def __init__(self, k_w, d_w=None, name=None):
+        super().__init__(name)
+        self.k_w = k_w
+        self.d_w = d_w or k_w
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return lax.reduce_window(
+            input, -jnp.inf, lax.max, (1, self.k_w, 1), (1, self.d_w, 1),
+            "VALID"), state
+
+
+class VolumetricConvolution(Module):
+    """3-D convolution over NDHWC
+    (reference: nn/VolumetricConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, k_t, k_w, k_h,
+                 d_t=1, d_w=1, d_h=1, pad_t=0, pad_w=0, pad_h=0,
+                 with_bias=True, name=None):
+        super().__init__(name)
+        self.cin, self.cout = n_input_plane, n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.with_bias = with_bias
+
+    def setup(self, rng, input_spec):
+        kt, kh, kw = self.kernel
+        fan_in = self.cin * kt * kh * kw
+        w = Xavier().init(rng, (kt, kh, kw, self.cin, self.cout), fan_in,
+                          self.cout)
+        params = {"weight": w}
+        if self.with_bias:
+            params["bias"] = jnp.zeros((self.cout,), jnp.float32)
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        pt, ph, pw = self.pad
+        y = lax.conv_general_dilated(
+            input, params["weight"].astype(input.dtype), self.stride,
+            [(pt, pt), (ph, ph), (pw, pw)],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+
+class VolumetricFullConvolution(Module):
+    """Transposed 3-D convolution (reference:
+    nn/VolumetricFullConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, k_t, k_w, k_h,
+                 d_t=1, d_w=1, d_h=1, pad_t=0, pad_w=0, pad_h=0,
+                 adj_t=0, adj_w=0, adj_h=0, with_bias=True, name=None):
+        super().__init__(name)
+        self.cin, self.cout = n_input_plane, n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.adj = (adj_t, adj_h, adj_w)
+        self.with_bias = with_bias
+
+    def setup(self, rng, input_spec):
+        kt, kh, kw = self.kernel
+        fan_in = self.cin * kt * kh * kw
+        w = Xavier().init(rng, (kt, kh, kw, self.cin, self.cout), fan_in,
+                          self.cout)
+        params = {"weight": w}
+        if self.with_bias:
+            params["bias"] = jnp.zeros((self.cout,), jnp.float32)
+        return params, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        kt, kh, kw = self.kernel
+        pt, ph, pw = self.pad
+        at, ah, aw = self.adj
+        y = lax.conv_transpose(
+            input, params["weight"].astype(input.dtype), self.stride,
+            [(kt - 1 - pt, kt - 1 - pt + at),
+             (kh - 1 - ph, kh - 1 - ph + ah),
+             (kw - 1 - pw, kw - 1 - pw + aw)],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            transpose_kernel=True)
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+
+class _VolumetricPool(Module):
+    def __init__(self, k_t, k_w, k_h, d_t=None, d_w=None, d_h=None,
+                 pad_t=0, pad_w=0, pad_h=0, name=None):
+        super().__init__(name)
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+
+
+class VolumetricMaxPooling(_VolumetricPool):
+    """3-D max pooling (reference: nn/VolumetricMaxPooling.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        kt, kh, kw = self.kernel
+        st, sh, sw = self.stride
+        pt, ph, pw = self.pad
+        return lax.reduce_window(
+            input, -jnp.inf, lax.max, (1, kt, kh, kw, 1),
+            (1, st, sh, sw, 1),
+            [(0, 0), (pt, pt), (ph, ph), (pw, pw), (0, 0)]), state
+
+
+class VolumetricAveragePooling(_VolumetricPool):
+    """3-D average pooling (reference: nn/VolumetricAveragePooling.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        kt, kh, kw = self.kernel
+        st, sh, sw = self.stride
+        pt, ph, pw = self.pad
+        pads = [(0, 0), (pt, pt), (ph, ph), (pw, pw), (0, 0)]
+        total = lax.reduce_window(input, 0.0, lax.add, (1, kt, kh, kw, 1),
+                                  (1, st, sh, sw, 1), pads)
+        return total / (kt * kh * kw), state

@@ -1,0 +1,227 @@
+"""Table-valued layers (pairs/tuples of tensors as inputs).
+
+Reference: nn/SplitTable.scala, BifurcateSplitTable.scala,
+NarrowTable.scala, MixtureTable.scala, DotProduct.scala,
+CosineDistance.scala, PairwiseDistance.scala, MM.scala, MV.scala,
+CrossProduct.scala, Index.scala, Pack.scala, CAveTable.scala.
+All dimension indices are 0-based (python idiom; reference is 1-based).
+"""
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module, child_rng
+
+
+class SplitTable(Module):
+    """Tensor -> tuple of slices along ``dimension``
+    (reference: nn/SplitTable.scala)."""
+
+    def __init__(self, dimension, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        n = input.shape[self.dimension]
+        parts = tuple(
+            jnp.squeeze(s, axis=self.dimension)
+            for s in jnp.split(input, n, axis=self.dimension))
+        return parts, state
+
+
+class BifurcateSplitTable(Module):
+    """Tensor -> (first half, second half) along ``dimension``
+    (reference: nn/BifurcateSplitTable.scala)."""
+
+    def __init__(self, dimension, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        n = input.shape[self.dimension]
+        a, b = jnp.split(input, [n // 2], axis=self.dimension)
+        return (a, b), state
+
+
+class NarrowTable(Module):
+    """Table -> sub-table [offset, offset+length)
+    (reference: nn/NarrowTable.scala)."""
+
+    def __init__(self, offset, length=1, name=None):
+        super().__init__(name)
+        self.offset = offset
+        self.length = length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = tuple(input[self.offset:self.offset + self.length])
+        return out[0] if self.length == 1 else out, state
+
+
+class MixtureTable(Module):
+    """(gater (N, k), experts tuple/stacked tensor) -> sum_k g_k * expert_k
+    (reference: nn/MixtureTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        gater, experts = input[0], input[1]
+        if isinstance(experts, tuple):
+            experts = jnp.stack(experts, axis=1)    # (N, k, ...)
+        g = gater.reshape(gater.shape + (1,) * (experts.ndim - 2))
+        return jnp.sum(g * experts, axis=1), state
+
+
+class DotProduct(Module):
+    """(a, b) -> rowwise dot (reference: nn/DotProduct.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        a, b = input
+        return jnp.sum(a * b, axis=-1), state
+
+
+class CosineDistance(Module):
+    """(a, b) -> rowwise cosine similarity
+    (reference: nn/CosineDistance.scala)."""
+
+    def __init__(self, eps=1e-12, name=None):
+        super().__init__(name)
+        self.eps = eps
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        a, b = input
+        na = jnp.maximum(jnp.linalg.norm(a, axis=-1), self.eps)
+        nb = jnp.maximum(jnp.linalg.norm(b, axis=-1), self.eps)
+        return jnp.sum(a * b, axis=-1) / (na * nb), state
+
+
+class PairwiseDistance(Module):
+    """(a, b) -> rowwise Lp distance (reference: nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm=2, name=None):
+        super().__init__(name)
+        self.norm = norm
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        a, b = input
+        d = jnp.abs(a - b) ** self.norm
+        return jnp.sum(d, axis=-1) ** (1.0 / self.norm), state
+
+
+class MM(Module):
+    """(A, B) -> A @ B with optional transposes, batched
+    (reference: nn/MM.scala)."""
+
+    def __init__(self, trans_a=False, trans_b=False, name=None):
+        super().__init__(name)
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        a, b = input
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b, state
+
+
+class MV(Module):
+    """(M, v) -> M @ v, batched (reference: nn/MV.scala)."""
+
+    def __init__(self, trans=False, name=None):
+        super().__init__(name)
+        self.trans = trans
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        m, v = input
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v), state
+
+
+class CrossProduct(Module):
+    """Table of k tensors -> all pairwise dot products (N, k*(k-1)/2)
+    (reference: nn/CrossProduct.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs = []
+        k = len(input)
+        for i in range(k):
+            for j in range(i + 1, k):
+                outs.append(jnp.sum(input[i] * input[j], axis=-1))
+        return jnp.stack(outs, axis=-1), state
+
+
+class Index(Module):
+    """(tensor, indices) -> tensor indexed along ``dimension``
+    (reference: nn/Index.scala)."""
+
+    def __init__(self, dimension=0, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t, idx = input
+        return jnp.take(t, idx.astype(jnp.int32), axis=self.dimension), state
+
+
+class Pack(Module):
+    """Table of tensors -> stacked along a new ``dimension``
+    (reference: nn/Pack.scala)."""
+
+    def __init__(self, dimension=0, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        parts = input if isinstance(input, tuple) else (input,)
+        return jnp.stack(parts, axis=self.dimension), state
+
+
+class CAveTable(Module):
+    """Elementwise average of table entries (reference: nn/CAveTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        total = input[0]
+        for x in input[1:]:
+            total = total + x
+        return total / len(input), state
+
+
+class Bottle(Module):
+    """Apply ``module`` to an input with leading dims collapsed to
+    ``n_input_dim`` dims, then restore (reference: nn/Bottle.scala)."""
+
+    def __init__(self, module, n_input_dim=2, n_output_dim=None, name=None):
+        super().__init__(name)
+        self.module = module
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim or n_input_dim
+
+    def setup(self, rng, input_spec):
+        import jax
+        shape = input_spec.shape
+        lead = shape[:len(shape) - self.n_input_dim + 1]
+        collapsed = (int(jnp.prod(jnp.asarray(lead))),) + \
+            shape[len(shape) - self.n_input_dim + 1:]
+        spec = jax.ShapeDtypeStruct(collapsed, input_spec.dtype)
+        return self.module.setup(rng, spec)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        shape = input.shape
+        lead = shape[:len(shape) - self.n_input_dim + 1]
+        rest = shape[len(shape) - self.n_input_dim + 1:]
+        x = input.reshape((-1,) + rest)
+        y, new_state = self.module.apply(params, state, x,
+                                         training=training, rng=rng)
+        return y.reshape(lead + y.shape[1:]), new_state
+
+
+class SparseJoinTable(Module):
+    """Concatenate 2-D SparseTensors column-wise
+    (reference: nn/SparseJoinTable.scala:36)."""
+
+    def __init__(self, dimension=1, name=None):
+        super().__init__(name)
+        assert dimension == 1, "reference supports the column dim only"
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from bigdl_tpu.nn.sparse import sparse_join
+        return sparse_join(list(input)), state

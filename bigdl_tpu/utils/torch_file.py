@@ -113,9 +113,21 @@ class _Reader:
                 return self.memo[idx]
             version = self.string()
             cls = self.string() if version.startswith("V ") else version
-            result = self._torch_object(cls)
-            self.memo[idx] = result
-            return result
+            if cls in _TENSOR_DTYPES or cls in _STORAGE_DTYPES:
+                result = self._torch_object(cls)
+                self.memo[idx] = result
+                return result
+            # generic nn.* object: memo a placeholder BEFORE parsing the
+            # payload so cyclic references (nngraph parents/children)
+            # resolve instead of desyncing the stream
+            holder = {"__torch_class__": cls}
+            self.memo[idx] = holder
+            payload = self.obj()
+            if isinstance(payload, dict):
+                holder.update(payload)
+            else:
+                holder["value"] = payload
+            return holder
         raise NotImplementedError(f".t7 type id {tid}")
 
     def _torch_object(self, cls):
