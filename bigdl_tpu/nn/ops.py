@@ -290,3 +290,450 @@ class IsNan(Operation):
 class IsInf(Operation):
     def apply(self, params, state, input, *, training=False, rng=None):
         return jnp.isinf(input), state
+
+
+# --------------------------------------------------------------------------- #
+# Math/array op breadth (reference: nn/ops/ remaining files)
+# --------------------------------------------------------------------------- #
+
+
+class ApproximateEqual(_Binary):
+    """|a - b| < tolerance (reference: nn/ops/ApproximateEqual.scala)."""
+
+    def __init__(self, tolerance=1e-5, name=None):
+        super().__init__(name)
+        self.tolerance = tolerance
+
+    def fn(self, a, b):
+        return jnp.abs(a - b) < self.tolerance
+
+
+class BatchMatMul(_Binary):
+    """Batched matmul with optional adjoints
+    (reference: nn/ops/BatchMatMul.scala)."""
+
+    def __init__(self, adj_x=False, adj_y=False, name=None):
+        super().__init__(name)
+        self.adj_x, self.adj_y = adj_x, adj_y
+
+    def fn(self, a, b):
+        if self.adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+
+
+Compare = _Binary      # reference: nn/ops/Compare.scala (abstract base)
+
+
+class _Elementwise(Operation):
+    def fn(self, x):
+        raise NotImplementedError
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self.fn(input), state
+
+
+class CrossEntropy(Operation):
+    """Softmax cross-entropy with logits, per row
+    (reference: nn/ops/CrossEntropy.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        logits, labels = input
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(labels * logp, axis=-1), state
+
+
+class DepthwiseConv2D(Operation):
+    """(x NHWC, filter (kh, kw, cin, mult)) -> depthwise conv
+    (reference: nn/ops/DepthwiseConv2D.scala)."""
+
+    def __init__(self, stride_w=1, stride_h=1, pad_w=-1, pad_h=-1,
+                 data_format="NHWC", name=None):
+        super().__init__(name)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from jax import lax
+        x, w = input
+        kh, kw, cin, mult = w.shape
+        pad = ("SAME" if self.pad == (-1, -1)
+               else [(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])])
+        y = lax.conv_general_dilated(
+            x, w.reshape(kh, kw, 1, cin * mult).astype(x.dtype),
+            self.stride, pad, feature_group_count=cin,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y, state
+
+
+class Dilation2D(Operation):
+    """Grayscale morphological dilation: max over window of (x + filter)
+    (reference: nn/ops/Dilation2D.scala)."""
+
+    def __init__(self, strides, rates, padding="SAME", name=None):
+        super().__init__(name)
+        self.strides = tuple(strides)
+        self.rates = tuple(rates)
+        self.padding = padding
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from jax import lax
+        x, w = input                       # x NHWC, w (kh, kw, C)
+        kh, kw, c = w.shape
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), (self.strides[1], self.strides[2]), self.padding,
+            rhs_dilation=(self.rates[1], self.rates[2]),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        n, oh, ow, _ = patches.shape
+        # patches feature dim is (C, kh, kw) channel-major
+        patches = patches.reshape(n, oh, ow, c, kh * kw)
+        wt = w.transpose(2, 0, 1).reshape(c, kh * kw).astype(x.dtype)
+        return jnp.max(patches + wt[None, None, None], axis=-1), state
+
+
+class Digamma(_Elementwise):
+    def fn(self, x):
+        return jax.scipy.special.digamma(x)
+
+
+class Erf(_Elementwise):
+    def fn(self, x):
+        return jax.scipy.special.erf(x)
+
+
+class Erfc(_Elementwise):
+    def fn(self, x):
+        return jax.scipy.special.erfc(x)
+
+
+class Expm1(_Elementwise):
+    def fn(self, x):
+        return jnp.expm1(x)
+
+
+class Lgamma(_Elementwise):
+    def fn(self, x):
+        return jax.scipy.special.gammaln(x)
+
+
+class Rint(_Elementwise):
+    def fn(self, x):
+        return jnp.rint(x)
+
+
+class Inv(_Elementwise):
+    def fn(self, x):
+        return 1.0 / x
+
+
+class IsFinite(_Elementwise):
+    def fn(self, x):
+        return jnp.isfinite(x)
+
+
+class FloorMod(_Binary):
+    def fn(self, a, b):
+        return jnp.mod(a, b)
+
+
+class TruncateDiv(_Binary):
+    def fn(self, a, b):
+        return jnp.trunc(a / b).astype(a.dtype)
+
+
+class SquaredDifference(_Binary):
+    def fn(self, a, b):
+        return jnp.square(a - b)
+
+
+class InTopK(Operation):
+    """(predictions (N, C), targets (N,)) -> bool: target within top k
+    (reference: nn/ops/InTopK.scala)."""
+
+    def __init__(self, k, name=None):
+        super().__init__(name)
+        self.k = k
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        pred, tgt = input
+        t = tgt.astype(jnp.int32)
+        x_t = jnp.take_along_axis(pred, t[:, None], axis=1)[:, 0]
+        rank = jnp.sum(pred > x_t[:, None], axis=1)
+        return rank < self.k, state
+
+
+class L2Loss(Operation):
+    """sum(x^2) / 2 (reference: nn/ops/L2Loss.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.sum(jnp.square(input)) / 2.0, state
+
+
+class Pad(Operation):
+    """(x, paddings (ndim, 2)) -> padded (reference: nn/ops/Pad ops)."""
+
+    def __init__(self, mode="CONSTANT", constant_value=0.0, name=None):
+        super().__init__(name)
+        self.mode = mode
+        self.constant_value = constant_value
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x, pads = input
+        import numpy as np
+        cfg = [tuple(int(v) for v in row) for row in np.asarray(pads)]
+        if self.mode == "CONSTANT":
+            return jnp.pad(x, cfg, constant_values=self.constant_value), \
+                state
+        return jnp.pad(x, cfg, mode=self.mode.lower()), state
+
+
+class Prod(Operation):
+    """Product over an axis (reference: nn/ops/Prod.scala)."""
+
+    def __init__(self, axis=0, keep_dims=False, name=None):
+        super().__init__(name)
+        self.axis = axis
+        self.keep_dims = keep_dims
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.prod(input, axis=self.axis,
+                        keepdims=self.keep_dims), state
+
+
+class RangeOps(Operation):
+    """(start, limit, delta) -> arange (reference: nn/ops/RangeOps.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        start, limit, delta = [int(v) for v in input]
+        return jnp.arange(start, limit, delta), state
+
+
+class SegmentSum(Operation):
+    """(data, segment_ids) -> per-segment sums
+    (reference: nn/ops/SegmentSum.scala)."""
+
+    def __init__(self, num_segments=None, name=None):
+        super().__init__(name)
+        self.num_segments = num_segments
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        data, ids = input
+        ids = ids.astype(jnp.int32)
+        n = self.num_segments
+        if n is None:
+            if isinstance(ids, jax.core.Tracer):
+                raise ValueError("pass num_segments= for jit use")
+            n = int(jnp.max(ids)) + 1
+        return jax.ops.segment_sum(data, ids, num_segments=n), state
+
+
+class TruncatedNormal(Operation):
+    """Shape -> truncated-normal sample
+    (reference: nn/ops/TruncatedNormal.scala)."""
+
+    def __init__(self, mean=0.0, stddev=1.0, seed=0, name=None):
+        super().__init__(name)
+        self.mean, self.stddev, self.seed = mean, stddev, seed
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import numpy as np
+        shape = tuple(int(v) for v in np.asarray(input))
+        key = rng if rng is not None else jax.random.key(self.seed)
+        x = jax.random.truncated_normal(key, -2.0, 2.0, shape)
+        return self.mean + self.stddev * x, state
+
+
+class ModuleToOperation(Operation):
+    """Mark any module as forward-only
+    (reference: nn/ops/ModuleToOperation.scala)."""
+
+    def __init__(self, module, name=None):
+        super().__init__(name)
+        self.module = module
+
+    def setup(self, rng, input_spec):
+        return self.module.setup(rng, input_spec)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self.module.apply(params, state, input, training=training,
+                                 rng=rng)
+
+
+class TensorOp(Operation):
+    """Arbitrary tensor transform from a python fn
+    (reference: nn/ops/TensorOp.scala's composable op)."""
+
+    def __init__(self, fn=None, name=None):
+        super().__init__(name)
+        self._fn = fn or (lambda x: x)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self._fn(input), state
+
+
+# --------------------------------------------------------------------------- #
+# Feature-column ops (reference: nn/ops/CategoricalCol*.scala, CrossCol.scala,
+# BucketizedCol.scala, IndicatorCol.scala, MkString.scala, Kv2Tensor.scala).
+# String-typed ops run eagerly on host numpy (TPU has no string dtype); the
+# numeric outputs they produce feed the device pipeline, mirroring the
+# reference where these ops run inside the Spark ingest stage.
+# --------------------------------------------------------------------------- #
+
+
+def _stable_hash(s: str) -> int:
+    import hashlib
+    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "little")
+
+
+class _HostOp(Operation):
+    """String-typed op: runs on host numpy; bypass spec-based build (JAX has
+    no string dtype)."""
+
+    def _ensure_built(self, input):
+        if not self.is_built():
+            self._params, self._state = (), ()
+
+
+class BucketizedCol(Operation):
+    """Numeric -> bucket index by boundaries
+    (reference: nn/ops/BucketizedCol.scala)."""
+
+    def __init__(self, boundaries, name=None):
+        super().__init__(name)
+        self.boundaries = jnp.asarray(boundaries)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.searchsorted(self.boundaries, input, side="right"), state
+
+
+class CategoricalColHashBucket(_HostOp):
+    """String column -> stable hash bucket id
+    (reference: nn/ops/CategoricalColHashBucket.scala)."""
+
+    def __init__(self, hash_bucket_size, strict=True, name=None):
+        super().__init__(name)
+        self.hash_bucket_size = hash_bucket_size
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import numpy as np
+        vals = np.asarray(
+            [[_stable_hash(str(v)) % self.hash_bucket_size]
+             for v in np.asarray(input).ravel()], np.int32)
+        return jnp.asarray(vals), state
+
+
+class CategoricalColVocaList(_HostOp):
+    """String column -> vocabulary id (OOV -> hash buckets after the vocab
+    or default) (reference: nn/ops/CategoricalColVocaList.scala)."""
+
+    def __init__(self, voca_list, strict=True, num_oov_buckets=0,
+                 default=-1, name=None):
+        super().__init__(name)
+        self.vocab = {v: i for i, v in enumerate(voca_list)}
+        self.num_oov = num_oov_buckets
+        self.default = default
+        self.strict = strict
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import numpy as np
+        out = []
+        for v in np.asarray(input).ravel():
+            s = str(v)
+            if s in self.vocab:
+                out.append(self.vocab[s])
+            elif self.strict:
+                raise ValueError(f"token {s!r} not in vocabulary")
+            elif self.num_oov > 0:
+                out.append(len(self.vocab)
+                           + _stable_hash(s) % self.num_oov)
+            else:
+                out.append(self.default)
+        return jnp.asarray(np.asarray(out, np.int32)[:, None]), state
+
+
+class CrossCol(_HostOp):
+    """Cross multiple string columns -> hashed id per row
+    (reference: nn/ops/CrossCol.scala)."""
+
+    def __init__(self, hash_bucket_size, name=None):
+        super().__init__(name)
+        self.hash_bucket_size = hash_bucket_size
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import numpy as np
+        cols = [np.asarray(c).ravel() for c in input]
+        out = [[_stable_hash("_X_".join(str(c[i]) for c in cols))
+                % self.hash_bucket_size] for i in range(len(cols[0]))]
+        return jnp.asarray(np.asarray(out, np.int32)), state
+
+
+class IndicatorCol(Operation):
+    """Categorical ids -> multi-hot indicator vector
+    (reference: nn/ops/IndicatorCol.scala)."""
+
+    def __init__(self, feature_num, is_count=True, name=None):
+        super().__init__(name)
+        self.feature_num = feature_num
+        self.is_count = is_count
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ids = input.astype(jnp.int32)
+        onehot = jax.nn.one_hot(ids, self.feature_num)
+        multi = jnp.sum(onehot, axis=-2) if onehot.ndim > 2 else onehot
+        if not self.is_count:
+            multi = (multi > 0).astype(multi.dtype)
+        return multi, state
+
+
+class MkString(_HostOp):
+    """Join each row's entries into one string (host-side)
+    (reference: nn/ops/MkString.scala)."""
+
+    def __init__(self, str_delimiter=",", name=None):
+        super().__init__(name)
+        self.delim = str_delimiter
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import numpy as np
+        arr = np.asarray(input)
+        out = np.asarray([self.delim.join(str(v) for v in row)
+                          for row in arr.reshape(arr.shape[0], -1)])
+        return out, state
+
+
+class Kv2Tensor(_HostOp):
+    """Rows of "k:v,k:v" strings -> dense (N, item_num) tensor
+    (reference: nn/ops/Kv2Tensor.scala)."""
+
+    def __init__(self, kv_delimiter=",", item_delimiter=":", item_num=0,
+                 name=None):
+        super().__init__(name)
+        self.kv_delimiter = kv_delimiter
+        self.item_delimiter = item_delimiter
+        self.item_num = item_num
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import numpy as np
+        rows = np.asarray(input).ravel()
+        out = np.zeros((len(rows), self.item_num), np.float32)
+        for i, row in enumerate(rows):
+            for kv in str(row).split(self.kv_delimiter):
+                if not kv:
+                    continue
+                k, v = kv.split(self.item_delimiter)
+                out[i, int(k)] = float(v)
+        return jnp.asarray(out), state
+
+
+class Substr(_HostOp):
+    """(strings, pos, len) -> substrings (host-side)
+    (reference: nn/ops/Substr.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import numpy as np
+        s, pos, length = input
+        pos, length = int(pos), int(length)
+        return np.asarray([str(v)[pos:pos + length]
+                           for v in np.asarray(s).ravel()]), state

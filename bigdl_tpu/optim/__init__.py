@@ -15,6 +15,6 @@ from bigdl_tpu.optim.local_optimizer import (
     BaseOptimizer, LocalOptimizer, Optimizer, validate,
 )
 from bigdl_tpu.optim.distri_optimizer import (
-    DistriOptimizer, make_distri_train_step,
+    DistriOptimizer, ParallelOptimizer, make_distri_train_step,
 )
 from bigdl_tpu.optim.predictor import Predictor, PredictionService, evaluate

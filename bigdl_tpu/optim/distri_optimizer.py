@@ -118,7 +118,7 @@ class DistriOptimizer(BaseOptimizer):
         t = None if t is None else jax.tree.map(to_global, t)
         return x, t
 
-    def optimize(self):
+    def _optimize_impl(self):
         n_dev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names
                              if a == self.axis]))
         train_iter = self.dataset.data(train=True)
@@ -232,3 +232,18 @@ class DistriOptimizer(BaseOptimizer):
                 self.validation_summary.add_scalar(method.name, value,
                                                    state["neval"])
         return results
+
+
+class ParallelOptimizer(DistriOptimizer):
+    """Reference: optim/ParallelOptimizer.scala:69 — distributed training
+    with per-layer ASYNC gradient sync (BlockManagerParameterSynchronizer,
+    priority = layer depth) to overlap backward with communication.
+
+    TPU-native stance: that overlap is the XLA compiler's job.  The whole
+    step — backward, psum/reduce-scatter, update — is one XLA program, and
+    the latency-hiding scheduler already interleaves per-layer collectives
+    with remaining backward compute on the ICI mesh, which is exactly what
+    the reference built by hand with priority queues and pinned cores.
+    This subclass therefore shares DistriOptimizer's implementation; it
+    exists so reference call sites resolve.
+    """

@@ -110,6 +110,27 @@ class BaseOptimizer:
         return self
 
     # ----- shared helpers -------------------------------------------------- #
+    def optimize(self):
+        """Run training with the reference's failure-retry semantics: on an
+        exception, reload the latest checkpoint and continue, at most
+        BIGDL_FAILURE_RETRY_TIMES times (reference: DistriOptimizer's
+        retryNum loop, optim/DistriOptimizer.scala:862-908)."""
+        from bigdl_tpu.utils import config
+        retries_left = config.failure_retry_times()
+        while True:
+            try:
+                return self._optimize_impl()
+            except KeyboardInterrupt:
+                raise
+            except Exception:
+                if retries_left <= 0 or self.checkpoint_path is None:
+                    raise
+                retries_left -= 1
+                log.exception(
+                    "training failed; restoring last checkpoint and "
+                    "retrying (%d retries left)", retries_left)
+                self.resume_from_checkpoint()
+
     def _init_model(self, example_batch):
         x, _ = _device_batch(example_batch)
         if not self.model.is_built():
@@ -147,7 +168,7 @@ class BaseOptimizer:
 class LocalOptimizer(BaseOptimizer):
     """Reference: optim/LocalOptimizer.scala:45."""
 
-    def optimize(self):
+    def _optimize_impl(self):
         train_iter = self.dataset.data(train=True)
         first_batch = next(train_iter)
         params, mstate = self._init_model(first_batch)

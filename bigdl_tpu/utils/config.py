@@ -1,0 +1,69 @@
+"""Flag/config tier: ``BIGDL_*`` environment variables.
+
+Reference: the ``-Dbigdl.*`` JVM system-property tier (SURVEY.md section 5
+"Config / flag system": bigdl.engineType utils/Engine.scala:45,210;
+bigdl.localMode / bigdl.coreNumber :158-187; bigdl.failure.retryTimes
+optim/DistriOptimizer.scala:862-908; bigdl.Parameter.syncPoolSize
+parameters/AllReduceParameter.scala:36).  JVM properties become env vars:
+``-Dbigdl.failure.retryTimes=5`` -> ``BIGDL_FAILURE_RETRY_TIMES=5``.
+"""
+
+import os
+
+
+def _get(name, default, cast):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        raise ValueError(f"invalid {name}={raw!r}")
+
+
+def engine_type():
+    """'xla' is the only compute engine; kept for reference parity
+    (bigdl.engineType selects MklBlas/MklDnn upstream)."""
+    return os.environ.get("BIGDL_ENGINE_TYPE", "xla")
+
+
+def local_mode():
+    return _get("BIGDL_LOCAL_MODE", False, lambda s: s.lower() == "true")
+
+
+def core_number():
+    return _get("BIGDL_CORE_NUMBER", None, int)
+
+
+def failure_retry_times():
+    """Reference: bigdl.failure.retryTimes (default 5) — bound on the
+    optimizer's restore-from-checkpoint retry loop."""
+    return _get("BIGDL_FAILURE_RETRY_TIMES", 5, int)
+
+
+def check_singleton():
+    return _get("BIGDL_CHECK_SINGLETON", False, lambda s: s.lower() == "true")
+
+
+def log_file():
+    """Reference: LoggerFilter redirect path (bigdl.utils.LoggerFilter
+    defaults to ./bigdl.log)."""
+    return os.environ.get("BIGDL_LOG_FILE", None)
+
+
+def redirect_spark_info_logs(path=None):
+    """LoggerFilter.redirectSparkInfoLogs equivalent
+    (reference: utils/LoggerFilter.scala:34,91): route INFO records of the
+    framework's loggers to a file, keeping the console at WARNING."""
+    import logging
+    path = path or log_file() or "bigdl_tpu.log"
+    root = logging.getLogger("bigdl_tpu")
+    file_handler = logging.FileHandler(path)
+    file_handler.setLevel(logging.INFO)
+    file_handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s - %(message)s"))
+    root.addHandler(file_handler)
+    root.setLevel(logging.INFO)
+    for h in logging.getLogger().handlers:
+        h.setLevel(max(h.level, logging.WARNING))
+    return path

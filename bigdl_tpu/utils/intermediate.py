@@ -1,0 +1,181 @@
+"""Backend-neutral IR: the engine seam.
+
+Reference: utils/intermediate/IRElement.scala:42-104 (IRElement/IROperator
+case classes), IRGraph.scala (an AbstractModule that lazily builds a
+concrete graph), IRConverter.scala:61-107 (toDnnGraph/toBlasGraph) — the
+pluggable-engine seam where the reference swaps MklBlas for MklDnn
+(SURVEY.md section 1, "key architectural fact").
+
+TPU-native: the third engine the survey calls for.  ``to_ir`` lifts a
+module tree into IRElements; ``IRGraph.to_xla`` lowers the IR back to
+modules and AOT-compiles one fused XLA executable
+(jit(...).lower().compile() — the analogue of DnnGraph.compile(phase),
+nn/mkldnn/DnnGraph.scala:309).  Because every layer already lowers through
+jnp/lax there is exactly one numeric backend; the IR's value is (a) a
+stable describe/serialize surface and (b) the place a future engine
+(e.g. a pallas-specialised layer set) plugs in, mirroring IRConverter.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class IRElement:
+    """One node (reference: IRElement.scala:42)."""
+
+    name: str
+    op: str                                  # reference: IROperator subtype
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    inputs: List[str] = field(default_factory=list)
+
+
+@dataclass
+class IRGraph:
+    """Engine-neutral graph (reference: IRGraph.scala)."""
+
+    elements: List[IRElement]
+    input_names: List[str]
+    output_names: List[str]
+
+    def to_xla(self, input_spec, sample_input=None):
+        """Lower to an AOT-compiled XLA executable
+        (reference: IRConverter.toDnnGraph + DnnGraph.compile)."""
+        import jax
+
+        module = ir_to_module(self)
+        module.build(input_spec)
+        params, state = module._params, module._state
+
+        def fwd(p, s, x):
+            y, _ = module.apply(p, s, x, training=False, rng=None)
+            return y
+
+        compiled = jax.jit(fwd).lower(params, state, input_spec).compile()
+        return module, compiled, (params, state)
+
+
+_IR_ATTR_KEYS = {
+    "Linear": ["input_size", "output_size", "with_bias"],
+    "SpatialConvolution": ["n_input_plane", "n_output_plane", "kernel",
+                           "stride", "pad", "n_group", "with_bias"],
+    "SpatialMaxPooling": ["kernel", "stride", "pad", "ceil_mode"],
+    "SpatialAveragePooling": ["kernel", "stride", "pad", "ceil_mode"],
+    "BatchNormalization": ["n_output", "eps", "momentum", "affine"],
+    "SpatialBatchNormalization": ["n_output", "eps", "momentum", "affine"],
+    "Dropout": ["p"],
+    "Reshape": ["size"],
+    "LookupTable": ["n_index", "n_output"],
+    "SpatialCrossMapLRN": ["size", "alpha", "beta", "k"],
+    "Concat": ["dimension"],
+    "JoinTable": ["dimension"],
+}
+
+
+def to_ir(module, prefix="") -> IRGraph:
+    """Module tree -> IRGraph (reference: BlasToIR mapper,
+    ReflectionUtils-driven in the reference; explicit attr tables here)."""
+    import bigdl_tpu.nn as nn
+
+    elements: List[IRElement] = []
+
+    def walk(mod, prefix, input_name):
+        cls = type(mod).__name__
+        my_name = f"{prefix}{mod.name}"
+        if isinstance(mod, nn.Sequential):
+            cur = input_name
+            for i, child in enumerate(mod.modules):
+                cur = walk(child, f"{my_name}/", cur)
+            return cur
+        if isinstance(mod, nn.Concat):
+            branch_outs = [walk(child, f"{my_name}/{i}/", input_name)
+                           for i, child in enumerate(mod.modules)]
+            elements.append(IRElement(my_name, "Concat",
+                                      {"dimension": mod.dimension,
+                                       "_input": input_name},
+                                      branch_outs))
+            return my_name
+        attrs = {}
+        for key in _IR_ATTR_KEYS.get(cls, []):
+            if hasattr(mod, key):
+                attrs[key] = getattr(mod, key)
+        elements.append(IRElement(my_name, cls, attrs, [input_name]))
+        return my_name
+
+    out = walk(module, prefix, "input")
+    return IRGraph(elements, ["input"], [out])
+
+
+def ir_to_module(graph: IRGraph):
+    """IRGraph -> module tree (reference: IRToBlas / IRToDnn mappers)."""
+    import bigdl_tpu.nn as nn
+
+    producers = {e.name: e for e in graph.elements}
+    consumers: Dict[str, List[IRElement]] = {}
+    for e in graph.elements:
+        for i in e.inputs:
+            consumers.setdefault(i, []).append(e)
+
+    def build_node(e: IRElement):
+        cls = e.op
+        a = e.attrs
+        if cls == "Concat":
+            cat = nn.Concat(a.get("dimension", -1))
+            for src in e.inputs:
+                cat.add(build_chain(src, stop=a["_input"]))
+            return cat
+        if cls == "Linear":
+            return nn.Linear(a.get("input_size"), a.get("output_size"),
+                             with_bias=a.get("with_bias", True))
+        if cls == "SpatialConvolution":
+            kh, kw = a["kernel"]
+            sh, sw = a["stride"]
+            ph, pw = a["pad"]
+            return nn.SpatialConvolution(
+                a["n_input_plane"], a["n_output_plane"], kw, kh, sw, sh,
+                pw, ph, n_group=a.get("n_group", 1),
+                with_bias=a.get("with_bias", True))
+        if cls in ("SpatialMaxPooling", "SpatialAveragePooling"):
+            kh, kw = a["kernel"]
+            sh, sw = a["stride"]
+            ph, pw = a["pad"]
+            m = getattr(nn, cls)(kw, kh, sw, sh, pw, ph)
+            if a.get("ceil_mode"):
+                m.ceil()
+            return m
+        if cls in ("BatchNormalization", "SpatialBatchNormalization"):
+            return getattr(nn, cls)(a["n_output"], a.get("eps", 1e-5),
+                                    a.get("momentum", 0.1),
+                                    affine=a.get("affine", True))
+        if cls == "Dropout":
+            return nn.Dropout(a.get("p", 0.5))
+        if cls == "Reshape":
+            return nn.Reshape(tuple(a["size"]))
+        if cls == "LookupTable":
+            return nn.LookupTable(a["n_index"], a["n_output"])
+        if cls == "SpatialCrossMapLRN":
+            return nn.SpatialCrossMapLRN(a["size"], a["alpha"], a["beta"],
+                                         a["k"])
+        if cls == "JoinTable":
+            return nn.JoinTable(a["dimension"])
+        if hasattr(nn, cls):
+            return getattr(nn, cls)()          # parameter-free layer
+        raise NotImplementedError(f"IR op {cls}")
+
+    def build_chain(output_name, stop="input"):
+        """Chain ending at output_name, walking back to ``stop`` ->
+        Sequential.  Concat nodes jump back through their recorded feed."""
+        chain = []
+        cur = output_name
+        while cur != stop and cur in producers:
+            e = producers[cur]
+            chain.append(e)
+            cur = e.attrs["_input"] if e.op == "Concat" else e.inputs[0]
+        chain.reverse()
+        seq = nn.Sequential()
+        for e in chain:
+            seq.add(build_node(e))
+        return seq
+
+    assert len(graph.output_names) == 1, "single-output IR graphs only"
+    return build_chain(graph.output_names[0])

@@ -1,0 +1,154 @@
+"""Aux subsystems: GradientChecker, IR bridge, config tier, failure retry.
+
+Reference: test GradientChecker.scala usage in nn specs; utils/intermediate
+IRGraph/IRConverter; the bigdl.* property tier; DistriOptimizer retry loop
+(optim/DistriOptimizer.scala:862-908).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+
+
+class TestGradientChecker:
+    def test_linear_tanh(self):
+        from bigdl_tpu.utils.gradient_checker import GradientChecker
+        gc = GradientChecker(1e-3, 1e-2)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 6)),
+                        jnp.float32)
+        m = nn.Sequential().add(nn.Linear(6, 5)).add(nn.Tanh())
+        assert gc.check_layer(m, x)
+        assert gc.check_weight(m, x, sample=10)
+
+    def test_conv(self):
+        from bigdl_tpu.utils.gradient_checker import GradientChecker
+        gc = GradientChecker(1e-2, 2e-2)
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 6, 6, 3)),
+                        jnp.float32)
+        m = nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1)
+        assert gc.check_layer(m, x, sample=10)
+
+
+class TestIRBridge:
+    def test_round_trip_lenet(self):
+        from bigdl_tpu.models.lenet import LeNet5
+        from bigdl_tpu.utils.intermediate import ir_to_module, to_ir
+
+        m = LeNet5()
+        ir = to_ir(m)
+        assert any(e.op == "SpatialConvolution" for e in ir.elements)
+        m2 = ir_to_module(ir)
+        x = jnp.zeros((2, 28, 28, 1))
+        assert m2.forward(x).shape == m.forward(x).shape
+
+    def test_concat_structure(self):
+        from bigdl_tpu.utils.intermediate import ir_to_module, to_ir
+        m = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 4, 1, 1))
+             .add(nn.Concat(3)
+                  .add(nn.SpatialConvolution(4, 2, 1, 1))
+                  .add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1)))
+             .add(nn.ReLU()))
+        ir = to_ir(m)
+        m2 = ir_to_module(ir)
+        y = m2.forward(jnp.zeros((1, 5, 5, 3)))
+        assert y.shape == (1, 5, 5, 6)
+
+    def test_to_xla_compiles(self):
+        from bigdl_tpu.utils.intermediate import to_ir
+        m = nn.Sequential().add(nn.Linear(4, 3)).add(nn.ReLU())
+        ir = to_ir(m)
+        spec = jax.ShapeDtypeStruct((2, 4), jnp.float32)
+        module, compiled, (params, state) = ir.to_xla(spec)
+        y = compiled(params, state, jnp.ones((2, 4)))
+        assert np.asarray(y).shape == (2, 3)
+
+
+class TestConfigTier:
+    def test_env_overrides(self, monkeypatch):
+        from bigdl_tpu.utils import config
+        monkeypatch.setenv("BIGDL_FAILURE_RETRY_TIMES", "7")
+        assert config.failure_retry_times() == 7
+        monkeypatch.setenv("BIGDL_LOCAL_MODE", "true")
+        assert config.local_mode() is True
+        monkeypatch.delenv("BIGDL_FAILURE_RETRY_TIMES")
+        assert config.failure_retry_times() == 5
+
+    def test_logger_filter(self, tmp_path):
+        import logging
+        from bigdl_tpu.utils import config
+        path = config.redirect_spark_info_logs(str(tmp_path / "bigdl.log"))
+        logging.getLogger("bigdl_tpu.test").info("hello from the filter")
+        for h in logging.getLogger("bigdl_tpu").handlers:
+            h.flush()
+        assert "hello from the filter" in open(path).read()
+
+
+class TestFailureRetry:
+    def test_retry_restores_from_checkpoint(self, tmp_path, monkeypatch):
+        """First _optimize_impl blows up mid-run; retry resumes from the
+        checkpoint and completes (reference retryNum semantics)."""
+        from bigdl_tpu import optim
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.models.lenet import LeNet5
+        from bigdl_tpu.optim import LocalOptimizer, Trigger
+        from bigdl_tpu.dataset.mnist import synthetic_mnist
+
+        x, y = synthetic_mnist(256)
+        ds = array_dataset(x, y) >> SampleToMiniBatch(64)
+        opt = LocalOptimizer(LeNet5(), ds, nn.ClassNLLCriterion(),
+                             optim.SGD(learning_rate=0.1))
+        opt.set_end_when(Trigger.max_iteration(6))
+        opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+
+        real_impl = LocalOptimizer._optimize_impl
+        calls = {"n": 0}
+
+        def flaky(self):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # run a few real iterations, then die
+                orig_trigger = self.end_trigger
+
+                def bomb(state):
+                    if state["neval"] > 3:
+                        raise RuntimeError("injected failure")
+                    return orig_trigger(state)
+                self.end_trigger = bomb
+                try:
+                    return real_impl(self)
+                finally:
+                    self.end_trigger = orig_trigger
+            return real_impl(self)
+
+        monkeypatch.setattr(LocalOptimizer, "_optimize_impl", flaky)
+        opt.optimize()
+        assert calls["n"] == 2
+        assert opt.driver_state["neval"] >= 6
+
+    def test_no_checkpoint_reraises(self):
+        from bigdl_tpu import optim
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.models.lenet import LeNet5
+        from bigdl_tpu.optim import LocalOptimizer, Trigger
+        from bigdl_tpu.dataset.mnist import synthetic_mnist
+
+        x, y = synthetic_mnist(64)
+        ds = array_dataset(x, y) >> SampleToMiniBatch(64)
+        opt = LocalOptimizer(LeNet5(), ds, nn.ClassNLLCriterion())
+
+        def boom(state):
+            raise RuntimeError("no checkpoint -> no retry")
+        opt.set_end_when(boom)
+        with pytest.raises(RuntimeError, match="no checkpoint"):
+            opt.optimize()
+
+    def test_parallel_optimizer_alias(self):
+        from bigdl_tpu.optim import DistriOptimizer, ParallelOptimizer
+        assert issubclass(ParallelOptimizer, DistriOptimizer)

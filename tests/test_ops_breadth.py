@@ -1,0 +1,115 @@
+"""Op-zoo breadth: math/array extras + feature-column ops.
+
+Reference: the remaining nn/ops/ files (BatchMatMul, SegmentSum, InTopK,
+Dilation2D, feature-column ops CategoricalColHashBucket / CrossCol /
+BucketizedCol / IndicatorCol / Kv2Tensor / MkString / Substr).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import ops
+
+
+class TestMathOps:
+    def test_batch_matmul_adjoints(self):
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.normal(size=(2, 3, 4)), jnp.float32)
+        b = jnp.asarray(rng.normal(size=(2, 5, 4)), jnp.float32)
+        y = ops.BatchMatMul(adj_y=True).forward((a, b))
+        gold = np.einsum("bij,bkj->bik", np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(y), gold, atol=1e-5)
+
+    def test_special_functions_vs_scipy(self):
+        torch = pytest.importorskip("torch")
+        x = jnp.asarray([0.5, 1.5, 3.0])
+        np.testing.assert_allclose(
+            np.asarray(ops.Erf().forward(x)),
+            torch.erf(torch.tensor(np.asarray(x))).numpy(), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ops.Lgamma().forward(x)),
+            torch.lgamma(torch.tensor(np.asarray(x))).numpy(), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(ops.Digamma().forward(x)),
+            torch.digamma(torch.tensor(np.asarray(x))).numpy(), atol=1e-5)
+
+    def test_in_top_k(self):
+        pred = jnp.asarray([[1.0, 3.0, 2.0], [9.0, 1.0, 2.0]])
+        assert np.asarray(ops.InTopK(2).forward(
+            (pred, jnp.asarray([2, 1])))).tolist() == [True, False]
+
+    def test_segment_sum(self):
+        y = ops.SegmentSum().forward(
+            (jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([0, 0, 1, 1])))
+        np.testing.assert_allclose(np.asarray(y), [3.0, 7.0])
+
+    def test_squared_difference_l2loss_expm1(self):
+        a, b = jnp.asarray([3.0]), jnp.asarray([1.0])
+        assert float(ops.SquaredDifference().forward((a, b))[0]) == 4.0
+        assert float(ops.L2Loss().forward(jnp.asarray([3.0, 4.0]))) == 12.5
+        np.testing.assert_allclose(
+            float(ops.Expm1().forward(jnp.asarray(1.0))), np.expm1(1.0),
+            rtol=1e-6)
+
+    def test_dilation2d(self):
+        x = jnp.zeros((1, 4, 4, 1)).at[0, 1, 1, 0].set(5.0)
+        w = jnp.zeros((3, 3, 1))
+        y = ops.Dilation2D((1, 1, 1, 1), (1, 1, 1, 1), "SAME").forward(
+            (x, w))
+        # morphological dilation spreads the peak to its 3x3 neighbourhood
+        assert float(y[0, 2, 2, 0]) == 5.0 and float(y[0, 0, 0, 0]) == 5.0
+
+    def test_depthwise_conv(self):
+        x = jnp.ones((1, 5, 5, 3))
+        w = jnp.ones((3, 3, 3, 2))
+        y = ops.DepthwiseConv2D().forward((x, w))
+        assert y.shape == (1, 5, 5, 6)
+
+    def test_prod_range(self):
+        np.testing.assert_allclose(
+            np.asarray(ops.Prod(0).forward(jnp.asarray([2.0, 3.0, 4.0]))),
+            24.0)
+        np.testing.assert_allclose(
+            np.asarray(ops.RangeOps().forward((2, 10, 3))), [2, 5, 8])
+
+
+class TestFeatureColumns:
+    def test_bucketized_col(self):
+        y = ops.BucketizedCol([0.0, 10.0, 100.0]).forward(
+            jnp.asarray([[-1.0, 5.0], [150.0, 20.0]]))
+        np.testing.assert_array_equal(np.asarray(y), [[0, 1], [3, 2]])
+
+    def test_hash_bucket_deterministic(self):
+        op = ops.CategoricalColHashBucket(1000)
+        a = np.asarray(op.forward(np.array(["cat", "dog", "cat"])))
+        assert a[0] == a[2] and a[0] != a[1]
+        assert (a >= 0).all() and (a < 1000).all()
+
+    def test_voca_list(self):
+        op = ops.CategoricalColVocaList(["a", "b", "c"], strict=False,
+                                        num_oov_buckets=2)
+        y = np.asarray(op.forward(np.array(["b", "zzz", "a"])))
+        assert y[0] == 1 and y[2] == 0 and 3 <= y[1] < 5
+
+    def test_cross_col(self):
+        op = ops.CrossCol(50)
+        y1 = np.asarray(op.forward((np.array(["a"]), np.array(["x"]))))
+        y2 = np.asarray(op.forward((np.array(["a"]), np.array(["y"]))))
+        assert y1.shape == (1, 1) and (0 <= y1).all() and (y1 < 50).all()
+        assert y1[0, 0] != y2[0, 0]
+
+    def test_indicator_col(self):
+        y = ops.IndicatorCol(4).forward(jnp.asarray([[0, 2], [1, 1]]))
+        np.testing.assert_array_equal(
+            np.asarray(y), [[1, 0, 1, 0], [0, 2, 0, 0]])
+
+    def test_kv2tensor_mkstring_substr(self):
+        y = ops.Kv2Tensor(item_num=4).forward(np.array(["0:1.5,2:3"]))
+        np.testing.assert_allclose(np.asarray(y), [[1.5, 0, 3, 0]])
+        assert ops.MkString("-").forward(
+            np.array([[1, 2], [3, 4]])).tolist() == ["1-2", "3-4"]
+        assert ops.Substr().forward(
+            (np.array(["hello"]), 1, 3)).tolist() == ["ell"]
