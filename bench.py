@@ -1,29 +1,49 @@
 """Headline benchmark: ResNet-50 training throughput on one TPU chip.
 
 Mirrors the reference's perf harnesses (models/utils/DistriOptimizerPerf.scala,
-nn/mkldnn/Perf.scala: imgs/sec on synthetic data) with the BASELINE.json
+nn/mkldnn/Perf.scala:56-126: imgs/sec on synthetic data) with the BASELINE.json
 north-star metric: ResNet-50 images/sec/chip and MFU.
 
 vs_baseline = achieved_MFU / 0.35 (the >=35% MFU target from BASELINE.md;
 the reference publishes no absolute imgs/sec for its Xeon clusters).
 
-Prints ONE JSON line.
+Robustness (round-2): the parent process re-executes itself as a child and
+retries on TPU backend init/compile failures (transient tunnel errors were the
+whole of round 1's bench story), optionally falling back to CPU, and ALWAYS
+prints exactly ONE JSON line -- a diagnostic record on total failure rather
+than a stack trace.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+
+def _honor_env_platforms():
+    """The axon sitecustomize force-sets jax_platforms='axon,cpu' via
+    jax.config, overriding the JAX_PLATFORMS env var.  Re-assert the env
+    var's intent so CPU-forced runs stay on CPU."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
 
 
-def main():
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
+def run_bench():
+    """Run the benchmark in-process and print the result JSON line."""
+    _honor_env_platforms()
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
 
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from bigdl_tpu import optim
     from bigdl_tpu.models.resnet import ResNet
@@ -72,8 +92,18 @@ def main():
     dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * steps / dt
-    # v5e peak: 197 TFLOP/s bf16
-    peak = 197e12 if platform != "cpu" else 1e12
+    # bf16 peak FLOP/s by device kind; CPU: meaningless, use 1 TF.
+    kind = getattr(dev, "device_kind", "") or ""
+    if platform == "cpu":
+        peak = 1e12
+    elif "v6" in kind:
+        peak = 918e12
+    elif "v5p" in kind:
+        peak = 459e12
+    elif "v4" in kind:
+        peak = 275e12
+    else:  # v5e and unknown TPUs: assume v5e (197 TFLOP/s bf16)
+        peak = 197e12
     mfu = (flops_per_step * steps / dt) / peak
 
     print(json.dumps({
@@ -83,11 +113,96 @@ def main():
         "vs_baseline": round(mfu / 0.35, 4),
         "extra": {
             "platform": platform,
+            "device_kind": kind,
+            "peak_flops_assumed": peak,
             "batch": batch,
+            "steps": steps,
+            "sec_per_step": round(dt / steps, 4),
             "mfu": round(mfu, 4),
             "flops_per_step": flops_per_step,
             "loss": float(loss),
         },
+    }))
+
+
+def _spawn_child(extra_env, timeout):
+    import signal
+    import tempfile
+
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    env.update(extra_env)
+    # pipe via files + own process group: a hung grandchild (TPU runtime
+    # helper) holding the pipe open cannot block us, and killpg reaps it
+    with tempfile.TemporaryFile("w+") as out, \
+            tempfile.TemporaryFile("w+") as err:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=out, stderr=err, env=env, start_new_session=True)
+        timed_out = False
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            rc = proc.wait()
+        out.seek(0)
+        stdout = out.read()
+        err.seek(0)
+        stderr = err.read()
+    if timed_out:
+        return None, (f"timeout after {timeout}s; stderr tail: "
+                      + stderr[-500:])
+    # find the result JSON line on stdout
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"rc={rc}; stderr tail: {stderr[-800:]}"
+
+
+def main():
+    if os.environ.get("BENCH_CHILD"):
+        run_bench()
+        return
+
+    attempts = int(os.environ.get("BENCH_RETRIES", "3"))
+    timeout = int(os.environ.get("BENCH_TIMEOUT", "1500"))
+    failures = []
+    for i in range(attempts):
+        result, err = _spawn_child({}, timeout)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        failures.append(f"attempt {i + 1}: {err}")
+        if i < attempts - 1:
+            time.sleep(min(30, 5 * (i + 1)))
+
+    # TPU unreachable after retries: take a CPU measurement so the round
+    # still produces a perf artifact, and carry the TPU failure diagnostics.
+    if os.environ.get("BENCH_NO_CPU_FALLBACK") != "1":
+        result, err = _spawn_child(
+            {"JAX_PLATFORMS": "cpu", "BENCH_BATCH": "16", "BENCH_STEPS": "3"},
+            timeout)
+        if result is not None:
+            result["extra"]["tpu_failures"] = failures
+            result["vs_baseline"] = 0.0  # CPU number can't claim the target
+            print(json.dumps(result))
+            return
+        failures.append(f"cpu fallback: {err}")
+
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+        "extra": {"error": "all attempts failed", "failures": failures},
     }))
 
 

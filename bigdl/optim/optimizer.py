@@ -72,14 +72,16 @@ class ValidationSummary:
         return VS(log_dir, app_name)
 
 
-def _to_dataset(data, batch_size):
+def _to_dataset(data, batch_size, one_based_labels="auto"):
     from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
-    from bigdl.util.common import Sample, samples_to_arrays
+    from bigdl.util.common import (Sample, samples_to_arrays,
+                                   shift_one_based_labels)
 
     if isinstance(data, tuple) and len(data) == 2:
         x, y = data
+        y = shift_one_based_labels(y, one_based_labels)
     elif isinstance(data, (list,)) and data and isinstance(data[0], Sample):
-        x, y = samples_to_arrays(data)
+        x, y = samples_to_arrays(data, one_based_labels)
     else:
         raise TypeError(
             "training data must be a list of bigdl.util.common.Sample "
@@ -92,11 +94,13 @@ class Optimizer:
     """Reference: optimizer.py:814 (and `create` :848)."""
 
     def __init__(self, model, training_rdd, criterion, end_trigger=None,
-                 batch_size=32, optim_method=None, bigdl_type="float"):
+                 batch_size=32, optim_method=None, bigdl_type="float",
+                 one_based_labels="auto"):
         from bigdl_tpu.optim import LocalOptimizer
+        self._one_based = one_based_labels
         self._opt = LocalOptimizer(
-            model, _to_dataset(training_rdd, batch_size), criterion,
-            optim_method or SGD())
+            model, _to_dataset(training_rdd, batch_size, one_based_labels),
+            criterion, optim_method or SGD())
         self._opt.set_end_when(end_trigger or MaxEpoch(1))
         self.model = model
 
@@ -109,7 +113,8 @@ class Optimizer:
 
     def set_validation(self, batch_size, val_rdd, trigger, val_method=None):
         self._opt.set_validation(
-            trigger, _to_dataset(val_rdd, batch_size),
+            trigger,
+            _to_dataset(val_rdd, batch_size, self._one_based),
             val_method or [Top1Accuracy()])
         return self
 
@@ -147,9 +152,13 @@ class DistriOptimizer(Optimizer):
     """Reference: optimizer.py:927 — mesh-sharded variant."""
 
     def __init__(self, model, training_rdd, criterion, end_trigger=None,
-                 batch_size=32, optim_method=None, bigdl_type="float"):
+                 batch_size=32, optim_method=None, bigdl_type="float",
+                 one_based_labels="auto"):
         from bigdl_tpu.optim import DistriOptimizer as _D
-        self._opt = _D(model, _to_dataset(training_rdd, batch_size),
+        self._one_based = one_based_labels
+        self._opt = _D(model,
+                       _to_dataset(training_rdd, batch_size,
+                                   one_based_labels),
                        criterion, optim_method or SGD())
         self._opt.set_end_when(end_trigger or MaxEpoch(1))
         self.model = model

@@ -57,12 +57,18 @@ def get_node_and_core_number(bigdl_type="float"):
     return 1, jax.device_count()
 
 
-def samples_to_arrays(samples):
+def samples_to_arrays(samples, one_based_labels="auto"):
     """list[Sample] -> (features ndarray, labels ndarray) stacked batches.
 
     Reference pyspark scripts use Torch's 1-BASED class labels (e.g. the
-    mnist example trains with label+1); bigdl_tpu criterions are 0-based,
-    so integral scalar labels with min >= 1 are shifted down by one here.
+    mnist example trains with label+1); bigdl_tpu criterions are 0-based.
+
+    one_based_labels:
+      True   -- always shift integral scalar labels down by one
+      False  -- never shift (0-based data or regression targets)
+      "auto" -- shift when labels look 1-based (integral, min >= 1) and
+                WARN, since a 0-based set with no class-0 sample or an
+                integral regression target is indistinguishable.
     """
     if any(len(s.features) > 1 or len(s.labels) > 1 for s in samples):
         raise NotImplementedError(
@@ -72,8 +78,40 @@ def samples_to_arrays(samples):
     labs = np.stack([s.label.to_ndarray() for s in samples])
     if labs.ndim == 2 and labs.shape[1] == 1:
         labs = labs[:, 0]
-    if (labs.ndim == 1 and np.issubdtype(labs.dtype, np.floating)
-            and np.all(labs == np.round(labs)) and labs.size
-            and labs.min() >= 1):
+    return feats, shift_one_based_labels(labs, one_based_labels)
+
+
+def shift_one_based_labels(labs, one_based_labels="auto"):
+    """Apply the Torch-1-based -> 0-based label shift policy (see
+    samples_to_arrays).  Shared by the Sample path and the (X, y) path.
+
+    "auto" fires only on FLOATING-dtype integral-valued labels -- the
+    pyspark Sample convention (JTensor is always float) -- never on int
+    dtypes, which are this repo's native 0-based convention.  Pass
+    one_based_labels=True to shift explicitly (any numeric dtype).
+    The label array's shape is preserved; (N, 1) columns are detected for
+    the auto heuristic but not reshaped.
+    """
+    labs = np.asarray(labs)
+    if isinstance(one_based_labels, (bool, np.bool_)):
+        one_based_labels = bool(one_based_labels)
+    elif one_based_labels != "auto":
+        raise ValueError(
+            f"one_based_labels must be True, False, or 'auto'; got "
+            f"{one_based_labels!r}")
+    vals = labs[:, 0] if labs.ndim == 2 and labs.shape[1] == 1 else labs
+    integral_1based = (
+        vals.ndim == 1 and np.issubdtype(vals.dtype, np.floating)
+        and vals.size and np.all(vals == np.round(vals)) and vals.min() >= 1)
+    if one_based_labels is True:
+        labs = labs - 1
+    elif one_based_labels == "auto" and integral_1based:
+        import warnings
+        warnings.warn(
+            "labels look Torch-1-based (integral, min>=1); shifting down "
+            "by 1.  Pass one_based_labels=False "
+            "(Optimizer(..., one_based_labels=False)) if they are really "
+            "0-based class ids or integral regression targets.",
+            stacklevel=2)
         labs = labs - 1      # Torch 1-based -> 0-based
-    return feats, labs
+    return labs

@@ -57,7 +57,9 @@ def _encode_tensor(arr, ctx: _Ctx, msg=None):
     t.datatype = pb.FLOAT if arr.dtype != np.float64 else pb.DOUBLE
     t.size.extend(int(s) for s in arr.shape)
     t.stride.extend(_contiguous_strides(arr.shape))
-    t.offset = 0
+    # reference writes 1-BASED storageOffset (TensorConverter.scala:278 uses
+    # DenseTensor.storageOffset = _storageOffset + 1); 1 == start of storage
+    t.offset = 1
     t.dimension = arr.ndim
     t.nElements = int(arr.size)
     t.isScalar = arr.ndim == 0
@@ -104,7 +106,29 @@ def _decode_tensor(t, ctx: _Ctx):
         ctx.by_id[t.storage.id] = data
     shape = tuple(t.size)
     n = int(np.prod(shape)) if shape else 1
-    off = t.offset if data.size >= n + t.offset else 0
+    # proto offset is 1-based (see _encode_tensor); files written by the
+    # round-1 exporter used 0 -- treat offsets < 1 as start-of-storage
+    off = max(int(t.offset) - 1, 0)
+    strides = tuple(int(s) for s in t.stride)
+    if strides and list(strides) != _contiguous_strides(shape):
+        # non-contiguous view saved by real BigDL: reconstruct elementwise
+        # from size/stride/offset, then copy to a contiguous array
+        last = off + sum(s * (d - 1) for s, d in zip(strides, shape))
+        if not shape or min(shape) == 0:
+            return np.zeros(shape, data.dtype)
+        if last >= data.size or off >= data.size:
+            raise ValueError(
+                f"tensor view out of bounds: offset {t.offset}, strides "
+                f"{strides}, size {shape} over storage of {data.size}")
+        itemsize = data.dtype.itemsize
+        view = np.lib.stride_tricks.as_strided(
+            data[off:], shape=shape,
+            strides=tuple(s * itemsize for s in strides))
+        return np.ascontiguousarray(view)
+    if data.size < off + n:
+        raise ValueError(
+            f"tensor storage truncated: need {off + n} elements "
+            f"(offset {t.offset} + {n}), storage has {data.size}")
     return data[off:off + n].reshape(shape)
 
 

@@ -290,20 +290,24 @@ class RoiPooling(Module):
             y2 = jnp.round(roi[4] * self.scale)
             rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
             rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
-            bin_y = jnp.floor((ys - y1) * self.ph / rh)
-            bin_x = jnp.floor((xs - x1) * self.pw / rw)
             in_y = (ys >= y1) & (ys <= y2)
             in_x = (xs >= x1) & (xs <= x2)
-            by = jnp.where(in_y, jnp.clip(bin_y, 0, self.ph - 1), -1) \
-                .astype(jnp.int32)                 # (H,), -1 = outside roi
-            bx = jnp.where(in_x, jnp.clip(bin_x, 0, self.pw - 1), -1) \
-                .astype(jnp.int32)                 # (W,)
+            ry = (ys - y1).astype(feats.dtype)     # row offset within roi
+            rx = (xs - x1).astype(feats.dtype)
             fmap = feats[b]                        # (H, W, C)
 
-            # per-bin masked max via fori_loop: O(H*W*C) peak memory
+            # Caffe bin boundaries overlap: bin i covers rows
+            # [floor(i*rh/ph), ceil((i+1)*rh/ph)) -- a pixel may belong to
+            # two adjacent bins (reference: nn/RoiPooling.scala semantics)
             def bin_body(i, acc):
                 iy, ix = i // self.pw, i % self.pw
-                mask = ((by == iy)[:, None] & (bx == ix)[None, :])[..., None]
+                y_lo = jnp.floor(iy * rh / self.ph)
+                y_hi = jnp.ceil((iy + 1) * rh / self.ph)
+                x_lo = jnp.floor(ix * rw / self.pw)
+                x_hi = jnp.ceil((ix + 1) * rw / self.pw)
+                my = in_y & (ry >= y_lo) & (ry < y_hi)
+                mx = in_x & (rx >= x_lo) & (rx < x_hi)
+                mask = (my[:, None] & mx[None, :])[..., None]
                 val = jnp.max(jnp.where(mask, fmap, -jnp.inf), axis=(0, 1))
                 val = jnp.where(jnp.isfinite(val), val, 0.0)
                 return acc.at[iy, ix].set(val)

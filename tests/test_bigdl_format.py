@@ -76,6 +76,53 @@ class TestRoundTrip:
         with pytest.raises(NotImplementedError):
             save_bigdl(m, str(tmp_path / "x.bigdl"))
 
+    def test_one_based_storage_offset(self, tmp_path):
+        """Wire convention: storageOffset is 1-BASED (reference
+        TensorConverter.scala:278 writes _storageOffset + 1)."""
+        m = nn.Sequential().add(nn.Linear(4, 2))
+        m.forward(jnp.zeros((1, 4)))
+        p = str(tmp_path / "m.bigdl")
+        save_bigdl(m, p)
+        msg = pb.BigDLModule()
+        with open(p, "rb") as f:
+            msg.ParseFromString(f.read())
+        for t in msg.subModules[0].parameters:
+            assert t.offset == 1
+
+    def test_decode_offset_and_strides(self):
+        """1-based offsets slice correctly; round-1 files with offset=0
+        still load; non-contiguous stride views reconstruct."""
+        from bigdl_tpu.interop.bigdl_format import _Ctx, _decode_tensor
+
+        def make(data, size, stride, offset):
+            t = pb.BigDLTensor()
+            t.datatype = pb.FLOAT
+            t.size.extend(size)
+            t.stride.extend(stride)
+            t.offset = offset
+            t.nElements = int(np.prod(size))
+            t.storage.datatype = pb.FLOAT
+            t.storage.id = 1
+            t.storage.float_data.extend(np.asarray(data, np.float32))
+            return t
+
+        data = np.arange(12, dtype=np.float32)
+        # whole-storage, 1-based offset
+        np.testing.assert_array_equal(
+            _decode_tensor(make(data, [3, 4], [4, 1], 1), _Ctx()),
+            data.reshape(3, 4))
+        # legacy round-1 files wrote offset=0 -> treated as start
+        np.testing.assert_array_equal(
+            _decode_tensor(make(data, [3, 4], [4, 1], 0), _Ctx()),
+            data.reshape(3, 4))
+        # shared-storage view: second row of a (3,4) tensor -> offset 5
+        np.testing.assert_array_equal(
+            _decode_tensor(make(data, [4], [1], 5), _Ctx()), data[4:8])
+        # non-contiguous (transposed) view: stride (1, 4)
+        np.testing.assert_array_equal(
+            _decode_tensor(make(data, [4, 3], [1, 4], 1), _Ctx()),
+            data.reshape(3, 4).T)
+
     def test_module_type_names_match_reference(self, tmp_path):
         """moduleType strings are the reference's Scala FQCNs."""
         m = nn.Sequential().add(nn.Linear(4, 2)).add(nn.ReLU())

@@ -212,6 +212,19 @@ class TestSpatialExtras:
         np.testing.assert_allclose(
             np.asarray(out)[0, :, :, 0], [[9, 11], [25, 27]])
 
+    def test_roi_pooling_caffe_overlapping_bins(self):
+        # 5x5 roi into 2x2 bins: Caffe boundaries [floor(i*5/2),
+        # ceil((i+1)*5/2)) = [0,3) and [2,5) OVERLAP at index 2
+        feats = jnp.asarray(
+            np.arange(64, dtype=np.float32).reshape(1, 8, 8, 1))
+        rois = jnp.asarray([[0, 0, 0, 4, 4]], jnp.float32)
+        out = nn.RoiPooling(2, 2, 1.0).forward((feats, rois))
+        f = np.arange(64, dtype=np.float32).reshape(8, 8)
+        gold = np.array(
+            [[f[0:3, 0:3].max(), f[0:3, 2:5].max()],
+             [f[2:5, 0:3].max(), f[2:5, 2:5].max()]])
+        np.testing.assert_allclose(np.asarray(out)[0, :, :, 0], gold)
+
     def test_temporal_max_pooling(self):
         x = jnp.asarray(np.random.default_rng(8).normal(size=(2, 10, 3)),
                         jnp.float32)
