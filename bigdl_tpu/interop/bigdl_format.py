@@ -17,6 +17,7 @@ Storage dedup: every distinct ndarray gets one ``TensorStorage`` id; the
 loader caches by id (reference: BigDLTensor.id / TensorStorage.id sharing).
 """
 
+import json
 import os
 
 import numpy as np
@@ -50,11 +51,29 @@ def _contiguous_strides(shape):
     return list(reversed(strides))
 
 
+def _proto_dtype(dtype):
+    """numpy dtype -> (proto DataType, storage field name, cast dtype)."""
+    if dtype == np.float64:
+        return pb.DOUBLE, "double_data", np.float64
+    if np.issubdtype(dtype, np.bool_):
+        return pb.BOOL, "bool_data", np.bool_
+    if dtype in (np.int64, np.uint32, np.uint64):
+        return pb.INT64, "long_data", np.int64
+    if np.issubdtype(dtype, np.integer):
+        return pb.INT32, "int_data", np.int32
+    # f32 + half/bfloat16 ride as FLOAT; exact dtype restored via the
+    # generic path's leafDtypes attr
+    return pb.FLOAT, "float_data", np.float32
+
+
 def _encode_tensor(arr, ctx: _Ctx, msg=None):
     orig = arr
-    arr = np.ascontiguousarray(arr)
+    arr = np.asarray(arr)
+    if not arr.flags["C_CONTIGUOUS"]:
+        # NB: unconditional ascontiguousarray would reshape 0-d to (1,)
+        arr = np.ascontiguousarray(arr)
     t = msg if msg is not None else pb.BigDLTensor()
-    t.datatype = pb.FLOAT if arr.dtype != np.float64 else pb.DOUBLE
+    t.datatype = _proto_dtype(arr.dtype)[0]
     t.size.extend(int(s) for s in arr.shape)
     t.stride.extend(_contiguous_strides(arr.shape))
     # reference writes 1-BASED storageOffset (TensorConverter.scala:278 uses
@@ -78,12 +97,9 @@ def _encode_tensor(arr, ctx: _Ctx, msg=None):
     t.storage.id = t.id
     ctx.by_obj[id(orig)] = t.id
     ctx.keep.append(orig)
-    flat = arr.astype(np.float64 if t.datatype == pb.DOUBLE else np.float32
-                      ).ravel()
-    if t.datatype == pb.DOUBLE:
-        t.storage.double_data.extend(flat.tolist())
-    else:
-        t.storage.float_data.extend(flat.tolist())
+    _, field, cast = _proto_dtype(arr.dtype)
+    flat = arr.astype(cast).ravel()
+    getattr(t.storage, field).extend(flat.tolist())
     return t
 
 
@@ -94,6 +110,10 @@ def _decode_tensor(t, ctx: _Ctx):
         data = np.asarray(t.storage.double_data, np.float64)
     elif t.storage.int_data:
         data = np.asarray(t.storage.int_data, np.int32)
+    elif t.storage.long_data:
+        data = np.asarray(t.storage.long_data, np.int64)
+    elif len(t.storage.bool_data):
+        data = np.asarray(t.storage.bool_data, np.bool_)
     elif t.storage.id in ctx.by_id:
         data = ctx.by_id[t.storage.id]
     elif t.nElements > 0:
@@ -315,11 +335,29 @@ def _noarg(cls_name):
 
 def _register_all():
     for name in ["ReLU", "Tanh", "Sigmoid", "LogSoftMax", "SoftMax",
-                 "ReLU6", "ELU", "SoftPlus", "SoftSign", "Abs", "Exp",
+                 "ReLU6", "SoftSign", "Abs", "Exp",
                  "Square", "Sqrt", "Identity", "FlattenTable", "GELU",
                  "SiLU"]:
         save, load = _noarg(name)
         _register(name, _NN + name, save, load)
+
+    # parameterised activations keep their args on the wire
+    # (reference: nn/ELU.scala alpha, nn/SoftPlus.scala beta)
+    def save_elu(m, p):
+        return {"alpha": float(m.alpha)}, []
+
+    def load_elu(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        return nn.ELU(attrs("alpha", 1.0)), {}
+    _register("ELU", _NN + "ELU", save_elu, load_elu)
+
+    def save_softplus(m, p):
+        return {"beta": float(m.beta)}, []
+
+    def load_softplus(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        return nn.SoftPlus(attrs("beta", 1.0)), {}
+    _register("SoftPlus", _NN + "SoftPlus", save_softplus, load_softplus)
 
     _register("Linear", _NN + "Linear", _save_linear, _load_linear)
     _register("SpatialConvolution", _NN + "SpatialConvolution",
@@ -389,30 +427,369 @@ _register_all()
 
 
 # --------------------------------------------------------------------------- #
+# generic reflection path: round-trips ANY module via recorded init args
+# (reference analogue: ModuleSerializable's constructor-mirror reflection,
+#  utils/serializer/ModuleSerializable.scala -- here the constructor call is
+#  recorded at instance creation, see nn/module.py _record_init)
+# --------------------------------------------------------------------------- #
+
+_GEN = "bigdl_tpu.nn."
+_GEN_CRIT = "bigdl_tpu.criterion."
+
+
+def _is_dtype_like(v):
+    if isinstance(v, np.dtype):
+        return True
+    if isinstance(v, type) and issubclass(v, np.generic):
+        return True
+    return type(v).__name__ == "_ScalarMeta"   # jnp.float32 & friends
+
+
+def _encode_value(a, value, ctx):
+    """python constructor-arg value -> AttrValue (generic path)."""
+    from bigdl_tpu.nn.module import Criterion, Module
+
+    if value is None:
+        a.dataType = pb.STRING
+        a.subType = "none"
+    elif isinstance(value, (bool, np.bool_)):
+        a.dataType = pb.BOOL
+        a.boolValue = bool(value)
+    elif isinstance(value, (int, np.integer)):
+        if abs(int(value)) > 2**31 - 1:
+            a.dataType = pb.INT64
+            a.int64Value = int(value)
+        else:
+            a.dataType = pb.INT32
+            a.int32Value = int(value)
+    elif isinstance(value, (float, np.floating)):
+        a.dataType = pb.DOUBLE
+        a.doubleValue = float(value)
+    elif isinstance(value, str):
+        a.dataType = pb.STRING
+        a.stringValue = value
+    elif isinstance(value, Module):
+        a.dataType = pb.MODULE
+        _module_to_pb(value, {}, {}, ctx, arch_only=True,
+                      msg=a.bigDLModuleValue)
+    elif isinstance(value, Criterion):
+        a.dataType = pb.MODULE
+        a.subType = "criterion"
+        _crit_to_pb(value, ctx, a.bigDLModuleValue)
+    elif _is_dtype_like(value):
+        a.dataType = pb.STRING
+        a.subType = "dtype"
+        a.stringValue = np.dtype(value).name
+    elif isinstance(value, np.ndarray) or type(value).__module__.startswith(
+            ("jax", "jaxlib")):
+        arr = np.asarray(value)
+        a.dataType = pb.TENSOR
+        a.subType = str(arr.dtype)
+        _encode_tensor(arr, ctx, a.tensorValue)
+    elif isinstance(value, (tuple, list)):
+        a.dataType = pb.ARRAY_VALUE
+        a.subType = "list" if isinstance(value, list) else "tuple"
+        av = a.arrayValue
+        av.size = len(value)
+        if not value:
+            av.datatype = pb.INT32
+        elif all(isinstance(v, (bool, np.bool_)) for v in value):
+            av.datatype = pb.BOOL
+            av.boolean.extend(bool(v) for v in value)
+        elif all(isinstance(v, (int, np.integer)) for v in value):
+            av.datatype = pb.INT32
+            av.i32.extend(int(v) for v in value)
+        elif all(isinstance(v, (int, float, np.integer, np.floating))
+                 for v in value):
+            av.datatype = pb.DOUBLE
+            av.dbl.extend(float(v) for v in value)
+        elif all(isinstance(v, str) for v in value):
+            av.datatype = pb.STRING
+            av.str.extend(value)
+        elif all(isinstance(v, Module) for v in value):
+            av.datatype = pb.MODULE
+            for v in value:
+                _module_to_pb(v, {}, {}, ctx, arch_only=True,
+                              msg=av.bigDLModule.add())
+        elif all(isinstance(v, Criterion) for v in value):
+            av.datatype = pb.MODULE
+            a.subType += ":criterion"
+            for v in value:
+                _crit_to_pb(v, ctx, av.bigDLModule.add())
+        elif all(isinstance(v, (tuple, list)) and all(
+                isinstance(x, (int, np.integer)) for x in v) for v in value):
+            av.datatype = pb.SHAPE
+            for v in value:
+                s = av.shape.add()
+                s.shapeType = pb.Shape.SINGLE
+                s.ssize = len(v)
+                s.shapeValue.extend(int(x) for x in v)
+        else:
+            raise TypeError(
+                f"unsupported constructor-arg sequence for serialization: "
+                f"{value!r}")
+    else:
+        raise TypeError(
+            f"unsupported constructor-arg type for serialization: "
+            f"{type(value).__name__} ({value!r}); register an explicit "
+            f"converter for this layer")
+
+
+def _decode_value(a, ctx):
+    import jax.numpy as jnp
+
+    if a.subType == "none":
+        return None
+    if a.subType == "dtype":
+        return jnp.dtype(a.stringValue)
+    which = a.WhichOneof("value")
+    if which is None:
+        return None
+    if which == "bigDLModuleValue":
+        if a.subType == "criterion":
+            return _crit_from_pb(a.bigDLModuleValue, ctx)
+        return _module_from_pb(a.bigDLModuleValue, ctx, (), [])
+    if which == "tensorValue":
+        arr = _decode_tensor(a.tensorValue, ctx)
+        if a.subType:
+            arr = arr.astype(jnp.dtype(a.subType))
+        return jnp.asarray(arr)
+    if which == "arrayValue":
+        av = a.arrayValue
+        if av.datatype == pb.BOOL:
+            out = [bool(v) for v in av.boolean]
+        elif av.datatype == pb.INT32:
+            out = [int(v) for v in av.i32]
+        elif av.datatype == pb.DOUBLE:
+            out = [float(v) for v in av.dbl]
+        elif av.datatype == pb.STRING:
+            out = list(av.str)
+        elif av.datatype == pb.MODULE:
+            if a.subType.endswith(":criterion"):
+                out = [_crit_from_pb(m, ctx) for m in av.bigDLModule]
+            else:
+                out = [_module_from_pb(m, ctx, (), []) for m in av.bigDLModule]
+        elif av.datatype == pb.SHAPE:
+            out = [tuple(int(x) for x in s.shapeValue) for s in av.shape]
+        else:
+            raise TypeError(f"unsupported array datatype {av.datatype}")
+        return out if a.subType.startswith("list") else tuple(out)
+    v = getattr(a, which)
+    return v
+
+
+def _crit_to_pb(crit, ctx, msg):
+    msg.moduleType = _GEN_CRIT + type(crit).__name__
+    args, kwargs = getattr(crit, "_init_args", ((), {}))
+    _encode_value(msg.attr["nArgs"], len(args), ctx)
+    for i, v in enumerate(args):
+        _encode_value(msg.attr[f"arg{i}"], v, ctx)
+    for k, v in kwargs.items():
+        _encode_value(msg.attr["kw:" + k], v, ctx)
+    return msg
+
+
+def _crit_from_pb(msg, ctx):
+    import bigdl_tpu.nn as nn
+
+    name = msg.moduleType.rsplit(".", 1)[-1]
+    cls = getattr(nn, name, None)
+    if cls is None:
+        raise NotImplementedError(f"unknown criterion {msg.moduleType}")
+    nargs = _decode_value(msg.attr["nArgs"], ctx)
+    args = [_decode_value(msg.attr[f"arg{i}"], ctx) for i in range(nargs)]
+    kwargs = {k[3:]: _decode_value(v, ctx)
+              for k, v in msg.attr.items() if k.startswith("kw:")}
+    return cls(*args, **kwargs)
+
+
+def _generic_to_pb(module, params, state, ctx, arch_only=False, msg=None):
+    import jax
+
+    msg = msg if msg is not None else pb.BigDLModule()
+    msg.name = module.name or type(module).__name__
+    msg.version = "0.8.0"
+    msg.train = bool(getattr(module, "train_mode", True))
+    msg.moduleType = _GEN + type(module).__name__
+    args, kwargs = getattr(module, "_init_args", ((), {}))
+    _encode_value(msg.attr["nArgs"], len(args), ctx)
+    for i, v in enumerate(args):
+        _encode_value(msg.attr[f"arg{i}"], v, ctx)
+    for k, v in kwargs.items():
+        _encode_value(msg.attr["kw:" + k], v, ctx)
+
+    from bigdl_tpu.nn.module import Container
+    if isinstance(module, Container):
+        # children added via .add() post-construction; constructor-built
+        # children (wrappers) are re-created by the constructor on load
+        n_ctor = len(_ctor_children(module))
+        _encode_value(msg.attr["nCtorChildren"], n_ctor, ctx)
+        for child in module.modules[n_ctor:]:
+            _module_to_pb(child, {}, {}, ctx, arch_only=True,
+                          msg=msg.subModules.add())
+
+    if not arch_only:
+        p_leaves = jax.tree_util.tree_leaves(params)
+        s_leaves = jax.tree_util.tree_leaves(state)
+        if p_leaves or s_leaves:
+            msg.hasParameters = True
+            _encode_value(msg.attr["nParamLeaves"], len(p_leaves), ctx)
+            dtypes = []
+            for leaf in p_leaves + s_leaves:
+                arr = np.asarray(leaf)
+                dtypes.append(str(arr.dtype))
+                _encode_tensor(arr, ctx, msg.parameters.add())
+            _encode_value(msg.attr["leafDtypes"], dtypes, ctx)
+    return msg
+
+
+def _ctor_children(module):
+    """Children the constructor itself creates: re-running cls(*init_args)
+    on load reproduces them, so only .add()-ed children serialize as
+    subModules.  Detected by re-invoking the constructor (pure by the
+    module contract: __init__ only stores config)."""
+    cls = type(module)
+    args, kwargs = getattr(module, "_init_args", ((), {}))
+    try:
+        probe = cls(*args, **kwargs)
+        return probe.modules
+    except Exception:
+        return []
+
+
+def _generic_from_pb(msg, ctx, path, installs):
+    import bigdl_tpu.nn as nn
+
+    name = msg.moduleType.rsplit(".", 1)[-1]
+    cls = getattr(nn, name, None)
+    if cls is None:
+        raise NotImplementedError(f"unknown module type {msg.moduleType}")
+    nargs = _decode_value(msg.attr["nArgs"], ctx)
+    args = [_decode_value(msg.attr[f"arg{i}"], ctx) for i in range(nargs)]
+    kwargs = {k[3:]: _decode_value(v, ctx)
+              for k, v in msg.attr.items() if k.startswith("kw:")}
+    m = cls(*args, **kwargs)
+    if msg.name:
+        m.name = msg.name
+    if "nCtorChildren" in msg.attr:
+        n_ctor = _decode_value(msg.attr["nCtorChildren"], ctx)
+        if len(m.modules) != n_ctor:
+            raise ValueError(
+                f"{type(m).__name__}: constructor produced "
+                f"{len(m.modules)} children but the file was saved with "
+                f"{n_ctor} -- save-side probe and load disagree")
+    if msg.subModules:
+        for sub in msg.subModules:
+            m.add(_module_from_pb(sub, ctx, (), []))
+    if msg.hasParameters:
+        n_p = _decode_value(msg.attr["nParamLeaves"], ctx)
+        dtypes = _decode_value(msg.attr["leafDtypes"], ctx) or []
+        leaves = [_decode_tensor(t, ctx) for t in msg.parameters]
+        leaves = [l.astype(np.dtype(d)) if d else l
+                  for l, d in zip(leaves, dtypes)]
+        installs.append(("subtree", path, leaves[:n_p], leaves[n_p:]))
+    return m
+
+
+# --------------------------------------------------------------------------- #
+# Graph (static DAG): topology via subModules + preModules edge names
+# (reference: Graph serialization with preModules/nextModules fields)
+# --------------------------------------------------------------------------- #
+
+
+def _graph_to_pb(module, params, state, ctx, arch_only=False, msg=None):
+    msg = msg if msg is not None else pb.BigDLModule()
+    msg.name = module.name
+    msg.version = "0.8.0"
+    msg.train = bool(module.train_mode)
+    msg.moduleType = _NN + "StaticGraph"
+    names = {id(n): f"node{i}" for i, n in enumerate(module._topo)}
+    for i, node in enumerate(module._topo):
+        if node.module is None:
+            sub = msg.subModules.add()
+            sub.moduleType = _NN + "Input"
+        else:
+            sub = _module_to_pb(node.module, params.get(str(i), {}),
+                                state.get(str(i), {}), ctx,
+                                arch_only=arch_only,
+                                msg=msg.subModules.add())
+            _encode_value(sub.attr["origName"], node.module.name, ctx)
+        sub.name = names[id(node)]
+        sub.preModules.extend(names[id(p)] for p in node.inputs)
+    _encode_value(msg.attr["inputNames"],
+                  [names[id(n)] for n in module.input_nodes], ctx)
+    _encode_value(msg.attr["outputNames"],
+                  [names[id(n)] for n in module.output_nodes], ctx)
+    return msg
+
+
+def _graph_from_pb(msg, ctx, path, installs):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.graph import Input, Node
+
+    nodes = {}
+    for i, sub in enumerate(msg.subModules):
+        if sub.moduleType.rsplit(".", 1)[-1] == "Input":
+            node = Input()
+        else:
+            m = _module_from_pb(sub, ctx, path + (str(i),), installs)
+            orig = _decode_value(sub.attr["origName"], ctx) \
+                if "origName" in sub.attr else None
+            if orig:
+                m.name = orig
+            node = Node(m, [nodes[p] for p in sub.preModules])
+        nodes[sub.name] = node
+    inputs = [nodes[n] for n in _decode_value(msg.attr["inputNames"], ctx)]
+    outputs = [nodes[n] for n in _decode_value(msg.attr["outputNames"], ctx)]
+    g = nn.Graph(inputs, outputs)
+    if msg.name:
+        g.name = msg.name
+    return g
+
+
+# --------------------------------------------------------------------------- #
 # module tree <-> BigDLModule
 # --------------------------------------------------------------------------- #
 
 
-def _module_to_pb(module, params, state, ctx: _Ctx):
-    """params/state are THIS module's subtrees (root owns the full tree)."""
+def _module_to_pb(module, params, state, ctx: _Ctx, arch_only=False,
+                  msg=None):
+    """params/state are THIS module's subtrees (root owns the full tree).
+
+    Dispatch: Graph -> topology converter; Sequential/Concat -> wire-compat
+    recursion; registered classes -> wire-compat converters (reference FQCN
+    moduleType, readable by real BigDL); everything else -> generic
+    reflection path.  arch_only (constructor-arg modules) always uses the
+    generic/graph path since wire-compat converters need built params.
+    """
     import bigdl_tpu.nn as nn
 
-    msg = pb.BigDLModule()
-    msg.name = module.name or type(module).__name__
+    params = params if isinstance(params, dict) else {}
+    state = state if isinstance(state, dict) else {}
+
+    if isinstance(module, nn.Graph):
+        return _graph_to_pb(module, params, state, ctx,
+                            arch_only=arch_only, msg=msg)
+    cls = type(module).__name__
+    if not isinstance(module, (nn.Sequential, nn.Concat)):
+        if arch_only or cls not in _SAVERS:
+            return _generic_to_pb(module, params, state, ctx,
+                                  arch_only=arch_only, msg=msg)
+
+    msg = msg if msg is not None else pb.BigDLModule()
+    msg.name = module.name or cls
     msg.version = "0.8.0"
     msg.train = bool(getattr(module, "train_mode", True))
 
-    cls = type(module).__name__
-    params = params if isinstance(params, dict) else {}
-    state = state if isinstance(state, dict) else {}
     if isinstance(module, (nn.Sequential, nn.Concat)):
         msg.moduleType = _NN + cls
         if isinstance(module, nn.Concat):
             _set_attr(msg.attr, "dimension", module.dimension + 1, ctx)
         for i, child in enumerate(module.modules):
-            msg.subModules.append(_module_to_pb(
-                child, params.get(str(i), {}), state.get(str(i), {}), ctx))
-    elif cls in _SAVERS:
+            _module_to_pb(
+                child, params.get(str(i), {}), state.get(str(i), {}), ctx,
+                arch_only=arch_only, msg=msg.subModules.add())
+    else:
         module_type, to_attrs = _SAVERS[cls]
         msg.moduleType = module_type
         attrs, plist = to_attrs(module, params)
@@ -430,10 +807,6 @@ def _module_to_pb(module, params, state, ctx: _Ctx):
                       np.asarray(state["running_mean"]), ctx)
             _set_attr(msg.attr, "runningVar",
                       np.asarray(state["running_var"]), ctx)
-    else:
-        raise NotImplementedError(
-            f"{cls} has no BigDL-format converter; use "
-            f"bigdl_tpu.utils.serializer for the native format")
     return msg
 
 
@@ -443,6 +816,12 @@ def _module_from_pb(msg, ctx: _Ctx, path, installs):
 
     mt = msg.moduleType
     short = mt.rsplit(".", 1)[-1]
+    if short == "StaticGraph":
+        return _graph_from_pb(msg, ctx, path, installs)
+    # registered loaders win over the generic prefix: a few wire-compat
+    # types (e.g. Flatten) live under the bigdl_tpu.nn. moduleType too
+    if mt.startswith(_GEN) and mt not in _LOADERS:
+        return _generic_from_pb(msg, ctx, path, installs)
     if short in ("Sequential", "Concat"):
         if short == "Concat":
             node = nn.Concat(_get_attr(msg, "dimension", 1, ctx) - 1)
@@ -481,8 +860,14 @@ def _module_from_pb(msg, ctx: _Ctx, path, installs):
 
 def _install(module, installs):
     """Overwrite built params/state leaves with deserialized values."""
+    import jax
     import jax.numpy as jnp
-    for path, key, value, is_state in installs:
+    for entry in installs:
+        if entry[0] == "subtree":
+            _, path, p_leaves, s_leaves = entry
+            _install_subtree(module, path, p_leaves, s_leaves)
+            continue
+        path, key, value, is_state = entry
         node = module._state if is_state else module._params
         for p in path:
             node = node[p]
@@ -495,6 +880,40 @@ def _install(module, installs):
                 f"shape mismatch at {'/'.join(path)}/{key}: file "
                 f"{value.shape} vs module {tuple(node[key].shape)}")
         node[key] = jnp.asarray(value)
+
+
+def _install_subtree(module, path, p_leaves, s_leaves):
+    """Replace the flattened leaves of the params/state subtree at ``path``
+    (generic path: leaf ORDER is the contract -- same class + init args +
+    build spec => same treedef on both sides)."""
+    import jax
+    import jax.numpy as jnp
+
+    for attr, leaves in (("_params", p_leaves), ("_state", s_leaves)):
+        tree = getattr(module, attr)
+        parents, node = [], tree
+        for k in path:
+            parents.append(node)
+            node = node[k]
+        flat, treedef = jax.tree_util.tree_flatten(node)
+        if len(flat) != len(leaves):
+            raise ValueError(
+                f"{attr} subtree at {'/'.join(path) or '<root>'} has "
+                f"{len(flat)} leaves; file has {len(leaves)} -- was the "
+                f"module built with a different input spec?")
+        new = []
+        for old, val in zip(flat, leaves):
+            if tuple(np.shape(old)) != tuple(np.shape(val)):
+                raise ValueError(
+                    f"shape mismatch in {attr} at "
+                    f"{'/'.join(path) or '<root>'}: file {np.shape(val)} "
+                    f"vs module {tuple(np.shape(old))}")
+            new.append(jnp.asarray(val))
+        rebuilt = jax.tree_util.tree_unflatten(treedef, new)
+        if parents:
+            parents[-1][path[-1]] = rebuilt
+        else:
+            setattr(module, attr, rebuilt)
 
 
 def _strip_storages(msg, store):
@@ -541,22 +960,45 @@ def _restore_storages(msg, store):
         _restore_storages(sub, store)
 
 
+def _spec_to_json(spec):
+    if isinstance(spec, (tuple, list)):
+        return [_spec_to_json(s) for s in spec]
+    if hasattr(spec, "shape") and hasattr(spec, "dtype"):
+        return {"shape": [int(s) for s in spec.shape],
+                "dtype": str(np.dtype(spec.dtype))}
+    raise TypeError(f"unsupported build spec node {type(spec).__name__}")
+
+
+def _spec_from_json(j):
+    import jax
+    if isinstance(j, list):
+        return tuple(_spec_from_json(s) for s in j)
+    return jax.ShapeDtypeStruct(tuple(j["shape"]), np.dtype(j["dtype"]))
+
+
 def save_bigdl(module, path, overwrite=True, weight_path=None):
     """ModulePersister.saveToFile equivalent (protobuf BigDLModule file).
 
     ``weight_path``: big-model support — tensor storages go to a separate
     npz keyed by storage id and the proto keeps only metadata (reference:
     ModuleLoader.scala:219 saveToFile(definitionPath, weightPath)).
+
+    Unbuilt modules save architecture-only; built modules additionally
+    record their build spec so ``load_bigdl`` can rebuild without an
+    ``input_spec``.
     """
     if os.path.exists(path) and not overwrite:
         raise FileExistsError(path)
-    if not module.is_built():
-        raise RuntimeError(
-            "module has no parameters yet -- call build()/forward() before "
-            "save_bigdl (reference models are always materialised)")
     ctx = _Ctx()
     msg = _module_to_pb(module, module._params or {}, module._state or {},
-                        ctx)
+                        ctx, arch_only=not module.is_built())
+    build_spec = getattr(module, "_build_spec", None)  # round-1 pickle
+    if module.is_built() and build_spec is not None:   # objects lack it
+        try:
+            _set_attr(msg.attr, "buildSpec",
+                      json.dumps(_spec_to_json(build_spec)), ctx)
+        except TypeError:
+            pass     # exotic spec: caller must pass input_spec at load
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     if weight_path is not None:
@@ -588,6 +1030,8 @@ def load_bigdl(path, input_spec=None, weight_path=None):
     ctx = _Ctx()
     installs = []
     module = _module_from_pb(msg, ctx, (), installs)
+    if not msg.train:
+        module.evaluate()
 
     orig_build = module.build
 
@@ -597,10 +1041,10 @@ def load_bigdl(path, input_spec=None, weight_path=None):
         return out
     module.build = build_and_install
 
+    if input_spec is None and "buildSpec" in msg.attr:
+        input_spec = _spec_from_json(
+            json.loads(_get_attr(msg, "buildSpec", ctx=ctx)))
     if input_spec is not None:
-        import jax
-        if not isinstance(input_spec, jax.ShapeDtypeStruct):
-            arr = np.asarray(input_spec)
-            input_spec = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
-        module.build(input_spec)
+        from bigdl_tpu.utils.shape import spec_of
+        module.build(spec_of(input_spec))
     return module

@@ -23,6 +23,7 @@ optimizers -- never uses the facade: they extract ``setup``/``apply`` and jit
 one fused train step (see optim/local_optimizer.py).
 """
 
+import functools
 from typing import Any, Optional, Tuple
 
 import jax
@@ -36,6 +37,26 @@ State = Any
 Activity = Any
 
 _name_counters = {}
+
+
+def _record_init(cls):
+    """Wrap ``cls.__init__`` to record the constructor call on the instance.
+
+    The outermost (most-derived) call wins; nested super().__init__ calls
+    see ``_init_args`` already set and leave it alone.  This is the
+    reflection seam the protobuf serializer uses to round-trip EVERY module
+    without per-class converters (reference: ModuleSerializable's
+    constructor-mirror reflection, utils/serializer/ModuleSerializable.scala).
+    """
+    orig = cls.__dict__["__init__"]
+
+    @functools.wraps(orig)
+    def __init__(self, *args, **kwargs):
+        if not hasattr(self, "_init_args"):
+            self._init_args = (args, dict(kwargs))
+        orig(self, *args, **kwargs)
+
+    cls.__init__ = __init__
 
 
 def _auto_name(cls_name: str) -> str:
@@ -54,6 +75,11 @@ def child_rng(rng, index: int):
 class Module:
     """Base class of every layer (reference: AbstractModule.scala:59)."""
 
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if "__init__" in cls.__dict__:
+            _record_init(cls)
+
     def __init__(self, name: Optional[str] = None):
         self.name = name or _auto_name(type(self).__name__)
         self.train_mode: bool = True
@@ -64,6 +90,7 @@ class Module:
         self._state: State = None
         self._grads: Params = None
         self._last_rng = None
+        self._build_spec = None
 
     # ------------------------------------------------------------------ #
     # Functional contract -- override these two in every layer.
@@ -95,6 +122,7 @@ class Module:
         """Materialise params/state for an input spec (lazy in forward())."""
         if rng is None:
             rng = RNG.next_key()
+        self._build_spec = input_spec     # recorded for serialization
         self._params, self._state = self.setup(rng, input_spec)
         self._grads = None
         return self
@@ -278,6 +306,11 @@ class Criterion:
     """
 
     size_average: bool = True
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if "__init__" in cls.__dict__:
+            _record_init(cls)
 
     def apply(self, input: Activity, target: Activity) -> jnp.ndarray:
         raise NotImplementedError(type(self).__name__)

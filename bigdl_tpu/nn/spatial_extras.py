@@ -395,15 +395,20 @@ class VolumetricFullConvolution(Module):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         kt, kh, kw = self.kernel
+        st, sh, sw = self.stride
         pt, ph, pw = self.pad
         at, ah, aw = self.adj
-        y = lax.conv_transpose(
-            input, params["weight"].astype(input.dtype), self.stride,
-            [(kt - 1 - pt, kt - 1 - pt + at),
-             (kh - 1 - ph, kh - 1 - ph + ah),
-             (kw - 1 - pw, kw - 1 - pw + aw)],
-            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-            transpose_kernel=True)
+        # Transposed conv = conv with lhs dilation over the spatially
+        # flipped kernel (same construction as SpatialFullConvolution)
+        w = params["weight"].astype(input.dtype)[::-1, ::-1, ::-1, :, :]
+        y = lax.conv_general_dilated(
+            input, w,
+            window_strides=(1, 1, 1),
+            padding=((kt - 1 - pt, kt - 1 - pt + at),
+                     (kh - 1 - ph, kh - 1 - ph + ah),
+                     (kw - 1 - pw, kw - 1 - pw + aw)),
+            lhs_dilation=(st, sh, sw),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
         if self.with_bias:
             y = y + params["bias"].astype(y.dtype)
         return y, state

@@ -4,11 +4,15 @@ Reference: utils/serializer/ (protobuf bigdl.proto model format with storage
 dedup + big-model separate weight file), utils/File.scala (legacy Java
 serialization).
 
-Round-1 format: a single pickle containing (a) the module object graph --
-plain Python objects, no compiled state -- and (b) params/state pytrees as
-numpy.  ``save_weights``/``load_weights`` additionally give an npz flat-
-tensor format for interop.  (A bigdl.proto-compatible exporter is a later
-interop layer; see SURVEY.md section 2.6.)
+PRIMARY format (round 2+): the language-neutral protobuf wire format
+(interop/bigdl_format.py) -- wire-compatible moduleTypes for the reference
+overlap set, generic reflection encoding (recorded constructor args +
+flattened param/state leaves) for everything else.  Survives class
+refactors between versions, unlike pickle.
+
+``load_module`` still reads round-1 pickle files (sniffed by the pickle
+magic byte).  ``save_weights``/``load_weights`` give an npz flat-tensor
+format for interop.
 """
 
 import os
@@ -18,41 +22,32 @@ import jax
 import numpy as np
 
 
-def _numpyify(tree):
-    return jax.tree.map(np.asarray, tree)
-
-
-def save_module(module, path: str):
+def save_module(module, path: str, weight_path=None):
     """Persist architecture + weights + state (reference:
     ModulePersister.saveToFile, utils/serializer/ModuleLoader.scala:219)."""
-    params, state = module._params, module._state
-    payload = {
-        "format": "bigdl_tpu.module.v1",
-        "module": module,          # architecture (python object graph)
-        "params": _numpyify(params),
-        "state": _numpyify(state),
-    }
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    # strip live arrays off the module object before pickling
-    saved = module._params, module._state, module._grads
-    module._params = module._state = module._grads = None
-    try:
-        with open(path, "wb") as f:
-            pickle.dump(payload, f)
-    finally:
-        module._params, module._state, module._grads = saved
+    from bigdl_tpu.interop.bigdl_format import save_bigdl
+
+    save_bigdl(module, path, weight_path=weight_path)
 
 
-def load_module(path: str):
-    """-> module with params/state restored (reference: ModuleLoader.loadFromFile)."""
+def load_module(path: str, input_spec=None, weight_path=None):
+    """-> module with params/state restored (reference:
+    ModuleLoader.loadFromFile).  Reads the protobuf format; round-1 pickle
+    files are detected by the pickle magic and still load."""
     with open(path, "rb") as f:
-        payload = pickle.load(f)
-    assert payload.get("format") == "bigdl_tpu.module.v1", "unknown format"
-    module = payload["module"]
-    module._params = payload["params"]
-    module._state = payload["state"]
-    return module
+        head = f.read(2)
+    if head[:1] == b"\x80":      # pickle protocol >= 2 (round-1 format)
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        assert payload.get("format") == "bigdl_tpu.module.v1", \
+            "unknown format"
+        module = payload["module"]
+        module._params = payload["params"]
+        module._state = payload["state"]
+        return module
+    from bigdl_tpu.interop.bigdl_format import load_bigdl
+
+    return load_bigdl(path, input_spec=input_spec, weight_path=weight_path)
 
 
 def save_weights(module, path: str):

@@ -5,7 +5,6 @@ round-trips modules through the protobuf schema.
 """
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 
@@ -68,13 +67,12 @@ class TestRoundTrip:
         assert not lin.parameters[0].storage.float_data
 
     def test_lookup_embedding(self, tmp_path):
+        # TimeDistributed has no wire-compat converter -> generic
+        # reflection path (round 2) round-trips it anyway
         m = nn.Sequential().add(nn.LookupTable(10, 6)).add(
             nn.TimeDistributed(nn.Linear(6, 3)))
-        # TimeDistributed has no converter -> native error path
         x = jnp.asarray([[1, 2], [3, 4]])
-        m.forward(x)
-        with pytest.raises(NotImplementedError):
-            save_bigdl(m, str(tmp_path / "x.bigdl"))
+        _round_trip(m, x, tmp_path)
 
     def test_one_based_storage_offset(self, tmp_path):
         """Wire convention: storageOffset is 1-BASED (reference

@@ -225,6 +225,23 @@ class TestSpatialExtras:
              [f[2:5, 0:3].max(), f[2:5, 2:5].max()]])
         np.testing.assert_allclose(np.asarray(out)[0, :, :, 0], gold)
 
+    def test_volumetric_full_convolution(self):
+        """Golden vs torch ConvTranspose3d (was untested: the original
+        conv_transpose(transpose_kernel=True) call mis-ordered I/O dims)."""
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(2, 4, 4, 4, 2)).astype(np.float32)
+        m = nn.VolumetricFullConvolution(2, 3, 2, 2, 2, 2, 2, 2)
+        y = m.forward(jnp.asarray(x))
+        tm = torch.nn.ConvTranspose3d(2, 3, 2, stride=2)
+        with torch.no_grad():
+            # ours: (kt, kh, kw, cin, cout); torch: (cin, cout, kt, kh, kw)
+            tm.weight.copy_(_t(
+                np.asarray(m._params["weight"]).transpose(3, 4, 0, 1, 2)))
+            tm.bias.copy_(_t(np.asarray(m._params["bias"])))
+        gold = tm(_t(x.transpose(0, 4, 1, 2, 3))).detach().numpy() \
+            .transpose(0, 2, 3, 4, 1)
+        np.testing.assert_allclose(np.asarray(y), gold, atol=1e-5)
+
     def test_temporal_max_pooling(self):
         x = jnp.asarray(np.random.default_rng(8).normal(size=(2, 10, 3)),
                         jnp.float32)
