@@ -1,0 +1,44 @@
+"""Keras-1.2.2-style API over bigdl_tpu (reference: nn/keras/*.scala,
+Topology.scala:55,89,127).
+
+Layers infer their underlying module from the input shape at build time --
+the TPU-native analogue of the reference's KerasLayer.doBuild(inputShape)
+"labor" pattern: our Module.setup already receives the input spec, so a
+Keras layer is just a Module that constructs and delegates to nn modules
+inside setup/apply.  Shape inference is jax.eval_shape (free, no tracing
+cost at runtime).
+
+    from bigdl_tpu.keras import Sequential, Dense
+    model = Sequential()
+    model.add(Dense(64, activation="relu", input_shape=(784,)))
+    model.add(Dense(10, activation="softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    model.fit(x, y, batch_size=32, nb_epoch=2)
+"""
+
+from bigdl_tpu.keras.layers import (  # noqa: F401
+    Activation, AtrousConvolution1D, AtrousConvolution2D, AveragePooling1D,
+    AveragePooling2D, AveragePooling3D, BatchNormalization, Bidirectional,
+    Convolution1D, Convolution2D, Convolution3D, Cropping1D, Cropping2D,
+    Cropping3D, Deconvolution2D, Dense, Dropout, ELU, Embedding, Flatten,
+    GRU, GaussianDropout, GaussianNoise, GlobalAveragePooling1D,
+    GlobalAveragePooling2D, GlobalAveragePooling3D, GlobalMaxPooling1D,
+    GlobalMaxPooling2D, GlobalMaxPooling3D, Highway, InputLayer, KerasLayer,
+    LSTM, LeakyReLU, LocallyConnected1D, LocallyConnected2D, Masking,
+    MaxPooling1D, MaxPooling2D, MaxPooling3D, MaxoutDense, Merge, PReLU,
+    Permute, RepeatVector, Reshape, SReLU, SeparableConvolution2D,
+    SimpleRNN, SoftMax, SpatialDropout1D, SpatialDropout2D,
+    SpatialDropout3D, ThresholdedReLU, TimeDistributed, UpSampling1D,
+    UpSampling2D, UpSampling3D, ZeroPadding1D, ZeroPadding2D, ZeroPadding3D,
+)
+from bigdl_tpu.keras.topology import Input, Model, Sequential  # noqa: F401
+from bigdl_tpu.keras.converter import (  # noqa: F401
+    load_keras, model_from_json, load_weights_hdf5,
+)
+
+# Keras-2/3 aliases (the importer normalises to the 1.2.2 names)
+Conv1D = Convolution1D
+Conv2D = Convolution2D
+Conv3D = Convolution3D
+Conv2DTranspose = Deconvolution2D
+SeparableConv2D = SeparableConvolution2D

@@ -1,0 +1,558 @@
+"""Keras model importer: JSON definition + HDF5 weights -> bigdl_tpu.
+
+Reference: pyspark/bigdl/keras/converter.py (DefinitionLoader /
+WeightLoader, 1759 LoC) -- consumes Keras-1.2.2 ``model.to_json()`` plus
+``save_weights`` HDF5.  This importer reads the same 1.2.2 format and
+additionally normalises Keras-2/3 config names (units->output_dim,
+filters/kernel_size->nb_filter/nb_row/nb_col, padding->border_mode,
+data_format->dim_ordering) so models written by modern Keras load too.
+
+    model = load_keras(json_path="m.json", hdf5_path="m_weights.h5")
+"""
+
+import json
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from bigdl_tpu.keras import layers as KL
+from bigdl_tpu.keras import topology as KT
+
+# ------------------------------------------------------------------ #
+# config normalisation (Keras 2/3 -> Keras 1.2.2 argument names)
+# ------------------------------------------------------------------ #
+
+_K2_CLASS = {
+    "Conv1D": "Convolution1D",
+    "Conv2D": "Convolution2D",
+    "Conv3D": "Convolution3D",
+    "Conv2DTranspose": "Deconvolution2D",
+    "SeparableConv2D": "SeparableConvolution2D",
+    "Add": "Merge", "Multiply": "Merge", "Average": "Merge",
+    "Maximum": "Merge", "Concatenate": "Merge", "Dot": "Merge",
+}
+
+_K2_MERGE_MODE = {
+    "Add": "sum", "Multiply": "mul", "Average": "ave", "Maximum": "max",
+    "Concatenate": "concat", "Dot": "dot",
+}
+
+
+def _norm_config(class_name, cfg):
+    """-> (keras1 class name, keras1-style config dict)."""
+    cfg = dict(cfg)
+    out = {}
+    name = _K2_CLASS.get(class_name, class_name)
+
+    def mv(src, dst, f=lambda v: v):
+        if src in cfg and cfg[src] is not None:
+            out[dst] = f(cfg.pop(src))
+
+    mv("name", "name")
+    mv("batch_input_shape", "batch_input_shape")
+    mv("batch_shape", "batch_input_shape")       # keras3 InputLayer
+    if "input_shape" in cfg:
+        out.setdefault("batch_input_shape",
+                       [None] + list(cfg.pop("input_shape")))
+    mv("units", "output_dim")                    # Dense/RNN keras2
+    mv("output_dim", "output_dim")
+    mv("filters", "nb_filter")
+    mv("nb_filter", "nb_filter")
+    if "kernel_size" in cfg:
+        ks = cfg.pop("kernel_size")
+        ks = list(ks) if isinstance(ks, (list, tuple)) else [ks]
+        if name == "Convolution1D":
+            out["filter_length"] = ks[0]
+        elif name == "Convolution3D":
+            out["kernel_dim1"], out["kernel_dim2"], out["kernel_dim3"] = ks
+        else:
+            out["nb_row"], out["nb_col"] = ks[0], ks[-1]
+    for k in ("nb_row", "nb_col", "filter_length", "kernel_dim1",
+              "kernel_dim2", "kernel_dim3"):
+        mv(k, k)
+    if "strides" in cfg:
+        st = cfg.pop("strides")
+        st = list(st) if isinstance(st, (list, tuple)) else [st]
+        if name in ("Convolution1D", "MaxPooling1D", "AveragePooling1D"):
+            out["subsample_length" if name == "Convolution1D"
+                else "stride"] = st[0]
+        else:
+            out["subsample"] = tuple(st)
+    mv("subsample", "subsample", tuple)
+    mv("subsample_length", "subsample_length")
+    if "padding" in cfg and isinstance(cfg["padding"], str):
+        out["border_mode"] = cfg.pop("padding")
+    elif "padding" in cfg:
+        out["padding"] = cfg.pop("padding")      # ZeroPadding layers
+    mv("border_mode", "border_mode")
+    if "data_format" in cfg:
+        out["dim_ordering"] = ("tf" if cfg.pop("data_format")
+                               == "channels_last" else "th")
+    mv("dim_ordering", "dim_ordering")
+    mv("use_bias", "bias")
+    mv("bias", "bias")
+    if "activation" in cfg:
+        act = cfg.pop("activation")
+        if isinstance(act, dict):                # keras3 serialized object
+            act = act.get("config", {}).get("name", act.get("class_name"))
+        out["activation"] = act
+    mv("pool_size", "pool_size", tuple)
+    mv("pool_length", "pool_length")
+    mv("stride", "stride")
+    mv("rate", "p")                              # Dropout keras2
+    mv("p", "p")
+    mv("dropout", "p")
+    mv("epsilon", "epsilon")
+    mv("momentum", "momentum")
+    mv("axis", "axis")
+    mv("input_dim", "input_dim")
+    mv("input_length", "input_length")
+    mv("target_shape", "target_shape", tuple)
+    mv("dims", "dims", tuple)
+    mv("n", "n")
+    mv("size", "size", tuple)
+    mv("length", "length")
+    mv("cropping", "cropping")
+    mv("mask_value", "mask_value")
+    mv("alpha", "alpha")
+    mv("theta", "theta")
+    mv("sigma", "sigma")
+    mv("stddev", "sigma")                        # GaussianNoise keras2
+    mv("return_sequences", "return_sequences")
+    mv("go_backwards", "go_backwards")
+    mv("mode", "mode")
+    mv("concat_axis", "concat_axis")
+    if class_name in _K2_MERGE_MODE:
+        out["mode"] = _K2_MERGE_MODE[class_name]
+        if class_name == "Concatenate":
+            out["concat_axis"] = cfg.pop("axis", -1)
+    return name, out
+
+
+_BUILDERS = {
+    "Dense": lambda c: KL.Dense(
+        c["output_dim"], activation=c.get("activation"),
+        bias=c.get("bias", True)),
+    "Activation": lambda c: KL.Activation(c["activation"]),
+    "Dropout": lambda c: KL.Dropout(c.get("p", 0.5)),
+    "Flatten": lambda c: KL.Flatten(),
+    "Reshape": lambda c: KL.Reshape(c["target_shape"]),
+    "Permute": lambda c: KL.Permute(c["dims"]),
+    "RepeatVector": lambda c: KL.RepeatVector(c["n"]),
+    "Masking": lambda c: KL.Masking(c.get("mask_value", 0.0)),
+    "Highway": lambda c: KL.Highway(bias=c.get("bias", True)),
+    "MaxoutDense": lambda c: KL.MaxoutDense(
+        c["output_dim"], c.get("nb_feature", 4)),
+    "Embedding": lambda c: KL.Embedding(c["input_dim"], c["output_dim"]),
+    "BatchNormalization": lambda c: KL.BatchNormalization(
+        epsilon=c.get("epsilon", 1e-3), momentum=c.get("momentum", 0.99),
+        dim_ordering=c.get("dim_ordering", "th")),
+    "Convolution1D": lambda c: KL.Convolution1D(
+        c["nb_filter"], c["filter_length"],
+        activation=c.get("activation"),
+        border_mode=c.get("border_mode", "valid"),
+        subsample_length=c.get("subsample_length", 1),
+        bias=c.get("bias", True)),
+    "Convolution2D": lambda c: KL.Convolution2D(
+        c["nb_filter"], c["nb_row"], c["nb_col"],
+        activation=c.get("activation"),
+        border_mode=c.get("border_mode", "valid"),
+        subsample=c.get("subsample", (1, 1)),
+        dim_ordering=c.get("dim_ordering", "th"),
+        bias=c.get("bias", True)),
+    "Convolution3D": lambda c: KL.Convolution3D(
+        c["nb_filter"], c["kernel_dim1"], c["kernel_dim2"],
+        c["kernel_dim3"], activation=c.get("activation"),
+        border_mode=c.get("border_mode", "valid"),
+        subsample=c.get("subsample", (1, 1, 1)),
+        dim_ordering=c.get("dim_ordering", "th"),
+        bias=c.get("bias", True)),
+    "Deconvolution2D": lambda c: KL.Deconvolution2D(
+        c["nb_filter"], c["nb_row"], c["nb_col"],
+        activation=c.get("activation"),
+        subsample=c.get("subsample", (1, 1)),
+        dim_ordering=c.get("dim_ordering", "th"),
+        bias=c.get("bias", True)),
+    "SeparableConvolution2D": lambda c: KL.SeparableConvolution2D(
+        c["nb_filter"], c["nb_row"], c["nb_col"],
+        activation=c.get("activation"),
+        border_mode=c.get("border_mode", "valid"),
+        subsample=c.get("subsample", (1, 1)),
+        depth_multiplier=c.get("depth_multiplier", 1),
+        dim_ordering=c.get("dim_ordering", "th"),
+        bias=c.get("bias", True)),
+    "MaxPooling1D": lambda c: KL.MaxPooling1D(
+        c.get("pool_length", 2), c.get("stride"),
+        c.get("border_mode", "valid")),
+    "AveragePooling1D": lambda c: KL.AveragePooling1D(
+        c.get("pool_length", 2), c.get("stride"),
+        c.get("border_mode", "valid")),
+    "MaxPooling2D": lambda c: KL.MaxPooling2D(
+        c.get("pool_size", (2, 2)), c.get("strides"),
+        c.get("border_mode", "valid"), c.get("dim_ordering", "th")),
+    "AveragePooling2D": lambda c: KL.AveragePooling2D(
+        c.get("pool_size", (2, 2)), c.get("strides"),
+        c.get("border_mode", "valid"), c.get("dim_ordering", "th")),
+    "MaxPooling3D": lambda c: KL.MaxPooling3D(
+        c.get("pool_size", (2, 2, 2)), c.get("strides"),
+        c.get("border_mode", "valid"), c.get("dim_ordering", "th")),
+    "AveragePooling3D": lambda c: KL.AveragePooling3D(
+        c.get("pool_size", (2, 2, 2)), c.get("strides"),
+        c.get("border_mode", "valid"), c.get("dim_ordering", "th")),
+    "GlobalMaxPooling1D": lambda c: KL.GlobalMaxPooling1D(),
+    "GlobalAveragePooling1D": lambda c: KL.GlobalAveragePooling1D(),
+    "GlobalMaxPooling2D": lambda c: KL.GlobalMaxPooling2D(
+        c.get("dim_ordering", "th")),
+    "GlobalAveragePooling2D": lambda c: KL.GlobalAveragePooling2D(
+        c.get("dim_ordering", "th")),
+    "GlobalMaxPooling3D": lambda c: KL.GlobalMaxPooling3D(
+        c.get("dim_ordering", "th")),
+    "GlobalAveragePooling3D": lambda c: KL.GlobalAveragePooling3D(
+        c.get("dim_ordering", "th")),
+    "ZeroPadding1D": lambda c: KL.ZeroPadding1D(c.get("padding", 1)),
+    "ZeroPadding2D": lambda c: KL.ZeroPadding2D(
+        c.get("padding", (1, 1)), c.get("dim_ordering", "th")),
+    "ZeroPadding3D": lambda c: KL.ZeroPadding3D(
+        c.get("padding", (1, 1, 1)), c.get("dim_ordering", "th")),
+    "Cropping1D": lambda c: KL.Cropping1D(c.get("cropping", (1, 1))),
+    "Cropping2D": lambda c: KL.Cropping2D(
+        c.get("cropping", ((0, 0), (0, 0))), c.get("dim_ordering", "th")),
+    "Cropping3D": lambda c: KL.Cropping3D(
+        c.get("cropping", ((1, 1), (1, 1), (1, 1))),
+        c.get("dim_ordering", "th")),
+    "UpSampling1D": lambda c: KL.UpSampling1D(c.get("length", 2)),
+    "UpSampling2D": lambda c: KL.UpSampling2D(
+        c.get("size", (2, 2)), c.get("dim_ordering", "th")),
+    "UpSampling3D": lambda c: KL.UpSampling3D(
+        c.get("size", (2, 2, 2)), c.get("dim_ordering", "th")),
+    "SimpleRNN": lambda c: KL.SimpleRNN(
+        c["output_dim"], c.get("activation", "tanh"),
+        c.get("return_sequences", False), c.get("go_backwards", False)),
+    "LSTM": lambda c: KL.LSTM(
+        c["output_dim"], c.get("activation", "tanh"),
+        c.get("return_sequences", False), c.get("go_backwards", False)),
+    "GRU": lambda c: KL.GRU(
+        c["output_dim"], c.get("activation", "tanh"),
+        c.get("return_sequences", False), c.get("go_backwards", False)),
+    "LeakyReLU": lambda c: KL.LeakyReLU(c.get("alpha", 0.3)),
+    "ELU": lambda c: KL.ELU(c.get("alpha", 1.0)),
+    "PReLU": lambda c: KL.PReLU(),
+    "SReLU": lambda c: KL.SReLU(),
+    "ThresholdedReLU": lambda c: KL.ThresholdedReLU(c.get("theta", 1.0)),
+    "SoftMax": lambda c: KL.SoftMax(),
+    "GaussianDropout": lambda c: KL.GaussianDropout(c.get("p", 0.5)),
+    "GaussianNoise": lambda c: KL.GaussianNoise(c.get("sigma", 0.1)),
+    "SpatialDropout1D": lambda c: KL.SpatialDropout1D(c.get("p", 0.5)),
+    "SpatialDropout2D": lambda c: KL.SpatialDropout2D(
+        c.get("p", 0.5), c.get("dim_ordering", "th")),
+    "SpatialDropout3D": lambda c: KL.SpatialDropout3D(
+        c.get("p", 0.5), c.get("dim_ordering", "th")),
+    "Merge": lambda c: KL.Merge(
+        mode=c.get("mode", "sum"), concat_axis=c.get("concat_axis", -1)),
+}
+
+
+def _build_layer(class_name, raw_config):
+    name, cfg = _norm_config(class_name, raw_config)
+    if name in ("InputLayer", "Input"):
+        return None, cfg
+    if name not in _BUILDERS:
+        raise NotImplementedError(
+            f"keras importer: unsupported layer {class_name}")
+    layer = _BUILDERS[name](cfg)
+    if cfg.get("name"):
+        layer.name = cfg["name"]
+    if cfg.get("batch_input_shape"):
+        layer.input_shape = tuple(cfg["batch_input_shape"][1:])
+    layer._keras_class = name
+    layer._keras_config = cfg
+    return layer, cfg
+
+
+def model_from_json(text):
+    """Keras model JSON (1.2.2 or 2/3) -> bigdl_tpu keras model."""
+    spec = json.loads(text) if isinstance(text, str) else text
+    cls = spec["class_name"]
+    config = spec["config"]
+    if cls == "Sequential":
+        layer_confs = config["layers"] if isinstance(config, dict) \
+            else config    # keras1: list; keras2/3: {"layers": [...]}
+        model = KT.Sequential()
+        for lc in layer_confs:
+            layer, cfg = _build_layer(lc["class_name"], lc["config"])
+            if layer is None:      # InputLayer: record shape for the next
+                model._pending_input_shape = tuple(
+                    cfg["batch_input_shape"][1:])
+                continue
+            if getattr(model, "_pending_input_shape", None) is not None \
+                    and layer.input_shape is None:
+                layer.input_shape = model._pending_input_shape
+                model._pending_input_shape = None
+            model.add(layer)
+        return model
+    if cls in ("Model", "Functional"):
+        return _model_from_functional(config)
+    raise NotImplementedError(f"unsupported model class {cls}")
+
+
+def _model_from_functional(config):
+    nodes = {}       # layer name -> output Node
+    layers = {}
+    for lc in config["layers"]:
+        lname = lc.get("name") or lc["config"].get("name")
+        layer, cfg = _build_layer(lc["class_name"], lc["config"])
+        inbound = lc.get("inbound_nodes") or []
+        in_names = _inbound_names(inbound)
+        if layer is None:
+            node = KT.Input(shape=cfg["batch_input_shape"][1:])
+            nodes[lname] = node
+            continue
+        layers[lname] = layer
+        parents = [nodes[n] for n in in_names]
+        nodes[lname] = layer(*parents)
+    def top(names):
+        return [nodes[n[0] if isinstance(n, (list, tuple)) else n]
+                for n in names]
+    inputs = top(config["input_layers"])
+    outputs = top(config["output_layers"])
+    return KT.Model(inputs, outputs)
+
+
+def _inbound_names(inbound):
+    """keras1/2: [[[name, idx, tensor_idx], ...]]; keras3: list of dicts."""
+    if not inbound:
+        return []
+    first = inbound[0]
+    if isinstance(first, dict):      # keras3
+        hist = first["args"][0]
+        hist = hist if isinstance(hist, list) else [hist]
+        out = []
+        for h in hist:
+            kh = h["config"]["keras_history"] if isinstance(h, dict) else h
+            out.append(kh[0])
+        return out
+    return [e[0] for e in first]
+
+
+# ------------------------------------------------------------------ #
+# weight install
+# ------------------------------------------------------------------ #
+
+
+def _param_dicts(tree, keys=("weight",)):
+    """All dicts in the subtree containing every key, traversal order."""
+    found = []
+
+    def walk(t):
+        if isinstance(t, dict):
+            if all(k in t for k in keys):
+                found.append(t)
+            for k in sorted(t):
+                walk(t[k])
+        elif isinstance(t, (tuple, list)):
+            for v in t:
+                walk(v)
+    walk(tree)
+    return found
+
+
+def _as_mutable(tree):
+    if isinstance(tree, dict):
+        return {k: _as_mutable(v) for k, v in tree.items()}
+    if isinstance(tree, tuple):
+        return tuple(_as_mutable(v) for v in tree)
+    if isinstance(tree, list):
+        return [_as_mutable(v) for v in tree]
+    return tree
+
+
+def _set(d, key, arr):
+    want = tuple(np.shape(d[key]))
+    got = tuple(np.shape(arr))
+    if want != got:
+        raise ValueError(f"weight shape mismatch for {key}: model {want} "
+                         f"vs file {got}")
+    d[key] = jnp.asarray(np.asarray(arr, np.float32))
+
+
+def _install_dense(layer, p, s, arrays):
+    W = arrays[0]
+    d = _param_dicts(p)[0]
+    _set(d, "weight", W.T)
+    if len(arrays) > 1:
+        _set(d, "bias", arrays[1])
+
+
+def _install_conv2d(layer, p, s, arrays):
+    W = arrays[0]
+    if W.ndim == 4 and W.shape[-1] != layer.nb_filter:
+        # keras1 th layout (nb_filter, stack, rows, cols) -> HWIO
+        W = W.transpose(2, 3, 1, 0)
+    d = _param_dicts(p)[0]
+    _set(d, "weight", W.reshape(np.shape(d["weight"])))
+    if len(arrays) > 1:
+        _set(d, "bias", arrays[1])
+
+
+def _install_conv1d(layer, p, s, arrays):
+    W = arrays[0]
+    d = _param_dicts(p)[0]
+    if W.ndim == 4:                  # keras1 stores (k, 1, cin, cout)
+        W = W.reshape(W.shape[0], W.shape[2], W.shape[3])
+    _set(d, "weight", W.reshape(np.shape(d["weight"])))
+    if len(arrays) > 1:
+        _set(d, "bias", arrays[1])
+
+
+def _install_bn(layer, p, s, arrays):
+    gamma, beta, mean, var = arrays
+    d = _param_dicts(p)[0]
+    _set(d, "weight", gamma)
+    _set(d, "bias", beta)
+    sd = _param_dicts(s, keys=("running_mean",))[0]
+    _set(sd, "running_mean", mean)
+    _set(sd, "running_var", var)
+
+
+def _install_embedding(layer, p, s, arrays):
+    _set(_param_dicts(p)[0], "weight", arrays[0])
+
+
+def _split_rnn(arrays, n_gates):
+    """keras1 stores per-gate (W, U, b)*gates; keras2/3 stores
+    (kernel, recurrent_kernel, bias)."""
+    if len(arrays) == 3:
+        W, U, b = arrays
+        Ws = np.split(W, n_gates, axis=1)
+        Us = np.split(U, n_gates, axis=1)
+        bs = np.split(b, n_gates, axis=-1)
+        if b.ndim == 2:              # keras3 GRU bias (2, 3h)
+            bs = [x for x in np.split(b[0], n_gates)]
+        return Ws, Us, bs
+    Ws = arrays[0::3]
+    Us = arrays[1::3]
+    bs = arrays[2::3]
+    return list(Ws), list(Us), list(bs)
+
+
+def _install_lstm(layer, p, s, arrays):
+    Ws, Us, bs = _split_rnn(arrays, 4)
+    if len(arrays) == 3:
+        order = [0, 1, 2, 3]         # keras2/3: i, f, c, o
+    else:
+        order = [0, 2, 1, 3]         # keras1 file: i, c, f, o -> i,f,c,o
+    # ours: gate order i, f, g(c), o with (4h, in) weights
+    idx = {"ifco": order}
+    W = np.concatenate([Ws[i] for i in ([0, 1, 2, 3] if len(arrays) == 3
+                                        else [0, 2, 1, 3])], axis=1)
+    U = np.concatenate([Us[i] for i in ([0, 1, 2, 3] if len(arrays) == 3
+                                        else [0, 2, 1, 3])], axis=1)
+    b = np.concatenate([bs[i] for i in ([0, 1, 2, 3] if len(arrays) == 3
+                                        else [0, 2, 1, 3])], axis=-1)
+    d = _param_dicts(p, keys=("weight_ih",))[0]
+    _set(d, "weight_ih", W.T)
+    _set(d, "weight_hh", U.T)
+    _set(d, "bias_ih", b.reshape(-1))
+    _set(d, "bias_hh", np.zeros_like(b.reshape(-1)))
+
+
+def _install_gru(layer, p, s, arrays):
+    Ws, Us, bs = _split_rnn(arrays, 3)
+    # keras order z, r, h;  ours r, z, n
+    perm = [1, 0, 2]
+    W = np.concatenate([Ws[i] for i in perm], axis=1)
+    U = np.concatenate([Us[i] for i in perm], axis=1)
+    b = np.concatenate([np.asarray(bs[i]).reshape(-1) for i in perm])
+    d = _param_dicts(p, keys=("weight_ih",))[0]
+    _set(d, "weight_ih", W.T)
+    _set(d, "weight_hh", U.T)
+    _set(d, "bias_ih", b)
+    _set(d, "bias_hh", np.zeros_like(b))
+
+
+def _install_simple_rnn(layer, p, s, arrays):
+    W, U, b = arrays
+    d = _param_dicts(p, keys=("weight_ih",))[0]
+    _set(d, "weight_ih", W.T)
+    _set(d, "weight_hh", U.T)
+    _set(d, "bias_ih", np.asarray(b).reshape(-1))
+    _set(d, "bias_hh", np.zeros_like(np.asarray(b).reshape(-1)))
+
+
+_INSTALLERS = {
+    "Dense": _install_dense,
+    "Convolution2D": _install_conv2d,
+    "Deconvolution2D": _install_conv2d,
+    "Convolution1D": _install_conv1d,
+    "BatchNormalization": _install_bn,
+    "Embedding": _install_embedding,
+    "LSTM": _install_lstm,
+    "GRU": _install_gru,
+    "SimpleRNN": _install_simple_rnn,
+}
+
+
+def set_layer_weights(model, weights_by_layer):
+    """Install keras weight arrays into a BUILT Sequential model.
+
+    weights_by_layer: list aligned with model.modules of (arrays or None).
+    """
+    if not model.is_built():
+        model.build_model()
+    p = _as_mutable(model._params)
+    st = _as_mutable(model._state)
+    for i, (layer, arrays) in enumerate(zip(model.modules,
+                                            weights_by_layer)):
+        if not arrays:
+            continue
+        cls = getattr(layer, "_keras_class", type(layer).__name__)
+        installer = _INSTALLERS.get(cls)
+        if installer is None:
+            raise NotImplementedError(
+                f"no weight installer for keras layer {cls}")
+        installer(layer, p[str(i)], st[str(i)],
+                  [np.asarray(a) for a in arrays])
+    model._params = p
+    model._state = st
+    return model
+
+
+def load_weights_hdf5(model, path, by_name=False):
+    """Legacy Keras HDF5 weight file (save_weights 1.x/2.x layout:
+    attrs['layer_names'] + per-group attrs['weight_names'])."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        g = f["model_weights"] if "model_weights" in f else f
+        layer_names = [n.decode() if isinstance(n, bytes) else n
+                       for n in g.attrs["layer_names"]]
+        by_layer_name = {}
+        for ln in layer_names:
+            grp = g[ln]
+            wnames = [n.decode() if isinstance(n, bytes) else n
+                      for n in grp.attrs.get("weight_names", [])]
+            by_layer_name[ln] = [np.asarray(grp[w]) for w in wnames]
+    weights = []
+    for layer in model.modules:
+        arrays = by_layer_name.get(layer.name)
+        if arrays is None and not by_name:
+            # positional fallback: consume file layers in order
+            for ln in layer_names:
+                if by_layer_name.get(ln):
+                    arrays = by_layer_name.pop(ln)
+                    break
+        weights.append(arrays)
+    return set_layer_weights(model, weights)
+
+
+def load_keras(json_path=None, hdf5_path=None, json_str=None):
+    """Reference API: bigdl.keras.converter.load_keras(json, hdf5)."""
+    if json_str is None:
+        with open(json_path) as f:
+            json_str = f.read()
+    model = model_from_json(json_str)
+    model.build_model()
+    if hdf5_path:
+        load_weights_hdf5(model, hdf5_path)
+    return model

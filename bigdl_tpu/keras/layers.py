@@ -1,0 +1,1037 @@
+"""Keras-1.2.2 layer set (reference: nn/keras/*.scala, 71 files).
+
+Every layer is a ``Module`` whose ``setup`` builds the underlying
+bigdl_tpu.nn "labor" from the inferred input spec -- the TPU-native
+equivalent of the reference's ``KerasLayer.doBuild(inputShape)`` pattern
+(nn/keras/KerasLayer.scala:165,233).  ``input_shape`` (sans batch) is only
+needed on the first layer of a Sequential, exactly as in Keras.
+
+dim_ordering: "th" (channels-first, the reference default) or "tf"
+(channels-last).  Internally everything computes NHWC -- the natural TPU
+layout -- with boundary transposes for "th" that XLA cancels between
+consecutive layers.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Module, child_rng
+from bigdl_tpu.utils.shape import spec_of
+
+# ------------------------------------------------------------------ #
+# helpers
+# ------------------------------------------------------------------ #
+
+_ACTIVATIONS = {
+    "tanh": nn.Tanh, "relu": nn.ReLU, "sigmoid": nn.Sigmoid,
+    "softmax": nn.SoftMax, "softplus": nn.SoftPlus,
+    "softsign": nn.SoftSign, "hard_sigmoid": nn.HardSigmoid,
+    "linear": nn.Identity, "elu": nn.ELU, "gelu": nn.GELU,
+    "silu": nn.SiLU, "log_softmax": nn.LogSoftMax,
+}
+
+
+def get_activation(name):
+    if name is None or isinstance(name, Module):
+        return name
+    try:
+        return _ACTIVATIONS[name]()
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}") from None
+
+
+_INITS = {
+    "glorot_uniform": "Xavier", "glorot_normal": "Xavier",
+    "uniform": "RandomUniform", "normal": "RandomNormal",
+    "he_normal": "MsraFiller", "he_uniform": "MsraFiller",
+    "zero": "Zeros", "one": "Ones",
+}
+
+
+def _to_tuple(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+class KerasLayer(Module):
+    """Base: holds ``input_shape`` and an inferred labor module
+    (reference: nn/keras/KerasLayer.scala:165)."""
+
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(name)
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self._labor = None
+        self.activation = None
+
+    # override ONE of (_build_labor, _call)
+    def _build_labor(self, input_spec):
+        return None
+
+    def _call(self, params, state, x, training, rng):
+        raise NotImplementedError(type(self).__name__)
+
+    def setup(self, rng, input_spec):
+        self._labor = self._build_labor(input_spec)
+        if self._labor is None:
+            return (), ()
+        return self._labor.setup(rng, input_spec)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self._labor is not None:
+            y, state = self._labor.apply(params, state, input,
+                                         training=training, rng=rng)
+        else:
+            y, state = self._call(params, state, input, training, rng)
+        if self.activation is not None:
+            y, _ = self.activation.apply((), (), y, training=training)
+        return y, state
+
+    def children(self):
+        return [self._labor] if self._labor is not None else []
+
+
+class _Spatial(KerasLayer):
+    """Shared th/tf plumbing for layers over 3-D..5-D feature maps."""
+
+    def __init__(self, dim_ordering="th", input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        if dim_ordering not in ("th", "tf"):
+            raise ValueError(f"dim_ordering must be th/tf: {dim_ordering}")
+        self.dim_ordering = dim_ordering
+
+    def _nlast(self, x):
+        """channels-first -> channels-last"""
+        if self.dim_ordering == "tf":
+            return x
+        nd = x.ndim if hasattr(x, "ndim") else len(x.shape)
+        perm = (0,) + tuple(range(2, nd)) + (1,)
+        return jnp.transpose(x, perm)
+
+    def _nfirst(self, x):
+        if self.dim_ordering == "tf":
+            return x
+        nd = x.ndim if hasattr(x, "ndim") else len(x.shape)
+        perm = (0, nd - 1) + tuple(range(1, nd - 1))
+        return jnp.transpose(x, perm)
+
+    def _spec_nlast(self, spec):
+        if self.dim_ordering == "tf":
+            return spec
+        nd = len(spec.shape)
+        perm = (0,) + tuple(range(2, nd)) + (1,)
+        return jax.ShapeDtypeStruct(
+            tuple(spec.shape[p] for p in perm), spec.dtype)
+
+    def setup(self, rng, input_spec):
+        self._labor = self._build_labor(self._spec_nlast(input_spec))
+        if self._labor is None:
+            return (), ()
+        return self._labor.setup(rng, self._spec_nlast(input_spec))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = self._nlast(input)
+        if self._labor is not None:
+            y, state = self._labor.apply(params, state, x,
+                                         training=training, rng=rng)
+        else:
+            y, state = self._call(params, state, x, training, rng)
+        y = self._nfirst(y)
+        if self.activation is not None:
+            y, _ = self.activation.apply((), (), y, training=training)
+        return y, state
+
+
+# ------------------------------------------------------------------ #
+# core
+# ------------------------------------------------------------------ #
+
+
+class InputLayer(KerasLayer):
+    """Placeholder (reference: nn/keras/Input.scala)."""
+
+    def _call(self, params, state, x, training, rng):
+        return x, state
+
+
+class Dense(KerasLayer):
+    """reference: nn/keras/Dense.scala:49 -- nD input works on the last
+    dim (labor = InferReshape+Linear+InferReshape for ndim > 2)."""
+
+    def __init__(self, output_dim, init="glorot_uniform", activation=None,
+                 bias=True, input_shape=None, name=None, **_):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.bias = bias
+        self.init = init
+        self.activation = get_activation(activation)
+
+    def _build_labor(self, spec):
+        in_dim = spec.shape[-1]
+        lin = nn.Linear(in_dim, self.output_dim, with_bias=self.bias)
+        if len(spec.shape) > 2:
+            return (nn.Sequential()
+                    .add(nn.InferReshape((-1, in_dim)))
+                    .add(lin)
+                    .add(nn.InferReshape((-1,) + tuple(spec.shape[1:-1])
+                                         + (self.output_dim,))))
+        return lin
+
+
+class Activation(KerasLayer):
+    """reference: nn/keras/Activation.scala"""
+
+    def __init__(self, activation, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.activation = get_activation(activation)
+
+    def _call(self, params, state, x, training, rng):
+        return x, state
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _build_labor(self, spec):
+        return nn.Dropout(self.p)
+
+
+class Flatten(KerasLayer):
+    def _build_labor(self, spec):
+        return nn.Flatten()
+
+
+class Reshape(KerasLayer):
+    """reference: nn/keras/Reshape.scala (supports one -1)."""
+
+    def __init__(self, target_shape, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.target_shape = tuple(target_shape)
+
+    def _build_labor(self, spec):
+        return nn.InferReshape((-1,) + self.target_shape) \
+            if -1 in self.target_shape else nn.Reshape(self.target_shape)
+
+
+class Permute(KerasLayer):
+    """dims are 1-based over non-batch axes (keras convention)."""
+
+    def __init__(self, dims, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.dims = tuple(dims)
+
+    def _call(self, params, state, x, training, rng):
+        return jnp.transpose(x, (0,) + self.dims), state
+
+
+class RepeatVector(KerasLayer):
+    """(N, F) -> (N, n, F) (reference: nn/keras/RepeatVector.scala)."""
+
+    def __init__(self, n, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.n = n
+
+    def _call(self, params, state, x, training, rng):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value=0.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.mask_value = mask_value
+
+    def _build_labor(self, spec):
+        return nn.Masking(self.mask_value)
+
+
+class Highway(KerasLayer):
+    def __init__(self, activation="tanh", bias=True, input_shape=None,
+                 name=None):
+        super().__init__(input_shape, name)
+        self._act_name = activation
+        self.bias = bias
+
+    def _build_labor(self, spec):
+        return nn.Highway(spec.shape[-1], with_bias=self.bias,
+                          activation=None)
+
+
+class MaxoutDense(KerasLayer):
+    def __init__(self, output_dim, nb_feature=4, bias=True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+
+    def _build_labor(self, spec):
+        return nn.Maxout(spec.shape[-1], self.output_dim, self.nb_feature)
+
+
+class Embedding(KerasLayer):
+    """(N, T) int -> (N, T, output_dim) (reference: nn/keras/Embedding.scala)."""
+
+    def __init__(self, input_dim, output_dim, init="uniform",
+                 input_shape=None, name=None, **_):
+        super().__init__(input_shape, name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def _build_labor(self, spec):
+        return nn.LookupTable(self.input_dim, self.output_dim)
+
+
+class BatchNormalization(_Spatial):
+    """reference: nn/keras/BatchNormalization.scala -- 2-D or 4-D input,
+    normalises the channel axis."""
+
+    def __init__(self, epsilon=1e-3, momentum=0.99, beta_init="zero",
+                 gamma_init="one", dim_ordering="th", input_shape=None,
+                 name=None, **_):
+        super().__init__(dim_ordering, input_shape, name)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def _build_labor(self, spec):
+        n_out = spec.shape[-1]
+        if len(spec.shape) == 2:
+            return nn.BatchNormalization(n_out, eps=self.epsilon,
+                                         momentum=1.0 - self.momentum)
+        return nn.SpatialBatchNormalization(n_out, eps=self.epsilon,
+                                            momentum=1.0 - self.momentum)
+
+
+# ------------------------------------------------------------------ #
+# convolution
+# ------------------------------------------------------------------ #
+
+
+class Convolution2D(_Spatial):
+    """reference: nn/keras/Convolution2D.scala"""
+
+    def __init__(self, nb_filter, nb_row, nb_col, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample=(1, 1),
+                 dim_ordering="th", bias=True, input_shape=None, name=None,
+                 **_):
+        super().__init__(dim_ordering, input_shape, name)
+        self.nb_filter = nb_filter
+        self.kernel = (nb_row, nb_col)
+        self.subsample = _to_tuple(subsample)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode {border_mode}")
+        self.border_mode = border_mode
+        self.bias = bias
+        self.activation = get_activation(activation)
+
+    def _build_labor(self, spec):
+        kh, kw = self.kernel
+        sh, sw = self.subsample
+        if self.border_mode == "same":
+            ph, pw = -1, -1     # nn.SpatialConvolution SAME convention
+        else:
+            ph, pw = 0, 0
+        return nn.SpatialConvolution(
+            spec.shape[-1], self.nb_filter, kw, kh, sw, sh, pw, ph,
+            with_bias=self.bias)
+
+
+class AtrousConvolution2D(_Spatial):
+    """reference: nn/keras/AtrousConvolution2D.scala"""
+
+    def __init__(self, nb_filter, nb_row, nb_col, init="glorot_uniform",
+                 activation=None, subsample=(1, 1), atrous_rate=(1, 1),
+                 dim_ordering="th", bias=True, input_shape=None, name=None,
+                 **_):
+        super().__init__(dim_ordering, input_shape, name)
+        self.nb_filter = nb_filter
+        self.kernel = (nb_row, nb_col)
+        self.subsample = _to_tuple(subsample)
+        self.atrous_rate = _to_tuple(atrous_rate)
+        self.bias = bias
+        self.activation = get_activation(activation)
+
+    def _build_labor(self, spec):
+        kh, kw = self.kernel
+        sh, sw = self.subsample
+        dh, dw = self.atrous_rate
+        return nn.SpatialDilatedConvolution(
+            spec.shape[-1], self.nb_filter, kw, kh, sw, sh, 0, 0, dw, dh,
+            with_bias=self.bias)
+
+
+class Convolution1D(KerasLayer):
+    """(N, T, C) -> (N, T', nb_filter) (reference: nn/keras/Convolution1D.scala)."""
+
+    def __init__(self, nb_filter, filter_length, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample_length=1,
+                 bias=True, input_shape=None, name=None, **_):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.border_mode = border_mode
+        self.subsample_length = subsample_length
+        self.bias = bias
+        self.activation = get_activation(activation)
+
+    def _build_labor(self, spec):
+        return nn.Conv1D(spec.shape[-1], self.nb_filter, self.filter_length,
+                         stride=self.subsample_length,
+                         padding=("SAME" if self.border_mode == "same"
+                                  else "VALID"),
+                         with_bias=self.bias)
+
+
+class AtrousConvolution1D(KerasLayer):
+    def __init__(self, nb_filter, filter_length, init="glorot_uniform",
+                 activation=None, subsample_length=1, atrous_rate=1,
+                 bias=True, input_shape=None, name=None, **_):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.subsample_length = subsample_length
+        self.atrous_rate = atrous_rate
+        self.bias = bias
+        self.activation = get_activation(activation)
+
+    def _call(self, params, state, x, training, rng):
+        y = lax.conv_general_dilated(
+            x, params["weight"].astype(x.dtype), (self.subsample_length,),
+            "VALID", rhs_dilation=(self.atrous_rate,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+    def setup(self, rng, input_spec):
+        from bigdl_tpu.nn.initialization import Xavier, Zeros
+        cin = input_spec.shape[-1]
+        k = self.filter_length
+        w = Xavier().init(child_rng(rng, 0), (k, cin, self.nb_filter),
+                          cin * k, self.nb_filter * k)
+        p = {"weight": w}
+        if self.bias:
+            p["bias"] = Zeros().init(child_rng(rng, 1), (self.nb_filter,),
+                                     cin, self.nb_filter)
+        return p, ()
+
+
+class Convolution3D(_Spatial):
+    """reference: nn/keras/Convolution3D.scala (th: N,C,D,H,W)."""
+
+    def __init__(self, nb_filter, kernel_dim1, kernel_dim2, kernel_dim3,
+                 init="glorot_uniform", activation=None,
+                 border_mode="valid", subsample=(1, 1, 1),
+                 dim_ordering="th", bias=True, input_shape=None, name=None,
+                 **_):
+        super().__init__(dim_ordering, input_shape, name)
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.subsample = _to_tuple(subsample, 3)
+        self.border_mode = border_mode
+        self.bias = bias
+        self.activation = get_activation(activation)
+
+    def _build_labor(self, spec):
+        kt, kh, kw = self.kernel
+        st, sh, sw = self.subsample
+        return nn.VolumetricConvolution(
+            spec.shape[-1], self.nb_filter, kt, kw, kh, st, sw, sh,
+            with_bias=self.bias)
+
+
+class Deconvolution2D(_Spatial):
+    """reference: nn/keras/Deconvolution2D.scala"""
+
+    def __init__(self, nb_filter, nb_row, nb_col, output_shape=None,
+                 init="glorot_uniform", activation=None, subsample=(1, 1),
+                 dim_ordering="th", bias=True, input_shape=None, name=None,
+                 **_):
+        super().__init__(dim_ordering, input_shape, name)
+        self.nb_filter = nb_filter
+        self.kernel = (nb_row, nb_col)
+        self.subsample = _to_tuple(subsample)
+        self.bias = bias
+        self.activation = get_activation(activation)
+
+    def _build_labor(self, spec):
+        kh, kw = self.kernel
+        sh, sw = self.subsample
+        return nn.SpatialFullConvolution(
+            spec.shape[-1], self.nb_filter, kw, kh, sw, sh,
+            with_bias=self.bias)
+
+
+class SeparableConvolution2D(_Spatial):
+    """reference: nn/keras/SeparableConvolution2D.scala"""
+
+    def __init__(self, nb_filter, nb_row, nb_col, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample=(1, 1),
+                 depth_multiplier=1, dim_ordering="th", bias=True,
+                 input_shape=None, name=None, **_):
+        super().__init__(dim_ordering, input_shape, name)
+        self.nb_filter = nb_filter
+        self.kernel = (nb_row, nb_col)
+        self.subsample = _to_tuple(subsample)
+        self.depth_multiplier = depth_multiplier
+        self.border_mode = border_mode
+        self.bias = bias
+        self.activation = get_activation(activation)
+
+    def _build_labor(self, spec):
+        kh, kw = self.kernel
+        sh, sw = self.subsample
+        pad = -1 if self.border_mode == "same" else 0
+        return nn.SpatialSeparableConvolution(
+            spec.shape[-1], self.nb_filter, self.depth_multiplier,
+            kw, kh, sw, sh, pad, pad, with_bias=self.bias)
+
+
+class LocallyConnected1D(KerasLayer):
+    def __init__(self, nb_filter, filter_length, activation=None,
+                 subsample_length=1, bias=True, input_shape=None,
+                 name=None, **_):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.subsample_length = subsample_length
+        self.bias = bias
+        self.activation = get_activation(activation)
+
+    def _build_labor(self, spec):
+        return nn.LocallyConnected1D(
+            spec.shape[1], spec.shape[2], self.nb_filter,
+            self.filter_length, self.subsample_length,
+            with_bias=self.bias)
+
+
+class LocallyConnected2D(_Spatial):
+    def __init__(self, nb_filter, nb_row, nb_col, activation=None,
+                 border_mode="valid", subsample=(1, 1), dim_ordering="th",
+                 bias=True, input_shape=None, name=None, **_):
+        super().__init__(dim_ordering, input_shape, name)
+        self.nb_filter = nb_filter
+        self.kernel = (nb_row, nb_col)
+        self.subsample = _to_tuple(subsample)
+        self.bias = bias
+        self.activation = get_activation(activation)
+
+    def _build_labor(self, spec):
+        kh, kw = self.kernel
+        sh, sw = self.subsample
+        return nn.LocallyConnected2D(
+            spec.shape[3], spec.shape[2], spec.shape[1], self.nb_filter,
+            kw, kh, sw, sh, with_bias=self.bias)
+
+
+# ------------------------------------------------------------------ #
+# pooling
+# ------------------------------------------------------------------ #
+
+
+class _Pool2D(_Spatial):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 dim_ordering="th", input_shape=None, name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        self.pool_size = _to_tuple(pool_size)
+        self.strides = _to_tuple(strides) if strides else self.pool_size
+        self.border_mode = border_mode
+
+
+class MaxPooling2D(_Pool2D):
+    def _build_labor(self, spec):
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        pad = -1 if self.border_mode == "same" else 0
+        return nn.SpatialMaxPooling(pw, ph, sw, sh, pad, pad)
+
+
+class AveragePooling2D(_Pool2D):
+    def _build_labor(self, spec):
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        pad = -1 if self.border_mode == "same" else 0
+        return nn.SpatialAveragePooling(pw, ph, sw, sh, pad, pad)
+
+
+class _Pool1D(KerasLayer):
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+        self.border_mode = border_mode
+
+    def _reduce(self, x, init, op):
+        pads = ((0, 0), (0, 0), (0, 0))
+        if self.border_mode == "same":
+            t = x.shape[1]
+            out = -(-t // self.stride)
+            need = max((out - 1) * self.stride + self.pool_length - t, 0)
+            pads = ((0, 0), (need // 2, need - need // 2), (0, 0))
+        return lax.reduce_window(x, init, op, (1, self.pool_length, 1),
+                                 (1, self.stride, 1), pads)
+
+
+class MaxPooling1D(_Pool1D):
+    def _call(self, params, state, x, training, rng):
+        return self._reduce(x, -jnp.inf, lax.max), state
+
+
+class AveragePooling1D(_Pool1D):
+    def _call(self, params, state, x, training, rng):
+        s = self._reduce(x, 0.0, lax.add)
+        n = self._reduce(jnp.ones_like(x), 0.0, lax.add)
+        return s / n, state
+
+
+class _Pool3D(_Spatial):
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 border_mode="valid", dim_ordering="th", input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        self.pool_size = _to_tuple(pool_size, 3)
+        self.strides = _to_tuple(strides, 3) if strides else self.pool_size
+
+
+class MaxPooling3D(_Pool3D):
+    def _build_labor(self, spec):
+        pt, ph, pw = self.pool_size
+        st, sh, sw = self.strides
+        return nn.VolumetricMaxPooling(pt, pw, ph, st, sw, sh)
+
+
+class AveragePooling3D(_Pool3D):
+    def _build_labor(self, spec):
+        pt, ph, pw = self.pool_size
+        st, sh, sw = self.strides
+        return nn.VolumetricAveragePooling(pt, pw, ph, st, sw, sh)
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def _call(self, params, state, x, training, rng):
+        return jnp.max(x, axis=1), state
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def _call(self, params, state, x, training, rng):
+        return jnp.mean(x, axis=1), state
+
+
+class GlobalMaxPooling2D(_Spatial):
+    def _call(self, params, state, x, training, rng):
+        return self._nfirst_identity(jnp.max(x, axis=(1, 2))), state
+
+    @staticmethod
+    def _nfirst_identity(x):
+        return x
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = self._nlast(input)
+        return jnp.max(x, axis=(1, 2)), state
+
+
+class GlobalAveragePooling2D(_Spatial):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = self._nlast(input)
+        return jnp.mean(x, axis=(1, 2)), state
+
+
+class GlobalMaxPooling3D(_Spatial):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = self._nlast(input)
+        return jnp.max(x, axis=(1, 2, 3)), state
+
+
+class GlobalAveragePooling3D(_Spatial):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = self._nlast(input)
+        return jnp.mean(x, axis=(1, 2, 3)), state
+
+
+# ------------------------------------------------------------------ #
+# padding / cropping / upsampling
+# ------------------------------------------------------------------ #
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding=1, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.padding = _to_tuple(padding, 2) if isinstance(
+            padding, (tuple, list)) else (padding, padding)
+
+    def _call(self, params, state, x, training, rng):
+        lo, hi = self.padding
+        return jnp.pad(x, ((0, 0), (lo, hi), (0, 0))), state
+
+
+class ZeroPadding2D(_Spatial):
+    def __init__(self, padding=(1, 1), dim_ordering="th", input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        p = tuple(padding)
+        self.pads = (p[0], p[0], p[1], p[1]) if len(p) == 2 else p
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t, b, l, r = self.pads
+        x = self._nlast(input)
+        y = jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+        return self._nfirst(y), state
+
+
+class ZeroPadding3D(_Spatial):
+    def __init__(self, padding=(1, 1, 1), dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        self.padding = _to_tuple(padding, 3)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        pt, ph, pw = self.padding
+        x = self._nlast(input)
+        y = jnp.pad(x, ((0, 0), (pt, pt), (ph, ph), (pw, pw), (0, 0)))
+        return self._nfirst(y), state
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping=(1, 1), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.cropping = tuple(cropping)
+
+    def _call(self, params, state, x, training, rng):
+        lo, hi = self.cropping
+        return x[:, lo:x.shape[1] - hi], state
+
+
+class Cropping2D(_Spatial):
+    def __init__(self, cropping=((0, 0), (0, 0)), dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        (t, b), (l, r) = self.cropping
+        x = self._nlast(input)
+        y = x[:, t:x.shape[1] - b, l:x.shape[2] - r]
+        return self._nfirst(y), state
+
+
+class Cropping3D(_Spatial):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        (a1, a2), (b1, b2), (c1, c2) = self.cropping
+        x = self._nlast(input)
+        y = x[:, a1:x.shape[1] - a2, b1:x.shape[2] - b2,
+              c1:x.shape[3] - c2]
+        return self._nfirst(y), state
+
+
+class UpSampling1D(KerasLayer):
+    def __init__(self, length=2, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.length = length
+
+    def _call(self, params, state, x, training, rng):
+        return jnp.repeat(x, self.length, axis=1), state
+
+
+class UpSampling2D(_Spatial):
+    def __init__(self, size=(2, 2), dim_ordering="th", input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        self.size = _to_tuple(size)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = self._nlast(input)
+        y = jnp.repeat(jnp.repeat(x, self.size[0], 1), self.size[1], 2)
+        return self._nfirst(y), state
+
+
+class UpSampling3D(_Spatial):
+    def __init__(self, size=(2, 2, 2), dim_ordering="th", input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        self.size = _to_tuple(size, 3)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = self._nlast(input)
+        y = x
+        for ax, s in enumerate(self.size):
+            y = jnp.repeat(y, s, axis=ax + 1)
+        return self._nfirst(y), state
+
+
+# ------------------------------------------------------------------ #
+# recurrent
+# ------------------------------------------------------------------ #
+
+
+class _KerasRNN(KerasLayer):
+    def __init__(self, output_dim, activation="tanh", return_sequences=False,
+                 go_backwards=False, input_shape=None, name=None, **_):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self._act_name = activation
+
+    def _make_cell(self, input_size):
+        raise NotImplementedError
+
+    def _build_labor(self, spec):
+        return nn.Recurrent(self._make_cell(spec.shape[-1]),
+                            reverse=self.go_backwards)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y, state = self._labor.apply(params, state, input,
+                                     training=training, rng=rng)
+        if not self.return_sequences:
+            y = y[:, -1]
+        return y, state
+
+
+class SimpleRNN(_KerasRNN):
+    def _make_cell(self, input_size):
+        act = {"tanh": jnp.tanh, "relu": jax.nn.relu,
+               "sigmoid": jax.nn.sigmoid}[self._act_name]
+        return nn.RnnCell(input_size, self.output_dim, activation=act)
+
+
+class LSTM(_KerasRNN):
+    def _make_cell(self, input_size):
+        return nn.LSTM(input_size, self.output_dim)
+
+
+class GRU(_KerasRNN):
+    def _make_cell(self, input_size):
+        return nn.GRU(input_size, self.output_dim)
+
+
+class ConvLSTM2D(_Spatial):
+    """reference: nn/keras/ConvLSTM2D.scala (th input N,T,C,H,W)."""
+
+    def __init__(self, nb_filter, nb_kernel, activation="tanh",
+                 dim_ordering="th", border_mode="valid", subsample=(1, 1),
+                 return_sequences=False, go_backwards=False,
+                 input_shape=None, name=None, **_):
+        super().__init__(dim_ordering, input_shape, name)
+        self.nb_filter = nb_filter
+        self.nb_kernel = nb_kernel
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def _spec_nlast(self, spec):
+        if self.dim_ordering == "tf":
+            return spec
+        n, t, c, h, w = spec.shape
+        return jax.ShapeDtypeStruct((n, t, h, w, c), spec.dtype)
+
+    def _nlast(self, x):
+        if self.dim_ordering == "tf":
+            return x
+        return jnp.transpose(x, (0, 1, 3, 4, 2))
+
+    def _nfirst(self, x):
+        if self.dim_ordering == "tf":
+            return x
+        if x.ndim == 5:
+            return jnp.transpose(x, (0, 1, 4, 2, 3))
+        return jnp.transpose(x, (0, 3, 1, 2))
+
+    def _build_labor(self, spec):
+        cell = nn.ConvLSTMPeephole(
+            spec.shape[-1], self.nb_filter, self.nb_kernel, self.nb_kernel,
+            with_peephole=False)
+        return nn.Recurrent(cell, reverse=self.go_backwards)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = self._nlast(input)
+        y, state = self._labor.apply(params, state, x,
+                                     training=training, rng=rng)
+        if not self.return_sequences:
+            y = y[:, -1]
+        return self._nfirst(y), state
+
+
+class Bidirectional(KerasLayer):
+    """Wrapper over a _KerasRNN (reference: nn/keras/Bidirectional.scala)."""
+
+    def __init__(self, layer, merge_mode="concat", input_shape=None,
+                 name=None):
+        super().__init__(input_shape or layer.input_shape, name)
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def _build_labor(self, spec):
+        fwd = self.layer._make_cell(spec.shape[-1])
+        bwd = self.layer._make_cell(spec.shape[-1])
+        return nn.BiRecurrent(fwd, bwd, merge=self.merge_mode)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y, state = self._labor.apply(params, state, input,
+                                     training=training, rng=rng)
+        if not self.layer.return_sequences:
+            y = y[:, -1]
+        return y, state
+
+
+class TimeDistributed(KerasLayer):
+    """Apply a layer to every timestep (reference: nn/keras/TimeDistributed.scala)."""
+
+    def __init__(self, layer, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.layer = layer
+
+    def _build_labor(self, spec):
+        return nn.TimeDistributed(self.layer)
+
+
+# ------------------------------------------------------------------ #
+# advanced activations / noise
+# ------------------------------------------------------------------ #
+
+
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha=0.3, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def _build_labor(self, spec):
+        return nn.LeakyReLU(self.alpha)
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha=1.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def _build_labor(self, spec):
+        return nn.ELU(self.alpha)
+
+
+class PReLU(KerasLayer):
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+
+    def _build_labor(self, spec):
+        return nn.PReLU()
+
+
+class SReLU(KerasLayer):
+    def __init__(self, input_shape=None, name=None, **_):
+        super().__init__(input_shape, name)
+
+    def _build_labor(self, spec):
+        return nn.SReLU()
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta=1.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.theta = theta
+
+    def _build_labor(self, spec):
+        return nn.Threshold(self.theta, 0.0)
+
+
+class SoftMax(KerasLayer):
+    def _build_labor(self, spec):
+        return nn.SoftMax()
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _build_labor(self, spec):
+        return nn.GaussianDropout(self.p)
+
+
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.sigma = sigma
+
+    def _build_labor(self, spec):
+        return nn.GaussianNoise(self.sigma)
+
+
+class SpatialDropout1D(KerasLayer):
+    def __init__(self, p=0.5, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def _build_labor(self, spec):
+        return nn.SpatialDropout1D(self.p)
+
+
+class SpatialDropout2D(_Spatial):
+    def __init__(self, p=0.5, dim_ordering="th", input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        self.p = p
+
+    def _build_labor(self, spec):
+        return nn.SpatialDropout2D(self.p)
+
+
+class SpatialDropout3D(_Spatial):
+    def __init__(self, p=0.5, dim_ordering="th", input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering, input_shape, name)
+        self.p = p
+
+    def _build_labor(self, spec):
+        return nn.SpatialDropout3D(self.p)
+
+
+# ------------------------------------------------------------------ #
+# merge
+# ------------------------------------------------------------------ #
+
+
+class Merge(KerasLayer):
+    """Merge a table of inputs (reference: nn/keras/Merge.scala).
+    mode: sum/mul/max/ave/concat/dot/cos."""
+
+    def __init__(self, layers=None, mode="sum", concat_axis=-1,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.mode = mode
+        self.concat_axis = concat_axis
+        self.layers = layers or []
+
+    def _call(self, params, state, xs, training, rng):
+        m = self.mode
+        if m == "sum":
+            y = sum(xs[1:], xs[0])
+        elif m == "mul":
+            y = xs[0]
+            for x in xs[1:]:
+                y = y * x
+        elif m == "max":
+            y = xs[0]
+            for x in xs[1:]:
+                y = jnp.maximum(y, x)
+        elif m == "ave":
+            y = sum(xs[1:], xs[0]) / len(xs)
+        elif m == "concat":
+            y = jnp.concatenate(xs, axis=self.concat_axis)
+        elif m == "dot":
+            y = jnp.sum(xs[0] * xs[1], axis=-1, keepdims=True)
+        elif m == "cos":
+            a, b = xs[0], xs[1]
+            na = jnp.linalg.norm(a, axis=-1, keepdims=True)
+            nb = jnp.linalg.norm(b, axis=-1, keepdims=True)
+            y = jnp.sum(a * b, -1, keepdims=True) / (na * nb + 1e-8)
+        else:
+            raise ValueError(f"unknown merge mode {m}")
+        return y, state

@@ -1,0 +1,103 @@
+"""Keras topology: Sequential / Model / Input with shape inference.
+
+Reference: nn/keras/Topology.scala (Sequential :262, Model :165) and
+nn/keras/Input.scala.  compile/fit/evaluate/predict come from the existing
+training mixin (nn/keras.py); this module adds the Keras-side shape
+bookkeeping: ``input_shape`` on the first layer, ``get_output_shape()``,
+and eager build so weight shapes exist as soon as the model is assembled
+(matching the reference, which builds each KerasLayer at add() time).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.graph import Node
+from bigdl_tpu.nn.keras import _KerasMixin
+from bigdl_tpu.keras.layers import KerasLayer
+
+
+def Input(shape=None, name=None, dtype=jnp.float32):
+    """Graph input node carrying its (batch-less) shape
+    (reference: nn/keras/Input.scala)."""
+    node = Node(None, [])
+    node.keras_shape = tuple(shape) if shape is not None else None
+    node.keras_dtype = dtype
+    return node
+
+
+class Sequential(_KerasMixin, nn.Sequential):
+    """Keras Sequential with shape inference at add() time
+    (reference: Topology.scala:262)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._shapes = []      # output spec after each layer (with batch=1)
+
+    def add(self, layer):
+        if not self.modules:
+            in_shape = getattr(layer, "input_shape", None)
+            if in_shape is not None:
+                self._shapes = [jax.ShapeDtypeStruct(
+                    (1,) + tuple(in_shape), jnp.float32)]
+        super().add(layer)
+        if self._shapes:
+            # infer this layer's output spec eagerly (reference builds the
+            # labor at add() time; here eval_shape costs no compute)
+            spec = self._shapes[-1]
+            p, s = layer.setup(jax.random.key(0), spec)
+            self._shapes.append(layer.output_spec(p, s, spec))
+        return self
+
+    def get_input_shape(self):
+        assert self._shapes, "first layer needs input_shape"
+        return (None,) + tuple(self._shapes[0].shape[1:])
+
+    def get_output_shape(self):
+        assert self._shapes, "first layer needs input_shape"
+        return (None,) + tuple(self._shapes[-1].shape[1:])
+
+    def build_model(self, dtype=jnp.float32):
+        """Materialise params from the recorded input_shape."""
+        assert self._shapes, "first layer needs input_shape"
+        spec = jax.ShapeDtypeStruct(self._shapes[0].shape, dtype)
+        self.build(spec)
+        return self
+
+
+class Model(_KerasMixin, nn.Graph):
+    """Keras functional Model over Input() nodes
+    (reference: Topology.scala:165)."""
+
+    def __init__(self, input, output, name=None):
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+        outputs = output if isinstance(output, (list, tuple)) else [output]
+        super().__init__(list(inputs), list(outputs), name)
+        self._input_specs = [
+            jax.ShapeDtypeStruct((1,) + tuple(n.keras_shape),
+                                 getattr(n, "keras_dtype", jnp.float32))
+            for n in inputs if getattr(n, "keras_shape", None) is not None]
+
+    def get_input_shape(self):
+        assert self._input_specs, "Input(shape=...) required"
+        if len(self._input_specs) == 1:
+            return (None,) + tuple(self._input_specs[0].shape[1:])
+        return [(None,) + tuple(s.shape[1:]) for s in self._input_specs]
+
+    def get_output_shape(self):
+        spec = self._input_specs
+        spec = spec[0] if len(spec) == 1 else tuple(spec)
+        p, s = self.setup(jax.random.key(0), spec)
+        out = self.output_spec(p, s, spec)
+        if isinstance(out, tuple):
+            return [(None,) + tuple(o.shape[1:]) for o in out]
+        return (None,) + tuple(out.shape[1:])
+
+    def build_model(self, dtype=jnp.float32):
+        assert self._input_specs, "Input(shape=...) required"
+        spec = [jax.ShapeDtypeStruct(s.shape, dtype)
+                for s in self._input_specs]
+        self.build(spec[0] if len(spec) == 1 else tuple(spec))
+        return self
