@@ -82,16 +82,33 @@ def run_bench():
     for _ in range(3):
         params, mstate, opt_state, loss = compiled(
             params, mstate, opt_state, x, t, key)
-    jax.block_until_ready(loss)
+    jax.block_until_ready((params, mstate, opt_state, loss))
 
+    # Async timing (dispatch loop, block once at the end on ALL outputs).
+    # Recorded for diagnostics only -- through the axon tunnel, blocking on
+    # a single output buffer demonstrably undercounted by ~21x in round 2
+    # (recorded 274% MFU; see VERDICT.md round 2, Weak #1).
     t0 = time.perf_counter()
     for _ in range(steps):
         params, mstate, opt_state, loss = compiled(
             params, mstate, opt_state, x, t, key)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    jax.block_until_ready((params, mstate, opt_state, loss))
+    dt_async = time.perf_counter() - t0
 
-    imgs_per_sec = batch * steps / dt
+    # Blocked timing (authoritative): block on EVERY step's full output set
+    # so no async/tunnel artifact can hide device time.  Median of per-step
+    # times is the reported number.
+    per_step = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        params, mstate, opt_state, loss = compiled(
+            params, mstate, opt_state, x, t, key)
+        jax.block_until_ready((params, mstate, opt_state, loss))
+        per_step.append(time.perf_counter() - t0)
+    per_step.sort()
+    sec_per_step = per_step[len(per_step) // 2]
+
+    imgs_per_sec = batch / sec_per_step
     # bf16 peak FLOP/s by device kind; CPU: meaningless, use 1 TF.
     kind = getattr(dev, "device_kind", "") or ""
     if platform == "cpu":
@@ -104,9 +121,9 @@ def run_bench():
         peak = 275e12
     else:  # v5e and unknown TPUs: assume v5e (197 TFLOP/s bf16)
         peak = 197e12
-    mfu = (flops_per_step * steps / dt) / peak
+    mfu = (flops_per_step / sec_per_step) / peak
 
-    print(json.dumps({
+    record = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec",
@@ -117,12 +134,23 @@ def run_bench():
             "peak_flops_assumed": peak,
             "batch": batch,
             "steps": steps,
-            "sec_per_step": round(dt / steps, 4),
+            "sec_per_step": round(sec_per_step, 4),
+            "sec_per_step_async": round(dt_async / steps, 4),
+            "sec_per_step_p10": round(per_step[len(per_step) // 10], 4),
+            "sec_per_step_p90": round(per_step[(len(per_step) * 9) // 10], 4),
             "mfu": round(mfu, 4),
             "flops_per_step": flops_per_step,
             "loss": float(loss),
         },
-    }))
+    }
+    # A physically impossible MFU means the measurement is broken, not that
+    # the chip is fast.  Refuse to emit a number >100% of peak (round-2
+    # regression guard).
+    if platform != "cpu" and not (0.0 < mfu <= 1.0):
+        record["vs_baseline"] = 0.0
+        record["extra"]["error"] = (
+            f"measurement invalid: mfu={mfu:.4f} outside (0, 1]")
+    print(json.dumps(record))
 
 
 def _spawn_child(extra_env, timeout):

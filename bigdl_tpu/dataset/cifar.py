@@ -1,0 +1,86 @@
+"""CIFAR-10 ingestion in the standard binary format.
+
+Reference: models/vgg/Train.scala + models/resnet/Train.scala load CIFAR-10
+for the cifar recipes (the Scala side reads the python-pickle batches via
+Spark; the canonical on-disk format here is the C binary version:
+one record = 1 label byte + 3072 image bytes, R plane then G then B,
+row-major 32x32 -- data_batch_{1..5}.bin / test_batch.bin).
+
+``load_cifar10`` parses that format; ``synthetic_cifar10`` writes/creates a
+deterministic separable stand-in (and can serialise it to the same binary
+format) so convergence tests exercise the real parse path without network
+access.
+"""
+
+import os
+
+import numpy as np
+
+# per-channel statistics of the real training set (reference:
+# models/vgg/Train.scala normalisation constants are equivalent BGR means)
+TRAIN_MEAN = (0.4914, 0.4822, 0.4465)
+TRAIN_STD = (0.2470, 0.2435, 0.2616)
+
+_RECORD = 1 + 3 * 32 * 32
+
+
+def _parse_batch(path):
+    raw = np.fromfile(path, np.uint8)
+    if raw.size % _RECORD:
+        raise ValueError(f"{path}: size {raw.size} not a multiple of "
+                         f"{_RECORD}-byte CIFAR records")
+    raw = raw.reshape(-1, _RECORD)
+    labels = raw[:, 0].astype(np.int32)
+    # (N, 3, 32, 32) planar -> NHWC float in [0,1]
+    images = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return images.astype(np.float32) / 255.0, labels
+
+
+def load_cifar10(folder, train=True):
+    """-> (images (N,32,32,3) float32 in [0,1], labels (N,) int32)."""
+    if train:
+        files = sorted(
+            f for f in os.listdir(folder)
+            if f.startswith("data_batch") and f.endswith(".bin"))
+    else:
+        files = [f for f in ("test_batch.bin",)
+                 if os.path.exists(os.path.join(folder, f))]
+    if not files:
+        raise FileNotFoundError(f"no CIFAR-10 .bin batches under {folder}")
+    parts = [_parse_batch(os.path.join(folder, f)) for f in files]
+    return (np.concatenate([p[0] for p in parts]),
+            np.concatenate([p[1] for p in parts]))
+
+
+def normalize(images, mean=TRAIN_MEAN, std=TRAIN_STD):
+    return ((images - np.asarray(mean, np.float32))
+            / np.asarray(std, np.float32)).astype(np.float32)
+
+
+def synthetic_cifar10(n=2048, num_classes=10, seed=11):
+    """Deterministic separable 32x32x3 blobs (same idea as synthetic_mnist):
+    each class is a colored Gaussian bump at a class-specific position."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, n).astype(np.int32)
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32)
+    images = np.empty((n, 32, 32, 3), np.float32)
+    for c in range(num_classes):
+        cy, cx = 8 + 12 * (c // 5), 4 + 6 * (c % 5)
+        bump = np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 24.0)
+        color = np.array([(c % 3 == 0), (c % 3 == 1), (c % 3 == 2)],
+                         np.float32) * 0.7 + 0.3
+        mask = labels == c
+        k = int(mask.sum())
+        images[mask] = (bump[..., None] * color
+                        + 0.25 * rng.standard_normal((k, 32, 32, 3)))
+    return np.clip(images, 0.0, 1.0).astype(np.float32), labels
+
+
+def write_binary(path, images, labels):
+    """Serialise (NHWC [0,1] float, int labels) to the CIFAR binary format
+    (inverse of _parse_batch) -- used to build test fixtures."""
+    imgs = np.clip(np.asarray(images) * 255.0, 0, 255).astype(np.uint8)
+    imgs = imgs.transpose(0, 3, 1, 2).reshape(len(imgs), -1)  # planar RGB
+    rec = np.concatenate(
+        [np.asarray(labels, np.uint8)[:, None], imgs], axis=1)
+    rec.tofile(path)

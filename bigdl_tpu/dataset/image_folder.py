@@ -1,0 +1,67 @@
+"""Directory-of-images ingestion (reference: dataset/DataSet.scala:420
+``ImageFolder``: path/label-dir/img files -> LocalImgData, labels assigned
+by sorted directory name, 1-based in the reference -- 0-based here, the
+pyspark compat layer shifts).
+
+Decode is host-side via Pillow (the TPU analogue of the reference's
+OpenCV JNI path, SURVEY.md 2.8: image decode never touches the chip).
+"""
+
+import os
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import LocalDataSet
+from bigdl_tpu.dataset.minibatch import Sample
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".gif")
+
+
+def find_images(folder):
+    """-> sorted [(path, class_index)], class order = sorted dir names
+    (reference ImageFolder.paths assigns labels by directory scan order)."""
+    classes = sorted(
+        d for d in os.listdir(folder)
+        if os.path.isdir(os.path.join(folder, d)))
+    if not classes:
+        raise FileNotFoundError(f"no class directories under {folder}")
+    out = []
+    for idx, cls in enumerate(classes):
+        cdir = os.path.join(folder, cls)
+        for name in sorted(os.listdir(cdir)):
+            if name.lower().endswith(_EXTS):
+                out.append((os.path.join(cdir, name), idx))
+    return out, classes
+
+
+def decode_image(path, size=None):
+    """-> (H, W, 3) float32 RGB in [0,1]; optional (h, w) resize."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        if size is not None:
+            im = im.resize((size[1], size[0]), Image.BILINEAR)
+        return np.asarray(im, np.float32) / 255.0
+
+
+class ImageFolderDataSet(LocalDataSet):
+    """Lazily-decoded folder dataset: elements are Samples with the decoded
+    image as feature (reference: DataSet.ImageFolder.images reads eagerly;
+    we decode per epoch on the host input thread instead -- HBM never sees
+    undecoded bytes)."""
+
+    def __init__(self, folder, size=None, shuffle_on_epoch=True, seed=0):
+        items, self.classes = find_images(folder)
+        self._size_hw = size
+        super().__init__(items, shuffle_on_epoch=shuffle_on_epoch, seed=seed)
+
+    def data(self, train=True):
+        for path, label in super().data(train):
+            yield Sample(decode_image(path, self._size_hw),
+                         np.int32(label))
+
+
+def image_folder(folder, size=None, **kw):
+    """Factory mirroring DataSet.ImageFolder (DataSet.scala:420)."""
+    return ImageFolderDataSet(folder, size=size, **kw)

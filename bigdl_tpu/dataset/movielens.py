@@ -1,0 +1,32 @@
+"""MovieLens-1M ingestion (reference: pyspark/bigdl/dataset/movielens.py --
+parses ml-1m/ratings.dat lines 'UserID::MovieID::Rating::Timestamp').
+
+The loader parses the standard ml-1m layout from a local directory; tests
+build a miniature ratings.dat in the same format.
+"""
+
+import os
+
+import numpy as np
+
+
+def read_data_sets(folder):
+    """-> (N, 3) int32 array of [user_id, movie_id, rating]
+    (same contract as the pyspark original's movielens.read_data_sets)."""
+    path = os.path.join(folder, "ratings.dat")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"{path} not found (expected ml-1m layout)")
+    rows = []
+    with open(path, "r", encoding="latin-1") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("::")
+            if len(parts) < 3:
+                continue
+            rows.append((int(parts[0]), int(parts[1]), int(parts[2])))
+    return np.asarray(rows, np.int32)
+
+
+def get_id_pairs(folder):
+    """-> (user, item) id pairs + ratings, 1-based ids preserved."""
+    data = read_data_sets(folder)
+    return data[:, :2], data[:, 2]

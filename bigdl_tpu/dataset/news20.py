@@ -1,0 +1,57 @@
+"""20 Newsgroups + GloVe ingestion (reference:
+pyspark/bigdl/dataset/news20.py -- downloads and parses the 20news-18828
+tarball layout: one directory per newsgroup, one file per post; and
+glove.6B word-vector text files).
+
+No network here: the loaders parse the standard on-disk layouts; tests
+build miniature fixtures in the same layout.
+"""
+
+import os
+
+import numpy as np
+
+CLASS_NUM = 20
+
+
+def get_news20(folder):
+    """Parse an extracted 20news tree: folder/<group>/<post-file>.
+
+    -> list of (text, label) with labels 0-based by sorted group name
+    (the pyspark original is 1-based; the bigdl compat layer shifts).
+    """
+    groups = sorted(
+        d for d in os.listdir(folder)
+        if os.path.isdir(os.path.join(folder, d)))
+    if not groups:
+        raise FileNotFoundError(f"no newsgroup directories under {folder}")
+    texts = []
+    for label, group in enumerate(groups):
+        gdir = os.path.join(folder, group)
+        for name in sorted(os.listdir(gdir)):
+            path = os.path.join(gdir, name)
+            if not os.path.isfile(path):
+                continue
+            with open(path, "rb") as f:
+                texts.append((f.read().decode("latin-1"), label))
+    return texts
+
+
+def get_glove_w2v(path, dim=None):
+    """Parse a glove.6B-style text file: 'word v1 v2 ... vN' per line.
+
+    -> dict word -> np.float32 vector.  ``dim`` (if given) validates the
+    vector width.
+    """
+    w2v = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip().split(" ")
+            if len(parts) < 2:
+                continue
+            vec = np.asarray(parts[1:], np.float32)
+            if dim is not None and vec.size != dim:
+                raise ValueError(
+                    f"glove vector width {vec.size} != expected {dim}")
+            w2v[parts[0]] = vec
+    return w2v

@@ -26,7 +26,8 @@ from bigdl_tpu.keras.layers import (  # noqa: F401
     GlobalMaxPooling2D, GlobalMaxPooling3D, Highway, InputLayer, KerasLayer,
     LSTM, LeakyReLU, LocallyConnected1D, LocallyConnected2D, Masking,
     MaxPooling1D, MaxPooling2D, MaxPooling3D, MaxoutDense, Merge, PReLU,
-    Permute, RepeatVector, Reshape, SReLU, SeparableConvolution2D,
+    Permute, ReLUVariant, RepeatVector, Reshape, SReLU,
+    SeparableConvolution2D,
     SimpleRNN, SoftMax, SpatialDropout1D, SpatialDropout2D,
     SpatialDropout3D, ThresholdedReLU, TimeDistributed, UpSampling1D,
     UpSampling2D, UpSampling3D, ZeroPadding1D, ZeroPadding2D, ZeroPadding3D,

@@ -106,6 +106,13 @@ def _norm_config(class_name, cfg):
     mv("epsilon", "epsilon")
     mv("momentum", "momentum")
     mv("axis", "axis")
+    if isinstance(out.get("axis"), (list, tuple)):   # tf.keras ListWrapper
+        out["axis"] = out["axis"][0] if out["axis"] else -1
+    mv("nb_feature", "nb_feature")
+    mv("max_value", "max_value")
+    mv("negative_slope", "negative_slope")
+    mv("threshold", "threshold")
+    mv("reset_after", "reset_after")
     mv("input_dim", "input_dim")
     mv("input_length", "input_length")
     mv("target_shape", "target_shape", tuple)
@@ -141,13 +148,28 @@ _BUILDERS = {
     "Permute": lambda c: KL.Permute(c["dims"]),
     "RepeatVector": lambda c: KL.RepeatVector(c["n"]),
     "Masking": lambda c: KL.Masking(c.get("mask_value", 0.0)),
-    "Highway": lambda c: KL.Highway(bias=c.get("bias", True)),
+    "Highway": lambda c: KL.Highway(
+        activation=c.get("activation"), bias=c.get("bias", True)),
     "MaxoutDense": lambda c: KL.MaxoutDense(
         c["output_dim"], c.get("nb_feature", 4)),
+    "LocallyConnected1D": lambda c: KL.LocallyConnected1D(
+        c["nb_filter"], c["filter_length"],
+        activation=c.get("activation"),
+        subsample_length=c.get("subsample_length", 1),
+        bias=c.get("bias", True)),
+    "LocallyConnected2D": lambda c: KL.LocallyConnected2D(
+        c["nb_filter"], c["nb_row"], c["nb_col"],
+        activation=c.get("activation"),
+        subsample=c.get("subsample", (1, 1)),
+        dim_ordering=c.get("dim_ordering", "th"),
+        bias=c.get("bias", True)),
     "Embedding": lambda c: KL.Embedding(c["input_dim"], c["output_dim"]),
     "BatchNormalization": lambda c: KL.BatchNormalization(
         epsilon=c.get("epsilon", 1e-3), momentum=c.get("momentum", 0.99),
-        dim_ordering=c.get("dim_ordering", "th")),
+        # keras2/3 carry the channel axis instead of dim_ordering:
+        # axis=-1/ndim-1 is channels-last ("tf"), axis=1 channels-first
+        dim_ordering=("tf" if c.get("axis", None) in (-1, 3, 4)
+                      else c.get("dim_ordering", "th"))),
     "Convolution1D": lambda c: KL.Convolution1D(
         c["nb_filter"], c["filter_length"],
         activation=c.get("activation"),
@@ -234,7 +256,9 @@ _BUILDERS = {
         c.get("return_sequences", False), c.get("go_backwards", False)),
     "GRU": lambda c: KL.GRU(
         c["output_dim"], c.get("activation", "tanh"),
-        c.get("return_sequences", False), c.get("go_backwards", False)),
+        c.get("return_sequences", False), c.get("go_backwards", False),
+        # keras1 configs have no reset_after key -> False (its convention)
+        reset_after=c.get("reset_after", False)),
     "LeakyReLU": lambda c: KL.LeakyReLU(c.get("alpha", 0.3)),
     "ELU": lambda c: KL.ELU(c.get("alpha", 1.0)),
     "PReLU": lambda c: KL.PReLU(),
@@ -250,6 +274,21 @@ _BUILDERS = {
         c.get("p", 0.5), c.get("dim_ordering", "th")),
     "Merge": lambda c: KL.Merge(
         mode=c.get("mode", "sum"), concat_axis=c.get("concat_axis", -1)),
+    "ConvLSTM2D": lambda c: KL.ConvLSTM2D(
+        c["nb_filter"], c.get("nb_row", 3),
+        dim_ordering=c.get("dim_ordering", "th"),
+        return_sequences=c.get("return_sequences", False),
+        go_backwards=c.get("go_backwards", False)),
+    # keras-2/3 standalone activation layers (ReLU keeps max_value /
+    # negative_slope / threshold -- e.g. ReLU6 in MobileNet configs)
+    "ReLU": lambda c: (
+        KL.Activation("relu")
+        if c.get("max_value") is None and not c.get("negative_slope")
+        and not c.get("threshold")
+        else KL.ReLUVariant(c.get("max_value"),
+                            c.get("negative_slope", 0.0),
+                            c.get("threshold", 0.0))),
+    "Softmax": lambda c: KL.SoftMax(),
 }
 
 
@@ -312,6 +351,11 @@ def _model_from_functional(config):
         parents = [nodes[n] for n in in_names]
         nodes[lname] = layer(*parents)
     def top(names):
+        # keras3 writes a single output as one flat [name, idx, tensor] triple
+        if (isinstance(names, (list, tuple)) and len(names) == 3
+                and isinstance(names[0], str)
+                and not isinstance(names[1], (list, tuple, str))):
+            names = [names]
         return [nodes[n[0] if isinstance(n, (list, tuple)) else n]
                 for n in names]
     inputs = top(config["input_layers"])
@@ -458,17 +502,31 @@ def _install_lstm(layer, p, s, arrays):
 
 
 def _install_gru(layer, p, s, arrays):
-    Ws, Us, bs = _split_rnn(arrays, 3)
-    # keras order z, r, h;  ours r, z, n
-    perm = [1, 0, 2]
+    """Our GRU cell follows the reset-after convention
+    (n = tanh(Wx + b_i + r*(Uh + b_h)), nn/recurrent.py GRU.step), which is
+    keras GRU reset_after=True (the keras-2/3 default; its bias is (2, 3h))."""
+    perm = [1, 0, 2]                 # keras order z, r, h; ours r, z, n
+    if len(arrays) == 3:
+        W, U, b = (np.asarray(a) for a in arrays)
+        Ws = np.split(W, 3, axis=1)
+        Us = np.split(U, 3, axis=1)
+        if b.ndim == 2:              # reset_after=True
+            bi, bh = b[0], b[1]
+        else:                        # reset_after=False: no recurrent bias
+            bi, bh = b, np.zeros_like(b)
+        bis, bhs = np.split(bi, 3), np.split(bh, 3)
+    else:                            # keras1 per-gate (W, U, b) * 3
+        Ws, Us, bis = arrays[0::3], arrays[1::3], arrays[2::3]
+        bhs = [np.zeros_like(np.asarray(x).reshape(-1)) for x in bis]
     W = np.concatenate([Ws[i] for i in perm], axis=1)
     U = np.concatenate([Us[i] for i in perm], axis=1)
-    b = np.concatenate([np.asarray(bs[i]).reshape(-1) for i in perm])
+    bi = np.concatenate([np.asarray(bis[i]).reshape(-1) for i in perm])
+    bh = np.concatenate([np.asarray(bhs[i]).reshape(-1) for i in perm])
     d = _param_dicts(p, keys=("weight_ih",))[0]
     _set(d, "weight_ih", W.T)
     _set(d, "weight_hh", U.T)
-    _set(d, "bias_ih", b)
-    _set(d, "bias_hh", np.zeros_like(b))
+    _set(d, "bias_ih", bi)
+    _set(d, "bias_hh", bh)
 
 
 def _install_simple_rnn(layer, p, s, arrays):
@@ -478,6 +536,95 @@ def _install_simple_rnn(layer, p, s, arrays):
     _set(d, "weight_hh", U.T)
     _set(d, "bias_ih", np.asarray(b).reshape(-1))
     _set(d, "bias_hh", np.zeros_like(np.asarray(b).reshape(-1)))
+
+
+def _install_prelu(layer, p, s, arrays):
+    """keras alpha has shape input_shape[1:] (shared axes already 1);
+    ours is a flat per-channel (or shared scalar) vector."""
+    alpha = np.asarray(arrays[0]).reshape(-1) \
+        if np.asarray(arrays[0]).ndim <= 1 else None
+    if alpha is None:
+        a = np.asarray(arrays[0])
+        # conv input: accept only channel-wise alphas (spatial axes shared)
+        lead = a.reshape(-1, a.shape[-1])
+        if not np.allclose(lead, lead[0]):
+            raise ValueError("PReLU alphas vary over spatial axes; "
+                             "bigdl_tpu PReLU is per-channel only")
+        alpha = lead[0]
+    d = _param_dicts(p)[0]
+    if np.shape(d["weight"]) == (1,) and alpha.size > 1:
+        if not np.allclose(alpha, alpha[0]):
+            raise ValueError("shared PReLU cannot hold per-channel alphas")
+        alpha = alpha[:1]
+    _set(d, "weight", alpha)
+
+
+def _install_srelu(layer, p, s, arrays):
+    """keras SReLU get_weights order: t_left, a_left, t_right, a_right."""
+    d = _param_dicts(p, keys=("t_left",))[0]
+    for key, arr in zip(("t_left", "a_left", "t_right", "a_right"), arrays):
+        _set(d, key, arr)
+
+
+def _install_maxout(layer, p, s, arrays):
+    """keras1 MaxoutDense: W (nb_feature, input_dim, output_dim) -- its
+    build computes np.dot(x, W) which contracts x's last axis with W's
+    SECOND-TO-LAST axis -- and b (nb_feature, output_dim).  Ours: weight
+    (nb*out, in) with row m*output_size + o <-> W[m, :, o] (nn.Maxout
+    reshapes to (maxout_number, output_size) before the max)."""
+    W = np.asarray(arrays[0])
+    d = _param_dicts(p)[0]
+    _set(d, "weight", W.transpose(0, 2, 1).reshape(-1, W.shape[1]))
+    if len(arrays) > 1:
+        _set(d, "bias", np.asarray(arrays[1]).reshape(-1))
+
+
+def _install_highway(layer, p, s, arrays):
+    """keras1 Highway get_weights: W, W_carry, b, b_carry with
+    y = act(xW+b)*sigmoid(xWc+bc) + x*(1-sigmoid(...)); ours stores
+    transposed (out, in) w_h/w_t."""
+    d = _param_dicts(p, keys=("w_t",))[0]
+    _set(d, "w_h", np.asarray(arrays[0]).T)
+    _set(d, "w_t", np.asarray(arrays[1]).T)
+    if len(arrays) > 2:
+        _set(d, "b_h", arrays[2])
+        _set(d, "b_t", arrays[3])
+
+
+def _install_local1d(layer, p, s, arrays):
+    """keras LocallyConnected1D kernel (out_t, k*cin, filters), bias
+    (out_t, filters) -- identical layout to ours."""
+    d = _param_dicts(p)[0]
+    _set(d, "weight", arrays[0])
+    if len(arrays) > 1:
+        _set(d, "bias", arrays[1])
+
+
+def _install_local2d(layer, p, s, arrays):
+    """keras LocallyConnected2D kernel (oh*ow, kh*kw*cin, filters) with
+    (kh, kw, cin)-major patch order; ours (oh, ow, cin*kh*kw, cout) because
+    lax.conv_general_dilated_patches emits channel-major patches."""
+    lab = getattr(layer, "_labor", layer)
+    kh, kw = lab.kernel
+    cin, f = lab.cin, lab.cout
+    oh, ow = lab._out_hw()
+    W = np.asarray(arrays[0]).reshape(oh * ow, kh, kw, cin, f)
+    W = W.transpose(0, 3, 1, 2, 4).reshape(oh, ow, cin * kh * kw, f)
+    d = _param_dicts(p)[0]
+    _set(d, "weight", W)
+    if len(arrays) > 1:
+        _set(d, "bias", np.asarray(arrays[1]).reshape(np.shape(d["bias"])))
+
+
+def _install_convlstm2d(layer, p, s, arrays):
+    """keras ConvLSTM2D: kernel (kh, kw, cin, 4f), recurrent (kh, kw, f, 4f),
+    bias (4f,), gate order i,f,c,o == our i,f,g,o; ours is OIHW."""
+    K, U = np.asarray(arrays[0]), np.asarray(arrays[1])
+    d = _param_dicts(p, keys=("weight_ih",))[0]
+    _set(d, "weight_ih", K.transpose(3, 2, 0, 1))
+    _set(d, "weight_hh", U.transpose(3, 2, 0, 1))
+    if len(arrays) > 2:
+        _set(d, "bias", np.asarray(arrays[2]).reshape(-1))
 
 
 _INSTALLERS = {
@@ -490,6 +637,13 @@ _INSTALLERS = {
     "LSTM": _install_lstm,
     "GRU": _install_gru,
     "SimpleRNN": _install_simple_rnn,
+    "PReLU": _install_prelu,
+    "SReLU": _install_srelu,
+    "MaxoutDense": _install_maxout,
+    "Highway": _install_highway,
+    "LocallyConnected1D": _install_local1d,
+    "LocallyConnected2D": _install_local2d,
+    "ConvLSTM2D": _install_convlstm2d,
 }
 
 
@@ -504,6 +658,35 @@ def set_layer_weights(model, weights_by_layer):
     st = _as_mutable(model._state)
     for i, (layer, arrays) in enumerate(zip(model.modules,
                                             weights_by_layer)):
+        if not arrays:
+            continue
+        cls = getattr(layer, "_keras_class", type(layer).__name__)
+        installer = _INSTALLERS.get(cls)
+        if installer is None:
+            raise NotImplementedError(
+                f"no weight installer for keras layer {cls}")
+        installer(layer, p[str(i)], st[str(i)],
+                  [np.asarray(a) for a in arrays])
+    model._params = p
+    model._state = st
+    return model
+
+
+def set_graph_weights(model, weights_by_name):
+    """Install keras weight arrays into a BUILT functional Model.
+
+    weights_by_name: dict of layer name -> arrays.  Graph params are keyed
+    by topological index (nn/graph.py setup), so walk ``model._topo``.
+    """
+    if not model.is_built():
+        model.build_model()
+    p = _as_mutable(model._params)
+    st = _as_mutable(model._state)
+    for i, node in enumerate(model._topo):
+        layer = node.module
+        if layer is None:
+            continue
+        arrays = weights_by_name.get(layer.name)
         if not arrays:
             continue
         cls = getattr(layer, "_keras_class", type(layer).__name__)
@@ -533,6 +716,11 @@ def load_weights_hdf5(model, path, by_name=False):
             wnames = [n.decode() if isinstance(n, bytes) else n
                       for n in grp.attrs.get("weight_names", [])]
             by_layer_name[ln] = [np.asarray(grp[w]) for w in wnames]
+    from bigdl_tpu.nn.graph import Graph
+
+    if isinstance(model, Graph):
+        # functional Model: params are keyed by topo index, match by name
+        return set_graph_weights(model, by_layer_name)
     weights = []
     for layer in model.modules:
         arrays = by_layer_name.get(layer.name)

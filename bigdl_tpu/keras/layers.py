@@ -258,8 +258,10 @@ class Highway(KerasLayer):
         self.bias = bias
 
     def _build_labor(self, spec):
+        # the activation applies to the transform branch only (inside
+        # nn.Highway), not to the layer output
         return nn.Highway(spec.shape[-1], with_bias=self.bias,
-                          activation=None)
+                          activation=get_activation(self._act_name))
 
 
 class MaxoutDense(KerasLayer):
@@ -380,9 +382,8 @@ class Convolution1D(KerasLayer):
 
     def _build_labor(self, spec):
         return nn.Conv1D(spec.shape[-1], self.nb_filter, self.filter_length,
-                         stride=self.subsample_length,
-                         padding=("SAME" if self.border_mode == "same"
-                                  else "VALID"),
+                         stride_w=self.subsample_length,
+                         pad_w=(-1 if self.border_mode == "same" else 0),
                          with_bias=self.bias)
 
 
@@ -810,8 +811,19 @@ class LSTM(_KerasRNN):
 
 
 class GRU(_KerasRNN):
+    """keras-1 GRU applies the reset gate BEFORE the recurrent matmul
+    (reset_after=False); keras-2/3 default to reset_after=True."""
+
+    def __init__(self, output_dim, activation="tanh", return_sequences=False,
+                 go_backwards=False, reset_after=False, input_shape=None,
+                 name=None, **kw):
+        super().__init__(output_dim, activation, return_sequences,
+                         go_backwards, input_shape, name, **kw)
+        self.reset_after = reset_after
+
     def _make_cell(self, input_size):
-        return nn.GRU(input_size, self.output_dim)
+        return nn.GRU(input_size, self.output_dim,
+                      reset_after=self.reset_after)
 
 
 class ConvLSTM2D(_Spatial):
@@ -827,37 +839,37 @@ class ConvLSTM2D(_Spatial):
         self.return_sequences = return_sequences
         self.go_backwards = go_backwards
 
-    def _spec_nlast(self, spec):
-        if self.dim_ordering == "tf":
+    # the ConvLSTMPeephole cell is NCHW per step, so the canonical internal
+    # layout is th (N, T, C, H, W); tf inputs are transposed at the boundary
+    def _spec_th(self, spec):
+        if self.dim_ordering == "th":
             return spec
-        n, t, c, h, w = spec.shape
-        return jax.ShapeDtypeStruct((n, t, h, w, c), spec.dtype)
+        n, t, h, w, c = spec.shape
+        return jax.ShapeDtypeStruct((n, t, c, h, w), spec.dtype)
 
-    def _nlast(self, x):
-        if self.dim_ordering == "tf":
-            return x
-        return jnp.transpose(x, (0, 1, 3, 4, 2))
-
-    def _nfirst(self, x):
-        if self.dim_ordering == "tf":
-            return x
-        if x.ndim == 5:
-            return jnp.transpose(x, (0, 1, 4, 2, 3))
-        return jnp.transpose(x, (0, 3, 1, 2))
+    def setup(self, rng, input_spec):
+        spec = self._spec_th(input_spec)
+        self._labor = self._build_labor(spec)
+        return self._labor.setup(rng, spec)
 
     def _build_labor(self, spec):
         cell = nn.ConvLSTMPeephole(
-            spec.shape[-1], self.nb_filter, self.nb_kernel, self.nb_kernel,
+            spec.shape[2], self.nb_filter, self.nb_kernel, self.nb_kernel,
             with_peephole=False)
         return nn.Recurrent(cell, reverse=self.go_backwards)
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        x = self._nlast(input)
+        x = input
+        if self.dim_ordering == "tf":
+            x = jnp.transpose(x, (0, 1, 4, 2, 3))
         y, state = self._labor.apply(params, state, x,
                                      training=training, rng=rng)
         if not self.return_sequences:
             y = y[:, -1]
-        return self._nfirst(y), state
+        if self.dim_ordering == "tf":
+            y = jnp.transpose(y, (0, 1, 3, 4, 2)) if y.ndim == 5 \
+                else jnp.transpose(y, (0, 2, 3, 1))
+        return y, state
 
 
 class Bidirectional(KerasLayer):
@@ -898,6 +910,27 @@ class TimeDistributed(KerasLayer):
 # ------------------------------------------------------------------ #
 
 
+class ReLUVariant(KerasLayer):
+    """keras-2/3 standalone ReLU with max_value / negative_slope /
+    threshold (e.g. ReLU6 in MobileNet configs):
+    f(x) = min(x, max_value) for x >= threshold,
+    negative_slope * (x - threshold) otherwise."""
+
+    def __init__(self, max_value=None, negative_slope=0.0, threshold=0.0,
+                 input_shape=None, name=None, **_):
+        super().__init__(input_shape, name)
+        self.max_value = max_value
+        self.negative_slope = negative_slope or 0.0
+        self.threshold = threshold or 0.0
+
+    def _call(self, params, state, x, training, rng):
+        y = jnp.where(x >= self.threshold, x,
+                      self.negative_slope * (x - self.threshold))
+        if self.max_value is not None:
+            y = jnp.minimum(y, self.max_value)
+        return y.astype(x.dtype), state
+
+
 class LeakyReLU(KerasLayer):
     def __init__(self, alpha=0.3, input_shape=None, name=None):
         super().__init__(input_shape, name)
@@ -921,7 +954,9 @@ class PReLU(KerasLayer):
         super().__init__(input_shape, name)
 
     def _build_labor(self, spec):
-        return nn.PReLU()
+        # per-channel alphas (channel = last axis); matches keras PReLU on
+        # dense inputs and keras shared_axes=spatial on conv inputs
+        return nn.PReLU(spec.shape[-1])
 
 
 class SReLU(KerasLayer):

@@ -232,11 +232,13 @@ class Conv1D(Module):
         return params, ()
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        # pad_w == -1 means SAME (same convention as SpatialConvolution)
+        pad = "SAME" if self.pad_w == -1 else ((self.pad_w, self.pad_w),)
         y = lax.conv_general_dilated(
             input,
             params["weight"].astype(input.dtype),
             window_strides=(self.stride_w,),
-            padding=((self.pad_w, self.pad_w),),
+            padding=pad,
             dimension_numbers=("NWC", "WIO", "NWC"),
         )
         if self.with_bias:

@@ -113,12 +113,21 @@ class LSTM(Cell):
 
 
 class GRU(Cell):
-    """GRU cell, gate order r,z,n (reference: nn/GRU.scala)."""
+    """GRU cell, gate order r,z,n (reference: nn/GRU.scala).
 
-    def __init__(self, input_size, hidden_size, name=None):
+    ``reset_after=True`` (default): n = tanh(Wx + b_i + r*(Uh + b_h)) --
+    the torch / keras reset_after=True convention.
+    ``reset_after=False``: n = tanh(Wx + b_i + U(r*h) + b_h) -- the
+    keras-1 / keras reset_after=False convention (reset gate applied
+    BEFORE the recurrent matmul).  The two differ whenever U is not
+    diagonal, so importers must match the source convention.
+    """
+
+    def __init__(self, input_size, hidden_size, reset_after=True, name=None):
         super().__init__(name)
         self.input_size = input_size
         self.hidden_size = hidden_size
+        self.reset_after = reset_after
 
     def setup(self, rng, input_spec):
         init = RandomUniform()
@@ -135,13 +144,23 @@ class GRU(Cell):
 
     def step(self, params, x_t, h):
         dt = x_t.dtype
+        nh = self.hidden_size
         gi = x_t @ params["weight_ih"].astype(dt).T + params["bias_ih"].astype(dt)
-        gh = h @ params["weight_hh"].astype(dt).T + params["bias_hh"].astype(dt)
         i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
-        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
-        r = jax.nn.sigmoid(i_r + h_r)
-        z = jax.nn.sigmoid(i_z + h_z)
-        n = jnp.tanh(i_n + r * h_n)
+        W_hh = params["weight_hh"].astype(dt)
+        b_hh = params["bias_hh"].astype(dt)
+        if self.reset_after:
+            gh = h @ W_hh.T + b_hh
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+        else:
+            gh = h @ W_hh[: 2 * nh].T + b_hh[: 2 * nh]
+            h_r, h_z = jnp.split(gh, 2, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + (r * h) @ W_hh[2 * nh:].T + b_hh[2 * nh:])
         h_new = (1.0 - z) * n + z * h
         return h_new, h_new
 

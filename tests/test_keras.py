@@ -1,0 +1,511 @@
+"""Keras package tests.
+
+Three tiers, mirroring the reference's Keras test strategy
+(test/.../keras/KerasRunner.scala:32-97 runs REAL Keras per spec, captures
+outputs, and compares; KerasBaseSpec.checkOutputAndGrad):
+
+1. Completeness: every public layer class in bigdl_tpu.keras.layers builds
+   and forwards (analogue of tests/test_serializer_complete.py's
+   reflection-complete loop).
+2. Golden importer tests against REAL Keras (3.x, TF backend, available in
+   this image): model.to_json() + get_weights() -> model_from_json +
+   set_layer_weights -> outputs must match.
+3. Keras-1-only classes (dropped by Keras 3: SReLU/MaxoutDense/Highway/
+   LocallyConnected) are tested against hand-written Keras-1 JSON plus a
+   numpy re-implementation of the documented Keras-1 semantics.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.keras import layers as KL
+from bigdl_tpu.keras.converter import (load_keras, load_weights_hdf5,
+                                       model_from_json, set_layer_weights)
+from bigdl_tpu.keras.topology import Input, Model, Sequential
+
+
+# ------------------------------------------------------------------ #
+# 1. completeness: every layer class builds + forwards
+# ------------------------------------------------------------------ #
+
+# class name -> (constructor thunk, input shape WITHOUT batch)
+# dim_ordering follows the keras-1 default "th" (channels first) unless the
+# ctor says otherwise.
+CASES = {
+    "Dense": (lambda: KL.Dense(7), (5,)),
+    "Activation": (lambda: KL.Activation("relu"), (5,)),
+    "Dropout": (lambda: KL.Dropout(0.3), (5,)),
+    "Flatten": (lambda: KL.Flatten(), (3, 4, 5)),
+    "Reshape": (lambda: KL.Reshape((12,)), (3, 4)),
+    "Permute": (lambda: KL.Permute((2, 1)), (3, 4)),
+    "RepeatVector": (lambda: KL.RepeatVector(3), (5,)),
+    "Masking": (lambda: KL.Masking(0.0), (4, 5)),
+    "Highway": (lambda: KL.Highway(), (6,)),
+    "MaxoutDense": (lambda: KL.MaxoutDense(7, 3), (5,)),
+    "Embedding": (lambda: KL.Embedding(11, 6), (4,)),
+    "BatchNormalization": (lambda: KL.BatchNormalization(), (3, 6, 6)),
+    "Convolution1D": (lambda: KL.Convolution1D(4, 3), (8, 5)),
+    "Convolution2D": (lambda: KL.Convolution2D(4, 3, 3), (2, 8, 8)),
+    "Convolution3D": (lambda: KL.Convolution3D(2, 3, 3, 3), (1, 6, 6, 6)),
+    "AtrousConvolution1D": (lambda: KL.AtrousConvolution1D(4, 3, 2), (9, 5)),
+    "AtrousConvolution2D": (
+        lambda: KL.AtrousConvolution2D(4, 3, 3, (2, 2)), (2, 9, 9)),
+    "Deconvolution2D": (lambda: KL.Deconvolution2D(4, 3, 3), (2, 6, 6)),
+    "SeparableConvolution2D": (
+        lambda: KL.SeparableConvolution2D(4, 3, 3), (2, 8, 8)),
+    "LocallyConnected1D": (lambda: KL.LocallyConnected1D(4, 3), (8, 5)),
+    "LocallyConnected2D": (lambda: KL.LocallyConnected2D(4, 3, 3), (2, 6, 6)),
+    "MaxPooling1D": (lambda: KL.MaxPooling1D(2), (8, 5)),
+    "AveragePooling1D": (lambda: KL.AveragePooling1D(2), (8, 5)),
+    "MaxPooling2D": (lambda: KL.MaxPooling2D(), (2, 8, 8)),
+    "AveragePooling2D": (lambda: KL.AveragePooling2D(), (2, 8, 8)),
+    "MaxPooling3D": (lambda: KL.MaxPooling3D(), (1, 6, 6, 6)),
+    "AveragePooling3D": (lambda: KL.AveragePooling3D(), (1, 6, 6, 6)),
+    "GlobalMaxPooling1D": (lambda: KL.GlobalMaxPooling1D(), (8, 5)),
+    "GlobalAveragePooling1D": (lambda: KL.GlobalAveragePooling1D(), (8, 5)),
+    "GlobalMaxPooling2D": (lambda: KL.GlobalMaxPooling2D(), (2, 6, 6)),
+    "GlobalAveragePooling2D": (lambda: KL.GlobalAveragePooling2D(), (2, 6, 6)),
+    "GlobalMaxPooling3D": (lambda: KL.GlobalMaxPooling3D(), (1, 4, 4, 4)),
+    "GlobalAveragePooling3D": (
+        lambda: KL.GlobalAveragePooling3D(), (1, 4, 4, 4)),
+    "ZeroPadding1D": (lambda: KL.ZeroPadding1D(2), (6, 4)),
+    "ZeroPadding2D": (lambda: KL.ZeroPadding2D(), (2, 5, 5)),
+    "ZeroPadding3D": (lambda: KL.ZeroPadding3D(), (1, 4, 4, 4)),
+    "Cropping1D": (lambda: KL.Cropping1D((1, 1)), (6, 4)),
+    "Cropping2D": (lambda: KL.Cropping2D(((1, 1), (1, 1))), (2, 6, 6)),
+    "Cropping3D": (
+        lambda: KL.Cropping3D(((1, 1), (1, 1), (1, 1))), (1, 5, 5, 5)),
+    "UpSampling1D": (lambda: KL.UpSampling1D(2), (4, 3)),
+    "UpSampling2D": (lambda: KL.UpSampling2D(), (2, 4, 4)),
+    "UpSampling3D": (lambda: KL.UpSampling3D(), (1, 3, 3, 3)),
+    "SimpleRNN": (lambda: KL.SimpleRNN(6), (5, 4)),
+    "LSTM": (lambda: KL.LSTM(6), (5, 4)),
+    "GRU": (lambda: KL.GRU(6, return_sequences=True), (5, 4)),
+    "ConvLSTM2D": (lambda: KL.ConvLSTM2D(4, 3), (3, 2, 6, 6)),
+    "Bidirectional": (
+        lambda: KL.Bidirectional(KL.LSTM(5, return_sequences=True)), (6, 4)),
+    "TimeDistributed": (
+        lambda: KL.TimeDistributed(nn.Linear(4, 7)), (5, 4)),
+    "LeakyReLU": (lambda: KL.LeakyReLU(0.1), (5,)),
+    "ReLUVariant": (lambda: KL.ReLUVariant(6.0, 0.1), (5,)),
+    "ELU": (lambda: KL.ELU(), (5,)),
+    "PReLU": (lambda: KL.PReLU(), (5,)),
+    "SReLU": (lambda: KL.SReLU(), (5,)),
+    "ThresholdedReLU": (lambda: KL.ThresholdedReLU(0.5), (5,)),
+    "SoftMax": (lambda: KL.SoftMax(), (5,)),
+    "GaussianDropout": (lambda: KL.GaussianDropout(0.3), (5,)),
+    "GaussianNoise": (lambda: KL.GaussianNoise(0.1), (5,)),
+    "SpatialDropout1D": (lambda: KL.SpatialDropout1D(0.3), (6, 4)),
+    "SpatialDropout2D": (lambda: KL.SpatialDropout2D(0.3), (2, 5, 5)),
+    "SpatialDropout3D": (lambda: KL.SpatialDropout3D(0.3), (1, 4, 4, 4)),
+}
+
+NOT_SEQUENTIAL = {"InputLayer", "Merge", "KerasLayer"}  # tested elsewhere
+
+
+def _public_layer_classes():
+    import inspect
+
+    out = []
+    for name in dir(KL):
+        obj = getattr(KL, name)
+        if (inspect.isclass(obj) and issubclass(obj, KL.KerasLayer)
+                and not name.startswith("_")):
+            out.append(name)
+    return out
+
+
+def test_every_layer_class_has_a_case():
+    """Reflection guard: adding a layer without a completeness case fails
+    (mirrors test_serializer_complete.py's stance)."""
+    missing = [n for n in _public_layer_classes()
+               if n not in CASES and n not in NOT_SEQUENTIAL
+               and n not in ("Sequential", "Model")]
+    assert not missing, f"layers without completeness cases: {missing}"
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_layer_builds_and_forwards(name):
+    make, shape = CASES[name]
+    layer = make()
+    layer.input_shape = shape
+    model = Sequential().add(layer)
+    model.build_model()
+    out_shape = model.get_output_shape()
+    if name == "Embedding":
+        x = np.random.randint(0, 11, (2,) + shape).astype(np.float32)
+    else:
+        x = np.random.randn(2, *shape).astype(np.float32)
+    y = np.asarray(model.forward(jnp.asarray(x)))
+    assert np.isfinite(y).all(), name
+    assert y.shape[1:] == tuple(out_shape[1:]), \
+        f"{name}: forward {y.shape[1:]} vs inferred {out_shape[1:]}"
+
+
+def test_merge_layer():
+    a = Input(shape=(4,))
+    b = Input(shape=(4,))
+    merged = KL.Merge(mode="sum")(a, b)
+    m = Model([a, b], [merged]).build_model()
+    x = np.random.randn(2, 4).astype(np.float32)
+    y = np.asarray(m.forward((jnp.asarray(x), jnp.asarray(x))))
+    np.testing.assert_allclose(y, 2 * x, rtol=1e-6)
+
+
+# ------------------------------------------------------------------ #
+# 2. golden tests against REAL Keras (3.x, TF backend)
+# ------------------------------------------------------------------ #
+
+keras = pytest.importorskip("keras")
+
+
+def _golden_check(kmodel, x, rtol=2e-4, atol=2e-5):
+    """Round-trip a real Keras model through to_json + get_weights and
+    compare forward outputs (KerasRunner analogue)."""
+    y_ref = np.asarray(kmodel(x))
+    ours = model_from_json(kmodel.to_json())
+    ours.build_model()
+    weights = [l.get_weights() for l in kmodel.layers
+               if l.__class__.__name__ != "InputLayer"]
+    if isinstance(ours, Sequential):
+        set_layer_weights(ours, weights)
+    else:
+        raise AssertionError("use _golden_check_functional")
+    ours.evaluate()          # eval mode: BN uses running stats
+    y = np.asarray(ours.forward(jnp.asarray(x)))
+    assert y.shape == y_ref.shape, (y.shape, y_ref.shape)
+    np.testing.assert_allclose(y, y_ref, rtol=rtol, atol=atol)
+    return ours
+
+
+class TestGoldenVsRealKeras:
+    def test_dense_mlp(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(8,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(4, activation="softmax"),
+        ])
+        _golden_check(km, np.random.randn(3, 8).astype(np.float32))
+
+    def test_lenet_style_conv(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(12, 12, 3)),
+            keras.layers.Conv2D(6, (3, 3), activation="tanh"),
+            keras.layers.MaxPooling2D((2, 2)),
+            keras.layers.Conv2D(8, (3, 3), activation="relu", padding="same"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(10),
+        ])
+        _golden_check(km, np.random.randn(2, 12, 12, 3).astype(np.float32))
+
+    def test_batchnorm_eval(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(6, 6, 4)),
+            keras.layers.BatchNormalization(),
+            keras.layers.ReLU(),
+        ])
+        # give the running stats non-trivial values
+        km.layers[0].set_weights([
+            np.random.rand(4).astype(np.float32) + 0.5,
+            np.random.randn(4).astype(np.float32),
+            np.random.randn(4).astype(np.float32) * 0.1,
+            np.random.rand(4).astype(np.float32) + 0.5,
+        ])
+        _golden_check(km, np.random.randn(2, 6, 6, 4).astype(np.float32))
+
+    def test_conv1d(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(10, 5)),
+            keras.layers.Conv1D(7, 3, activation="relu"),
+            keras.layers.GlobalAveragePooling1D(),
+        ])
+        _golden_check(km, np.random.randn(2, 10, 5).astype(np.float32))
+
+    def test_lstm(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(6, 5)),
+            keras.layers.LSTM(8),
+        ])
+        _golden_check(km, np.random.randn(2, 6, 5).astype(np.float32),
+                      rtol=1e-3, atol=1e-4)
+
+    def test_lstm_return_sequences(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(6, 5)),
+            keras.layers.LSTM(8, return_sequences=True),
+        ])
+        _golden_check(km, np.random.randn(2, 6, 5).astype(np.float32),
+                      rtol=1e-3, atol=1e-4)
+
+    def test_gru(self):
+        # keras default reset_after=True matches our GRU cell's convention
+        km = keras.Sequential([
+            keras.layers.Input(shape=(6, 5)),
+            keras.layers.GRU(8),
+        ])
+        _golden_check(km, np.random.randn(2, 6, 5).astype(np.float32),
+                      rtol=1e-3, atol=1e-4)
+
+    def test_gru_reset_after_false(self):
+        # keras-1 convention: reset gate applied before the recurrent matmul
+        km = keras.Sequential([
+            keras.layers.Input(shape=(6, 5)),
+            keras.layers.GRU(8, reset_after=False),
+        ])
+        _golden_check(km, np.random.randn(2, 6, 5).astype(np.float32),
+                      rtol=1e-3, atol=1e-4)
+
+    def test_relu6(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(7,)),
+            keras.layers.Dense(5),
+            keras.layers.ReLU(max_value=6.0),
+        ])
+        # drive pre-activations above 6 so the clamp matters
+        x = 4.0 * np.random.randn(8, 7).astype(np.float32)
+        _golden_check(km, x)
+
+    def test_simple_rnn(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(6, 5)),
+            keras.layers.SimpleRNN(8),
+        ])
+        _golden_check(km, np.random.randn(2, 6, 5).astype(np.float32),
+                      rtol=1e-3, atol=1e-4)
+
+    def test_embedding(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(7,)),
+            keras.layers.Embedding(13, 6),
+        ])
+        _golden_check(km, np.random.randint(0, 13, (3, 7)).astype(np.float32))
+
+    def test_prelu(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(9,)),
+            keras.layers.Dense(5),
+            keras.layers.PReLU(),
+        ])
+        km.layers[1].set_weights([np.random.rand(5).astype(np.float32)])
+        _golden_check(km, np.random.randn(4, 9).astype(np.float32))
+
+    def test_convlstm2d(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(3, 6, 6, 2)),
+            keras.layers.ConvLSTM2D(4, (3, 3), padding="same",
+                                    data_format="channels_last",
+                                    return_sequences=False),
+        ])
+        x = np.random.randn(2, 3, 6, 6, 2).astype(np.float32)
+        y_ref = np.asarray(km(x))
+        # our ConvLSTM2D follows the keras-1 th convention; feed tf-ordered
+        # config through the importer
+        ours = model_from_json(km.to_json())
+        ours.build_model()
+        set_layer_weights(
+            ours, [l.get_weights() for l in km.layers
+                   if l.__class__.__name__ != "InputLayer"])
+        ours.evaluate()
+        y = np.asarray(ours.forward(jnp.asarray(x)))
+        assert y.shape == y_ref.shape, (y.shape, y_ref.shape)
+        np.testing.assert_allclose(y, y_ref, rtol=1e-3, atol=1e-4)
+
+    def test_functional_two_branch_add(self):
+        inp = keras.layers.Input(shape=(6,))
+        a = keras.layers.Dense(5, activation="relu")(inp)
+        b = keras.layers.Dense(5)(inp)
+        out = keras.layers.Add()([a, b])
+        km = keras.Model(inputs=inp, outputs=out)
+        x = np.random.randn(3, 6).astype(np.float32)
+        y_ref = np.asarray(km(x))
+
+        from bigdl_tpu.keras.converter import set_graph_weights
+
+        ours = model_from_json(km.to_json())
+        ours.build_model()
+        set_graph_weights(ours, {l.name: l.get_weights()
+                                 for l in km.layers if l.get_weights()})
+        ours.evaluate()
+        y = np.asarray(ours.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(y, y_ref, rtol=2e-4, atol=2e-5)
+
+
+# ------------------------------------------------------------------ #
+# 3. keras-1-only classes: hand-written keras-1 JSON + numpy semantics
+# ------------------------------------------------------------------ #
+
+
+def _k1_json(layers):
+    return json.dumps({"class_name": "Sequential", "config": layers})
+
+
+class TestKeras1OnlyClasses:
+    def test_maxout_dense_import_and_math(self):
+        js = _k1_json([
+            {"class_name": "MaxoutDense",
+             "config": {"name": "mo", "output_dim": 4, "nb_feature": 3,
+                        "batch_input_shape": [None, 5]}},
+        ])
+        m = load_keras(json_str=js)
+        # keras-1 weights: W (nb_feature, input_dim, output_dim) -- its
+        # build computes np.dot(x, W) (contract over W's middle axis) then
+        # max over the feature axis -- and b (nb_feature, output_dim)
+        W = np.random.randn(3, 5, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        set_layer_weights(m, [[W, b]])
+        x = np.random.randn(2, 5).astype(np.float32)
+        y = np.asarray(m.forward(jnp.asarray(x)))
+        ref = (np.einsum("ni,fio->nfo", x, W) + b).max(axis=1)
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+    def test_highway_import_and_math(self):
+        js = _k1_json([
+            {"class_name": "Highway",
+             "config": {"name": "hw", "activation": "relu",
+                        "batch_input_shape": [None, 6]}},
+        ])
+        m = load_keras(json_str=js)
+        W = np.random.randn(6, 6).astype(np.float32)
+        Wc = np.random.randn(6, 6).astype(np.float32)
+        b = np.random.randn(6).astype(np.float32)
+        bc = np.random.randn(6).astype(np.float32)
+        set_layer_weights(m, [[W, Wc, b, bc]])
+        x = np.random.randn(3, 6).astype(np.float32)
+        y = np.asarray(m.forward(jnp.asarray(x)))
+        t = 1.0 / (1.0 + np.exp(-(x @ Wc + bc)))
+        h = np.maximum(x @ W + b, 0.0)
+        np.testing.assert_allclose(y, t * h + (1 - t) * x,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_srelu_import_and_math(self):
+        js = _k1_json([
+            {"class_name": "SReLU",
+             "config": {"name": "sr", "batch_input_shape": [None, 5]}},
+        ])
+        m = load_keras(json_str=js)
+        tl = np.random.randn(5).astype(np.float32) * 0.1
+        al = np.random.rand(5).astype(np.float32)
+        tr = np.random.rand(5).astype(np.float32) + 0.5
+        ar = np.random.rand(5).astype(np.float32)
+        set_layer_weights(m, [[tl, al, tr, ar]])
+        x = np.random.randn(4, 5).astype(np.float32)
+        y = np.asarray(m.forward(jnp.asarray(x)))
+        mid = np.where(x <= tl, tl + al * (x - tl), x)
+        ref = np.where(mid >= tr, tr + ar * (mid - tr), mid)
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+    def test_locally_connected1d_import(self):
+        js = _k1_json([
+            {"class_name": "LocallyConnected1D",
+             "config": {"name": "lc1", "nb_filter": 4, "filter_length": 3,
+                        "batch_input_shape": [None, 8, 5]}},
+        ])
+        m = load_keras(json_str=js)
+        ot = 8 - 3 + 1
+        Wk = np.random.randn(ot, 3 * 5, 4).astype(np.float32)
+        b = np.random.randn(ot, 4).astype(np.float32)
+        set_layer_weights(m, [[Wk, b]])
+        x = np.random.randn(2, 8, 5).astype(np.float32)
+        y = np.asarray(m.forward(jnp.asarray(x)))
+        # windows flattened (k, cin) -> row-major, matching our einsum
+        ref = np.empty((2, ot, 4), np.float32)
+        for t in range(ot):
+            win = x[:, t:t + 3, :].reshape(2, -1)
+            ref[:, t, :] = win @ Wk[t] + b[t]
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+    def test_locally_connected2d_import(self):
+        js = _k1_json([
+            {"class_name": "LocallyConnected2D",
+             "config": {"name": "lc2", "nb_filter": 3, "nb_row": 3,
+                        "nb_col": 3, "dim_ordering": "tf",
+                        "batch_input_shape": [None, 6, 6, 2]}},
+        ])
+        m = load_keras(json_str=js)
+        oh = ow = 6 - 3 + 1
+        Wk = np.random.randn(oh * ow, 3 * 3 * 2, 3).astype(np.float32)
+        b = np.random.randn(oh, ow, 3).astype(np.float32)
+        set_layer_weights(m, [[Wk, b]])
+        x = np.random.randn(2, 6, 6, 2).astype(np.float32)
+        y = np.asarray(m.forward(jnp.asarray(x)))
+        assert y.shape == (2, oh, ow, 3)
+        # cross-check one output position by hand
+        win = x[:, 1:4, 2:5, :].reshape(2, -1)
+        ref = win @ Wk[1 * ow + 2] + b[1, 2]
+        np.testing.assert_allclose(y[:, 1, 2, :], ref, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------------ #
+# 4. legacy HDF5 weight files (save_weights 1.x/2.x layout)
+# ------------------------------------------------------------------ #
+
+
+class TestLegacyHDF5:
+    def test_functional_model_hdf5(self, tmp_path):
+        """load_keras on a FUNCTIONAL model + legacy h5 must route through
+        set_graph_weights (Graph params are keyed by topo index)."""
+        h5py = pytest.importorskip("h5py")
+        js = json.dumps({
+            "class_name": "Model",
+            "config": {
+                "layers": [
+                    {"class_name": "InputLayer", "name": "in0",
+                     "config": {"name": "in0",
+                                "batch_input_shape": [None, 4]},
+                     "inbound_nodes": []},
+                    {"class_name": "Dense", "name": "d1",
+                     "config": {"name": "d1", "output_dim": 3},
+                     "inbound_nodes": [[["in0", 0, 0]]]},
+                ],
+                "input_layers": [["in0", 0, 0]],
+                "output_layers": [["d1", 0, 0]],
+            },
+        })
+        W = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(3).astype(np.float32)
+        path = str(tmp_path / "w.h5")
+        with h5py.File(path, "w") as f:
+            f.attrs["layer_names"] = [b"d1"]
+            g = f.create_group("d1")
+            g.attrs["weight_names"] = [b"d1/kernel:0", b"d1/bias:0"]
+            g.create_dataset("d1/kernel:0", data=W)
+            g.create_dataset("d1/bias:0", data=b)
+        m = load_keras(json_str=js, hdf5_path=path)
+        x = np.random.randn(2, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(m.forward(jnp.asarray(x))), x @ W + b,
+            rtol=1e-5, atol=1e-6)
+
+    def test_load_weights_hdf5(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        js = _k1_json([
+            {"class_name": "Dense",
+             "config": {"name": "d1", "output_dim": 6, "activation": "relu",
+                        "batch_input_shape": [None, 4]}},
+            {"class_name": "Dense",
+             "config": {"name": "d2", "output_dim": 3}},
+        ])
+        W1 = np.random.randn(4, 6).astype(np.float32)
+        b1 = np.random.randn(6).astype(np.float32)
+        W2 = np.random.randn(6, 3).astype(np.float32)
+        b2 = np.random.randn(3).astype(np.float32)
+        path = str(tmp_path / "w.h5")
+        with h5py.File(path, "w") as f:
+            f.attrs["layer_names"] = [b"d1", b"d2"]
+            for nm, (Wa, ba) in (("d1", (W1, b1)), ("d2", (W2, b2))):
+                g = f.create_group(nm)
+                g.attrs["weight_names"] = [
+                    f"{nm}/kernel:0".encode(), f"{nm}/bias:0".encode()]
+                g.create_dataset(f"{nm}/kernel:0", data=Wa)
+                g.create_dataset(f"{nm}/bias:0", data=ba)
+        m = load_keras(json_str=js, hdf5_path=path)
+        x = np.random.randn(2, 4).astype(np.float32)
+        y = np.asarray(m.forward(jnp.asarray(x)))
+        ref = np.maximum(x @ W1 + b1, 0.0) @ W2 + b2
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,167 @@
+"""Numerical equivalence of tp/pp/ep train steps vs the single-device step.
+
+Round-2 VERDICT (ask #4): sp already has an equivalence test
+(test_ring_attention.py); these give tp/pp/ep the same treatment -- one
+optimizer step on identical params/batch must produce the same loss and the
+same updated parameters as a plain single-device jit step, because the
+parallel forms only re-layout the computation (GSPMD partitioning, GPipe
+scheduling), not the math.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.nn.attention import TransformerLM
+from bigdl_tpu.nn.moe import MoETransformerLM
+from bigdl_tpu.utils.random_generator import RNG
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+def _tree_allclose(a, b, rtol=5e-4, atol=1e-5):
+    flat_a = jax.tree_util.tree_flatten_with_path(a)[0]
+    flat_b = jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for (path, x), y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def _baseline_step(model, criterion, method, params, x, y):
+    """Plain single-device fused step (the semantics tp/pp/ep must match)."""
+
+    def step(p, opt_state):
+        def loss_fn(q):
+            out, _ = model.apply(q, (), x, training=True,
+                                 rng=jax.random.key(0))
+            return criterion.apply(out.astype(jnp.float32), y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        new_p, new_opt = method.update(grads, opt_state, p)
+        return new_p, new_opt, loss
+
+    return jax.jit(step)(params, method.init_state(params))
+
+
+class TestTPEquivalence:
+    def test_one_step_matches_single_device(self):
+        from bigdl_tpu.parallel.tp import (init_opt_state_sharded,
+                                           make_tp_train_step, shard_params)
+
+        RNG.set_seed(0)
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:4]).reshape(2, 2), ("data", "model"))
+        model = TransformerLM(64, 32, 4, 2, max_len=32)
+        model.build(jax.ShapeDtypeStruct((4, 16), jnp.int32))
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+        y = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+
+        ref_p, _, ref_loss = _baseline_step(
+            model, crit, optim.SGD(learning_rate=0.1, momentum=0.9,
+                                   dampening=0.0),
+            jax.tree.map(jnp.copy, model._params), x, y)
+
+        method = optim.SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+        step = make_tp_train_step(model, crit, method, mesh)(model._params)
+        sharded = shard_params(jax.tree.map(jnp.copy, model._params), mesh)
+        opt_state = init_opt_state_sharded(method, sharded, mesh)
+        tp_p, _, tp_loss = step(sharded, opt_state, x, y, jax.random.key(0))
+
+        np.testing.assert_allclose(float(tp_loss), float(ref_loss),
+                                   rtol=1e-5)
+        _tree_allclose(tp_p, ref_p)
+
+
+class TestPPEquivalence:
+    def test_one_step_matches_single_device(self):
+        from bigdl_tpu.parallel.pp import (init_pp_opt_state,
+                                           make_pp_train_step, pp_shardings,
+                                           stack_stage_params,
+                                           unstack_stage_params)
+
+        RNG.set_seed(0)
+        n_stages = 2
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:4]).reshape(2, 2), ("data", "pipe"))
+        model = TransformerLM(64, 32, 4, num_layers=n_stages, max_len=32)
+        model.build(jax.ShapeDtypeStruct((4, 16), jnp.int32))
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+        y = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+
+        ref_p, _, ref_loss = _baseline_step(
+            model, crit, optim.SGD(learning_rate=0.1, momentum=0.9,
+                                   dampening=0.0),
+            jax.tree.map(jnp.copy, model._params), x, y)
+
+        method = optim.SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+        pp = stack_stage_params(model, n_stages)
+        pp = jax.tree.map(jax.device_put, pp, pp_shardings(pp, mesh))
+        opt_state = init_pp_opt_state(method, pp, mesh)
+        step = make_pp_train_step(model, crit, method, mesh,
+                                  n_microbatches=2, data_axis="data")
+        pp_new, _, pp_loss = step(pp, opt_state, x, y, jax.random.key(0))
+
+        np.testing.assert_allclose(float(pp_loss), float(ref_loss),
+                                   rtol=1e-5)
+        _tree_allclose(unstack_stage_params(model, pp_new), ref_p)
+
+
+class TestEPEquivalence:
+    def test_one_step_matches_single_device(self):
+        from bigdl_tpu.parallel.ep import (ep_shard_params,
+                                           init_ep_opt_state,
+                                           make_ep_train_step)
+
+        RNG.set_seed(0)
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:4]).reshape(2, 2), ("data", "expert"))
+        model = MoETransformerLM(64, 32, 4, 2, num_experts=2, max_len=32,
+                                 capacity_factor=4.0)
+        model.build(jax.ShapeDtypeStruct((2, 8), jnp.int32))
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.integers(0, 64, (4, 8)), jnp.int32)
+        y = jnp.asarray(rng.integers(0, 64, (4, 8)), jnp.int32)
+        aux_weight = 0.01
+
+        method_ref = optim.SGD(learning_rate=0.1, momentum=0.9,
+                               dampening=0.0)
+
+        def base_step(p, opt_state):
+            def loss_fn(q):
+                logits, st = model.apply(q, (), x, training=True,
+                                         rng=jax.random.key(0))
+                task = crit.apply(logits.astype(jnp.float32), y)
+                return task + aux_weight * st["aux_loss"], task
+
+            (_, task), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            new_p, new_opt = method_ref.update(grads, opt_state, p)
+            return new_p, new_opt, task
+
+        ref_p, _, ref_task = jax.jit(base_step)(
+            jax.tree.map(jnp.copy, model._params),
+            method_ref.init_state(model._params))
+
+        method = optim.SGD(learning_rate=0.1, momentum=0.9,
+                           dampening=0.0)
+        step = make_ep_train_step(model, crit, method, mesh,
+                                  aux_weight=aux_weight)(model._params)
+        params = ep_shard_params(
+            jax.tree.map(jnp.copy, model._params), mesh)
+        opt_state = init_ep_opt_state(method, params, mesh)
+        ep_p, _, ep_task = step(params, opt_state, x, y, jax.random.key(0))
+
+        np.testing.assert_allclose(float(ep_task), float(ref_task),
+                                   rtol=1e-5)
+        _tree_allclose(ep_p, ref_p)
