@@ -1,6 +1,9 @@
-"""bigdl.nn.criterion — criterions re-exported from bigdl_tpu.nn.
+"""bigdl.nn.criterion — criterions re-exported from bigdl_tpu.nn, with
+classification criterions adapted to the Torch 1-BASED label convention.
 
-Reference: pyspark/bigdl/nn/criterion.py.
+Reference: pyspark/bigdl/nn/criterion.py (ClassNLLCriterion targets are
+1..C there; bigdl_tpu targets are 0..C-1).  The adapters shift labels via
+the same policy as bigdl.util.common.shift_one_based_labels("auto").
 """
 
 from bigdl_tpu.nn import (  # noqa: F401
@@ -20,3 +23,60 @@ from bigdl_tpu.nn import (  # noqa: F401,E402
     MultiMarginCriterion, PoissonCriterion, SoftMarginCriterion,
     TimeDistributedMaskCriterion, TransformerCriterion,
 )
+
+
+import jax.numpy as _jnp
+
+from bigdl_tpu.nn import ClassNLLCriterion as _ClassNLL
+from bigdl_tpu.nn import CrossEntropyCriterion as _CrossEntropy
+
+
+def _shift_labels(target):
+    """1-based class labels -> 0-based, same policy as
+    bigdl.util.common.shift_one_based_labels("auto"): only FLOAT targets
+    whose values are all integral and >= 1 are shifted (the pyspark float
+    label convention); integer dtypes are the repo's native 0-based ids and
+    never shift.  Fully traceable so the compat criterions work inside
+    jitted train steps (the shift is a data-dependent select, not Python
+    control flow)."""
+    t = _jnp.asarray(target)
+    if _jnp.issubdtype(t.dtype, _jnp.integer):
+        return t
+    integral = _jnp.all(t == _jnp.round(t))
+    ti = t.astype(_jnp.int32)
+    shift = _jnp.logical_and(integral, _jnp.min(ti) >= 1)
+    return _jnp.where(shift, ti - 1, ti)
+
+
+class ClassNLLCriterion(_ClassNLL):
+    """pyspark signature (criterion.py ClassNLLCriterion): targets 1..C.
+
+    ``_targets_already_zero_based`` is latched by bigdl.optim.Optimizer when
+    its dataset-level label shift already normalised the labels, so a batch
+    that happens to lack class 0 is not shifted twice."""
+
+    def __init__(self, weights=None, size_average=True,
+                 logProbAsInput=True, bigdl_type="float"):
+        super().__init__(weights=weights, size_average=size_average)
+        self.log_prob_as_input = logProbAsInput
+        self._targets_already_zero_based = False
+
+    def apply(self, input, target):
+        if not self.log_prob_as_input:
+            input = _jnp.log(_jnp.clip(input, 1e-8))
+        if not self._targets_already_zero_based:
+            target = _shift_labels(target)
+        return super().apply(input, target)
+
+
+class CrossEntropyCriterion(_CrossEntropy):
+    """pyspark signature: targets 1..C."""
+
+    def __init__(self, weights=None, size_average=True, bigdl_type="float"):
+        super().__init__(weights=weights, size_average=size_average)
+        self._targets_already_zero_based = False
+
+    def apply(self, input, target):
+        if not self._targets_already_zero_based:
+            target = _shift_labels(target)
+        return super().apply(input, target)

@@ -1,12 +1,277 @@
-"""bigdl.nn.layer — layer names re-exported from bigdl_tpu.nn.
+"""bigdl.nn.layer — drop-in pyspark-API compatibility layer.
 
-Reference: pyspark/bigdl/nn/layer.py:118 (class Layer), :696 (Model).
-The pyspark package constructs JVM layers over py4j; here the classes ARE
-the TPU-native modules, same constructor argument order as the reference
-(positional args follow the Scala constructors).
+Reference: pyspark/bigdl/nn/layer.py (Layer :118, Model :696).  The pyspark
+package constructs JVM layers over py4j with (a) Torch 1-BASED dimension /
+index conventions, (b) ``bigdl_type`` + regularizer + ``init_weight`` /
+``init_bias`` constructor arguments, and (c) NCHW as the default image
+layout.  The adapters below translate those conventions onto the 0-based,
+NHWC-preferring ``bigdl_tpu.nn`` classes so unmodified reference snippets
+run (see tests/test_pyspark_snippets.py).
 """
 
+import numpy as np
+
 from bigdl_tpu.nn import *          # noqa: F401,F403
+import bigdl_tpu.nn as _nn
 from bigdl_tpu.nn import Module as Layer  # noqa: F401
 from bigdl_tpu.nn import Graph as Model   # noqa: F401
 from bigdl_tpu.nn.graph import Input, Node  # noqa: F401
+
+
+def _dim(v):
+    """Torch 1-based dim/index -> 0-based (negative = from-end unchanged)."""
+    if isinstance(v, (int, np.integer)) and v > 0:
+        return int(v) - 1
+    return v
+
+
+class Regularizer:
+    """Weight-penalty marker (reference: pyspark/bigdl/optim/optimizer.py
+    L1L2Regularizer).  Recorded on the layer; the TPU training loop applies
+    global weight decay via the OptimMethod instead of per-layer hooks."""
+
+    def __init__(self, l1=0.0, l2=0.0, bigdl_type="float"):
+        self.l1, self.l2 = l1, l2
+
+
+class L1Regularizer(Regularizer):
+    def __init__(self, l1, bigdl_type="float"):
+        super().__init__(l1=l1)
+
+
+class L2Regularizer(Regularizer):
+    def __init__(self, l2, bigdl_type="float"):
+        super().__init__(l2=l2)
+
+
+class L1L2Regularizer(Regularizer):
+    pass
+
+
+def _install_inits(params, init_weight=None, init_bias=None):
+    if init_weight is not None:
+        w = np.asarray(init_weight, np.float32)
+        assert w.shape == tuple(np.shape(params["weight"])), \
+            (w.shape, np.shape(params["weight"]))
+        params["weight"] = w
+    if init_bias is not None:
+        params["bias"] = np.asarray(init_bias, np.float32)
+    return params
+
+
+class Linear(_nn.Linear):
+    """pyspark signature (pyspark/bigdl/nn/layer.py:905 Linear.__init__):
+    regularizers accepted and recorded, init_weight/init_bias installed."""
+
+    def __init__(self, input_size, output_size, with_bias=True,
+                 wRegularizer=None, bRegularizer=None, init_weight=None,
+                 init_bias=None, init_grad_weight=None, init_grad_bias=None,
+                 bigdl_type="float", name=None):
+        super().__init__(input_size, output_size, with_bias=with_bias,
+                         name=name)
+        self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
+        self._compat_inits = (init_weight, init_bias)
+
+    def setup(self, rng, input_spec):
+        p, s = super().setup(rng, input_spec)
+        return _install_inits(p, *self._compat_inits), s
+
+
+class SpatialConvolution(_nn.SpatialConvolution):
+    """pyspark signature (layer.py:1373): NCHW default, regularizers/init
+    tensors accepted.  init_weight follows the reference layout
+    (nGroup, out/g, in/g, kH, kW) and converts to our HWIO."""
+
+    def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+                 stride_w=1, stride_h=1, pad_w=0, pad_h=0, n_group=1,
+                 propagate_back=True, wRegularizer=None, bRegularizer=None,
+                 init_weight=None, init_bias=None, init_grad_weight=None,
+                 init_grad_bias=None, with_bias=True, data_format="NCHW",
+                 bigdl_type="float", name=None):
+        super().__init__(n_input_plane, n_output_plane, kernel_w, kernel_h,
+                         stride_w, stride_h, pad_w, pad_h, n_group=n_group,
+                         with_bias=with_bias, data_format=data_format,
+                         name=name)
+        self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
+        self._compat_inits = (init_weight, init_bias)
+
+    @staticmethod
+    def _to_hwio(w):
+        w = np.asarray(w, np.float32)
+        if w.ndim == 5:              # (g, out/g, in/g, kH, kW) -> HWIO
+            g, og, ig, kh, kw = w.shape
+            return w.transpose(3, 4, 2, 0, 1).reshape(kh, kw, ig, g * og)
+        if w.ndim == 4:              # (out, in, kH, kW) -> HWIO
+            return w.transpose(2, 3, 1, 0)
+        return w
+
+    def setup(self, rng, input_spec):
+        p, s = super().setup(rng, input_spec)
+        iw, ib = self._compat_inits
+        if iw is not None:
+            p["weight"] = self._to_hwio(iw)
+        if ib is not None:
+            p["bias"] = np.asarray(ib, np.float32)
+        return p, s
+
+    def set_weights(self, weights):
+        """Reference weight layout (out, in, kH, kW) or grouped 5-D."""
+        ws = list(weights)
+        if ws:
+            ws[0] = self._to_hwio(ws[0])
+        return super().set_weights(ws)
+
+    def get_weights(self):
+        ws = super().get_weights()
+        if ws:
+            ws[0] = ws[0].transpose(3, 2, 0, 1)   # HWIO -> (out, in, kH, kW)
+        return ws
+
+
+class SpatialMaxPooling(_nn.SpatialMaxPooling):
+    """pyspark signature: kw, kh, dw, dh order and NCHW default."""
+
+    def __init__(self, kw, kh, dw=1, dh=1, pad_w=0, pad_h=0, to_ceil=False,
+                 format="NCHW", bigdl_type="float", name=None):
+        super().__init__(kw, kh, dw, dh, pad_w, pad_h, ceil_mode=to_ceil,
+                         data_format=format, name=name)
+
+
+class SpatialAveragePooling(_nn.SpatialAveragePooling):
+    def __init__(self, kw, kh, dw=1, dh=1, pad_w=0, pad_h=0,
+                 global_pooling=False, ceil_mode=False,
+                 count_include_pad=True, divide=True, format="NCHW",
+                 bigdl_type="float", name=None):
+        if not divide:
+            raise NotImplementedError(
+                "SpatialAveragePooling(divide=False) (sum pooling) is not "
+                "supported")
+        super().__init__(kw, kh, dw, dh, pad_w, pad_h, ceil_mode=ceil_mode,
+                         count_include_pad=count_include_pad,
+                         data_format=format, name=name)
+        self._global_pooling = global_pooling
+
+    def setup(self, rng, input_spec):
+        if self._global_pooling:
+            # reference semantics: the kernel covers the whole feature map
+            if self.data_format == "NCHW":
+                h, w = input_spec.shape[2], input_spec.shape[3]
+            else:
+                h, w = input_spec.shape[1], input_spec.shape[2]
+            self.kernel = (h, w)
+            self.stride = (h, w)
+            self.pad = (0, 0)
+        return super().setup(rng, input_spec)
+
+
+class SpatialBatchNormalization(_nn.SpatialBatchNormalization):
+    """pyspark SpatialBatchNormalization operates on NCHW input; ours is
+    channels-last -- transpose at the module boundary."""
+
+    def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
+                 init_weight=None, init_bias=None, init_grad_weight=None,
+                 init_grad_bias=None, data_format="NCHW",
+                 bigdl_type="float", name=None):
+        super().__init__(n_output, eps, momentum, affine, name=name)
+        self._compat_format = data_format
+        self._compat_inits = (init_weight, init_bias)
+
+    def setup(self, rng, input_spec):
+        spec = input_spec
+        if self._compat_format == "NCHW":
+            import jax
+
+            n, c, h, w = spec.shape
+            spec = jax.ShapeDtypeStruct((n, h, w, c), spec.dtype)
+        p, s = super().setup(rng, spec)
+        return _install_inits(p, *self._compat_inits), s
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax.numpy as jnp
+
+        if self._compat_format == "NCHW":
+            x = jnp.transpose(input, (0, 2, 3, 1))
+            y, state = super().apply(params, state, x, training=training,
+                                     rng=rng)
+            return jnp.transpose(y, (0, 3, 1, 2)), state
+        return super().apply(params, state, input, training=training,
+                             rng=rng)
+
+
+class Select(_nn.Select):
+    """1-based dim and index (pyspark layer.py:1547)."""
+
+    def __init__(self, dim, index, bigdl_type="float", name=None):
+        super().__init__(_dim(dim), _dim(index), name=name)
+
+
+class Narrow(_nn.Narrow):
+    def __init__(self, dimension, offset, length=1, bigdl_type="float",
+                 name=None):
+        super().__init__(_dim(dimension), _dim(offset), length, name=name)
+
+
+class JoinTable(_nn.JoinTable):
+    def __init__(self, dimension, n_input_dims=-1, bigdl_type="float",
+                 name=None):
+        super().__init__(_dim(dimension), name=name)
+
+
+class Concat(_nn.Concat):
+    def __init__(self, dimension, bigdl_type="float", name=None):
+        super().__init__(_dim(dimension), name=name)
+
+
+class SelectTable(_nn.SelectTable):
+    def __init__(self, index, bigdl_type="float", name=None):
+        super().__init__(_dim(index), name=name)
+
+
+class Squeeze(_nn.Squeeze):
+    def __init__(self, dim=None, num_input_dims=-2147483648,
+                 bigdl_type="float", name=None):
+        super().__init__(None if dim is None else _dim(dim), name=name)
+
+
+class Unsqueeze(_nn.Unsqueeze):
+    def __init__(self, pos, num_input_dims=-2147483648, bigdl_type="float",
+                 name=None):
+        super().__init__(_dim(pos), name=name)
+
+
+class Sum(_nn.Sum):
+    def __init__(self, dimension=1, n_input_dims=-1, size_average=False,
+                 squeeze=True, bigdl_type="float", name=None):
+        super().__init__(_dim(dimension), squeeze, size_average, name=name)
+
+
+class Mean(_nn.Mean):
+    def __init__(self, dimension=1, n_input_dims=-1, squeeze=True,
+                 bigdl_type="float", name=None):
+        super().__init__(_dim(dimension), squeeze, name=name)
+
+
+class Max(_nn.Max):
+    def __init__(self, dim=1, num_input_dims=-2147483648,
+                 bigdl_type="float", name=None):
+        super().__init__(_dim(dim), name=name)
+
+
+class Min(_nn.Min):
+    def __init__(self, dim=1, num_input_dims=-2147483648,
+                 bigdl_type="float", name=None):
+        super().__init__(_dim(dim), name=name)
+
+
+class SplitTable(_nn.SplitTable):
+    def __init__(self, dimension, n_input_dims=-1, bigdl_type="float",
+                 name=None):
+        super().__init__(_dim(dimension), name=name)
+
+
+class Transpose(_nn.Transpose):
+    """pyspark passes 1-based (dim1, dim2) swap pairs."""
+
+    def __init__(self, permutations, bigdl_type="float", name=None):
+        super().__init__([(_dim(a), _dim(b)) for a, b in permutations],
+                         name=name)

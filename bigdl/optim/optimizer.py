@@ -15,7 +15,23 @@ from bigdl_tpu import optim as _optim
 from bigdl_tpu.optim import Trigger as _Trigger
 
 # OptimMethods (constructor args follow the reference pyspark signatures)
-SGD = _optim.SGD
+def SGD(learningrate=1e-3, learningrate_decay=0.0, weightdecay=0.0,
+        momentum=0.0, dampening=None, nesterov=False,
+        leaningrate_schedule=None, learningrates=None, weightdecays=None,
+        bigdl_type="float", **kw):
+    """pyspark SGD signature adapter (pyspark/bigdl/optim/optimizer.py SGD:
+    `learningrate` etc. in one word) onto bigdl_tpu.optim.SGD."""
+    if learningrates is not None or weightdecays is not None:
+        raise NotImplementedError(
+            "per-parameter learningrates/weightdecays are not supported; "
+            "use set_optim_methods per submodule instead")
+    return _optim.SGD(
+        learning_rate=kw.pop("learning_rate", learningrate),
+        learning_rate_decay=learningrate_decay,
+        weight_decay=weightdecay, momentum=momentum,
+        dampening=momentum if dampening is None else dampening,
+        nesterov=nesterov, learning_rate_schedule=leaningrate_schedule,
+        **kw)
 Adam = _optim.Adam
 Adagrad = _optim.Adagrad
 Adadelta = _optim.Adadelta
@@ -98,6 +114,11 @@ class Optimizer:
                  one_based_labels="auto"):
         from bigdl_tpu.optim import LocalOptimizer
         self._one_based = one_based_labels
+        if hasattr(criterion, "_targets_already_zero_based"):
+            # the Optimizer owns the label policy: either the dataset-level
+            # shift below normalises labels, or the user declared them
+            # 0-based -- either way the criterion must not shift again
+            criterion._targets_already_zero_based = True
         self._opt = LocalOptimizer(
             model, _to_dataset(training_rdd, batch_size, one_based_labels),
             criterion, optim_method or SGD())
@@ -156,6 +177,8 @@ class DistriOptimizer(Optimizer):
                  one_based_labels="auto"):
         from bigdl_tpu.optim import DistriOptimizer as _D
         self._one_based = one_based_labels
+        if hasattr(criterion, "_targets_already_zero_based"):
+            criterion._targets_already_zero_based = True
         self._opt = _D(model,
                        _to_dataset(training_rdd, batch_size,
                                    one_based_labels),

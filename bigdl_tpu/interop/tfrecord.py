@@ -1,0 +1,218 @@
+"""TFRecord file I/O + tf.Example parsing.
+
+Reference: utils/tf/TFRecordInputFormat.scala / TFRecordOutputFormat.scala
+(Hadoop input/output formats over the TFRecord framing) and
+utils/tf/TFRecordIterator.java.  Framing per record:
+
+    uint64 LE  length
+    uint32 LE  masked crc32c(length bytes)
+    byte[length] payload (usually a serialized tf.Example)
+    uint32 LE  masked crc32c(payload)
+
+The Example protobuf is parsed with a minimal hand-rolled proto reader
+(wire format only: field 1 = features map<string, Feature>, Feature oneof
+bytes_list/float_list/int64_list) so no tensorflow dependency is needed --
+the schema restates the public tensorflow/core/example/example.proto.
+"""
+
+import struct
+
+import numpy as np
+
+from bigdl_tpu.visualization.tensorboard import _masked_crc
+
+
+class TFRecordReader:
+    """Iterate payload bytes from a TFRecord file (crc-checked)."""
+
+    def __init__(self, path, check_crc=True):
+        self.path = path
+        self.check_crc = check_crc
+
+    def __iter__(self):
+        with open(self.path, "rb") as f:
+            while True:
+                head = f.read(8)
+                if len(head) < 8:
+                    return
+                (length,) = struct.unpack("<Q", head)
+                (len_crc,) = struct.unpack("<I", f.read(4))
+                if self.check_crc and _masked_crc(head) != len_crc:
+                    raise ValueError(
+                        f"{self.path}: corrupt length crc at offset "
+                        f"{f.tell() - 12}")
+                payload = f.read(length)
+                if len(payload) < length:
+                    raise ValueError(f"{self.path}: truncated record")
+                (data_crc,) = struct.unpack("<I", f.read(4))
+                if self.check_crc and _masked_crc(payload) != data_crc:
+                    raise ValueError(
+                        f"{self.path}: corrupt payload crc")
+                yield payload
+
+
+class TFRecordWriter:
+    """Write payload bytes with TFRecord framing."""
+
+    def __init__(self, path):
+        self._f = open(path, "wb")
+
+    def write(self, payload: bytes):
+        head = struct.pack("<Q", len(payload))
+        self._f.write(head)
+        self._f.write(struct.pack("<I", _masked_crc(head)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+# --------------------------------------------------------------------------- #
+# minimal proto wire reader/writer for tf.Example
+# --------------------------------------------------------------------------- #
+
+
+def _read_varint(buf, pos):
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _write_varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value_bytes_or_int)."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:          # varint
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:        # 64-bit
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 2:        # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:        # 32-bit
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def _parse_feature(buf):
+    """Feature: oneof {1: BytesList, 2: FloatList, 3: Int64List}."""
+    for field, _, val in _fields(buf):
+        items = []
+        if field == 1:       # BytesList: repeated bytes value = 1
+            for f2, _, v2 in _fields(val):
+                if f2 == 1:
+                    items.append(bytes(v2))
+            return items
+        if field == 2:       # FloatList: repeated float value = 1 (packed)
+            for f2, wt2, v2 in _fields(val):
+                if f2 == 1:
+                    if wt2 == 2:
+                        items.extend(np.frombuffer(v2, "<f4").tolist())
+                    else:
+                        items.append(struct.unpack("<f", v2)[0])
+            return np.asarray(items, np.float32)
+        if field == 3:       # Int64List: repeated int64 value = 1 (packed)
+            for f2, wt2, v2 in _fields(val):
+                if f2 == 1:
+                    if wt2 == 2:
+                        p = 0
+                        while p < len(v2):
+                            n, p = _read_varint(v2, p)
+                            items.append(n - (1 << 64) if n >= 1 << 63
+                                         else n)
+                    else:
+                        items.append(v2 - (1 << 64) if v2 >= 1 << 63
+                                     else v2)
+            return np.asarray(items, np.int64)
+    return []
+
+
+def parse_example(payload: bytes):
+    """Serialized tf.Example -> dict name -> list[bytes] | float32 array |
+    int64 array (the ParseExample analogue, utils/tf/loaders usage)."""
+    out = {}
+    for field, _, val in _fields(payload):
+        if field != 1:       # Example.features
+            continue
+        for f2, _, feat_entry in _fields(val):
+            if f2 != 1:      # Features.feature map entry
+                continue
+            name, feature = None, None
+            for f3, _, v3 in _fields(feat_entry):
+                if f3 == 1:
+                    name = v3.decode()
+                elif f3 == 2:
+                    feature = _parse_feature(v3)
+            if name is not None:
+                out[name] = feature
+    return out
+
+
+def _encode_feature(value):
+    if isinstance(value, (bytes, bytearray)):
+        value = [bytes(value)]
+    if isinstance(value, (list, tuple)) and value \
+            and isinstance(value[0], (bytes, bytearray)):
+        inner = b"".join(
+            _write_varint((1 << 3) | 2) + _write_varint(len(v)) + bytes(v)
+            for v in value)
+        body = _write_varint((1 << 3) | 2) + _write_varint(len(inner)) + inner
+        return body                      # Feature.bytes_list = 1
+    arr = np.asarray(value)
+    if np.issubdtype(arr.dtype, np.integer):
+        inner = b"".join(_write_varint(int(v) & ((1 << 64) - 1))
+                         for v in arr.ravel())
+        packed = _write_varint((1 << 3) | 2) + _write_varint(len(inner)) \
+            + inner
+        return _write_varint((3 << 3) | 2) + _write_varint(len(packed)) \
+            + packed                     # Feature.int64_list = 3
+    data = arr.astype("<f4").tobytes()
+    packed = _write_varint((1 << 3) | 2) + _write_varint(len(data)) + data
+    return _write_varint((2 << 3) | 2) + _write_varint(len(packed)) \
+        + packed                         # Feature.float_list = 2
+
+
+def build_example(features: dict) -> bytes:
+    """dict -> serialized tf.Example (inverse of parse_example)."""
+    entries = b""
+    for name, value in features.items():
+        nb = name.encode()
+        feat = _encode_feature(value)
+        entry = (_write_varint((1 << 3) | 2) + _write_varint(len(nb)) + nb
+                 + _write_varint((2 << 3) | 2) + _write_varint(len(feat))
+                 + feat)
+        entries += (_write_varint((1 << 3) | 2)
+                    + _write_varint(len(entry)) + entry)
+    return _write_varint((1 << 3) | 2) + _write_varint(len(entries)) \
+        + entries

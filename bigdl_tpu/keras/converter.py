@@ -133,7 +133,8 @@ def _norm_config(class_name, cfg):
     if class_name in _K2_MERGE_MODE:
         out["mode"] = _K2_MERGE_MODE[class_name]
         if class_name == "Concatenate":
-            out["concat_axis"] = cfg.pop("axis", -1)
+            # mv("axis") above already moved the key into out
+            out["concat_axis"] = out.pop("axis", cfg.pop("axis", -1))
     return name, out
 
 
@@ -288,7 +289,7 @@ _BUILDERS = {
         else KL.ReLUVariant(c.get("max_value"),
                             c.get("negative_slope", 0.0),
                             c.get("threshold", 0.0))),
-    "Softmax": lambda c: KL.SoftMax(),
+    "Softmax": lambda c: KL.SoftMax(axis=c.get("axis", -1)),
 }
 
 

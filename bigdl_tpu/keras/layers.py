@@ -251,7 +251,8 @@ class Masking(KerasLayer):
 
 
 class Highway(KerasLayer):
-    def __init__(self, activation="tanh", bias=True, input_shape=None,
+    # keras-1 Highway defaults to a LINEAR transform branch
+    def __init__(self, activation=None, bias=True, input_shape=None,
                  name=None):
         super().__init__(input_shape, name)
         self._act_name = activation
@@ -977,8 +978,12 @@ class ThresholdedReLU(KerasLayer):
 
 
 class SoftMax(KerasLayer):
+    def __init__(self, axis=-1, input_shape=None, name=None, **_):
+        super().__init__(input_shape, name)
+        self.axis = axis
+
     def _build_labor(self, spec):
-        return nn.SoftMax()
+        return nn.SoftMax(axis=self.axis)
 
 
 class GaussianDropout(KerasLayer):

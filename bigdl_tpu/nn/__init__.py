@@ -91,6 +91,11 @@ from bigdl_tpu.nn.criterion_extras import (
     ClassSimplexCriterion,
 )
 
+from bigdl_tpu.nn.control_flow import (  # noqa: E402
+    DynamicGraph, Merge, Switch, WhileLoop, on_branch,
+)
+from bigdl_tpu.nn.multibox_loss import MultiBoxCriterion  # noqa: E402
+
 # reference-name aliases (the underlying class covers the same surface)
 from bigdl_tpu.nn.recurrent import RnnCell as RNN  # noqa: E402
 from bigdl_tpu.nn.graph import Graph as StaticGraph  # noqa: E402

@@ -46,10 +46,14 @@ class Sigmoid(_Elementwise):
 
 
 class SoftMax(Module):
-    """Softmax over the last dimension (reference: nn/SoftMax.scala)."""
+    """Softmax over ``axis`` (default last; reference: nn/SoftMax.scala)."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__(name)
+        self.axis = axis
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        return jax.nn.softmax(input, axis=-1), state
+        return jax.nn.softmax(input, axis=self.axis), state
 
 
 class SoftMin(Module):

@@ -1,0 +1,171 @@
+"""Graph-level control flow: the DynamicGraph/ControlOps analogue.
+
+Reference: nn/DynamicGraph.scala:28 executes breadth-first with a Scheduler,
+and nn/FrameManager.scala:31 + nn/tf/ControlOps.scala (Switch/Merge/Enter/
+Exit/NextIteration) implement TF-style data-dependent control flow by
+scheduling only the live branch at runtime.
+
+TPU-native redesign: under XLA everything is one traced program, so there is
+no scheduler to skip dead branches -- data-dependent control flow lowers to
+``lax.cond`` (conditional diamond) and ``lax.while_loop`` (frames).  The
+API keeps the reference's graph-construction surface:
+
+    s = Switch()(data_node, pred_node)          # -> (false_out, true_out)
+    a = SomeModule()(s.true_edge())
+    b = OtherModule()(s.false_edge())
+    out = Merge()(a, b)
+    model = DynamicGraph([inputs], [out])       # lowers diamond to lax.cond
+
+    loop = WhileLoop(cond_graph, body_graph)    # lax.while_loop module
+
+Semantic difference, by design: the reference executes ONLY the taken
+branch; XLA traces BOTH branches and selects (lax.cond executes one branch
+on device, but both must be traceable with the same output structure).
+"""
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.graph import Graph, Node
+from bigdl_tpu.nn.module import Container, Module, child_rng
+
+
+class Switch(Module):
+    """(data, pred) -> (false_out, true_out) (reference: ControlOps.scala:65
+    SwitchOps -- output 1 is the false branch, output 2 the true branch).
+
+    Under XLA both outputs carry the data; the selection happens at the
+    matching Merge (lax.cond/select), not by scheduling.
+    """
+
+    def __call__(self, data: Node, pred: Node) -> "SwitchNode":
+        node = SwitchNode(self, [data, pred])
+        return node
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        data, pred = input
+        return (data, pred), state
+
+
+class SwitchNode(Node):
+    """Node wrapper exposing false/true edges (reference:
+    SwitchControlNode.availableNodes)."""
+
+    def false_edge(self) -> Node:
+        return Node(_SwitchBranch(False), [self])
+
+    def true_edge(self) -> Node:
+        return Node(_SwitchBranch(True), [self])
+
+
+class _SwitchBranch(Module):
+    def __init__(self, taken: bool, name=None):
+        super().__init__(name)
+        self.taken = taken
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        data, pred = input
+        return (data, pred, jnp.asarray(self.taken)), state
+
+
+class Merge(Module):
+    """Join the two arms of a Switch diamond (reference: ControlOps.scala:87
+    MergeOps passes through whichever input arrived; here: select on the
+    predicate that the Switch threaded through the arms)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        (a, pred_a, taken_a), (b, _pred_b, _taken_b) = input
+        # arm outputs carry (value, pred, arm_polarity); pick by predicate
+        pred = jnp.reshape(pred_a, ()).astype(bool)
+        first_if = jnp.asarray(taken_a, bool)
+        pick_a = jnp.where(pred, first_if, ~first_if)
+        return jax.tree.map(
+            lambda x, y: jnp.where(pick_a, x, y), a, b), state
+
+
+class _Passthrough(Module):
+    """Keeps the (value, pred, polarity) triple through a module applied to
+    a switch arm: applies the wrapped module to the value only."""
+
+    def __init__(self, inner, name=None):
+        super().__init__(name)
+        self.inner = inner
+
+    def setup(self, rng, input_spec):
+        val_spec = input_spec[0]
+        return self.inner.setup(rng, val_spec)
+
+    def children(self):
+        return [self.inner]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        val, pred, taken = input
+        out, state = self.inner.apply(params, state, val,
+                                      training=training, rng=rng)
+        return (out, pred, taken), state
+
+
+def on_branch(module: Module, arm: Node) -> Node:
+    """Apply ``module`` to a switch arm, threading the control triple."""
+    return Node(_Passthrough(module), [arm])
+
+
+class DynamicGraph(Graph):
+    """Graph that accepts Switch/Merge nodes (reference: DynamicGraph.scala
+    schedules them; here they trace to select/cond -- the only difference
+    from Graph is the construction sugar, since under jit static topology +
+    lax select IS dynamic execution)."""
+
+
+class WhileLoop(Module):
+    """lax.while_loop over loop-carried values, with condition and body
+    given as Graphs over those values (reference: tf while frames --
+    Enter/Merge/LoopCond/Switch/NextIteration/Exit,
+    nn/tf/ControlOps.scala:182-240).
+
+    cond_graph: Graph mapping the N loop vars -> boolean scalar.
+    body_graph: Graph mapping the N loop vars -> N updated vars.
+    apply input: tuple of N initial values -> tuple of N final values.
+    """
+
+    def __init__(self, cond_graph: Graph, body_graph: Graph, name=None):
+        super().__init__(name)
+        self.cond_graph = cond_graph
+        self.body_graph = body_graph
+
+    def children(self):
+        return [self.cond_graph, self.body_graph]
+
+    def setup(self, rng, input_spec):
+        spec = input_spec if isinstance(input_spec, tuple) else (input_spec,)
+        cp, cs = self.cond_graph.setup(
+            child_rng(rng, 0), spec if len(spec) > 1 else spec[0])
+        bp, bs = self.body_graph.setup(
+            child_rng(rng, 1), spec if len(spec) > 1 else spec[0])
+        return {"cond": cp, "body": bp}, {"cond": cs, "body": bs}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        init = input if isinstance(input, tuple) else (input,)
+        single = not isinstance(input, tuple)
+
+        def cond_fn(vs):
+            out, _ = self.cond_graph.apply(
+                params["cond"], state["cond"], vs[0] if single else vs,
+                training=False, rng=None)
+            return jnp.reshape(out, ()).astype(bool)
+
+        def body_fn(vs):
+            out, _ = self.body_graph.apply(
+                params["body"], state["body"], vs[0] if single else vs,
+                training=False, rng=None)
+            out = out if isinstance(out, tuple) else (out,)
+            # keep carried dtypes/shapes stable across iterations
+            return tuple(jnp.asarray(o).astype(v.dtype)
+                         for o, v in zip(out, vs))
+
+        final = lax.while_loop(cond_fn, body_fn,
+                               tuple(jnp.asarray(v) for v in init))
+        return (final[0] if single else final), state

@@ -40,10 +40,11 @@ def Input(name=None) -> Node:
 class Graph(Container):
     """Static DAG executed in topological order (reference: nn/StaticGraph.scala:38)."""
 
-    def __init__(self, inputs, outputs, name=None):
+    def __init__(self, inputs, outputs, name=None, allow_unused=False):
         super().__init__(name)
         self.input_nodes = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         self.output_nodes = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        self.allow_unused = allow_unused
         self._topo = self._topo_sort()
         for node in self._topo:
             if node.module is not None:
@@ -68,7 +69,7 @@ class Graph(Container):
         for out in self.output_nodes:
             visit(out)
         for inp in self.input_nodes:
-            if id(inp) not in seen:
+            if id(inp) not in seen and not self.allow_unused:
                 raise ValueError("An input node is not connected to any output")
         return order
 

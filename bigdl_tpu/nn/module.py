@@ -125,6 +125,12 @@ class Module:
         self._build_spec = input_spec     # recorded for serialization
         self._params, self._state = self.setup(rng, input_spec)
         self._grads = None
+        pending = getattr(self, "_pending_weights", None)
+        if pending is not None:
+            self._pending_weights = None
+            # install directly: any layout conversion already happened in
+            # the (possibly overridden) set_weights that stored them
+            self._install_weight_list(pending)
         return self
 
     def _ensure_built(self, input: Activity):
@@ -169,6 +175,65 @@ class Module:
 
     def set_parameters(self, params: Params):
         self._params = params
+
+    # weight-list accessors (reference: Layer.get_weights/set_weights in
+    # pyspark/bigdl/nn/layer.py:478-508 -- flat [weight, bias, ...] arrays
+    # in layer traversal order)
+    def _weight_leaves(self):
+        """[(dict, key)] of param leaves, weight-before-bias per dict."""
+        order = {"weight": 0, "bias": 1}
+        found = []
+
+        def walk(t):
+            if isinstance(t, dict):
+                for k in sorted(t, key=lambda k: (order.get(k, 2), k)):
+                    v = t[k]
+                    if isinstance(v, (dict, tuple, list)):
+                        walk(v)
+                    elif hasattr(v, "shape"):
+                        found.append((t, k))
+            elif isinstance(t, (tuple, list)):
+                for v in t:
+                    walk(v)
+        walk(self._params)
+        return found
+
+    def get_weights(self):
+        if not self.is_built():
+            return []
+        import numpy as np
+
+        return [np.asarray(d[k]) for d, k in self._weight_leaves()]
+
+    def set_weights(self, weights):
+        """Install a flat weight list.  Before build, the arrays are kept
+        pending and installed when build() runs (the pyspark API sets
+        weights on eagerly-constructed layers)."""
+        import numpy as np
+
+        if not self.is_built():
+            self._pending_weights = [np.asarray(w) for w in weights]
+            return self
+        return self._install_weight_list(weights)
+
+    def _install_weight_list(self, weights):
+        leaves = self._weight_leaves()
+        if len(leaves) != len(weights):
+            raise ValueError(
+                f"set_weights: {len(weights)} arrays for {len(leaves)} "
+                f"parameter tensors")
+        import numpy as np
+
+        # the (dict, key) handles returned above are the live dicts
+        for (d, k), w in zip(leaves, weights):
+            w = np.asarray(w, np.float32)
+            want = tuple(d[k].shape)
+            if w.shape != want:
+                raise ValueError(
+                    f"set_weights: shape {w.shape} != expected {want} "
+                    f"for '{k}'")
+            d[k] = jnp.asarray(w)
+        return self
 
     def get_parameters(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Flat (weights, grads) 1-D views (reference: getParameters).

@@ -1,0 +1,124 @@
+"""SSD MultiBox training criterion.
+
+The reference trains SSD via its model-zoo MultiBoxLoss (the in-tree nn/
+package ships the inference heads: PriorBox nn/PriorBox.scala:43,
+DetectionOutputSSD); this provides the training-side counterpart so the
+detection path is trainable end-to-end, jit-compatible on TPU:
+
+- static shapes: gt comes padded to (B, M, 5) rows [label, x1, y1, x2, y2]
+  (label < 0 marks padding), priors (P, 4) corner form, predictions
+  loc (B, P, 4) offsets + conf (B, P, C) logits;
+- matching (bipartite-ish, vectorised): priors with IoU > threshold to any
+  gt are positive, plus each gt's best prior is forced positive;
+- loc loss: smooth-L1 on SSD-encoded offsets (center/size with variances
+  0.1/0.2) over positives;
+- conf loss: softmax CE over positives + hard-negative mining at
+  ``neg_pos_ratio`` (3:1 default) -- top-k implemented with a static sort.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Criterion
+
+
+def _iou(priors, boxes):
+    """(P, 4) x (M, 4) corner boxes -> (P, M) IoU."""
+    px1, py1, px2, py2 = [priors[:, i:i + 1] for i in range(4)]
+    gx1, gy1, gx2, gy2 = [boxes[None, :, i] for i in range(4)]
+    ix1 = jnp.maximum(px1, gx1)
+    iy1 = jnp.maximum(py1, gy1)
+    ix2 = jnp.minimum(px2, gx2)
+    iy2 = jnp.minimum(py2, gy2)
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    pa = jnp.clip(px2 - px1, 0) * jnp.clip(py2 - py1, 0)
+    ga = jnp.clip(gx2 - gx1, 0) * jnp.clip(gy2 - gy1, 0)
+    union = pa + ga - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode(matched, priors, variances=(0.1, 0.2)):
+    """gt corner boxes matched per prior -> SSD regression targets."""
+    pcx = (priors[:, 0] + priors[:, 2]) / 2
+    pcy = (priors[:, 1] + priors[:, 3]) / 2
+    pw = jnp.clip(priors[:, 2] - priors[:, 0], 1e-6)
+    ph = jnp.clip(priors[:, 3] - priors[:, 1], 1e-6)
+    gcx = (matched[:, 0] + matched[:, 2]) / 2
+    gcy = (matched[:, 1] + matched[:, 3]) / 2
+    gw = jnp.clip(matched[:, 2] - matched[:, 0], 1e-6)
+    gh = jnp.clip(matched[:, 3] - matched[:, 1], 1e-6)
+    return jnp.stack([
+        (gcx - pcx) / pw / variances[0],
+        (gcy - pcy) / ph / variances[0],
+        jnp.log(gw / pw) / variances[1],
+        jnp.log(gh / ph) / variances[1],
+    ], axis=-1)
+
+
+def _smooth_l1(x):
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+class MultiBoxCriterion(Criterion):
+    """loss((loc (B,P,4), conf (B,P,C)), (priors (P,4), gt (B,M,5)))."""
+
+    def __init__(self, num_classes, overlap_threshold=0.5,
+                 neg_pos_ratio=3.0, background_label=0, loc_weight=1.0):
+        self.num_classes = num_classes
+        self.threshold = overlap_threshold
+        self.neg_pos_ratio = neg_pos_ratio
+        self.background = background_label
+        self.loc_weight = loc_weight
+
+    def apply(self, output, target):
+        loc, conf = output
+        priors, gt = target
+
+        def one(loc_i, conf_i, gt_i):
+            labels = gt_i[:, 0]
+            boxes = gt_i[:, 1:5]
+            valid = labels >= 0                        # (M,)
+            iou = _iou(priors, boxes) * valid[None, :]  # (P, M)
+            best_gt = jnp.argmax(iou, axis=1)          # (P,)
+            best_iou = jnp.max(iou, axis=1)
+            # force each valid gt's best prior to match it -- scatter-MAX so
+            # a padding row (argmax over its all-zero column = prior 0)
+            # cannot clobber a valid gt's forced positive at the same index
+            best_prior = jnp.argmax(iou, axis=0)       # (M,)
+            m = gt_i.shape[0]
+            forced = jnp.zeros_like(best_iou).at[best_prior].max(
+                jnp.where(valid, 2.0, 0.0))
+            best_gt = best_gt.at[best_prior].set(
+                jnp.where(valid, jnp.arange(m), best_gt[best_prior]))
+            pos = (best_iou > self.threshold) | (forced > 1.0)
+
+            matched_boxes = boxes[best_gt]
+            matched_labels = jnp.where(
+                pos, labels[best_gt].astype(jnp.int32), self.background)
+
+            # localization
+            t = _encode(matched_boxes, priors)
+            l_loss = jnp.sum(
+                _smooth_l1(loc_i - t).sum(-1) * pos.astype(loc_i.dtype))
+
+            # confidence with hard negative mining
+            logp = jax.nn.log_softmax(conf_i, axis=-1)
+            ce = -jnp.take_along_axis(
+                logp, matched_labels[:, None], axis=-1)[:, 0]
+            n_pos = jnp.sum(pos)
+            n_neg = jnp.minimum(
+                (self.neg_pos_ratio * n_pos).astype(jnp.int32),
+                jnp.asarray(pos.shape[0], jnp.int32))
+            neg_score = jnp.where(pos, -jnp.inf,
+                                  -logp[:, self.background])
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros_like(order).at[order].set(
+                jnp.arange(order.shape[0]))
+            neg = (~pos) & (rank < n_neg)
+            c_loss = jnp.sum(ce * (pos | neg).astype(ce.dtype))
+            return l_loss, c_loss, n_pos
+
+        l_loss, c_loss, n_pos = jax.vmap(one)(loc, conf, gt)
+        denom = jnp.maximum(jnp.sum(n_pos).astype(loc.dtype), 1.0)
+        return (self.loc_weight * jnp.sum(l_loss) + jnp.sum(c_loss)) / denom

@@ -225,6 +225,223 @@ class RandomTransformer(FeatureTransformer):
         return feature
 
 
+class Expand(FeatureTransformer):
+    """Place the image on a mean-filled larger canvas at a random offset,
+    recording the inverse boundary box for RoiProject (reference:
+    augmentation/Expand.scala -- SSD zoom-out augmentation)."""
+
+    def __init__(self, means_r=123, means_g=117, means_b=104,
+                 min_expand_ratio=1.0, max_expand_ratio=4.0,
+                 seed: Optional[int] = None):
+        self.means = np.asarray([means_r, means_g, means_b], np.float32)
+        self.min_ratio, self.max_ratio = min_expand_ratio, max_expand_ratio
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        if abs(self.max_ratio - 1.0) < 1e-2:
+            return feature
+        img = feature["image"]
+        h, w = img.shape[:2]
+        ratio = self.rng.uniform(self.min_ratio, self.max_ratio)
+        nh, nw = int(h * ratio), int(w * ratio)
+        h_off = float(np.floor(self.rng.uniform(0, nh - h)))
+        w_off = float(np.floor(self.rng.uniform(0, nw - w)))
+        canvas = np.tile(self.means, (nh, nw, 1)).astype(np.float32)
+        canvas[int(h_off):int(h_off) + h, int(w_off):int(w_off) + w] = img
+        feature["image"] = canvas
+        if "label" in feature:
+            from bigdl_tpu.transform.vision_roi import BoundingBox
+
+            feature["bounding_box"] = BoundingBox(
+                -w_off / w, -h_off / h, (nw - w_off) / w, (nh - h_off) / h)
+        return feature
+
+
+class Filler(FeatureTransformer):
+    """Fill a normalized sub-rectangle with a constant (reference:
+    augmentation/Filler.scala)."""
+
+    def __init__(self, start_x, start_y, end_x, end_y, value=255):
+        self.box = (start_x, start_y, end_x, end_y)
+        self.value = value
+
+    def transform(self, feature):
+        img = feature["image"]
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        img[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
+        feature["image"] = img
+        return feature
+
+
+def _rgb_to_hsv(img):
+    import colorsys  # noqa: F401 (documenting the convention)
+    r, g, b = img[..., 0], img[..., 1], img[..., 2]
+    maxc = img.max(-1)
+    minc = img.min(-1)
+    v = maxc
+    span = np.where(maxc > 0, maxc - minc, 1.0)
+    s = np.where(maxc > 0, (maxc - minc) / np.where(maxc == 0, 1, maxc), 0)
+    rc = (maxc - r) / span
+    gc = (maxc - g) / span
+    bc = (maxc - b) / span
+    h = np.where(maxc == minc, 0.0,
+                 np.where(maxc == r, bc - gc,
+                          np.where(maxc == g, 2.0 + rc - bc,
+                                   4.0 + gc - rc)))
+    h = (h / 6.0) % 1.0
+    return h, s, v
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    conds = [i == k for k in range(6)]
+    r = np.select(conds, [v, q, p, p, t, v])
+    g = np.select(conds, [t, v, v, q, p, p])
+    b = np.select(conds, [p, p, t, v, v, q])
+    return np.stack([r, g, b], -1)
+
+
+class Hue(FeatureTransformer):
+    """Rotate the hue channel by a random angle in degrees (reference:
+    augmentation/Hue.scala -- HSV-space hue shift)."""
+
+    def __init__(self, delta_low=-18.0, delta_high=18.0,
+                 seed: Optional[int] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        img = np.clip(feature["image"], 0, 255).astype(np.float32)
+        scale = 255.0 if img.max() > 1.5 else 1.0
+        h, s, v = _rgb_to_hsv(img / scale)
+        delta = self.rng.uniform(self.low, self.high) / 360.0
+        h = (h + delta) % 1.0
+        feature["image"] = (_hsv_to_rgb(h, s, v) * scale).astype(np.float32)
+        return feature
+
+
+class ChannelOrder(FeatureTransformer):
+    """Randomly permute the color channels (reference:
+    augmentation/ChannelOrder.scala)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        perm = self.rng.permutation(3)
+        feature["image"] = np.ascontiguousarray(feature["image"][..., perm])
+        return feature
+
+
+class ColorJitter(FeatureTransformer):
+    """Brightness/contrast/saturation/hue in random order (reference:
+    augmentation/ColorJitter.scala)."""
+
+    def __init__(self, brightness_prob=0.5, brightness_delta=32,
+                 contrast_prob=0.5, contrast_lower=0.5, contrast_upper=1.5,
+                 saturation_prob=0.5, saturation_lower=0.5,
+                 saturation_upper=1.5, hue_prob=0.5, hue_delta=18,
+                 seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        self.rng = rng
+        self.stages = [
+            RandomTransformer(
+                Brightness(-brightness_delta, brightness_delta,
+                           seed=int(rng.integers(1 << 31))),
+                brightness_prob, seed=int(rng.integers(1 << 31))),
+            RandomTransformer(
+                Contrast(contrast_lower, contrast_upper,
+                         seed=int(rng.integers(1 << 31))),
+                contrast_prob, seed=int(rng.integers(1 << 31))),
+            RandomTransformer(
+                Saturation(saturation_lower, saturation_upper,
+                           seed=int(rng.integers(1 << 31))),
+                saturation_prob, seed=int(rng.integers(1 << 31))),
+            RandomTransformer(
+                Hue(-hue_delta, hue_delta, seed=int(rng.integers(1 << 31))),
+                hue_prob, seed=int(rng.integers(1 << 31))),
+        ]
+
+    def transform(self, feature):
+        for i in self.rng.permutation(len(self.stages)):
+            feature = self.stages[i](feature)
+        return feature
+
+
+class RandomResize(FeatureTransformer):
+    """Resize to a random scale from a list (reference:
+    augmentation/RandomResize.scala)."""
+
+    def __init__(self, min_size, max_size, seed: Optional[int] = None):
+        self.min_size, self.max_size = min_size, max_size
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        size = int(self.rng.integers(self.min_size, self.max_size + 1))
+        return Resize(size, size)(feature)
+
+
+class MTImageFeatureToBatch:
+    """Parallel decode/augment/batch assembler (reference:
+    MTImageFeatureToBatch.scala: multi-threaded ImageFeature -> MiniBatch
+    with fixed output size; detection labels batch as a list of RoiLabels
+    since box counts vary per image)."""
+
+    def __init__(self, width, height, batch_size,
+                 transformer: Optional[FeatureTransformer] = None,
+                 to_rgb=False, extract_roi=False, num_threads=4):
+        import threading
+
+        self.width, self.height = width, height
+        self.batch_size = batch_size
+        self.transformer = transformer
+        self.extract_roi = extract_roi
+        self.num_threads = num_threads
+        # np.random.Generator (inside the random augmentations) is not
+        # thread-safe; the reference clones the transformer per thread
+        # (MTImageFeatureToBatch.scala), here a lock serialises the cheap
+        # augment stage while decode/resize stay parallel
+        self._transform_lock = threading.Lock()
+
+    def _one(self, feature):
+        if self.transformer is not None:
+            with self._transform_lock:
+                feature = self.transformer(feature)
+        img = feature["image"]
+        if img.shape[:2] != (self.height, self.width):
+            img = bilinear_resize(img, self.height, self.width)
+        return img, feature.get("label")
+
+    def __call__(self, features):
+        """iterable of ImageFeature -> yields (images (B,H,W,3), labels)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        batch = []
+        with ThreadPoolExecutor(self.num_threads) as pool:
+            for img, label in pool.map(self._one, features):
+                batch.append((img, label))
+                if len(batch) == self.batch_size:
+                    yield self._assemble(batch)
+                    batch = []
+        if batch:
+            yield self._assemble(batch)
+
+    def _assemble(self, batch):
+        images = np.stack([b[0] for b in batch]).astype(np.float32)
+        labels = [b[1] for b in batch]
+        if self.extract_roi:
+            return images, labels        # list of RoiLabel
+        if all(l is not None and np.ndim(l) == 0 for l in labels):
+            return images, np.asarray(labels)
+        return images, labels
+
+
 class MatToSample(FeatureTransformer):
     """Terminal stage: ImageFeature -> Sample
     (reference: ImageFrameToSample / MatToTensor)."""

@@ -1,0 +1,254 @@
+"""ROI (detection) label transforms + SSD-style crop sampling.
+
+Reference: transform/vision/image/label/roi/ -- RoiLabel.scala (label
+container), RoiTransformer.scala (RoiNormalize/RoiHFlip/RoiResize/
+RoiProject), BatchSampler.scala + RandomSampler.scala (SSD batch-sampled
+crops), and util/BoundingBox.scala.  Host-side numpy throughout (the TPU
+never sees undecoded label plumbing).
+
+Boxes are (N, 4) float32 ``[x1, y1, x2, y2]`` arrays; ``classes`` is
+(N,) or (2, N) (the reference stores difficult-flags in a second row).
+"""
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from bigdl_tpu.transform.vision import FeatureTransformer, ImageFeature
+
+
+@dataclass
+class RoiLabel:
+    """Detection label (reference: label/roi/RoiLabel.scala)."""
+
+    classes: np.ndarray            # (N,) or (2, N) float32
+    bboxes: np.ndarray             # (N, 4) float32 x1,y1,x2,y2
+
+    def size(self) -> int:
+        return int(self.bboxes.shape[0])
+
+    def copy(self) -> "RoiLabel":
+        return RoiLabel(np.array(self.classes), np.array(self.bboxes))
+
+
+@dataclass
+class BoundingBox:
+    """reference: transform/vision/image/util/BoundingBox.scala."""
+
+    x1: float = 0.0
+    y1: float = 0.0
+    x2: float = 1.0
+    y2: float = 1.0
+    normalized: bool = True
+
+    def width(self):
+        return self.x2 - self.x1
+
+    def height(self):
+        return self.y2 - self.y1
+
+    def area(self):
+        return max(self.width(), 0.0) * max(self.height(), 0.0)
+
+    def jaccard_overlap(self, other: "BoundingBox") -> float:
+        ix1, iy1 = max(self.x1, other.x1), max(self.y1, other.y1)
+        ix2, iy2 = min(self.x2, other.x2), min(self.y2, other.y2)
+        iw, ih = max(ix2 - ix1, 0.0), max(iy2 - iy1, 0.0)
+        inter = iw * ih
+        union = self.area() + other.area() - inter
+        return inter / union if union > 0 else 0.0
+
+    def locate(self, box: "BoundingBox") -> "BoundingBox":
+        """Map a [0,1]-space box into this box's coordinate frame
+        (reference: BoundingBox.locateBBox)."""
+        w, h = self.width(), self.height()
+        return BoundingBox(self.x1 + box.x1 * w, self.y1 + box.y1 * h,
+                           self.x1 + box.x2 * w, self.y1 + box.y2 * h)
+
+    def contains_center(self, bbox_row) -> bool:
+        cx = (bbox_row[0] + bbox_row[2]) / 2
+        cy = (bbox_row[1] + bbox_row[3]) / 2
+        return self.x1 <= cx <= self.x2 and self.y1 <= cy <= self.y2
+
+
+def scale_bboxes(bboxes: np.ndarray, scale_h: float, scale_w: float):
+    """In-place scale (reference: BboxUtil.scaleBBox -- x by width scale,
+    y by height scale)."""
+    bboxes[:, 0] *= scale_w
+    bboxes[:, 2] *= scale_w
+    bboxes[:, 1] *= scale_h
+    bboxes[:, 3] *= scale_h
+
+
+class RoiNormalize(FeatureTransformer):
+    """Scale boxes to [0, 1] (reference: RoiTransformer.scala RoiNormalize)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        h, w = feature["image"].shape[:2]
+        label: RoiLabel = feature["label"]
+        scale_bboxes(label.bboxes, 1.0 / h, 1.0 / w)
+        return feature
+
+
+class RoiHFlip(FeatureTransformer):
+    """Mirror boxes horizontally (reference: RoiHFlip)."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        label: RoiLabel = feature["label"]
+        width = 1.0 if self.normalized else feature["image"].shape[1]
+        x1 = width - label.bboxes[:, 0].copy()
+        label.bboxes[:, 0] = width - label.bboxes[:, 2]
+        label.bboxes[:, 2] = x1
+        return feature
+
+
+class RoiResize(FeatureTransformer):
+    """Scale un-normalized boxes by the resize factor (reference: RoiResize)."""
+
+    def __init__(self, normalized: bool = False):
+        self.normalized = normalized
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        if not self.normalized:
+            orig = feature.get("original_size", feature["image"].shape)
+            oh, ow = orig[0], orig[1]
+            h, w = feature["image"].shape[:2]
+            scale_bboxes(feature["label"].bboxes, h / oh, w / ow)
+        return feature
+
+
+class RoiProject(FeatureTransformer):
+    """Project normalized gt boxes onto the image-boundary box stored at
+    feature["bounding_box"], dropping boxes that leave the crop (reference:
+    RoiProject: clip to the boundary, optionally require the box center
+    inside, then re-express in the boundary's frame)."""
+
+    def __init__(self, need_meet_center_constraint: bool = True):
+        self.need_center = need_meet_center_constraint
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        boundary: BoundingBox = feature["bounding_box"]
+        label: RoiLabel = feature["label"]
+        keep, new_boxes = [], []
+        bw, bh = boundary.width(), boundary.height()
+        for i in range(label.size()):
+            row = label.bboxes[i]
+            if self.need_center and not boundary.contains_center(row):
+                continue
+            x1 = max(row[0], boundary.x1)
+            y1 = max(row[1], boundary.y1)
+            x2 = min(row[2], boundary.x2)
+            y2 = min(row[3], boundary.y2)
+            if x2 <= x1 or y2 <= y1:
+                continue
+            keep.append(i)
+            new_boxes.append([(x1 - boundary.x1) / bw,
+                              (y1 - boundary.y1) / bh,
+                              (x2 - boundary.x1) / bw,
+                              (y2 - boundary.y1) / bh])
+        classes = (label.classes[..., keep] if label.classes.ndim > 1
+                   else label.classes[keep])
+        feature["label"] = RoiLabel(
+            np.asarray(classes, np.float32),
+            np.asarray(new_boxes, np.float32).reshape(-1, 4))
+        return feature
+
+
+class BatchSampler:
+    """Sample crop boxes satisfying scale/aspect/overlap constraints
+    (reference: label/roi/BatchSampler.scala)."""
+
+    def __init__(self, max_sample=1, max_trials=50, min_scale=1.0,
+                 max_scale=1.0, min_aspect_ratio=1.0, max_aspect_ratio=1.0,
+                 min_overlap: Optional[float] = None,
+                 max_overlap: Optional[float] = None):
+        assert 0 < min_scale <= max_scale <= 1
+        assert 0 < min_aspect_ratio <= 1 <= max_aspect_ratio
+        self.max_sample = max_sample
+        self.max_trials = max_trials
+        self.min_scale, self.max_scale = min_scale, max_scale
+        self.min_ar, self.max_ar = min_aspect_ratio, max_aspect_ratio
+        self.min_overlap, self.max_overlap = min_overlap, max_overlap
+
+    def _sample_box(self, rng) -> BoundingBox:
+        scale = rng.uniform(self.min_scale, self.max_scale)
+        ratio = rng.uniform(self.min_ar, self.max_ar)
+        ratio = min(max(ratio, scale * scale), 1.0 / scale / scale)
+        w, h = scale * np.sqrt(ratio), scale / np.sqrt(ratio)
+        x1 = rng.uniform(0, 1 - w)
+        y1 = rng.uniform(0, 1 - h)
+        return BoundingBox(x1, y1, x1 + w, y1 + h)
+
+    def _satisfies(self, box: BoundingBox, label: RoiLabel) -> bool:
+        if self.min_overlap is None and self.max_overlap is None:
+            return True
+        for i in range(label.size()):
+            r = label.bboxes[i]
+            o = box.jaccard_overlap(BoundingBox(r[0], r[1], r[2], r[3]))
+            if (self.min_overlap is None or o >= self.min_overlap) and \
+                    (self.max_overlap is None or o <= self.max_overlap):
+                return True
+        return False
+
+    def sample(self, source: BoundingBox, label: RoiLabel,
+               out: List[BoundingBox], rng):
+        found = 0
+        for _ in range(self.max_trials):
+            if found >= self.max_sample:
+                return
+            box = source.locate(self._sample_box(rng))
+            if self._satisfies(box, label):
+                found += 1
+                out.append(box)
+
+
+#: the SSD training sampler set (reference: RandomSampler usage in the
+#: pipeline configs: full image + jaccard thresholds .1/.3/.5/.7/.9 + max)
+SSD_SAMPLERS = [
+    BatchSampler(),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2.0,
+                 min_overlap=0.1),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2.0,
+                 min_overlap=0.3),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2.0,
+                 min_overlap=0.5),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2.0,
+                 min_overlap=0.7),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2.0,
+                 min_overlap=0.9),
+    BatchSampler(min_scale=0.3, min_aspect_ratio=0.5, max_aspect_ratio=2.0,
+                 max_overlap=1.0),
+]
+
+
+class RandomSampler(FeatureTransformer):
+    """Pick one sampled crop, crop the image and project the rois
+    (reference: label/roi/RandomSampler.scala: sample boxes with all
+    samplers, choose one at random, crop + RoiProject)."""
+
+    def __init__(self, samplers: Optional[List[BatchSampler]] = None,
+                 seed: int = 0):
+        self.samplers = samplers if samplers is not None else SSD_SAMPLERS
+        self._rng = np.random.default_rng(seed)
+        self._project = RoiProject(True)
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        label: RoiLabel = feature["label"]
+        unit = BoundingBox(0.0, 0.0, 1.0, 1.0)
+        boxes: List[BoundingBox] = []
+        for s in self.samplers:
+            s.sample(unit, label, boxes, self._rng)
+        if not boxes:
+            return feature
+        pick = boxes[int(self._rng.integers(0, len(boxes)))]
+        img = feature["image"]
+        h, w = img.shape[:2]
+        y1, y2 = int(pick.y1 * h), int(np.ceil(pick.y2 * h))
+        x1, x2 = int(pick.x1 * w), int(np.ceil(pick.x2 * w))
+        feature["image"] = np.ascontiguousarray(img[y1:y2, x1:x2])
+        feature["bounding_box"] = pick
+        return self._project.transform(feature)

@@ -22,10 +22,14 @@ class RandomGenerator:
 
     def __init__(self, seed: int = 1):
         self._lock = threading.Lock()
-        self.set_seed(seed)
+        # LAZY: creating a jax key initialises the XLA backend, which must
+        # not happen at import time (it would break
+        # jax.distributed.initialize in multi-host processes)
+        self._seed = int(seed)
+        self._key = None
 
     def set_seed(self, seed: int) -> "RandomGenerator":
-        with getattr(self, "_lock", threading.Lock()):
+        with self._lock:
             self._seed = int(seed)
             self._key = jax.random.key(self._seed)
         return self
@@ -35,6 +39,8 @@ class RandomGenerator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 

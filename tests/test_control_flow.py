@@ -1,0 +1,204 @@
+"""Dynamic graph / control flow (VERDICT r2 ask #7).
+
+Native API: Switch/Merge conditionals and WhileLoop frames lowering to
+lax select / while_loop (reference: nn/DynamicGraph.scala:28,
+nn/tf/ControlOps.scala).  TF import: a classic tf.while_loop graph
+(Enter/Merge/LoopCond/Switch/NextIteration/Exit, control-flow v1) must
+import and match real TF's execution.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.control_flow import on_branch
+from bigdl_tpu.nn.graph import Input, Node
+
+
+class TestSwitchMerge:
+    def _cond_model(self):
+        data = Input()
+        pred = Input()
+        sw = nn.Switch()(data, pred)
+        true_arm = on_branch(nn.MulConstant(2.0), sw.true_edge())
+        false_arm = on_branch(nn.AddConstant(10.0), sw.false_edge())
+        out = nn.Merge()(true_arm, false_arm)
+        return nn.DynamicGraph([data, pred], [out])
+
+    def test_true_branch(self):
+        m = self._cond_model()
+        x = np.asarray([[1.0, -2.0]], np.float32)
+        y = m.forward((jnp.asarray(x), jnp.asarray(True)))
+        np.testing.assert_allclose(np.asarray(y), x * 2.0)
+
+    def test_false_branch(self):
+        m = self._cond_model()
+        x = np.asarray([[1.0, -2.0]], np.float32)
+        y = m.forward((jnp.asarray(x), jnp.asarray(False)))
+        np.testing.assert_allclose(np.asarray(y), x + 10.0)
+
+    def test_jits_with_traced_pred(self):
+        m = self._cond_model()
+        m.build((jax.ShapeDtypeStruct((1, 2), jnp.float32),
+                 jax.ShapeDtypeStruct((), jnp.bool_)))
+
+        @jax.jit
+        def run(x, p):
+            out, _ = m.apply(m._params, m._state, (x, p))
+            return out
+
+        x = jnp.asarray([[3.0, 4.0]])
+        np.testing.assert_allclose(run(x, jnp.asarray(True)), x * 2.0)
+        np.testing.assert_allclose(run(x, jnp.asarray(False)), x + 10.0)
+
+
+class TestWhileLoop:
+    def test_counted_loop(self):
+        """while i < 10: x = x * 1.5; i += 1"""
+        i_in, x_in = Input(), Input()
+
+        class _Less10(nn.Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                i, x = input
+                return i < 10, state
+
+        class _Step(nn.Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                i, x = input
+                return (i + 1, x * 1.5), state
+
+        ci, cx = Input(), Input()
+        cond_g = nn.StaticGraph([ci, cx], [Node(_Less10(), [ci, cx])])
+        bi, bx = Input(), Input()
+        body_g = nn.StaticGraph([bi, bx], [Node(_Step(), [bi, bx])])
+
+        loop = nn.WhileLoop(cond_g, body_g)
+        out = Node(loop, [i_in, x_in])
+        m = nn.DynamicGraph([i_in, x_in], [out])
+        i0 = jnp.asarray(0, jnp.int32)
+        x0 = jnp.asarray([1.0, 2.0], jnp.float32)
+        fi, fx = m.forward((i0, x0))
+        assert int(fi) == 10
+        np.testing.assert_allclose(np.asarray(fx),
+                                   np.asarray([1.0, 2.0]) * 1.5 ** 10,
+                                   rtol=1e-5)
+
+
+class TestTfCondImport:
+    def test_imported_tf_cond_with_branch_ops(self, tmp_path):
+        """tf.cond whose branches contain real ops (not bare Switch
+        pass-throughs) must lower to lax.cond and match TF."""
+        tf = pytest.importorskip("tensorflow")
+        g = tf.Graph()
+        with g.as_default():
+            tf.compat.v1.disable_control_flow_v2()
+            x = tf.compat.v1.placeholder(tf.float32, (2, 3), name="x")
+            p = tf.compat.v1.placeholder(tf.bool, (), name="p")
+            out = tf.cond(p,
+                          lambda: tf.nn.relu(x) * 3.0 + 1.0,
+                          lambda: tf.tanh(x) - 2.0)
+            tf.identity(out, name="out")
+            tf.compat.v1.enable_control_flow_v2()
+
+        path = str(tmp_path / "cond.pb")
+        with open(path, "wb") as f:
+            f.write(g.as_graph_def().SerializeToString())
+
+        from bigdl_tpu.interop.tensorflow import load_tf
+
+        model = load_tf(path, inputs=["x", "p"], outputs=["out"],
+                        input_specs={"x": (2, 3), "p": ((), np.bool_)})
+        xv = np.random.randn(2, 3).astype(np.float32)
+        with tf.compat.v1.Session(graph=g) as sess:
+            for pv in (True, False):
+                ours = np.asarray(model.forward(
+                    (jnp.asarray(xv), jnp.asarray(pv))))
+                ref = sess.run("out:0", {"x:0": xv, "p:0": pv})
+                np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestTfWhileImport:
+    def test_while_with_invariant_capture(self, tmp_path):
+        """A loop-invariant tensor derived from a placeholder enters the
+        frame as a capture (extra sub-graph input), not a constant."""
+        tf = pytest.importorskip("tensorflow")
+        g = tf.Graph()
+        with g.as_default():
+            tf.compat.v1.disable_control_flow_v2()
+            x = tf.compat.v1.placeholder(tf.float32, (2, 3), name="x")
+            step = tf.tanh(x)            # invariant, placeholder-derived
+            i0 = tf.constant(0)
+            acc0 = tf.zeros_like(x)
+
+            def cond(i, acc):
+                return tf.less(i, 4)
+
+            def body(i, acc):
+                return i + 1, acc + step
+
+            _, final = tf.while_loop(cond, body, [i0, acc0])
+            tf.identity(final, name="out")
+            tf.compat.v1.enable_control_flow_v2()
+
+        path = str(tmp_path / "cap.pb")
+        with open(path, "wb") as f:
+            f.write(g.as_graph_def().SerializeToString())
+
+        from bigdl_tpu.interop.tensorflow import load_tf
+
+        model = load_tf(path, inputs=["x"], outputs=["out"],
+                        input_specs={"x": (2, 3)})
+        xv = np.random.randn(2, 3).astype(np.float32)
+        ours = np.asarray(model.forward(jnp.asarray(xv)))
+        with tf.compat.v1.Session(graph=g) as sess:
+            ref = sess.run("out:0", {"x:0": xv})
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+    def test_imported_tf_loop_matches_tf(self, tmp_path):
+        """Build a classic (v1) tf.while_loop graph with real TF, import it,
+        and compare numerics -- 'enough to run an imported TF graph with a
+        loop' (VERDICT #7)."""
+        tf = pytest.importorskip("tensorflow")
+        g = tf.Graph()
+        with g.as_default():
+            # graph-mode while_loop in a tf.Graph emits v1 control flow
+            # when control flow v2 is disabled for the graph
+            tf.compat.v1.disable_control_flow_v2()
+            x = tf.compat.v1.placeholder(tf.float32, (2, 3), name="x")
+            i0 = tf.constant(0)
+
+            def cond(i, acc):
+                return tf.less(i, 5)
+
+            def body(i, acc):
+                return i + 1, acc * 1.25 + 0.5
+
+            _, final = tf.while_loop(cond, body, [i0, x], name="loop")
+            tf.identity(final, name="out")
+            tf.compat.v1.enable_control_flow_v2()
+
+        ops = {n.op for n in g.as_graph_def().node}
+        assert "Exit" in ops and "NextIteration" in ops, (
+            f"expected v1 control flow ops, got {sorted(ops)}")
+
+        path = str(tmp_path / "loop.pb")
+        with open(path, "wb") as f:
+            f.write(g.as_graph_def().SerializeToString())
+
+        from bigdl_tpu.interop.tensorflow import load_tf
+
+        model = load_tf(path, inputs=["x"], outputs=["out"],
+                        input_specs={"x": (2, 3)})
+        xv = np.random.randn(2, 3).astype(np.float32)
+        ours = np.asarray(model.forward(jnp.asarray(xv)))
+
+        with tf.compat.v1.Session(graph=g) as sess:
+            ref = sess.run("out:0", {"x:0": xv})
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)

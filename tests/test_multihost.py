@@ -1,0 +1,119 @@
+"""Multi-host runtime test (VERDICT r2 ask #4): two REAL processes
+rendezvous through Engine.init(coordinator_address=...) and run a
+data-parallel training step whose gradient psum crosses the process
+boundary.
+
+Reference analogue: utils/Engine.scala:105-117 discovers the cluster from
+the Spark conf; here jax.distributed.initialize handles rendezvous and the
+global mesh spans both processes' CPU devices (the same path a TPU pod
+slice uses, SURVEY.md section 2.4 comm-backend redesign).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["REPO"])
+from bigdl_tpu.utils.engine import Engine
+
+pid = int(sys.argv[1])
+Engine.reset()
+Engine.init(coordinator_address="127.0.0.1:%PORT%",
+            num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert Engine.node_number() == 2
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.optim.train_step import make_train_step
+from bigdl_tpu.utils.random_generator import RNG
+
+RNG.set_seed(0)
+mesh = Engine.mesh()
+assert mesh.devices.size == jax.device_count()
+
+model = nn.Sequential().add(nn.Linear(4, 8)).add(nn.ReLU()).add(
+    nn.Linear(8, 3))
+model.build(jax.ShapeDtypeStruct((4, 4), jnp.float32))
+params, mstate = model.parameters()[0], model.state()
+method = optim.SGD(learning_rate=0.1)
+opt_state = method.init_state(params)
+
+step = jax.jit(make_train_step(model, nn.CrossEntropyCriterion(), method))
+
+# per-process local shard of the global batch: DIFFERENT data per process,
+# so matching losses require the cross-process gradient/loss reduction
+rng = np.random.default_rng(pid)
+local_x = rng.standard_normal((4, 4)).astype(np.float32)
+local_y = rng.integers(0, 3, 4).astype(np.int32)
+gx = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), local_x)
+gy = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), local_y)
+
+params, mstate, opt_state, loss = step(params, mstate, opt_state,
+                                       gx, gy, jax.random.key(0))
+# the jitted step runs SPMD over both processes; the loss is global
+print(f"RESULT pid={pid} loss={float(loss):.6f}", flush=True)
+
+# the updated params must be identical on both processes (same global
+# gradient): print a digest for the parent to compare
+from jax.flatten_util import ravel_pytree
+
+local_params = jax.tree.map(
+    lambda a: np.asarray(a.addressable_data(0)), params)
+flat, _ = ravel_pytree(local_params)
+print(f"DIGEST pid={pid} {float(np.sum(np.abs(flat))):.6f}", flush=True)
+"""
+
+
+@pytest.mark.slow
+class TestTwoProcessEngine:
+    def test_two_process_training_step(self, tmp_path):
+        import socket
+
+        with socket.socket() as s:       # free port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        script = str(tmp_path / "worker.py")
+        with open(script, "w") as f:
+            f.write(_WORKER.replace("%PORT%", str(port)))
+
+        env = dict(os.environ)
+        env["REPO"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env.pop("XLA_FLAGS", None)       # 1 local CPU device per process
+        procs = [subprocess.Popen(
+            [sys.executable, script, str(i)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for i in range(2)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(out)
+
+        losses, digests = [], []
+        for out in outs:
+            for line in out.splitlines():
+                if line.startswith("RESULT"):
+                    losses.append(float(line.split("loss=")[1]))
+                if line.startswith("DIGEST"):
+                    digests.append(float(line.split()[-1]))
+        assert len(losses) == 2 and len(digests) == 2
+        # same global loss and same updated params on both processes
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+        np.testing.assert_allclose(digests[0], digests[1], rtol=1e-6)

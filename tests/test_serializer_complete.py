@@ -255,6 +255,11 @@ EXAMPLES = {
     "DetectionOutputSSD": (lambda: nn.DetectionOutputSSD(n_classes=3), None),
     "DetectionOutputFrcnn": (
         lambda: nn.DetectionOutputFrcnn(n_classes=3), None),
+    # control flow (nn/control_flow.py): Switch/Merge are no-arg graph
+    # plumbing; WhileLoop/DynamicGraph carry graph topology and round-trip
+    # architecture-only like the detection heads
+    "Switch": (lambda: nn.Switch(), None),
+    "Merge": (lambda: nn.Merge(), None),
 }
 
 CRIT_EXAMPLES = {
@@ -305,10 +310,15 @@ CRIT_EXAMPLES = {
         lambda: nn.TimeDistributedMaskCriterion(nn.MSECriterion()),
     "TransformerCriterion":
         lambda: nn.TransformerCriterion(nn.MSECriterion()),
+    "MultiBoxCriterion": lambda: nn.MultiBoxCriterion(3),
 }
 
 # abstract bases / helper types exempt from example coverage
-EXEMPT = {"Module", "Container", "Cell", "Graph", "Criterion"}
+EXEMPT = {"Module", "Container", "Cell", "Graph", "Criterion",
+          # node-graph constructor args (serialized via the Graph topology
+          # converter when embedded in a model, not constructible from
+          # recorded init args alone)
+          "DynamicGraph", "WhileLoop"}
 
 
 def _all_module_classes():

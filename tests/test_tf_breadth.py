@@ -1,0 +1,272 @@
+"""TF interop round-3 breadth: new op loaders, TFRecord I/O, and golden
+tests against the reference's own fixtures (test/resources/tf/test.pb,
+mnist_train.tfrecord) cross-checked with the REAL TensorFlow installed in
+this image (the strongest available oracle, mirroring how the reference's
+TensorflowSpec tests shell out to python TF).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.interop.tensorflow import load_tf, read_graph
+from bigdl_tpu.interop.tfrecord import (TFRecordReader, TFRecordWriter,
+                                        build_example, parse_example)
+
+REF_TF = "/root/reference/spark/dl/src/test/resources/tf"
+
+
+def _make_graph(build_fn):
+    """Build a TF1-style GraphDef using real TF's compat layer."""
+    tf = pytest.importorskip("tensorflow")
+    g = tf.Graph()
+    with g.as_default():
+        build_fn(tf)
+    return g
+
+
+class TestGoldenTestPb:
+    def test_reference_mlp_matches_tf(self):
+        """Load the reference's own test.pb and compare our forward with
+        real TF executing the same graph."""
+        tf = pytest.importorskip("tensorflow")
+        path = os.path.join(REF_TF, "test.pb")
+        model = load_tf(path, inputs=["Placeholder"], outputs=["output"],
+                        input_specs={"Placeholder": (2, 1)})
+        x = np.random.randn(2, 1).astype(np.float32)
+        ours = np.asarray(model.forward(jnp.asarray(x)))
+
+        tf_gdef = tf.compat.v1.GraphDef()
+        with open(path, "rb") as f:
+            tf_gdef.ParseFromString(f.read())
+        g = tf.Graph()
+        with g.as_default():
+            tf.graph_util.import_graph_def(tf_gdef, name="")
+        with tf.compat.v1.Session(graph=g) as sess:
+            ref = sess.run("output:0", {"Placeholder:0": x})
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestNewOpLoaders:
+    def _roundtrip(self, build_fn, feeds, out_name, rtol=1e-5):
+        """Build graph with real TF, run both TF and our importer, compare."""
+        tf = pytest.importorskip("tensorflow")
+        g = _make_graph(build_fn)
+        gdef = g.as_graph_def()
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "g.pb")
+            with open(path, "wb") as f:
+                f.write(gdef.SerializeToString())
+            in_names = list(feeds)
+            model = load_tf(path, inputs=in_names, outputs=[out_name],
+                            input_specs={n: v.shape
+                                         for n, v in feeds.items()})
+            xs = [jnp.asarray(v) for v in feeds.values()]
+            ours = np.asarray(model.forward(xs[0] if len(xs) == 1
+                                            else tuple(xs)))
+        with tf.compat.v1.Session(graph=g) as sess:
+            ref = sess.run(out_name + ":0",
+                           {n + ":0": v for n, v in feeds.items()})
+        np.testing.assert_allclose(ours, ref, rtol=rtol, atol=1e-5)
+
+    def test_transpose_tile_expanddims(self):
+        x = np.random.randn(2, 3, 4).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (2, 3, 4), name="x")
+            t = tf.transpose(p, [0, 2, 1])
+            t = tf.tile(t, [1, 2, 1])
+            tf.identity(tf.expand_dims(t, 1), name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_strided_slice(self):
+        x = np.random.randn(4, 6, 8).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (4, 6, 8), name="x")
+            tf.identity(p[1:3, ::2, 5:1:-2], name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_strided_slice_shrink(self):
+        x = np.random.randn(4, 6).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (4, 6), name="x")
+            tf.identity(p[2], name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_split_and_pack(self):
+        x = np.random.randn(2, 6).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (2, 6), name="x")
+            a, b, c = tf.split(p, 3, axis=1)
+            tf.identity(tf.stack([a, c, b], axis=0), name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_unstack(self):
+        x = np.random.randn(3, 2, 4).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (3, 2, 4), name="x")
+            parts = tf.unstack(p, axis=0)
+            tf.identity(parts[0] + 2.0 * parts[2], name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_reductions(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32) + 0.5
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (3, 4, 5), name="x")
+            s = tf.reduce_sum(p, axis=[1], keepdims=True)
+            m = tf.reduce_max(p, axis=[2])
+            tf.identity(tf.reduce_sum(s) + tf.reduce_min(m), name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_comparison_select(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        y = np.random.randn(3, 4).astype(np.float32)
+
+        def build(tf):
+            a = tf.compat.v1.placeholder(tf.float32, (3, 4), name="a")
+            b = tf.compat.v1.placeholder(tf.float32, (3, 4), name="b")
+            tf.identity(tf.where(tf.greater(a, b), a * 2.0, b - 1.0),
+                        name="out")
+        self._roundtrip(build, {"a": x, "b": y}, "out")
+
+    def test_depthwise_conv(self):
+        x = np.random.randn(1, 8, 8, 3).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (1, 8, 8, 3), name="x")
+            k = tf.constant(
+                np.random.randn(3, 3, 3, 2).astype(np.float32))
+            tf.identity(
+                tf.nn.depthwise_conv2d(p, k, [1, 1, 1, 1], "SAME"),
+                name="out")
+        self._roundtrip(build, {"x": x}, "out", rtol=1e-4)
+
+    def test_conv2d_backprop_input_as_deconv(self):
+        x = np.random.randn(1, 4, 4, 2).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (1, 4, 4, 2), name="x")
+            k = tf.constant(np.random.randn(3, 3, 5, 2).astype(np.float32))
+            tf.identity(
+                tf.nn.conv2d_transpose(p, k, (1, 8, 8, 5),
+                                       [1, 2, 2, 1], "SAME"), name="out")
+        self._roundtrip(build, {"x": x}, "out", rtol=1e-4)
+
+    def test_gather_onehot_addn(self):
+        idx = np.asarray([[0, 2], [1, 0]], np.int32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.int32, (2, 2), name="idx")
+            table = tf.constant(
+                np.random.randn(4, 3).astype(np.float32))
+            g = tf.gather(table, p)
+            oh = tf.one_hot(p, depth=3, on_value=2.0, off_value=-1.0)
+            tf.identity(tf.add_n([g, oh, oh]), name="out")
+        self._roundtrip(build, {"idx": idx}, "out")
+
+    def test_variable_graph_import(self):
+        """Un-frozen graph: VariableV2 + Assign initializer resolves to the
+        initial value (the reference loads such graphs via Session)."""
+        x = np.random.randn(2, 3).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (2, 3), name="x")
+            w = tf.compat.v1.Variable(
+                np.random.randn(3, 4).astype(np.float32), name="w")
+            tf.identity(tf.matmul(p, w), name="out")
+        tf = pytest.importorskip("tensorflow")
+        g = _make_graph(build)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "g.pb")
+            with open(path, "wb") as f:
+                f.write(g.as_graph_def().SerializeToString())
+            model = load_tf(path, inputs=["x"], outputs=["out"],
+                            input_specs={"x": (2, 3)})
+            ours = np.asarray(model.forward(jnp.asarray(x)))
+        with tf.compat.v1.Session(graph=g) as sess:
+            sess.run(tf.compat.v1.global_variables_initializer())
+            ref = sess.run("out:0", {"x:0": x})
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestTFRecord:
+    def test_read_reference_mnist_tfrecord(self):
+        """Parse the reference's mnist_train.tfrecord and cross-check every
+        record against real TF's parser."""
+        tf = pytest.importorskip("tensorflow")
+        path = os.path.join(REF_TF, "mnist_train.tfrecord")
+        payloads = list(TFRecordReader(path))
+        assert payloads, "no records read"
+
+        tf_payloads = [bytes(r.numpy())
+                       for r in tf.data.TFRecordDataset(path)]
+        assert len(payloads) == len(tf_payloads)
+        for ours, theirs in zip(payloads, tf_payloads):
+            assert ours == theirs
+
+        ex = parse_example(payloads[0])
+        tfex = tf.train.Example()
+        tfex.ParseFromString(payloads[0])
+        assert set(ex) == set(tfex.features.feature)
+        for name in ex:
+            feat = tfex.features.feature[name]
+            if feat.HasField("int64_list"):
+                np.testing.assert_array_equal(
+                    ex[name], list(feat.int64_list.value))
+            elif feat.HasField("float_list"):
+                np.testing.assert_allclose(
+                    ex[name], list(feat.float_list.value), rtol=1e-6)
+            else:
+                assert ex[name] == list(feat.bytes_list.value)
+
+    def test_write_read_roundtrip_and_tf_readable(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        path = str(tmp_path / "out.tfrecord")
+        feats = {
+            "label": np.asarray([3], np.int64),
+            "vec": np.asarray([0.5, -1.25], np.float32),
+            "raw": [b"hello"],
+        }
+        with TFRecordWriter(path) as w:
+            w.write(build_example(feats))
+            w.write(build_example({"label": np.asarray([7], np.int64)}))
+
+        # our reader round-trips
+        records = list(TFRecordReader(path))
+        assert len(records) == 2
+        back = parse_example(records[0])
+        np.testing.assert_array_equal(back["label"], [3])
+        np.testing.assert_allclose(back["vec"], [0.5, -1.25])
+        assert back["raw"] == [b"hello"]
+
+        # real TF can read our framing AND our Example bytes
+        ds = list(tf.data.TFRecordDataset(path))
+        assert len(ds) == 2
+        tfex = tf.train.Example()
+        tfex.ParseFromString(bytes(ds[0].numpy()))
+        assert list(tfex.features.feature["label"].int64_list.value) == [3]
+        np.testing.assert_allclose(
+            list(tfex.features.feature["vec"].float_list.value),
+            [0.5, -1.25])
+
+    def test_corrupt_crc_detected(self, tmp_path):
+        path = str(tmp_path / "bad.tfrecord")
+        with TFRecordWriter(path) as w:
+            w.write(b"payload-bytes")
+        raw = bytearray(open(path, "rb").read())
+        raw[14] ^= 0xFF          # flip a payload byte
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(ValueError, match="crc"):
+            list(TFRecordReader(path))
