@@ -81,13 +81,58 @@ def pp_shardings(pp_params, mesh, pipe_axis="pipe"):
     }
 
 
+def pp_tp_shardings(pp_params, mesh, pipe_axis="pipe", model_axis="model",
+                    rules=None):
+    """3-D composition shardings: stage-stacked leaves sharded over
+    ``pipe`` on dim 0 AND Megatron-style over ``model`` on their weight
+    dims (TRANSFORMER_TP_RULES shifted by the stage dimension); embed/tail
+    replicated.  Use with make_pp_train_step(..., manual_axes=
+    ("data", "pipe")) so the model axis stays automatic (GSPMD)."""
+    import re
+
+    from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
+    from bigdl_tpu.parallel.tp import TRANSFORMER_TP_RULES
+
+    rules = rules if rules is not None else TRANSFORMER_TP_RULES
+    rep = NamedSharding(mesh, P())
+
+    def stage_shardings(tree):
+        leaves, treedef = tree_flatten_with_path(tree)
+        out = []
+        for path, leaf in leaves:
+            name = keystr(path)
+            spec = [pipe_axis] + [None] * (leaf.ndim - 1)
+            for pattern, dims in rules:
+                if re.search(pattern, name):
+                    if len(dims) == leaf.ndim - 1:
+                        spec = [pipe_axis] + [
+                            d if d is None else model_axis for d in dims]
+                    break
+            out.append(NamedSharding(mesh, P(*spec)))
+        return tree_unflatten(treedef, out)
+
+    return {
+        "embed": jax.tree.map(lambda _: rep, pp_params["embed"]),
+        "stages": stage_shardings(pp_params["stages"]),
+        "tail": jax.tree.map(lambda _: rep, pp_params["tail"]),
+    }
+
+
 def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
                     pipe_axis: str = "pipe",
-                    data_axis: Optional[str] = None):
+                    data_axis: Optional[str] = None,
+                    manual_axes: Optional[tuple] = None):
     """-> loss(pp_params, x_tokens, y_tokens) with the GPipe schedule inside.
 
     ``x``/``y``: int32 (batch, T); batch must divide n_microbatches (times
     the data-axis size when present).
+
+    ``manual_axes``: mesh axes handled manually by this shard_map; axes NOT
+    listed (e.g. a ``model`` tensor-parallel axis on a 3-D mesh) stay
+    automatic -- GSPMD partitions the per-stage math over them from the
+    argument shardings (pp_tp_shardings).  Default: all mesh axes manual
+    (the 2-D data x pipe case).
     """
     n_stages = mesh.shape[pipe_axis]
     lps = len(model.blocks) // n_stages
@@ -142,12 +187,16 @@ def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
         return loss
 
     batch_spec = P(None, data_axis) if data_axis else P()
+    smap_kwargs = {}
+    if manual_axes is not None:
+        smap_kwargs["axis_names"] = frozenset(manual_axes)
     smapped = jax.shard_map(
         per_device, mesh=mesh,
         in_specs=({"embed": P(), "stages": P(pipe_axis), "tail": P()},
                   batch_spec, batch_spec, P()),
         out_specs=P(),
         check_vma=False,
+        **smap_kwargs,
     )
 
     def loss_fn(pp_params, x, y, rng=None):
@@ -169,16 +218,19 @@ def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
 
 def make_pp_train_step(model, criterion, optim_method, mesh,
                        n_microbatches: int, pipe_axis: str = "pipe",
-                       data_axis: Optional[str] = None):
+                       data_axis: Optional[str] = None,
+                       manual_axes: Optional[tuple] = None):
     """-> jitted step(pp_params, opt_state, x, y, rng) -> (params', opt', loss).
 
     Stage-stacked params (and their optimizer moments) live sharded over the
     ``pipe`` axis; the update runs where the shard lives (optimizer-state
     parallelism, the pipeline analogue of the reference's chunk ownership in
-    parameters/AllReduceParameter.scala:84).
+    parameters/AllReduceParameter.scala:84).  ``manual_axes``: see
+    make_pp_loss_fn -- pass ("data", "pipe") on a 3-D data x pipe x model
+    mesh to compose with GSPMD tensor parallelism.
     """
     loss_fn = make_pp_loss_fn(model, criterion, mesh, n_microbatches,
-                              pipe_axis, data_axis)
+                              pipe_axis, data_axis, manual_axes)
 
     def step(pp_params, opt_state, x, y, rng):
         loss, grads = jax.value_and_grad(loss_fn)(pp_params, x, y, rng)

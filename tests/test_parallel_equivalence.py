@@ -117,6 +117,47 @@ class TestPPEquivalence:
         _tree_allclose(unstack_stage_params(model, pp_new), ref_p)
 
 
+class Test3DComposition:
+    def test_pp_tp_dp_one_step_matches_single_device(self):
+        """3-D mesh (data x pipe x model): GPipe shard_map manual on
+        data/pipe, Megatron shardings on the model axis left to GSPMD
+        (VERDICT r2 ask #4: composed parallelism dryrun + equivalence)."""
+        from bigdl_tpu.parallel.pp import (make_pp_train_step,
+                                           pp_tp_shardings,
+                                           stack_stage_params,
+                                           unstack_stage_params)
+        from bigdl_tpu.parallel.zero import shard_opt_state
+
+        RNG.set_seed(0)
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+            ("data", "pipe", "model"))
+        model = TransformerLM(64, 32, 4, num_layers=2, max_len=32)
+        model.build(jax.ShapeDtypeStruct((4, 16), jnp.int32))
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+        y = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+
+        ref_p, _, ref_loss = _baseline_step(
+            model, crit, optim.SGD(learning_rate=0.1, momentum=0.9,
+                                   dampening=0.0),
+            jax.tree.map(jnp.copy, model._params), x, y)
+
+        method = optim.SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+        pp = stack_stage_params(model, 2)
+        sh = pp_tp_shardings(pp, mesh)
+        pp = jax.tree.map(jax.device_put, pp, sh)
+        opt_state = shard_opt_state(method, pp, sh, mesh)
+        step = make_pp_train_step(model, crit, method, mesh,
+                                  n_microbatches=2, data_axis="data",
+                                  manual_axes=("data", "pipe"))
+        pp_new, _, loss = step(pp, opt_state, x, y, jax.random.key(0))
+
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        _tree_allclose(unstack_stage_params(model, pp_new), ref_p)
+
+
 class TestEPEquivalence:
     def test_one_step_matches_single_device(self):
         from bigdl_tpu.parallel.ep import (ep_shard_params,
