@@ -42,8 +42,18 @@ log = logging.getLogger("bigdl_tpu.optim")
 
 def make_distri_train_step(model, criterion, optim_method, flat_space,
                            mesh, axis="data", compute_dtype=None,
-                           clip_value=None, clip_norm=None):
-    """Build the per-device step body and its shard_map wrapper."""
+                           clip_value=None, clip_norm=None,
+                           grad_compression=None):
+    """Build the per-device step body and its shard_map wrapper.
+
+    ``grad_compression``: dtype the gradients ride the wire in (e.g.
+    ``jnp.bfloat16`` or ``jnp.float16``) -- the TPU analogue of the
+    reference's fp16 on-the-wire compression
+    (parameters/FP16CompressedTensor.scala:26,173-199).  On-chip ICI is
+    bf16-native so this matters for DCN-crossing mesh axes; the reduction
+    output converts back to fp32 before the optimizer update, exactly like
+    the reference decompresses after aggregation.
+    """
 
     def step_body(params_flat, mstate, opt_state, x, target, rng):
         # per-device view: params_flat replicated, x/target = this device's shard
@@ -60,7 +70,12 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
         (loss, new_mstate), gflat = jax.value_and_grad(
             loss_fn, has_aux=True)(params_flat)
         # mean-reduce gradients; each device keeps only its chunk (ZeRO-1)
-        gchunk = jax.lax.psum_scatter(gflat, axis, tiled=True)
+        if grad_compression is not None:
+            wire = gflat.astype(grad_compression)
+            gchunk = jax.lax.psum_scatter(wire, axis,
+                                          tiled=True).astype(gflat.dtype)
+        else:
+            gchunk = jax.lax.psum_scatter(gflat, axis, tiled=True)
         gchunk = gchunk / jax.lax.psum(1, axis)
         if clip_value is not None:
             gchunk = clip_by_value(gchunk, *clip_value)
@@ -105,10 +120,18 @@ class DistriOptimizer(BaseOptimizer):
     (reference: optim/DistriOptimizer.scala:52)."""
 
     def __init__(self, model, dataset, criterion, optim_method=None,
-                 mesh=None, axis="data"):
+                 mesh=None, axis="data", grad_compression=None):
         super().__init__(model, dataset, criterion, optim_method)
         self.mesh = mesh or Engine.mesh()
         self.axis = axis
+        self.grad_compression = grad_compression
+
+    def set_gradient_compression(self, dtype=jnp.bfloat16):
+        """Gradients ride the allreduce wire in ``dtype`` (the analogue of
+        the reference's fp16 compression for slow/DCN-crossing axes,
+        parameters/FP16CompressedTensor.scala:26)."""
+        self.grad_compression = dtype
+        return self
 
     def _shard_batch(self, batch, sharding):
         x, t = batch.get_input(), batch.get_target()
@@ -162,7 +185,7 @@ class DistriOptimizer(BaseOptimizer):
         _, wrap = make_distri_train_step(
             self.model, self.criterion, self.optim_method, flat_space,
             self.mesh, self.axis, self.compute_dtype, self.clip_value,
-            self.clip_norm)
+            self.clip_norm, self.grad_compression)
         step = wrap(opt_state_eval)
 
         batch_sharding = NamedSharding(self.mesh, P(self.axis))

@@ -1,7 +1,12 @@
-"""Checkpoint persistence.
+"""Checkpoint persistence, local and remote.
 
-Reference: utils/File.scala:27-130 (Java serialization + HDFS/S3),
-optim/AbstractOptimizer.scala:206-226 (checkpoint of model.<neval> +
+Reference: utils/File.scala:27-130 -- saveToHdfs/load route any
+``scheme://`` path through the Hadoop FileSystem API (HDFS/S3), plain
+paths through java.io.  Here the same split: URL-schemed paths
+(hdfs://, s3://, gs://, memory://, ...) go through fsspec when it is
+installed; plain paths use the local fast path with no extra dependency.
+
+Also: optim/AbstractOptimizer.scala:206-226 (checkpoint of model.<neval> +
 optimMethod.<neval>).
 
 Format: a pickle of numpy-ified pytrees -- portable, no JVM.  (The
@@ -11,10 +16,69 @@ see SURVEY.md section 2.6.)
 
 import os
 import pickle
+import re
 from typing import Any
 
 import jax
 import numpy as np
+
+_SCHEME = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.-]*://")
+
+
+def _is_remote(path: str) -> bool:
+    return bool(_SCHEME.match(str(path))) and not str(path).startswith(
+        "file://")
+
+
+def _fs_for(path: str):
+    try:
+        import fsspec
+    except ImportError as e:          # pragma: no cover
+        raise ImportError(
+            f"reading/writing {path} needs the optional fsspec dependency "
+            f"(reference parity: utils/File.scala HDFS/S3 support)") from e
+    fs, _, paths = fsspec.get_fs_token_paths(path)
+    return fs, paths[0]
+
+
+def open_file(path: str, mode: str = "rb"):
+    """Open a local path or any fsspec URL (hdfs://, s3://, gs://, ...)."""
+    if _is_remote(path):
+        fs, p = _fs_for(path)
+        if "w" in mode:
+            parent = p.rsplit("/", 1)[0]
+            if parent:
+                fs.makedirs(parent, exist_ok=True)
+        return fs.open(p, mode)
+    if "w" in mode:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+    return open(path, mode)
+
+
+def exists(path: str) -> bool:
+    if _is_remote(path):
+        fs, p = _fs_for(path)
+        return fs.exists(p)
+    return os.path.exists(path)
+
+
+def listdir(path: str):
+    if _is_remote(path):
+        fs, p = _fs_for(path)
+        if not fs.isdir(p):
+            return []
+        return [e.rsplit("/", 1)[-1] for e in fs.ls(p, detail=False)]
+    if not os.path.isdir(path):
+        return []
+    return os.listdir(path)
+
+
+def join(path: str, *parts: str) -> str:
+    if _is_remote(path):
+        return "/".join([str(path).rstrip("/")] + [p.strip("/")
+                                                   for p in parts])
+    return os.path.join(path, *parts)
 
 
 def _to_numpy(tree):
@@ -22,13 +86,12 @@ def _to_numpy(tree):
 
 
 def save(obj: Any, path: str):
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
+    with open_file(path, "wb") as f:
         pickle.dump(_to_numpy(obj), f)
 
 
 def load(path: str) -> Any:
-    with open(path, "rb") as f:
+    with open_file(path, "rb") as f:
         return pickle.load(f)
 
 
@@ -42,14 +105,12 @@ def save_checkpoint(path: str, tag, model_params, model_state, opt_state,
             "opt_state": opt_state,
             "driver_state": dict(driver_state),
         },
-        os.path.join(path, f"checkpoint.{tag}.pkl"),
+        join(path, f"checkpoint.{tag}.pkl"),
     )
 
 
 def latest_checkpoint(path: str):
-    if not os.path.isdir(path):
-        return None
-    snaps = [f for f in os.listdir(path)
+    snaps = [f for f in listdir(path)
              if f.startswith("checkpoint.") and f.endswith(".pkl")]
     if not snaps:
         return None
@@ -60,4 +121,4 @@ def latest_checkpoint(path: str):
         except ValueError:
             return -1
 
-    return os.path.join(path, max(snaps, key=tag))
+    return join(path, max(snaps, key=tag))

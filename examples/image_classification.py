@@ -1,0 +1,71 @@
+"""Image classification inference over a folder of images.
+
+Reference: example/imageclassification (loads a model, builds an image
+pipeline, predicts over an ImageFrame).
+
+    python examples/image_classification.py --folder /path/to/images \
+        --model /path/to/model.bigdl
+
+With no arguments it builds a tiny demo: a synthetic image folder + a
+freshly-initialised ResNet-cifar, and prints the top-1 class per image.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def main(argv=None):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.transform.vision import (ChannelNormalize, ImageFrame,
+                                            Resize)
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--folder", default=None, help="dir of class subdirs")
+    p.add_argument("--model", default=None, help=".bigdl model file")
+    p.add_argument("--size", type=int, default=32)
+    args = p.parse_args(argv)
+
+    if args.folder:
+        from bigdl_tpu.dataset.image_folder import find_images, decode_image
+
+        items, classes = find_images(args.folder)
+        images = [decode_image(path) for path, _ in items]
+        names = [path for path, _ in items]
+    else:
+        from bigdl_tpu.dataset.cifar import synthetic_cifar10
+
+        images, labels = synthetic_cifar10(8)
+        images = list(images)
+        names = [f"synthetic[{i}] (true class {labels[i]})"
+                 for i in range(len(images))]
+
+    if args.model:
+        model = nn.Module.load(args.model)
+    else:
+        from bigdl_tpu.models.resnet import ResNetCifar
+
+        model = ResNetCifar(depth=8, class_num=10)
+
+    frame = ImageFrame.from_arrays(images)
+    frame = frame >> Resize(args.size, args.size) \
+                  >> ChannelNormalize((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
+    batch = np.stack([f["image"] for f in frame.features])
+    model.evaluate()
+    logits = np.asarray(model.forward(jnp.asarray(batch)))
+    for name, pred in zip(names, logits.argmax(axis=1)):
+        print(f"{name}: class {pred}")
+
+
+if __name__ == "__main__":
+    main()

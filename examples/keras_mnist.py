@@ -1,0 +1,53 @@
+"""Keras-style API: define, compile, fit (reference: example/keras --
+mnist_cnn.py with use_bigdl_backend; here the API is native).
+
+    python examples/keras_mnist.py --epochs 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def main(argv=None):
+    import numpy as np
+
+    from bigdl_tpu.dataset.mnist import load_mnist, synthetic_mnist
+    from bigdl_tpu.keras import (Convolution2D, Dense, Flatten,
+                                 MaxPooling2D, Sequential)
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--folder", default=None, help="MNIST idx folder")
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch", type=int, default=64)
+    args = p.parse_args(argv)
+
+    if args.folder:
+        x, y = load_mnist(args.folder, train=True)
+    else:
+        x, y = synthetic_mnist(2048)
+    x = x[:, None, :, :]                 # th ordering (N, 1, 28, 28)
+
+    model = Sequential()
+    model.add(Convolution2D(8, 3, 3, activation="relu",
+                            input_shape=(1, 28, 28)))
+    model.add(MaxPooling2D((2, 2)))
+    model.add(Flatten())
+    model.add(Dense(32, activation="relu"))
+    model.add(Dense(10, activation="softmax"))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=args.batch, nb_epoch=args.epochs,
+              validation_data=(x[:512], y[:512]))
+    acc = model.evaluate(x[:512], y[:512], batch_size=args.batch)[0]
+    print(f"final top-1: {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Import a model from another framework and run inference.
+
+Reference: example/loadmodel (loads Caffe / Torch .t7 / TensorFlow models
+into BigDL and evaluates them).
+
+    python examples/load_model.py --caffe deploy.prototxt weights.caffemodel
+    python examples/load_model.py --tf frozen.pb input output
+    python examples/load_model.py --torch model.t7
+    python examples/load_model.py --keras model.json weights.h5
+
+With no arguments it demos the TF path on a tiny graph built in-process
+(needs the tensorflow package, present in the test image).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def main(argv=None):
+    import numpy as np
+    import jax.numpy as jnp
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--caffe", nargs=2, metavar=("PROTOTXT", "CAFFEMODEL"))
+    p.add_argument("--tf", nargs=3, metavar=("PB", "INPUT", "OUTPUT"))
+    p.add_argument("--torch", metavar="T7")
+    p.add_argument("--keras", nargs=2, metavar=("JSON", "H5"))
+    args = p.parse_args(argv)
+
+    if args.caffe:
+        from bigdl_tpu.interop.caffe import load_caffe
+
+        model = load_caffe(*args.caffe)
+    elif args.tf:
+        from bigdl_tpu.interop.tensorflow import load_tf
+
+        model = load_tf(args.tf[0], inputs=[args.tf[1]],
+                        outputs=[args.tf[2]])
+    elif args.torch:
+        from bigdl_tpu.utils.torch_file import load_torch
+
+        model = load_torch(args.torch)
+    elif args.keras:
+        from bigdl_tpu.keras.converter import load_keras
+
+        model = load_keras(json_path=args.keras[0], hdf5_path=args.keras[1])
+    else:
+        # demo: build a small TF graph with real TF, freeze, import
+        import tempfile
+
+        import tensorflow as tf
+
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, (1, 8), name="x")
+            w = tf.constant(np.random.randn(8, 4).astype(np.float32))
+            tf.identity(tf.nn.relu(tf.matmul(x, w)), name="out")
+        from bigdl_tpu.interop.tensorflow import load_tf
+
+        with tempfile.TemporaryDirectory() as d:
+            pb = os.path.join(d, "g.pb")
+            with open(pb, "wb") as f:
+                f.write(g.as_graph_def().SerializeToString())
+            model = load_tf(pb, inputs=["x"], outputs=["out"],
+                            input_specs={"x": (1, 8)})
+        out = model.forward(jnp.ones((1, 8)))
+        print("imported TF graph; demo output:", np.asarray(out))
+        return
+
+    print("loaded:", type(model).__name__)
+
+
+if __name__ == "__main__":
+    main()
