@@ -1,0 +1,197 @@
+"""Hadoop SequenceFile ingestion (the reference's ImageNet storage format).
+
+Reference: dataset/DataSet.scala:482 ``SeqFileFolder`` -- reads sequence
+files of (Text key, Text value) where the key text is "name\nlabel" (or
+just "label") and the value holds the raw image bytes; records become
+ByteRecord(bytes, label) (readLabel at DataSet.scala:508).
+
+This is a pure-python parser of the on-disk format (SequenceFile v6,
+uncompressed -- the layout produced by the reference's documented ImageNet
+prep), plus a writer for fixtures.  Wire layout:
+
+    "SEQ" + version(1B)
+    key class name, value class name           (java writeUTF: u16 len + utf8)
+    compressed(1B bool), blockCompressed(1B bool)
+    metadata count (int32 BE) + (TextPair)*
+    sync marker (16B)
+    records: recordLen(int32 BE) keyLen(int32 BE) key value
+             recordLen == -1 -> 16-byte sync marker follows
+    Text serialisation: hadoop VInt length + utf8 bytes
+"""
+
+import io
+import os
+import struct
+
+import numpy as np
+
+_TEXT = "org.apache.hadoop.io.Text"
+
+
+def _read_vint(f):
+    """Hadoop WritableUtils.readVLong."""
+    first = f.read(1)[0]
+    b = first - 256 if first > 127 else first
+    if -112 <= b <= 127:
+        return b
+    length = (-112 - b) if b >= -120 else (-120 - b)
+    val = 0
+    for _ in range(length):
+        val = (val << 8) | f.read(1)[0]
+    return ~val if b < -120 else val
+
+
+def _write_vint(n):
+    """Hadoop WritableUtils.writeVLong (non-negative sizes only here)."""
+    if -112 <= n <= 127:
+        return bytes([n & 0xFF])
+    length = 0
+    tmp = n
+    while tmp:
+        length += 1
+        tmp >>= 8
+    out = bytes([(-112 - length) & 0xFF])
+    return out + n.to_bytes(length, "big")
+
+
+def _read_utf(f):
+    (ln,) = struct.unpack(">H", f.read(2))
+    return f.read(ln).decode("utf-8")
+
+
+def _write_utf(s):
+    b = s.encode("utf-8")
+    return struct.pack(">H", len(b)) + b
+
+
+def _read_text(buf):
+    f = io.BytesIO(buf)
+    ln = _read_vint(f)
+    return f.read(ln).decode("utf-8", errors="replace")
+
+
+def _write_text(s):
+    b = s.encode("utf-8") if isinstance(s, str) else bytes(s)
+    return _write_vint(len(b)) + b
+
+
+class SequenceFileReader:
+    """Iterate (key_bytes, value_bytes) records from one sequence file."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def __iter__(self):
+        with open(self.path, "rb") as f:
+            magic = f.read(3)
+            if magic != b"SEQ":
+                raise ValueError(f"{self.path}: not a SequenceFile")
+            version = f.read(1)[0]
+            if version < 5:
+                raise NotImplementedError(
+                    f"SequenceFile version {version} (< 5) unsupported")
+            key_cls = _read_utf(f)
+            val_cls = _read_utf(f)
+            compressed = f.read(1)[0] != 0
+            block_compressed = f.read(1)[0] != 0
+            if compressed or block_compressed:
+                raise NotImplementedError(
+                    f"{self.path}: compressed SequenceFiles unsupported "
+                    f"(the reference's ImageNet prep writes uncompressed)")
+            (meta_count,) = struct.unpack(">I", f.read(4))
+            for _ in range(meta_count):
+                _read_text(f)            # metadata key
+                _read_text(f)            # metadata value
+            sync = f.read(16)
+            while True:
+                head = f.read(4)
+                if len(head) < 4:
+                    return
+                (rec_len,) = struct.unpack(">i", head)
+                if rec_len == -1:        # sync marker
+                    marker = f.read(16)
+                    if marker != sync:
+                        raise ValueError(f"{self.path}: bad sync marker")
+                    continue
+                (key_len,) = struct.unpack(">i", f.read(4))
+                key = f.read(key_len)
+                value = f.read(rec_len - key_len)
+                yield key, value
+
+
+class SequenceFileWriter:
+    """Write (Text key, Text value) records (uncompressed, v6)."""
+
+    def __init__(self, path, sync_interval=10):
+        self._f = open(path, "wb")
+        self._sync = os.urandom(16)
+        self._count = 0
+        self._interval = sync_interval
+        self._f.write(b"SEQ" + bytes([6]))
+        self._f.write(_write_utf(_TEXT))
+        self._f.write(_write_utf(_TEXT))
+        self._f.write(bytes([0, 0]))             # not compressed
+        self._f.write(struct.pack(">I", 0))      # no metadata
+        self._f.write(self._sync)
+
+    def append(self, key: str, value: bytes):
+        if self._count and self._count % self._interval == 0:
+            self._f.write(struct.pack(">i", -1))
+            self._f.write(self._sync)
+        kb = _write_text(key)
+        vb = _write_text(value)
+        self._f.write(struct.pack(">i", len(kb) + len(vb)))
+        self._f.write(struct.pack(">i", len(kb)))
+        self._f.write(kb)
+        self._f.write(vb)
+        self._count += 1
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def read_label(key_text: str) -> str:
+    """Key text 'name\nlabel' or 'label' -> label
+    (reference: SeqFileFolder.readLabel, DataSet.scala:508)."""
+    parts = key_text.split("\n")
+    return parts[0] if len(parts) == 1 else parts[1]
+
+
+def read_name(key_text: str) -> str:
+    parts = key_text.split("\n")
+    if len(parts) < 2:
+        raise ValueError("key in seq file only contains label, no name")
+    return parts[0]
+
+
+def find_seq_files(folder):
+    """Sorted .seq files under a folder (reference: findFiles,
+    DataSet.scala:594)."""
+    out = [os.path.join(folder, f) for f in sorted(os.listdir(folder))
+           if f.endswith(".seq")]
+    if not out:
+        raise FileNotFoundError(f"no .seq files under {folder}")
+    return out
+
+
+def read_byte_records(folder, class_num=None):
+    """-> list of (image_bytes, float label) over every .seq file
+    (reference: SeqFileFolder.files -> ByteRecord, DataSet.scala:535-543).
+    """
+    records = []
+    for path in find_seq_files(folder):
+        for key, value in SequenceFileReader(path):
+            label = float(read_label(_read_text(key)))
+            if class_num is not None and label > class_num:
+                continue
+            # value is a serialised Text: VInt length prefix + bytes
+            f = io.BytesIO(value)
+            ln = _read_vint(f)
+            records.append((f.read(ln), label))
+    return records

@@ -203,11 +203,20 @@ def _convert_node(ctx, ndef):
 
     if op == "MatMul":
         x = _node_of(ctx, ins[0])
-        w = _const_of(ctx, ins[1])        # (in, out)
         if ndef.attr["transpose_a"].b:
             raise NotImplementedError("MatMul transpose_a")
-        if ndef.attr["transpose_b"].b:
-            w = w.T
+        w_kind, w_val = _convert(ctx, ins[1])
+        tb = bool(ndef.attr["transpose_b"].b)
+        if w_kind == "node":
+            # weight is a live graph value (e.g. a trainable session
+            # variable): emit the matmul as a two-input op
+            class _MatMul(Module):
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    a, b = input
+                    return a @ (b.T if tb else b), state
+            return "node", Node(_MatMul(), [x, w_val])
+        w = w_val.T if tb else w_val      # (in, out)
         mod = nn.Linear(w.shape[0], w.shape[1], with_bias=True)
         node = Node(mod, [x])
 
@@ -221,10 +230,26 @@ def _convert_node(ctx, ndef):
         if ndef.attr["data_format"].s.decode() not in ("", "NHWC"):
             raise NotImplementedError("Conv2D data_format NCHW")
         x = _node_of(ctx, ins[0])
-        k = _const_of(ctx, ins[1])        # HWIO
         st = list(ndef.attr["strides"].list.i)
         dil = list(ndef.attr["dilations"].list.i) or [1, 1, 1, 1]
         pad = ndef.attr["padding"].s.decode()
+        k_kind, k_val = _convert(ctx, ins[1])
+        if k_kind == "node":
+            sh, sw = int(st[1]), int(st[2])
+            dh, dw = int(dil[1]), int(dil[2])
+
+            class _ConvOp(Module):
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    from jax import lax
+                    a, k = input
+                    y = lax.conv_general_dilated(
+                        a, k.astype(a.dtype), (sh, sw), pad,
+                        rhs_dilation=(dh, dw),
+                        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                    return y, state
+            return "node", Node(_ConvOp(), [x, k_val])
+        k = k_val                          # HWIO
         mod = _tf_conv_module(k.shape, (int(st[1]), int(st[2])),
                               (int(dil[1]), int(dil[2])), pad == "SAME")
         node = Node(mod, [x])
@@ -781,6 +806,29 @@ def _convert_node(ctx, ndef):
         for n in ctx.nodes.values():
             if n.op in ("Assign", "AssignVariableOp") \
                     and _clean(n.input[0]) == ndef.name:
+                if getattr(ctx, "trainable", False):
+                    init_kind, init_val = _convert(ctx, n.input[1])
+                    if init_kind != "const":
+                        raise NotImplementedError(
+                            f"{ndef.name}: non-constant initializer in "
+                            f"trainable session mode")
+
+                    class _TfVariable(Module):
+                        """A graph variable as a trainable parameter
+                        (reference: Session.scala constructModel trains the
+                        imported graph's variables)."""
+
+                        def setup(self, rng, input_spec):
+                            return {"value": jnp.asarray(
+                                np.asarray(init_val, np.float32))}, ()
+
+                        def apply(self, params, state, input, *,
+                                  training=False, rng=None):
+                            return params["value"], state
+
+                    var = _TfVariable()
+                    var.name = ndef.name.replace("/", "_")
+                    return "node", Node(var, [])
                 return _convert(ctx, n.input[1])
         raise NotImplementedError(
             f"{op} {ndef.name} has no Assign initializer in-graph")
@@ -1125,12 +1173,16 @@ def _convert_while_frame(ctx, exit_ndef):
                         [while_node])
 
 
-def load_tf(path, inputs, outputs, binary=None, input_specs=None):
+def load_tf(path, inputs, outputs, binary=None, input_specs=None,
+            trainable=False):
     """TensorflowLoader.load equivalent: extract the inference subgraph
     between ``inputs`` (placeholder names) and ``outputs`` (node names) and
     build a bigdl_tpu Graph.  Reference: TensorflowLoader.scala:43,358.
 
     ``input_specs``: dict name -> (shape NHWC, dtype) to build immediately.
+    ``trainable``: variables become trainable parameters initialised from
+    their in-graph Assign values (the Session training mode,
+    utils/tf/Session.scala:105) instead of folding to constants.
     """
     import jax
     from bigdl_tpu.nn.graph import Graph, Input
@@ -1138,6 +1190,7 @@ def load_tf(path, inputs, outputs, binary=None, input_specs=None):
     gdef = read_graph(path, binary)
     nodes = {n.name: n for n in gdef.node}
     ctx = _GraphCtx(nodes)
+    ctx.trainable = trainable
     for name in inputs:
         ctx.input_nodes[_clean(name)] = Input()
 

@@ -1,0 +1,50 @@
+"""Train an imported TensorFlow graph (Session training).
+
+Reference: utils/tf/Session.scala:105 BigDLSessionImpl.train(outputs,
+dataSet, optMethod, criterion, endWhen): construct the model from the
+GraphDef with VARIABLES TRAINABLE, then drive the normal optimizer over an
+in-memory dataset (the queue-fed variant replaces TFRecord queue ops with
+the host input pipeline -- here that is the DataSet pipeline already).
+"""
+
+from typing import List, Optional
+
+from bigdl_tpu.interop.tensorflow import load_tf, read_graph
+
+
+class TFSession:
+    """reference: BigDLSessionImpl (utils/tf/Session.scala)."""
+
+    def __init__(self, path, binary=None):
+        self.path = path
+        self._gdef = read_graph(path, binary)
+
+    def placeholders(self) -> List[str]:
+        return [n.name for n in self._gdef.node
+                if n.op in ("Placeholder", "PlaceholderV2")]
+
+    def build(self, outputs, inputs: Optional[List[str]] = None,
+              input_specs=None):
+        """-> trainable Graph between the placeholders and ``outputs``
+        (variables become parameters initialised from their Assign values).
+        """
+        inputs = inputs if inputs is not None else self.placeholders()
+        if not inputs:
+            raise ValueError(
+                "no Placeholder inputs found; Session training needs "
+                "placeholder-fed graphs (the reference requires the same: "
+                "Session.scala 'only support Placeholder as input')")
+        return load_tf(self.path, inputs=inputs, outputs=outputs,
+                       input_specs=input_specs, trainable=True)
+
+    def train(self, outputs, dataset, optim_method, criterion, end_when,
+              inputs: Optional[List[str]] = None, input_specs=None):
+        """Train the graph's variables; returns the trained model
+        (Session.scala:105 train overload #1)."""
+        from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+
+        model = self.build(outputs, inputs, input_specs)
+        opt = LocalOptimizer(model, dataset, criterion, optim_method)
+        opt.set_end_when(end_when)
+        opt.optimize()
+        return model

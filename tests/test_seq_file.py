@@ -1,0 +1,107 @@
+"""Hadoop SequenceFile reader/writer (reference ImageNet storage path,
+dataset/DataSet.scala:482 SeqFileFolder)."""
+
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.seq_file import (SequenceFileReader,
+                                        SequenceFileWriter, _read_text,
+                                        _read_vint, _write_text,
+                                        _write_vint, find_seq_files,
+                                        read_byte_records, read_label,
+                                        read_name)
+
+
+class TestVInt:
+    @pytest.mark.parametrize("n", [0, 1, 127, 128, 255, 256, 65535,
+                                   1 << 20, (1 << 31) - 1])
+    def test_roundtrip(self, n):
+        assert _read_vint(io.BytesIO(_write_vint(n))) == n
+
+    def test_single_byte_range(self):
+        # hadoop encodes -112..127 as one raw byte
+        assert _write_vint(100) == bytes([100])
+        assert len(_write_vint(128)) == 2
+
+
+class TestSequenceFile:
+    def test_write_read_roundtrip(self, tmp_path):
+        path = str(tmp_path / "part-00000.seq")
+        imgs = [bytes([i]) * (10 + i) for i in range(25)]
+        with SequenceFileWriter(path, sync_interval=7) as w:
+            for i, img in enumerate(imgs):
+                w.append(f"img{i}.JPEG\n{i % 5 + 1}", img)
+        got = list(SequenceFileReader(path))
+        assert len(got) == 25
+        for i, (key, value) in enumerate(got):
+            kt = _read_text(key)
+            assert read_name(kt) == f"img{i}.JPEG"
+            assert read_label(kt) == str(i % 5 + 1)
+            f = io.BytesIO(value)
+            ln = _read_vint(f)
+            assert f.read(ln) == imgs[i]
+
+    def test_header_layout(self, tmp_path):
+        path = str(tmp_path / "x.seq")
+        with SequenceFileWriter(path) as w:
+            w.append("1", b"abc")
+        raw = open(path, "rb").read()
+        assert raw[:3] == b"SEQ" and raw[3] == 6
+        # key class name follows as java writeUTF
+        (ln,) = struct.unpack(">H", raw[4:6])
+        assert raw[6:6 + ln] == b"org.apache.hadoop.io.Text"
+
+    def test_read_byte_records_and_class_filter(self, tmp_path):
+        for part in range(2):
+            path = str(tmp_path / f"part-0000{part}.seq")
+            with SequenceFileWriter(path) as w:
+                for i in range(5):
+                    w.append(f"n{i}.JPEG\n{i + 1}",
+                             bytes([part * 10 + i]) * 4)
+        recs = read_byte_records(str(tmp_path))
+        assert len(recs) == 10
+        assert {r[1] for r in recs} == {1.0, 2.0, 3.0, 4.0, 5.0}
+        recs3 = read_byte_records(str(tmp_path), class_num=3)
+        assert len(recs3) == 6 and max(r[1] for r in recs3) == 3.0
+
+    def test_label_only_key(self, tmp_path):
+        path = str(tmp_path / "y.seq")
+        with SequenceFileWriter(path) as w:
+            w.append("7", b"pix")
+        ((key, _),) = list(SequenceFileReader(path))
+        assert read_label(_read_text(key)) == "7"
+        with pytest.raises(ValueError):
+            read_name(_read_text(key))
+
+    def test_not_a_seqfile(self, tmp_path):
+        p = tmp_path / "bad.seq"
+        p.write_bytes(b"NOPE")
+        with pytest.raises(ValueError, match="not a SequenceFile"):
+            list(SequenceFileReader(str(p)))
+
+    def test_find_requires_seq_files(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            find_seq_files(str(tmp_path))
+
+    def test_end_to_end_with_jpeg_decode(self, tmp_path):
+        """ImageNet-style path: JPEG bytes in seq files -> decoded arrays
+        (reference pipeline: SeqFileFolder.files -> BytesToBGRImg)."""
+        PIL = pytest.importorskip("PIL")
+        from PIL import Image
+
+        path = str(tmp_path / "part-00000.seq")
+        rng = np.random.default_rng(0)
+        with SequenceFileWriter(path) as w:
+            for i in range(3):
+                arr = rng.integers(0, 255, (8, 9, 3)).astype(np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(arr).save(buf, format="JPEG")
+                w.append(f"n0{i}.JPEG\n{i + 1}", buf.getvalue())
+        recs = read_byte_records(str(tmp_path))
+        for img_bytes, label in recs:
+            img = np.asarray(Image.open(io.BytesIO(img_bytes)).convert("RGB"))
+            assert img.shape == (8, 9, 3)
+            assert 1.0 <= label <= 3.0

@@ -1,0 +1,81 @@
+"""TF Session training (reference: utils/tf/Session.scala:105 -- train an
+imported TF graph's variables with the normal optimizer machinery)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+from bigdl_tpu.interop.tf_session import TFSession
+from bigdl_tpu.optim.trigger import Trigger
+
+tf = pytest.importorskip("tensorflow")
+
+
+def _mlp_graph(tmp_path, seed=0):
+    rng = np.random.default_rng(seed)
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, (None, 6), name="x")
+        w1 = tf.compat.v1.Variable(
+            rng.standard_normal((6, 16)).astype(np.float32) * 0.3,
+            name="w1")
+        b1 = tf.compat.v1.Variable(np.zeros(16, np.float32), name="b1")
+        w2 = tf.compat.v1.Variable(
+            rng.standard_normal((16, 3)).astype(np.float32) * 0.3,
+            name="w2")
+        h = tf.nn.relu(tf.matmul(x, w1) + b1)
+        tf.identity(tf.matmul(h, w2), name="logits")
+    path = str(tmp_path / "mlp.pb")
+    with open(path, "wb") as f:
+        f.write(g.as_graph_def().SerializeToString())
+    return g, path
+
+
+class TestTFSession:
+    def test_initial_forward_matches_tf(self, tmp_path):
+        g, path = _mlp_graph(tmp_path)
+        sess = TFSession(path)
+        assert sess.placeholders() == ["x"]
+        model = sess.build(["logits"], input_specs={"x": (4, 6)})
+        x = np.random.randn(4, 6).astype(np.float32)
+        ours = np.asarray(model.forward(jnp.asarray(x)))
+        with tf.compat.v1.Session(graph=g) as s:
+            s.run(tf.compat.v1.global_variables_initializer())
+            ref = s.run("logits:0", {"x:0": x})
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+    def test_variables_are_trainable_params(self, tmp_path):
+        _, path = _mlp_graph(tmp_path)
+        model = TFSession(path).build(["logits"],
+                                      input_specs={"x": (4, 6)})
+        flat, _ = model.get_parameters()
+        # w1 (96) + b1 (16) + w2 (48) trainable scalars
+        assert flat.size == 6 * 16 + 16 + 16 * 3
+
+    def test_session_train_learns(self, tmp_path):
+        """Train the imported graph on a separable problem; accuracy and
+        changed variables prove the gradients flow into the TF variables."""
+        _, path = _mlp_graph(tmp_path)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((512, 6)).astype(np.float32)
+        w = rng.standard_normal((6, 3)).astype(np.float32)
+        y = np.argmax(x @ w, axis=1).astype(np.int32)
+        ds = array_dataset(x, y) >> SampleToMiniBatch(64)
+
+        sess = TFSession(path)
+        model = sess.train(
+            ["logits"], ds, optim.SGD(learning_rate=0.2, momentum=0.9,
+                                      dampening=0.0),
+            nn.CrossEntropyCriterion(), Trigger.max_epoch(15),
+            input_specs={"x": (64, 6)})
+
+        logits = np.asarray(model.forward(jnp.asarray(x[:256])))
+        acc = float((logits.argmax(1) == y[:256]).mean())
+        assert acc > 0.85, acc
