@@ -702,13 +702,87 @@ def set_graph_weights(model, weights_by_name):
     return model
 
 
+def _read_weights_h5_v3(path):
+    """Keras 3 ``.weights.h5`` layout: layers/<auto>/.../vars/<int>, with
+    the USER layer name in the vars group's 'name' attr.  -> dict user
+    name -> [arrays in vars order] (matches get_weights order)."""
+    import h5py
+
+    by_name = {}
+    with h5py.File(path, "r") as f:
+        layers = f["layers"] if "layers" in f else f.get("_layer_checkpoint_dependencies")
+        if layers is None:
+            raise ValueError(f"{path}: no 'layers' group (not a keras-3 "
+                             f"weights file)")
+
+        def gather(group):
+            """All vars datasets under this layer group, traversal order."""
+            arrays = []
+            # the layer's own vars group carries the USER name; nested
+            # cell/vars groups carry internal names (e.g. 'lstm_cell')
+            name = None
+            if "vars" in group:
+                name = group["vars"].attrs.get("name")
+
+            def visit(g):
+                nonlocal name
+                for k in g:
+                    item = g[k]
+                    if isinstance(item, h5py.Group):
+                        if k == "vars" and name is None:
+                            name = item.attrs.get("name")
+                        visit(item)
+                    elif g.name.rsplit("/", 1)[-1] == "vars":
+                        arrays.append((g.name, int(k), np.asarray(item)))
+            visit(group)
+            if isinstance(name, bytes):
+                name = name.decode()
+            arrays.sort(key=lambda t: (t[0], t[1]))
+            return name, [a for _, _, a in arrays]
+
+        for key in layers:
+            name, arrays = gather(layers[key])
+            if arrays:
+                by_name[name or key] = arrays
+    return by_name
+
+
 def load_weights_hdf5(model, path, by_name=False):
-    """Legacy Keras HDF5 weight file (save_weights 1.x/2.x layout:
-    attrs['layer_names'] + per-group attrs['weight_names'])."""
+    """Keras HDF5 weight files: the legacy save_weights 1.x/2.x layout
+    (attrs['layer_names'] + per-group attrs['weight_names']) and the
+    keras-3 ``.weights.h5`` layout (layers/<auto>/vars/<int>)."""
     import h5py
 
     with h5py.File(path, "r") as f:
         g = f["model_weights"] if "model_weights" in f else f
+        if "layer_names" not in g.attrs:
+            by_layer_name = _read_weights_h5_v3(path)
+            from bigdl_tpu.nn.graph import Graph
+
+            if isinstance(model, Graph):
+                return set_graph_weights(model, by_layer_name)
+            if not model.is_built():
+                model.build_model()
+            import jax
+
+            has_params = [bool(jax.tree.leaves(
+                model._params.get(str(i), ()))) for i in
+                range(len(model.modules))]
+            named = all(layer.name in by_layer_name
+                        for layer, hp in zip(model.modules, has_params)
+                        if hp)
+            ordered = list(by_layer_name.values())
+            weights, qi = [], 0
+            for layer, hp in zip(model.modules, has_params):
+                if not hp:
+                    weights.append(None)
+                elif named:
+                    weights.append(by_layer_name[layer.name])
+                else:                        # positional: param-bearing only
+                    weights.append(ordered[qi] if qi < len(ordered)
+                                   else None)
+                    qi += 1
+            return set_layer_weights(model, weights)
         layer_names = [n.decode() if isinstance(n, bytes) else n
                        for n in g.attrs["layer_names"]]
         by_layer_name = {}

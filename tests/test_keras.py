@@ -446,6 +446,50 @@ class TestKeras1OnlyClasses:
 # ------------------------------------------------------------------ #
 
 
+class TestKeras3WeightsH5:
+    def test_full_json_plus_weights_file_roundtrip(self, tmp_path):
+        """The modern keras-3 path end-to-end: to_json + save_weights
+        (.weights.h5) -> load_keras -> identical outputs."""
+        km = keras.Sequential([
+            keras.layers.Input(shape=(10, 10, 3)),
+            keras.layers.Conv2D(5, (3, 3), activation="relu", name="c1"),
+            keras.layers.MaxPooling2D((2, 2)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(7, name="top"),
+        ])
+        x = np.random.randn(2, 10, 10, 3).astype(np.float32)
+        y_ref = np.asarray(km(x))
+        jpath = str(tmp_path / "m.json")
+        wpath = str(tmp_path / "m.weights.h5")
+        with open(jpath, "w") as f:
+            f.write(km.to_json())
+        km.save_weights(wpath)
+
+        ours = load_keras(json_path=jpath, hdf5_path=wpath)
+        ours.evaluate()
+        np.testing.assert_allclose(
+            np.asarray(ours.forward(jnp.asarray(x))), y_ref,
+            rtol=2e-4, atol=2e-5)
+
+    def test_lstm_weights_file(self, tmp_path):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(6, 5)),
+            keras.layers.LSTM(8, name="mem"),
+            keras.layers.Dense(3, name="out"),
+        ])
+        x = np.random.randn(2, 6, 5).astype(np.float32)
+        y_ref = np.asarray(km(x))
+        jpath, wpath = str(tmp_path / "m.json"), str(tmp_path / "m.weights.h5")
+        with open(jpath, "w") as f:
+            f.write(km.to_json())
+        km.save_weights(wpath)
+        ours = load_keras(json_path=jpath, hdf5_path=wpath)
+        ours.evaluate()
+        np.testing.assert_allclose(
+            np.asarray(ours.forward(jnp.asarray(x))), y_ref,
+            rtol=1e-3, atol=1e-4)
+
+
 class TestLegacyHDF5:
     def test_functional_model_hdf5(self, tmp_path):
         """load_keras on a FUNCTIONAL model + legacy h5 must route through
