@@ -165,6 +165,65 @@ def cmd_resnet_train(args):
     opt.optimize()
 
 
+def cmd_resnet_imagenet_train(args):
+    """The published ResNet-50/ImageNet recipe (reference:
+    models/resnet/README.md:131-149 + TrainImageNet.scala): global batch
+    8192, 90 epochs, 5-epoch linear warmup 0.1 -> 3.2, then 0.1x decay at
+    epochs 30/60/80, SGD momentum 0.9, weight decay 1e-4.  Data: a folder
+    of Hadoop SequenceFiles (--folder, the reference's ImageNet prep) or an
+    ImageFolder tree; synthetic stand-in otherwise (the recipe itself --
+    schedule, batch, epochs -- is exactly the published one either way)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.resnet import ResNet
+
+    n_train = 1281167
+    steps_per_epoch = max(int(np.ceil(n_train / args.batch)), 1)
+    warmup_epochs = 5
+    base_lr, max_lr = args.lr, args.max_lr
+    warmup_iteration = steps_per_epoch * warmup_epochs
+    delta = (max_lr - base_lr) / warmup_iteration
+
+    if args.folder and any(f.endswith(".seq")
+                           for f in os.listdir(args.folder)):
+        import io
+
+        from PIL import Image
+
+        from bigdl_tpu.dataset.seq_file import read_byte_records
+
+        recs = read_byte_records(args.folder, class_num=1000)
+        x = np.stack([
+            np.asarray(Image.open(io.BytesIO(b)).convert("RGB")
+                       .resize((224, 224)), np.float32) / 255.0
+            for b, _ in recs])
+        y = np.asarray([int(l) - 1 for _, l in recs], np.int32)
+        n_train = len(x)
+        steps_per_epoch = max(int(np.ceil(n_train / args.batch)), 1)
+        warmup_iteration = steps_per_epoch * warmup_epochs
+        delta = (max_lr - base_lr) / max(warmup_iteration, 1)
+    elif args.folder:
+        from bigdl_tpu.dataset.image_folder import find_images, decode_image
+
+        items, _ = find_images(args.folder)
+        x = np.stack([decode_image(p, (224, 224)) for p, _ in items])
+        y = np.asarray([label for _, label in items], np.int32)
+    else:
+        x, y = _synthetic_images(max(args.synth_n // 4, args.batch * 2),
+                                 224, 224, 3, 1000)
+
+    model = ResNet(depth=50, class_num=1000)
+    method = optim.SGD(
+        learning_rate=base_lr, momentum=0.9, dampening=0.0,
+        weight_decay=1e-4,
+        learning_rate_schedule=optim.EpochDecayWithWarmUp(
+            warmup_iteration, delta, steps_per_epoch))
+    opt = _build_optimizer(
+        args, model, _to_dataset(x, y, args.batch), None,
+        nn.CrossEntropyCriterion(), method, [optim.Top1Accuracy()])
+    opt.optimize()
+
+
 def cmd_inception_train(args):
     import bigdl_tpu.nn as nn
     from bigdl_tpu import optim
@@ -225,6 +284,9 @@ def main(argv=None):
         "vgg-train": (cmd_vgg_train, 2, []),
         "resnet-train": (cmd_resnet_train, 2,
                          [("--depth", dict(type=int, default=20))]),
+        "resnet-imagenet-train": (
+            cmd_resnet_imagenet_train, 90,
+            [("--maxLr", dict(type=float, default=3.2, dest="max_lr"))]),
         "inception-train": (cmd_inception_train, 1,
                             [("--version", dict(default="v1",
                                                 choices=["v1", "v2"])),
@@ -241,6 +303,9 @@ def main(argv=None):
         for flag, kw in extra:
             p.add_argument(flag, **kw)
         p.set_defaults(fn=fn)
+        if name == "resnet-imagenet-train":
+            # recipe defaults (models/resnet/README.md:131-149)
+            p.set_defaults(lr=0.1)
 
     args = parser.parse_args(argv)
     args.fn(args)

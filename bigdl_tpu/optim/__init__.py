@@ -1,7 +1,7 @@
 from bigdl_tpu.optim.optim_method import (
     OptimMethod, SGD, Adam, ParallelAdam, Adagrad, Adadelta, RMSprop, Adamax, Ftrl,
     LearningRateSchedule, Default, Step, MultiStep, Poly, Exponential,
-    NaturalExp, Warmup, SequentialSchedule,
+    NaturalExp, Warmup, SequentialSchedule, EpochDecayWithWarmUp,
     clip_by_value, clip_by_global_norm,
 )
 from bigdl_tpu.optim.lbfgs import LBFGS, line_search_wolfe

@@ -100,6 +100,33 @@ class NaturalExp(LearningRateSchedule):
         return base_lr * jnp.exp(-self.gamma * jnp.floor(step / self.decay_step))
 
 
+class EpochDecayWithWarmUp(LearningRateSchedule):
+    """The ResNet-50/ImageNet large-batch recipe schedule (reference:
+    optim/SGD.scala:671 EpochDecayWithWarmUp, used by
+    models/resnet/TrainImageNet.scala:107 with decay steps at epochs
+    30/60/80): linear warmup base_lr -> base_lr + delta*warmup_iteration,
+    then max_lr * 0.1^decay(epoch).
+
+    ``steps_per_epoch`` derives the epoch from the step count so the
+    schedule stays a pure traceable fn of the step.
+    """
+
+    def __init__(self, warmup_iteration, warmup_delta, steps_per_epoch,
+                 decay_epochs=(30, 60, 80)):
+        self.warmup_iteration = warmup_iteration
+        self.warmup_delta = warmup_delta
+        self.steps_per_epoch = steps_per_epoch
+        self.decay_epochs = jnp.asarray(decay_epochs)
+
+    def __call__(self, step, base_lr):
+        warm = base_lr + self.warmup_delta * step
+        max_lr = base_lr + self.warmup_delta * self.warmup_iteration
+        epoch = step // self.steps_per_epoch
+        decay = jnp.sum(epoch >= self.decay_epochs)
+        cooled = max_lr * jnp.power(0.1, decay)
+        return jnp.where(step < self.warmup_iteration, warm, cooled)
+
+
 class Warmup(LearningRateSchedule):
     """Linear ramp adding ``delta`` per step (reference SGD.Warmup; used inside
     SequentialSchedule for the ResNet-50 warmup recipe)."""

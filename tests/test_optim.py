@@ -250,3 +250,27 @@ def test_line_search_unbracketed_returns_consistent_point():
                                    float(jnp.vdot(g0, d)), max_iter=5)
     fe, _ = feval(x + t * d)
     np.testing.assert_allclose(float(f), float(fe))
+
+
+class TestEpochDecayWithWarmUp:
+    def test_published_resnet_recipe_values(self):
+        """The exact ResNet-50/ImageNet schedule (reference: SGD.scala:671 +
+        TrainImageNet.scala imageNetDecay 30/60/80): 0.1 -> 3.2 linear over
+        5 epochs, then 0.1x at 30/60/80."""
+        from bigdl_tpu.optim import EpochDecayWithWarmUp
+
+        steps_per_epoch = 157          # ceil(1281167 / 8192)
+        warmup = steps_per_epoch * 5
+        delta = (3.2 - 0.1) / warmup
+        sched = EpochDecayWithWarmUp(warmup, delta, steps_per_epoch)
+
+        assert float(sched(0, 0.1)) == pytest.approx(0.1)
+        assert float(sched(warmup // 2, 0.1)) == pytest.approx(
+            0.1 + delta * (warmup // 2))
+        assert float(sched(warmup, 0.1)) == pytest.approx(3.2)
+        assert float(sched(steps_per_epoch * 29, 0.1)) == pytest.approx(3.2)
+        assert float(sched(steps_per_epoch * 30, 0.1)) == pytest.approx(0.32)
+        assert float(sched(steps_per_epoch * 60, 0.1)) == pytest.approx(
+            0.032)
+        assert float(sched(steps_per_epoch * 80, 0.1)) == pytest.approx(
+            0.0032, rel=1e-5)
