@@ -46,17 +46,21 @@ class MultiHeadAttention(Module):
 
     def __init__(self, hidden_size: int, num_heads: int, causal: bool = False,
                  dropout: float = 0.0, seq_axis_name: Optional[str] = None,
-                 name=None):
+                 seq_mode: str = "ring", name=None):
         super().__init__(name)
         assert hidden_size % num_heads == 0
+        assert seq_mode in ("ring", "ulysses")
         self.hidden_size = hidden_size
         self.num_heads = num_heads
         self.head_dim = hidden_size // num_heads
         self.causal = causal
         self.dropout = dropout
         #: when set, apply() is assumed to run inside shard_map with the
-        #: sequence sharded over this mesh axis -> ring attention.
+        #: sequence sharded over this mesh axis; ``seq_mode`` picks the
+        #: strategy: "ring" (ppermute K/V rotation) or "ulysses"
+        #: (all-to-all head re-sharding, parallel/ulysses.py).
         self.seq_axis_name = seq_axis_name
+        self.seq_mode = seq_mode
 
     def setup(self, rng, input_spec):
         d = self.hidden_size
@@ -74,7 +78,13 @@ class MultiHeadAttention(Module):
         qkv = input @ params["qkv_weight"].astype(dt).T + params["qkv_bias"].astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (n, t, self.num_heads, self.head_dim)
-        if self.seq_axis_name is not None:
+        if self.seq_axis_name is not None and self.seq_mode == "ulysses":
+            from bigdl_tpu.parallel.ulysses import ulysses_self_attention
+
+            y = ulysses_self_attention(q.reshape(shape), k.reshape(shape),
+                                       v.reshape(shape), self.seq_axis_name,
+                                       causal=self.causal)
+        elif self.seq_axis_name is not None:
             from bigdl_tpu.parallel.ring_attention import ring_self_attention
 
             y = ring_self_attention(q.reshape(shape), k.reshape(shape),
@@ -96,11 +106,11 @@ class TransformerBlock(Container):
     """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x))."""
 
     def __init__(self, hidden_size, num_heads, mlp_ratio=4, causal=True,
-                 dropout=0.0, seq_axis_name=None, name=None):
+                 dropout=0.0, seq_axis_name=None, seq_mode="ring", name=None):
         super().__init__(name)
         self.ln1 = LayerNorm(hidden_size)
         self.attn = MultiHeadAttention(hidden_size, num_heads, causal, dropout,
-                                       seq_axis_name)
+                                       seq_axis_name, seq_mode)
         self.ln2 = LayerNorm(hidden_size)
         self.fc1 = Linear(hidden_size, mlp_ratio * hidden_size)
         self.fc2 = Linear(mlp_ratio * hidden_size, hidden_size)
@@ -136,14 +146,16 @@ class TransformerLM(Container):
     """
 
     def __init__(self, vocab_size, hidden_size, num_heads, num_layers,
-                 max_len=2048, mlp_ratio=4, seq_axis_name=None, name=None):
+                 max_len=2048, mlp_ratio=4, seq_axis_name=None,
+                 seq_mode="ring", name=None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.max_len = max_len
         self.seq_axis_name = seq_axis_name
         self.blocks = [TransformerBlock(hidden_size, num_heads, mlp_ratio,
-                                        seq_axis_name=seq_axis_name)
+                                        seq_axis_name=seq_axis_name,
+                                        seq_mode=seq_mode)
                        for _ in range(num_layers)]
         self.ln_f = LayerNorm(hidden_size)
         for b in self.blocks:
