@@ -32,25 +32,33 @@ _LIB = None
 _TRIED = False
 
 
+def build_native_lib(name: str):
+    """Build (if stale) and load ``native/<name>.cpp`` as
+    ``build/lib<name>.so``.  Prebuilt artifacts from `make -C native` are
+    used as-is; otherwise g++ compiles on demand; callers fall back to
+    pure python/numpy when neither works."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    src = os.path.join(here, "native", f"{name}.cpp")
+    out_dir = os.path.join(here, "build")
+    so_path = os.path.join(out_dir, f"lib{name}.so")
+    if (not os.path.exists(so_path)
+            or os.path.getmtime(so_path) < os.path.getmtime(src)):
+        os.makedirs(out_dir, exist_ok=True)
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", so_path, src,
+             "-lpthread"],
+            check=True, capture_output=True)
+    return ctypes.CDLL(so_path)
+
+
 def _build_and_load():
     global _LIB, _TRIED
     if _TRIED:
         return _LIB
     _TRIED = True
-    here = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    src = os.path.join(here, "native", "batch_assembler.cpp")
-    out_dir = os.path.join(here, "build")
-    so_path = os.path.join(out_dir, "libbatch_assembler.so")
     try:
-        if (not os.path.exists(so_path)
-                or os.path.getmtime(so_path) < os.path.getmtime(src)):
-            os.makedirs(out_dir, exist_ok=True)
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", so_path, src,
-                 "-lpthread"],
-                check=True, capture_output=True)
-        lib = ctypes.CDLL(so_path)
+        lib = build_native_lib("batch_assembler")
         lib.bigdl_gather_normalize.argtypes = [
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),

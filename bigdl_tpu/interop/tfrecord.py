@@ -22,14 +22,79 @@ import numpy as np
 from bigdl_tpu.visualization.tensorboard import _masked_crc
 
 
-class TFRecordReader:
-    """Iterate payload bytes from a TFRecord file (crc-checked)."""
+_NATIVE = None
+_NATIVE_TRIED = False
 
-    def __init__(self, path, check_crc=True):
+
+def _native_reader():
+    """The C++ reader (native/record_reader.cpp) when buildable; the
+    framing + crc work is pure host IO, so it lives native like the
+    reference's loader layer (SURVEY.md 2.8)."""
+    global _NATIVE, _NATIVE_TRIED
+    if _NATIVE_TRIED:
+        return _NATIVE
+    _NATIVE_TRIED = True
+    try:
+        import ctypes
+
+        from bigdl_tpu.dataset.native_loader import build_native_lib
+
+        lib = build_native_lib("record_reader")
+        lib.rr_open.restype = ctypes.c_void_p
+        lib.rr_open.argtypes = [ctypes.c_char_p]
+        lib.rr_next.restype = ctypes.c_longlong
+        lib.rr_next.argtypes = [ctypes.c_void_p]
+        lib.rr_data.restype = ctypes.POINTER(ctypes.c_ubyte)
+        lib.rr_data.argtypes = [ctypes.c_void_p]
+        lib.rr_close.argtypes = [ctypes.c_void_p]
+        _NATIVE = lib
+    except Exception:
+        _NATIVE = None
+    return _NATIVE
+
+
+class TFRecordReader:
+    """Iterate payload bytes from a TFRecord file (crc-checked).
+
+    Uses the native C++ reader when available (``use_native=None`` =
+    auto); the pure-python path is the behavioural reference either way.
+    """
+
+    def __init__(self, path, check_crc=True, use_native=None):
         self.path = path
         self.check_crc = check_crc
+        self.use_native = use_native
 
     def __iter__(self):
+        native = self.use_native
+        if native is None:
+            native = self.check_crc and _native_reader() is not None
+        if native:
+            yield from self._iter_native()
+            return
+        yield from self._iter_python()
+
+    def _iter_native(self):
+        import ctypes
+
+        lib = _native_reader()
+        if lib is None:
+            raise RuntimeError("native record reader unavailable")
+        h = lib.rr_open(self.path.encode())
+        if not h:
+            raise FileNotFoundError(self.path)
+        try:
+            while True:
+                n = lib.rr_next(h)
+                if n == -1:
+                    return
+                if n < 0:
+                    raise ValueError(f"{self.path}: corrupt record crc")
+                yield ctypes.string_at(lib.rr_data(h), n)
+        finally:
+            lib.rr_close(h)
+
+    def _iter_python(self):
         with open(self.path, "rb") as f:
             while True:
                 head = f.read(8)

@@ -1,0 +1,98 @@
+// Native TFRecord reader: framing + masked crc32c validation in C++.
+//
+// The runtime analogue of the reference's native data-loader layer
+// (SURVEY.md 2.8: IO/codec work stays off the accelerator; the reference
+// does it in JNI/OpenCV land, here a small C++ reader feeds the host
+// pipeline).  Wire format per record (see interop/tfrecord.py):
+//
+//   uint64 LE length | uint32 LE masked_crc(length) |
+//   payload[length]  | uint32 LE masked_crc(payload)
+//
+// C API (ctypes, no pybind11):
+//   void*       rr_open(const char* path);
+//   long long   rr_next(void* h);   // >=0 payload len, -1 EOF, -2 corrupt
+//   const unsigned char* rr_data(void* h);
+//   void        rr_close(void* h);
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+uint32_t crc_table[256];
+bool table_ready = false;
+
+void init_table() {
+  if (table_ready) return;
+  const uint32_t poly = 0x82F63B78u;  // Castagnoli, reflected
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? (poly ^ (c >> 1)) : (c >> 1);
+    crc_table[i] = c;
+  }
+  table_ready = true;
+}
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  init_table();
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    crc = crc_table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+uint32_t masked_crc(const uint8_t* data, size_t n) {
+  uint32_t crc = crc32c(data, n);
+  return (((crc >> 15) | (crc << 17)) + 0xA282EAD8u);
+}
+
+struct Reader {
+  FILE* f;
+  std::vector<uint8_t> buf;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rr_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  Reader* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+long long rr_next(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  uint8_t head[8];
+  size_t got = std::fread(head, 1, 8, r->f);
+  if (got == 0) return -1;  // clean EOF
+  if (got < 8) return -2;
+  uint64_t len = 0;
+  std::memcpy(&len, head, 8);  // little-endian hosts only (x86/arm)
+  uint32_t len_crc = 0;
+  if (std::fread(&len_crc, 1, 4, r->f) != 4) return -2;
+  if (masked_crc(head, 8) != len_crc) return -2;
+  r->buf.resize(len);
+  if (len && std::fread(r->buf.data(), 1, len, r->f) != len) return -2;
+  uint32_t data_crc = 0;
+  if (std::fread(&data_crc, 1, 4, r->f) != 4) return -2;
+  if (masked_crc(r->buf.data(), len) != data_crc) return -2;
+  return static_cast<long long>(len);
+}
+
+const unsigned char* rr_data(void* handle) {
+  return static_cast<Reader*>(handle)->buf.data();
+}
+
+void rr_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (r->f) std::fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"
