@@ -128,6 +128,8 @@ def _norm_config(class_name, cfg):
     mv("stddev", "sigma")                        # GaussianNoise keras2
     mv("return_sequences", "return_sequences")
     mv("go_backwards", "go_backwards")
+    mv("merge_mode", "merge_mode")
+    mv("layer", "layer")                         # wrapper inner-layer config
     mv("mode", "mode")
     mv("concat_axis", "concat_axis")
     if class_name in _K2_MERGE_MODE:
@@ -280,6 +282,9 @@ _BUILDERS = {
         dim_ordering=c.get("dim_ordering", "th"),
         return_sequences=c.get("return_sequences", False),
         go_backwards=c.get("go_backwards", False)),
+    "Bidirectional": lambda c: KL.Bidirectional(
+        _inner_layer(c), merge_mode=c.get("merge_mode", "concat")),
+    "TimeDistributed": lambda c: KL.TimeDistributed(_inner_layer(c)),
     # keras-2/3 standalone activation layers (ReLU keeps max_value /
     # negative_slope / threshold -- e.g. ReLU6 in MobileNet configs)
     "ReLU": lambda c: (
@@ -291,6 +296,14 @@ _BUILDERS = {
                             c.get("threshold", 0.0))),
     "Softmax": lambda c: KL.SoftMax(axis=c.get("axis", -1)),
 }
+
+
+def _inner_layer(cfg):
+    """Wrapper configs (Bidirectional/TimeDistributed) nest the wrapped
+    layer as {"class_name": ..., "config": ...}."""
+    inner = cfg["layer"]
+    layer, _ = _build_layer(inner["class_name"], inner["config"])
+    return layer
 
 
 def _build_layer(class_name, raw_config):
@@ -628,7 +641,27 @@ def _install_convlstm2d(layer, p, s, arrays):
         _set(d, "bias", np.asarray(arrays[2]).reshape(-1))
 
 
+def _install_bidirectional(layer, p, s, arrays):
+    """keras Bidirectional get_weights = forward layer's arrays then the
+    backward layer's; our BiRecurrent params are {"fwd": ..., "bwd": ...}.
+    """
+    inner_cls = getattr(layer.layer, "_keras_class",
+                        type(layer.layer).__name__)
+    installer = _INSTALLERS[inner_cls]
+    half = len(arrays) // 2
+    installer(layer.layer, p["fwd"], s, arrays[:half])
+    installer(layer.layer, p["bwd"], s, arrays[half:])
+
+
+def _install_time_distributed(layer, p, s, arrays):
+    inner_cls = getattr(layer.layer, "_keras_class",
+                        type(layer.layer).__name__)
+    _INSTALLERS[inner_cls](layer.layer, p, s, arrays)
+
+
 _INSTALLERS = {
+    "Bidirectional": _install_bidirectional,
+    "TimeDistributed": _install_time_distributed,
     "Dense": _install_dense,
     "Convolution2D": _install_conv2d,
     "Deconvolution2D": _install_conv2d,

@@ -316,6 +316,22 @@ class TestGoldenVsRealKeras:
         assert y.shape == y_ref.shape, (y.shape, y_ref.shape)
         np.testing.assert_allclose(y, y_ref, rtol=1e-3, atol=1e-4)
 
+    def test_bidirectional_lstm(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(6, 5)),
+            keras.layers.Bidirectional(
+                keras.layers.LSTM(4, return_sequences=True)),
+        ])
+        _golden_check(km, np.random.randn(2, 6, 5).astype(np.float32),
+                      rtol=1e-3, atol=1e-4)
+
+    def test_time_distributed_dense(self):
+        km = keras.Sequential([
+            keras.layers.Input(shape=(6, 5)),
+            keras.layers.TimeDistributed(keras.layers.Dense(3)),
+        ])
+        _golden_check(km, np.random.randn(2, 6, 5).astype(np.float32))
+
     def test_functional_two_branch_add(self):
         inp = keras.layers.Input(shape=(6,))
         a = keras.layers.Dense(5, activation="relu")(inp)
