@@ -43,11 +43,19 @@ class BatchNormalization(Module):
         return params, state
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        x32 = input.astype(jnp.float32)
+        # statistics accumulate in fp32 WITHOUT materialising an fp32 copy
+        # of the activations: the elementwise cast/square fuse into the
+        # reduction, and the normalise runs in the input dtype so it fuses
+        # with the surrounding convs (bf16 on TPU).  E[x^2]-E[x]^2 in fp32
+        # is the standard fused-BN formulation (post-conv activations are
+        # ~zero-mean, so cancellation is benign at fp32).
         if training:
-            mean = jnp.mean(x32, axis=self.reduce_axes)
-            var = jnp.var(x32, axis=self.reduce_axes)
-            n = x32.size // x32.shape[-1]
+            mean = jnp.mean(input, axis=self.reduce_axes,
+                            dtype=jnp.float32)
+            sq = jnp.mean(jnp.square(input.astype(jnp.float32)),
+                          axis=self.reduce_axes, dtype=jnp.float32)
+            var = jnp.maximum(sq - jnp.square(mean), 0.0)
+            n = input.size // input.shape[-1]
             unbiased = var * n / max(n - 1, 1)
             m = self.momentum
             state = {
@@ -61,8 +69,8 @@ class BatchNormalization(Module):
         if self.affine:
             scale = scale * params["weight"]
             shift = shift * params["weight"] + params["bias"]
-        y = x32 * scale + shift
-        return y.astype(input.dtype), state
+        y = input * scale.astype(input.dtype) + shift.astype(input.dtype)
+        return y, state
 
 
 class SpatialBatchNormalization(BatchNormalization):
