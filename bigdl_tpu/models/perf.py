@@ -102,7 +102,24 @@ def run_perf(model_name="resnet50", batch=32, iterations=20, distributed=False):
     return batch / med
 
 
+def _honor_env_platforms():
+    """The axon sitecustomize force-selects the tunneled TPU platform at
+    interpreter start, overriding the JAX_PLATFORMS env var; re-assert the
+    env var's intent so CPU-forced runs never block on the tunnel."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+
+
 def main(argv=None):
+    _honor_env_platforms()
     p = argparse.ArgumentParser(prog="bigdl_tpu.models.perf")
     p.add_argument("--model", default="resnet50", choices=sorted(MODELS))
     p.add_argument("-b", "--batchSize", type=int, default=32, dest="batch")

@@ -274,7 +274,24 @@ def cmd_rnn_train(args):
     opt.optimize()
 
 
+def _honor_env_platforms():
+    """The axon sitecustomize force-selects the tunneled TPU platform at
+    interpreter start, overriding the JAX_PLATFORMS env var; re-assert the
+    env var's intent so CPU-forced runs never block on the tunnel."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+
+
 def main(argv=None):
+    _honor_env_platforms()
     parser = argparse.ArgumentParser(prog="bigdl_tpu.models.run")
     sub = parser.add_subparsers(dest="command", required=True)
 
