@@ -1,0 +1,6 @@
+"""bigdl.dataset.movielens — reference: pyspark/bigdl/dataset/movielens.py
+(read_data_sets over the ml-1m layout)."""
+
+from bigdl_tpu.dataset.movielens import (  # noqa: F401
+    get_id_pairs, read_data_sets,
+)

@@ -1,0 +1,7 @@
+"""bigdl.dataset.news20 — reference: pyspark/bigdl/dataset/news20.py
+(get_news20, get_glove_w2v).  Parses the standard extracted layouts from a
+local directory (no download in this environment)."""
+
+from bigdl_tpu.dataset.news20 import (  # noqa: F401
+    CLASS_NUM, get_glove_w2v, get_news20,
+)

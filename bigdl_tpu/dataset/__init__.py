@@ -11,3 +11,50 @@ from bigdl_tpu.dataset.dataset import (
 )
 from bigdl_tpu.dataset import cifar, movielens, news20
 from bigdl_tpu.dataset.image_folder import ImageFolderDataSet, image_folder
+
+
+class DataSet:
+    """Factory namespace mirroring the reference's ``DataSet`` object
+    (dataset/DataSet.scala:322 array, :420 ImageFolder, :482
+    SeqFileFolder)."""
+
+    @staticmethod
+    def array(features, labels=None):
+        return array_dataset(features, labels)
+
+    @staticmethod
+    def image_folder(path, size=None, **kw):
+        """reference: DataSet.ImageFolder (DataSet.scala:420)."""
+        return image_folder(path, size=size, **kw)
+
+    @staticmethod
+    def seq_file_folder(path, class_num=None):
+        """reference: DataSet.SeqFileFolder.files (DataSet.scala:482) ->
+        LocalDataSet of Samples with decoded images + 0-based labels."""
+        import io
+
+        import numpy as np
+
+        from bigdl_tpu.dataset.minibatch import Sample
+        from bigdl_tpu.dataset.seq_file import read_byte_records
+
+        from PIL import Image
+
+        records = read_byte_records(path, class_num=class_num)
+        samples = []
+        for img_bytes, label in records:
+            img = np.asarray(
+                Image.open(io.BytesIO(img_bytes)).convert("RGB"),
+                np.float32) / 255.0
+            samples.append(Sample(img, np.int32(label - 1)))
+        return LocalDataSet(samples)
+
+    @staticmethod
+    def cifar10(folder, train=True):
+        from bigdl_tpu.dataset.minibatch import Sample
+
+        import numpy as np
+
+        x, y = cifar.load_cifar10(folder, train=train)
+        return LocalDataSet([Sample(xi, np.int32(yi))
+                             for xi, yi in zip(x, y)])

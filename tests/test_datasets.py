@@ -157,3 +157,39 @@ class TestCifarConvergence:
         (acc,) = model.evaluate_on(val, [Top1Accuracy()])
         top1 = acc.result()[0]
         assert top1 > 0.7, f"ResNet-8 top-1 after 20 epochs: {top1}"
+
+
+class TestDataSetFactories:
+    """The DataSet factory namespace (reference: DataSet.scala object)."""
+
+    def test_seq_file_folder_factory(self, tmp_path):
+        import io
+
+        from PIL import Image
+
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.dataset.seq_file import SequenceFileWriter
+
+        rng = np.random.default_rng(0)
+        with SequenceFileWriter(str(tmp_path / "p.seq")) as w:
+            for i in range(4):
+                arr = rng.integers(0, 255, (6, 6, 3)).astype(np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(arr).save(buf, format="PNG")
+                w.append(f"n{i}.PNG\n{i % 2 + 1}", buf.getvalue())
+        ds = DataSet.seq_file_folder(str(tmp_path))
+        assert ds.size() == 4
+        samples = list(ds.data(train=False))
+        assert samples[0].feature.shape == (6, 6, 3)
+        assert {int(s.label) for s in samples} == {0, 1}
+
+    def test_cifar_and_array_factories(self, tmp_path):
+        from bigdl_tpu.dataset import DataSet, cifar
+
+        imgs, labels = cifar.synthetic_cifar10(20)
+        cifar.write_binary(str(tmp_path / "data_batch_1.bin"), imgs, labels)
+        ds = DataSet.cifar10(str(tmp_path))
+        assert ds.size() == 20
+        arr = DataSet.array(np.zeros((8, 3), np.float32),
+                            np.zeros(8, np.int32))
+        assert arr.size() == 8
