@@ -46,10 +46,11 @@ class MultiHeadAttention(Module):
 
     def __init__(self, hidden_size: int, num_heads: int, causal: bool = False,
                  dropout: float = 0.0, seq_axis_name: Optional[str] = None,
-                 seq_mode: str = "ring", name=None):
+                 seq_mode: str = "ring", use_flash: str = "auto", name=None):
         super().__init__(name)
         assert hidden_size % num_heads == 0
         assert seq_mode in ("ring", "ulysses")
+        assert use_flash in ("auto", "never", "always", "interpret")
         self.hidden_size = hidden_size
         self.num_heads = num_heads
         self.head_dim = hidden_size // num_heads
@@ -61,6 +62,22 @@ class MultiHeadAttention(Module):
         #: (all-to-all head re-sharding, parallel/ulysses.py).
         self.seq_axis_name = seq_axis_name
         self.seq_mode = seq_mode
+        #: "auto": the Pallas flash kernel (ops/flash_attention.py) on TPU
+        #: when T is block-aligned; plain attention otherwise.  "interpret"
+        #: forces the kernel in interpreter mode (CPU tests).
+        self.use_flash = use_flash
+
+    def _flash_ok(self, t):
+        if self.use_flash == "never" or self.seq_axis_name is not None:
+            return False
+        if self.use_flash in ("always", "interpret"):
+            return True
+        if t % 128:
+            return False
+        try:
+            return jax.devices()[0].platform == "tpu"
+        except Exception:
+            return False
 
     def setup(self, rng, input_spec):
         d = self.hidden_size
@@ -90,6 +107,14 @@ class MultiHeadAttention(Module):
             y = ring_self_attention(q.reshape(shape), k.reshape(shape),
                                     v.reshape(shape), self.seq_axis_name,
                                     causal=self.causal)
+        elif self._flash_ok(t):
+            from bigdl_tpu.ops.flash_attention import flash_attention
+
+            bq = t if t < 128 else 128
+            y = flash_attention(q.reshape(shape), k.reshape(shape),
+                                v.reshape(shape), causal=self.causal,
+                                block_q=bq, block_k=bq,
+                                interpret=self.use_flash == "interpret")
         else:
             y = dot_product_attention(q.reshape(shape), k.reshape(shape),
                                       v.reshape(shape), causal=self.causal)

@@ -40,3 +40,33 @@ class TestFlashAttention:
                               interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestMHAFlashWiring:
+    def test_mha_flash_matches_plain(self):
+        """MultiHeadAttention(use_flash='interpret') must match the plain
+        path (the wiring the TPU 'auto' mode takes)."""
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(0)
+        plain = MultiHeadAttention(32, 4, causal=True, use_flash="never")
+        plain.build(jax.ShapeDtypeStruct((2, 16, 32), jnp.float32))
+        RNG.set_seed(0)
+        flash = MultiHeadAttention(32, 4, causal=True,
+                                   use_flash="interpret")
+        flash.build(jax.ShapeDtypeStruct((2, 16, 32), jnp.float32))
+
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((2, 16, 32)),
+            jnp.float32)
+        y_plain = plain.forward(x)
+        y_flash = flash.forward(x)
+        np.testing.assert_allclose(np.asarray(y_flash),
+                                   np.asarray(y_plain),
+                                   rtol=2e-5, atol=2e-5)
