@@ -95,12 +95,17 @@ class LayerNorm(Module):
         }, ()
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        x32 = input.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=-1, keepdims=True)
-        var = jnp.var(x32, axis=-1, keepdims=True)
-        y = (x32 - mean) * lax.rsqrt(var + self.eps)
-        y = y * params["weight"] + params["bias"]
-        return y.astype(input.dtype), state
+        # fp32-accumulated statistics, normalise in the input dtype (fused
+        # like BatchNormalization above -- no fp32 activation copy)
+        mean = jnp.mean(input, axis=-1, keepdims=True, dtype=jnp.float32)
+        sq = jnp.mean(jnp.square(input.astype(jnp.float32)), axis=-1,
+                      keepdims=True, dtype=jnp.float32)
+        var = jnp.maximum(sq - jnp.square(mean), 0.0)
+        inv = lax.rsqrt(var + self.eps)
+        dt = input.dtype
+        y = (input - mean.astype(dt)) * inv.astype(dt)
+        y = y * params["weight"].astype(dt) + params["bias"].astype(dt)
+        return y, state
 
 
 class RMSNorm(Module):
@@ -115,9 +120,10 @@ class RMSNorm(Module):
         return {"weight": jnp.ones((self.n_output,), jnp.float32)}, ()
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        x32 = input.astype(jnp.float32)
-        y = x32 * lax.rsqrt(jnp.mean(jnp.square(x32), -1, keepdims=True) + self.eps)
-        return (y * params["weight"]).astype(input.dtype), state
+        sq = jnp.mean(jnp.square(input.astype(jnp.float32)), -1,
+                      keepdims=True, dtype=jnp.float32)
+        inv = lax.rsqrt(sq + self.eps).astype(input.dtype)
+        return input * inv * params["weight"].astype(input.dtype), state
 
 
 class Dropout(Module):
