@@ -193,3 +193,61 @@ class TestDataSetFactories:
         arr = DataSet.array(np.zeros((8, 3), np.float32),
                             np.zeros(8, np.int32))
         assert arr.size() == 8
+
+
+class TestReferenceRealImages:
+    """Real image files from the reference's own test resources through our
+    ingestion (no synthetic data): CIFAR pngs + ImageNet JPEGs."""
+
+    CIFAR_DIR = "/root/reference/spark/dl/src/test/resources/cifar"
+    IMAGENET_DIR = "/root/reference/spark/dl/src/test/resources/imagenet"
+
+    def test_reference_cifar_pngs(self):
+        if not os.path.isdir(self.CIFAR_DIR):
+            pytest.skip("reference resources unavailable")
+        ds = image_folder(self.CIFAR_DIR, shuffle_on_epoch=False)
+        assert ds.classes == ["airplane", "deer"]
+        samples = list(ds.data(train=False))
+        assert len(samples) >= 4
+        for s in samples:
+            assert s.feature.shape == (32, 32, 3)
+            assert 0.0 <= float(s.feature.min()) <= float(s.feature.max()) <= 1.0
+
+    def test_reference_imagenet_jpegs_resized(self):
+        if not os.path.isdir(self.IMAGENET_DIR):
+            pytest.skip("reference resources unavailable")
+        ds = image_folder(self.IMAGENET_DIR, size=(224, 224),
+                          shuffle_on_epoch=False)
+        assert len(ds.classes) == 4
+        s = next(iter(ds.data(train=False)))
+        assert s.feature.shape == (224, 224, 3)
+
+    def test_train_on_reference_cifar_images(self):
+        """Short end-to-end fit on the reference's real pngs."""
+        if not os.path.isdir(self.CIFAR_DIR):
+            pytest.skip("reference resources unavailable")
+        import jax
+
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu import optim
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        ds = image_folder(self.CIFAR_DIR, shuffle_on_epoch=False)
+        samples = list(ds.data(train=False))
+        x = np.stack([s.feature for s in samples])
+        y = np.asarray([int(s.label) for s in samples], np.int32)
+
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(3, 8, 3, 3, 2, 2, 1, 1))
+                 .add(nn.ReLU()).add(nn.Reshape((8 * 16 * 16,)))
+                 .add(nn.Linear(8 * 16 * 16, 2)))
+        opt = LocalOptimizer(
+            model, array_dataset(x, y) >> SampleToMiniBatch(len(x)),
+            nn.CrossEntropyCriterion(),
+            optim.SGD(learning_rate=0.05, momentum=0.9))
+        opt.set_end_when(Trigger.max_iteration(30))
+        opt.optimize()
+        logits = np.asarray(model.forward(jnp.asarray(x)))
+        assert (logits.argmax(1) == y).mean() >= 0.8
