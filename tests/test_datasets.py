@@ -226,8 +226,6 @@ class TestReferenceRealImages:
         """Short end-to-end fit on the reference's real pngs."""
         if not os.path.isdir(self.CIFAR_DIR):
             pytest.skip("reference resources unavailable")
-        import jax
-
         import bigdl_tpu.nn as nn
         from bigdl_tpu import optim
         from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset

@@ -1,0 +1,96 @@
+"""Tunnel-proof ResNet-50 step timing via value fetches (no loop primitives).
+
+Two bracketing measurements on the SAME compiled step:
+
+  lower  -- dispatch N chained steps (params/state/opt donated, so step i+1
+            consumes step i's outputs), then fetch the FINAL loss *value*.
+            The value cannot exist before all N executions complete, so
+            total/N >= true step time as N grows (one RTT amortised).
+
+  upper  -- fetch the loss value after EVERY step: dispatch + execute +
+            device->host RTT per iteration; true step time + RTT.
+
+If these disagree with block_until_ready-based timings, the discrepancy is
+the tunnel artifact VERDICT r2 Weak #1 describes.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim.train_step import make_train_step
+
+    batch = int(os.environ.get("PROF_BATCH", "128"))
+    n_lower = int(os.environ.get("PROF_STEPS", "50"))
+
+    model = ResNet(depth=50, class_num=1000)
+    model.build(jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16))
+    params, mstate = model.parameters()[0], model.state()
+    method = optim.SGD(learning_rate=0.02, momentum=0.9, dampening=0.0,
+                       weight_decay=1e-4)
+    opt_state = method.init_state(params)
+    step = jax.jit(
+        make_train_step(model, CrossEntropyCriterion(), method,
+                        compute_dtype=jnp.bfloat16),
+        donate_argnums=(0, 1, 2))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),
+                    dtype=jnp.bfloat16)
+    t = jnp.asarray(rng.integers(0, 1000, batch), dtype=jnp.int32)
+    key = jax.random.key(0)
+
+    compiled = step.lower(params, mstate, opt_state, x, t, key).compile()
+    flops = float(compiled.cost_analysis()["flops"])
+    print(f"compiled; flops/step = {flops:.4e}", flush=True)
+
+    # warmup
+    for _ in range(3):
+        params, mstate, opt_state, loss = compiled(params, mstate, opt_state,
+                                                   x, t, key)
+    print(f"warmup loss value = {float(loss):.4f}", flush=True)
+
+    # ---- lower bound: N chained dispatches, fetch final loss value ----
+    t0 = time.perf_counter()
+    for _ in range(n_lower):
+        params, mstate, opt_state, loss = compiled(params, mstate, opt_state,
+                                                   x, t, key)
+    final = float(loss)          # value fetch: forces the whole chain
+    dt = time.perf_counter() - t0
+    print(f"lower (N={n_lower} chained + final value fetch): "
+          f"{dt/n_lower*1e3:7.2f} ms/step  (loss={final:.4f})", flush=True)
+    lower = dt / n_lower
+
+    # ---- upper bound: value fetch every step ----
+    times = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        params, mstate, opt_state, loss = compiled(params, mstate, opt_state,
+                                                   x, t, key)
+        v = float(loss)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    upper = times[len(times) // 2]
+    print(f"upper (per-step value fetch, median of 20): {upper*1e3:7.2f} ms/step",
+          flush=True)
+    print(f"per-step spread p10={times[2]*1e3:.2f} p90={times[18]*1e3:.2f}",
+          flush=True)
+
+    peak = 197e12
+    print(f"\nMFU bracket: [{flops/upper/peak:.4f}, {flops/lower/peak:.4f}]",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Tunnel-proof ResNet-50 step timing: K chained steps inside ONE jit.
+
+A ``lax.fori_loop`` over the train step forces the device to execute K
+sequential steps per dispatch -- no host round-trip, no async-dispatch
+artifact can hide or duplicate work.  Fetching the final loss VALUE (not
+just block_until_ready) proves execution completed.  Timing two different
+K values separates fixed dispatch/tunnel overhead from per-step device
+time:  t(K) = a + b*K  =>  b is the real sec/step.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim.train_step import make_train_step
+
+    batch = int(os.environ.get("PROF_BATCH", "128"))
+    model = ResNet(depth=50, class_num=1000)
+    model.build(jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16))
+    params, mstate = model.parameters()[0], model.state()
+    method = optim.SGD(learning_rate=0.02, momentum=0.9, dampening=0.0,
+                       weight_decay=1e-4)
+    opt_state = method.init_state(params)
+    step = make_train_step(model, CrossEntropyCriterion(), method,
+                           compute_dtype=jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),
+                    dtype=jnp.bfloat16)
+    t = jnp.asarray(rng.integers(0, 1000, batch), dtype=jnp.int32)
+
+    def k_steps(params, mstate, opt_state, x, t, k):
+        def body(i, carry):
+            p, ms, os_, _ = carry
+            key = jax.random.fold_in(jax.random.key(0), i)
+            return step(p, ms, os_, x, t, key)
+        loss0 = jnp.float32(0.0)
+        return jax.lax.fori_loop(0, k, body, (params, mstate, opt_state, loss0))
+
+    results = {}
+    for k in (4, 32):
+        f = jax.jit(k_steps, static_argnums=(5,))
+        lowered = f.lower(params, mstate, opt_state, x, t, k)
+        c = lowered.compile()
+        flops = float(c.cost_analysis()["flops"])
+        # warmup once (fetch loss value to force completion)
+        out = c(params, mstate, opt_state, x, t)
+        lossv = float(out[3])
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = c(params, mstate, opt_state, x, t)
+            lossv = float(out[3])  # host fetch of the value: cannot fake
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        results[k] = (times[len(times) // 2], flops, lossv)
+        print(f"K={k:3d}: total={results[k][0]*1e3:9.2f} ms  "
+              f"per-step={results[k][0]/k*1e3:7.2f} ms  "
+              f"flops/step={flops/k:.3e}  loss_after_K={lossv:.4f}")
+
+    (t4, f4, _), (t32, f32, _) = results[4], results[32]
+    b = (t32 - t4) / (32 - 4)          # marginal per-step device time
+    a = t4 - 4 * b                      # fixed dispatch overhead
+    fl_step = (f32 - f4) / (32 - 4)
+    peak = 197e12
+    print(f"\nfixed overhead a = {a*1e3:.2f} ms/dispatch")
+    print(f"marginal step  b = {b*1e3:.2f} ms/step")
+    print(f"flops/step = {fl_step:.3e}")
+    print(f"=> device MFU = {fl_step / b / peak:.4f}")
+
+
+if __name__ == "__main__":
+    main()
