@@ -65,10 +65,15 @@ def run_bench():
                         compute_dtype=jnp.bfloat16),
         donate_argnums=(0, 1, 2))
 
+    # Distinct input batches, cycled, so no layer of the stack can dedup or
+    # cache "the same computation" (every step differs in BOTH params --
+    # donated chain -- and data).
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),
-                    dtype=jnp.bfloat16)
-    t = jnp.asarray(rng.integers(0, 1000, batch), dtype=jnp.int32)
+    xs = [jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),
+                      dtype=jnp.bfloat16) for _ in range(4)]
+    ts = [jnp.asarray(rng.integers(0, 1000, batch), dtype=jnp.int32)
+          for _ in range(4)]
+    x, t = xs[0], ts[0]
     key = jax.random.key(0)
 
     lowered = step.lower(params, mstate, opt_state, x, t, key)
@@ -84,29 +89,33 @@ def run_bench():
             params, mstate, opt_state, x, t, key)
     jax.block_until_ready((params, mstate, opt_state, loss))
 
-    # Async timing (dispatch loop, block once at the end on ALL outputs).
-    # Recorded for diagnostics only -- through the axon tunnel, blocking on
-    # a single output buffer demonstrably undercounted by ~21x in round 2
-    # (recorded 274% MFU; see VERDICT.md round 2, Weak #1).
+    # Authoritative timing: N chained dispatches (params/opt state donated,
+    # so step i+1 consumes step i's outputs -- a serial device-side
+    # dependency chain), then fetch the final loss VALUE.  The value cannot
+    # exist before all N steps execute, so total/N is true device
+    # throughput with the tunnel RTT amortised.  block_until_ready-based
+    # per-step timing is NOT trustworthy through the axon tunnel: round 2
+    # recorded 274% MFU from async dispatch, and round 3 measured per-step
+    # blocked times BELOW the compute floor (pipelining leaks through).
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
         params, mstate, opt_state, loss = compiled(
-            params, mstate, opt_state, x, t, key)
-    jax.block_until_ready((params, mstate, opt_state, loss))
-    dt_async = time.perf_counter() - t0
+            params, mstate, opt_state, xs[i % 4], ts[i % 4], key)
+    final_loss = float(loss)          # forces the whole chain
+    dt_chain = time.perf_counter() - t0
+    sec_per_step = dt_chain / steps
 
-    # Blocked timing (authoritative): block on EVERY step's full output set
-    # so no async/tunnel artifact can hide device time.  Median of per-step
-    # times is the reported number.
+    # Diagnostic: per-step value fetch = step time + device->host RTT
+    # (upper bound on step time; useful to spot tunnel latency).
     per_step = []
-    for _ in range(steps):
+    for i in range(steps):
         t0 = time.perf_counter()
         params, mstate, opt_state, loss = compiled(
-            params, mstate, opt_state, x, t, key)
-        jax.block_until_ready((params, mstate, opt_state, loss))
+            params, mstate, opt_state, xs[i % 4], ts[i % 4], key)
+        float(loss)
         per_step.append(time.perf_counter() - t0)
     per_step.sort()
-    sec_per_step = per_step[len(per_step) // 2]
+    sec_per_step_fetch = per_step[len(per_step) // 2]
 
     imgs_per_sec = batch / sec_per_step
     # bf16 peak FLOP/s by device kind; CPU: meaningless, use 1 TF.
@@ -122,6 +131,24 @@ def run_bench():
     else:  # v5e and unknown TPUs: assume v5e (197 TFLOP/s bf16)
         peak = 197e12
     mfu = (flops_per_step / sec_per_step) / peak
+    mfu_fetch = (flops_per_step / sec_per_step_fetch) / peak
+
+    # A physically impossible MFU means the measurement (or the flops/peak
+    # model) is broken, not that the chip is fast (round-2 regression
+    # guard).  Fall back to the per-step-fetch number -- an upper bound on
+    # step time, hence a LOWER bound on MFU -- before declaring invalid.
+    error = None
+    invalid = False
+    if platform != "cpu" and not (0.0 < mfu <= 1.0):
+        if 0.0 < mfu_fetch <= 1.0:
+            error = (f"chained mfu={mfu:.4f} impossible; reporting the "
+                     f"conservative per-step-fetch bound instead")
+            sec_per_step, mfu = sec_per_step_fetch, mfu_fetch
+            imgs_per_sec = batch / sec_per_step
+        else:
+            invalid = True
+            error = (f"measurement invalid: mfu={mfu:.4f} and fetch bound "
+                     f"mfu={mfu_fetch:.4f} both outside (0, 1]")
 
     record = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
@@ -135,21 +162,19 @@ def run_bench():
             "batch": batch,
             "steps": steps,
             "sec_per_step": round(sec_per_step, 4),
-            "sec_per_step_async": round(dt_async / steps, 4),
-            "sec_per_step_p10": round(per_step[len(per_step) // 10], 4),
-            "sec_per_step_p90": round(per_step[(len(per_step) * 9) // 10], 4),
+            "sec_per_step_chained": round(dt_chain / steps, 4),
+            "sec_per_step_fetch": round(sec_per_step_fetch, 4),
+            "fetch_p10": round(per_step[len(per_step) // 10], 4),
+            "fetch_p90": round(per_step[(len(per_step) * 9) // 10], 4),
             "mfu": round(mfu, 4),
             "flops_per_step": flops_per_step,
-            "loss": float(loss),
+            "loss": final_loss,
         },
     }
-    # A physically impossible MFU means the measurement is broken, not that
-    # the chip is fast.  Refuse to emit a number >100% of peak (round-2
-    # regression guard).
-    if platform != "cpu" and not (0.0 < mfu <= 1.0):
+    if error is not None:
+        record["extra"]["error"] = error
+    if invalid:
         record["vs_baseline"] = 0.0
-        record["extra"]["error"] = (
-            f"measurement invalid: mfu={mfu:.4f} outside (0, 1]")
     print(json.dumps(record))
 
 

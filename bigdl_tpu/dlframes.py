@@ -109,3 +109,97 @@ class DLClassifier(DLEstimator):
 
     def _prepare_labels(self, y):
         return np.asarray(y, np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Image DataFrames (reference: dlframes/DLImageReader.scala,
+# DLImageTransformer.scala; schema DLImageSchema.byteSchema/floatSchema --
+# compatible with the Spark 2.3 image format: origin/height/width/nChannels/
+# mode/data with row-wise BGR bytes)
+# ---------------------------------------------------------------------------
+
+#: OpenCV type codes used in the ``mode`` field (CvType.CV_8UC1 etc.)
+CV_8UC1, CV_8UC3, CV_32FC1, CV_32FC3 = 0, 16, 5, 21
+
+IMAGE_SCHEMA = ("origin", "height", "width", "nChannels", "mode", "data")
+
+
+def _imf_to_row(origin, img_hwc_rgb, float_data):
+    """HWC RGB float image -> schema dict (data row-wise BGR like OpenCV)."""
+    import numpy as np
+
+    h, w = img_hwc_rgb.shape[:2]
+    c = 1 if img_hwc_rgb.ndim == 2 else img_hwc_rgb.shape[2]
+    bgr = img_hwc_rgb[..., ::-1] if c == 3 else img_hwc_rgb
+    if float_data:
+        mode = CV_32FC3 if c == 3 else CV_32FC1
+        data = np.ascontiguousarray(bgr, np.float32)
+    else:
+        mode = CV_8UC3 if c == 3 else CV_8UC1
+        data = np.ascontiguousarray(np.clip(bgr, 0, 255), np.uint8).tobytes()
+    return {"origin": origin, "height": h, "width": w, "nChannels": c,
+            "mode": mode, "data": data}
+
+
+def _row_to_image(row):
+    """schema dict -> HWC RGB float32 array."""
+    import numpy as np
+
+    h, w, c = row["height"], row["width"], row["nChannels"]
+    if isinstance(row["data"], bytes):
+        arr = np.frombuffer(row["data"], np.uint8).astype(np.float32)
+    else:
+        arr = np.asarray(row["data"], np.float32)
+    arr = arr.reshape(h, w, c)
+    return arr[..., ::-1] if c == 3 else arr
+
+
+class DLImageReader:
+    """Read an image directory into a list of schema rows, one ``image``
+    column per row (reference: DLImageReader.readImages --
+    dlframes/DLImageReader.scala; the Spark DataFrame becomes a plain list
+    of dict rows in this py-first runtime)."""
+
+    @staticmethod
+    def read_images(path) -> list:
+        import os
+
+        from bigdl_tpu.dataset.image_folder import _EXTS, decode_image
+
+        paths = []
+        for root, _dirs, names in sorted(os.walk(path)):
+            for name in sorted(names):
+                if name.lower().endswith(_EXTS):
+                    paths.append(os.path.join(root, name))
+        rows = []
+        for p in paths:
+            img = decode_image(p) * 255.0   # HWC RGB float32 0..255
+            rows.append({"image": _imf_to_row("file://" + str(p), img,
+                                              float_data=False)})
+        return rows
+
+
+class DLImageTransformer:
+    """Apply a vision FeatureTransformer chain to the image column
+    (reference: dlframes/DLImageTransformer.scala: transform -> float
+    schema rows ready for DLModel/DLClassifierModel)."""
+
+    def __init__(self, transformer, input_col="image", output_col="output"):
+        self.transformer = transformer
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, rows: list) -> list:
+        from bigdl_tpu.transform.vision import ImageFeature
+
+        out = []
+        for row in rows:
+            src = row[self.input_col]
+            feat = ImageFeature(_row_to_image(src), path=src.get("origin"))
+            feat = self.transformer(feat)
+            new = dict(row)
+            new[self.output_col] = _imf_to_row(
+                src.get("origin"), np.asarray(feat["image"], np.float32),
+                float_data=True)
+            out.append(new)
+        return out

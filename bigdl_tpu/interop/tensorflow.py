@@ -259,6 +259,12 @@ def _convert_node(ctx, ndef):
         ctx.module_blobs.append((mod, install))
         return "node", node
 
+    if op in ("NoOp", "Assert"):
+        # ordering/validation-only nodes: nothing to compute (the reference
+        # maps these to ControlDependency/Assert pass-throughs)
+        return "const", np.zeros((), np.float32)
+    if op == "BiasAddV1":
+        op = "BiasAdd"
     if op == "BiasAdd" or (op in ("Add", "AddV2") and len(ins) == 2):
         a_kind, a_val = _convert(ctx, ins[0])
         b_kind, b_val = _convert(ctx, ins[1])
@@ -854,7 +860,250 @@ def _convert_node(ctx, ndef):
     if op == "Shape":
         raise NotImplementedError(
             "dynamic Shape op (import the inference subgraph only)")
+
+    extra = _convert_extra_op(ctx, ndef, op, ins)
+    if extra is not None:
+        return extra
     raise NotImplementedError(f"TF op {op} has no converter")
+
+
+def _convert_extra_op(ctx, ndef, op, ins):
+    """Wide op coverage: elementwise math, comparisons and explicit-gradient
+    ops (reference: utils/tf/loaders/ -- one loader class per op, 161 total;
+    the *Grad ops appear in TF training graphs, which Session training
+    imports -- Session.scala:105).  Returns None for unknown ops."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import ops as nnops
+    from bigdl_tpu.nn.graph import Node
+    from bigdl_tpu.nn.module import Module
+
+    def unary_node(mod):
+        """Emit a unary op, folding constant operands through the module's
+        own apply (frozen graphs do shape math with these)."""
+        kind, val = _convert(ctx, ins[0])
+        if kind == "const":
+            out, _ = mod.apply({}, (), jnp.asarray(val))
+            return "const", np.asarray(out)
+        return "node", Node(mod, [val])
+
+    def bin_node(fn, in_a, in_b):
+        """Emit a binary op with any mix of node/const operands."""
+        a_kind, a_val = _convert(ctx, in_a)
+        b_kind, b_val = _convert(ctx, in_b)
+        if a_kind == "const" and b_kind == "const":
+            return "const", np.asarray(fn(jnp.asarray(a_val),
+                                          jnp.asarray(b_val)))
+
+        class _Bin2(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                if a_kind == "node" and b_kind == "node":
+                    a, b = input
+                elif a_kind == "const":
+                    a, b = jnp.asarray(a_val), input
+                else:
+                    a, b = input, jnp.asarray(b_val)
+                return fn(a, b), state
+        parents = [v for k, v in ((a_kind, a_val), (b_kind, b_val))
+                   if k == "node"]
+        return "node", Node(_Bin2(), parents)
+
+    unary = {
+        "Ceil": nnops.Ceil, "Round": nnops.Round, "Rint": nnops.Rint,
+        "Sign": nnops.Sign, "Expm1": nnops.Expm1, "Erf": nnops.Erf,
+        "Erfc": nnops.Erfc, "Lgamma": nnops.Lgamma,
+        "Digamma": nnops.Digamma, "Inv": nnops.Inv,
+        "Reciprocal": nnops.Inv, "IsFinite": nnops.IsFinite,
+        "IsInf": nnops.IsInf, "IsNan": nnops.IsNan, "Rank": nnops.Rank,
+        "L2Loss": nnops.L2Loss,
+    }
+    if op in unary:
+        return unary_node(unary[op]())
+    if op == "Log1p":
+        class _Log1p(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                return jnp.log1p(input), state
+        return unary_node(_Log1p())
+
+    binary = {
+        "Div": jnp.divide, "FloorDiv": jnp.floor_divide, "Mod": jnp.fmod,
+        "FloorMod": jnp.mod, "TruncateMod": jnp.fmod,
+        "TruncateDiv": lambda a, b: jnp.trunc(a / b).astype(a.dtype),
+        "SquaredDifference": lambda a, b: jnp.square(a - b),
+        # explicit-gradient ops out of tf.gradients graphs
+        "ReluGrad": lambda g, x: g * (x > 0).astype(g.dtype),
+        "Relu6Grad": lambda g, x: g * ((x > 0) & (x < 6)).astype(g.dtype),
+        "SigmoidGrad": lambda y, g: g * y * (1.0 - y),
+        "TanhGrad": lambda y, g: g * (1.0 - jnp.square(y)),
+        "SqrtGrad": lambda y, g: g * 0.5 / y,
+        "RsqrtGrad": lambda y, g: -0.5 * g * y * y * y,
+        "SoftplusGrad": lambda g, x: g * jax.nn.sigmoid(x),
+        "SoftsignGrad": lambda g, x: g / jnp.square(1.0 + jnp.abs(x)),
+        "EluGrad": lambda g, y: g * jnp.where(y > 0, 1.0, y + 1.0),
+        "InvGrad": lambda y, g: -g * y * y,
+        "ReciprocalGrad": lambda y, g: -g * y * y,
+    }
+    if op in binary:
+        return bin_node(binary[op], ins[0], ins[1])
+
+    if op == "ApproximateEqual":
+        tol = float(ndef.attr["tolerance"].f) or 1e-5
+        return bin_node(lambda x, y: jnp.abs(x - y) < tol, ins[0], ins[1])
+
+    if op in ("BatchMatMul", "BatchMatMulV2"):
+        adj_x = bool(ndef.attr["adj_x"].b)
+        adj_y = bool(ndef.attr["adj_y"].b)
+
+        def bmm(x, y):
+            if adj_x:
+                x = jnp.swapaxes(x, -1, -2)
+            if adj_y:
+                y = jnp.swapaxes(y, -1, -2)
+            return jnp.matmul(x, y)
+        return bin_node(bmm, ins[0], ins[1])
+
+    if op == "ArgMax":
+        axis = int(_const_of(ctx, ins[1]).ravel()[0])
+        return "node", Node(nnops.ArgMax(axis), [_node_of(ctx, ins[0])])
+
+    if op in ("TopK", "TopKV2"):
+        if op == "TopK":
+            k = int(ndef.attr["k"].i)
+        else:
+            k = int(_const_of(ctx, ins[1]).ravel()[0])
+        x = _node_of(ctx, ins[0])
+
+        def pick(j):
+            class _TopKPart(Module):
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    return jax.lax.top_k(input, k)[j], state
+            return _TopKPart()
+        return "multi", [("node", Node(pick(0), [x])),
+                         ("node", Node(pick(1), [x]))]
+
+    if op in ("InTopK", "InTopKV2"):
+        if op == "InTopK":
+            k = int(ndef.attr["k"].i)
+        else:
+            k = int(_const_of(ctx, ins[2]).ravel()[0])
+        return bin_node(
+            lambda p, t: nnops.InTopK(k).apply({}, (), (p, t))[0],
+            ins[0], ins[1])
+
+    if op == "SoftmaxCrossEntropyWithLogits":
+        logits = _node_of(ctx, ins[0])
+        labels = _node_of(ctx, ins[1])
+
+        class _SoftmaxXent(Module):
+            """-> (loss (N,), backprop (N, C)) like the TF op."""
+
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                lg, lb = input
+                lsm = jax.nn.log_softmax(lg, axis=-1)
+                return -jnp.sum(lb * lsm, axis=-1), state
+
+        class _SoftmaxXentGrad(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                lg, lb = input
+                return jax.nn.softmax(lg, axis=-1) - lb, state
+        return "multi", [
+            ("node", Node(_SoftmaxXent(), [logits, labels])),
+            ("node", Node(_SoftmaxXentGrad(), [logits, labels]))]
+
+    if op == "BiasAddGrad":
+        g = _node_of(ctx, ins[0])
+
+        class _BiasAddGrad(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                return jnp.sum(input, axis=tuple(range(input.ndim - 1))), \
+                    state
+        return "node", Node(_BiasAddGrad(), [g])
+
+    if op == "SegmentSum":
+        data = _node_of(ctx, ins[0])
+        seg_kind, seg_val = _convert(ctx, ins[1])
+        if seg_kind == "const":
+            num = int(np.max(seg_val)) + 1
+
+            class _SegSumC(Module):
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    return jax.ops.segment_sum(
+                        input, jnp.asarray(seg_val, jnp.int32),
+                        num_segments=num), state
+            return "node", Node(_SegSumC(), [data])
+        return "node", Node(nnops.SegmentSum(), [data, seg_val])
+
+    if op == "RandomShuffle":
+        x = _node_of(ctx, ins[0])
+        seed = int(ndef.attr["seed"].i)
+
+        class _RandomShuffle(Module):
+            """Shuffle along axis 0 (reference: loaders/RandomShuffle.scala)."""
+
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                key = rng if rng is not None else jax.random.key(seed)
+                return jax.random.permutation(key, input, axis=0), state
+        return "node", Node(_RandomShuffle(), [x])
+
+    if op == "RandomUniform":
+        shape = tuple(int(v) for v in _const_of(ctx, ins[0]).ravel())
+        seed = int(ndef.attr["seed"].i)
+
+        class _RandomUniform(Module):
+            """Deterministic under the framework rng (reference:
+            loaders/RandomUniform.scala seeds the BigDL RNG)."""
+
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                key = rng if rng is not None else jax.random.key(seed)
+                return jax.random.uniform(key, shape), state
+        return "node", Node(_RandomUniform(), [])
+
+    if op == "ResizeBilinear":
+        size = tuple(int(v) for v in _const_of(ctx, ins[1]).ravel())
+        align = bool(ndef.attr["align_corners"].b)
+        half_pixel = bool(ndef.attr["half_pixel_centers"].b)
+        if align:
+            raise NotImplementedError("ResizeBilinear align_corners=True")
+        x = _node_of(ctx, ins[0])
+
+        class _ResizeBilinear(Module):
+            """TF1 legacy grid (src = dst*scale) or half-pixel centers,
+            per the half_pixel_centers attr."""
+
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                out_shape = (input.shape[0],) + size + (input.shape[-1],)
+                if half_pixel:
+                    return jax.image.resize(input, out_shape,
+                                            "bilinear"), state
+                in_h, in_w = input.shape[1], input.shape[2]
+                out = input
+                for axis, (n_in, n_out) in ((1, (in_h, size[0])),
+                                            (2, (in_w, size[1]))):
+                    src = jnp.arange(n_out) * (n_in / n_out)
+                    lo = jnp.clip(jnp.floor(src).astype(jnp.int32),
+                                  0, n_in - 1)
+                    hi = jnp.clip(lo + 1, 0, n_in - 1)
+                    w = (src - lo).astype(input.dtype)
+                    shape = [1] * out.ndim
+                    shape[axis] = n_out
+                    w = w.reshape(shape)
+                    out = (jnp.take(out, lo, axis=axis) * (1 - w)
+                           + jnp.take(out, hi, axis=axis) * w)
+                return out, state
+        return "node", Node(_ResizeBilinear(), [x])
+
+    return None
 
 
 def _branch_switches(ctx, seed, stop_ok=True):

@@ -249,3 +249,49 @@ class TestReferenceRealImages:
         opt.optimize()
         logits = np.asarray(model.forward(jnp.asarray(x)))
         assert (logits.argmax(1) == y).mean() >= 0.8
+
+
+class TestDLImageFrames:
+    """DLImageReader/DLImageTransformer on the reference's real test images
+    (reference: dlframes/DLImageReader.scala, DLImageTransformer.scala)."""
+
+    IMAGENET_DIR = "/root/reference/spark/dl/src/test/resources/imagenet"
+
+    def test_read_images_schema(self):
+        if not os.path.isdir(self.IMAGENET_DIR):
+            pytest.skip("reference resources unavailable")
+        from bigdl_tpu.dlframes import CV_8UC3, DLImageReader
+
+        rows = DLImageReader.read_images(self.IMAGENET_DIR)
+        assert len(rows) > 0
+        for row in rows:
+            img = row["image"]
+            assert img["origin"].startswith("file://")
+            assert img["nChannels"] == 3 and img["mode"] == CV_8UC3
+            assert isinstance(img["data"], bytes)
+            assert len(img["data"]) == img["height"] * img["width"] * 3
+
+    def test_transform_to_float_rows(self):
+        if not os.path.isdir(self.IMAGENET_DIR):
+            pytest.skip("reference resources unavailable")
+        from bigdl_tpu.dlframes import (CV_32FC3, DLImageReader,
+                                        DLImageTransformer, _row_to_image)
+        from bigdl_tpu.transform.vision import (CenterCrop, ChannelNormalize,
+                                                Resize)
+
+        rows = DLImageReader.read_images(self.IMAGENET_DIR)
+        chain = (Resize(256, 256) >> CenterCrop(224, 224) >>
+                 ChannelNormalize([124.0, 117.0, 104.0], [58.6, 57.1, 57.4]))
+        out = DLImageTransformer(chain).transform(rows)
+        assert len(out) == len(rows)
+        for row in out:
+            t = row["output"]
+            assert t["mode"] == CV_32FC3
+            assert (t["height"], t["width"]) == (224, 224)
+            img = _row_to_image(t)
+            assert img.shape == (224, 224, 3)
+            assert abs(float(img.mean())) < 3.0   # normalized scale
+        # round-trip: byte row decodes back to the original pixels
+        img0 = _row_to_image(rows[0]["image"])
+        assert img0.shape == (rows[0]["image"]["height"],
+                              rows[0]["image"]["width"], 3)

@@ -304,3 +304,151 @@ class TestNativeRecordReader:
         open(path, "wb").write(bytes(raw))
         with pytest.raises(ValueError, match="crc"):
             list(TFRecordReader(path, use_native=True))
+
+
+class TestExtraOpLoaders:
+    """Round-3 wide coverage: elementwise math, comparisons, grad ops
+    (reference: utils/tf/loaders/{Ceil,Round,Erf,Div,TopKV2,...}.scala)."""
+
+    _roundtrip = TestNewOpLoaders._roundtrip
+
+    def test_unary_math_chain(self):
+        x = (np.random.randn(3, 5) * 3).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (3, 5), name="x")
+            t = tf.math.ceil(p) + tf.math.round(p) + tf.math.sign(p)
+            t = t + tf.math.rint(p) + tf.math.erf(p) + tf.math.erfc(p)
+            tf.identity(t + tf.math.log1p(tf.abs(p)) +
+                        tf.math.expm1(p / 10.0), name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_gamma_functions(self):
+        x = np.abs(np.random.randn(4, 4)).astype(np.float32) + 0.5
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (4, 4), name="x")
+            tf.identity(tf.math.lgamma(p) + tf.math.digamma(p), name="out")
+        self._roundtrip(build, {"x": x}, "out", rtol=1e-4)
+
+    def test_reciprocal_isfinite(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        x[0, 0] = 0.0
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (3, 4), name="x")
+            r = tf.math.reciprocal(p)
+            tf.identity(tf.where(tf.math.is_finite(r), r,
+                                 tf.zeros_like(r)), name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_div_variants(self):
+        a = (np.random.randn(3, 4) * 5).astype(np.float32)
+        b = (np.abs(np.random.randn(3, 4)) + 0.5).astype(np.float32)
+
+        def build(tf):
+            pa = tf.compat.v1.placeholder(tf.float32, (3, 4), name="a")
+            pb = tf.compat.v1.placeholder(tf.float32, (3, 4), name="b")
+            t = tf.math.divide(pa, pb) + tf.math.floordiv(pa, pb)
+            t = t + tf.math.floormod(pa, pb)
+            tf.identity(t + tf.math.squared_difference(pa, pb), name="out")
+        self._roundtrip(build, {"a": a, "b": b}, "out", rtol=1e-4)
+
+    def test_batch_matmul(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 5, 4).astype(np.float32)
+
+        def build(tf):
+            pa = tf.compat.v1.placeholder(tf.float32, (2, 3, 4), name="a")
+            pb = tf.compat.v1.placeholder(tf.float32, (2, 5, 4), name="b")
+            tf.identity(tf.matmul(pa, pb, adjoint_b=True), name="out")
+        self._roundtrip(build, {"a": a, "b": b}, "out")
+
+    def test_argmax_topk(self):
+        x = np.random.randn(4, 10).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (4, 10), name="x")
+            vals, idx = tf.math.top_k(p, k=3)
+            am = tf.math.argmax(p, axis=1)
+            tf.identity(vals + tf.cast(idx, tf.float32) +
+                        tf.cast(tf.expand_dims(am, 1), tf.float32),
+                        name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_in_top_k(self):
+        pred = np.random.randn(6, 8).astype(np.float32)
+        tgt = np.random.randint(0, 8, 6).astype(np.int32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (6, 8), name="p")
+            t = tf.compat.v1.placeholder(tf.int32, (6,), name="t")
+            tf.identity(tf.cast(tf.math.in_top_k(t, p, k=2), tf.float32),
+                        name="out")
+        self._roundtrip(build, {"p": pred, "t": tgt}, "out")
+
+    def test_softmax_xent_with_logits(self):
+        lg = np.random.randn(5, 7).astype(np.float32)
+        lb = np.random.dirichlet(np.ones(7), 5).astype(np.float32)
+
+        def build(tf):
+            pl = tf.compat.v1.placeholder(tf.float32, (5, 7), name="lg")
+            pb = tf.compat.v1.placeholder(tf.float32, (5, 7), name="lb")
+            loss, _grad = tf.raw_ops.SoftmaxCrossEntropyWithLogits(
+                features=pl, labels=pb)
+            tf.identity(loss, name="out")
+        self._roundtrip(build, {"lg": lg, "lb": lb}, "out")
+
+    def test_l2_loss_and_bias_add_grad(self):
+        g = np.random.randn(4, 5, 6).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (4, 5, 6), name="g")
+            l2 = tf.nn.l2_loss(p)
+            bag = tf.raw_ops.BiasAddGrad(out_backprop=p)
+            tf.identity(bag + l2, name="out")
+        self._roundtrip(build, {"g": g}, "out", rtol=1e-4)
+
+    def test_relu_tanh_sigmoid_grads(self):
+        g = np.random.randn(3, 4).astype(np.float32)
+        x = np.random.randn(3, 4).astype(np.float32)
+
+        def build(tf):
+            pg = tf.compat.v1.placeholder(tf.float32, (3, 4), name="g")
+            px = tf.compat.v1.placeholder(tf.float32, (3, 4), name="x")
+            t = tf.raw_ops.ReluGrad(gradients=pg, features=px)
+            y = tf.nn.sigmoid(px)
+            t += tf.raw_ops.SigmoidGrad(y=y, dy=pg)
+            yt = tf.nn.tanh(px)
+            t += tf.raw_ops.TanhGrad(y=yt, dy=pg)
+            tf.identity(t, name="out")
+        self._roundtrip(build, {"g": g, "x": x}, "out")
+
+    def test_segment_sum_const_ids(self):
+        x = np.random.randn(6, 4).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (6, 4), name="x")
+            ids = tf.constant([0, 0, 1, 1, 1, 2])
+            tf.identity(tf.math.segment_sum(p, ids), name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_resize_bilinear(self):
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3), name="x")
+            tf.identity(tf.compat.v1.image.resize_bilinear(p, (4, 4)),
+                        name="out")
+        self._roundtrip(build, {"x": x}, "out")
+
+    def test_approximate_equal(self):
+        a = np.random.randn(3, 3).astype(np.float32)
+        b = a + np.random.randn(3, 3).astype(np.float32) * 1e-6
+
+        def build(tf):
+            pa = tf.compat.v1.placeholder(tf.float32, (3, 3), name="a")
+            pb = tf.compat.v1.placeholder(tf.float32, (3, 3), name="b")
+            tf.identity(tf.cast(tf.raw_ops.ApproximateEqual(
+                x=pa, y=pb, tolerance=1e-3), tf.float32), name="out")
+        self._roundtrip(build, {"a": a, "b": b}, "out")
