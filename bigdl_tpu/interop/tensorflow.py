@@ -950,7 +950,8 @@ def _convert_extra_op(ctx, ndef, op, ins):
         return bin_node(binary[op], ins[0], ins[1])
 
     if op == "ApproximateEqual":
-        tol = float(ndef.attr["tolerance"].f) or 1e-5
+        tol = (float(ndef.attr["tolerance"].f)
+               if "tolerance" in ndef.attr else 1e-5)
         return bin_node(lambda x, y: jnp.abs(x - y) < tol, ins[0], ins[1])
 
     if op in ("BatchMatMul", "BatchMatMulV2"):

@@ -59,6 +59,30 @@ def _record_init(cls):
     cls.__init__ = __init__
 
 
+def _install_pending_after_setup(cls):
+    """Wrap ``cls.setup`` so arrays stored by set_weights /
+    set_state_entries BEFORE build install into the freshly created
+    params/state no matter who runs setup -- containers call child.setup
+    directly (never child.build), so without this hook pending weights on
+    nested unbuilt layers would be silently ignored."""
+    orig = cls.__dict__["setup"]
+
+    @functools.wraps(orig)
+    def setup(self, rng, input_spec):
+        p, s = orig(self, rng, input_spec)
+        pw = getattr(self, "_pending_weights", None)
+        if pw is not None:
+            self._pending_weights = None
+            self._install_weight_list(pw, tree=p)
+        ps = getattr(self, "_pending_state", None)
+        if ps is not None:
+            self._pending_state = None
+            self._install_state_entries(ps, tree=s)
+        return p, s
+
+    cls.setup = setup
+
+
 def _auto_name(cls_name: str) -> str:
     n = _name_counters.get(cls_name, 0)
     _name_counters[cls_name] = n + 1
@@ -79,6 +103,8 @@ class Module:
         super().__init_subclass__(**kw)
         if "__init__" in cls.__dict__:
             _record_init(cls)
+        if "setup" in cls.__dict__:
+            _install_pending_after_setup(cls)
 
     def __init__(self, name: Optional[str] = None):
         self.name = name or _auto_name(type(self).__name__)
@@ -125,12 +151,57 @@ class Module:
         self._build_spec = input_spec     # recorded for serialization
         self._params, self._state = self.setup(rng, input_spec)
         self._grads = None
+        # pending set_weights/set_state_entries arrays are normally
+        # installed by the setup wrapper (_install_pending_after_setup);
+        # classes inheriting the base no-param setup are not wrapped, so
+        # consume (and validate) any leftovers here
         pending = getattr(self, "_pending_weights", None)
         if pending is not None:
             self._pending_weights = None
-            # install directly: any layout conversion already happened in
-            # the (possibly overridden) set_weights that stored them
             self._install_weight_list(pending)
+        pending_state = getattr(self, "_pending_state", None)
+        if pending_state is not None:
+            self._pending_state = None
+            self._install_state_entries(pending_state)
+        return self
+
+    def set_state_entries(self, entries):
+        """Install {key: array} into the state pytree by leaf-dict key name
+        (e.g. BN running_mean/running_var).  Before build, kept pending and
+        installed when build() runs -- the state analogue of set_weights."""
+        import numpy as np
+
+        entries = {k: np.asarray(v, np.float32) for k, v in entries.items()}
+        if not self.is_built():
+            self._pending_state = entries
+            return self
+        return self._install_state_entries(entries)
+
+    def _install_state_entries(self, entries, tree=None):
+        hit = set()
+
+        def walk(t):
+            if isinstance(t, dict):
+                for k in list(t):
+                    if k in entries and hasattr(t[k], "shape"):
+                        want = tuple(t[k].shape)
+                        got = tuple(entries[k].shape)
+                        if want != got:
+                            raise ValueError(
+                                f"set_state_entries: shape {got} != "
+                                f"expected {want} for '{k}'")
+                        t[k] = jnp.asarray(entries[k])
+                        hit.add(k)
+                    else:
+                        walk(t[k])
+            elif isinstance(t, (tuple, list)):
+                for v in t:
+                    walk(v)
+        walk(self._state if tree is None else tree)
+        missing = set(entries) - hit
+        if missing:
+            raise ValueError(f"set_state_entries: no state leaves named "
+                             f"{sorted(missing)}")
         return self
 
     def _ensure_built(self, input: Activity):
@@ -179,7 +250,7 @@ class Module:
     # weight-list accessors (reference: Layer.get_weights/set_weights in
     # pyspark/bigdl/nn/layer.py:478-508 -- flat [weight, bias, ...] arrays
     # in layer traversal order)
-    def _weight_leaves(self):
+    def _weight_leaves(self, tree=None):
         """[(dict, key)] of param leaves, weight-before-bias per dict."""
         order = {"weight": 0, "bias": 1}
         found = []
@@ -195,7 +266,7 @@ class Module:
             elif isinstance(t, (tuple, list)):
                 for v in t:
                     walk(v)
-        walk(self._params)
+        walk(self._params if tree is None else tree)
         return found
 
     def get_weights(self):
@@ -216,8 +287,8 @@ class Module:
             return self
         return self._install_weight_list(weights)
 
-    def _install_weight_list(self, weights):
-        leaves = self._weight_leaves()
+    def _install_weight_list(self, weights, tree=None):
+        leaves = self._weight_leaves(tree)
         if len(leaves) != len(weights):
             raise ValueError(
                 f"set_weights: {len(weights)} arrays for {len(leaves)} "

@@ -187,6 +187,16 @@ class _Writer:
         elif isinstance(value, str):
             self.i32(TYPE_STRING)
             self.string(value)
+        elif isinstance(value, dict) and "__torch_class__" in value:
+            # serialized torch object (e.g. an nn.* module table): emit
+            # TYPE_TORCH with the class name and the rest as payload
+            self.i32(TYPE_TORCH)
+            self.index += 1
+            self.i32(self.index)
+            self.string("V 1")
+            self.string(value["__torch_class__"])
+            self.obj({k: v for k, v in value.items()
+                      if k != "__torch_class__"})
         elif isinstance(value, dict):
             self.i32(TYPE_TABLE)
             self.index += 1
@@ -251,3 +261,173 @@ def save_t7(value, path, overwrite=True):
     w.obj(value)
     with open(path, "wb") as f:
         f.write(b"".join(w.chunks))
+
+
+# ---------------------------------------------------------------------------
+# Torch nn module -> bigdl_tpu module (reference: Module.loadTorch /
+# TorchFile.loadModule -- the loadmodel example path)
+# ---------------------------------------------------------------------------
+
+def _t7_modules_list(table):
+    """The 'modules' entry of a container table -> ordered python list."""
+    mods = table.get("modules", {})
+    if isinstance(mods, dict):
+        return [mods[k] for k in sorted(k for k in mods
+                                        if isinstance(k, (int, float)))]
+    return list(mods)
+
+
+def load_torch_module(path, input_spec=None):
+    """Read a .t7-serialized torch nn model into the equivalent module tree
+    (reference: Module.loadTorch; weight layouts converted at the boundary:
+    torch conv (out, in/g, kH, kW) -> HWIO, NCHW activations assumed, so
+    containers get data_format adapters where needed).
+
+    If ``input_spec`` is given the model is built immediately and BN
+    running statistics are installed; otherwise weights install lazily on
+    first build and running stats are pending the same way.
+    """
+    table = load_t7(path)
+    mod = _torch_table_to_module(table)
+    if input_spec is not None:
+        mod.build(input_spec)
+    return mod
+
+
+def _make_torch_reshape():
+    """Reshape with torch (NCHW, channel-major) flatten semantics: 4-d
+    activations are NHWC here, so transpose back to NCHW before the
+    reshape -- the classic conv -> View -> Linear pattern then matches the
+    verbatim-installed torch Linear weights."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.module import Module
+
+    class _TorchReshape(Module):
+        def __init__(self, size):
+            super().__init__()
+            self.size = tuple(size)
+
+        def apply(self, params, state, input, *, training=False, rng=None):
+            x = input
+            if x.ndim == 4:
+                x = jnp.transpose(x, (0, 3, 1, 2))   # NHWC -> NCHW
+            out = x.reshape((x.shape[0],) + self.size)
+            if out.ndim == 4:
+                raise NotImplementedError(
+                    "torch Reshape/View to a 4-d spatial shape: the NCHW "
+                    "result cannot feed NHWC convs without a per-model "
+                    "layout adapter")
+            return out, state
+    return _TorchReshape
+
+
+_TorchReshape = _make_torch_reshape()
+
+
+def _torch_table_to_module(t):
+    import bigdl_tpu.nn as nn
+
+    if not isinstance(t, dict) or "__torch_class__" not in t:
+        raise ValueError(f"not a serialized torch module: {type(t)}")
+    cls = t["__torch_class__"].split(".")[-1]
+
+    if cls in ("Sequential",):
+        seq = nn.Sequential()
+        for sub in _t7_modules_list(t):
+            seq.add(_torch_table_to_module(sub))
+        return seq
+    if cls == "ConcatTable":
+        ct = nn.ConcatTable()
+        for sub in _t7_modules_list(t):
+            ct.add(_torch_table_to_module(sub))
+        return ct
+    if cls == "ParallelTable":
+        pt = nn.ParallelTable()
+        for sub in _t7_modules_list(t):
+            pt.add(_torch_table_to_module(sub))
+        return pt
+    if cls == "Concat":
+        # torch dimension is 1-based over NCHW (1=N, 2=C, 3=H, 4=W);
+        # activations here are NHWC, so C -> -1, H -> 1, W -> 2
+        tdim = int(t.get("dimension", 2))
+        c = nn.Concat({1: 0, 2: -1, 3: 1, 4: 2}.get(tdim, tdim - 1))
+        for sub in _t7_modules_list(t):
+            c.add(_torch_table_to_module(sub))
+        return c
+    if cls == "CAddTable":
+        return nn.CAddTable()
+    if cls == "Identity":
+        return nn.Identity()
+
+    if cls == "Linear":
+        w = np.asarray(t["weight"], np.float32)        # (out, in)
+        m = nn.Linear(w.shape[1], w.shape[0],
+                      with_bias="bias" in t and t["bias"] is not None)
+        weights = [w] + ([np.asarray(t["bias"], np.float32)]
+                         if m.with_bias else [])
+        m.set_weights(weights)
+        return m
+
+    if cls == "SpatialConvolution":
+        w = np.asarray(t["weight"], np.float32)
+        groups = int(t.get("nGroup", 1))
+        if w.ndim == 5:                                # grouped (g,out/g,in/g,kH,kW)
+            w = w.reshape(-1, w.shape[2], w.shape[3], w.shape[4])
+        n_out, cin_g, kh, kw = w.shape
+        m = nn.SpatialConvolution(
+            int(t["nInputPlane"]), int(t["nOutputPlane"]),
+            int(t["kW"]), int(t["kH"]), int(t.get("dW", 1)),
+            int(t.get("dH", 1)), int(t.get("padW", 0)), int(t.get("padH", 0)),
+            n_group=groups,
+            with_bias="bias" in t and t["bias"] is not None)
+        hwio = w.transpose(2, 3, 1, 0)                 # -> (kH,kW,cin_g,out)
+        weights = [hwio] + ([np.asarray(t["bias"], np.float32)]
+                            if m.with_bias else [])
+        m.set_weights(weights)
+        return m
+
+    if cls == "SpatialMaxPooling":
+        m = nn.SpatialMaxPooling(
+            int(t["kW"]), int(t["kH"]), int(t.get("dW", 1)),
+            int(t.get("dH", 1)), int(t.get("padW", 0)), int(t.get("padH", 0)))
+        if t.get("ceil_mode"):
+            m.ceil()
+        return m
+    if cls == "SpatialAveragePooling":
+        return nn.SpatialAveragePooling(
+            int(t["kW"]), int(t["kH"]), int(t.get("dW", 1)),
+            int(t.get("dH", 1)), int(t.get("padW", 0)), int(t.get("padH", 0)))
+
+    if cls in ("SpatialBatchNormalization", "BatchNormalization"):
+        n = int(np.asarray(t["running_mean"]).shape[0])
+        affine = "weight" in t and t["weight"] is not None
+        make = (nn.SpatialBatchNormalization
+                if cls == "SpatialBatchNormalization" else
+                nn.BatchNormalization)
+        m = make(n, eps=float(t.get("eps", 1e-5)),
+                 momentum=float(t.get("momentum", 0.1)), affine=affine)
+        if affine:
+            m.set_weights([np.asarray(t["weight"], np.float32),
+                           np.asarray(t["bias"], np.float32)])
+        m.set_state_entries({
+            "running_mean": np.asarray(t["running_mean"], np.float32),
+            "running_var": np.asarray(t["running_var"], np.float32)})
+        return m
+
+    simple = {
+        "ReLU": nn.ReLU, "Tanh": nn.Tanh, "Sigmoid": nn.Sigmoid,
+        "LogSoftMax": nn.LogSoftMax, "SoftMax": nn.SoftMax,
+        "ELU": nn.ELU, "SoftPlus": nn.SoftPlus, "Abs": nn.Abs,
+    }
+    if cls in simple:
+        return simple[cls]()
+    if cls == "Dropout":
+        return nn.Dropout(float(t.get("p", 0.5)))
+    if cls in ("Reshape", "View"):
+        size = tuple(int(v) for v in np.asarray(t["size"]).astype(int).ravel())
+        return _TorchReshape(size)
+
+    raise NotImplementedError(
+        f"torch class {t['__torch_class__']} has no converter "
+        f"(reference parity: TorchFile.scala loadModule table)")

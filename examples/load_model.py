@@ -44,9 +44,9 @@ def main(argv=None):
         model = load_tf(args.tf[0], inputs=[args.tf[1]],
                         outputs=[args.tf[2]])
     elif args.torch:
-        from bigdl_tpu.utils.torch_file import load_torch
+        from bigdl_tpu.utils.torch_file import load_torch_module
 
-        model = load_torch(args.torch)
+        model = load_torch_module(args.torch)
     elif args.keras:
         from bigdl_tpu.keras.converter import load_keras
 

@@ -10,6 +10,8 @@ import os
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 from bigdl_tpu.utils.torch_file import load_t7, save_t7
 
 FIX = "/root/reference/spark/dl/src/test/resources/torch/n02110063_11239.t7"
@@ -52,3 +54,155 @@ def test_overwrite_guard(tmp_path):
     save_t7(1, p)
     with pytest.raises(FileExistsError):
         save_t7(2, p, overwrite=False)
+
+
+class TestLoadTorchModule:
+    """load_torch_module: t7-serialized nn model -> our module tree, golden
+    vs PyTorch executing the same weights (reference: Module.loadTorch)."""
+
+    def _t7_linear(self, tl):
+        d = {"__torch_class__": "nn.Linear",
+             "weight": tl.weight.detach().numpy().astype(np.float64)}
+        if tl.bias is not None:
+            d["bias"] = tl.bias.detach().numpy().astype(np.float64)
+        return d
+
+    def test_mlp_golden(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        tm = torch.nn.Sequential(
+            torch.nn.Linear(6, 16), torch.nn.ReLU(),
+            torch.nn.Linear(16, 3), torch.nn.LogSoftmax(dim=-1))
+        table = {"__torch_class__": "nn.Sequential", "modules": [
+            self._t7_linear(tm[0]), {"__torch_class__": "nn.ReLU"},
+            self._t7_linear(tm[2]), {"__torch_class__": "nn.LogSoftMax"}]}
+        p = str(tmp_path / "mlp.t7")
+        save_t7(table, p)
+
+        from bigdl_tpu.utils.torch_file import load_torch_module
+        model = load_torch_module(p)
+        x = np.random.randn(4, 6).astype(np.float32)
+        ours = np.asarray(model.forward(jnp.asarray(x)))
+        ref = tm(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+    def test_conv_bn_pool_golden(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        tm = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 8, 3, padding=1),
+            torch.nn.BatchNorm2d(8),
+            torch.nn.ReLU(),
+            torch.nn.MaxPool2d(2))
+        tm.eval()
+        bn = tm[1]
+        with torch.no_grad():
+            bn.running_mean.copy_(torch.randn(8) * 0.1)
+            bn.running_var.copy_(torch.rand(8) + 0.5)
+        conv = tm[0]
+        table = {"__torch_class__": "nn.Sequential", "modules": [
+            {"__torch_class__": "nn.SpatialConvolution",
+             "nInputPlane": 3, "nOutputPlane": 8, "kW": 3, "kH": 3,
+             "dW": 1, "dH": 1, "padW": 1, "padH": 1,
+             "weight": conv.weight.detach().numpy().astype(np.float64),
+             "bias": conv.bias.detach().numpy().astype(np.float64)},
+            {"__torch_class__": "nn.SpatialBatchNormalization",
+             "eps": bn.eps, "momentum": bn.momentum,
+             "weight": bn.weight.detach().numpy().astype(np.float64),
+             "bias": bn.bias.detach().numpy().astype(np.float64),
+             "running_mean": bn.running_mean.numpy().astype(np.float64),
+             "running_var": bn.running_var.numpy().astype(np.float64)},
+            {"__torch_class__": "nn.ReLU"},
+            {"__torch_class__": "nn.SpatialMaxPooling",
+             "kW": 2, "kH": 2, "dW": 2, "dH": 2, "padW": 0, "padH": 0}]}
+        p = str(tmp_path / "conv.t7")
+        save_t7(table, p)
+
+        from bigdl_tpu.utils.torch_file import load_torch_module
+        import jax
+        model = load_torch_module(
+            p, input_spec=jax.ShapeDtypeStruct((2, 8, 8, 3), jnp.float32))
+        model.evaluate()
+
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        ours = np.asarray(model.forward(jnp.asarray(x)))        # NHWC
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)))     # NCHW
+        ref = ref.detach().numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+    def test_concat_and_reshape(self, tmp_path):
+        table = {"__torch_class__": "nn.Sequential", "modules": [
+            {"__torch_class__": "nn.ConcatTable", "modules": [
+                {"__torch_class__": "nn.Identity"},
+                {"__torch_class__": "nn.Identity"}]},
+            {"__torch_class__": "nn.CAddTable"}]}
+        p = str(tmp_path / "cat.t7")
+        save_t7(table, p)
+        from bigdl_tpu.utils.torch_file import load_torch_module
+        model = load_torch_module(p)
+        x = np.random.randn(3, 5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.forward(jnp.asarray(x))),
+                                   2 * x, rtol=1e-6)
+
+    def test_unknown_class_raises(self, tmp_path):
+        save_t7({"__torch_class__": "nn.FancyNewLayer"},
+                str(tmp_path / "u.t7"))
+        from bigdl_tpu.utils.torch_file import load_torch_module
+        with pytest.raises(NotImplementedError, match="FancyNewLayer"):
+            load_torch_module(str(tmp_path / "u.t7"))
+
+
+class TestLoadTorchModuleLayout:
+    """Layout-sensitive torch import paths: channel Concat and the
+    conv -> View -> Linear flatten (torch is NCHW channel-major)."""
+
+    def test_concat_channel_axis(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        c1 = torch.nn.Conv2d(3, 4, 1)
+        c2 = torch.nn.Conv2d(3, 6, 1)
+
+        def conv_table(c):
+            return {"__torch_class__": "nn.SpatialConvolution",
+                    "nInputPlane": c.in_channels,
+                    "nOutputPlane": c.out_channels,
+                    "kW": 1, "kH": 1, "dW": 1, "dH": 1, "padW": 0, "padH": 0,
+                    "weight": c.weight.detach().numpy().astype(np.float64),
+                    "bias": c.bias.detach().numpy().astype(np.float64)}
+        table = {"__torch_class__": "nn.Concat", "dimension": 2,
+                 "modules": [conv_table(c1), conv_table(c2)]}
+        p = str(tmp_path / "concat.t7")
+        save_t7(table, p)
+        from bigdl_tpu.utils.torch_file import load_torch_module
+        model = load_torch_module(p)
+        x = np.random.randn(2, 5, 5, 3).astype(np.float32)
+        ours = np.asarray(model.forward(jnp.asarray(x)))       # NHWC
+        xt = torch.from_numpy(x.transpose(0, 3, 1, 2))
+        ref = torch.cat([c1(xt), c2(xt)], dim=1)
+        ref = ref.detach().numpy().transpose(0, 2, 3, 1)
+        assert ours.shape == (2, 5, 5, 10)
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_view_linear_golden(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        conv = torch.nn.Conv2d(3, 4, 3)       # -> (N, 4, 4, 4) on 6x6 input
+        lin = torch.nn.Linear(4 * 4 * 4, 5)
+        tm = torch.nn.Sequential(conv, torch.nn.ReLU(),
+                                 torch.nn.Flatten(), lin)
+        table = {"__torch_class__": "nn.Sequential", "modules": [
+            {"__torch_class__": "nn.SpatialConvolution",
+             "nInputPlane": 3, "nOutputPlane": 4, "kW": 3, "kH": 3,
+             "dW": 1, "dH": 1, "padW": 0, "padH": 0,
+             "weight": conv.weight.detach().numpy().astype(np.float64),
+             "bias": conv.bias.detach().numpy().astype(np.float64)},
+            {"__torch_class__": "nn.ReLU"},
+            {"__torch_class__": "nn.View",
+             "size": np.asarray([4 * 4 * 4], np.float64)},
+            {"__torch_class__": "nn.Linear",
+             "weight": lin.weight.detach().numpy().astype(np.float64),
+             "bias": lin.bias.detach().numpy().astype(np.float64)}]}
+        p = str(tmp_path / "cvl.t7")
+        save_t7(table, p)
+        from bigdl_tpu.utils.torch_file import load_torch_module
+        model = load_torch_module(p)
+        x = np.random.randn(2, 6, 6, 3).astype(np.float32)
+        ours = np.asarray(model.forward(jnp.asarray(x)))
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach().numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
