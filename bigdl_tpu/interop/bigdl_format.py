@@ -180,6 +180,8 @@ def _set_attr(attrs, key, value, ctx):
         a.arrayValue.datatype = pb.INT32
         a.arrayValue.size = len(value)
         a.arrayValue.i32.extend(int(v) for v in value)
+    elif _is_regularizer(value):
+        _encode_value(a, value, ctx)
     else:
         raise TypeError(f"unsupported attr {key}: {type(value)}")
 
@@ -196,6 +198,8 @@ def _get_attr(mod_pb, key, default=None, ctx=None):
         return _decode_tensor(v, ctx or _Ctx())
     if which == "arrayValue":
         return list(v.i32) or list(v.i64) or list(v.flt) or list(v.dbl)
+    if which == "regularizerValue":
+        return _decode_value(a, ctx or _Ctx())
     return v
 
 
@@ -227,9 +231,25 @@ def _conv_weight_from_bigdl(w, kh, kw, cin_g, g, out_g):
             .transpose(3, 4, 2, 0, 1).reshape(kh, kw, cin_g, g * out_g))
 
 
+def _reg_attrs(m):
+    """wRegularizer/bRegularizer attr entries when present (reference attr
+    names from the Scala serializer)."""
+    out = {}
+    if getattr(m, "w_regularizer", None) is not None:
+        out["wRegularizer"] = m.w_regularizer
+    if getattr(m, "b_regularizer", None) is not None:
+        out["bRegularizer"] = m.b_regularizer
+    return out
+
+
+def _install_regs(m, attrs):
+    m.set_regularizer(attrs("wRegularizer", None), attrs("bRegularizer", None))
+    return m
+
+
 def _save_linear(m, p):
     return ({"inputSize": m.input_size, "outputSize": m.output_size,
-             "withBias": m.with_bias},
+             "withBias": m.with_bias, **_reg_attrs(m)},
             [np.asarray(p["weight"])]
             + ([np.asarray(p["bias"])] if m.with_bias else []))
 
@@ -238,6 +258,7 @@ def _load_linear(attrs, params, ctx):
     import bigdl_tpu.nn as nn
     m = nn.Linear(attrs("inputSize"), attrs("outputSize"),
                   with_bias=attrs("withBias", True))
+    _install_regs(m, attrs)
     pt = {"weight": params[0]}
     if attrs("withBias", True) and len(params) > 1:
         pt["bias"] = params[1]
@@ -249,7 +270,7 @@ def _save_conv(m, p):
              "kernelW": m.kernel[1], "kernelH": m.kernel[0],
              "strideW": m.stride[1], "strideH": m.stride[0],
              "padW": m.pad[1], "padH": m.pad[0], "nGroup": m.n_group,
-             "withBias": m.with_bias}
+             "withBias": m.with_bias, **_reg_attrs(m)}
     params = [_conv_weight_to_bigdl(m, np.asarray(p["weight"]))]
     if m.with_bias:
         params.append(np.asarray(p["bias"]))
@@ -265,6 +286,7 @@ def _load_conv(attrs, params, ctx):
         cin, cout, kw, kh, attrs("strideW", 1), attrs("strideH", 1),
         attrs("padW", 0), attrs("padH", 0), n_group=g,
         with_bias=attrs("withBias", True))
+    _install_regs(m, attrs)
     w = _conv_weight_from_bigdl(params[0], kh, kw, cin // g, g, cout // g)
     pt = {"weight": w}
     if attrs("withBias", True) and len(params) > 1:
@@ -437,6 +459,11 @@ _GEN = "bigdl_tpu.nn."
 _GEN_CRIT = "bigdl_tpu.criterion."
 
 
+def _is_regularizer(v):
+    from bigdl_tpu.optim.regularizer import Regularizer
+    return isinstance(v, Regularizer)
+
+
 def _is_dtype_like(v):
     if isinstance(v, np.dtype):
         return True
@@ -476,6 +503,20 @@ def _encode_value(a, value, ctx):
         a.dataType = pb.MODULE
         a.subType = "criterion"
         _crit_to_pb(value, ctx, a.bigDLModuleValue)
+    elif _is_regularizer(value):
+        # wire: Regularizer message with regularData=[l1, l2]
+        # (reference: serializer converters/DataConverter regularizer path)
+        a.dataType = pb.REGULARIZER
+        rv = a.regularizerValue
+        l1 = float(getattr(value, "l1", 0.0))
+        l2 = float(getattr(value, "l2", 0.0))
+        if type(value).__name__ == "L1Regularizer":
+            rv.regularizerType = pb.L1Regularizer
+        elif type(value).__name__ == "L2Regularizer":
+            rv.regularizerType = pb.L2Regularizer
+        else:
+            rv.regularizerType = pb.L1L2Regularizer
+        rv.regularData.extend([l1, l2])
     elif _is_dtype_like(value):
         a.dataType = pb.STRING
         a.subType = "dtype"
@@ -549,6 +590,18 @@ def _decode_value(a, ctx):
         if a.subType == "criterion":
             return _crit_from_pb(a.bigDLModuleValue, ctx)
         return _module_from_pb(a.bigDLModuleValue, ctx, (), [])
+    if which == "regularizerValue":
+        from bigdl_tpu.optim.regularizer import (L1L2Regularizer,
+                                                 L1Regularizer, L2Regularizer)
+        rv = a.regularizerValue
+        data = list(rv.regularData)
+        l1 = data[0] if data else 0.0
+        l2 = data[1] if len(data) > 1 else 0.0
+        if rv.regularizerType == pb.L1Regularizer:
+            return L1Regularizer(l1)
+        if rv.regularizerType == pb.L2Regularizer:
+            return L2Regularizer(l2)
+        return L1L2Regularizer(l1, l2)
     if which == "tensorValue":
         arr = _decode_tensor(a.tensorValue, ctx)
         if a.subType:

@@ -42,9 +42,12 @@ class SpatialConvolution(Module):
         data_format: str = "NHWC",
         weight_init=None,
         bias_init=None,
+        w_regularizer=None,
+        b_regularizer=None,
         name=None,
     ):
         super().__init__(name)
+        self.set_regularizer(w_regularizer, b_regularizer)
         assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
         assert data_format in ("NHWC", "NCHW")
         self.n_input_plane = n_input_plane

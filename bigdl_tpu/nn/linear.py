@@ -22,6 +22,8 @@ class Linear(Module):
         with_bias: bool = True,
         weight_init=None,
         bias_init=None,
+        w_regularizer=None,
+        b_regularizer=None,
         name=None,
     ):
         super().__init__(name)
@@ -30,6 +32,7 @@ class Linear(Module):
         self.with_bias = with_bias
         self.weight_init = weight_init or Xavier()
         self.bias_init = bias_init or Zeros()
+        self.set_regularizer(w_regularizer, b_regularizer)
 
     def setup(self, rng, input_spec):
         in_size = self.input_size or input_spec.shape[-1]

@@ -335,6 +335,16 @@ class Module:
             m.evaluate()
         return self
 
+    def set_regularizer(self, w=None, b=None):
+        """Attach per-layer weight/bias regularizers (reference:
+        wRegularizer/bRegularizer params on layer constructors,
+        optim/Regularizer.scala).  Consumed by the train step's loss."""
+        if w is not None:
+            self.w_regularizer = w
+        if b is not None:
+            self.b_regularizer = b
+        return self
+
     def children(self):
         return []
 

@@ -2,7 +2,11 @@ from bigdl_tpu.optim.optim_method import (
     OptimMethod, SGD, Adam, ParallelAdam, Adagrad, Adadelta, RMSprop, Adamax, Ftrl,
     LearningRateSchedule, Default, Step, MultiStep, Poly, Exponential,
     NaturalExp, Warmup, SequentialSchedule, EpochDecayWithWarmUp,
+    EpochSchedule, EpochDecay, EpochStep, Plateau,
     clip_by_value, clip_by_global_norm,
+)
+from bigdl_tpu.optim.regularizer import (
+    Regularizer, L1Regularizer, L2Regularizer, L1L2Regularizer,
 )
 from bigdl_tpu.optim.lbfgs import LBFGS, line_search_wolfe
 from bigdl_tpu.optim.trigger import Trigger

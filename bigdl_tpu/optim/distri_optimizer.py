@@ -218,6 +218,7 @@ class DistriOptimizer(BaseOptimizer):
             if (self.validation_trigger is not None
                     and self.validation_trigger(state)):
                 self._validate_distri(params_flat, flat_space, mstate, state)
+                opt_state = self._feed_plateau(state, opt_state)
             if (self.checkpoint_trigger is not None
                     and self.checkpoint_trigger(state)):
                 file_io.save_checkpoint(

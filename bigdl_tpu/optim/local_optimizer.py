@@ -110,6 +110,25 @@ class BaseOptimizer:
         return self
 
     # ----- shared helpers -------------------------------------------------- #
+    def _feed_plateau(self, state, opt_state):
+        """Feed the monitored validation metric to a Plateau schedule
+        (reference: SGD.Plateau consumes the score via the optimizer's
+        state Table).  Only an explicitly monitored value is fed -- no
+        silent fallback to the training loss, whose direction would not
+        match the schedule's mode."""
+        sched = getattr(self.optim_method, "schedule", None)
+        if sched is None or not hasattr(sched, "record"):
+            return opt_state
+        value = state.get(getattr(sched, "monitor", "score"),
+                          state.get("score"))
+        if value is None:
+            log.warning(
+                "Plateau schedule: monitored value %r not produced by the "
+                "validation methods; LR factor unchanged",
+                getattr(sched, "monitor", "score"))
+            return opt_state
+        return sched.record(value, opt_state)
+
     def optimize(self):
         """Run training with the reference's failure-retry semantics: on an
         exception, reload the latest checkpoint and continue, at most
@@ -220,6 +239,7 @@ class LocalOptimizer(BaseOptimizer):
             if (self.validation_trigger is not None
                     and self.validation_trigger(state)):
                 self._validate(params, mstate, state)
+                opt_state = self._feed_plateau(state, opt_state)
             if (self.checkpoint_trigger is not None
                     and self.checkpoint_trigger(state)):
                 self._checkpoint(params, mstate, opt_state)

@@ -127,6 +127,116 @@ class EpochDecayWithWarmUp(LearningRateSchedule):
         return jnp.where(step < self.warmup_iteration, warm, cooled)
 
 
+class EpochSchedule(LearningRateSchedule):
+    """Per-epoch LR regimes (reference: SGD.EpochSchedule over Regime
+    case classes).  ``regimes`` is [(start_epoch, end_epoch, lr)], 1-based
+    inclusive like the reference; ``steps_per_epoch`` derives the epoch so
+    the schedule stays a pure traceable fn of the step."""
+
+    def __init__(self, regimes, steps_per_epoch):
+        self.starts = jnp.asarray([r[0] for r in regimes], jnp.float32)
+        self.lrs = jnp.asarray([r[2] for r in regimes], jnp.float32)
+        self.steps_per_epoch = steps_per_epoch
+
+    def __call__(self, step, base_lr):
+        epoch = jnp.floor(step / self.steps_per_epoch) + 1.0
+        idx = jnp.clip(jnp.sum(epoch >= self.starts) - 1, 0,
+                       self.lrs.shape[0] - 1)
+        return self.lrs[idx]
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decay_fn(epoch) (reference: SGD.EpochDecay, which takes an
+    epoch->power function).  The python fn is tabulated up to ``max_epoch``
+    so the lookup is traceable."""
+
+    def __init__(self, decay_fn, steps_per_epoch, max_epoch=1000):
+        self.table = jnp.asarray([float(decay_fn(e))
+                                  for e in range(1, max_epoch + 1)],
+                                 jnp.float32)
+        self.steps_per_epoch = steps_per_epoch
+
+    def __call__(self, step, base_lr):
+        epoch = jnp.clip(jnp.floor(jnp.asarray(step) /
+                                   self.steps_per_epoch).astype(jnp.int32),
+                         0, self.table.shape[0] - 1)
+        return base_lr * jnp.power(0.1, self.table[epoch])
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^floor(epoch / step_size) (reference: SGD.EpochStep)."""
+
+    def __init__(self, step_size, gamma, steps_per_epoch):
+        self.step_size, self.gamma = step_size, gamma
+        self.steps_per_epoch = steps_per_epoch
+
+    def __call__(self, step, base_lr):
+        epoch = jnp.floor(step / self.steps_per_epoch) + 1.0
+        return base_lr * jnp.power(self.gamma,
+                                   jnp.floor(epoch / self.step_size))
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce LR when a monitored metric stops improving (reference:
+    SGD.Plateau).
+
+    The multiplicative factor lives in the optimizer state
+    (``lr_factor``) so the jitted step sees updates without recompiling;
+    ``record(value, opt_state)`` is called host-side by the optimizer's
+    validation hook (monitor counters stay on the host)."""
+
+    stateful = True
+
+    def __init__(self, monitor="score", factor=0.1, patience=10,
+                 mode="max", epsilon=1e-4, cooldown=0, min_lr=0.0):
+        assert mode in ("min", "max")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.epsilon = epsilon
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "max":
+            return value > self.best + self.epsilon
+        return value < self.best - self.epsilon
+
+    def record(self, value, opt_state):
+        """Host-side: feed the monitored value, get updated opt state."""
+        value = float(value)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+            return opt_state
+        if self.cooldown_counter > 0:
+            return opt_state
+        self.wait += 1
+        if self.wait < self.patience:
+            return opt_state
+        self.wait = 0
+        self.cooldown_counter = self.cooldown
+        old = float(opt_state.get("lr_factor", 1.0))
+        base = float(self.base_lr) if hasattr(self, "base_lr") else 1.0
+        new = max(old * self.factor, self.min_lr / max(base, 1e-30))
+        out = dict(opt_state)
+        out["lr_factor"] = jnp.asarray(new, jnp.float32)
+        return out
+
+    def __call__(self, step, base_lr):
+        self.base_lr = base_lr          # recorded for the min_lr clamp
+        return base_lr                  # factor applied via opt_state
+
+
 class Warmup(LearningRateSchedule):
     """Linear ramp adding ``delta`` per step (reference SGD.Warmup; used inside
     SequentialSchedule for the ResNet-50 warmup recipe)."""
@@ -213,14 +323,20 @@ class SGD(OptimMethod):
         state = {"neval": jnp.zeros((), jnp.int32)}
         if self.momentum > 0:
             state["velocity"] = jax.tree.map(jnp.zeros_like, params)
+        if getattr(self.schedule, "stateful", False):
+            state["lr_factor"] = jnp.ones((), jnp.float32)
         return state
 
     def update(self, grads, state, params):
         lr = self.schedule(state["neval"].astype(jnp.float32), self.learning_rate)
+        if "lr_factor" in state:
+            lr = lr * state["lr_factor"]
         wd, mu, damp = self.weight_decay, self.momentum, self.dampening
 
         if wd != 0:
             grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
+        new_state = dict(state)
+        new_state["neval"] = state["neval"] + 1
         if mu > 0:
             new_vel = jax.tree.map(lambda v, g: mu * v + (1 - damp) * g,
                                    state["velocity"], grads)
@@ -229,14 +345,17 @@ class SGD(OptimMethod):
             else:
                 eff = new_vel
             new_params = jax.tree.map(lambda p, g: p - lr * g, params, eff)
-            new_state = {"neval": state["neval"] + 1, "velocity": new_vel}
+            new_state["velocity"] = new_vel
         else:
             new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-            new_state = {"neval": state["neval"] + 1}
         return new_params, new_state
 
     def get_learning_rate(self, state):
-        return self.schedule(state["neval"].astype(jnp.float32), self.learning_rate)
+        lr = self.schedule(state["neval"].astype(jnp.float32),
+                           self.learning_rate)
+        if "lr_factor" in state:
+            lr = lr * state["lr_factor"]
+        return lr
 
 
 class Adam(OptimMethod):

@@ -44,13 +44,23 @@ def make_train_step(
     bf16 forward/backward (MXU-native), fp32 update.
     """
 
+    from bigdl_tpu.optim.regularizer import (has_regularizers,
+                                             regularization_loss)
+    use_reg = has_regularizers(model)
+
     def train_step(params, mstate, opt_state, input, target, rng):
         def loss_fn(p):
             cp = _cast_tree(p, compute_dtype)
             x = _cast_tree(input, compute_dtype)
             out, new_mstate = model.apply(cp, mstate, x, training=True, rng=rng)
             out32 = _cast_tree(out, jnp.float32)
-            return criterion.apply(out32, target), new_mstate
+            loss = criterion.apply(out32, target)
+            if use_reg:
+                # per-layer wRegularizer/bRegularizer terms on the fp32
+                # master params (reference: accGradParameters adds the
+                # regularizer gradients; autodiff does it here)
+                loss = loss + regularization_loss(model, p)
+            return loss, new_mstate
 
         (loss, new_mstate), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)

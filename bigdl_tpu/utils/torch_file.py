@@ -294,11 +294,20 @@ def load_torch_module(path, input_spec=None):
     return mod
 
 
+_torch_reshape_cls = None
+
+
 def _make_torch_reshape():
     """Reshape with torch (NCHW, channel-major) flatten semantics: 4-d
     activations are NHWC here, so transpose back to NCHW before the
     reshape -- the classic conv -> View -> Linear pattern then matches the
-    verbatim-installed torch Linear weights."""
+    verbatim-installed torch Linear weights.
+
+    Built lazily so that plain .t7 tensor IO (load_t7/save_t7) never pays
+    the jax + nn module-system import cost."""
+    global _torch_reshape_cls
+    if _torch_reshape_cls is not None:
+        return _torch_reshape_cls
     import jax.numpy as jnp
 
     from bigdl_tpu.nn.module import Module
@@ -319,10 +328,9 @@ def _make_torch_reshape():
                     "result cannot feed NHWC convs without a per-model "
                     "layout adapter")
             return out, state
+
+    _torch_reshape_cls = _TorchReshape
     return _TorchReshape
-
-
-_TorchReshape = _make_torch_reshape()
 
 
 def _torch_table_to_module(t):
@@ -426,7 +434,7 @@ def _torch_table_to_module(t):
         return nn.Dropout(float(t.get("p", 0.5)))
     if cls in ("Reshape", "View"):
         size = tuple(int(v) for v in np.asarray(t["size"]).astype(int).ravel())
-        return _TorchReshape(size)
+        return _make_torch_reshape()(size)
 
     raise NotImplementedError(
         f"torch class {t['__torch_class__']} has no converter "

@@ -274,3 +274,139 @@ class TestEpochDecayWithWarmUp:
             0.032)
         assert float(sched(steps_per_epoch * 80, 0.1)) == pytest.approx(
             0.0032, rel=1e-5)
+
+
+class TestEpochSchedules:
+    """Epoch-derived schedules (reference: SGD.EpochSchedule/EpochDecay/
+    EpochStep over Regime lists and epoch->power functions)."""
+
+    def test_epoch_schedule_regimes(self):
+        s = optim.EpochSchedule(
+            [(1, 3, 1e-2), (4, 7, 5e-3), (8, 100, 1e-3)], steps_per_epoch=10)
+        assert_close(s(0.0, 0.0), 1e-2)       # epoch 1
+        assert_close(s(29.0, 0.0), 1e-2)      # epoch 3
+        assert_close(s(30.0, 0.0), 5e-3)      # epoch 4
+        assert_close(s(75.0, 0.0), 1e-3)      # epoch 8
+        assert_close(s(999.0, 0.0), 1e-3)     # clamped to last regime
+
+    def test_epoch_decay(self):
+        # the reference's imagenet decay: floor(epoch/30) powers of 0.1
+        s = optim.EpochDecay(lambda e: e // 30, steps_per_epoch=2,
+                             max_epoch=200)
+        assert_close(s(0.0, 0.1), 0.1)
+        assert_close(s(60.0, 0.1), 0.01)      # epoch 31 -> power 1
+        assert_close(s(120.0, 0.1), 0.001)    # epoch 61 -> power 2
+
+    def test_epoch_step(self):
+        s = optim.EpochStep(2, 0.5, steps_per_epoch=5)
+        # reference EpochStep: gamma^floor(epoch/step); epoch 1 -> 0 powers
+        assert_close(s(4.0, 1.0), 1.0)        # epoch 1
+        assert_close(s(5.0, 1.0), 0.5)        # epoch 2 -> floor(2/2)=1
+        assert_close(s(19.0, 1.0), 0.25)      # epoch 4 -> 2 powers
+
+    def test_plateau_reduces_on_stall(self):
+        sched = optim.Plateau(factor=0.5, patience=2, mode="max")
+        method = optim.SGD(learning_rate=0.1, learning_rate_schedule=sched)
+        params = {"w": jnp.ones(3)}
+        st = method.init_state(params)
+        assert "lr_factor" in st
+        st = sched.record(0.5, st)            # first value = best
+        st = sched.record(0.5, st)            # stall 1
+        st = sched.record(0.5, st)            # stall 2 -> reduce
+        assert_close(st["lr_factor"], 0.5)
+        g = {"w": jnp.ones(3)}
+        p2, st2 = method.update(g, st, params)
+        assert_close(p2["w"], 1.0 - 0.05)     # lr 0.1 * factor 0.5
+        assert_close(method.get_learning_rate(st2), 0.05)
+        st2 = sched.record(0.9, st2)          # improvement: factor keeps
+        assert_close(st2["lr_factor"], 0.5)
+
+    def test_plateau_min_mode(self):
+        sched = optim.Plateau(factor=0.1, patience=1, mode="min")
+        st = {"lr_factor": jnp.ones(())}
+        st = sched.record(1.0, st)
+        st = sched.record(2.0, st)            # worse in min mode -> reduce
+        assert_close(st["lr_factor"], 0.1)
+
+
+class TestRegularizers:
+    """Per-layer regularizers (reference: optim/Regularizer.scala attached
+    as wRegularizer/bRegularizer; gradient contribution l2*w / l1*sign(w))."""
+
+    def test_l2_gradient_matches_reference_formula(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.optim.train_step import make_train_step
+
+        l2 = 0.3
+        model = nn.Sequential().add(
+            nn.Linear(4, 2, w_regularizer=optim.L2Regularizer(l2)))
+        model.build(jax.ShapeDtypeStruct((3, 4), jnp.float32))
+        params, mstate = model.parameters()[0], model.state()
+        method = optim.SGD(learning_rate=1.0)
+        opt_state = method.init_state(params)
+        x = jnp.zeros((3, 4))          # zero input: data grad of weight = 0
+        t = jnp.zeros((3, 2))
+        step = jax.jit(make_train_step(model, nn.MSECriterion(), method))
+        w0 = np.asarray(params["0"]["weight"])
+        new_params, _, _, _ = step(params, mstate, opt_state, x, t,
+                                   jax.random.key(0))
+        # update = -lr * l2 * w  (bias has no regularizer and zero grad)
+        np.testing.assert_allclose(np.asarray(new_params["0"]["weight"]),
+                                   w0 - l2 * w0, rtol=1e-5)
+
+    def test_l1_and_generic_setter(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.optim.regularizer import regularization_loss
+
+        m = nn.Linear(3, 3).set_regularizer(w=optim.L1Regularizer(2.0),
+                                            b=optim.L2Regularizer(4.0))
+        m.build(jax.ShapeDtypeStruct((1, 3), jnp.float32))
+        p = m.parameters()[0]
+        expect = (2.0 * np.abs(np.asarray(p["weight"])).sum()
+                  + 0.5 * 4.0 * (np.asarray(p["bias"]) ** 2).sum())
+        got = float(regularization_loss(m, p))
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_graph_keyed_walk(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.graph import Input, Node
+        from bigdl_tpu.optim.regularizer import (has_regularizers,
+                                                 regularization_loss)
+
+        inp = Input()
+        h = Node(nn.Linear(4, 8, w_regularizer=optim.L2Regularizer(0.1)),
+                 [inp])
+        out = Node(nn.Linear(8, 2), [h])
+        g = nn.Graph([inp], [out])
+        assert has_regularizers(g)
+        g.build(jax.ShapeDtypeStruct((2, 4), jnp.float32))
+        p = g.parameters()[0]
+        loss = float(regularization_loss(g, p))
+        w = None
+        for v in p.values():            # find the 4x8 weight
+            if "weight" in v and v["weight"].shape == (8, 4):
+                w = np.asarray(v["weight"])
+        assert w is not None
+        np.testing.assert_allclose(loss, 0.5 * 0.1 * (w ** 2).sum(),
+                                   rtol=1e-5)
+
+    def test_regularizer_serializes(self, tmp_path):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.utils.serializer import load_module, save_module
+        from bigdl_tpu.optim.regularizer import regularization_loss
+
+        m = nn.Linear(4, 2, w_regularizer=optim.L1L2Regularizer(0.1, 0.2),
+                      b_regularizer=optim.L1Regularizer(0.3))
+        m.build(jax.ShapeDtypeStruct((1, 4), jnp.float32))
+        p = str(tmp_path / "reg.bigdl")
+        save_module(m, p)
+        back = load_module(p)
+        assert back.w_regularizer.l1 == pytest.approx(0.1)
+        assert back.w_regularizer.l2 == pytest.approx(0.2)
+        assert back.b_regularizer.l1 == pytest.approx(0.3)
+        x = jnp.ones((1, 4))
+        np.testing.assert_allclose(np.asarray(back.forward(x)),
+                                   np.asarray(m.forward(x)), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(regularization_loss(back, back.parameters()[0])),
+            float(regularization_loss(m, m.parameters()[0])), rtol=1e-6)
