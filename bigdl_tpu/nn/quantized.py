@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from bigdl_tpu.nn.conv import SpatialConvolution
+from bigdl_tpu.nn.conv import SpatialConvolution, SpatialDilatedConvolution
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.module import Container, Module
 
@@ -135,8 +135,10 @@ def _quantize_children(module: Module):
             q = QuantizedLinear(child, child_params)
             module.modules[i] = q
             params[key] = q._params
-        elif isinstance(child, SpatialConvolution) and child_params and \
-                type(child) is SpatialConvolution:
+        elif child_params and type(child) in (SpatialConvolution,
+                                             SpatialDilatedConvolution):
+            # dilated variant included: the int8 conv carries rhs_dilation
+            # (reference: nn/quantized/SpatialDilatedConvolution.scala)
             q = QuantizedSpatialConvolution(child, child_params)
             module.modules[i] = q
             params[key] = q._params

@@ -55,6 +55,10 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
     the reference decompresses after aggregation.
     """
 
+    from bigdl_tpu.optim.regularizer import (has_regularizers,
+                                             regularization_loss)
+    use_reg = has_regularizers(model)
+
     def step_body(params_flat, mstate, opt_state, x, target, rng):
         # per-device view: params_flat replicated, x/target = this device's shard
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
@@ -65,9 +69,17 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
             cx = _cast_tree(x, compute_dtype)
             out, new_mstate = model.apply(cp, mstate, cx, training=True, rng=rng)
             out32 = _cast_tree(out, jnp.float32)
-            return criterion.apply(out32, target), new_mstate
+            data_loss = criterion.apply(out32, target)
+            total = data_loss
+            if use_reg:
+                # per-layer wRegularizer/bRegularizer gradient contributions
+                # enter via autodiff; the REPORTED loss stays the bare
+                # criterion value like the reference (accGradParameters
+                # touches gradients only)
+                total = total + regularization_loss(model, params)
+            return total, (data_loss, new_mstate)
 
-        (loss, new_mstate), gflat = jax.value_and_grad(
+        (_, (loss, new_mstate)), gflat = jax.value_and_grad(
             loss_fn, has_aux=True)(params_flat)
         # mean-reduce gradients; each device keeps only its chunk (ZeRO-1)
         if grad_compression is not None:
@@ -250,6 +262,7 @@ class DistriOptimizer(BaseOptimizer):
                 continue
             value, _ = res.result()
             log.info("Validation %s: %s", method.name, res)
+            state[method.name] = value     # addressable by Plateau monitor
             if method.name in ("Top1Accuracy", "Top5Accuracy"):
                 state["score"] = value
             if self.validation_summary is not None:

@@ -119,13 +119,17 @@ class BaseOptimizer:
         sched = getattr(self.optim_method, "schedule", None)
         if sched is None or not hasattr(sched, "record"):
             return opt_state
-        value = state.get(getattr(sched, "monitor", "score"),
-                          state.get("score"))
+        monitor = getattr(sched, "monitor", "score")
+        # a custom monitor must match exactly -- feeding a different metric
+        # (wrong direction for the schedule's mode) would silently decay
+        # the LR on healthy training
+        value = state.get(monitor)
         if value is None:
             log.warning(
                 "Plateau schedule: monitored value %r not produced by the "
-                "validation methods; LR factor unchanged",
-                getattr(sched, "monitor", "score"))
+                "validation methods (available: %s); LR factor unchanged",
+                monitor,
+                [m.name for m in self.validation_methods])
             return opt_state
         return sched.record(value, opt_state)
 
@@ -263,6 +267,7 @@ class LocalOptimizer(BaseOptimizer):
                 continue
             value, _ = res.result()
             log.info("Validation %s: %s", method.name, res)
+            state[method.name] = value     # addressable by Plateau monitor
             if method.name in ("Top1Accuracy", "Top5Accuracy"):
                 state["score"] = value
             if self.validation_summary is not None:

@@ -54,15 +54,17 @@ def make_train_step(
             x = _cast_tree(input, compute_dtype)
             out, new_mstate = model.apply(cp, mstate, x, training=True, rng=rng)
             out32 = _cast_tree(out, jnp.float32)
-            loss = criterion.apply(out32, target)
+            data_loss = criterion.apply(out32, target)
+            total = data_loss
             if use_reg:
                 # per-layer wRegularizer/bRegularizer terms on the fp32
-                # master params (reference: accGradParameters adds the
-                # regularizer gradients; autodiff does it here)
-                loss = loss + regularization_loss(model, p)
-            return loss, new_mstate
+                # master params: gradients pick them up via autodiff, but
+                # the REPORTED loss stays the bare criterion value like the
+                # reference (accGradParameters touches gradients only)
+                total = total + regularization_loss(model, p)
+            return total, (data_loss, new_mstate)
 
-        (loss, new_mstate), grads = jax.value_and_grad(
+        (_, (loss, new_mstate)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         grads = _cast_tree(grads, jnp.float32)
         if grad_transform is not None:

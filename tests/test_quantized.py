@@ -74,3 +74,29 @@ class TestQuantizeModel:
         quantize(model)
         dtypes = {str(l.dtype) for l in jax.tree.leaves(model._params)}
         assert "int8" in dtypes
+
+
+def test_quantize_dilated_conv():
+    """SpatialDilatedConvolution quantizes like the reference's
+    nn/quantized/SpatialDilatedConvolution.scala."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.quantized import (QuantizedSpatialConvolution, quantize)
+
+    model = nn.Sequential().add(
+        nn.SpatialDilatedConvolution(3, 8, 3, 3, 1, 1, 2, 2,
+                                     dilation_w=2, dilation_h=2))
+    model.build(jax.ShapeDtypeStruct((2, 10, 10, 3), jnp.float32))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (2, 10, 10, 3)), jnp.float32)
+    ref = np.asarray(model.forward(x))
+    quantize(model)
+    assert isinstance(model.modules[0], QuantizedSpatialConvolution)
+    got = np.asarray(model.forward(x))
+    assert got.shape == ref.shape
+    # int8 tolerance: relative error on the order of the quant step
+    assert np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6) < 0.1
