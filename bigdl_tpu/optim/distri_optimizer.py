@@ -253,22 +253,7 @@ class DistriOptimizer(BaseOptimizer):
         results = validate(self.model, params_tree, mstate,
                            self.validation_dataset, self.validation_methods,
                            self.compute_dtype)
-        for method, res in zip(self.validation_methods, results):
-            if res is None:
-                log.warning(
-                    "validation dataset produced no full batches; skipping "
-                    "%s (reduce batch size or grow the validation split)",
-                    method.name)
-                continue
-            value, _ = res.result()
-            log.info("Validation %s: %s", method.name, res)
-            state[method.name] = value     # addressable by Plateau monitor
-            if method.name in ("Top1Accuracy", "Top5Accuracy"):
-                state["score"] = value
-            if self.validation_summary is not None:
-                self.validation_summary.add_scalar(method.name, value,
-                                                   state["neval"])
-        return results
+        return self._record_validation(results, state)
 
 
 class ParallelOptimizer(DistriOptimizer):

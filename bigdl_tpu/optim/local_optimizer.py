@@ -133,6 +133,27 @@ class BaseOptimizer:
             return opt_state
         return sched.record(value, opt_state)
 
+    def _record_validation(self, results, state):
+        """Log each validation result and record it in the driver state
+        (state[method.name] is addressable by a Plateau monitor; 'score'
+        aliases accuracy for the default monitor)."""
+        for method, res in zip(self.validation_methods, results):
+            if res is None:
+                log.warning(
+                    "validation dataset produced no full batches; skipping "
+                    "%s (reduce batch size or grow the validation split)",
+                    method.name)
+                continue
+            value, _ = res.result()
+            log.info("Validation %s: %s", method.name, res)
+            state[method.name] = value
+            if method.name in ("Top1Accuracy", "Top5Accuracy"):
+                state["score"] = value
+            if self.validation_summary is not None:
+                self.validation_summary.add_scalar(
+                    method.name, value, state["neval"])
+        return results
+
     def optimize(self):
         """Run training with the reference's failure-retry semantics: on an
         exception, reload the latest checkpoint and continue, at most
@@ -258,22 +279,7 @@ class LocalOptimizer(BaseOptimizer):
     def _validate(self, params, mstate, state):
         results = validate(self.model, params, mstate, self.validation_dataset,
                            self.validation_methods, self.compute_dtype)
-        for method, res in zip(self.validation_methods, results):
-            if res is None:
-                log.warning(
-                    "validation dataset produced no full batches; skipping "
-                    "%s (reduce batch size or grow the validation split)",
-                    method.name)
-                continue
-            value, _ = res.result()
-            log.info("Validation %s: %s", method.name, res)
-            state[method.name] = value     # addressable by Plateau monitor
-            if method.name in ("Top1Accuracy", "Top5Accuracy"):
-                state["score"] = value
-            if self.validation_summary is not None:
-                self.validation_summary.add_scalar(
-                    method.name, value, state["neval"])
-        return results
+        return self._record_validation(results, state)
 
 
 def validate(model, params, mstate, dataset, methods, compute_dtype=None):
