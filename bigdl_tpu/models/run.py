@@ -274,6 +274,75 @@ def cmd_rnn_train(args):
     opt.optimize()
 
 
+def cmd_transformer_train(args):
+    """Transformer LM on a synthetic next-token corpus, single-device or
+    sequence-parallel over a mesh (the long-context flagship; no reference
+    analogue -- SURVEY.md §5 lists long-context as greenfield)."""
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.transformer import synthetic_corpus, transformer_lm
+
+    vocab, seq = args.vocab, args.seq_len
+    x, y = synthetic_corpus(args.synth_n, seq, vocab)
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+
+    if args.sp > 1:
+        from bigdl_tpu.parallel.sequence import make_sp_train_step
+        from bigdl_tpu.utils.engine import Engine
+        from bigdl_tpu.utils.random_generator import RNG
+
+        n_dev = jax.device_count()
+        data_deg = n_dev // max(args.sp, 1)
+        problems = []
+        if n_dev % args.sp:
+            problems.append(f"device count {n_dev} % sp {args.sp} != 0")
+        if seq % args.sp:
+            problems.append(f"--seq-len {seq} % sp {args.sp} != 0")
+        elif data_deg and args.batch % data_deg:
+            problems.append(f"--batchSize {args.batch} % data-parallel "
+                            f"degree {data_deg} != 0")
+        if problems:
+            raise ValueError("sequence-parallel shape requirements: "
+                             + "; ".join(problems))
+        for flag in ("checkpoint", "summary_dir"):
+            if getattr(args, flag, None):
+                print(f"warning: --{flag} is not supported with --sp yet; "
+                      f"ignored")
+        mesh = Engine.build_mesh((data_deg, args.sp), ("data", "seq"))
+        model = transformer_lm(args.size, vocab, max_len=seq,
+                               seq_axis_name="seq")
+        model.build(jax.ShapeDtypeStruct((args.batch, seq // args.sp),
+                                         jnp.int32))
+        params = model.parameters()[0]
+        method = optim.Adam(learning_rate=args.lr)
+        opt_state = method.init_state(params)
+        step = make_sp_train_step(model, crit, method, mesh,
+                                  data_axis="data")
+        # full batches only: shard_map needs the batch axis divisible
+        n_full = (len(x) // args.batch) * args.batch
+        if n_full == 0:
+            raise ValueError(f"--synthN {len(x)} < --batchSize {args.batch}")
+        x, y = x[:n_full], y[:n_full]
+        steps = args.max_iteration if args.max_iteration is not None \
+            else args.max_epoch * (len(x) // args.batch)
+        for i in range(steps):
+            lo = (i * args.batch) % len(x)
+            bx = jnp.asarray(x[lo:lo + args.batch])
+            by = jnp.asarray(y[lo:lo + args.batch])
+            params, opt_state, loss = step(params, opt_state, bx, by,
+                                           RNG.next_key())
+            print(f"step {i + 1}/{steps} loss {float(loss):.4f}")
+        return
+
+    model = transformer_lm(args.size, vocab, max_len=seq)
+    opt = _build_optimizer(args, model, _to_dataset(x, y, args.batch), None,
+                           crit, optim.Adam(learning_rate=args.lr), [])
+    opt.optimize()
+
+
 def _honor_env_platforms():
     """The axon sitecustomize force-selects the tunneled TPU platform at
     interpreter start, overriding the JAX_PLATFORMS env var; re-assert the
@@ -313,6 +382,15 @@ def main(argv=None):
                       [("--vocab", dict(type=int, default=100)),
                        ("--seq-len", dict(type=int, default=20,
                                           dest="seq_len"))]),
+        "transformer-train": (
+            cmd_transformer_train, 1,
+            [("--vocab", dict(type=int, default=256)),
+             ("--seq-len", dict(type=int, default=64, dest="seq_len")),
+             ("--size", dict(default="tiny",
+                             choices=["tiny", "small", "medium", "large"])),
+             ("--sp", dict(type=int, default=1,
+                           help="sequence-parallel degree (ring attention "
+                                "over a data x seq mesh)"))]),
     }
     for name, (fn, epochs, extra) in specs.items():
         p = sub.add_parser(name)

@@ -1,0 +1,49 @@
+"""Transformer LM model family: the long-context flagship.
+
+No reference analogue exists (BigDL 0.8 predates transformers —
+SURVEY.md §5 'Long-context / sequence parallelism: absent; greenfield');
+this family is the north-star capability built on the same machinery the
+reference families use (models/*/Train.scala CLI style), with optional
+sequence parallelism over a device mesh (ppermute ring attention or
+Ulysses all-to-all — parallel/{ring_attention,ulysses}.py).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.nn.attention import TransformerLM
+
+
+def transformer_lm(size: str = "tiny", vocab_size: int = 32000,
+                   max_len: int = 2048,
+                   seq_axis_name: Optional[str] = None,
+                   seq_mode: str = "ring") -> TransformerLM:
+    """Named configs; 'tiny'/'small' fit a chip's HBM comfortably, larger
+    sizes pair with tp/pp/sp shardings."""
+    configs = {
+        #        hidden heads layers
+        "tiny":  (256,   4,    4),
+        "small": (768,  12,   12),
+        "medium": (1024, 16,  24),
+        "large": (1536, 16,   36),
+    }
+    if size not in configs:
+        raise ValueError(f"unknown size {size!r}; pick from {list(configs)}")
+    hidden, heads, layers = configs[size]
+    return TransformerLM(vocab_size, hidden, heads, layers, max_len=max_len,
+                         seq_axis_name=seq_axis_name, seq_mode=seq_mode)
+
+
+def synthetic_corpus(n_seq: int, seq_len: int, vocab_size: int, seed=0):
+    """Next-token-prediction pairs from a Markov-ish synthetic stream (so a
+    converging loss is meaningful, unlike uniform noise)."""
+    rng = np.random.default_rng(seed)
+    # each token depends on the previous one: learnable structure
+    trans = rng.integers(0, vocab_size, size=(vocab_size, 4))
+    toks = np.empty((n_seq, seq_len + 1), np.int32)
+    toks[:, 0] = rng.integers(0, vocab_size, n_seq)
+    choice = rng.integers(0, 4, size=(n_seq, seq_len))
+    for t in range(seq_len):
+        toks[:, t + 1] = trans[toks[:, t], choice[:, t]]
+    return toks[:, :-1], toks[:, 1:]

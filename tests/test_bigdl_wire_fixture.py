@@ -162,3 +162,101 @@ def test_writer_keeps_the_frozen_convention(tmp_path):
 
 
 import jax  # noqa: E402  (used in the writer test above)
+
+
+# --------------------------------------------------------------------------- #
+# Second frozen fixture: conv + BN (grouped-weight wire layout, eval-mode
+# running statistics as runningMean/runningVar attrs --
+# BatchNormalization.scala:430-436)
+# --------------------------------------------------------------------------- #
+
+FIXTURE2 = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "conv_bn.bigdl")
+
+_rng2 = np.random.default_rng(42)
+_CW = _rng2.standard_normal((4, 3, 3, 3)).astype(np.float32)  # (out,in,kH,kW)
+_CB = _rng2.standard_normal(4).astype(np.float32)
+_G = _rng2.standard_normal(4).astype(np.float32)              # gamma
+_BE = _rng2.standard_normal(4).astype(np.float32)             # beta
+_RM = (_rng2.standard_normal(4) * 0.1).astype(np.float32)
+_RV = (_rng2.random(4) + 0.5).astype(np.float32)
+
+
+def build_conv_bn_message():
+    """Sequential(SpatialConvolution(3->4, 3x3, pad 1), SpatialBatchNorm(4))
+    as the JVM serializer lays it out (5-d grouped conv weight)."""
+    root = pb.BigDLModule()
+    root.name = "convnet"
+    root.moduleType = "com.intel.analytics.bigdl.nn.Sequential"
+    root.version = "0.8.0"
+    root.train = False
+
+    conv = root.subModules.add()
+    conv.name = "conv1"
+    conv.moduleType = "com.intel.analytics.bigdl.nn.SpatialConvolution"
+    conv.version = "0.8.0"
+    conv.train = False
+    for k, v in (("nInputPlane", 3), ("nOutputPlane", 4), ("kernelW", 3),
+                 ("kernelH", 3), ("strideW", 1), ("strideH", 1),
+                 ("padW", 1), ("padH", 1), ("nGroup", 1)):
+        conv.attr[k].dataType = pb.INT32
+        conv.attr[k].int32Value = v
+    conv.attr["withBias"].dataType = pb.BOOL
+    conv.attr["withBias"].boolValue = True
+    conv.hasParameters = True
+    _tensor(conv.parameters.add(), _CW.reshape(1, 4, 3, 3, 3), sid=10)
+    _tensor(conv.parameters.add(), _CB, sid=11)
+
+    bn = root.subModules.add()
+    bn.name = "bn1"
+    bn.moduleType = \
+        "com.intel.analytics.bigdl.nn.SpatialBatchNormalization"
+    bn.version = "0.8.0"
+    bn.train = False
+    bn.attr["nOutput"].dataType = pb.INT32
+    bn.attr["nOutput"].int32Value = 4
+    bn.attr["eps"].dataType = pb.DOUBLE
+    bn.attr["eps"].doubleValue = 1e-5
+    bn.attr["momentum"].dataType = pb.DOUBLE
+    bn.attr["momentum"].doubleValue = 0.1
+    bn.attr["affine"].dataType = pb.BOOL
+    bn.attr["affine"].boolValue = True
+    bn.hasParameters = True
+    _tensor(bn.parameters.add(), _G, sid=12)
+    _tensor(bn.parameters.add(), _BE, sid=13)
+    bn.attr["runningMean"].dataType = pb.TENSOR
+    _tensor(bn.attr["runningMean"].tensorValue, _RM, sid=14)
+    bn.attr["runningVar"].dataType = pb.TENSOR
+    _tensor(bn.attr["runningVar"].tensorValue, _RV, sid=15)
+    return root
+
+
+def test_conv_bn_fixture_bytes_are_frozen():
+    with open(FIXTURE2, "rb") as f:
+        frozen = f.read()
+    ours = build_conv_bn_message().SerializeToString(deterministic=True)
+    assert frozen == ours
+
+
+def test_load_conv_bn_fixture_matches_torch():
+    """Independent oracle: PyTorch executes the same weights in NCHW."""
+    torch = pytest.importorskip("torch")
+    model = load_bigdl(FIXTURE2)
+    model.evaluate()
+    x = np.random.default_rng(1).standard_normal((2, 6, 6, 3)) \
+        .astype(np.float32)
+    ours = np.asarray(model.forward(jnp.asarray(x)))            # NHWC
+
+    tconv = torch.nn.Conv2d(3, 4, 3, padding=1)
+    tbn = torch.nn.BatchNorm2d(4, eps=1e-5)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(_CW))
+        tconv.bias.copy_(torch.from_numpy(_CB))
+        tbn.weight.copy_(torch.from_numpy(_G))
+        tbn.bias.copy_(torch.from_numpy(_BE))
+        tbn.running_mean.copy_(torch.from_numpy(_RM))
+        tbn.running_var.copy_(torch.from_numpy(_RV))
+    tm = torch.nn.Sequential(tconv, tbn).eval()
+    ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    ref = ref.detach().numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
