@@ -287,7 +287,9 @@ def cmd_transformer_train(args):
 
     vocab, seq = args.vocab, args.seq_len
     x, y = synthetic_corpus(args.synth_n, seq, vocab)
-    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+    # Pallas blockwise CE on TPU for big vocabs; plain formulation
+    # elsewhere (ops/cross_entropy.py)
+    crit = nn.TimeDistributedCriterion(nn.FusedSoftmaxCrossEntropyCriterion())
 
     if args.sp > 1:
         from bigdl_tpu.parallel.sequence import make_sp_train_step
@@ -301,15 +303,16 @@ def cmd_transformer_train(args):
             problems.append(f"device count {n_dev} % sp {args.sp} != 0")
         if seq % args.sp:
             problems.append(f"--seq-len {seq} % sp {args.sp} != 0")
-        elif data_deg and args.batch % data_deg:
+        if data_deg and args.batch % data_deg:
             problems.append(f"--batchSize {args.batch} % data-parallel "
                             f"degree {data_deg} != 0")
         if problems:
             raise ValueError("sequence-parallel shape requirements: "
                              + "; ".join(problems))
-        for flag in ("checkpoint", "summary_dir"):
-            if getattr(args, flag, None):
-                print(f"warning: --{flag} is not supported with --sp yet; "
+        for attr, flag in (("checkpoint", "--checkpoint"),
+                           ("summary_dir", "--summaryDir")):
+            if getattr(args, attr, None):
+                print(f"warning: {flag} is not supported with --sp yet; "
                       f"ignored")
         mesh = Engine.build_mesh((data_deg, args.sp), ("data", "seq"))
         model = transformer_lm(args.size, vocab, max_len=seq,

@@ -50,7 +50,8 @@ from bigdl_tpu.nn.detection import (
     bbox_transform_inv, clip_boxes, decode_boxes, nms,
 )
 from bigdl_tpu.nn.criterion import (
-    ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
+    ClassNLLCriterion, CrossEntropyCriterion,
+    FusedSoftmaxCrossEntropyCriterion, MSECriterion, AbsCriterion,
     BCECriterion, BCEWithLogitsCriterion, SmoothL1Criterion,
     DistKLDivCriterion, MarginCriterion, HingeEmbeddingCriterion, L1Cost,
     CosineEmbeddingCriterion, KullbackLeiblerDivergenceCriterion,

@@ -65,6 +65,45 @@ class CrossEntropyCriterion(Criterion):
         return self.inner.apply(jax.nn.log_softmax(input, axis=-1), target)
 
 
+class FusedSoftmaxCrossEntropyCriterion(Criterion):
+    """CrossEntropyCriterion backed by the Pallas blockwise kernel
+    (ops/cross_entropy.py) -- for large vocabularies where materialising
+    log_softmax costs an (N, V) HBM round-trip.  Falls back to the plain
+    formulation for small/ragged class counts where the kernel's block
+    shapes don't pay; wrap in TimeDistributedCriterion for (B, T, V) LM
+    heads.
+    """
+
+    def __init__(self, size_average=True, min_classes=512,
+                 interpret=False):
+        self.size_average = size_average
+        self.min_classes = min_classes
+        #: interpret=True runs the kernel in the Pallas interpreter (tests);
+        #: otherwise off-TPU backends use the plain formulation
+        self.interpret = interpret
+
+    def apply(self, input, target):
+        import jax as _jax
+
+        on_tpu = _jax.default_backend() == "tpu"
+        if (input.ndim != 2 or input.shape[1] < self.min_classes
+                or input.shape[0] % 8 or not (on_tpu or self.interpret)):
+            return CrossEntropyCriterion(
+                size_average=self.size_average).apply(input, target)
+        from bigdl_tpu.ops.cross_entropy import fused_softmax_cross_entropy
+
+        n, v = input.shape
+        block_n = n if n < 128 else 128
+        while n % block_n:
+            block_n //= 2
+        # clip like ClassNLLCriterion so out-of-range/ignore markers give
+        # identical losses on every backend
+        y = jnp.clip(target.astype(jnp.int32), 0, v - 1)
+        losses = fused_softmax_cross_entropy(
+            input, y, block_n, 512, self.interpret)
+        return losses.mean() if self.size_average else losses.sum()
+
+
 class MSECriterion(Criterion):
     """Mean squared error (reference: nn/MSECriterion.scala).
 

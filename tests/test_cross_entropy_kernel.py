@@ -1,0 +1,144 @@
+"""Pallas fused softmax cross-entropy vs the plain jax reference
+(interpret mode on CPU), values and gradients."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.cross_entropy import fused_softmax_cross_entropy
+
+
+def _ref_loss(logits, labels):
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(lsm, labels[:, None], axis=1)[:, 0]
+
+
+@pytest.mark.parametrize("n,v", [(128, 512), (128, 1000), (256, 4096)])
+def test_forward_matches_reference(n, v):
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((n, v)) * 3, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, n), jnp.int32)
+    got = fused_softmax_cross_entropy(logits, labels, interpret=True)
+    want = _ref_loss(logits, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradient_matches_reference():
+    rng = np.random.default_rng(1)
+    n, v = 128, 1000
+    logits = jnp.asarray(rng.standard_normal((n, v)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, n), jnp.int32)
+
+    def mean_fused(x):
+        return fused_softmax_cross_entropy(x, labels,
+                                           interpret=True).mean()
+
+    def mean_ref(x):
+        return _ref_loss(x, labels).mean()
+
+    g_fused = jax.grad(mean_fused)(logits)
+    g_ref = jax.grad(mean_ref)(logits)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_bf16_logits():
+    rng = np.random.default_rng(2)
+    n, v = 128, 512
+    logits = jnp.asarray(rng.standard_normal((n, v)), jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, v, n), jnp.int32)
+    got = fused_softmax_cross_entropy(logits, labels, interpret=True)
+    want = _ref_loss(logits, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+    g = jax.grad(lambda x: fused_softmax_cross_entropy(
+        x, labels, interpret=True).mean())(logits)
+    assert g.dtype == jnp.bfloat16
+
+
+def test_extreme_logits_stable():
+    logits = jnp.asarray([[1e4, -1e4, 0.0, 1e4]] * 128, jnp.float32)
+    labels = jnp.zeros(128, jnp.int32)
+    got = fused_softmax_cross_entropy(
+        jnp.pad(logits, ((0, 0), (0, 124)), constant_values=-1e30),
+        labels, interpret=True)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.log(2.0), rtol=1e-3)
+
+
+class TestFusedCriterion:
+    def test_matches_plain_criterion(self):
+        import bigdl_tpu.nn as nn
+
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(rng.standard_normal((128, 1000)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 1000, 128), jnp.int32)
+        fused = nn.FusedSoftmaxCrossEntropyCriterion(interpret=True)
+        plain = nn.CrossEntropyCriterion()
+        np.testing.assert_allclose(
+            float(fused.apply(logits, labels)),
+            float(plain.apply(logits, labels)), rtol=1e-5)
+
+    def test_small_vocab_falls_back(self):
+        import bigdl_tpu.nn as nn
+
+        rng = np.random.default_rng(4)
+        logits = jnp.asarray(rng.standard_normal((32, 10)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 10, 32), jnp.int32)
+        fused = nn.FusedSoftmaxCrossEntropyCriterion(interpret=True)
+        plain = nn.CrossEntropyCriterion()
+        np.testing.assert_allclose(
+            float(fused.apply(logits, labels)),
+            float(plain.apply(logits, labels)), rtol=1e-5)
+
+    def test_time_distributed_lm_head(self):
+        """(B, T, V) through TimeDistributedCriterion: the LM-head shape."""
+        import bigdl_tpu.nn as nn
+
+        rng = np.random.default_rng(5)
+        b, t, v = 4, 32, 512
+        logits = jnp.asarray(rng.standard_normal((b, t, v)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, v, (b, t)), jnp.int32)
+        fused = nn.TimeDistributedCriterion(
+            nn.FusedSoftmaxCrossEntropyCriterion(interpret=True))
+        plain = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        np.testing.assert_allclose(float(fused.apply(logits, labels)),
+                                   float(plain.apply(logits, labels)),
+                                   rtol=1e-5)
+        g1 = jax.grad(lambda x: fused.apply(x, labels))(logits)
+        g2 = jax.grad(lambda x: plain.apply(x, labels))(logits)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_out_of_range_target_matches_fallback():
+    """Ignore-marker targets (e.g. -1) must produce identical losses on the
+    kernel and fallback paths (ClassNLLCriterion clips)."""
+    import bigdl_tpu.nn as nn
+
+    rng = np.random.default_rng(6)
+    logits = jnp.asarray(rng.standard_normal((64, 600)), jnp.float32)
+    labels = np.asarray(rng.integers(0, 600, 64), np.int32)
+    labels[:5] = -1
+    labels = jnp.asarray(labels)
+    fused = nn.FusedSoftmaxCrossEntropyCriterion(interpret=True)
+    plain = nn.CrossEntropyCriterion()
+    np.testing.assert_allclose(float(fused.apply(logits, labels)),
+                               float(plain.apply(logits, labels)),
+                               rtol=1e-5)
+
+
+def test_3d_input_falls_back():
+    import bigdl_tpu.nn as nn
+
+    rng = np.random.default_rng(7)
+    logits = jnp.asarray(rng.standard_normal((2, 8, 600)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 600, (2, 8)), jnp.int32)
+    fused = nn.FusedSoftmaxCrossEntropyCriterion(interpret=True)
+    plain = nn.CrossEntropyCriterion()
+    np.testing.assert_allclose(float(fused.apply(logits, labels)),
+                               float(plain.apply(logits, labels)),
+                               rtol=1e-5)

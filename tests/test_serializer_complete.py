@@ -273,6 +273,8 @@ CRIT_EXAMPLES = {
     "CosineEmbeddingCriterion": lambda: nn.CosineEmbeddingCriterion(0.1),
     "CosineProximityCriterion": lambda: nn.CosineProximityCriterion(),
     "CrossEntropyCriterion": lambda: nn.CrossEntropyCriterion(),
+    "FusedSoftmaxCrossEntropyCriterion":
+        lambda: nn.FusedSoftmaxCrossEntropyCriterion(),
     "DiceCoefficientCriterion": lambda: nn.DiceCoefficientCriterion(),
     "DistKLDivCriterion": lambda: nn.DistKLDivCriterion(),
     "DotProductCriterion": lambda: nn.DotProductCriterion(),
