@@ -14,23 +14,34 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _bench(compiled, args, steps=8):
-    # warmup
-    out = compiled(*args)
+    """Chained-dispatch timing with a final VALUE fetch: block_until_ready
+    is not trustworthy through the device tunnel (docs/performance.md,
+    round-3 timing investigation), but a result value cannot exist before
+    execution completes.  The first output leaf's [0...] element is
+    fetched; with an un-donated signature each dispatch still depends on
+    the previous one finishing only at the device-queue level, so we ALSO
+    fold the previous output back in when shapes allow (donated-style
+    chain) by re-feeding args unchanged -- the queue serialises identical
+    executables on one core either way."""
     import jax
 
+    out = compiled(*args)             # warmup
     jax.block_until_ready(out)
-    times = []
+    t0 = time.perf_counter()
     for _ in range(steps):
-        t0 = time.perf_counter()
         out = compiled(*args)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2]
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.ravel()[0])            # value fetch forces the queue
+    return (time.perf_counter() - t0) / steps
 
 
 def main():
     import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the axon sitecustomize force-selects the tunneled TPU; honor the
+        # env var so CPU-forced runs never block on the tunnel
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
     import numpy as np
 
