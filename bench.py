@@ -22,17 +22,8 @@ import time
 
 
 def _honor_env_platforms():
-    """The axon sitecustomize force-sets jax_platforms='axon,cpu' via
-    jax.config, overriding the JAX_PLATFORMS env var.  Re-assert the env
-    var's intent so CPU-forced runs stay on CPU."""
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+    from bigdl_tpu.utils.config import honor_env_platforms
+    honor_env_platforms()
 
 
 def run_bench():

@@ -275,7 +275,8 @@ def _convert_node(ctx, ndef):
             sole = ctx.consumers.get(_clean(ins[0]), 0) <= 1
             if (b_val.ndim == 1 and sole and prod.module is not None
                     and (isinstance(prod.module, nn.Linear)
-                         or type(prod.module).__name__ == "TfConv2D")
+                         or type(prod.module).__name__ in ("TfConv2D",
+                                                           "TfConv3D"))
                     and not getattr(prod.module, "_tf_bias_set", False)):
                 mod = prod.module
                 mod._tf_bias_set = True
@@ -1041,6 +1042,46 @@ def _convert_extra_op(ctx, ndef, op, ins):
                         num_segments=num), state
             return "node", Node(_SegSumC(), [data])
         return "node", Node(nnops.SegmentSum(), [data, seg_val])
+
+    if op == "Conv3D":
+        fmt = ndef.attr["data_format"].s.decode()
+        if fmt not in ("", "NDHWC"):
+            raise NotImplementedError(f"Conv3D data_format {fmt}")
+        strides = tuple(ndef.attr["strides"].list.i)[1:4]
+        dil = tuple(ndef.attr["dilations"].list.i)[1:4] or (1, 1, 1)
+        padding = ndef.attr["padding"].s.decode() or "VALID"
+        w_kind, w_val = _convert(ctx, ins[1])
+        if w_kind != "const":
+            raise NotImplementedError("Conv3D with non-constant filter")
+        x = _node_of(ctx, ins[0])
+        w = np.asarray(w_val, np.float32)      # (kd, kh, kw, cin, cout)
+
+        class TfConv3D(Module):
+            """TF-exact 3-D conv: filter/bias as PARAMETERS (trainable,
+            BiasAdd-foldable) like the 2-D TfConv2D; lax string padding
+            reproduces TF SAME."""
+
+            def setup(self, rng, input_spec):
+                return {"weight": jnp.zeros(w.shape, jnp.float32),
+                        "bias": jnp.zeros((w.shape[-1],), jnp.float32)}, ()
+
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                from jax import lax
+                y = lax.conv_general_dilated(
+                    input, params["weight"].astype(input.dtype),
+                    window_strides=strides, padding=padding,
+                    rhs_dilation=dil,
+                    dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+                return y + params["bias"].astype(y.dtype), state
+
+        mod = TfConv3D()
+        node = Node(mod, [x])
+
+        def install(params, w=w):
+            params["weight"] = jnp.asarray(w)
+        ctx.module_blobs.append((mod, install))
+        return "node", node
 
     if op == "RandomShuffle":
         x = _node_of(ctx, ins[0])

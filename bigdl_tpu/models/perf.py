@@ -103,19 +103,8 @@ def run_perf(model_name="resnet50", batch=32, iterations=20, distributed=False):
 
 
 def _honor_env_platforms():
-    """The axon sitecustomize force-selects the tunneled TPU platform at
-    interpreter start, overriding the JAX_PLATFORMS env var; re-assert the
-    env var's intent so CPU-forced runs never block on the tunnel."""
-    import os
-
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+    from bigdl_tpu.utils.config import honor_env_platforms
+    honor_env_platforms()
 
 
 def main(argv=None):

@@ -347,19 +347,8 @@ def cmd_transformer_train(args):
 
 
 def _honor_env_platforms():
-    """The axon sitecustomize force-selects the tunneled TPU platform at
-    interpreter start, overriding the JAX_PLATFORMS env var; re-assert the
-    env var's intent so CPU-forced runs never block on the tunnel."""
-    import os
-
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+    from bigdl_tpu.utils.config import honor_env_platforms
+    honor_env_platforms()
 
 
 def main(argv=None):

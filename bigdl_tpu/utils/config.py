@@ -67,3 +67,21 @@ def redirect_spark_info_logs(path=None):
     for h in logging.getLogger().handlers:
         h.setLevel(max(h.level, logging.WARNING))
     return path
+
+
+def honor_env_platforms():
+    """Re-assert the JAX_PLATFORMS env var's intent.
+
+    The axon sitecustomize force-sets ``jax_platforms`` to the tunneled TPU
+    at interpreter start, overriding the env var; every CLI/tool that wants
+    CPU-forced runs must call this before touching jax.  (Shared helper --
+    the same workaround used to be copy-pasted per entry point.)
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass

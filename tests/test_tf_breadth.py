@@ -452,3 +452,31 @@ class TestExtraOpLoaders:
             tf.identity(tf.cast(tf.raw_ops.ApproximateEqual(
                 x=pa, y=pb, tolerance=1e-3), tf.float32), name="out")
         self._roundtrip(build, {"a": a, "b": b}, "out")
+
+    def test_conv3d(self):
+        x = np.random.randn(2, 5, 6, 7, 3).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (2, 5, 6, 7, 3),
+                                         name="x")
+            w = tf.constant(
+                np.random.default_rng(0).standard_normal(
+                    (3, 3, 3, 3, 4)).astype(np.float32))
+            t = tf.nn.conv3d(p, w, strides=[1, 1, 2, 2, 1], padding="SAME")
+            tf.identity(t, name="out")
+        self._roundtrip(build, {"x": x}, "out", rtol=1e-4)
+
+    def test_conv3d_bias_fold(self):
+        x = np.random.randn(1, 4, 5, 6, 2).astype(np.float32)
+
+        def build(tf):
+            p = tf.compat.v1.placeholder(tf.float32, (1, 4, 5, 6, 2),
+                                         name="x")
+            rng = np.random.default_rng(1)
+            w = tf.constant(rng.standard_normal(
+                (2, 2, 2, 2, 3)).astype(np.float32))
+            b = tf.constant(rng.standard_normal(3).astype(np.float32))
+            t = tf.nn.conv3d(p, w, strides=[1, 1, 1, 1, 1],
+                             padding="VALID") + b
+            tf.identity(t, name="out")
+        self._roundtrip(build, {"x": x}, "out", rtol=1e-4)

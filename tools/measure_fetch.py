@@ -22,6 +22,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
+    from bigdl_tpu.utils.config import honor_env_platforms
+    honor_env_platforms()
+
     import jax
     import jax.numpy as jnp
     import numpy as np

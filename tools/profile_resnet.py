@@ -14,15 +14,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _bench(compiled, args, steps=8):
-    """Chained-dispatch timing with a final VALUE fetch: block_until_ready
-    is not trustworthy through the device tunnel (docs/performance.md,
-    round-3 timing investigation), but a result value cannot exist before
-    execution completes.  The first output leaf's [0...] element is
-    fetched; with an un-donated signature each dispatch still depends on
-    the previous one finishing only at the device-queue level, so we ALSO
-    fold the previous output back in when shapes allow (donated-style
-    chain) by re-feeding args unchanged -- the queue serialises identical
-    executables on one core either way."""
+    """Dispatch-N-then-fetch-a-VALUE timing: block_until_ready is not
+    trustworthy through the device tunnel (docs/performance.md, round-3
+    timing investigation), but a result value cannot exist before its
+    execution completes.  There is NO data dependency between dispatches
+    (args are re-fed unchanged); correctness rests on the single-core
+    in-order execution queue -- the last execution finishing implies all
+    prior ones did.  bench.py's donated-chain measurement is the stronger
+    primary; this is the profiling-loop approximation."""
     import jax
 
     out = compiled(*args)             # warmup
@@ -31,17 +30,15 @@ def _bench(compiled, args, steps=8):
     for _ in range(steps):
         out = compiled(*args)
     leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.ravel()[0])            # value fetch forces the queue
+    float(leaf.ravel()[0])            # value fetch drains the queue
     return (time.perf_counter() - t0) / steps
 
 
 def main():
-    import jax
+    from bigdl_tpu.utils.config import honor_env_platforms
+    honor_env_platforms()
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # the axon sitecustomize force-selects the tunneled TPU; honor the
-        # env var so CPU-forced runs never block on the tunnel
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
