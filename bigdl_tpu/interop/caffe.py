@@ -309,6 +309,7 @@ def load_caffe(prototxt_path, model_path=None, input_shape=None,
         cin = channels.get(bottom, input_shape[-1])
         mod, cout = _build_module(type_str, lpb, cin,
                                   customized_layers or {})
+        mod.name = name        # caffe layer name (copy_weights matches on it)
         node = Node(mod, [top_nodes[bottom]])
         top_nodes[tops[0] if tops else name] = node
         channels[tops[0] if tops else name] = cout
@@ -540,47 +541,95 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
 
 
 def load(model, prototxt_path, model_path, match_all=True):
-    """Copy caffe weights into an EXISTING bigdl_tpu model by layer name
-    (reference: CaffeLoader.load, CaffeLoader.scala:57).
+    """Reference-named alias of :func:`copy_weights`
+    (CaffeLoader.load, CaffeLoader.scala:57)."""
+    return copy_weights(model, prototxt_path, model_path, match_all)
 
-    The model must be built.  Matching: module.name == caffe layer name.
-    Caveat: InnerProduct blobs are copied verbatim, i.e. with caffe's
-    (C,H,W)-order columns -- a model whose flatten is NHWC-ordered
-    (``nn.Flatten``) needs the importer's graph path (``load_caffe``)
-    instead, which inserts an NCHW-ordered flatten.
+
+def copy_weights(model, prototxt_path, model_path, match_all=True):
+    """Copy caffemodel weights into an EXISTING model by layer name
+    (reference: CaffeLoader.load -- CaffeLoader.scala:57 "load caffe model
+    weights into a predefined net").  ``match_all=True`` raises when a
+    caffe layer carrying weights finds no same-named target module;
+    target layers with no caffe counterpart keep their initialization.
+
+    The target's layers must be named after the caffe layers (as
+    ``load_caffe`` names them); layout conversion matches the import path
+    (conv (out, in/g, kH, kW) -> HWIO, BN mean/var with scale factor).
+    ``prototxt_path`` mirrors the reference signature; matching is by name
+    from the caffemodel alone, so it is accepted but not read.  Returns
+    the model.
     """
     import jax.numpy as jnp
     import bigdl_tpu.nn as nn
 
+    if not model.is_built():
+        raise ValueError("copy_weights expects a built model")
     wnet = _read_net(model_path, binary=True)
     blobs_by_name = {}
     for name, _, _, _, lpb in _layers(wnet):
         if lpb.blobs:
             blobs_by_name[name] = [_blob_to_array(b) for b in lpb.blobs]
 
-    copied = set()
+    def walk(mod, params, state):
+        matched = []
+        name = getattr(mod, "name", None)
+        if name in blobs_by_name and isinstance(params, dict):
+            blobs = blobs_by_name[name]
+            if isinstance(mod, nn.SpatialConvolution):
+                w = blobs[0].reshape(blobs[0].shape[-4:])
+                params["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))
+                if len(blobs) > 1 and "bias" in params:
+                    params["bias"] = jnp.asarray(blobs[1].reshape(-1))
+            elif isinstance(mod, nn.Linear):
+                params["weight"] = jnp.asarray(
+                    blobs[0].reshape(blobs[0].shape[-2:]))
+                if len(blobs) > 1 and "bias" in params:
+                    params["bias"] = jnp.asarray(blobs[1].reshape(-1))
+            elif isinstance(mod, nn.Sequential) and mod.modules \
+                    and isinstance(mod.modules[-1], nn.Linear):
+                # InnerProduct import wrapper (flatten + linear)
+                sub = params[str(len(mod.modules) - 1)]
+                sub["weight"] = jnp.asarray(
+                    blobs[0].reshape(blobs[0].shape[-2:]))
+                if len(blobs) > 1 and "bias" in sub:
+                    sub["bias"] = jnp.asarray(blobs[1].reshape(-1))
+            elif isinstance(mod, nn.SpatialBatchNormalization):
+                scale = float(blobs[2][0]) if len(blobs) > 2 \
+                    and blobs[2].size else 1.0
+                scale = 1.0 / scale if scale != 0 else 1.0
+                state["running_mean"] = jnp.asarray(
+                    blobs[0].reshape(-1) * scale)
+                state["running_var"] = jnp.asarray(
+                    blobs[1].reshape(-1) * scale)
+            elif type(mod).__name__ == "ChannelAffine":
+                # caffe Scale layer (the BN+Scale pair)
+                params["weight"] = jnp.asarray(blobs[0].reshape(-1))
+                if len(blobs) > 1 and "bias" in params:
+                    params["bias"] = jnp.asarray(blobs[1].reshape(-1))
+            else:
+                raise NotImplementedError(
+                    f"copy_weights into {type(mod).__name__}")
+            matched.append(name)
+        topo = getattr(mod, "_topo", None)
+        if topo is not None:
+            for i, node in enumerate(topo):
+                if node.module is not None and str(i) in params:
+                    matched += walk(node.module, params[str(i)],
+                                    state.get(str(i), {}))
+        else:
+            for i, child in enumerate(mod.children()):
+                if isinstance(params, dict) and str(i) in params:
+                    matched += walk(child, params[str(i)],
+                                    state.get(str(i), {})
+                                    if isinstance(state, dict) else {})
+        return matched
 
-    def walk(mod, params):
-        for i, child in enumerate(getattr(mod, "modules", [])):
-            sub = params.get(str(i), {}) if isinstance(params, dict) else {}
-            blobs = blobs_by_name.get(child.name)
-            if blobs:
-                if isinstance(child, nn.SpatialConvolution):
-                    w = blobs[0].reshape(blobs[0].shape[-4:])
-                    sub["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))
-                    if len(blobs) > 1 and "bias" in sub:
-                        sub["bias"] = jnp.asarray(blobs[1].reshape(-1))
-                    copied.add(child.name)
-                elif isinstance(child, nn.Linear):
-                    sub["weight"] = jnp.asarray(
-                        blobs[0].reshape(blobs[0].shape[-2:]))
-                    if len(blobs) > 1 and "bias" in sub:
-                        sub["bias"] = jnp.asarray(blobs[1].reshape(-1))
-                    copied.add(child.name)
-            walk(child, sub)
-
-    walk(model, model._params)
-    missing = set(blobs_by_name) - copied
-    if match_all and missing:
-        raise ValueError(f"unmatched caffe layers: {sorted(missing)}")
+    matched = walk(model, model._params, model._state)
+    if match_all:
+        unmatched = [m for m in blobs_by_name if m not in matched]
+        if unmatched:
+            raise ValueError(
+                f"caffe layers with no target module (matchAll=True, "
+                f"reference CaffeLoader semantics): {unmatched}")
     return model

@@ -1055,6 +1055,7 @@ def _convert_extra_op(ctx, ndef, op, ins):
             raise NotImplementedError("Conv3D with non-constant filter")
         x = _node_of(ctx, ins[0])
         w = np.asarray(w_val, np.float32)      # (kd, kh, kw, cin, cout)
+        w_shape = w.shape                       # class captures shape only
 
         class TfConv3D(Module):
             """TF-exact 3-D conv: filter/bias as PARAMETERS (trainable,
@@ -1062,8 +1063,8 @@ def _convert_extra_op(ctx, ndef, op, ins):
             reproduces TF SAME."""
 
             def setup(self, rng, input_spec):
-                return {"weight": jnp.zeros(w.shape, jnp.float32),
-                        "bias": jnp.zeros((w.shape[-1],), jnp.float32)}, ()
+                return {"weight": jnp.zeros(w_shape, jnp.float32),
+                        "bias": jnp.zeros((w_shape[-1],), jnp.float32)}, ()
 
             def apply(self, params, state, input, *, training=False,
                       rng=None):

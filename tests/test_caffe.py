@@ -131,3 +131,42 @@ class TestCaffeExportRoundTrip:
         g.evaluate()
         np.testing.assert_allclose(np.asarray(y), np.asarray(g.forward(x)),
                                    atol=1e-5)
+
+
+@needs_fixtures
+class TestCopyWeights:
+    """CaffeLoader.load semantics: copy caffemodel weights into an
+    EXISTING net by layer name (CaffeLoader.scala:57)."""
+
+    def test_copy_matches_full_load(self):
+        from bigdl_tpu.interop.caffe import copy_weights
+
+        golden = load_caffe(
+            FIXDIR + "test.prototxt", FIXDIR + "test.caffemodel",
+            customized_layers={"Dummy": lambda lpb: nn.Identity()})
+        golden.evaluate()
+
+        # architecture only (random init), then copy weights in by name
+        fresh = load_caffe(
+            FIXDIR + "test.prototxt", None,
+            customized_layers={"Dummy": lambda lpb: nn.Identity()})
+        fresh.evaluate()
+        x = jnp.asarray(
+            np.random.default_rng(1).normal(size=(1, 5, 5, 3)), jnp.float32)
+        before = np.asarray(fresh.forward(x))
+        copy_weights(fresh, FIXDIR + "test.prototxt",
+                     FIXDIR + "test.caffemodel")
+        after = np.asarray(fresh.forward(x))
+        want = np.asarray(golden.forward(x))
+        assert not np.allclose(before, want)    # random init differed
+        np.testing.assert_allclose(after, want, rtol=1e-5, atol=1e-6)
+
+    def test_match_all_raises_on_missing_target(self):
+        from bigdl_tpu.interop.caffe import copy_weights
+
+        m = nn.Sequential().add(nn.Linear(4, 2))
+        import jax
+        m.build(jax.ShapeDtypeStruct((1, 4), jnp.float32))
+        with pytest.raises(ValueError, match="matchAll"):
+            copy_weights(m, FIXDIR + "test.prototxt",
+                         FIXDIR + "test.caffemodel")
