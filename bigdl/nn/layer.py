@@ -26,26 +26,46 @@ def _dim(v):
 
 
 class Regularizer:
-    """Weight-penalty marker (reference: pyspark/bigdl/optim/optimizer.py
-    L1L2Regularizer).  Recorded on the layer; the TPU training loop applies
-    global weight decay via the OptimMethod instead of per-layer hooks."""
+    """pyspark regularizer (reference: pyspark/bigdl/optim/optimizer.py
+    L1L2Regularizer).  Converts to the native per-layer mechanism
+    (bigdl_tpu.optim.regularizer), which the training loops apply."""
 
     def __init__(self, l1=0.0, l2=0.0, bigdl_type="float"):
         self.l1, self.l2 = l1, l2
+
+    def _native(self):
+        from bigdl_tpu.optim.regularizer import L1L2Regularizer as _N
+        return _N(self.l1, self.l2)
 
 
 class L1Regularizer(Regularizer):
     def __init__(self, l1, bigdl_type="float"):
         super().__init__(l1=l1)
 
+    def _native(self):
+        from bigdl_tpu.optim.regularizer import L1Regularizer as _N
+        return _N(self.l1)
+
 
 class L2Regularizer(Regularizer):
     def __init__(self, l2, bigdl_type="float"):
         super().__init__(l2=l2)
 
+    def _native(self):
+        from bigdl_tpu.optim.regularizer import L2Regularizer as _N
+        return _N(self.l2)
+
 
 class L1L2Regularizer(Regularizer):
     pass
+
+
+def _set_native_regs(module, w_reg, b_reg):
+    """Install pyspark-style regularizer markers as native per-layer
+    regularizers on the module."""
+    module.set_regularizer(
+        w_reg._native() if w_reg is not None else None,
+        b_reg._native() if b_reg is not None else None)
 
 
 def _install_inits(params, init_weight=None, init_bias=None):
@@ -70,6 +90,7 @@ class Linear(_nn.Linear):
         super().__init__(input_size, output_size, with_bias=with_bias,
                          name=name)
         self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
+        _set_native_regs(self, wRegularizer, bRegularizer)
         self._compat_inits = (init_weight, init_bias)
 
     def setup(self, rng, input_spec):
@@ -93,6 +114,7 @@ class SpatialConvolution(_nn.SpatialConvolution):
                          with_bias=with_bias, data_format=data_format,
                          name=name)
         self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
+        _set_native_regs(self, wRegularizer, bRegularizer)
         self._compat_inits = (init_weight, init_bias)
 
     @staticmethod

@@ -346,11 +346,56 @@ def _blob_to_array(b):
                         else (data.size,))
 
 
-def _install_weights(graph, module_blobs):
-    """Copy caffe blobs into the built graph's params (layout-converted)."""
+def _install_blobs(mod, params, state, blobs, strict_shapes=True):
+    """Install one caffe layer's blobs into a module's param/state dicts,
+    layout-converted (conv (out, in/g, kH, kW) -> HWIO, InnerProduct
+    verbatim caffe column order, BN mean/var with the scale factor,
+    Scale -> ChannelAffine).  The ONE conversion table -- both the import
+    path and copy_weights go through it.  -> True if installed, False for
+    module types with no blob convention."""
     import jax.numpy as jnp
     import bigdl_tpu.nn as nn
 
+    if isinstance(mod, nn.SpatialConvolution):
+        w = blobs[0].reshape(blobs[0].shape[-4:])  # (out, in/g, kh, kw)
+        params["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))
+        if len(blobs) > 1 and "bias" in params:
+            params["bias"] = jnp.asarray(blobs[1].reshape(-1))
+        return True
+    if isinstance(mod, nn.Linear):
+        w = blobs[0].reshape(blobs[0].shape[-2:])
+        if strict_shapes and tuple(params["weight"].shape) != tuple(w.shape):
+            raise ValueError(
+                f"InnerProduct weight shape {w.shape} vs "
+                f"{tuple(params['weight'].shape)}")
+        params["weight"] = jnp.asarray(w)
+        if len(blobs) > 1 and "bias" in params:
+            params["bias"] = jnp.asarray(blobs[1].reshape(-1))
+        return True
+    if isinstance(mod, nn.Sequential) and mod.modules \
+            and isinstance(mod.modules[-1], nn.Linear):
+        # InnerProduct import wrapper (flatten + linear)
+        sub = params[str(len(mod.modules) - 1)]
+        return _install_blobs(mod.modules[-1], sub, {}, blobs,
+                              strict_shapes=strict_shapes)
+    if isinstance(mod, nn.SpatialBatchNormalization):
+        # caffe BatchNorm blobs: mean, var, scale_factor
+        scale = float(blobs[2][0]) if len(blobs) > 2 and blobs[2].size \
+            else 1.0
+        scale = 1.0 / scale if scale != 0 else 1.0
+        state["running_mean"] = jnp.asarray(blobs[0].reshape(-1) * scale)
+        state["running_var"] = jnp.asarray(blobs[1].reshape(-1) * scale)
+        return True
+    if type(mod).__name__ == "ChannelAffine":  # caffe Scale layer
+        params["weight"] = jnp.asarray(blobs[0].reshape(-1))
+        if len(blobs) > 1 and "bias" in params:
+            params["bias"] = jnp.asarray(blobs[1].reshape(-1))
+        return True
+    return False
+
+
+def _install_weights(graph, module_blobs):
+    """Copy caffe blobs into the built graph's params (layout-converted)."""
     mod_to_idx = {}
     for i, node in enumerate(graph._topo):
         if node.module is not None:
@@ -360,36 +405,8 @@ def _install_weights(graph, module_blobs):
         if not blobs:
             continue
         key = mod_to_idx[id(mod)]
-        tgt = graph._params[key]
-        if isinstance(mod, nn.SpatialConvolution):
-            w = blobs[0].reshape(blobs[0].shape[-4:])  # (out, in/g, kh, kw)
-            tgt["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))
-            if len(blobs) > 1 and "bias" in tgt:
-                tgt["bias"] = jnp.asarray(blobs[1].reshape(-1))
-        elif isinstance(mod, nn.Sequential):   # InnerProduct wrapper
-            lin = mod.modules[-1]
-            sub = tgt[str(len(mod.modules) - 1)]
-            w = blobs[0].reshape(blobs[0].shape[-2:])
-            if tuple(sub["weight"].shape) != tuple(w.shape):
-                raise ValueError(
-                    f"InnerProduct weight shape {w.shape} vs "
-                    f"{tuple(sub['weight'].shape)}")
-            sub["weight"] = jnp.asarray(w)
-            if len(blobs) > 1 and "bias" in sub:
-                sub["bias"] = jnp.asarray(blobs[1].reshape(-1))
-        elif isinstance(mod, nn.SpatialBatchNormalization):
-            # caffe BatchNorm blobs: mean, var, scale_factor
-            scale = float(blobs[2][0]) if len(blobs) > 2 and blobs[2].size \
-                else 1.0
-            scale = 1.0 / scale if scale != 0 else 1.0
-            st = graph._state[key]
-            st["running_mean"] = jnp.asarray(blobs[0].reshape(-1) * scale)
-            st["running_var"] = jnp.asarray(blobs[1].reshape(-1) * scale)
-        elif type(mod).__name__ == "ChannelAffine":
-            tgt["weight"] = jnp.asarray(blobs[0].reshape(-1))
-            if len(blobs) > 1 and "bias" in tgt:
-                tgt["bias"] = jnp.asarray(blobs[1].reshape(-1))
-        else:
+        if not _install_blobs(mod, graph._params[key],
+                              graph._state.get(key, {}), blobs):
             warnings.warn(f"blobs for unhandled module {type(mod).__name__}")
 
 
@@ -538,6 +555,69 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
         f.write(text_format.MessageToString(defn))
     with open(model_path, "wb") as f:
         f.write(net.SerializeToString())
+
+
+def load(model, prototxt_path, model_path, match_all=True):
+    """Reference-named alias of :func:`copy_weights`
+    (CaffeLoader.load, CaffeLoader.scala:57)."""
+    return copy_weights(model, prototxt_path, model_path, match_all)
+
+
+def copy_weights(model, prototxt_path, model_path, match_all=True):
+    """Copy caffemodel weights into an EXISTING model by layer name
+    (reference: CaffeLoader.load -- CaffeLoader.scala:57 "load caffe model
+    weights into a predefined net").  ``match_all=True`` raises when a
+    caffe layer carrying weights finds no same-named installable target
+    module; with ``match_all=False`` such layers are skipped.  Target
+    layers with no caffe counterpart keep their initialization either way.
+
+    The target's layers must be named after the caffe layers (as
+    ``load_caffe`` names them); blob layout conversion is the import
+    path's (shared ``_install_blobs`` table).  Caveat: InnerProduct blobs
+    copy verbatim with caffe's (C,H,W)-ordered columns -- a hand-built
+    model flattening in NHWC order (plain ``nn.Flatten``) needs the
+    importer's graph path (``load_caffe``), which inserts an NCHW-ordered
+    flatten.  ``prototxt_path`` mirrors the reference signature; matching
+    is by name from the caffemodel alone, so it is accepted but not read.
+    Returns the model.
+    """
+    if not model.is_built():
+        raise ValueError("copy_weights expects a built model")
+    wnet = _read_net(model_path, binary=True)
+    blobs_by_name = {}
+    for name, _, _, _, lpb in _layers(wnet):
+        if lpb.blobs:
+            blobs_by_name[name] = [_blob_to_array(b) for b in lpb.blobs]
+
+    def walk(mod, params, state):
+        matched = []
+        name = getattr(mod, "name", None)
+        if name in blobs_by_name and isinstance(params, dict):
+            if _install_blobs(mod, params, state, blobs_by_name[name]):
+                matched.append(name)
+        topo = getattr(mod, "_topo", None)
+        if topo is not None:
+            for i, node in enumerate(topo):
+                if node.module is not None and str(i) in params:
+                    matched += walk(node.module, params[str(i)],
+                                    state.get(str(i), {}))
+        else:
+            for i, child in enumerate(mod.children()):
+                if isinstance(params, dict) and str(i) in params:
+                    matched += walk(child, params[str(i)],
+                                    state.get(str(i), {})
+                                    if isinstance(state, dict) else {})
+        return matched
+
+    matched = walk(model, model._params, model._state)
+    if match_all:
+        unmatched = [m for m in blobs_by_name if m not in matched]
+        if unmatched:
+            raise ValueError(
+                f"caffe layers with no installable target module "
+                f"(matchAll=True, reference CaffeLoader semantics): "
+                f"{unmatched}")
+    return model
 
 
 def load(model, prototxt_path, model_path, match_all=True):

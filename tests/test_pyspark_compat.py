@@ -56,3 +56,24 @@ class TestCompatNamespace:
         assert t({"neval": 6, "epoch": 1}) and not t({"neval": 3, "epoch": 1})
         assert MaxEpoch(2)({"epoch": 3, "neval": 0})
         assert EveryEpoch() is not None and SeveralIteration(4) is not None
+
+
+def test_pyspark_regularizers_are_live():
+    """wRegularizer on a pyspark-named layer feeds the native per-layer
+    mechanism (previously an inert marker)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl.nn.layer import L2Regularizer, Linear
+    from bigdl_tpu.optim.regularizer import (has_regularizers,
+                                             regularization_loss)
+
+    fc = Linear(4, 2, wRegularizer=L2Regularizer(0.5))
+    assert has_regularizers(fc)
+    fc.build(jax.ShapeDtypeStruct((1, 4), jnp.float32))
+    p = fc.parameters()[0]
+    want = 0.25 * float((np.asarray(p["weight"]) ** 2).sum())
+    got = float(regularization_loss(fc, p))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
