@@ -30,8 +30,22 @@ class DLModel:
         self.feature_size = tuple(feature_size)
         self.batch_size = batch_size
 
+    #: image-frame column consumed by transform() when X is a row list
+    #: (reference: DLModel.setFeaturesCol on DLImageTransformer output)
+    features_col = "output"
+
     def transform(self, X) -> np.ndarray:
-        """-> predictions, one row per input row."""
+        """-> predictions, one row per input row.
+
+        Accepts a plain array OR a list of image-schema rows from
+        DLImageReader/DLImageTransformer (the reference's
+        readImages -> transformer -> model DataFrame flow); rows are
+        decoded from ``features_col`` (falling back to the raw ``image``
+        column).
+        """
+        if isinstance(X, list) and X and isinstance(X[0], dict):
+            col = self.features_col if self.features_col in X[0] else "image"
+            X = np.stack([_row_to_image(r[col]) for r in X])
         X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
         samples = [Sample(x) for x in X]
         return np.stack(self.model.predict(samples, self.batch_size))

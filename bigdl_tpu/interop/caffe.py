@@ -356,21 +356,29 @@ def _install_blobs(mod, params, state, blobs, strict_shapes=True):
     import jax.numpy as jnp
     import bigdl_tpu.nn as nn
 
+    def put(tgt, key, arr, what):
+        """Install with a shape check against the existing leaf -- a
+        mismatched caffemodel must fail here, not later inside XLA."""
+        arr = np.asarray(arr, np.float32)
+        if strict_shapes and key in tgt \
+                and tuple(tgt[key].shape) != arr.shape:
+            raise ValueError(
+                f"{what} {key} shape {arr.shape} != expected "
+                f"{tuple(tgt[key].shape)} on {type(mod).__name__} "
+                f"'{getattr(mod, 'name', '?')}'")
+        tgt[key] = jnp.asarray(arr)
+
     if isinstance(mod, nn.SpatialConvolution):
         w = blobs[0].reshape(blobs[0].shape[-4:])  # (out, in/g, kh, kw)
-        params["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))
+        put(params, "weight", w.transpose(2, 3, 1, 0), "conv")
         if len(blobs) > 1 and "bias" in params:
-            params["bias"] = jnp.asarray(blobs[1].reshape(-1))
+            put(params, "bias", blobs[1].reshape(-1), "conv")
         return True
     if isinstance(mod, nn.Linear):
-        w = blobs[0].reshape(blobs[0].shape[-2:])
-        if strict_shapes and tuple(params["weight"].shape) != tuple(w.shape):
-            raise ValueError(
-                f"InnerProduct weight shape {w.shape} vs "
-                f"{tuple(params['weight'].shape)}")
-        params["weight"] = jnp.asarray(w)
+        put(params, "weight", blobs[0].reshape(blobs[0].shape[-2:]),
+            "InnerProduct")
         if len(blobs) > 1 and "bias" in params:
-            params["bias"] = jnp.asarray(blobs[1].reshape(-1))
+            put(params, "bias", blobs[1].reshape(-1), "InnerProduct")
         return True
     if isinstance(mod, nn.Sequential) and mod.modules \
             and isinstance(mod.modules[-1], nn.Linear):
@@ -383,13 +391,13 @@ def _install_blobs(mod, params, state, blobs, strict_shapes=True):
         scale = float(blobs[2][0]) if len(blobs) > 2 and blobs[2].size \
             else 1.0
         scale = 1.0 / scale if scale != 0 else 1.0
-        state["running_mean"] = jnp.asarray(blobs[0].reshape(-1) * scale)
-        state["running_var"] = jnp.asarray(blobs[1].reshape(-1) * scale)
+        put(state, "running_mean", blobs[0].reshape(-1) * scale, "BN")
+        put(state, "running_var", blobs[1].reshape(-1) * scale, "BN")
         return True
     if type(mod).__name__ == "ChannelAffine":  # caffe Scale layer
-        params["weight"] = jnp.asarray(blobs[0].reshape(-1))
+        put(params, "weight", blobs[0].reshape(-1), "Scale")
         if len(blobs) > 1 and "bias" in params:
-            params["bias"] = jnp.asarray(blobs[1].reshape(-1))
+            put(params, "bias", blobs[1].reshape(-1), "Scale")
         return True
     return False
 
@@ -405,8 +413,8 @@ def _install_weights(graph, module_blobs):
         if not blobs:
             continue
         key = mod_to_idx[id(mod)]
-        if not _install_blobs(mod, graph._params[key],
-                              graph._state.get(key, {}), blobs):
+        if not _install_blobs(mod, graph._params[key], graph._state[key],
+                              blobs):
             warnings.warn(f"blobs for unhandled module {type(mod).__name__}")
 
 
@@ -617,99 +625,4 @@ def copy_weights(model, prototxt_path, model_path, match_all=True):
                 f"caffe layers with no installable target module "
                 f"(matchAll=True, reference CaffeLoader semantics): "
                 f"{unmatched}")
-    return model
-
-
-def load(model, prototxt_path, model_path, match_all=True):
-    """Reference-named alias of :func:`copy_weights`
-    (CaffeLoader.load, CaffeLoader.scala:57)."""
-    return copy_weights(model, prototxt_path, model_path, match_all)
-
-
-def copy_weights(model, prototxt_path, model_path, match_all=True):
-    """Copy caffemodel weights into an EXISTING model by layer name
-    (reference: CaffeLoader.load -- CaffeLoader.scala:57 "load caffe model
-    weights into a predefined net").  ``match_all=True`` raises when a
-    caffe layer carrying weights finds no same-named target module;
-    target layers with no caffe counterpart keep their initialization.
-
-    The target's layers must be named after the caffe layers (as
-    ``load_caffe`` names them); layout conversion matches the import path
-    (conv (out, in/g, kH, kW) -> HWIO, BN mean/var with scale factor).
-    ``prototxt_path`` mirrors the reference signature; matching is by name
-    from the caffemodel alone, so it is accepted but not read.  Returns
-    the model.
-    """
-    import jax.numpy as jnp
-    import bigdl_tpu.nn as nn
-
-    if not model.is_built():
-        raise ValueError("copy_weights expects a built model")
-    wnet = _read_net(model_path, binary=True)
-    blobs_by_name = {}
-    for name, _, _, _, lpb in _layers(wnet):
-        if lpb.blobs:
-            blobs_by_name[name] = [_blob_to_array(b) for b in lpb.blobs]
-
-    def walk(mod, params, state):
-        matched = []
-        name = getattr(mod, "name", None)
-        if name in blobs_by_name and isinstance(params, dict):
-            blobs = blobs_by_name[name]
-            if isinstance(mod, nn.SpatialConvolution):
-                w = blobs[0].reshape(blobs[0].shape[-4:])
-                params["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))
-                if len(blobs) > 1 and "bias" in params:
-                    params["bias"] = jnp.asarray(blobs[1].reshape(-1))
-            elif isinstance(mod, nn.Linear):
-                params["weight"] = jnp.asarray(
-                    blobs[0].reshape(blobs[0].shape[-2:]))
-                if len(blobs) > 1 and "bias" in params:
-                    params["bias"] = jnp.asarray(blobs[1].reshape(-1))
-            elif isinstance(mod, nn.Sequential) and mod.modules \
-                    and isinstance(mod.modules[-1], nn.Linear):
-                # InnerProduct import wrapper (flatten + linear)
-                sub = params[str(len(mod.modules) - 1)]
-                sub["weight"] = jnp.asarray(
-                    blobs[0].reshape(blobs[0].shape[-2:]))
-                if len(blobs) > 1 and "bias" in sub:
-                    sub["bias"] = jnp.asarray(blobs[1].reshape(-1))
-            elif isinstance(mod, nn.SpatialBatchNormalization):
-                scale = float(blobs[2][0]) if len(blobs) > 2 \
-                    and blobs[2].size else 1.0
-                scale = 1.0 / scale if scale != 0 else 1.0
-                state["running_mean"] = jnp.asarray(
-                    blobs[0].reshape(-1) * scale)
-                state["running_var"] = jnp.asarray(
-                    blobs[1].reshape(-1) * scale)
-            elif type(mod).__name__ == "ChannelAffine":
-                # caffe Scale layer (the BN+Scale pair)
-                params["weight"] = jnp.asarray(blobs[0].reshape(-1))
-                if len(blobs) > 1 and "bias" in params:
-                    params["bias"] = jnp.asarray(blobs[1].reshape(-1))
-            else:
-                raise NotImplementedError(
-                    f"copy_weights into {type(mod).__name__}")
-            matched.append(name)
-        topo = getattr(mod, "_topo", None)
-        if topo is not None:
-            for i, node in enumerate(topo):
-                if node.module is not None and str(i) in params:
-                    matched += walk(node.module, params[str(i)],
-                                    state.get(str(i), {}))
-        else:
-            for i, child in enumerate(mod.children()):
-                if isinstance(params, dict) and str(i) in params:
-                    matched += walk(child, params[str(i)],
-                                    state.get(str(i), {})
-                                    if isinstance(state, dict) else {})
-        return matched
-
-    matched = walk(model, model._params, model._state)
-    if match_all:
-        unmatched = [m for m in blobs_by_name if m not in matched]
-        if unmatched:
-            raise ValueError(
-                f"caffe layers with no target module (matchAll=True, "
-                f"reference CaffeLoader semantics): {unmatched}")
     return model

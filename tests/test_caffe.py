@@ -170,3 +170,26 @@ class TestCopyWeights:
         with pytest.raises(ValueError, match="matchAll"):
             copy_weights(m, FIXDIR + "test.prototxt",
                          FIXDIR + "test.caffemodel")
+
+    def test_match_all_false_skips_unsupported(self):
+        """match_all=False skips caffe layers whose named target has no
+        blob convention instead of raising (new tolerant semantics)."""
+        from bigdl_tpu.interop.caffe import copy_weights
+
+        import jax
+        m = nn.Sequential().add(nn.ReLU())
+        m.modules[0].name = "conv"     # name-collides with a weighted layer
+        m.build(jax.ShapeDtypeStruct((1, 4), jnp.float32))
+        copy_weights(m, FIXDIR + "test.prototxt",
+                     FIXDIR + "test.caffemodel", match_all=False)
+
+    def test_shape_mismatch_fails_loudly(self):
+        from bigdl_tpu.interop.caffe import copy_weights
+
+        import jax
+        m = nn.Sequential().add(nn.SpatialConvolution(3, 7, 3, 3))
+        m.modules[0].name = "conv"     # fixture conv has different shape
+        m.build(jax.ShapeDtypeStruct((1, 5, 5, 3), jnp.float32))
+        with pytest.raises(ValueError, match="shape"):
+            copy_weights(m, FIXDIR + "test.prototxt",
+                         FIXDIR + "test.caffemodel", match_all=False)

@@ -295,3 +295,29 @@ class TestDLImageFrames:
         img0 = _row_to_image(rows[0]["image"])
         assert img0.shape == (rows[0]["image"]["height"],
                               rows[0]["image"]["width"], 3)
+
+    def test_rows_feed_dlmodel(self):
+        """Full reference flow: readImages -> DLImageTransformer ->
+        DLClassifierModel.transform on the image column."""
+        if not os.path.isdir(self.IMAGENET_DIR):
+            pytest.skip("reference resources unavailable")
+        import jax
+
+        from bigdl_tpu.dlframes import (DLClassifierModel, DLImageReader,
+                                        DLImageTransformer)
+        from bigdl_tpu.transform.vision import (CenterCrop, ChannelNormalize,
+                                                Resize)
+
+        rows = DLImageReader.read_images(self.IMAGENET_DIR)
+        chain = (Resize(40, 40) >> CenterCrop(32, 32) >>
+                 ChannelNormalize([124.0, 117.0, 104.0], [58.6, 57.1, 57.4]))
+        rows = DLImageTransformer(chain).transform(rows)
+
+        from bigdl_tpu.models.resnet import ResNetCifar
+        model = ResNetCifar(depth=8, class_num=10)
+        model.build(jax.ShapeDtypeStruct((1, 32, 32, 3), jnp.float32))
+        model.evaluate()
+        m = DLClassifierModel(model, (32, 32, 3), batch_size=4)
+        preds = m.transform(rows)
+        assert preds.shape == (len(rows),)
+        assert ((preds >= 0) & (preds < 10)).all()
