@@ -34,18 +34,26 @@ class DLModel:
     #: (reference: DLModel.setFeaturesCol on DLImageTransformer output)
     features_col = "output"
 
+    def set_features_col(self, col):
+        """Reference: DLModel.setFeaturesCol."""
+        self.features_col = col
+        return self
+
     def transform(self, X) -> np.ndarray:
         """-> predictions, one row per input row.
 
         Accepts a plain array OR a list of image-schema rows from
         DLImageReader/DLImageTransformer (the reference's
         readImages -> transformer -> model DataFrame flow); rows are
-        decoded from ``features_col`` (falling back to the raw ``image``
-        column).
+        decoded from ``features_col`` -- a missing column raises rather
+        than silently predicting on the wrong one.
         """
         if isinstance(X, list) and X and isinstance(X[0], dict):
-            col = self.features_col if self.features_col in X[0] else "image"
-            X = np.stack([_row_to_image(r[col]) for r in X])
+            if self.features_col not in X[0]:
+                raise KeyError(
+                    f"features column {self.features_col!r} not in rows "
+                    f"(available: {sorted(X[0])}); use set_features_col()")
+            X = np.stack([_row_to_image(r[self.features_col]) for r in X])
         X = np.asarray(X, np.float32).reshape((-1,) + self.feature_size)
         samples = [Sample(x) for x in X]
         return np.stack(self.model.predict(samples, self.batch_size))

@@ -384,6 +384,72 @@ def _register_all():
     _register("Linear", _NN + "Linear", _save_linear, _load_linear)
     _register("SpatialConvolution", _NN + "SpatialConvolution",
               _save_conv, _load_conv)
+
+    # int8 quantized layers (reference: nn/quantized/QuantSerializer.scala:
+    # weights stored quantized with per-channel scales, never re-quantized
+    # on load).  weight_q rides as INT32 int_data; the loader restores int8.
+    def save_qlinear(m, p):
+        params = [np.asarray(p["weight_q"], np.int32),
+                  np.asarray(p["scale"], np.float32)]
+        if m.with_bias:
+            params.append(np.asarray(p["bias"], np.float32))
+        # weight layout (out, in) matches the reference Linear convention
+        return ({"inputSize": int(np.asarray(p["weight_q"]).shape[1]),
+                 "outputSize": m.output_size, "withBias": m.with_bias},
+                params)
+
+    def load_qlinear(attrs, params, ctx):
+        from bigdl_tpu.nn.quantized import QuantizedLinear
+        wb = attrs("withBias", True)
+        m = QuantizedLinear(
+            output_size=attrs("outputSize"), with_bias=wb,
+            weight_q=np.asarray(params[0], np.int8), scale=params[1],
+            bias=params[2] if wb and len(params) > 2 else None)
+        return m, {}
+    _register("QuantizedLinear",
+              "com.intel.analytics.bigdl.nn.quantized.Linear",
+              save_qlinear, load_qlinear)
+
+    def save_qconv(m, p):
+        c = m.conv
+        attrs = {"nInputPlane": c.n_input_plane,
+                 "nOutputPlane": c.n_output_plane,
+                 "kernelW": c.kernel[1], "kernelH": c.kernel[0],
+                 "strideW": c.stride[1], "strideH": c.stride[0],
+                 "padW": c.pad[1], "padH": c.pad[0], "nGroup": c.n_group,
+                 "dilationW": c.dilation[1], "dilationH": c.dilation[0],
+                 "withBias": c.with_bias, "dataFormat": c.data_format}
+        # wire layout = the reference's grouped (g, out/g, in/g, kH, kW),
+        # same as the float conv converter
+        wq = _conv_weight_to_bigdl(c, np.asarray(p["weight_q"], np.int32))
+        params = [wq, np.asarray(p["scale"], np.float32)]
+        if c.with_bias:
+            params.append(np.asarray(p["bias"], np.float32))
+        return attrs, params
+
+    def load_qconv(attrs, params, ctx):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.quantized import QuantizedSpatialConvolution
+        wb = attrs("withBias", True)
+        g = attrs("nGroup", 1)
+        cin, cout = attrs("nInputPlane"), attrs("nOutputPlane")
+        kh, kw = attrs("kernelH"), attrs("kernelW")
+        conv = nn.SpatialConvolution(
+            cin, cout, kw, kh,
+            attrs("strideW", 1), attrs("strideH", 1),
+            attrs("padW", 0), attrs("padH", 0),
+            n_group=g, dilation_w=attrs("dilationW", 1),
+            dilation_h=attrs("dilationH", 1), with_bias=wb,
+            data_format=attrs("dataFormat", "NHWC"))
+        wq = _conv_weight_from_bigdl(np.asarray(params[0]), kh, kw,
+                                     cin // g, g, cout // g)
+        m = QuantizedSpatialConvolution(
+            conv, weight_q=np.asarray(wq, np.int8), scale=params[1],
+            bias=params[2] if wb and len(params) > 2 else None)
+        return m, {}
+    _register("QuantizedSpatialConvolution",
+              "com.intel.analytics.bigdl.nn.quantized.SpatialConvolution",
+              save_qconv, load_qconv)
     _register("SpatialMaxPooling", _NN + "SpatialMaxPooling", _save_pool,
               _make_pool_loader("SpatialMaxPooling"))
     _register("SpatialAveragePooling", _NN + "SpatialAveragePooling",
@@ -969,46 +1035,57 @@ def _install_subtree(module, path, p_leaves, s_leaves):
             setattr(module, attr, rebuilt)
 
 
+_STORAGE_FIELDS = (("float_data", np.float32), ("double_data", np.float64),
+                   ("int_data", np.int32), ("long_data", np.int64))
+
+
+def _take_storage(st):
+    """-> array moved out of whichever payload field is populated, or None
+    (int_data matters for int8 quantized weights riding as INT32)."""
+    for field, dt in _STORAGE_FIELDS:
+        data = getattr(st, field)
+        if data:
+            arr = np.asarray(data, dt)
+            for f, _ in _STORAGE_FIELDS:
+                st.ClearField(f)
+            return arr
+    return None
+
+
 def _strip_storages(msg, store):
     """Move storage payloads out of the proto into ``store`` (npz dict)."""
     for t in list(msg.parameters):
-        if t.storage.float_data or t.storage.double_data:
-            store[str(t.storage.id)] = (
-                np.asarray(t.storage.float_data, np.float32)
-                if t.storage.float_data
-                else np.asarray(t.storage.double_data, np.float64))
-            t.storage.ClearField("float_data")
-            t.storage.ClearField("double_data")
+        arr = _take_storage(t.storage)
+        if arr is not None:
+            store[str(t.storage.id)] = arr
     for a in msg.attr.values():
         if a.WhichOneof("value") == "tensorValue":
-            t = a.tensorValue
-            if t.storage.float_data or t.storage.double_data:
-                store[str(t.storage.id)] = (
-                    np.asarray(t.storage.float_data, np.float32)
-                    if t.storage.float_data
-                    else np.asarray(t.storage.double_data, np.float64))
-                t.storage.ClearField("float_data")
-                t.storage.ClearField("double_data")
+            arr = _take_storage(a.tensorValue.storage)
+            if arr is not None:
+                store[str(a.tensorValue.storage.id)] = arr
     for sub in msg.subModules:
         _strip_storages(sub, store)
+
+
+def _put_storage(st, arr):
+    field = {np.dtype(np.float64): "double_data",
+             np.dtype(np.int32): "int_data",
+             np.dtype(np.int64): "long_data"}.get(arr.dtype, "float_data")
+    if field == "float_data":
+        arr = arr.astype(np.float32)
+    getattr(st, field).extend(arr.tolist())
 
 
 def _restore_storages(msg, store):
     for t in list(msg.parameters):
         key = str(t.storage.id)
-        if key in store and not (t.storage.float_data
-                                 or t.storage.double_data):
-            arr = store[key]
-            if arr.dtype == np.float64:
-                t.storage.double_data.extend(arr.tolist())
-            else:
-                t.storage.float_data.extend(arr.tolist())
+        if key in store and _take_storage(t.storage) is None:
+            _put_storage(t.storage, store[key])
     for a in msg.attr.values():
         if a.WhichOneof("value") == "tensorValue":
             key = str(a.tensorValue.storage.id)
-            if key in store:
-                a.tensorValue.storage.float_data.extend(
-                    store[key].astype(np.float32).tolist())
+            if key in store and _take_storage(a.tensorValue.storage) is None:
+                _put_storage(a.tensorValue.storage, store[key])
     for sub in msg.subModules:
         _restore_storages(sub, store)
 

@@ -42,16 +42,31 @@ def _quantize_activation(x):
 
 
 class QuantizedLinear(Module):
-    """Int8 linear (reference: nn/quantized/Linear.scala)."""
+    """Int8 linear (reference: nn/quantized/Linear.scala).
 
-    def __init__(self, linear: Linear, params, name=None):
-        super().__init__(name or linear.name + "_int8")
-        self.output_size = linear.output_size
-        self.with_bias = linear.with_bias
-        w_q, scale = quantize_weights_per_channel(params["weight"], 0)
-        self._params = {"weight_q": w_q, "scale": scale[:, 0]}
-        if self.with_bias:
-            self._params["bias"] = params["bias"]
+    Construct from a trained float layer (``QuantizedLinear(linear,
+    params)``) or from pre-quantized arrays (the deserialization path --
+    reference: nn/quantized/QuantSerializer.scala)."""
+
+    def __init__(self, linear: Linear = None, params=None, *,
+                 output_size=None, with_bias=True, weight_q=None,
+                 scale=None, bias=None, name=None):
+        if linear is not None:
+            super().__init__(name or linear.name + "_int8")
+            self.output_size = linear.output_size
+            self.with_bias = linear.with_bias
+            w_q, s = quantize_weights_per_channel(params["weight"], 0)
+            self._params = {"weight_q": w_q, "scale": s[:, 0]}
+            if self.with_bias:
+                self._params["bias"] = params["bias"]
+        else:
+            super().__init__(name)
+            self.output_size = output_size
+            self.with_bias = with_bias
+            self._params = {"weight_q": jnp.asarray(weight_q, jnp.int8),
+                            "scale": jnp.asarray(scale, jnp.float32)}
+            if with_bias:
+                self._params["bias"] = jnp.asarray(bias, jnp.float32)
         self._state = ()
 
     def setup(self, rng, input_spec):
@@ -75,13 +90,20 @@ class QuantizedSpatialConvolution(Module):
     Weight HWIO quantized per output channel (axis 3).
     """
 
-    def __init__(self, conv: SpatialConvolution, params, name=None):
+    def __init__(self, conv: SpatialConvolution, params=None, *,
+                 weight_q=None, scale=None, bias=None, name=None):
         super().__init__(name or conv.name + "_int8")
         self.conv = conv
-        w_q, scale = quantize_weights_per_channel(params["weight"], 3)
-        self._params = {"weight_q": w_q, "scale": scale.reshape(-1)}
-        if conv.with_bias:
-            self._params["bias"] = params["bias"]
+        if params is not None:
+            w_q, s = quantize_weights_per_channel(params["weight"], 3)
+            self._params = {"weight_q": w_q, "scale": s.reshape(-1)}
+            if conv.with_bias:
+                self._params["bias"] = params["bias"]
+        else:                              # pre-quantized (deserialization)
+            self._params = {"weight_q": jnp.asarray(weight_q, jnp.int8),
+                            "scale": jnp.asarray(scale, jnp.float32)}
+            if conv.with_bias:
+                self._params["bias"] = jnp.asarray(bias, jnp.float32)
         self._state = ()
 
     def setup(self, rng, input_spec):
