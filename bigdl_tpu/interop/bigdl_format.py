@@ -1067,6 +1067,11 @@ def _strip_storages(msg, store):
         _strip_storages(sub, store)
 
 
+def _storage_empty(st):
+    """Pure check -- unlike _take_storage it must NOT clear payloads."""
+    return not any(getattr(st, f) for f, _ in _STORAGE_FIELDS)
+
+
 def _put_storage(st, arr):
     field = {np.dtype(np.float64): "double_data",
              np.dtype(np.int32): "int_data",
@@ -1079,12 +1084,12 @@ def _put_storage(st, arr):
 def _restore_storages(msg, store):
     for t in list(msg.parameters):
         key = str(t.storage.id)
-        if key in store and _take_storage(t.storage) is None:
+        if key in store and _storage_empty(t.storage):
             _put_storage(t.storage, store[key])
     for a in msg.attr.values():
         if a.WhichOneof("value") == "tensorValue":
             key = str(a.tensorValue.storage.id)
-            if key in store and _take_storage(a.tensorValue.storage) is None:
+            if key in store and _storage_empty(a.tensorValue.storage):
                 _put_storage(a.tensorValue.storage, store[key])
     for sub in msg.subModules:
         _restore_storages(sub, store)

@@ -209,8 +209,12 @@ class DistriOptimizer(BaseOptimizer):
             x, target = self._shard_batch(batch, batch_sharding)
             params_flat, mstate, opt_state, loss = step(
                 params_flat, mstate, opt_state, x, target, RNG.next_key())
-            loss = float(loss)
+            # host/device pipeline: stage the NEXT batch while the devices
+            # run this step; float(loss) below is the sync point
             n = batch.size()
+            next_batch, train_iter = self._stage_next_batch(
+                train_iter, state, n, epoch_size)
+            loss = float(loss)
             dt = time.time() - t0
             state["loss"] = loss
             state["record_count"] += n
@@ -224,8 +228,6 @@ class DistriOptimizer(BaseOptimizer):
             if state["record_count"] >= epoch_size:
                 state["epoch"] += 1
                 state["record_count"] = 0
-                self.dataset.shuffle()
-                train_iter = self.dataset.data(train=True)
 
             if (self.validation_trigger is not None
                     and self.validation_trigger(state)):
@@ -238,8 +240,11 @@ class DistriOptimizer(BaseOptimizer):
                     {"model_params_flat": params_flat}, mstate, opt_state,
                     state)
 
-            if not self.end_trigger(state):
-                batch = next(train_iter)
+            if next_batch is None and not self.end_trigger(state):
+                # loss-based trigger mispredicted the end: fetch now
+                next_batch, train_iter = self._stage_next_batch(
+                    train_iter, state, 0, epoch_size, force=True)
+            batch = next_batch
 
         params_tree = jax.jit(flat_space.unflatten)(params_flat)
         self.model.set_parameters(params_tree)

@@ -154,6 +154,35 @@ class BaseOptimizer:
                     method.name, value, state["neval"])
         return results
 
+    def _stage_next_batch(self, train_iter, state, n, epoch_size,
+                          force=False):
+        """Prefetch the next batch while the device executes the current
+        step (call between dispatch and the loss sync).  Returns
+        (next_batch, train_iter); next_batch is None when the end trigger
+        is predicted to fire after this step, so a stream-fed dataset is
+        never touched past the end of training.  The prediction cannot see
+        the still-in-flight loss, so a loss-based end trigger may need one
+        synchronous fallback fetch (``force=True``)."""
+        if not force:
+            predicted = dict(state)
+            predicted["neval"] = state["neval"] + 1
+            predicted["record_count"] = state["record_count"] + n
+            if predicted["record_count"] >= epoch_size:
+                predicted["epoch"] = state["epoch"] + 1
+            if self.end_trigger(predicted):
+                return None, train_iter
+        if state["record_count"] + n >= epoch_size:
+            self.dataset.shuffle()
+            train_iter = self.dataset.data(train=True)
+        try:
+            return next(train_iter), train_iter
+        except StopIteration:
+            # finite iterator shorter than size() (e.g. drop_remainder):
+            # epoch boundary -- reshuffle like the rollover path
+            self.dataset.shuffle()
+            train_iter = self.dataset.data(train=True)
+            return next(train_iter), train_iter
+
     def optimize(self):
         """Run training with the reference's failure-retry semantics: on an
         exception, reload the latest checkpoint and continue, at most
@@ -238,8 +267,14 @@ class LocalOptimizer(BaseOptimizer):
             x, target = _device_batch(batch)
             params, mstate, opt_state, loss = step(
                 params, mstate, opt_state, x, target, RNG.next_key())
-            loss = float(loss)
+            # host/device pipeline: decode + stage the NEXT batch while the
+            # device executes this step -- the float(loss) below is the
+            # synchronization point (the reference overlaps the same way
+            # with its prefetch thread, MTLabeledBGRImgToBatch)
             n = batch.size()
+            next_batch, train_iter = self._stage_next_batch(
+                train_iter, state, n, epoch_size)
+            loss = float(loss)
             dt = time.time() - t0
             state["loss"] = loss
             state["record_count"] += n
@@ -258,8 +293,6 @@ class LocalOptimizer(BaseOptimizer):
             if state["record_count"] >= epoch_size:
                 state["epoch"] += 1
                 state["record_count"] = 0
-                self.dataset.shuffle()
-                train_iter = self.dataset.data(train=True)
 
             if (self.validation_trigger is not None
                     and self.validation_trigger(state)):
@@ -269,8 +302,11 @@ class LocalOptimizer(BaseOptimizer):
                     and self.checkpoint_trigger(state)):
                 self._checkpoint(params, mstate, opt_state)
 
-            if not self.end_trigger(state):
-                batch = next(train_iter)
+            if next_batch is None and not self.end_trigger(state):
+                # loss-based trigger mispredicted the end: fetch now
+                next_batch, train_iter = self._stage_next_batch(
+                    train_iter, state, 0, epoch_size, force=True)
+            batch = next_batch
 
         self.model.set_parameters(params)
         self.model.set_state(mstate)

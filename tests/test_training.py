@@ -245,3 +245,52 @@ class TestDistriRegularizer:
         np.testing.assert_allclose(w1, w0 - l2 * w0, rtol=1e-4, atol=1e-6)
         # reported loss = bare criterion (log 4 for uniform logits), no reg
         assert opt.driver_state["loss"] == pytest.approx(np.log(4), rel=1e-3)
+
+
+class TestPrefetchPipeline:
+    def test_min_loss_end_trigger_with_prefetch(self):
+        """A loss-based end trigger exercises the staged-prefetch
+        misprediction fallback (stage sees stale loss, loop must still
+        terminate exactly when the real loss crosses)."""
+        x, y = synthetic_mnist(128)
+        model = LeNet5()
+        opt = LocalOptimizer(model,
+                             array_dataset(x, y) >> SampleToMiniBatch(64),
+                             nn.ClassNLLCriterion(),
+                             optim.SGD(learning_rate=0.3, momentum=0.9,
+                                       dampening=0.0))
+        opt.set_end_when(optim.Trigger.or_(optim.Trigger.min_loss(0.05),
+                                           optim.Trigger.max_epoch(40)))
+        opt.optimize()
+        assert (opt.driver_state["loss"] < 0.05
+                or opt.driver_state["epoch"] > 40)
+
+    def test_stream_dataset_not_overfetched(self):
+        """The prefetch must not pull past the end of training (a queue-fed
+        dataset would block forever)."""
+        from bigdl_tpu.dataset.dataset import AbstractDataSet
+        from bigdl_tpu.dataset.minibatch import MiniBatch
+
+        x, y = synthetic_mnist(192)
+        fetched = []
+
+        class Stream(AbstractDataSet):
+            def size(self):
+                return 192
+
+            def shuffle(self):
+                pass
+
+            def data(self, train=True):
+                for i in range(0, 192, 64):
+                    fetched.append(i)
+                    yield MiniBatch(x[i:i + 64], y[i:i + 64])
+
+        model = LeNet5()
+        opt = LocalOptimizer(model, Stream(), nn.ClassNLLCriterion(),
+                             optim.SGD(learning_rate=0.1))
+        opt.set_end_when(optim.Trigger.max_iteration(3))
+        opt.optimize()
+        # exactly 3 batches consumed: the predicted-end guard stopped the
+        # 4th prefetch
+        assert len(fetched) == 3, fetched
