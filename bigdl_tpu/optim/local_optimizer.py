@@ -28,6 +28,11 @@ from bigdl_tpu.utils.shape import spec_of
 log = logging.getLogger("bigdl_tpu.optim")
 
 
+#: staging sentinel: the end trigger is PREDICTED to fire after this step
+#: (vs None = staging deferred, fetch synchronously after the state update)
+PREDICTED_END = object()
+
+
 def _device_batch(batch):
     x = jax.tree.map(jnp.asarray, batch.get_input())
     t = batch.get_target()
@@ -159,18 +164,25 @@ class BaseOptimizer:
         """Prefetch the next batch while the device executes the current
         step (call between dispatch and the loss sync).  Returns
         (next_batch, train_iter); next_batch is None when the end trigger
-        is predicted to fire after this step, so a stream-fed dataset is
-        never touched past the end of training.  The prediction cannot see
-        the still-in-flight loss, so a loss-based end trigger may need one
-        synchronous fallback fetch (``force=True``)."""
+        is predicted to fire after this step, so with the stateless
+        count-based triggers a stream-fed dataset is never touched past
+        the end of training.  Stateful triggers must not be probed with a
+        predicted state (they would mutate -- the while condition is their
+        single per-step evaluation), and output-reading triggers
+        (min_loss/max_score) cannot be predicted before the loss sync;
+        both defer to the synchronous fallback fetch (``force=True``),
+        which may pull one batch past the end on the final step."""
         if not force:
+            if (getattr(self.end_trigger, "stateful", False)
+                    or getattr(self.end_trigger, "uses_outputs", False)):
+                return None, train_iter
             predicted = dict(state)
             predicted["neval"] = state["neval"] + 1
             predicted["record_count"] = state["record_count"] + n
             if predicted["record_count"] >= epoch_size:
                 predicted["epoch"] = state["epoch"] + 1
             if self.end_trigger(predicted):
-                return None, train_iter
+                return PREDICTED_END, train_iter
         if state["record_count"] + n >= epoch_size:
             self.dataset.shuffle()
             train_iter = self.dataset.data(train=True)
@@ -196,13 +208,18 @@ class BaseOptimizer:
             except KeyboardInterrupt:
                 raise
             except Exception:
-                if retries_left <= 0 or self.checkpoint_path is None:
+                sharded = getattr(self, "sharded_checkpoint_path", None)
+                if retries_left <= 0 or (self.checkpoint_path is None
+                                         and not sharded):
                     raise
                 retries_left -= 1
                 log.exception(
                     "training failed; restoring last checkpoint and "
                     "retrying (%d retries left)", retries_left)
-                self.resume_from_checkpoint()
+                if sharded:
+                    self.resume_from_sharded_checkpoint()
+                else:
+                    self.resume_from_checkpoint()
 
     def _init_model(self, example_batch):
         x, _ = _device_batch(example_batch)
@@ -262,7 +279,13 @@ class LocalOptimizer(BaseOptimizer):
         epoch_size = self.dataset.size()
         state = self.driver_state
         batch = first_batch
+        # the end trigger is evaluated EXACTLY once per completed step
+        # (plus this entry check) -- stateful triggers like every_epoch
+        # consume their firing edge on evaluation
         while not self.end_trigger(state):
+            if batch is None:     # exotic trigger defeated the prediction
+                batch, train_iter = self._stage_next_batch(
+                    train_iter, state, 0, epoch_size, force=True)
             t0 = time.time()
             x, target = _device_batch(batch)
             params, mstate, opt_state, loss = step(
@@ -302,11 +325,14 @@ class LocalOptimizer(BaseOptimizer):
                     and self.checkpoint_trigger(state)):
                 self._checkpoint(params, mstate, opt_state)
 
-            if next_batch is None and not self.end_trigger(state):
-                # loss-based trigger mispredicted the end: fetch now
+            if next_batch is None:
+                # staging was deferred (stateful/output-reading trigger);
+                # fetch now WITHOUT re-evaluating the end trigger -- the
+                # while condition is its single per-step evaluation
+                # (stateful triggers consume their firing edge)
                 next_batch, train_iter = self._stage_next_batch(
                     train_iter, state, 0, epoch_size, force=True)
-            batch = next_batch
+            batch = None if next_batch is PREDICTED_END else next_batch
 
         self.model.set_parameters(params)
         self.model.set_state(mstate)

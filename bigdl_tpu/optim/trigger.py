@@ -7,6 +7,12 @@ driver state dict (keys: "epoch", "neval", "loss", "score",
 
 
 class Trigger:
+    #: mutates internal state on every call -- must not be probed with a
+    #: PREDICTED driver state (the training loops' batch-staging guard)
+    stateful: bool = False
+    #: reads step outputs (loss/score) the prediction cannot know yet
+    uses_outputs: bool = False
+
     def __call__(self, state) -> bool:
         raise NotImplementedError
 
@@ -28,24 +34,34 @@ class Trigger:
 
     @staticmethod
     def max_score(max_score):
-        return _Lambda(lambda s: s.get("score", float("-inf")) > max_score)
+        return _Lambda(lambda s: s.get("score", float("-inf")) > max_score,
+                       uses_outputs=True)
 
     @staticmethod
     def min_loss(min_loss):
-        return _Lambda(lambda s: s.get("loss", float("inf")) < min_loss)
+        return _Lambda(lambda s: s.get("loss", float("inf")) < min_loss,
+                       uses_outputs=True)
 
     @staticmethod
     def and_(first, *others):
-        return _Lambda(lambda s: first(s) and all(o(s) for o in others))
+        return _combine(lambda s, ts: all(t(s) for t in ts), first, *others)
 
     @staticmethod
     def or_(first, *others):
-        return _Lambda(lambda s: first(s) or any(o(s) for o in others))
+        return _combine(lambda s, ts: any(t(s) for t in ts), first, *others)
+
+
+def _combine(how, *triggers):
+    t = _Lambda(lambda s: how(s, triggers))
+    t.stateful = any(getattr(x, "stateful", False) for x in triggers)
+    t.uses_outputs = any(getattr(x, "uses_outputs", False) for x in triggers)
+    return t
 
 
 class _Lambda(Trigger):
-    def __init__(self, fn):
+    def __init__(self, fn, uses_outputs=False):
         self.fn = fn
+        self.uses_outputs = uses_outputs
 
     def __call__(self, state):
         return bool(self.fn(state))
@@ -54,6 +70,8 @@ class _Lambda(Trigger):
 class _EveryEpoch(Trigger):
     """Fires when the epoch counter advances past the last fire
     (reference: Trigger.everyEpoch)."""
+
+    stateful = True
 
     def __init__(self):
         self.last_epoch = None
