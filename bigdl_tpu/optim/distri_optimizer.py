@@ -170,7 +170,11 @@ class DistriOptimizer(BaseOptimizer):
     def resume_from_sharded_checkpoint(self, path=None):
         base = _abs_local(path or self.sharded_checkpoint_path)
         snaps = [d for d in file_io.listdir(base)
-                 if d.startswith("snap_") and d.split("_")[1].isdigit()]
+                 if d.startswith("snap_") and d.split("_")[1].isdigit()
+                 # a crash between the orbax finalize and the driver-state
+                 # sidecar write leaves an unusable snapshot: skip it so
+                 # retry/resume falls back to the previous complete one
+                 and file_io.exists(file_io.join(base, d) + ".driver")]
         if not snaps:
             return self
         latest = max(snaps, key=lambda d: int(d.split("_")[1]))
@@ -315,11 +319,7 @@ class DistriOptimizer(BaseOptimizer):
                         {"model_params_flat": params_flat}, mstate,
                         opt_state, state)
 
-            if next_batch is None:
-                # staging was deferred (stateful/output-reading trigger);
-                # fetch now WITHOUT re-evaluating the end trigger -- the
-                # while condition is its single per-step evaluation
-                # (stateful triggers consume their firing edge)
+            if next_batch is None:   # safety net; staging always fetches
                 next_batch, train_iter = self._stage_next_batch(
                     train_iter, state, 0, epoch_size, force=True)
             batch = None if next_batch is PREDICTED_END else next_batch

@@ -163,19 +163,20 @@ class BaseOptimizer:
                           force=False):
         """Prefetch the next batch while the device executes the current
         step (call between dispatch and the loss sync).  Returns
-        (next_batch, train_iter); next_batch is None when the end trigger
-        is predicted to fire after this step, so with the stateless
-        count-based triggers a stream-fed dataset is never touched past
-        the end of training.  Stateful triggers must not be probed with a
-        predicted state (they would mutate -- the while condition is their
-        single per-step evaluation), and output-reading triggers
-        (min_loss/max_score) cannot be predicted before the loss sync;
-        both defer to the synchronous fallback fetch (``force=True``),
-        which may pull one batch past the end on the final step."""
-        if not force:
-            if (getattr(self.end_trigger, "stateful", False)
-                    or getattr(self.end_trigger, "uses_outputs", False)):
-                return None, train_iter
+        (next_batch, train_iter); next_batch is PREDICTED_END when the end
+        trigger is predicted to fire after this step, so with the
+        stateless count-based triggers a stream-fed dataset is never
+        touched past the end of training.  Stateful triggers must not be
+        probed with a predicted state (they would mutate -- the while
+        condition is their single per-step evaluation), and output-reading
+        triggers (min_loss/max_score) cannot be predicted before the loss
+        sync; for those the prediction is skipped and the batch fetched
+        eagerly (keeping the prefetch/compute overlap and the
+        epoch-rollover reshuffle), at the cost of one batch pulled past
+        the end on the final step."""
+        if not force and not (
+                getattr(self.end_trigger, "stateful", False)
+                or getattr(self.end_trigger, "uses_outputs", False)):
             predicted = dict(state)
             predicted["neval"] = state["neval"] + 1
             predicted["record_count"] = state["record_count"] + n
@@ -325,11 +326,7 @@ class LocalOptimizer(BaseOptimizer):
                     and self.checkpoint_trigger(state)):
                 self._checkpoint(params, mstate, opt_state)
 
-            if next_batch is None:
-                # staging was deferred (stateful/output-reading trigger);
-                # fetch now WITHOUT re-evaluating the end trigger -- the
-                # while condition is its single per-step evaluation
-                # (stateful triggers consume their firing edge)
+            if next_batch is None:   # safety net; staging always fetches
                 next_batch, train_iter = self._stage_next_batch(
                     train_iter, state, 0, epoch_size, force=True)
             batch = None if next_batch is PREDICTED_END else next_batch
