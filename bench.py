@@ -243,12 +243,32 @@ def _spawn_child(extra_env, timeout):
 
 def main():
     if os.environ.get("BENCH_CHILD"):
+        if os.environ.get("BENCH_PROBE"):
+            _honor_env_platforms()
+            import jax
+
+            print(json.dumps({"probe": jax.devices()[0].platform}))
+            return
         run_bench()
         return
 
     attempts = int(os.environ.get("BENCH_RETRIES", "3"))
     timeout = int(os.environ.get("BENCH_TIMEOUT", "1500"))
     failures = []
+
+    # A dead tunnel HANGS rather than erroring; don't burn attempts x
+    # timeout on it.  A quick device-init probe decides whether the full
+    # TPU attempts are worth making.  Only a probe TIMEOUT (hang) or a
+    # deterministic non-TPU platform clamps the retries -- fast transient
+    # init errors keep the full retry budget (round-1's failure story).
+    probe, perr = _spawn_child({"BENCH_PROBE": "1"},
+                               min(300, timeout))
+    if probe is None or probe.get("probe") != "tpu":
+        failures.append(f"device probe: {perr or probe}")
+        hang = probe is None and str(perr).startswith("timeout")
+        no_tpu = probe is not None and probe.get("probe") != "tpu"
+        if hang or no_tpu:
+            attempts = min(attempts, 1)
     for i in range(attempts):
         result, err = _spawn_child({}, timeout)
         if result is not None:
