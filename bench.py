@@ -9,9 +9,13 @@ the reference publishes no absolute imgs/sec for its Xeon clusters).
 
 Robustness (round-2): the parent process re-executes itself as a child and
 retries on TPU backend init/compile failures (transient tunnel errors were the
-whole of round 1's bench story), optionally falling back to CPU, and ALWAYS
-prints exactly ONE JSON line -- a diagnostic record on total failure rather
-than a stack trace.
+whole of round 1's bench story), optionally falling back to CPU.
+
+Robustness (round-4): total wall-clock is bounded by BENCH_TOTAL_BUDGET
+(default 1100s) -- every stage's timeout is clamped to the remaining budget --
+and a diagnostic JSON line is printed before each long stage, so even a
+SIGKILL at any moment leaves the last printed line as a parseable artifact.
+The LAST JSON line on stdout is the result.
 """
 
 import json
@@ -194,9 +198,30 @@ def _bench_one(batch, steps):
     }
     if error is not None:
         record["extra"]["error"] = error
-    if invalid:
-        record["vs_baseline"] = 0.0
+    if invalid or platform != "tpu":
+        record["vs_baseline"] = 0.0   # off-TPU MFU can't claim the target
     return record
+
+
+_live_children = []
+
+
+def _reap_children(signum=None, frame=None):
+    """SIGTERM handler: kill any live child process groups before dying.
+
+    The driver's timeout sends SIGTERM first; without this, a hung probe
+    child (its own session) would outlive us, potentially holding a
+    half-open TPU tunnel connection.
+    """
+    import signal
+
+    for pid in _live_children:
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    if signum is not None:
+        sys.exit(128 + signum)
 
 
 def _spawn_child(extra_env, timeout):
@@ -213,6 +238,7 @@ def _spawn_child(extra_env, timeout):
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
             stdout=out, stderr=err, env=env, start_new_session=True)
+        _live_children.append(proc.pid)
         timed_out = False
         try:
             rc = proc.wait(timeout=timeout)
@@ -223,6 +249,7 @@ def _spawn_child(extra_env, timeout):
             except (ProcessLookupError, PermissionError):
                 pass
             rc = proc.wait()
+        _live_children.remove(proc.pid)
         out.seek(0)
         stdout = out.read()
         err.seek(0)
@@ -243,6 +270,8 @@ def _spawn_child(extra_env, timeout):
 
 def main():
     if os.environ.get("BENCH_CHILD"):
+        if os.environ.get("BENCH_FAKE_HANG"):  # test hook: dead-tunnel sim
+            time.sleep(100000)
         if os.environ.get("BENCH_PROBE"):
             _honor_env_platforms()
             import jax
@@ -252,27 +281,74 @@ def main():
         run_bench()
         return
 
+    # Total wall-clock budget across probe + attempts + fallback.  Round 3
+    # proved the failure mode of an unbounded sweep: the driver's timeout
+    # fired first (rc=124) and NOTHING was printed.  Now every stage is
+    # clamped to the remaining budget and a diagnostic JSON line is printed
+    # BEFORE each long stage, so a kill at any moment leaves the last
+    # printed line as a parseable artifact.
+    import signal
+
+    signal.signal(signal.SIGTERM, _reap_children)
+    budget = int(os.environ.get("BENCH_TOTAL_BUDGET", "1100"))
+    deadline = time.monotonic() + budget
     attempts = int(os.environ.get("BENCH_RETRIES", "3"))
-    timeout = int(os.environ.get("BENCH_TIMEOUT", "1500"))
+    timeout = int(os.environ.get("BENCH_TIMEOUT", "700"))
     failures = []
+
+    def remaining():
+        return deadline - time.monotonic()
+
+    def diagnostic(stage):
+        # Superseded by any later line; the LAST JSON line is the result.
+        print(json.dumps({
+            "metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "images/sec",
+            "vs_baseline": 0.0,
+            "extra": {
+                "error": f"incomplete: bench was killed during {stage} "
+                         f"(pre-stage diagnostic; a later line supersedes "
+                         f"this one)",
+                "budget_sec": budget,
+                "budget_left_sec": round(remaining(), 1),
+                "failures": failures,
+            },
+        }), flush=True)
+
+    def stage_timeout(want, stage):
+        """Clamp a stage's timeout to the remaining budget (20s reserve)."""
+        t = min(want, remaining() - 20)
+        if t < 30:
+            failures.append(f"{stage}: skipped (clamped timeout {t:.0f}s "
+                            f"< 30s minimum; budget left {remaining():.0f}s)")
+            return None
+        return t
 
     # A dead tunnel HANGS rather than erroring; don't burn attempts x
     # timeout on it.  A quick device-init probe decides whether the full
     # TPU attempts are worth making.  Only a probe TIMEOUT (hang) or a
     # deterministic non-TPU platform clamps the retries -- fast transient
     # init errors keep the full retry budget (round-1's failure story).
-    probe, perr = _spawn_child({"BENCH_PROBE": "1"},
-                               min(300, timeout))
+    diagnostic("device probe")
+    t = stage_timeout(min(240, timeout), "device probe")
+    probe, perr = (None, None) if t is None else \
+        _spawn_child({"BENCH_PROBE": "1"}, t)
     if probe is None or probe.get("probe") != "tpu":
-        failures.append(f"device probe: {perr or probe}")
+        if t is not None:   # skipped probes already recorded a failure
+            failures.append(f"device probe: {perr or probe}")
         hang = probe is None and str(perr).startswith("timeout")
         no_tpu = probe is not None and probe.get("probe") != "tpu"
         if hang or no_tpu:
             attempts = min(attempts, 1)
     for i in range(attempts):
-        result, err = _spawn_child({}, timeout)
+        diagnostic(f"tpu attempt {i + 1}")
+        t = stage_timeout(timeout, f"tpu attempt {i + 1}")
+        if t is None:
+            break
+        result, err = _spawn_child({}, t)
         if result is not None:
-            print(json.dumps(result))
+            print(json.dumps(result), flush=True)
             return
         failures.append(f"attempt {i + 1}: {err}")
         if i < attempts - 1:
@@ -281,15 +357,18 @@ def main():
     # TPU unreachable after retries: take a CPU measurement so the round
     # still produces a perf artifact, and carry the TPU failure diagnostics.
     if os.environ.get("BENCH_NO_CPU_FALLBACK") != "1":
-        result, err = _spawn_child(
-            {"JAX_PLATFORMS": "cpu", "BENCH_BATCH": "16", "BENCH_STEPS": "3"},
-            timeout)
-        if result is not None:
-            result["extra"]["tpu_failures"] = failures
-            result["vs_baseline"] = 0.0  # CPU number can't claim the target
-            print(json.dumps(result))
-            return
-        failures.append(f"cpu fallback: {err}")
+        diagnostic("cpu fallback")
+        t = stage_timeout(timeout, "cpu fallback")
+        if t is not None:
+            result, err = _spawn_child(
+                {"JAX_PLATFORMS": "cpu", "BENCH_BATCH": "8",
+                 "BENCH_STEPS": "2"}, t)
+            if result is not None:
+                result["extra"]["tpu_failures"] = failures
+                result["vs_baseline"] = 0.0  # CPU can't claim the target
+                print(json.dumps(result), flush=True)
+                return
+            failures.append(f"cpu fallback: {err}")
 
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec_per_chip",
@@ -297,7 +376,7 @@ def main():
         "unit": "images/sec",
         "vs_baseline": 0.0,
         "extra": {"error": "all attempts failed", "failures": failures},
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":

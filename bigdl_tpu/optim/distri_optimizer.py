@@ -200,6 +200,7 @@ class DistriOptimizer(BaseOptimizer):
         return x, t
 
     def _optimize_impl(self):
+        self._reshuffle_pending = False   # no stale flag from a prior run
         n_dev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names
                              if a == self.axis]))
         train_iter = self.dataset.data(train=True)
@@ -277,10 +278,10 @@ class DistriOptimizer(BaseOptimizer):
         state = self.driver_state
         batch = first_batch
         while not self.end_trigger(state):
+            t0 = time.time()  # includes a deferred (unoverlapped) fetch
             if batch is None:     # exotic trigger defeated the prediction
                 batch, train_iter = self._stage_next_batch(
                     train_iter, state, 0, epoch_size, force=True)
-            t0 = time.time()
             x, target = self._shard_batch(batch, batch_sharding)
             params_flat, mstate, opt_state, loss = step(
                 params_flat, mstate, opt_state, x, target, RNG.next_key())
@@ -303,6 +304,8 @@ class DistriOptimizer(BaseOptimizer):
             if state["record_count"] >= epoch_size:
                 state["epoch"] += 1
                 state["record_count"] = 0
+                if next_batch is None:   # fetch deferred past the reset:
+                    self._reshuffle_pending = True
 
             if (self.validation_trigger is not None
                     and self.validation_trigger(state)):
@@ -319,9 +322,8 @@ class DistriOptimizer(BaseOptimizer):
                         {"model_params_flat": params_flat}, mstate,
                         opt_state, state)
 
-            if next_batch is None:   # safety net; staging always fetches
-                next_batch, train_iter = self._stage_next_batch(
-                    train_iter, state, 0, epoch_size, force=True)
+            # next_batch None = deferred: the top-of-loop fetch runs only
+            # after the end trigger has decided training continues
             batch = None if next_batch is PREDICTED_END else next_batch
 
         params_tree = jax.jit(flat_space.unflatten)(params_flat)

@@ -115,6 +115,28 @@ class BaseOptimizer:
         return self
 
     # ----- shared helpers -------------------------------------------------- #
+    def _check_plateau_monitor(self):
+        """Fail fast (before the failure-retry loop) on a Plateau monitor
+        that the configured validation methods can never produce --
+        otherwise the deterministic config error would burn
+        BIGDL_FAILURE_RETRY_TIMES full validation intervals re-hitting
+        itself (reference require-fails at the same mismatch,
+        SGD.scala:571)."""
+        sched = getattr(self.optim_method, "schedule", None)
+        if (sched is None or not hasattr(sched, "record")
+                or self.validation_trigger is None):
+            return
+        monitor = getattr(sched, "monitor", "score")
+        available = [m.name for m in self.validation_methods]
+        if any(n in ("Top1Accuracy", "Top5Accuracy") for n in available):
+            available.append("score")
+        available.append("loss")      # training loss is always in state
+        if monitor not in available:
+            raise ValueError(
+                f"Plateau schedule requires monitored value {monitor!r}, "
+                f"which the validation methods will never produce "
+                f"(available: {available})")
+
     def _feed_plateau(self, state, opt_state):
         """Feed the monitored validation metric to a Plateau schedule
         (reference: SGD.Plateau consumes the score via the optimizer's
@@ -130,11 +152,13 @@ class BaseOptimizer:
         # the LR on healthy training
         value = state.get(monitor)
         if value is None:
+            # the monitor is producible (checked fail-fast in optimize());
+            # its absence here means THIS validation interval was skipped
+            # (e.g. no full batches) -- a transient, not the config error
+            # the reference require-fails on (SGD.scala:571)
             log.warning(
-                "Plateau schedule: monitored value %r not produced by the "
-                "validation methods (available: %s); LR factor unchanged",
-                monitor,
-                [m.name for m in self.validation_methods])
+                "Plateau schedule: monitored value %r absent this "
+                "validation interval; LR factor unchanged", monitor)
             return opt_state
         return sched.record(value, opt_state)
 
@@ -170,13 +194,17 @@ class BaseOptimizer:
         probed with a predicted state (they would mutate -- the while
         condition is their single per-step evaluation), and output-reading
         triggers (min_loss/max_score) cannot be predicted before the loss
-        sync; for those the prediction is skipped and the batch fetched
-        eagerly (keeping the prefetch/compute overlap and the
-        epoch-rollover reshuffle), at the cost of one batch pulled past
-        the end on the final step."""
-        if not force and not (
-                getattr(self.end_trigger, "stateful", False)
-                or getattr(self.end_trigger, "uses_outputs", False)):
+        sync; for those staging returns None and the fetch is DEFERRED to
+        the top of the next loop iteration, after the trigger has decided
+        training continues.  Deferral trades the prefetch/compute overlap
+        (exotic triggers only; count-based triggers keep it) for liveness:
+        an eager fetch one batch past the end would block forever on a
+        queue-fed stream dataset whose producer stops at the end of
+        training (round-3 advisor finding)."""
+        if not force:
+            if (getattr(self.end_trigger, "stateful", False)
+                    or getattr(self.end_trigger, "uses_outputs", False)):
+                return None, train_iter
             predicted = dict(state)
             predicted["neval"] = state["neval"] + 1
             predicted["record_count"] = state["record_count"] + n
@@ -184,7 +212,13 @@ class BaseOptimizer:
                 predicted["epoch"] = state["epoch"] + 1
             if self.end_trigger(predicted):
                 return PREDICTED_END, train_iter
-        if state["record_count"] + n >= epoch_size:
+        if getattr(self, "_reshuffle_pending", False):
+            # deferred-fetch path: the epoch rolled over (and record_count
+            # was reset) before this force fetch ran
+            self._reshuffle_pending = False
+            self.dataset.shuffle()
+            train_iter = self.dataset.data(train=True)
+        elif state["record_count"] + n >= epoch_size:
             self.dataset.shuffle()
             train_iter = self.dataset.data(train=True)
         try:
@@ -202,6 +236,7 @@ class BaseOptimizer:
         BIGDL_FAILURE_RETRY_TIMES times (reference: DistriOptimizer's
         retryNum loop, optim/DistriOptimizer.scala:862-908)."""
         from bigdl_tpu.utils import config
+        self._check_plateau_monitor()
         retries_left = config.failure_retry_times()
         while True:
             try:
@@ -260,6 +295,7 @@ class LocalOptimizer(BaseOptimizer):
     """Reference: optim/LocalOptimizer.scala:45."""
 
     def _optimize_impl(self):
+        self._reshuffle_pending = False   # no stale flag from a prior run
         train_iter = self.dataset.data(train=True)
         first_batch = next(train_iter)
         params, mstate = self._init_model(first_batch)
@@ -284,10 +320,10 @@ class LocalOptimizer(BaseOptimizer):
         # (plus this entry check) -- stateful triggers like every_epoch
         # consume their firing edge on evaluation
         while not self.end_trigger(state):
+            t0 = time.time()  # includes a deferred (unoverlapped) fetch
             if batch is None:     # exotic trigger defeated the prediction
                 batch, train_iter = self._stage_next_batch(
                     train_iter, state, 0, epoch_size, force=True)
-            t0 = time.time()
             x, target = _device_batch(batch)
             params, mstate, opt_state, loss = step(
                 params, mstate, opt_state, x, target, RNG.next_key())
@@ -317,6 +353,8 @@ class LocalOptimizer(BaseOptimizer):
             if state["record_count"] >= epoch_size:
                 state["epoch"] += 1
                 state["record_count"] = 0
+                if next_batch is None:   # fetch deferred past the reset:
+                    self._reshuffle_pending = True
 
             if (self.validation_trigger is not None
                     and self.validation_trigger(state)):
@@ -326,9 +364,8 @@ class LocalOptimizer(BaseOptimizer):
                     and self.checkpoint_trigger(state)):
                 self._checkpoint(params, mstate, opt_state)
 
-            if next_batch is None:   # safety net; staging always fetches
-                next_batch, train_iter = self._stage_next_batch(
-                    train_iter, state, 0, epoch_size, force=True)
+            # next_batch None = deferred: the top-of-loop fetch runs only
+            # after the end trigger has decided training continues
             batch = None if next_batch is PREDICTED_END else next_batch
 
         self.model.set_parameters(params)

@@ -187,8 +187,13 @@ class Plateau(LearningRateSchedule):
 
     stateful = True
 
-    def __init__(self, monitor="score", factor=0.1, patience=10,
-                 mode="max", epsilon=1e-4, cooldown=0, min_lr=0.0):
+    def __init__(self, monitor="Loss", factor=0.1, patience=10,
+                 mode="min", epsilon=1e-4, cooldown=0, min_lr=0.0):
+        # reference SGD.Plateau defaults/requires (SGD.scala:545-560):
+        # mode "min", factor < 1; monitor here defaults to the Loss
+        # validation metric to match the "min" direction.
+        if factor >= 1.0:
+            raise ValueError("Plateau does not support a factor >= 1.0")
         assert mode in ("min", "max")
         self.monitor = monitor
         self.factor = factor
@@ -220,13 +225,21 @@ class Plateau(LearningRateSchedule):
             return opt_state
         if self.cooldown_counter > 0:
             return opt_state
+        # reference accounting (SGD.scala:580-587): reduce only once
+        # waitCounter has ALREADY reached patience -- i.e. on the
+        # (patience+1)-th consecutive stalled evaluation -- and only while
+        # the effective LR is still above min_lr (+ lrEpsilon).
+        reduce_now = self.wait >= self.patience
         self.wait += 1
-        if self.wait < self.patience:
+        if not reduce_now:
             return opt_state
-        self.wait = 0
-        self.cooldown_counter = self.cooldown
         old = float(opt_state.get("lr_factor", 1.0))
         base = float(self.base_lr) if hasattr(self, "base_lr") else 1.0
+        lr_eps = self.min_lr * 1e-4
+        if abs(old * base) <= self.min_lr + lr_eps:
+            return opt_state
+        self.wait = 1
+        self.cooldown_counter = self.cooldown
         new = max(old * self.factor, self.min_lr / max(base, 1e-30))
         out = dict(opt_state)
         out["lr_factor"] = jnp.asarray(new, jnp.float32)

@@ -311,9 +311,11 @@ class TestEpochSchedules:
         st = method.init_state(params)
         assert "lr_factor" in st
         st = sched.record(0.5, st)            # first value = best
-        st = sched.record(0.5, st)            # stall 1
-        st = sched.record(0.5, st)            # stall 2 -> reduce
-        assert_close(st["lr_factor"], 0.5)
+        st = sched.record(0.5, st)            # stall 1 (wait -> 1)
+        st = sched.record(0.5, st)            # stall 2 (wait reaches patience)
+        assert_close(st.get("lr_factor", 1.0), 1.0)
+        st = sched.record(0.5, st)            # stall 3 -> reduce (reference:
+        assert_close(st["lr_factor"], 0.5)    # patience-th stall arms, next fires
         g = {"w": jnp.ones(3)}
         p2, st2 = method.update(g, st, params)
         assert_close(p2["w"], 1.0 - 0.05)     # lr 0.1 * factor 0.5
@@ -325,7 +327,8 @@ class TestEpochSchedules:
         sched = optim.Plateau(factor=0.1, patience=1, mode="min")
         st = {"lr_factor": jnp.ones(())}
         st = sched.record(1.0, st)
-        st = sched.record(2.0, st)            # worse in min mode -> reduce
+        st = sched.record(2.0, st)            # worse in min mode (wait -> 1)
+        st = sched.record(2.0, st)            # still worse -> reduce
         assert_close(st["lr_factor"], 0.1)
 
 

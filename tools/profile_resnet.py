@@ -13,24 +13,33 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _bench(compiled, args, steps=8):
+def _bench(compiled, args, steps=8, chain_idx=2):
     """Dispatch-N-then-fetch-a-VALUE timing: block_until_ready is not
     trustworthy through the device tunnel (docs/performance.md, round-3
     timing investigation), but a result value cannot exist before its
-    execution completes.  There is NO data dependency between dispatches
-    (args are re-fed unchanged); correctness rests on the single-core
-    in-order execution queue -- the last execution finishing implies all
-    prior ones did.  bench.py's donated-chain measurement is the stronger
-    primary; this is the profiling-loop approximation."""
+    execution completes.  Each dispatch's input batch is perturbed by
+    ``0 * (a scalar of the previous output)`` -- a structural data
+    dependency chaining step i+1 onto step i, so the final value fetch
+    proves ALL N executed serially even if the transport overlapped
+    independent dispatches (same guarantee as bench.py's donated chain;
+    the extra elementwise add costs ~0.2 ms against a >15 ms step)."""
     import jax
 
-    out = compiled(*args)             # warmup
-    jax.block_until_ready(out)
+    args = list(args)
+    x0 = args[chain_idx]
+    # warmup one FULL chained iteration so the tiny chain graphs
+    # (ravel/getitem/mul/add) compile outside the timed loop
+    out = compiled(*args)
+    dep = jax.tree_util.tree_leaves(out)[0].ravel()[0]
+    args[chain_idx] = x0 + (dep * 0).astype(x0.dtype)
+    out = compiled(*args)
+    float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
     t0 = time.perf_counter()
     for _ in range(steps):
         out = compiled(*args)
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.ravel()[0])            # value fetch drains the queue
+        dep = jax.tree_util.tree_leaves(out)[0].ravel()[0]
+        args[chain_idx] = x0 + (dep * 0).astype(x0.dtype)
+    float(jax.tree_util.tree_leaves(out)[0].ravel()[0])  # drains the chain
     return (time.perf_counter() - t0) / steps
 
 
@@ -85,7 +94,7 @@ def main():
         step = jax.jit(make_train_step(model, crit, method,
                                        compute_dtype=jnp.bfloat16))
         c = step.lower(params, mstate, opt_state, x, t, key).compile()
-        dt = _bench(c, (params, mstate, opt_state, x, t, key))
+        dt = _bench(c, (params, mstate, opt_state, x, t, key), chain_idx=3)
         fl = float(c.cost_analysis().get("flops", 0))
         print(f"full step:       {dt*1e3:8.2f} ms   "
               f"mfu={fl/dt/197e12:.3f} flops={fl:.3e}")
@@ -102,7 +111,7 @@ def main():
                                        compute_dtype=jnp.bfloat16))
         os2 = method.init_state(p2)
         c = step.lower(p2, ms2, os2, x2, t2, key).compile()
-        dt = _bench(c, (p2, ms2, os2, x2, t2, key), steps=6)
+        dt = _bench(c, (p2, ms2, os2, x2, t2, key), steps=6, chain_idx=3)
         fl = float(c.cost_analysis().get("flops", 0))
         print(f"full step b256:  {dt*1e3:8.2f} ms   "
               f"mfu={fl/dt/197e12:.3f} imgs/s={b2/dt:.0f}")
