@@ -40,8 +40,16 @@ def read_graph(path, binary=None):
 
 
 def _tensor_to_np(t):
-    dtype = _DT_NP.get(t.dtype, np.float32)
     shape = tuple(int(d.size) for d in t.tensor_shape.dim)
+    if t.string_val:
+        n = int(np.prod(shape)) if shape else 1
+        vals = list(t.string_val)
+        if len(vals) == 1 and n > 1:
+            vals = vals * n                         # splat encoding
+        arr = np.empty(len(vals), object)           # bytes elements
+        arr[:] = vals
+        return arr.reshape(shape)
+    dtype = _DT_NP.get(t.dtype, np.float32)
     n = int(np.prod(shape)) if shape else 1
     if t.tensor_content:
         arr = np.frombuffer(t.tensor_content, dtype=dtype)
@@ -65,6 +73,16 @@ def _tensor_to_np(t):
 def _clean(name):
     name = name.lstrip("^")
     return name.split(":")[0]
+
+
+def _input_key(name):
+    """ctx.input_nodes key for a user-named input: slot 0 collapses to the
+    bare node name; a non-zero slot (e.g. ``reader:1``, the value output
+    of ReaderReadV2) keeps its suffix so multi-output sockets stay
+    distinct."""
+    name = name.lstrip("^")
+    base, _, slot = name.partition(":")
+    return base if slot in ("", "0") else f"{base}:{slot}"
 
 
 class _GraphCtx:
@@ -127,6 +145,24 @@ def _tf_conv_module(k_shape, strides, dilations, with_same_pad):
     return TfConv2D()
 
 
+def _data_format(ndef):
+    return ndef.attr["data_format"].s.decode() or "NHWC"
+
+
+def _nchw_wrap(build):
+    """Run an NHWC-native conversion on NCHW data: permute in, build the
+    NHWC subgraph, permute back (XLA folds the transposes into layouts;
+    reference loaders support both formats natively, e.g. Conv2D.scala)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.graph import Node
+
+    def wrapped(x_node):
+        pre = Node(nn.Permute((0, 2, 3, 1)), [x_node])
+        out = build(pre)
+        return Node(nn.Permute((0, 3, 1, 2)), [out])
+    return wrapped
+
+
 def _pool_module(ndef, kind):
     """TF-exact pooling: reduce_window with lax string padding (SAME
     matches TF's asymmetric pads; avg excludes padded cells like TF)."""
@@ -136,8 +172,9 @@ def _pool_module(ndef, kind):
 
     ks = list(ndef.attr["ksize"].list.i)
     st = list(ndef.attr["strides"].list.i)
-    kh, kw = int(ks[1]), int(ks[2])
-    sh, sw = int(st[1]), int(st[2])
+    hw = (2, 3) if _data_format(ndef) == "NCHW" else (1, 2)
+    kh, kw = int(ks[hw[0]]), int(ks[hw[1]])
+    sh, sw = int(st[hw[0]]), int(st[hw[1]])
     pad = ndef.attr["padding"].s.decode()
 
     class TfPool(Module):
@@ -227,16 +264,16 @@ def _convert_node(ctx, ndef):
         return "node", node
 
     if op == "Conv2D":
-        if ndef.attr["data_format"].s.decode() not in ("", "NHWC"):
-            raise NotImplementedError("Conv2D data_format NCHW")
+        nchw = _data_format(ndef) == "NCHW"
+        hw = (2, 3) if nchw else (1, 2)
         x = _node_of(ctx, ins[0])
         st = list(ndef.attr["strides"].list.i)
         dil = list(ndef.attr["dilations"].list.i) or [1, 1, 1, 1]
         pad = ndef.attr["padding"].s.decode()
         k_kind, k_val = _convert(ctx, ins[1])
+        sh, sw = int(st[hw[0]]), int(st[hw[1]])
+        dh, dw = int(dil[hw[0]]), int(dil[hw[1]])
         if k_kind == "node":
-            sh, sw = int(st[1]), int(st[2])
-            dh, dw = int(dil[1]), int(dil[2])
 
             class _ConvOp(Module):
                 def apply(self, params, state, input, *, training=False,
@@ -248,11 +285,17 @@ def _convert_node(ctx, ndef):
                         rhs_dilation=(dh, dw),
                         dimension_numbers=("NHWC", "HWIO", "NHWC"))
                     return y, state
-            return "node", Node(_ConvOp(), [x, k_val])
+
+            build = lambda xn: Node(_ConvOp(), [xn, k_val])
+            if nchw:
+                build = _nchw_wrap(build)
+            return "node", build(x)
         k = k_val                          # HWIO
-        mod = _tf_conv_module(k.shape, (int(st[1]), int(st[2])),
-                              (int(dil[1]), int(dil[2])), pad == "SAME")
-        node = Node(mod, [x])
+        mod = _tf_conv_module(k.shape, (sh, sw), (dh, dw), pad == "SAME")
+        build = lambda xn: Node(mod, [xn])
+        if nchw:
+            build = _nchw_wrap(build)
+        node = build(x)
 
         def install(params, k=k):
             params["weight"] = jnp.asarray(k)       # HWIO verbatim
@@ -268,6 +311,10 @@ def _convert_node(ctx, ndef):
     if op == "BiasAdd" or (op in ("Add", "AddV2") and len(ins) == 2):
         a_kind, a_val = _convert(ctx, ins[0])
         b_kind, b_val = _convert(ctx, ins[1])
+        if (op == "BiasAdd" and _data_format(ndef) == "NCHW"
+                and b_kind == "const" and b_val.ndim == 1):
+            # bias broadcasts over the channel axis (1), not the last
+            b_val = b_val.reshape(-1, 1, 1)
         if a_kind == "node" and b_kind == "const":
             # fold into the producing conv/linear bias when 1-D and the
             # producer's raw output feeds ONLY this BiasAdd
@@ -367,16 +414,12 @@ def _convert_node(ctx, ndef):
             return "node", Node(_Rsqrt(), [x])
         return "node", Node(m[op](), [x])
 
-    if op == "MaxPool":
-        if ndef.attr["data_format"].s.decode() not in ("", "NHWC"):
-            raise NotImplementedError("MaxPool data_format NCHW")
-        return "node", Node(_pool_module(ndef, "max"),
-                            [_node_of(ctx, ins[0])])
-    if op == "AvgPool":
-        if ndef.attr["data_format"].s.decode() not in ("", "NHWC"):
-            raise NotImplementedError("AvgPool data_format NCHW")
-        return "node", Node(_pool_module(ndef, "avg"),
-                            [_node_of(ctx, ins[0])])
+    if op in ("MaxPool", "AvgPool"):
+        kind_s = "max" if op == "MaxPool" else "avg"
+        build = lambda xn: Node(_pool_module(ndef, kind_s), [xn])
+        if _data_format(ndef) == "NCHW":
+            build = _nchw_wrap(build)
+        return "node", build(_node_of(ctx, ins[0]))
 
     if op == "Reshape":
         x = _node_of(ctx, ins[0])
@@ -440,7 +483,10 @@ def _convert_node(ctx, ndef):
         var = _const_of(ctx, ins[4])
         eps = float(ndef.attr["epsilon"].f or 1e-3)
         mod = nn.SpatialBatchNormalization(scale.shape[0], eps)
-        node = Node(mod, [x])
+        build = lambda xn: Node(mod, [xn])
+        if _data_format(ndef) == "NCHW":
+            build = _nchw_wrap(build)
+        node = build(x)
 
         def install(params, s=scale, o=offset):
             params["weight"] = jnp.asarray(s)
@@ -451,7 +497,11 @@ def _convert_node(ctx, ndef):
             state["running_var"] = jnp.asarray(v)
         ctx.module_blobs.append((mod, install))
         ctx.module_blobs.append((mod, ("state", install_state)))
-        return "node", node
+        # slots 1-4 (batch_mean, batch_var, reserve_1, reserve_2) exist for
+        # grad-op wiring; our FusedBatchNormGrad recomputes batch stats in
+        # training mode, so the const running stats suffice as values
+        return "multi", [("node", node), ("const", mean), ("const", var),
+                         ("const", mean), ("const", var)]
 
     if op == "Cast":
         return _convert(ctx, ins[0])
@@ -682,32 +732,30 @@ def _convert_node(ctx, ndef):
         sm = int(ndef.attr["shrink_axis_mask"].i)
         nm = int(ndef.attr["new_axis_mask"].i)
         elm = int(ndef.attr["ellipsis_mask"].i)
-        if nm:
-            raise NotImplementedError("StridedSlice new_axis_mask")
-        if elm:
-            raise NotImplementedError("StridedSlice ellipsis_mask")
-        sls, shrink = [], []
+        # numpy/jnp advanced indexing natively expresses every mask:
+        # Ellipsis for ellipsis_mask, None for new_axis_mask, an integer
+        # index for shrink_axis_mask (reference: loaders/StridedSlice.scala
+        # builds the same spec for its slice op)
+        sls = []
         for i in range(len(begin)):
-            b = None if (bm >> i) & 1 else begin[i]
-            e = None if (em >> i) & 1 else end[i]
-            if (sm >> i) & 1:
-                shrink.append(i)
-                sls.append(slice(begin[i], begin[i] + 1, 1))
+            if (elm >> i) & 1:
+                sls.append(Ellipsis)
+            elif (nm >> i) & 1:
+                sls.append(None)
+            elif (sm >> i) & 1:
+                sls.append(begin[i])
             else:
+                b = None if (bm >> i) & 1 else begin[i]
+                e = None if (em >> i) & 1 else end[i]
                 sls.append(slice(b, e, strides[i]))
         sls = tuple(sls)
-        shrink = tuple(shrink)
         if kind == "const":
-            out = val[sls]
-            return "const", np.squeeze(out, axis=shrink) if shrink else out
+            return "const", val[sls]
 
         class _StridedSlice(Module):
             def apply(self, params, state, input, *, training=False,
                       rng=None):
-                out = input[sls]
-                if shrink:
-                    out = jnp.squeeze(out, axis=shrink)
-                return out, state
+                return input[sls], state
         return "node", Node(_StridedSlice(), [val])
 
     if op == "Tile":
@@ -737,11 +785,12 @@ def _convert_node(ctx, ndef):
         return "node", Node(nnops.Gather(axis), [val, _node_of(ctx, ins[1])])
 
     if op == "DepthwiseConv2dNative":
-        if ndef.attr["data_format"].s.decode() not in ("", "NHWC"):
-            raise NotImplementedError("DepthwiseConv2dNative NCHW")
+        nchw = _data_format(ndef) == "NCHW"
+        hw = (2, 3) if nchw else (1, 2)
         x = _node_of(ctx, ins[0])
         k = _const_of(ctx, ins[1])        # (kh, kw, cin, mult)
-        st = list(ndef.attr["strides"].list.i)
+        st_raw = list(ndef.attr["strides"].list.i) or [1, 1, 1, 1]
+        st = [1, int(st_raw[hw[0]]), int(st_raw[hw[1]]), 1]
         pad = ndef.attr["padding"].s.decode()
         kh, kw, cin, mult = k.shape
 
@@ -765,7 +814,10 @@ def _convert_node(ctx, ndef):
                 return y, state
 
         mod = _DwConv()
-        node = Node(mod, [x])
+        build = lambda xn: Node(mod, [xn])
+        if nchw:
+            build = _nchw_wrap(build)
+        node = build(x)
 
         def install(params, k=k):
             params["weight"] = jnp.asarray(k)
@@ -1129,22 +1181,486 @@ def _convert_extra_op(ctx, ndef, op, ins):
                 if half_pixel:
                     return jax.image.resize(input, out_shape,
                                             "bilinear"), state
-                in_h, in_w = input.shape[1], input.shape[2]
-                out = input
-                for axis, (n_in, n_out) in ((1, (in_h, size[0])),
-                                            (2, (in_w, size[1]))):
-                    src = jnp.arange(n_out) * (n_in / n_out)
-                    lo = jnp.clip(jnp.floor(src).astype(jnp.int32),
-                                  0, n_in - 1)
-                    hi = jnp.clip(lo + 1, 0, n_in - 1)
-                    w = (src - lo).astype(input.dtype)
-                    shape = [1] * out.ndim
-                    shape[axis] = n_out
-                    w = w.reshape(shape)
-                    out = (jnp.take(out, lo, axis=axis) * (1 - w)
-                           + jnp.take(out, hi, axis=axis) * w)
-                return out, state
+                return _tf1_resize_bilinear(input, size), state
         return "node", Node(_ResizeBilinear(), [x])
+
+    return _convert_grad_data_op(ctx, ndef, op, ins)
+
+
+def _tf1_resize_bilinear(input, size):
+    """TF1 legacy resize grid (src = dst * in/out, no half-pixel shift)."""
+    import jax.numpy as jnp
+
+    in_h, in_w = input.shape[1], input.shape[2]
+    out = input
+    for axis, (n_in, n_out) in ((1, (in_h, size[0])),
+                                (2, (in_w, size[1]))):
+        src = jnp.arange(n_out) * (n_in / n_out)
+        lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, n_in - 1)
+        hi = jnp.clip(lo + 1, 0, n_in - 1)
+        w = (src - lo).astype(input.dtype)
+        shape = [1] * out.ndim
+        shape[axis] = n_out
+        w = w.reshape(shape)
+        out = (jnp.take(out, lo, axis=axis) * (1 - w)
+               + jnp.take(out, hi, axis=axis) * w)
+    return out
+
+
+def _convert_grad_data_op(ctx, ndef, op, ins):
+    """Reference-loader parity tail (round-4): pooling/conv/BN backward ops
+    as the vjp of the matching forward (autodiff replaces the reference's
+    hand-written backward loaders, e.g. loaders/MaxPoolGrad.scala),
+    morphological Dilation2D (+grads), queue/reader plumbing (Identity
+    semantics per loaders/QueueDequeueV2.scala -- data enters/leaves the
+    graph there), tf.Example parsing and image decoding (host-side const
+    evaluation; runtime decoding belongs to the data pipeline).  Returns
+    None for unknown ops."""
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.graph import Input, Node
+    from bigdl_tpu.nn.module import Module
+
+    def _parents(*names):
+        """Mixed const/node operands: returns (getters, node_parents);
+        getters[i](input) yields operand i inside Module.apply (input is
+        the bare value for one parent, the tuple for several)."""
+        kinds = [_convert(ctx, i) for i in names]
+        parents = [v for k, v in kinds if k == "node"]
+        getters, pos = [], 0
+        for k, v in kinds:
+            if k == "node":
+                getters.append(lambda inp, i=pos, n=len(parents):
+                               inp[i] if n > 1 else inp)
+                pos += 1
+            else:
+                getters.append(lambda inp, c=v: jnp.asarray(c))
+        return getters, parents
+
+    # ---- queue / reader plumbing (reference: Identity loaders) -------- #
+    if op in ("QueueDequeueV2", "QueueDequeueManyV2", "ReaderReadV2"):
+        # data ENTERS the graph here: each output slot becomes an Input
+        # socket the caller feeds (the reference cuts its training graphs
+        # at the dequeue the same way, Session.scala)
+        n_out = (2 if op == "ReaderReadV2"
+                 else len(ndef.attr["component_types"].list.type) or 1)
+        outs = []
+        for i in range(n_out):
+            key = ndef.name if i == 0 else f"{ndef.name}:{i}"
+            node = ctx.input_nodes.get(key)
+            if node is None:
+                node = Input()
+                ctx.input_nodes[key] = node
+            outs.append(("node", node))
+        return ("multi", outs) if n_out > 1 else outs[0]
+    if op in ("QueueEnqueueV2", "QueueEnqueueManyV2"):
+        # pass the enqueued components through (ins[0] is the queue handle)
+        data = ins[1:] if len(ins) > 1 else ins
+        if len(data) == 1:
+            return _convert(ctx, data[0])
+        return "multi", [_convert(ctx, i) for i in data]
+
+    # ---- host-side data ops (const evaluation) ------------------------ #
+    if op in ("DecodeJpeg", "DecodePng", "DecodeBmp", "DecodeGif"):
+        kind, val = _convert(ctx, ins[0])
+        if kind != "const":
+            raise NotImplementedError(
+                f"{op} on a runtime tensor: decode images host-side in the "
+                "data pipeline (bigdl_tpu.transform.vision / "
+                "dataset.image_folder), where the reference's runtime "
+                "decoders also live")
+        import io
+
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(val.ravel()[0]))
+        if op == "DecodeGif":          # (num_frames, h, w, 3) like TF
+            frames = []
+            try:
+                while True:
+                    frames.append(np.asarray(img.convert("RGB"), np.uint8))
+                    img.seek(img.tell() + 1)
+            except EOFError:
+                pass
+            return "const", np.stack(frames)
+        channels = int(ndef.attr["channels"].i)
+        if channels == 1:
+            img = img.convert("L")
+        elif channels == 3:
+            img = img.convert("RGB")
+        elif channels == 4:
+            img = img.convert("RGBA")
+        arr = np.asarray(img, np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return "const", arr
+
+    if op == "DecodeRaw":
+        kind, val = _convert(ctx, ins[0])
+        if kind != "const":
+            raise NotImplementedError(
+                "DecodeRaw on a runtime tensor: decode bytes host-side in "
+                "the data pipeline")
+        out_np = _DT_NP.get(ndef.attr["out_type"].type, np.float32)
+        # TF's op default is little_endian=true; an absent attr (e.g.
+        # strip_default_attrs) must not flip the byte order
+        little = (bool(ndef.attr["little_endian"].b)
+                  if "little_endian" in ndef.attr else True)
+        dt = np.dtype(out_np).newbyteorder("<" if little else ">")
+        rows = [np.frombuffer(b, dt).astype(out_np) for b in val.ravel()]
+        return "const", np.stack(rows).reshape(val.shape + (-1,))
+
+    if op == "Substr":
+        kind, val = _convert(ctx, ins[0])
+        if kind != "const":
+            raise NotImplementedError("Substr on a runtime tensor")
+        pos = _const_of(ctx, ins[1]).astype(np.int64)
+        length = _const_of(ctx, ins[2]).astype(np.int64)
+        # TF broadcasts pos/len against the input shape
+        pos = np.broadcast_to(pos, val.shape)
+        length = np.broadcast_to(length, val.shape)
+        flat = val.ravel()
+        p, l = pos.ravel(), length.ravel()
+        out = np.empty(flat.shape, object)
+        for i, b in enumerate(flat):
+            out[i] = bytes(b)[int(p[i]):int(p[i]) + int(l[i])]
+        return "const", out.reshape(val.shape)
+
+    if op in ("ParseExample", "ParseSingleExample"):
+        from bigdl_tpu.interop.tfrecord import parse_example
+        kind, ser = _convert(ctx, ins[0])
+        if kind != "const":
+            raise NotImplementedError(
+                f"{op} on a runtime tensor: parse tf.Example records "
+                "host-side via bigdl_tpu.interop.tfrecord (TFRecordReader "
+                "+ parse_example) and feed the parsed tensors as inputs")
+        if op == "ParseExample":
+            nsparse = int(ndef.attr["Nsparse"].i)
+            ndense = int(ndef.attr["Ndense"].i)
+            if nsparse:
+                raise NotImplementedError("ParseExample sparse features")
+            keys = [bytes(_const_of(ctx, ins[2 + j]).ravel()[0])
+                    for j in range(ndense)]
+            shapes = [tuple(int(d.size) for d in sh.dim)
+                      for sh in ndef.attr["dense_shapes"].list.shape]
+            records = [parse_example(bytes(b))
+                       for b in np.atleast_1d(ser).ravel()]
+            outs = []
+            for j, k in enumerate(keys):
+                vals = [np.asarray(ex[k.decode()]).reshape(shapes[j])
+                        for ex in records]
+                outs.append(("const", np.stack(vals)))
+            return ("multi", outs) if len(outs) > 1 else outs[0]
+        keys = [bytes(s) for s in ndef.attr["dense_keys"].list.s]
+        shapes = [tuple(int(d.size) for d in sh.dim)
+                  for sh in ndef.attr["dense_shapes"].list.shape]
+        ex = parse_example(bytes(np.asarray(ser).ravel()[0]))
+        outs = [("const", np.asarray(ex[k.decode()]).reshape(shapes[j]))
+                for j, k in enumerate(keys)]
+        return ("multi", outs) if len(outs) > 1 else outs[0]
+
+    if op == "BroadcastGradientArgs":
+        s0 = [int(v) for v in _const_of(ctx, ins[0]).ravel()]
+        s1 = [int(v) for v in _const_of(ctx, ins[1]).ravel()]
+        n = max(len(s0), len(s1))
+        p0 = [1] * (n - len(s0)) + s0
+        p1 = [1] * (n - len(s1)) + s1
+        r0 = [i for i in range(n) if p0[i] == 1 and p1[i] != 1]
+        r1 = [i for i in range(n) if p1[i] == 1 and p0[i] != 1]
+        return "multi", [("const", np.asarray(r0, np.int32)),
+                         ("const", np.asarray(r1, np.int32))]
+
+    # ---- backward ops = vjp of the matching forward ------------------- #
+    if op in ("MaxPoolGrad", "AvgPoolGrad"):
+        ks = list(ndef.attr["ksize"].list.i)
+        st = list(ndef.attr["strides"].list.i)
+        nchw = _data_format(ndef) == "NCHW"
+        hw = (2, 3) if nchw else (1, 2)
+        pad = ndef.attr["padding"].s.decode()
+        kh, kw = int(ks[hw[0]]), int(ks[hw[1]])
+        sh, sw = int(st[hw[0]]), int(st[hw[1]])
+        dims = (1, 1, kh, kw) if nchw else (1, kh, kw, 1)
+        strides = (1, 1, sh, sw) if nchw else (1, sh, sw, 1)
+        if op == "MaxPoolGrad":
+            getters, parents = _parents(ins[0], ins[2])
+
+            class _MaxPoolGrad(Module):
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    from jax import lax
+                    xx, gg = getters[0](input), getters[1](input)
+                    f = lambda a: lax.reduce_window(
+                        a, -jnp.inf, lax.max, dims, strides, pad)
+                    _, vjp = jax.vjp(f, xx)
+                    return vjp(gg.astype(xx.dtype))[0], state
+            return "node", Node(_MaxPoolGrad(), parents)
+        shape = tuple(int(v) for v in _const_of(ctx, ins[0]).ravel())
+        getters, parents = _parents(ins[1])
+
+        class _AvgPoolGrad(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                from jax import lax
+                gg = getters[0](input)
+
+                def f(a):
+                    tot = lax.reduce_window(a, 0.0, lax.add, dims, strides,
+                                            pad)
+                    cnt = lax.reduce_window(jnp.ones_like(a), 0.0, lax.add,
+                                            dims, strides, pad)
+                    return tot / cnt
+                # avg pooling is linear: vjp at zeros is exact
+                _, vjp = jax.vjp(f, jnp.zeros(shape, gg.dtype))
+                return vjp(gg)[0], state
+        return "node", Node(_AvgPoolGrad(), parents)
+
+    if op == "Conv2DBackpropFilter":
+        nchw = _data_format(ndef) == "NCHW"
+        hw = (2, 3) if nchw else (1, 2)
+        st = list(ndef.attr["strides"].list.i)
+        dil = list(ndef.attr["dilations"].list.i) or [1, 1, 1, 1]
+        pad = ndef.attr["padding"].s.decode()
+        sh, sw = int(st[hw[0]]), int(st[hw[1]])
+        dh, dw = int(dil[hw[0]]), int(dil[hw[1]])
+        dn = (("NCHW", "HWIO", "NCHW") if nchw
+              else ("NHWC", "HWIO", "NHWC"))
+        fshape = tuple(int(v) for v in _const_of(ctx, ins[1]).ravel())
+        getters, parents = _parents(ins[0], ins[2])
+
+        class _ConvBpF(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                from jax import lax
+                xx, gg = getters[0](input), getters[1](input)
+                f = lambda w: lax.conv_general_dilated(
+                    xx, w, (sh, sw), pad, rhs_dilation=(dh, dw),
+                    dimension_numbers=dn)
+                # conv is linear in the filter: vjp at zeros is exact
+                _, vjp = jax.vjp(f, jnp.zeros(fshape, xx.dtype))
+                return vjp(gg.astype(xx.dtype))[0], state
+        return "node", Node(_ConvBpF(), parents)
+
+    if op in ("Conv3DBackpropInput", "Conv3DBackpropInputV2",
+              "Conv3DBackpropFilter", "Conv3DBackpropFilterV2"):
+        st = list(ndef.attr["strides"].list.i)
+        sd, sh, sw = int(st[1]), int(st[2]), int(st[3])
+        pad = ndef.attr["padding"].s.decode()
+        dn = ("NDHWC", "DHWIO", "NDHWC")
+
+        def conv3d(a, w):
+            from jax import lax
+            return lax.conv_general_dilated(a, w, (sd, sh, sw), pad,
+                                            dimension_numbers=dn)
+
+        wrt_input = "Input" in op
+        # V2 passes the reconstructed tensor's SIZES as a const vector;
+        # V1 passes the original tensor itself (used for its shape only)
+        size_in = ins[0] if wrt_input else ins[1]
+        k_kind, k_val = _convert(ctx, size_in)
+        static_shape = None
+        if k_kind == "const" and np.asarray(k_val).ndim == 1:
+            static_shape = tuple(int(v) for v in np.asarray(k_val).ravel())
+            other = ins[1] if wrt_input else ins[0]
+            getters, parents = _parents(other, ins[2])
+            g_shape = None
+        else:
+            getters, parents = _parents(ins[0], ins[1], ins[2])
+            g_shape = getters[0] if wrt_input else getters[1]
+            getters = ([getters[1], getters[2]] if wrt_input
+                       else [getters[0], getters[2]])
+
+        class _Conv3DBp(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                other, gg = getters[0](input), getters[1](input)
+                shape = (static_shape if static_shape is not None
+                         else g_shape(input).shape)
+                zeros = jnp.zeros(shape, gg.dtype)
+                if wrt_input:
+                    f = lambda a: conv3d(a, other.astype(gg.dtype))
+                else:
+                    f = lambda w: conv3d(other.astype(gg.dtype), w)
+                _, vjp = jax.vjp(f, zeros)
+                return vjp(gg)[0], state
+        return "node", Node(_Conv3DBp(), parents)
+
+    if op in ("DepthwiseConv2dNativeBackpropInput",
+              "DepthwiseConv2dNativeBackpropFilter"):
+        nchw = _data_format(ndef) == "NCHW"
+        hw = (2, 3) if nchw else (1, 2)
+        st = list(ndef.attr["strides"].list.i)
+        pad = ndef.attr["padding"].s.decode()
+        sh, sw = int(st[hw[0]]), int(st[hw[1]])
+        wrt_input = op.endswith("Input")
+        shape = tuple(int(v) for v in
+                      _const_of(ctx, ins[0] if wrt_input else ins[1])
+                      .ravel())
+        getters, parents = _parents(ins[1] if wrt_input else ins[0],
+                                    ins[2])
+
+        def dwconv(a, w):
+            from jax import lax
+            kh, kw, cin, mult = w.shape
+            wr = w.reshape(kh, kw, 1, cin * mult)
+            if nchw:
+                a = jnp.transpose(a, (0, 2, 3, 1))
+            y = lax.conv_general_dilated(
+                a, wr, (sh, sw), pad,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=cin)
+            return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
+
+        class _DwBp(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                other, gg = getters[0](input), getters[1](input)
+                zeros = jnp.zeros(shape, gg.dtype)
+                if wrt_input:
+                    f = lambda a: dwconv(a, other.astype(gg.dtype))
+                else:
+                    f = lambda w: dwconv(other.astype(gg.dtype), w)
+                _, vjp = jax.vjp(f, zeros)
+                return vjp(gg)[0], state
+        return "node", Node(_DwBp(), parents)
+
+    if op in ("FusedBatchNormGrad", "FusedBatchNormGradV2",
+              "FusedBatchNormGradV3"):
+        eps = float(ndef.attr["epsilon"].f or 1e-3)
+        is_training = (bool(ndef.attr["is_training"].b)
+                       if "is_training" in ndef.attr else True)
+        nchw = _data_format(ndef) == "NCHW"
+        axes = (0, 2, 3) if nchw else (0, 1, 2)
+        cshape = ((1, -1, 1, 1) if nchw else (1, 1, 1, -1))
+        getters, parents = _parents(ins[0], ins[1], ins[2], ins[3],
+                                    ins[4])
+
+        class _FBNGrad(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                gg, xx, scale = (getters[0](input), getters[1](input),
+                                 getters[2](input))
+                mean, var = getters[3](input), getters[4](input)
+
+                def f(a, s, o):
+                    if is_training:
+                        m = a.mean(axes, keepdims=True)
+                        v = ((a - m) ** 2).mean(axes, keepdims=True)
+                    else:
+                        m = mean.reshape(cshape)
+                        v = var.reshape(cshape)
+                    xhat = (a - m) / jnp.sqrt(v + eps)
+                    return xhat * s.reshape(cshape) + o.reshape(cshape)
+
+                _, vjp = jax.vjp(f, xx, scale.astype(xx.dtype),
+                                 jnp.zeros_like(scale, xx.dtype))
+                dx, ds, do = vjp(gg.astype(xx.dtype))
+                return [dx, ds, do], state
+
+        main = Node(_FBNGrad(), parents)
+        outs = [("node", Node(nn.SelectTable(i), [main]))
+                for i in range(3)]
+        # reserve-space outputs (slots 3, 4) exist for op chaining only
+        outs += [("const", np.zeros((), np.float32))] * 2
+        return "multi", outs
+
+    if op == "LRNGrad":
+        r = int(ndef.attr["depth_radius"].i or 5)
+        bias = float(ndef.attr["bias"].f or 1.0)
+        alpha = float(ndef.attr["alpha"].f or 1.0)
+        beta = float(ndef.attr["beta"].f or 0.5)
+        getters, parents = _parents(ins[0], ins[1])
+
+        class _LRNGrad(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                from jax import lax
+                gg, xx = getters[0](input), getters[1](input)
+
+                def f(a):
+                    sq = lax.reduce_window(
+                        a * a, 0.0, lax.add, (1, 1, 1, 2 * r + 1),
+                        (1, 1, 1, 1),
+                        [(0, 0), (0, 0), (0, 0), (r, r)])
+                    return a / jnp.power(bias + alpha * sq, beta)
+
+                _, vjp = jax.vjp(f, xx)
+                return vjp(gg.astype(xx.dtype))[0], state
+        return "node", Node(_LRNGrad(), parents)
+
+    if op == "ResizeBilinearGrad":
+        if bool(ndef.attr["align_corners"].b):
+            raise NotImplementedError("ResizeBilinearGrad align_corners")
+        half_pixel = bool(ndef.attr["half_pixel_centers"].b)
+        getters, parents = _parents(ins[0], ins[1])
+
+        class _ResizeGrad(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                gg, orig = getters[0](input), getters[1](input)
+                size = (gg.shape[1], gg.shape[2])
+
+                def f(a):
+                    if half_pixel:
+                        return jax.image.resize(
+                            a, (a.shape[0],) + size + (a.shape[-1],),
+                            "bilinear")
+                    return _tf1_resize_bilinear(a, size)
+                _, vjp = jax.vjp(f, orig)
+                return vjp(gg.astype(orig.dtype))[0], state
+        return "node", Node(_ResizeGrad(), parents)
+
+    if op in ("Dilation2D", "Dilation2DBackpropInput",
+              "Dilation2DBackpropFilter"):
+        st = list(ndef.attr["strides"].list.i)
+        rt = list(ndef.attr["rates"].list.i)
+        pad = ndef.attr["padding"].s.decode()
+        sh, sw = int(st[1]), int(st[2])
+        rh, rw = int(rt[1]), int(rt[2])
+
+        def dilation_fwd(a, f):
+            """Morphological (grey) dilation: max over the window of
+            input + filter (TF Dilation2D semantics)."""
+            kh, kw = f.shape[0], f.shape[1]
+            ekh, ekw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+            in_h, in_w = a.shape[1], a.shape[2]
+            if pad == "SAME":
+                out_h, out_w = -(-in_h // sh), -(-in_w // sw)
+                ph = max((out_h - 1) * sh + ekh - in_h, 0)
+                pw = max((out_w - 1) * sw + ekw - in_w, 0)
+                pt, pl = ph // 2, pw // 2
+                pads = ((0, 0), (pt, ph - pt), (pl, pw - pl), (0, 0))
+            else:
+                out_h = (in_h - ekh) // sh + 1
+                out_w = (in_w - ekw) // sw + 1
+                pads = ((0, 0), (0, 0), (0, 0), (0, 0))
+            ap = jnp.pad(a, pads, constant_values=-jnp.inf)
+            out = None
+            for di in range(kh):
+                for dj in range(kw):
+                    win = ap[:, di * rh:di * rh + (out_h - 1) * sh + 1:sh,
+                             dj * rw:dj * rw + (out_w - 1) * sw + 1:sw, :]
+                    cand = win + f[di, dj]
+                    out = cand if out is None else jnp.maximum(out, cand)
+            return out
+
+        has_g = op != "Dilation2D"
+        getters, parents = (_parents(ins[0], ins[1], ins[2]) if has_g
+                            else _parents(ins[0], ins[1]))
+        wrt = 0 if op.endswith("Input") else 1
+
+        class _Dilation(Module):
+            def apply(self, params, state, input, *, training=False,
+                      rng=None):
+                a, f = getters[0](input), getters[1](input)
+                f = f.astype(a.dtype)
+                if not has_g:
+                    return dilation_fwd(a, f), state
+                gg = getters[2](input).astype(a.dtype)
+                _, vjp = jax.vjp(dilation_fwd, a, f)
+                return vjp(gg)[wrt], state
+        return "node", Node(_Dilation(), parents)
 
     return None
 
@@ -1484,7 +2000,7 @@ def load_tf(path, inputs, outputs, binary=None, input_specs=None,
     ctx = _GraphCtx(nodes)
     ctx.trainable = trainable
     for name in inputs:
-        ctx.input_nodes[_clean(name)] = Input()
+        ctx.input_nodes[_input_key(name)] = Input()
 
     out_nodes = []
     for name in outputs:
@@ -1493,7 +2009,7 @@ def load_tf(path, inputs, outputs, binary=None, input_specs=None,
             raise ValueError(f"output {name} folded to a constant")
         out_nodes.append(val)
 
-    in_nodes = [ctx.input_nodes[_clean(n)] for n in inputs]
+    in_nodes = [ctx.input_nodes[_input_key(n)] for n in inputs]
     graph = Graph(in_nodes, out_nodes)
 
     if input_specs:
@@ -1528,7 +2044,10 @@ def _install(graph, module_blobs):
     for mod, fn in module_blobs:
         if fn is None:
             continue
-        key = idx[id(mod)]
+        key = idx.get(id(mod))
+        if key is None:
+            continue   # converted but unreachable from the outputs (e.g.
+                       # only a sibling output slot of its op is consumed)
         if isinstance(fn, tuple) and fn[0] == "state":
             fn[1](graph._state[key])
         else:

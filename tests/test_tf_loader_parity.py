@@ -1,0 +1,466 @@
+"""Round-4 TF loader parity (VERDICT r3 ask #3).
+
+The reference ships one loader class per op under utils/tf/loaders/ (161
+files).  This suite (a) enumerates that exact file list and asserts every
+op has a converter (or is infrastructure), and (b) golden-tests the
+round-4 additions — backward ops, NCHW data_format, StridedSlice masks,
+morphological Dilation2D, tf.Example parsing, image decoding, queue
+plumbing — against real TensorFlow running the same GraphDef.
+"""
+
+import io
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.interop import tensorflow_pb2 as tfpb
+from bigdl_tpu.interop.tensorflow import _GraphCtx, _convert, load_tf
+from bigdl_tpu.interop.tfrecord import build_example
+
+# ls /root/reference/spark/dl/src/main/scala/com/intel/analytics/bigdl/
+#    utils/tf/loaders/*.scala  (161 files, frozen here as the parity bar)
+REFERENCE_LOADERS = """
+Abs Adapter Add AddN All Any ApproximateEqual ArgMax ArrayOps Assert
+AvgPool AvgPoolGrad BatchMatMul BiasAdd BiasAddGrad BiasAddV1
+BroadcastGradientArgs Cast Ceil ConcatV2 Const ControlFlowOps Conv2D
+Conv2DBackpropFilter Conv2DBackpropInput Conv3D Conv3DBackpropFilter
+Conv3DBackpropFilterV2 Conv3DBackpropInput Conv3DBackpropInputV2
+DataFlowOps DecodeBmp DecodeGif DecodeJpeg DecodePng DecodeRaw
+DependencyNode DepthwiseConv2dNative DepthwiseConv2dNativeBackpropFilter
+DepthwiseConv2dNativeBackpropInput Digamma Dilation2D
+Dilation2DBackpropFilter Dilation2DBackpropInput Div Elu EluGrad Equal
+Erf Erfc Exp ExpandDims Expm1 Fill Floor FloorDiv FloorMod FusedBatchNorm
+FusedBatchNormGrad FusedBatchNormGradV2 FusedBatchNormV2 Gather Greater
+GreaterEqual Identity InTopK Inv InvGrad IsFinite IsInf IsNan L2Loss LRN
+LRNGrad Less LessEqual Lgamma Log Log1p LogSoftmax LogicalAnd LogicalNot
+LogicalOr MatMul Max MaxPool MaxPoolGrad Maximum Mean Minimum Mod Mul Neg
+NoOp NotEqual OneHot Pack Pad ParseExample ParseSingleExample Placeholder
+Pow Prod QueueDequeueManyV2 QueueDequeueV2 QueueEnqueueManyV2
+QueueEnqueueV2 RandomShuffle RandomUniform Range Rank ReaderReadV2
+RealDiv Reciprocal ReciprocalGrad Relu Relu6 Relu6Grad ReluGrad Reshape
+ResizeBilinear ResizeBilinearGrad Rint Round Rsqrt RsqrtGrad SegmentSum
+Select Shape Sigmoid SigmoidGrad Sign Slice Softmax
+SoftmaxCrossEntropyWithLogits Softplus SoftplusGrad Softsign SoftsignGrad
+Split Sqrt SqrtGrad Square SquaredDifference Squeeze StridedSlice Sub
+Substr Sum Tanh TanhGrad TensorflowOpsLoader Tile TopK TopKV2 Transpose
+TruncateDiv TruncateMod Unpack Utils VariableV2
+""".split()
+
+# loader-framework plumbing, not TF ops
+INFRA = {"Adapter", "ArrayOps", "ControlFlowOps", "DataFlowOps",
+         "DependencyNode", "TensorflowOpsLoader", "Utils"}
+
+
+class TestLoaderCoverage:
+    def test_reference_list_is_complete(self):
+        ref_dir = ("/root/reference/spark/dl/src/main/scala/com/intel/"
+                   "analytics/bigdl/utils/tf/loaders")
+        if os.path.isdir(ref_dir):
+            actual = sorted(f[:-6] for f in os.listdir(ref_dir)
+                            if f.endswith(".scala"))
+            assert actual == sorted(REFERENCE_LOADERS)
+
+    def test_every_loader_op_has_a_converter(self):
+        """Every reference loader op name appears in a converter branch
+        (ops whose runtime form cannot exist on-device — image decoding,
+        string ops, Example parsing — convert constants and raise with
+        data-pipeline guidance otherwise, which the branch itself
+        documents)."""
+        import bigdl_tpu.interop.tensorflow as tf_mod
+        src = open(tf_mod.__file__).read()
+        missing = [op for op in REFERENCE_LOADERS
+                   if op not in INFRA and f'"{op}"' not in src]
+        assert not missing, f"no converter branch for: {missing}"
+
+
+def _build_graph(build_fn):
+    tf = pytest.importorskip("tensorflow")
+    g = tf.Graph()
+    with g.as_default():
+        build_fn(tf)
+    return g
+
+
+def _roundtrip(build_fn, feeds, out, rtol=1e-4, atol=1e-3,
+               ref_transform=None):
+    """Import the graph and compare our forward with real TF's."""
+    tf = pytest.importorskip("tensorflow")
+    g = _build_graph(build_fn)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "g.pb")
+        with open(path, "wb") as f:
+            f.write(g.as_graph_def().SerializeToString())
+        model = load_tf(path, inputs=list(feeds), outputs=[out],
+                        input_specs={n: v.shape for n, v in feeds.items()})
+        xs = [jnp.asarray(v) for v in feeds.values()]
+        ours = np.asarray(model.forward(xs[0] if len(xs) == 1
+                                        else tuple(xs)))
+    with tf.compat.v1.Session(graph=g) as sess:
+        ref = sess.run(out + ":0", {n + ":0": v for n, v in feeds.items()})
+    if ref_transform is not None:
+        ref = ref_transform(ref)
+    np.testing.assert_allclose(ours, ref, rtol=rtol, atol=atol)
+    return ours
+
+
+class TestBackwardOps:
+    """The reference has hand-written backward loaders (MaxPoolGrad.scala
+    etc.); here each is the vjp of its forward — golden against real TF."""
+
+    def test_max_and_avg_pool_grad(self):
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        g = np.random.randn(2, 4, 4, 3).astype(np.float32)
+
+        def build(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                          name="x")
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 4, 4, 3),
+                                          name="g")
+            y = tf.nn.max_pool2d(xp, 2, 2, "SAME")
+            mg = tf.raw_ops.MaxPoolGrad(
+                orig_input=xp, orig_output=y, grad=gp,
+                ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1], padding="SAME")
+            ag = tf.raw_ops.AvgPoolGrad(
+                orig_input_shape=[2, 8, 8, 3], grad=gp,
+                ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1], padding="VALID")
+            tf.identity(mg + ag, name="out")
+        _roundtrip(build, {"x": x, "g": g}, "out")
+
+    def test_conv2d_backprop_filter(self):
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        g = np.random.randn(2, 8, 8, 5).astype(np.float32)
+
+        def build(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                          name="x")
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 5),
+                                          name="g")
+            tf.identity(tf.raw_ops.Conv2DBackpropFilter(
+                input=xp, filter_sizes=[3, 3, 3, 5], out_backprop=gp,
+                strides=[1, 1, 1, 1], padding="SAME"), name="out")
+        _roundtrip(build, {"x": x, "g": g}, "out", atol=1e-2)
+
+    def test_conv3d_backprops(self):
+        x = np.random.randn(2, 4, 8, 8, 3).astype(np.float32)
+        w = np.random.randn(2, 3, 3, 3, 4).astype(np.float32)
+        g = np.random.randn(2, 4, 8, 8, 4).astype(np.float32)
+
+        def build_in(tf):
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 4, 8, 8, 4),
+                                          name="g")
+            tf.identity(tf.raw_ops.Conv3DBackpropInputV2(
+                input_sizes=[2, 4, 8, 8, 3], filter=tf.constant(w),
+                out_backprop=gp, strides=[1, 1, 1, 1, 1], padding="SAME"),
+                name="out")
+        _roundtrip(build_in, {"g": g}, "out", rtol=1e-3)
+
+        def build_f(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 4, 8, 8, 3),
+                                          name="x")
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 4, 8, 8, 4),
+                                          name="g")
+            tf.identity(tf.raw_ops.Conv3DBackpropFilterV2(
+                input=xp, filter_sizes=[2, 3, 3, 3, 4], out_backprop=gp,
+                strides=[1, 1, 1, 1, 1], padding="SAME"), name="out")
+        _roundtrip(build_f, {"x": x, "g": g}, "out", rtol=1e-3, atol=1e-2)
+
+    def test_depthwise_backprops(self):
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        w = np.random.randn(3, 3, 3, 2).astype(np.float32)
+        g = np.random.randn(2, 8, 8, 6).astype(np.float32)
+
+        def build_in(tf):
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 6),
+                                          name="g")
+            tf.identity(tf.raw_ops.DepthwiseConv2dNativeBackpropInput(
+                input_sizes=[2, 8, 8, 3], filter=tf.constant(w),
+                out_backprop=gp, strides=[1, 1, 1, 1], padding="SAME"),
+                name="out")
+        _roundtrip(build_in, {"g": g}, "out", rtol=1e-3)
+
+        def build_f(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                          name="x")
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 6),
+                                          name="g")
+            tf.identity(tf.raw_ops.DepthwiseConv2dNativeBackpropFilter(
+                input=xp, filter_sizes=[3, 3, 3, 2], out_backprop=gp,
+                strides=[1, 1, 1, 1], padding="SAME"), name="out")
+        _roundtrip(build_f, {"x": x, "g": g}, "out", rtol=1e-3)
+
+    def test_fused_batch_norm_grad_all_outputs(self):
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        g = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        scale = (np.random.rand(3) + 0.5).astype(np.float32)
+        off = np.random.randn(3).astype(np.float32)
+
+        for field, name in [("x_backprop", "out"),
+                            ("scale_backprop", "outs"),
+                            ("offset_backprop", "outo")]:
+            def build(tf, field=field, name=name):
+                xp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                              name="x")
+                gp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                              name="g")
+                empty = tf.constant(np.zeros(0, np.float32))
+                f = tf.raw_ops.FusedBatchNorm(
+                    x=xp, scale=tf.constant(scale), offset=tf.constant(off),
+                    mean=empty, variance=empty, epsilon=1e-3,
+                    is_training=True)
+                r = tf.raw_ops.FusedBatchNormGrad(
+                    y_backprop=gp, x=xp, scale=tf.constant(scale),
+                    reserve_space_1=f.reserve_space_1,
+                    reserve_space_2=f.reserve_space_2, epsilon=1e-3,
+                    is_training=True)
+                tf.identity(getattr(r, field), name=name)
+            _roundtrip(build, {"x": x, "g": g}, name, rtol=1e-3)
+
+    def test_lrn_grad(self):
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        g = np.random.randn(2, 8, 8, 3).astype(np.float32)
+
+        def build(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                          name="x")
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                          name="g")
+            y = tf.raw_ops.LRN(input=xp, depth_radius=2, bias=1.0,
+                               alpha=1e-3, beta=0.75)
+            tf.identity(tf.raw_ops.LRNGrad(
+                input_grads=gp, input_image=xp, output_image=y,
+                depth_radius=2, bias=1.0, alpha=1e-3, beta=0.75),
+                name="out")
+        _roundtrip(build, {"x": x, "g": g}, "out", rtol=1e-3)
+
+    def test_resize_bilinear_grad(self):
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        g = np.random.randn(2, 16, 16, 3).astype(np.float32)
+
+        def build(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                          name="x")
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 16, 16, 3),
+                                          name="g")
+            tf.identity(tf.raw_ops.ResizeBilinearGrad(
+                grads=gp, original_image=xp, align_corners=False,
+                half_pixel_centers=True), name="out")
+        _roundtrip(build, {"x": x, "g": g}, "out", rtol=1e-3)
+
+    def test_broadcast_gradient_args(self):
+        g = tfpb.GraphDef()
+        for name, arr in [("s0", [2, 1, 3]), ("s1", [5, 2, 4, 3])]:
+            n = g.node.add()
+            n.name, n.op = name, "Const"
+            t = n.attr["value"].tensor
+            t.dtype = tfpb.DT_INT32
+            t.tensor_shape.dim.add().size = len(arr)
+            t.tensor_content = np.asarray(arr, np.int32).tobytes()
+        n = g.node.add()
+        n.name, n.op = "bga", "BroadcastGradientArgs"
+        n.input.extend(["s0", "s1"])
+        ctx = _GraphCtx({nd.name: nd for nd in g.node})
+        _, r0 = _convert(ctx, "bga:0")
+        _, r1 = _convert(ctx, "bga:1")
+        assert list(r0) == [0, 2] and list(r1) == []
+
+
+class TestDilation2D:
+    def test_forward_and_backprops(self):
+        x = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        g = np.random.randn(2, 8, 8, 3).astype(np.float32)
+        filt = np.random.randn(3, 3, 3).astype(np.float32)
+
+        def fwd(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                          name="x")
+            tf.identity(tf.raw_ops.Dilation2D(
+                input=xp, filter=tf.constant(filt), strides=[1, 1, 1, 1],
+                rates=[1, 1, 1, 1], padding="SAME"), name="out")
+        _roundtrip(fwd, {"x": x}, "out")
+
+        for raw in ("Dilation2DBackpropInput", "Dilation2DBackpropFilter"):
+            def bwd(tf, raw=raw):
+                xp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                              name="x")
+                gp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                              name="g")
+                tf.identity(getattr(tf.raw_ops, raw)(
+                    input=xp, filter=tf.constant(filt),
+                    strides=[1, 1, 1, 1], rates=[1, 1, 1, 1],
+                    padding="SAME", out_backprop=gp), name="out")
+            _roundtrip(bwd, {"x": x, "g": g}, "out")
+
+
+class TestNCHW:
+    """NCHW data_format conv/pool/BN/bias (VERDICT r3: these raised).
+    TF CPU cannot execute NCHW convs, so the oracle runs NHWC on
+    transposed data."""
+
+    def test_conv_bias_pool_nchw(self):
+        tf = pytest.importorskip("tensorflow")
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        w = np.random.randn(3, 3, 3, 5).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+
+        def build(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 3, 8, 8),
+                                          name="x")
+            y = tf.raw_ops.Conv2D(input=xp, filter=tf.constant(w),
+                                  strides=[1, 1, 1, 1], padding="SAME",
+                                  data_format="NCHW")
+            y = tf.raw_ops.BiasAdd(value=y, bias=tf.constant(b),
+                                   data_format="NCHW")
+            y = tf.raw_ops.MaxPool(input=y, ksize=[1, 1, 2, 2],
+                                   strides=[1, 1, 2, 2], padding="VALID",
+                                   data_format="NCHW")
+            tf.identity(y, name="out")
+        g = _build_graph(build)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "g.pb")
+            with open(path, "wb") as f:
+                f.write(g.as_graph_def().SerializeToString())
+            model = load_tf(path, inputs=["x"], outputs=["out"],
+                            input_specs={"x": x.shape})
+            ours = np.asarray(model.forward(jnp.asarray(x)))
+        ref_g = tf.Graph()
+        with ref_g.as_default():
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                          name="x")
+            y = tf.nn.max_pool2d(tf.nn.bias_add(
+                tf.nn.conv2d(xp, w, 1, "SAME"), b), 2, 2, "VALID")
+            tf.identity(y, name="out")
+        with tf.compat.v1.Session(graph=ref_g) as sess:
+            ref = sess.run("out:0", {"x:0": x.transpose(0, 2, 3, 1)})
+        np.testing.assert_allclose(ours, ref.transpose(0, 3, 1, 2),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestStridedSliceMasks:
+    def test_ellipsis_newaxis_shrink(self):
+        x = np.random.randn(4, 6, 8).astype(np.float32)
+
+        def build(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (4, 6, 8), name="x")
+            tf.identity(xp[1, ..., tf.newaxis, 2:7:2], name="out")
+        _roundtrip(build, {"x": x}, "out")
+
+
+class TestDataOps:
+    def _str_const(self, g, name, vals):
+        n = g.node.add()
+        n.name, n.op = name, "Const"
+        t = n.attr["value"].tensor
+        t.dtype = tfpb.DT_STRING
+        t.tensor_shape.dim.add().size = len(vals)
+        t.string_val.extend(vals)
+
+    def _np_const(self, g, name, arr, dt, np_dt):
+        n = g.node.add()
+        n.name, n.op = name, "Const"
+        t = n.attr["value"].tensor
+        t.dtype = dt
+        for d in np.asarray(arr).shape:
+            t.tensor_shape.dim.add().size = d
+        t.tensor_content = np.asarray(arr, np_dt).tobytes()
+
+    def test_decode_images(self):
+        from PIL import Image
+        rgb = (np.random.rand(5, 7, 3) * 255).astype(np.uint8)
+        for fmt, op in [("PNG", "DecodePng"), ("BMP", "DecodeBmp"),
+                        ("JPEG", "DecodeJpeg")]:
+            buf = io.BytesIO()
+            Image.fromarray(rgb).save(buf, fmt)
+            g = tfpb.GraphDef()
+            self._str_const(g, "b", [buf.getvalue()])
+            n = g.node.add()
+            n.name, n.op = "dec", op
+            n.input.append("b")
+            n.attr["channels"].i = 3
+            ctx = _GraphCtx({nd.name: nd for nd in g.node})
+            kind, v = _convert(ctx, "dec")
+            assert kind == "const" and v.shape == (5, 7, 3)
+            if fmt != "JPEG":            # jpeg is lossy
+                np.testing.assert_array_equal(v, rgb)
+
+    def test_decode_gif_frames(self):
+        from PIL import Image
+        frames = [(np.random.rand(4, 6, 3) * 255).astype(np.uint8)
+                  for _ in range(3)]
+        buf = io.BytesIO()
+        Image.fromarray(frames[0]).save(
+            buf, "GIF", save_all=True,
+            append_images=[Image.fromarray(f) for f in frames[1:]])
+        g = tfpb.GraphDef()
+        self._str_const(g, "b", [buf.getvalue()])
+        n = g.node.add()
+        n.name, n.op = "dec", "DecodeGif"
+        n.input.append("b")
+        ctx = _GraphCtx({nd.name: nd for nd in g.node})
+        _, v = _convert(ctx, "dec")
+        assert v.shape == (3, 4, 6, 3)
+
+    def test_decode_raw_and_substr(self):
+        raw = np.arange(12, dtype="<f4").tobytes()
+        g = tfpb.GraphDef()
+        self._str_const(g, "b", [raw, raw])
+        n = g.node.add()
+        n.name, n.op = "dec", "DecodeRaw"
+        n.input.append("b")
+        n.attr["out_type"].type = tfpb.DT_FLOAT
+        n.attr["little_endian"].b = True
+        ctx = _GraphCtx({nd.name: nd for nd in g.node})
+        _, v = _convert(ctx, "dec")
+        np.testing.assert_array_equal(
+            v, np.stack([np.arange(12, dtype=np.float32)] * 2))
+
+        g = tfpb.GraphDef()
+        self._str_const(g, "s", [b"hello world", b"abcdefgh"])
+        self._np_const(g, "p", [2], tfpb.DT_INT32, np.int32)
+        self._np_const(g, "l", [3], tfpb.DT_INT32, np.int32)
+        n = g.node.add()
+        n.name, n.op = "sub", "Substr"
+        n.input.extend(["s", "p", "l"])
+        ctx = _GraphCtx({nd.name: nd for nd in g.node})
+        _, v = _convert(ctx, "sub")
+        assert list(v) == [b"llo", b"cde"]
+
+    def test_parse_example_dense(self):
+        ex1 = build_example({"feat": np.array([1.0, 2.0], np.float32),
+                             "label": np.array([3], np.int64)})
+        ex2 = build_example({"feat": np.array([4.0, 5.0], np.float32),
+                             "label": np.array([6], np.int64)})
+        g = tfpb.GraphDef()
+        self._str_const(g, "ser", [ex1, ex2])
+        self._str_const(g, "names", [])
+        self._str_const(g, "k0", [b"feat"])
+        self._str_const(g, "k1", [b"label"])
+        self._np_const(g, "d0", np.zeros(2), tfpb.DT_FLOAT, np.float32)
+        self._np_const(g, "d1", np.zeros(1), tfpb.DT_INT64, np.int64)
+        n = g.node.add()
+        n.name, n.op = "pe", "ParseExample"
+        n.input.extend(["ser", "names", "k0", "k1", "d0", "d1"])
+        n.attr["Nsparse"].i = 0
+        n.attr["Ndense"].i = 2
+        n.attr["dense_shapes"].list.shape.add().dim.add().size = 2
+        n.attr["dense_shapes"].list.shape.add().dim.add().size = 1
+        ctx = _GraphCtx({nd.name: nd for nd in g.node})
+        _, feat = _convert(ctx, "pe:0")
+        _, label = _convert(ctx, "pe:1")
+        np.testing.assert_allclose(feat, [[1, 2], [4, 5]])
+        np.testing.assert_array_equal(label, [[3], [6]])
+
+    def test_queue_dequeue_becomes_input(self):
+        g = tfpb.GraphDef()
+        q = g.node.add()
+        q.name, q.op = "q", "FIFOQueueV2"
+        dq = g.node.add()
+        dq.name, dq.op = "dq", "QueueDequeueV2"
+        dq.input.append("q")
+        dq.attr["component_types"].list.type.append(tfpb.DT_FLOAT)
+        ctx = _GraphCtx({nd.name: nd for nd in g.node})
+        kind, _ = _convert(ctx, "dq")
+        assert kind == "node" and "dq" in ctx.input_nodes
