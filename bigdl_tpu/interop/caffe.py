@@ -418,9 +418,25 @@ def _install_weights(graph, module_blobs):
             warnings.warn(f"blobs for unhandled module {type(mod).__name__}")
 
 
+def _caffe_axis(dim, spec):
+    """Ours (NHWC, possibly negative) -> caffe (NCHW) concat axis.  2-D
+    activations (batch, features) map identically; only 4-D needs the
+    NHWC->NCHW permutation."""
+    rank = len(spec) if spec else 4
+    if dim < 0:
+        dim += rank
+    if rank == 4:
+        return {0: 0, 3: 1, 1: 2, 2: 3}.get(dim, dim)
+    return dim
+
+
 def save_caffe(model, prototxt_path, model_path, input_shape):
-    """Export a Sequential of supported layers to prototxt + caffemodel
-    (reference: utils/caffe/CaffePersister.scala).
+    """Export a model to prototxt + caffemodel (reference:
+    utils/caffe/CaffePersister.scala, which walks arbitrary graphs).
+    Supports ``Sequential`` chains, ``Concat`` tower fan-outs (the
+    Inception pattern) and ``Graph`` DAGs (JoinTable -> Concat,
+    CAddTable/CMulTable/CMaxTable -> Eltwise, BatchNormalization ->
+    BatchNorm+Scale pair).
 
     ``input_shape``: NHWC; written as caffe NCHW input_dim.
     """
@@ -437,12 +453,24 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
     # into caffe's (C, H, W) flatten order
     pre_flat = [None]
     cur_spec = [tuple(input_shape)]
+    used_names = set()
 
-    def emit(mod, params, prev_top):
+    def unique(name):
+        base = name or "layer"
+        out, i = base, 1
+        while out in used_names:
+            out = f"{base}_{i}"
+            i += 1
+        used_names.add(out)
+        return out
+
+    def emit(mod, params, bottoms, substate=None):
+        if isinstance(mod, nn.Identity):
+            return bottoms[0]
         l = net.layer.add()
-        l.name = mod.name
-        l.bottom.append(prev_top)
-        top = mod.name
+        l.name = unique(mod.name)
+        l.bottom.extend(bottoms)
+        top = l.name
         l.top.append(top)
         if isinstance(mod, nn.SpatialConvolution):
             l.type = "Convolution"
@@ -522,15 +550,54 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
                 # (FlattenNCHW needs no permutation -- it is already C,H,W)
                 if isinstance(mod, nn.Flatten):
                     pre_flat[0] = (spec[1], spec[2], spec[3])
+        elif isinstance(mod, (nn.GlobalAveragePooling2D,
+                              nn.GlobalMaxPooling2D)):
+            l.type = "Pooling"
+            p = l.pooling_param
+            p.pool = (caffe_pb2.PoolingParameter.MAX
+                      if isinstance(mod, nn.GlobalMaxPooling2D)
+                      else caffe_pb2.PoolingParameter.AVE)
+            p.global_pooling = True
+        elif isinstance(mod, nn.JoinTable):
+            l.type = "Concat"
+            l.concat_param.axis = _caffe_axis(mod.dimension, cur_spec[0])
+        elif isinstance(mod, (nn.CAddTable, nn.CMulTable, nn.CMaxTable)):
+            l.type = "Eltwise"
+            l.eltwise_param.operation = {
+                nn.CAddTable: caffe_pb2.EltwiseParameter.SUM,
+                nn.CMulTable: caffe_pb2.EltwiseParameter.PROD,
+                nn.CMaxTable: caffe_pb2.EltwiseParameter.MAX,
+            }[type(mod)]
+        elif isinstance(mod, nn.SpatialBatchNormalization):
+            l.type = "BatchNorm"
+            l.batch_norm_param.eps = mod.eps
+            st = substate or {}
+            mean = np.asarray(st.get("running_mean",
+                                     np.zeros(mod.n_output, np.float32)))
+            var = np.asarray(st.get("running_var",
+                                    np.ones(mod.n_output, np.float32)))
+            for arr in (mean, var, np.ones(1, np.float32)):
+                b = l.blobs.add()
+                b.shape.dim.extend(arr.shape)
+                b.data.extend(arr.ravel().tolist())
+            if "weight" in (params or {}):   # affine part -> Scale layer
+                sl = net.layer.add()
+                sl.name = unique(l.name + "_scale")
+                sl.type = "Scale"
+                sl.bottom.append(top)
+                top = sl.name
+                sl.top.append(top)
+                sl.scale_param.bias_term = "bias" in params
+                for key in ("weight", "bias"):
+                    if key in params:
+                        arr = np.asarray(params[key])
+                        b = sl.blobs.add()
+                        b.shape.dim.extend(arr.shape)
+                        b.data.extend(arr.ravel().tolist())
         else:
             raise NotImplementedError(
                 f"caffe export: unsupported layer {type(mod).__name__}")
         return top
-
-    if not isinstance(model, nn.Sequential):
-        raise NotImplementedError("caffe export supports Sequential models")
-    top = "data"
-    params = model._params or {}
 
     def _advance_spec(child, sub, substate):
         import jax
@@ -541,18 +608,87 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
         except Exception:
             cur_spec[0] = None   # spec tracking is best-effort
 
-    def walk_seq(seq, params, state, top):
-        for i, child in enumerate(seq.modules):
-            sub = params.get(str(i), {})
-            substate = state.get(str(i), {}) if isinstance(state, dict) else {}
-            if isinstance(child, nn.Sequential):
-                top = walk_seq(child, sub, substate, top)
-            else:
-                top = emit(child, sub, top)
-                _advance_spec(child, sub, substate)
-        return top
+    def walk(child, params, state, top):
+        """Emit ``child`` fed from ``top``; returns its output top."""
+        state = state if isinstance(state, dict) else {}
+        if isinstance(child, nn.Sequential):
+            for i, sub in enumerate(child.modules):
+                top = walk(sub, (params or {}).get(str(i), {}),
+                           state.get(str(i), {}), top)
+            return top
+        if isinstance(child, nn.Concat):
+            # every tower sees the SAME input spec; snapshot and restore
+            in_spec = cur_spec[0]
+            tower_tops = []
+            for i, t in enumerate(child.modules):
+                cur_spec[0] = in_spec
+                tower_tops.append(walk(t, (params or {}).get(str(i), {}),
+                                       state.get(str(i), {}), top))
+            l = net.layer.add()
+            l.name = unique(child.name or "concat")
+            l.type = "Concat"
+            l.bottom.extend(tower_tops)
+            l.top.append(l.name)
+            l.concat_param.axis = _caffe_axis(child.dimension, in_spec)
+            cur_spec[0] = in_spec
+            _advance_spec(child, params, state)
+            return l.name
+        if isinstance(child, nn.Graph):
+            if len(child.input_nodes) > 1:
+                raise NotImplementedError(
+                    "caffe export: multi-input graphs")
+            tops, specs = {}, {}
+            for inp_node in child.input_nodes:
+                tops[id(inp_node)] = top
+                specs[id(inp_node)] = cur_spec[0]
+            for i, node in enumerate(child._topo):
+                if node.module is None:
+                    continue
+                bottoms = [tops[id(p)] for p in node.inputs]
+                mod = node.module
+                sub = (params or {}).get(str(i), {})
+                substate = state.get(str(i), {})
+                # per-node spec tracking so Flatten+Linear inside the DAG
+                # still gets its column permutation
+                cur_spec[0] = specs.get(id(node.inputs[0])) \
+                    if node.inputs else None
+                if isinstance(mod, (nn.Sequential, nn.Concat, nn.Graph)):
+                    if len(bottoms) > 1:
+                        raise NotImplementedError(
+                            "caffe export: container graph node with "
+                            "multiple parents")
+                    tops[id(node)] = walk(mod, sub, substate, bottoms[0])
+                else:
+                    tops[id(node)] = emit(mod, sub, bottoms, substate)
+                    if len(bottoms) == 1:
+                        _advance_spec(mod, sub, substate)
+                    else:
+                        # _advance_spec feeds one spec; n-ary ops need
+                        # their own propagation rules
+                        in_specs = [specs.get(id(p)) for p in node.inputs]
+                        if isinstance(mod, (nn.CAddTable, nn.CMulTable,
+                                            nn.CMaxTable)):
+                            cur_spec[0] = in_specs[0]
+                        elif (isinstance(mod, nn.JoinTable)
+                                and all(in_specs)):
+                            d = mod.dimension % len(in_specs[0])
+                            joined = list(in_specs[0])
+                            joined[d] = sum(s[d] for s in in_specs)
+                            cur_spec[0] = tuple(joined)
+                        else:
+                            cur_spec[0] = None
+                specs[id(node)] = cur_spec[0]
+            outs = [tops[id(o)] for o in child.output_nodes]
+            if len(outs) > 1:
+                raise NotImplementedError(
+                    "caffe export: multi-output graphs")
+            cur_spec[0] = specs.get(id(child.output_nodes[0]))
+            return outs[0]
+        out = emit(child, params, [top], state)
+        _advance_spec(child, params, state)
+        return out
 
-    walk_seq(model, params, model._state or {}, top)
+    walk(model, model._params or {}, model._state or {}, "data")
 
     with open(prototxt_path, "w") as f:
         # definition only (blobs stripped)
