@@ -9,6 +9,9 @@ from bigdl_tpu.dataset.dataset import (
     AbstractDataSet, LocalDataSet, TransformedDataSet, DistributedDataSet,
     array_dataset,
 )
+from bigdl_tpu.dataset.distributed import (
+    ListPartitionSource, PartitionedDataSet, PartitionedSource, RDDSource,
+    rdd_dataset)
 from bigdl_tpu.dataset import cifar, movielens, news20
 from bigdl_tpu.dataset.image_folder import ImageFolderDataSet, image_folder
 

@@ -1,0 +1,186 @@
+"""Partitioned (Spark-style) distributed ingest.
+
+Reference: dataset/DataSet.scala:167 (``DistributedDataSet`` over RDDs)
+and :243 (``CachedDistriDataSet``: per-partition cached arrays, shuffled
+within partitions, locality-aware zip via
+spark-version/2.0 ZippedPartitionsWithLocalityRDD).
+
+TPU-native translation: partitions are an *ingest-side* concept.  Each
+HOST owns the partitions congruent to its process index — the locality
+analogue: records are cached on the host that consumes them — caches
+them on first touch, reshuffles *within* its cache at epoch boundaries
+(the reference shuffles within partitions, not globally), and feeds the
+per-host staging pipeline of ``DistriOptimizer``.  No JVM in the loop: a
+pyspark RDD or DataFrame (when pyspark is installed) is just one
+``PartitionedSource``; anything implementing the three-method protocol
+(``num_partitions`` / ``partition`` / ``count``) works the same.
+"""
+
+from typing import Optional, Sequence
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+
+
+class PartitionedSource:
+    """Protocol for partitioned record sources (duck-typed; subclassing
+    is optional)."""
+
+    def num_partitions(self) -> int:
+        raise NotImplementedError
+
+    def partition(self, idx: int):
+        """Iterable of records in partition ``idx``."""
+        raise NotImplementedError
+
+    def count(self) -> int:
+        """Global record count across ALL partitions."""
+        raise NotImplementedError
+
+
+class ListPartitionSource(PartitionedSource):
+    """In-memory partitions: the protocol reference implementation (and
+    the test double for Spark-less environments)."""
+
+    def __init__(self, partitions: Sequence[Sequence]):
+        self._parts = [list(p) for p in partitions]
+
+    def num_partitions(self):
+        return len(self._parts)
+
+    def partition(self, idx):
+        return self._parts[idx]
+
+    def count(self):
+        return sum(len(p) for p in self._parts)
+
+
+class RDDSource(PartitionedSource):
+    """A pyspark RDD as a partitioned source.  Fetches one partition at a
+    time (``sc.runJob`` with a partition list — the per-partition analogue
+    of the reference's cached ``rdd.persist()``), so a host never pulls
+    the whole dataset."""
+
+    def __init__(self, rdd):
+        self.rdd = rdd
+        self._n = rdd.getNumPartitions()
+        self._count = None
+
+    def num_partitions(self):
+        return self._n
+
+    def partition(self, idx):
+        sc = self.rdd.context
+        (records,) = sc.runJob(self.rdd, lambda it: [list(it)], [idx])
+        return records
+
+    def count(self):
+        if self._count is None:
+            self._count = self.rdd.count()
+        return self._count
+
+
+def source_of(obj) -> PartitionedSource:
+    """Coerce an RDD / DataFrame / list-of-partitions / PartitionedSource
+    to a PartitionedSource."""
+    if hasattr(obj, "num_partitions") and hasattr(obj, "partition"):
+        return obj
+    if hasattr(obj, "rdd"):                      # pyspark DataFrame
+        return RDDSource(obj.rdd.map(lambda row: row))
+    if hasattr(obj, "getNumPartitions"):         # pyspark RDD
+        return RDDSource(obj)
+    if isinstance(obj, (list, tuple)) and obj \
+            and isinstance(obj[0], (list, tuple)):
+        return ListPartitionSource(obj)
+    raise TypeError(
+        f"cannot interpret {type(obj).__name__} as a partitioned source; "
+        "pass a pyspark RDD/DataFrame, a list of partitions, or any "
+        "object with num_partitions()/partition(i)/count()")
+
+
+class PartitionedDataSet(AbstractDataSet):
+    """Host-sharded dataset over a ``PartitionedSource``.
+
+    Partition ``p`` belongs to the host with ``p % num_hosts ==
+    host_index`` (defaults: ``jax.process_count()`` /
+    ``jax.process_index()``).  Partitions are cached host-side on first
+    touch; ``shuffle()`` reshuffles within the cache; ``size()`` reports
+    the GLOBAL record count so the optimizer's epoch accounting matches
+    the reference's (record_count is advanced by the global batch).
+    Compose transformers with ``>>`` as with any dataset.
+    """
+
+    def __init__(self, source, host_index: Optional[int] = None,
+                 num_hosts: Optional[int] = None, seed: int = 0):
+        import numpy as np
+
+        self.source = source_of(source)
+        if num_hosts is None or host_index is None:
+            import jax
+            num_hosts = jax.process_count() if num_hosts is None \
+                else num_hosts
+            host_index = jax.process_index() if host_index is None \
+                else host_index
+        if not 0 <= host_index < num_hosts:
+            raise ValueError(f"host_index {host_index} outside "
+                             f"[0, {num_hosts})")
+        self.host_index = host_index
+        self.num_hosts = num_hosts
+        self.my_partitions = [
+            p for p in range(self.source.num_partitions())
+            if p % num_hosts == host_index]
+        if not self.my_partitions:
+            # a host with no data would spin forever in the train
+            # iterator; repartition the source to >= num_hosts partitions
+            raise ValueError(
+                f"host {host_index}/{num_hosts} owns no partitions "
+                f"(source has {self.source.num_partitions()}); "
+                f"repartition to at least {num_hosts} partitions")
+        self._rng = np.random.default_rng(seed + host_index)
+        self._cache = None        # list of per-partition record lists
+        self._order = None        # list of per-partition index arrays
+
+    def _materialize(self):
+        import numpy as np
+
+        if self._cache is None:
+            self._cache = [list(self.source.partition(p))
+                           for p in self.my_partitions]
+            self._order = [np.arange(len(part)) for part in self._cache]
+        return self._cache
+
+    def size(self):
+        return self.source.count()
+
+    def local_size(self):
+        return sum(len(p) for p in self._materialize())
+
+    def shuffle(self):
+        """Within-partition reshuffle (reference: CachedDistriDataSet
+        shuffles each cached partition array, DataSet.scala:243)."""
+        self._materialize()
+        for i, part in enumerate(self._cache):
+            self._order[i] = self._rng.permutation(len(part))
+
+    def data(self, train: bool):
+        parts = self._materialize()
+
+        if not train:
+            def once():
+                for part, order in zip(parts, self._order):
+                    for i in order:
+                        yield part[i]
+            return once()
+
+        def forever():
+            while True:
+                # re-read the order arrays every epoch so a shuffle()
+                # between epochs takes effect (LocalDataSet idiom)
+                for part, order in zip(parts, self._order):
+                    for i in order:
+                        yield part[i]
+        return forever()
+
+
+def rdd_dataset(rdd, **kw) -> PartitionedDataSet:
+    """``DataSet.rdd`` analogue (reference: dataset/DataSet.scala:167)."""
+    return PartitionedDataSet(rdd, **kw)

@@ -256,9 +256,11 @@ def load_caffe(prototxt_path, model_path=None, input_shape=None,
     inp = Input()
     top_nodes = {}
     channels = {}
+    ranks = {}             # activation rank per top (concat-axis mapping)
     if net.input:
         top_nodes[net.input[0]] = inp
         channels[net.input[0]] = input_shape[-1]
+        ranks[net.input[0]] = len(input_shape)
     module_blobs = []      # (module, blob list) in construction order
 
     first_data = True
@@ -274,23 +276,30 @@ def load_caffe(prototxt_path, model_path=None, input_shape=None,
             if first_data and tops:
                 top_nodes[tops[0]] = inp
                 channels[tops[0]] = input_shape[-1]
+                ranks[tops[0]] = len(input_shape)
                 first_data = False
             continue
         if type_str == "Split":
             for t in tops:
                 top_nodes[t] = top_nodes[bottoms[0]]
                 channels[t] = channels[bottoms[0]]
+                ranks[t] = ranks.get(bottoms[0], 4)
             continue
         if type_str == "Concat":
             p = lpb.concat_param
             axis = int(p.axis)
-            # NCHW (0,1,2,3) -> NHWC (0,3,1,2)
-            our_axis = {0: 0, 1: 3, 2: 1, 3: 2}.get(axis, axis)
+            # NCHW (0,1,2,3) -> NHWC (0,3,1,2) -- 4-D activations only;
+            # 2-D (batch, features) axes map identically (mirrors the
+            # exporter's _caffe_axis)
+            rank = ranks.get(bottoms[0], 4)
+            our_axis = ({0: 0, 1: 3, 2: 1, 3: 2}.get(axis, axis)
+                        if rank == 4 else axis)
             mod = nn.JoinTable(our_axis)
             parents = [top_nodes[b] for b in bottoms]
             node = Node(mod, parents)
             top_nodes[tops[0]] = node
             channels[tops[0]] = sum(channels[b] for b in bottoms)
+            ranks[tops[0]] = rank
             module_blobs.append((mod, None))
             continue
         if type_str == "Eltwise":
@@ -302,6 +311,7 @@ def load_caffe(prototxt_path, model_path=None, input_shape=None,
             node = Node(mod, parents)
             top_nodes[tops[0]] = node
             channels[tops[0]] = channels[bottoms[0]]
+            ranks[tops[0]] = ranks.get(bottoms[0], 4)
             module_blobs.append((mod, None))
             continue
 
@@ -311,8 +321,15 @@ def load_caffe(prototxt_path, model_path=None, input_shape=None,
                                   customized_layers or {})
         mod.name = name        # caffe layer name (copy_weights matches on it)
         node = Node(mod, [top_nodes[bottom]])
-        top_nodes[tops[0] if tops else name] = node
-        channels[tops[0] if tops else name] = cout
+        out_top = tops[0] if tops else name
+        top_nodes[out_top] = node
+        channels[out_top] = cout
+        if (type_str in ("InnerProduct", "Flatten")
+                or (type_str == "Pooling"
+                    and lpb.pooling_param.global_pooling)):
+            ranks[out_top] = 2          # these collapse to (batch, features)
+        else:
+            ranks[out_top] = ranks.get(bottom, 4)
         module_blobs.append((mod, weights.get(name)))
 
     # terminal nodes = tops never consumed as bottoms
@@ -466,6 +483,10 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
 
     def emit(mod, params, bottoms, substate=None):
         if isinstance(mod, nn.Identity):
+            if len(bottoms) > 1:
+                raise NotImplementedError(
+                    "caffe export: multi-input Identity (tuple "
+                    "pass-through has no caffe layer)")
             return bottoms[0]
         l = net.layer.add()
         l.name = unique(mod.name)
@@ -624,12 +645,14 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
                 cur_spec[0] = in_spec
                 tower_tops.append(walk(t, (params or {}).get(str(i), {}),
                                        state.get(str(i), {}), top))
+            tower_out_spec = cur_spec[0]   # what is actually concatenated
             l = net.layer.add()
             l.name = unique(child.name or "concat")
             l.type = "Concat"
             l.bottom.extend(tower_tops)
             l.top.append(l.name)
-            l.concat_param.axis = _caffe_axis(child.dimension, in_spec)
+            l.concat_param.axis = _caffe_axis(child.dimension,
+                                              tower_out_spec or in_spec)
             cur_spec[0] = in_spec
             _advance_spec(child, params, state)
             return l.name
@@ -652,6 +675,21 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
                 # still gets its column permutation
                 cur_spec[0] = specs.get(id(node.inputs[0])) \
                     if node.inputs else None
+                if isinstance(mod, nn.Linear) and node.inputs:
+                    # pre_flat is consumed-once (sequential idiom); a
+                    # Flatten node shared by several Linear heads must
+                    # re-derive it per head from the Flatten's own input
+                    parent = node.inputs[0]
+                    pmod = getattr(parent, "module", None)
+                    gp_spec = (specs.get(id(parent.inputs[0]))
+                               if parent.inputs else None)
+                    if (pmod is not None and gp_spec is not None
+                            and len(gp_spec) == 4
+                            and (isinstance(pmod, nn.Flatten)
+                                 or type(pmod).__name__ == "FlattenNCHW")):
+                        pre_flat[0] = (gp_spec[1:]
+                                       if isinstance(pmod, nn.Flatten)
+                                       else None)
                 if isinstance(mod, (nn.Sequential, nn.Concat, nn.Graph)):
                     if len(bottoms) > 1:
                         raise NotImplementedError(

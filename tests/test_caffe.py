@@ -277,3 +277,30 @@ class TestGraphExport:
         back.evaluate()
         theirs = np.asarray(back.forward(x))
         np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+    def test_2d_concat_roundtrip(self, tmp_path):
+        """JoinTable over 2-D activations must map axes symmetrically on
+        both sides (round-4 review finding: the loader applied the 4-D
+        NCHW map unconditionally)."""
+        from bigdl_tpu.nn.graph import Graph, Input, Node
+
+        RNG.set_seed(11)
+        inp = Input()
+        f = Node(nn.Flatten(), [inp])
+        l1 = Node(nn.Linear(12, 3), [f])
+        l2 = Node(nn.Linear(12, 5), [f])
+        join = Node(nn.JoinTable(1), [l1, l2])
+        g = Graph([inp], [join])
+        g.build(jax.ShapeDtypeStruct((2, 2, 2, 3), jnp.float32))
+        g.evaluate()
+        x = jnp.asarray(
+            np.random.default_rng(4).standard_normal((2, 2, 2, 3)),
+            jnp.float32)
+        ours = np.asarray(g.forward(x))
+        pt = str(tmp_path / "j.prototxt")
+        cm = str(tmp_path / "j.caffemodel")
+        save_caffe(g, pt, cm, (2, 2, 2, 3))
+        back = load_caffe(pt, cm)
+        back.evaluate()
+        theirs = np.asarray(back.forward(x))
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,117 @@
+"""Spark-style partitioned ingest (VERDICT r3 ask #4).
+
+Reference: dataset/DataSet.scala:167 DistributedDataSet over RDDs with
+per-partition caching (:243 CachedDistriDataSet).  Here any
+partition-iterator source feeds per-host shards into the DistriOptimizer
+staging pipeline; a pyspark RDD (optional dependency, not installed in
+this image) is just one source type.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.dataset import (ListPartitionSource, PartitionedDataSet,
+                               SampleToMiniBatch, Sample)
+from bigdl_tpu.optim import DistriOptimizer, Trigger
+from bigdl_tpu.utils.engine import Engine
+
+
+def _mnist_partitions(n=128, parts=4):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 28, 28, 1)).astype(np.float32)
+    y = (rng.integers(0, 10, n)).astype(np.int32)
+    samples = [Sample(xi, yi) for xi, yi in zip(x, y)]
+    k = n // parts
+    return ListPartitionSource(
+        [samples[i * k:(i + 1) * k] for i in range(parts)])
+
+
+class TestPartitionedDataSet:
+    def test_host_partition_assignment(self):
+        src = ListPartitionSource([[1, 2], [3, 4], [5, 6], [7, 8]])
+        d0 = PartitionedDataSet(src, host_index=0, num_hosts=2)
+        d1 = PartitionedDataSet(src, host_index=1, num_hosts=2)
+        assert d0.my_partitions == [0, 2]
+        assert d1.my_partitions == [1, 3]
+        assert sorted(d0.data(train=False)) == [1, 2, 5, 6]
+        assert sorted(d1.data(train=False)) == [3, 4, 7, 8]
+        # global size on every host (epoch accounting uses the global
+        # batch, like the reference)
+        assert d0.size() == d1.size() == 8
+        assert d0.local_size() == d1.local_size() == 4
+
+    def test_lazy_partition_fetch(self):
+        fetched = []
+
+        class Spy(ListPartitionSource):
+            def partition(self, idx):
+                fetched.append(idx)
+                return super().partition(idx)
+
+        src = Spy([[1], [2], [3], [4]])
+        ds = PartitionedDataSet(src, host_index=1, num_hosts=2)
+        assert fetched == []              # nothing pulled at construction
+        list(ds.data(train=False))
+        assert fetched == [1, 3]          # only this host's partitions
+
+    def test_shuffle_is_within_partition(self):
+        src = ListPartitionSource([list(range(10)),
+                                   list(range(10, 20))])
+        ds = PartitionedDataSet(src, host_index=0, num_hosts=1, seed=1)
+        ds.shuffle()
+        out = list(ds.data(train=False))
+        # reference shuffles per cached partition: records stay inside
+        # their partition's span
+        assert sorted(out[:10]) == list(range(10))
+        assert sorted(out[10:]) == list(range(10, 20))
+        assert out != list(range(20))     # but the order did change
+
+    def test_train_iterator_cycles_and_reshuffles(self):
+        src = ListPartitionSource([list(range(6))])
+        ds = PartitionedDataSet(src, host_index=0, num_hosts=1, seed=3)
+        it = ds.data(train=True)
+        first = [next(it) for _ in range(6)]
+        ds.shuffle()
+        second = [next(it) for _ in range(6)]
+        assert sorted(first) == sorted(second) == list(range(6))
+        assert first != second            # epoch-boundary reshuffle seen
+
+    def test_source_coercion_errors(self):
+        with pytest.raises(TypeError, match="partitioned source"):
+            PartitionedDataSet(42, host_index=0, num_hosts=1)
+
+
+class TestTrainingFromPartitions:
+    def test_lenet_trains_through_distri_optimizer(self):
+        """The VERDICT 'done' bar: LeNet learns from a partitioned source
+        through DistriOptimizer on the 8-device mesh."""
+        assert jax.device_count() == 8
+        from bigdl_tpu.models.lenet import LeNet5
+
+        src = _mnist_partitions(n=256, parts=8)
+        train = PartitionedDataSet(src, host_index=0, num_hosts=1) \
+            >> SampleToMiniBatch(64)
+        model = LeNet5()
+        opt = DistriOptimizer(model, train, nn.ClassNLLCriterion(),
+                              optim.SGD(learning_rate=0.1, momentum=0.9,
+                                        dampening=0.0),
+                              mesh=Engine.build_mesh())
+        opt.set_end_when(Trigger.max_epoch(3))
+        opt.optimize()
+        losses = opt.driver_state["loss"]
+        assert np.isfinite(losses)
+        # same step count as the equivalent LocalDataSet run under
+        # max_epoch(3) (established trigger semantics)
+        assert opt.driver_state["neval"] == 13
+
+    def test_host_with_no_partitions_rejected(self):
+        """More hosts than partitions would livelock the train iterator;
+        the constructor rejects it (round-4 review finding)."""
+        src = ListPartitionSource([[1], [2]])
+        with pytest.raises(ValueError, match="owns no partitions"):
+            PartitionedDataSet(src, host_index=3, num_hosts=4)
