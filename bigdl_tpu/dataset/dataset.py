@@ -99,6 +99,11 @@ class DistributedDataSet(LocalDataSet):
     def size(self):
         return self._global_size
 
+    def local_size(self):
+        """Host-sharded marker + per-host record count (multi-host
+        DistriOptimizer requires datasets exposing this)."""
+        return len(self._data)
+
 
 def array_dataset(features: np.ndarray, labels: Optional[np.ndarray] = None,
                   **kw) -> LocalDataSet:

@@ -292,6 +292,8 @@ def load_caffe(prototxt_path, model_path=None, input_shape=None,
             # 2-D (batch, features) axes map identically (mirrors the
             # exporter's _caffe_axis)
             rank = ranks.get(bottoms[0], 4)
+            if axis < 0:               # caffe allows negative axes
+                axis += rank
             our_axis = ({0: 0, 1: 3, 2: 1, 3: 2}.get(axis, axis)
                         if rank == 4 else axis)
             mod = nn.JoinTable(our_axis)

@@ -201,6 +201,21 @@ class DistriOptimizer(BaseOptimizer):
 
     def _optimize_impl(self):
         self._reshuffle_pending = False   # no stale flag from a prior run
+        if jax.process_count() > 1:
+            # record accounting multiplies the local batch by the process
+            # count, which is only correct for host-sharded datasets whose
+            # size() reports the GLOBAL count (PartitionedDataSet /
+            # DistributedDataSet expose local_size as the marker)
+            base = self.dataset
+            while hasattr(base, "base"):
+                base = base.base
+            if not hasattr(base, "local_size"):
+                raise ValueError(
+                    "multi-host DistriOptimizer requires a host-sharded "
+                    "dataset (PartitionedDataSet or DistributedDataSet) "
+                    "whose size() is the GLOBAL record count; got "
+                    f"{type(base).__name__}, whose per-host size would "
+                    "corrupt epoch accounting")
         n_dev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names
                              if a == self.axis]))
         train_iter = self.dataset.data(train=True)
@@ -286,8 +301,12 @@ class DistriOptimizer(BaseOptimizer):
             params_flat, mstate, opt_state, loss = step(
                 params_flat, mstate, opt_state, x, target, RNG.next_key())
             # host/device pipeline: stage the NEXT batch while the devices
-            # run this step; float(loss) below is the sync point
-            n = batch.size()
+            # run this step; float(loss) below is the sync point.
+            # _shard_batch treats each host's minibatch as process-LOCAL
+            # (jax.make_array_from_process_local_data), so the records
+            # consumed globally per step = local batch x process count
+            # (reference driverState counts global records)
+            n = batch.size() * jax.process_count()
             next_batch, train_iter = self._stage_next_batch(
                 train_iter, state, n, epoch_size)
             loss = float(loss)
