@@ -220,10 +220,12 @@ class DistriOptimizer(BaseOptimizer):
                              if a == self.axis]))
         train_iter = self.dataset.data(train=True)
         first_batch = next(train_iter)
-        if first_batch.size() % n_dev != 0:
+        global_batch = first_batch.size() * jax.process_count()
+        if global_batch % n_dev != 0:
             raise ValueError(
-                f"global batch {first_batch.size()} not divisible by "
-                f"{n_dev} devices on axis '{self.axis}'")
+                f"global batch {global_batch} (local "
+                f"{first_batch.size()} x {jax.process_count()} processes) "
+                f"not divisible by {n_dev} devices on axis '{self.axis}'")
 
         params_tree, mstate = self._init_model(first_batch)
         flat_space = FlatParamSpace(params_tree, n_dev)

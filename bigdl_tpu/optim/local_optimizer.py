@@ -261,6 +261,21 @@ class BaseOptimizer:
         x, _ = _device_batch(example_batch)
         if not self.model.is_built():
             self.model.build(spec_of(x))
+        # engine seam (reference: DistriOptimizer calls
+        # ConversionUtils.convert before training): BIGDL_ENGINE_TYPE=ir
+        # routes the model through the IR lowering, ir-quantized through
+        # the int8 engine; the default xla engine is the identity
+        from bigdl_tpu.utils.config import engine_type
+        engine = engine_type()
+        if engine not in ("xla", "direct"):
+            if "quantized" in engine:
+                raise ValueError(
+                    "the int8 engine is inference-only (reference: "
+                    "nn.quantized.Quantization quantizes for serving); "
+                    "train with BIGDL_ENGINE_TYPE=xla or ir, then "
+                    "convert(model, engine='ir-quantized') for serving")
+            from bigdl_tpu.utils.intermediate import convert
+            self.model = convert(self.model, input_spec=spec_of(x))
         return self.model.parameters()[0], self.model.state()
 
     def _checkpoint(self, params, mstate, opt_state):

@@ -22,8 +22,11 @@ def _get(name, default, cast):
 
 
 def engine_type():
-    """'xla' is the only compute engine; kept for reference parity
-    (bigdl.engineType selects MklBlas/MklDnn upstream)."""
+    """Engine selector (reference: bigdl.engineType picks MklBlas/MklDnn;
+    ConversionUtils.convert routes through the IR accordingly).  Values:
+    'xla' (default -- direct modules ARE the xla engine), 'ir' (lift to
+    IR and lower back through the xla mapping: exercises the engine
+    seam), 'ir-quantized' (IR + int8 MXU engine)."""
     return os.environ.get("BIGDL_ENGINE_TYPE", "xla")
 
 

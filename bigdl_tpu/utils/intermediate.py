@@ -153,24 +153,19 @@ def to_ir(module, prefix="") -> IRGraph:
     return IRGraph(elements, ["input"], [out])
 
 
-def ir_to_module(graph: IRGraph):
-    """IRGraph -> module tree (reference: IRToBlas / IRToDnn mappers)."""
-    import bigdl_tpu.nn as nn
+class Lowering:
+    """One engine's IR -> module mapping (reference: the IRToBlas/IRToDnn
+    mapper pair selected inside IRConverter.scala:61-107).  Subclass and
+    override :meth:`module_of` entries to plug a new engine in at exactly
+    this seam — the survey's "the TPU build adds a third engine at
+    exactly these seams" note."""
 
-    producers = {e.name: e for e in graph.elements}
-    consumers: Dict[str, List[IRElement]] = {}
-    for e in graph.elements:
-        for i in e.inputs:
-            consumers.setdefault(i, []).append(e)
+    name = "xla"
 
-    def build_node(e: IRElement):
+    def module_of(self, e: IRElement, nn):
+        """IRElement -> concrete module (leaf ops only)."""
         cls = e.op
         a = e.attrs
-        if cls == "Concat":
-            cat = nn.Concat(a.get("dimension", -1))
-            for src in e.inputs:
-                cat.add(build_chain(src, stop=a["_input"]))
-            return cat
         if cls == "Linear":
             return nn.Linear(a.get("input_size"), a.get("output_size"),
                              with_bias=a.get("with_bias", True))
@@ -207,36 +202,151 @@ def ir_to_module(graph: IRGraph):
             return nn.JoinTable(a["dimension"])
         if hasattr(nn, cls):
             return getattr(nn, cls)()          # parameter-free layer
-        raise NotImplementedError(f"IR op {cls}")
+        raise NotImplementedError(f"IR op {cls} ({self.name} engine)")
 
-    def build_chain(output_name, stop="input"):
-        """Chain ending at output_name, walking back to ``stop`` ->
-        Sequential.  Concat nodes jump back through their recorded feed."""
-        chain = []
-        cur = output_name
-        while cur != stop and cur in producers:
-            e = producers[cur]
-            chain.append(e)
-            cur = e.attrs["_input"] if e.op == "Concat" else e.inputs[0]
-        chain.reverse()
-        seq = nn.Sequential()
-        for e in chain:
-            seq.add(build_node(e))
-        return seq
+    def finalize(self, module):
+        """Post-lowering rewrite hook (e.g. quantization)."""
+        return module
 
-    assert len(graph.output_names) == 1, "single-output IR graphs only"
-    if graph.dag:
-        from bigdl_tpu.nn.graph import Input, Node
+    def lower(self, graph: IRGraph):
+        """IRGraph -> module tree (reference: IRConverter.toDnnGraph /
+        toBlasGraph)."""
+        import bigdl_tpu.nn as nn
 
-        node_of = {}
-        for name in graph.input_names:
-            node_of[name] = Input()
-        for e in graph.elements:            # already topologically ordered
+        producers = {e.name: e for e in graph.elements}
+
+        def build_node(e: IRElement):
             if e.op == "Concat":
-                mod = nn.JoinTable(e.attrs.get("dimension", -1))
-            else:
-                mod = build_node(e)
-            node_of[e.name] = Node(mod, [node_of[p] for p in e.inputs])
-        return nn.Graph([node_of[n] for n in graph.input_names],
-                        [node_of[graph.output_names[0]]])
-    return build_chain(graph.output_names[0])
+                cat = nn.Concat(e.attrs.get("dimension", -1))
+                for src in e.inputs:
+                    cat.add(build_chain(src, stop=e.attrs["_input"]))
+                return cat
+            return self.module_of(e, nn)
+
+        def build_chain(output_name, stop="input"):
+            """Chain ending at output_name, walking back to ``stop`` ->
+            Sequential.  Concat nodes jump back through their feed."""
+            chain = []
+            cur = output_name
+            while cur != stop and cur in producers:
+                e = producers[cur]
+                chain.append(e)
+                cur = e.attrs["_input"] if e.op == "Concat" \
+                    else e.inputs[0]
+            chain.reverse()
+            seq = nn.Sequential()
+            for e in chain:
+                seq.add(build_node(e))
+            return seq
+
+        assert len(graph.output_names) == 1, "single-output IR graphs only"
+        if graph.dag:
+            from bigdl_tpu.nn.graph import Input, Node
+
+            node_of = {}
+            for name in graph.input_names:
+                node_of[name] = Input()
+            for e in graph.elements:        # already topologically ordered
+                if e.op == "Concat":
+                    mod = nn.JoinTable(e.attrs.get("dimension", -1))
+                else:
+                    mod = build_node(e)
+                node_of[e.name] = Node(mod, [node_of[p] for p in e.inputs])
+            out = nn.Graph([node_of[n] for n in graph.input_names],
+                           [node_of[graph.output_names[0]]])
+        else:
+            out = build_chain(graph.output_names[0])
+        return self.finalize(out)
+
+
+class QuantizedLowering(Lowering):
+    """Int8 engine: float lowering + the Quantizer rewrite (reference:
+    ConversionUtils.getInt8ModelIfNeeded -> nn.quantized.Quantization;
+    here nn/quantized.py's MXU int8 modules)."""
+
+    name = "quantized"
+
+    def finalize(self, module):
+        # the rewrite happens after weights are carried over -- convert()
+        # calls finalize_built on the BUILT module instead
+        return module
+
+    def finalize_built(self, module):
+        from bigdl_tpu.nn.quantized import quantize
+        return quantize(module)
+
+
+ENGINES: Dict[str, Lowering] = {
+    "xla": Lowering(),
+    "quantized": QuantizedLowering(),
+}
+
+
+def ir_to_module(graph: IRGraph, engine: str = "xla"):
+    """IRGraph -> module tree through the selected engine's lowering
+    (reference: IRToBlas / IRToDnn mappers)."""
+    return ENGINES[engine].lower(graph)
+
+
+def convert(model, engine: Optional[str] = None, input_spec=None):
+    """``ConversionUtils.convert`` analogue (reference:
+    utils/intermediate/ConversionUtils.scala:37-50): when the configured
+    engine is not the direct one, lift the model to IR, lower it through
+    the engine's mapping, and carry the built parameters over.  The
+    training loops call this at model-init time, so setting
+    ``BIGDL_ENGINE_TYPE=ir`` routes training through the IR seam and
+    ``BIGDL_ENGINE_TYPE=ir-quantized`` through the int8 engine.
+    """
+    from bigdl_tpu.utils.config import engine_type
+
+    engine = engine or engine_type()
+    if engine in ("xla", "direct", "", None):
+        return model                       # the modules ARE the xla engine
+    if engine == "ir":
+        lowering_name = "xla"
+    elif engine.startswith("ir-"):
+        lowering_name = engine[3:]
+    else:
+        raise ValueError(f"unknown engine type {engine!r} "
+                         f"(expected xla | ir | ir-quantized)")
+    if lowering_name not in ENGINES:
+        raise ValueError(f"unknown IR engine {lowering_name!r} "
+                         f"(registered: {sorted(ENGINES)})")
+    lowering = ENGINES[lowering_name]
+
+    import jax
+
+    ir = to_ir(model)
+    new = lowering.lower(ir)
+    if model.is_built():
+        spec = input_spec if input_spec is not None \
+            else getattr(model, "_build_spec", None)
+        if spec is None:
+            raise ValueError("converting a built model needs input_spec")
+        new.build(spec)
+        old_p = jax.tree.leaves(model._params)
+        new_p, treedef = jax.tree.flatten(new._params)
+        if len(old_p) != len(new_p) or any(
+                a.shape != b.shape for a, b in zip(old_p, new_p)):
+            raise ValueError(
+                "IR conversion changed the parameter structure; cannot "
+                "carry weights over")
+        new._params = jax.tree.unflatten(treedef, old_p)
+        old_s = jax.tree.leaves(model._state)
+        new_s, sdef = jax.tree.flatten(new._state)
+        if len(old_s) != len(new_s) or any(
+                getattr(a, "shape", None) != getattr(b, "shape", None)
+                for a, b in zip(old_s, new_s)):
+            raise ValueError(
+                "IR conversion changed the state structure; cannot carry "
+                "state (e.g. BN running stats) over")
+        new._state = jax.tree.unflatten(sdef, old_s)
+        if hasattr(lowering, "finalize_built"):
+            new = lowering.finalize_built(new)
+    elif hasattr(lowering, "finalize_built"):
+        raise ValueError(
+            f"the {lowering.name!r} engine rewrites a BUILT model "
+            "(weights are required); build the model first")
+    if not model.train_mode:
+        new.evaluate()
+    return new
