@@ -1,0 +1,157 @@
+"""One-shot on-chip evidence run (execute while the tunnel is alive).
+
+Produces, in order of increasing tunnel risk:
+1. chained-dispatch ResNet-50 step timing (bench.py's authoritative
+   method) at PROF_BATCH,
+2. a jax.profiler trace captured around a second chained window, saved
+   under docs/traces/ -- the INDEPENDENT witness for the
+   chained-value-fetch methodology (VERDICT r3 weak #3): the device-busy
+   duration parsed from the xplane must agree with the chained wall time,
+3. the HLO op histogram of the compiled step (fusion evidence).
+
+Each phase prints one JSON line; a crash mid-phase leaves the earlier
+lines.  measure_scan.py (fori_loop witness) is NOT run here -- its
+server-side compile wedged the tunnel in round 3; run it manually last.
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _device_busy_from_xplane(trace_dir):
+    """Sum of top-level event durations on the device plane (best-effort;
+    returns None when the plugin protos or a device plane are absent)."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:
+        try:
+            from tensorflow.core.profiler.protobuf import xplane_pb2
+        except Exception:
+            return None
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    best = None
+    for path in paths:
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            name = plane.name.lower()
+            if not ("tpu" in name or "device" in name or "xla" in name):
+                continue
+            lo, hi, busy = None, None, 0
+            for line in plane.lines:
+                for ev in line.events:
+                    start = ev.offset_ps
+                    end = ev.offset_ps + ev.duration_ps
+                    lo = start if lo is None else min(lo, start)
+                    hi = end if hi is None else max(hi, end)
+                    busy += ev.duration_ps
+            if hi is not None:
+                span = (hi - lo) / 1e12
+                rec = {"plane": plane.name, "span_sec": span,
+                       "busy_event_sec": busy / 1e12}
+                if best is None or span > best["span_sec"]:
+                    best = rec
+    return best
+
+
+def main():
+    from bigdl_tpu.utils.config import honor_env_platforms
+    honor_env_platforms()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import optim
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim.train_step import make_train_step
+
+    batch = int(os.environ.get("PROF_BATCH", "128"))
+    steps = int(os.environ.get("EV_STEPS", "16"))
+    dev = jax.devices()[0]
+    print(json.dumps({"phase": "init", "platform": dev.platform,
+                      "device_kind": getattr(dev, "device_kind", "")}),
+          flush=True)
+
+    model = ResNet(depth=50, class_num=1000)
+    model.build(jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16))
+    params, mstate = model.parameters()[0], model.state()
+    method = optim.SGD(learning_rate=0.02, momentum=0.9, dampening=0.0,
+                       weight_decay=1e-4)
+    opt_state = method.init_state(params)
+    step = jax.jit(
+        make_train_step(model, CrossEntropyCriterion(), method,
+                        compute_dtype=jnp.bfloat16),
+        donate_argnums=(0, 1, 2))
+    rng = np.random.default_rng(0)
+    xs = [jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),
+                      dtype=jnp.bfloat16) for _ in range(4)]
+    ts = [jnp.asarray(rng.integers(0, 1000, batch), dtype=jnp.int32)
+          for _ in range(4)]
+    key = jax.random.key(0)
+    t0 = time.perf_counter()
+    compiled = step.lower(params, mstate, opt_state, xs[0], ts[0],
+                          key).compile()
+    flops = float(compiled.cost_analysis().get("flops", 0.0))
+    print(json.dumps({"phase": "compile",
+                      "sec": round(time.perf_counter() - t0, 1),
+                      "flops_per_step": flops}), flush=True)
+
+    for _ in range(3):   # warmup
+        params, mstate, opt_state, loss = compiled(
+            params, mstate, opt_state, xs[0], ts[0], key)
+    float(loss)
+
+    # phase 1: chained-dispatch timing (the bench.py method)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, mstate, opt_state, loss = compiled(
+            params, mstate, opt_state, xs[i % 4], ts[i % 4], key)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    sec_per_step = dt / steps
+    peak = 197e12 if dev.platform == "tpu" else 1e12
+    print(json.dumps({"phase": "chained", "steps": steps,
+                      "sec_per_step": round(sec_per_step, 5),
+                      "imgs_per_sec": round(batch / sec_per_step, 1),
+                      "mfu": round(flops / sec_per_step / peak, 4),
+                      "loss": final}), flush=True)
+
+    # phase 2: the same window under a profiler trace (independent witness)
+    trace_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "traces",
+        f"r4_{dev.platform}_b{batch}")
+    os.makedirs(trace_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir):
+        for i in range(steps):
+            params, mstate, opt_state, loss = compiled(
+                params, mstate, opt_state, xs[i % 4], ts[i % 4], key)
+        float(loss)
+    dt_traced = time.perf_counter() - t0
+    plane = _device_busy_from_xplane(trace_dir)
+    print(json.dumps({"phase": "traced", "steps": steps,
+                      "wall_sec": round(dt_traced, 3),
+                      "wall_sec_per_step": round(dt_traced / steps, 5),
+                      "trace_dir": trace_dir,
+                      "device_plane": plane}), flush=True)
+
+    # phase 3: HLO fusion evidence
+    txt = compiled.as_text()
+    print(json.dumps({"phase": "hlo",
+                      "fusions": txt.count(" fusion("),
+                      "convolutions": txt.count(" convolution("),
+                      "transposes": txt.count(" transpose("),
+                      "converts": txt.count(" convert(")}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
