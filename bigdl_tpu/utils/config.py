@@ -55,21 +55,10 @@ def log_file():
 
 
 def redirect_spark_info_logs(path=None):
-    """LoggerFilter.redirectSparkInfoLogs equivalent
-    (reference: utils/LoggerFilter.scala:34,91): route INFO records of the
-    framework's loggers to a file, keeping the console at WARNING."""
-    import logging
-    path = path or log_file() or "bigdl_tpu.log"
-    root = logging.getLogger("bigdl_tpu")
-    file_handler = logging.FileHandler(path)
-    file_handler.setLevel(logging.INFO)
-    file_handler.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname)s %(name)s - %(message)s"))
-    root.addHandler(file_handler)
-    root.setLevel(logging.INFO)
-    for h in logging.getLogger().handlers:
-        h.setLevel(max(h.level, logging.WARNING))
-    return path
+    """LoggerFilter.redirectSparkInfoLogs equivalent — delegating alias;
+    the implementation lives in :mod:`bigdl_tpu.utils.logger_filter`."""
+    from bigdl_tpu.utils.logger_filter import redirect_spark_info_logs
+    return redirect_spark_info_logs(log_file=path or log_file())
 
 
 def honor_env_platforms():

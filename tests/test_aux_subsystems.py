@@ -106,12 +106,15 @@ class TestConfigTier:
 
     def test_logger_filter(self, tmp_path):
         import logging
-        from bigdl_tpu.utils import config
+        from bigdl_tpu.utils import config, logger_filter
         path = config.redirect_spark_info_logs(str(tmp_path / "bigdl.log"))
-        logging.getLogger("bigdl_tpu.test").info("hello from the filter")
-        for h in logging.getLogger("bigdl_tpu").handlers:
-            h.flush()
-        assert "hello from the filter" in open(path).read()
+        try:
+            logging.getLogger("bigdl_tpu.test").info("hello from the filter")
+            for h in logging.getLogger("bigdl_tpu").handlers:
+                h.flush()
+            assert "hello from the filter" in open(path).read()
+        finally:
+            logger_filter.restore()
 
 
 class TestFailureRetry:
@@ -287,3 +290,36 @@ class TestEngineSeam:
     def test_quantized_engine_rejected_for_training(self, monkeypatch):
         with pytest.raises(ValueError, match="inference-only"):
             self._train(monkeypatch, "ir-quantized")
+
+
+class TestLoggerFilter:
+    """LoggerFilter analogue (reference: utils/LoggerFilter.scala
+    redirects Spark/breeze/akka logs to bigdl.log; here jax/absl)."""
+
+    def test_redirects_noisy_logs_to_file(self, tmp_path, monkeypatch):
+        import logging
+        from bigdl_tpu.utils import logger_filter
+
+        target = str(tmp_path / "bigdl.log")
+        monkeypatch.setenv("BIGDL_LOGGER_FILTER_LOGFILE", target)
+        try:
+            assert logger_filter.redirect_spark_info_logs() == target
+            logging.getLogger("jax").info("noisy backend message")
+            logging.getLogger("bigdl_tpu.optim").info("progress stays")
+            with open(target) as f:
+                content = f.read()
+            assert "noisy backend message" in content
+            # framework progress is copied to the file AND keeps its
+            # console propagation (reference logs progress to both)
+            assert "progress stays" in content
+            assert logging.getLogger("bigdl_tpu").propagate
+            assert not logging.getLogger("jax").propagate
+        finally:
+            logger_filter.restore()
+        assert logging.getLogger("jax").propagate
+
+    def test_disable_flag(self, monkeypatch):
+        from bigdl_tpu.utils import logger_filter
+
+        monkeypatch.setenv("BIGDL_LOGGER_FILTER_DISABLE", "true")
+        assert logger_filter.redirect_spark_info_logs() is None

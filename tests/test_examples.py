@@ -64,3 +64,12 @@ class TestExamples:
         from bigdl_tpu.models import run
 
         run.main(["lenet-train", "--maxIteration", "2"])
+
+    def test_distributed_ingest(self, monkeypatch):
+        import math
+        # the example sets BIGDL_ENGINE_TYPE; keep it out of the session
+        monkeypatch.setenv("BIGDL_ENGINE_TYPE", "xla")
+        loss = _run("distributed_ingest",
+                    argv=["--records", "64", "--batch", "32",
+                          "--epochs", "1", "--engine", "ir"])
+        assert math.isfinite(loss)
