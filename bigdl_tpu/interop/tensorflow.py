@@ -2061,8 +2061,12 @@ def _install(graph, module_blobs):
 
 def save_tf(model, path, input_shape, input_name="input",
             output_name="output"):
-    """Export a built Sequential to a frozen GraphDef
-    (reference: utils/tf/TensorflowSaver.scala).
+    """Export a built model to a frozen GraphDef (reference:
+    utils/tf/TensorflowSaver.scala, which walks arbitrary graphs).
+    Supports ``Sequential`` chains, ``Concat`` towers (-> ConcatV2) and
+    ``Graph`` DAGs (JoinTable -> ConcatV2, CAddTable -> AddN,
+    CMulTable/CMaxTable -> Mul/Maximum chains, BatchNormalization ->
+    FusedBatchNorm with frozen statistics).
     """
     import bigdl_tpu.nn as nn
 
@@ -2097,11 +2101,76 @@ def save_tf(model, path, input_shape, input_name="input",
         counter[0] += 1
         return f"{prefix}_{counter[0]}"
 
-    def emit(mod, params, cur):
+    def emit(mod, params, cur, state=None):
+        state = state if isinstance(state, dict) else {}
         if isinstance(mod, nn.Sequential):
             for i, ch in enumerate(mod.modules):
-                cur = emit(ch, params.get(str(i), {}), cur)
+                cur = emit(ch, params.get(str(i), {}), cur,
+                           state.get(str(i), {}))
             return cur
+        if isinstance(mod, nn.Identity):
+            return cur
+        if isinstance(mod, nn.Concat):
+            tower_tops = [emit(t, params.get(str(i), {}), cur,
+                               state.get(str(i), {}))
+                          for i, t in enumerate(mod.modules)]
+            return emit_concat(tower_tops, mod.dimension)
+        if isinstance(mod, nn.SpatialBatchNormalization):
+            scale = np.asarray(params.get(
+                "weight", np.ones(mod.n_output, np.float32)))
+            offset = np.asarray(params.get(
+                "bias", np.zeros(mod.n_output, np.float32)))
+            mean = np.asarray(state.get(
+                "running_mean", np.zeros(mod.n_output, np.float32)))
+            var = np.asarray(state.get(
+                "running_var", np.ones(mod.n_output, np.float32)))
+            n = g.node.add()
+            n.name = fresh("fusedbatchnorm")
+            n.op = "FusedBatchNorm"
+            n.input.extend([cur, add_const(fresh("scale"), scale),
+                            add_const(fresh("offset"), offset),
+                            add_const(fresh("mean"), mean),
+                            add_const(fresh("variance"), var)])
+            n.attr["T"].type = tfpb.DT_FLOAT
+            n.attr["epsilon"].f = mod.eps
+            n.attr["is_training"].b = False
+            n.attr["data_format"].s = b"NHWC"
+            return n.name
+        if isinstance(mod, nn.SpatialCrossMapLRN):
+            # ours (caffe form): (k + alpha/size * sum)^beta over `size`
+            # channels; TF: (bias + tf_alpha * sum)^beta over 2r+1 --
+            # only ODD windows are TF-representable
+            if mod.size % 2 == 0:
+                raise NotImplementedError(
+                    f"tf export: LRN window {mod.size} is even; TF LRN "
+                    f"windows are 2*depth_radius+1 (odd only)")
+            if getattr(mod, "data_format", "NHWC") != "NHWC":
+                raise NotImplementedError("tf export: NCHW LRN")
+            n = g.node.add()
+            n.name = fresh("lrn")
+            n.op = "LRN"
+            n.input.append(cur)
+            n.attr["T"].type = tfpb.DT_FLOAT
+            n.attr["depth_radius"].i = (mod.size - 1) // 2
+            n.attr["bias"].f = mod.k
+            n.attr["alpha"].f = mod.alpha / mod.size
+            n.attr["beta"].f = mod.beta
+            return n.name
+        if isinstance(mod, (nn.GlobalAveragePooling2D,
+                            nn.GlobalMaxPooling2D)):
+            if getattr(mod, "data_format", "NHWC") != "NHWC":
+                raise NotImplementedError("tf export: NCHW global pooling")
+            axes = add_const(fresh("axes"), np.asarray([1, 2], np.int32),
+                             dtype=np.int32)
+            n = g.node.add()
+            n.name = fresh("globalpool")
+            n.op = ("Mean" if isinstance(mod, nn.GlobalAveragePooling2D)
+                    else "Max")
+            n.input.extend([cur, axes])
+            n.attr["T"].type = tfpb.DT_FLOAT
+            n.attr["Tidx"].type = tfpb.DT_INT32
+            n.attr["keep_dims"].b = False
+            return n.name
         if isinstance(mod, nn.SpatialConvolution):
             if mod.pad != (0, 0):
                 # encode as explicit Pad + VALID conv (TF SAME cannot
@@ -2219,9 +2288,75 @@ def save_tf(model, path, input_shape, input_name="input",
         raise NotImplementedError(
             f"tf export: unsupported layer {type(mod).__name__}")
 
-    if not isinstance(model, nn.Sequential):
-        raise NotImplementedError("tf export supports Sequential models")
-    cur = emit(model, model._params or {}, cur)
+    def emit_concat(bottoms, dimension):
+        axis = add_const(fresh("axis"),
+                         np.asarray(dimension, np.int32).reshape(()),
+                         dtype=np.int32)
+        n = g.node.add()
+        n.name = fresh("concat")
+        n.op = "ConcatV2"
+        n.input.extend(list(bottoms) + [axis])
+        n.attr["T"].type = tfpb.DT_FLOAT
+        n.attr["Tidx"].type = tfpb.DT_INT32
+        n.attr["N"].i = len(bottoms)
+        return n.name
+
+    def emit_nary(op, bottoms):
+        if op == "AddN":
+            n = g.node.add()
+            n.name = fresh("addn")
+            n.op = "AddN"
+            n.input.extend(bottoms)
+            n.attr["T"].type = tfpb.DT_FLOAT
+            n.attr["N"].i = len(bottoms)
+            return n.name
+        cur = bottoms[0]
+        for other in bottoms[1:]:          # Mul/Maximum are binary in TF
+            n = g.node.add()
+            n.name = fresh(op.lower())
+            n.op = op
+            n.input.extend([cur, other])
+            n.attr["T"].type = tfpb.DT_FLOAT
+            cur = n.name
+        return cur
+
+    def walk_graph(graph_mod, params, state, cur):
+        if len(graph_mod.input_nodes) > 1:
+            raise NotImplementedError("tf export: multi-input graphs")
+        state = state if isinstance(state, dict) else {}
+        tops = {id(n): cur for n in graph_mod.input_nodes}
+        for i, node in enumerate(graph_mod._topo):
+            if node.module is None:
+                continue
+            bottoms = [tops[id(p)] for p in node.inputs]
+            m = node.module
+            sub = (params or {}).get(str(i), {})
+            substate = state.get(str(i), {})
+            if isinstance(m, nn.JoinTable):
+                tops[id(node)] = emit_concat(bottoms, m.dimension)
+            elif isinstance(m, nn.CAddTable):
+                tops[id(node)] = emit_nary("AddN", bottoms)
+            elif isinstance(m, nn.CMulTable):
+                tops[id(node)] = emit_nary("Mul", bottoms)
+            elif isinstance(m, nn.CMaxTable):
+                tops[id(node)] = emit_nary("Maximum", bottoms)
+            elif isinstance(m, nn.Graph):
+                tops[id(node)] = walk_graph(m, sub, substate, bottoms[0])
+            else:
+                if len(bottoms) > 1:
+                    raise NotImplementedError(
+                        f"tf export: multi-input {type(m).__name__} node")
+                tops[id(node)] = emit(m, sub, bottoms[0], substate)
+        outs = [tops[id(n)] for n in graph_mod.output_nodes]
+        if len(outs) > 1:
+            raise NotImplementedError("tf export: multi-output graphs")
+        return outs[0]
+
+    if isinstance(model, nn.Graph):
+        cur = walk_graph(model, model._params or {}, model._state or {},
+                         cur)
+    else:
+        cur = emit(model, model._params or {}, cur, model._state or {})
 
     out = g.node.add()
     out.name = output_name
