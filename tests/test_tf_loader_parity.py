@@ -537,3 +537,53 @@ class TestGraphExport:
         save_tf(g, path, (2, 8, 8, 4))
         np.testing.assert_allclose(ours, self._tf_run(path, x),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestEdgeCases:
+    def test_dilation2d_stride_rate_grid(self):
+        """Odd input sizes x {SAME,VALID} x strides x rates all match TF
+        (the SAME pad arithmetic is the risky part)."""
+        x = np.random.randn(2, 11, 13, 3).astype(np.float32)
+        filt = np.random.randn(3, 2, 3).astype(np.float32)
+        for padding in ("SAME", "VALID"):
+            for st, rt in [((2, 2), (1, 1)), ((1, 1), (2, 2)),
+                           ((2, 2), (2, 2))]:
+                def build(tf, padding=padding, st=st, rt=rt):
+                    xp = tf.compat.v1.placeholder(
+                        tf.float32, (2, 11, 13, 3), name="x")
+                    tf.identity(tf.raw_ops.Dilation2D(
+                        input=xp, filter=tf.constant(filt),
+                        strides=[1, st[0], st[1], 1],
+                        rates=[1, rt[0], rt[1], 1], padding=padding),
+                        name="out")
+                _roundtrip(build, {"x": x}, "out")
+
+    def test_fused_batch_norm_nchw_inference(self):
+        tf = pytest.importorskip("tensorflow")
+        xc = np.random.randn(2, 3, 6, 6).astype(np.float32)
+        scale = (np.random.rand(3) + 0.5).astype(np.float32)
+        off = np.random.randn(3).astype(np.float32)
+        mean = np.random.randn(3).astype(np.float32)
+        var = (np.random.rand(3) + 0.5).astype(np.float32)
+
+        def build(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 3, 6, 6),
+                                          name="x")
+            r = tf.raw_ops.FusedBatchNorm(
+                x=xp, scale=tf.constant(scale), offset=tf.constant(off),
+                mean=tf.constant(mean), variance=tf.constant(var),
+                epsilon=1e-3, is_training=False, data_format="NCHW")
+            tf.identity(r.y, name="out")
+        g = _build_graph(build)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "g.pb")
+            with open(path, "wb") as f:
+                f.write(g.as_graph_def().SerializeToString())
+            model = load_tf(path, inputs=["x"], outputs=["out"],
+                            input_specs={"x": xc.shape})
+            model.evaluate()       # inference stats, not batch stats
+            ours = np.asarray(model.forward(jnp.asarray(xc)))
+        # TF CPU cannot execute NCHW FusedBatchNorm: analytic oracle
+        ref = ((xc.transpose(0, 2, 3, 1) - mean) / np.sqrt(var + 1e-3)
+               * scale + off).transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
