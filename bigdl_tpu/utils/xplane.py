@@ -1,0 +1,59 @@
+"""Minimal xplane (jax.profiler trace) reader.
+
+Used to cross-validate wall-clock step timings with the device plane's
+own busy time (docs/performance.md: the chained-value-fetch clock needs
+an independent witness through the tunneled transport).  Parses the
+``*.xplane.pb`` files a ``jax.profiler.trace`` context writes, via the
+TF-shipped proto (no tensorboard plugin needed).
+"""
+
+import glob
+import os
+
+
+def device_busy(trace_dir):
+    """Largest device-plane span in the trace.
+
+    Returns ``{"plane", "span_sec", "busy_event_sec"}`` for the device
+    (TPU/XLA) plane with the longest span, or None when no device plane
+    or proto support is available (e.g. CPU-only traces).
+    """
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:
+        try:
+            from tensorflow.core.profiler.protobuf import xplane_pb2
+        except Exception:
+            return None
+    best = None
+    for path in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                          recursive=True):
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            name = plane.name.lower()
+            if not ("tpu" in name or "device" in name or "xla" in name):
+                continue
+            lo, hi, busiest = None, None, 0
+            for line in plane.lines:
+                # event offsets are relative to the LINE's timestamp;
+                # align to absolute picoseconds before comparing lines
+                base = line.timestamp_ns * 1000
+                line_busy = 0
+                for ev in line.events:
+                    start = base + ev.offset_ps
+                    end = start + ev.duration_ps
+                    lo = start if lo is None else min(lo, start)
+                    hi = end if hi is None else max(hi, end)
+                    line_busy += ev.duration_ps
+                # lines nest hierarchically (modules > ops): summing
+                # across lines double-counts, so busy = the busiest line
+                busiest = max(busiest, line_busy)
+            if hi is not None:
+                rec = {"plane": plane.name,
+                       "span_sec": (hi - lo) / 1e12,
+                       "busy_event_sec": busiest / 1e12}
+                if best is None or rec["span_sec"] > best["span_sec"]:
+                    best = rec
+    return best

@@ -14,7 +14,6 @@ lines.  measure_scan.py (fori_loop witness) is NOT run here -- its
 server-side compile wedged the tunnel in round 3; run it manually last.
 """
 
-import glob
 import json
 import os
 import sys
@@ -24,41 +23,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _device_busy_from_xplane(trace_dir):
-    """Sum of top-level event durations on the device plane (best-effort;
-    returns None when the plugin protos or a device plane are absent)."""
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception:
-        try:
-            from tensorflow.core.profiler.protobuf import xplane_pb2
-        except Exception:
-            return None
-    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
-                      recursive=True)
-    best = None
-    for path in paths:
-        xs = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs.ParseFromString(f.read())
-        for plane in xs.planes:
-            name = plane.name.lower()
-            if not ("tpu" in name or "device" in name or "xla" in name):
-                continue
-            lo, hi, busy = None, None, 0
-            for line in plane.lines:
-                for ev in line.events:
-                    start = ev.offset_ps
-                    end = ev.offset_ps + ev.duration_ps
-                    lo = start if lo is None else min(lo, start)
-                    hi = end if hi is None else max(hi, end)
-                    busy += ev.duration_ps
-            if hi is not None:
-                span = (hi - lo) / 1e12
-                rec = {"plane": plane.name, "span_sec": span,
-                       "busy_event_sec": busy / 1e12}
-                if best is None or span > best["span_sec"]:
-                    best = rec
-    return best
+    """Largest device-plane span (see bigdl_tpu.utils.xplane)."""
+    from bigdl_tpu.utils.xplane import device_busy
+    return device_busy(trace_dir)
 
 
 def main():
