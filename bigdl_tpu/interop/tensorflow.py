@@ -149,17 +149,21 @@ def _data_format(ndef):
     return ndef.attr["data_format"].s.decode() or "NHWC"
 
 
-def _nchw_wrap(build):
-    """Run an NHWC-native conversion on NCHW data: permute in, build the
-    NHWC subgraph, permute back (XLA folds the transposes into layouts;
-    reference loaders support both formats natively, e.g. Conv2D.scala)."""
+def _nchw_wrap(build, rank=4):
+    """Run a channels-last-native conversion on channels-first data:
+    permute in, build the NHWC/NDHWC subgraph, permute back (XLA folds
+    the transposes into layouts; reference loaders support both formats
+    natively, e.g. Conv2D.scala).  ``rank``: 4 for NCHW, 5 for NCDHW."""
     import bigdl_tpu.nn as nn
     from bigdl_tpu.nn.graph import Node
 
+    perm_in = (0,) + tuple(range(2, rank)) + (1,)
+    perm_out = (0, rank - 1) + tuple(range(1, rank - 1))
+
     def wrapped(x_node):
-        pre = Node(nn.Permute((0, 2, 3, 1)), [x_node])
+        pre = Node(nn.Permute(perm_in), [x_node])
         out = build(pre)
-        return Node(nn.Permute((0, 3, 1, 2)), [out])
+        return Node(nn.Permute(perm_out), [out])
     return wrapped
 
 
@@ -241,7 +245,12 @@ def _convert_node(ctx, ndef):
     if op == "MatMul":
         x = _node_of(ctx, ins[0])
         if ndef.attr["transpose_a"].b:
-            raise NotImplementedError("MatMul transpose_a")
+
+            class _TransposeA(Module):
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    return jnp.swapaxes(input, -1, -2), state
+            x = Node(_TransposeA(), [x])
         w_kind, w_val = _convert(ctx, ins[1])
         tb = bool(ndef.attr["transpose_b"].b)
         if w_kind == "node":
@@ -313,8 +322,21 @@ def _convert_node(ctx, ndef):
         b_kind, b_val = _convert(ctx, ins[1])
         if (op == "BiasAdd" and _data_format(ndef) == "NCHW"
                 and b_kind == "const" and b_val.ndim == 1):
-            # bias broadcasts over the channel axis (1), not the last
-            b_val = b_val.reshape(-1, 1, 1)
+            # bias broadcasts over the channel axis (1), not the last;
+            # the value's rank (4-D NCHW vs 5-D NCDHW) is only known at
+            # apply time
+            bias_cf = b_val
+            if a_kind == "const":
+                return "const", a_val + bias_cf.reshape(
+                    (-1,) + (1,) * (a_val.ndim - 2))
+
+            class _BiasAddCF(Module):
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    shape = (-1,) + (1,) * (input.ndim - 2)
+                    return input + jnp.asarray(bias_cf).reshape(shape), \
+                        state
+            return "node", Node(_BiasAddCF(), [a_val])
         if a_kind == "node" and b_kind == "const":
             # fold into the producing conv/linear bias when 1-D and the
             # producer's raw output feeds ONLY this BiasAdd
@@ -928,6 +950,7 @@ def _convert_extra_op(ctx, ndef, op, ins):
     import jax
     import jax.numpy as jnp
 
+    import bigdl_tpu.nn as nn
     from bigdl_tpu.nn import ops as nnops
     from bigdl_tpu.nn.graph import Node
     from bigdl_tpu.nn.module import Module
@@ -1096,16 +1119,32 @@ def _convert_extra_op(ctx, ndef, op, ins):
         return "node", Node(nnops.SegmentSum(), [data, seg_val])
 
     if op == "Conv3D":
-        fmt = ndef.attr["data_format"].s.decode()
-        if fmt not in ("", "NDHWC"):
-            raise NotImplementedError(f"Conv3D data_format {fmt}")
-        strides = tuple(ndef.attr["strides"].list.i)[1:4]
-        dil = tuple(ndef.attr["dilations"].list.i)[1:4] or (1, 1, 1)
+        fmt = ndef.attr["data_format"].s.decode() or "NDHWC"
+        ncdhw = fmt == "NCDHW"
+        sl = slice(2, 5) if ncdhw else slice(1, 4)
+        strides = tuple(ndef.attr["strides"].list.i)[sl] or (1, 1, 1)
+        dil = tuple(ndef.attr["dilations"].list.i)[sl] or (1, 1, 1)
         padding = ndef.attr["padding"].s.decode() or "VALID"
         w_kind, w_val = _convert(ctx, ins[1])
-        if w_kind != "const":
-            raise NotImplementedError("Conv3D with non-constant filter")
         x = _node_of(ctx, ins[0])
+        if w_kind == "node":
+            st3, dil3, pad3 = strides, dil, padding
+
+            class _Conv3DOp(Module):
+                def apply(self, params, state, input, *, training=False,
+                          rng=None):
+                    from jax import lax
+                    a, k = input
+                    y = lax.conv_general_dilated(
+                        a, k.astype(a.dtype), st3, pad3,
+                        rhs_dilation=dil3,
+                        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+                    return y, state
+
+            build = lambda xn: Node(_Conv3DOp(), [xn, w_val])
+            if ncdhw:
+                build = _nchw_wrap(build, rank=5)
+            return "node", build(x)
         w = np.asarray(w_val, np.float32)      # (kd, kh, kw, cin, cout)
         w_shape = w.shape                       # class captures shape only
 
@@ -1129,7 +1168,12 @@ def _convert_extra_op(ctx, ndef, op, ins):
                 return y + params["bias"].astype(y.dtype), state
 
         mod = TfConv3D()
-        node = Node(mod, [x])
+        build = lambda xn: Node(mod, [xn])
+        if ncdhw:
+            # NB: the permute wrapper blocks the BiasAdd fold into the
+            # conv bias; the channels-first BiasAdd module handles it
+            build = _nchw_wrap(build, rank=5)
+        node = build(x)
 
         def install(params, w=w):
             params["weight"] = jnp.asarray(w)
@@ -1167,13 +1211,12 @@ def _convert_extra_op(ctx, ndef, op, ins):
         size = tuple(int(v) for v in _const_of(ctx, ins[1]).ravel())
         align = bool(ndef.attr["align_corners"].b)
         half_pixel = bool(ndef.attr["half_pixel_centers"].b)
-        if align:
-            raise NotImplementedError("ResizeBilinear align_corners=True")
         x = _node_of(ctx, ins[0])
 
         class _ResizeBilinear(Module):
-            """TF1 legacy grid (src = dst*scale) or half-pixel centers,
-            per the half_pixel_centers attr."""
+            """TF1 legacy grid (src = dst*scale), align_corners
+            (src = dst*(in-1)/(out-1)), or half-pixel centers, per the
+            attrs."""
 
             def apply(self, params, state, input, *, training=False,
                       rng=None):
@@ -1181,21 +1224,27 @@ def _convert_extra_op(ctx, ndef, op, ins):
                 if half_pixel:
                     return jax.image.resize(input, out_shape,
                                             "bilinear"), state
-                return _tf1_resize_bilinear(input, size), state
+                return _tf1_resize_bilinear(input, size,
+                                            align_corners=align), state
         return "node", Node(_ResizeBilinear(), [x])
 
     return _convert_grad_data_op(ctx, ndef, op, ins)
 
 
-def _tf1_resize_bilinear(input, size):
-    """TF1 legacy resize grid (src = dst * in/out, no half-pixel shift)."""
+def _tf1_resize_bilinear(input, size, align_corners=False):
+    """TF1 resize grids: legacy (src = dst * in/out) or align_corners
+    (src = dst * (in-1)/(out-1))."""
     import jax.numpy as jnp
 
     in_h, in_w = input.shape[1], input.shape[2]
     out = input
     for axis, (n_in, n_out) in ((1, (in_h, size[0])),
                                 (2, (in_w, size[1]))):
-        src = jnp.arange(n_out) * (n_in / n_out)
+        if align_corners:
+            scale = (n_in - 1) / (n_out - 1) if n_out > 1 else 0.0
+            src = jnp.arange(n_out) * scale
+        else:
+            src = jnp.arange(n_out) * (n_in / n_out)
         lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, n_in - 1)
         hi = jnp.clip(lo + 1, 0, n_in - 1)
         w = (src - lo).astype(input.dtype)
@@ -1444,10 +1493,15 @@ def _convert_grad_data_op(ctx, ndef, op, ins):
 
     if op in ("Conv3DBackpropInput", "Conv3DBackpropInputV2",
               "Conv3DBackpropFilter", "Conv3DBackpropFilterV2"):
+        fmt = ndef.attr["data_format"].s.decode() or "NDHWC"
+        ncdhw = fmt == "NCDHW"
         st = list(ndef.attr["strides"].list.i)
-        sd, sh, sw = int(st[1]), int(st[2]), int(st[3])
+        sl = slice(2, 5) if ncdhw else slice(1, 4)
+        sd, sh, sw = (int(v) for v in st[sl])
         pad = ndef.attr["padding"].s.decode()
         dn = ("NDHWC", "DHWIO", "NDHWC")
+        to_last = (0, 2, 3, 4, 1)        # NCDHW activation -> NDHWC
+        to_first = (0, 4, 1, 2, 3)
 
         def conv3d(a, w):
             from jax import lax
@@ -1462,6 +1516,8 @@ def _convert_grad_data_op(ctx, ndef, op, ins):
         static_shape = None
         if k_kind == "const" and np.asarray(k_val).ndim == 1:
             static_shape = tuple(int(v) for v in np.asarray(k_val).ravel())
+            if ncdhw and wrt_input:      # sizes arrive in NCDHW order
+                static_shape = tuple(static_shape[i] for i in to_last)
             other = ins[1] if wrt_input else ins[0]
             getters, parents = _parents(other, ins[2])
             g_shape = None
@@ -1475,15 +1531,26 @@ def _convert_grad_data_op(ctx, ndef, op, ins):
             def apply(self, params, state, input, *, training=False,
                       rng=None):
                 other, gg = getters[0](input), getters[1](input)
-                shape = (static_shape if static_shape is not None
-                         else g_shape(input).shape)
+                if ncdhw:                # activations arrive NCDHW
+                    gg = jnp.transpose(gg, to_last)
+                    if not wrt_input:    # `other` is the input activation
+                        other = jnp.transpose(other, to_last)
+                if static_shape is not None:
+                    shape = static_shape
+                else:
+                    shape = g_shape(input).shape
+                    if ncdhw and wrt_input:
+                        shape = tuple(shape[i] for i in to_last)
                 zeros = jnp.zeros(shape, gg.dtype)
                 if wrt_input:
                     f = lambda a: conv3d(a, other.astype(gg.dtype))
                 else:
                     f = lambda w: conv3d(other.astype(gg.dtype), w)
                 _, vjp = jax.vjp(f, zeros)
-                return vjp(gg)[0], state
+                out = vjp(gg)[0]
+                if ncdhw and wrt_input:  # input-grad back to NCDHW
+                    out = jnp.transpose(out, to_first)
+                return out, state
         return "node", Node(_Conv3DBp(), parents)
 
     if op in ("DepthwiseConv2dNativeBackpropInput",
@@ -1590,8 +1657,7 @@ def _convert_grad_data_op(ctx, ndef, op, ins):
         return "node", Node(_LRNGrad(), parents)
 
     if op == "ResizeBilinearGrad":
-        if bool(ndef.attr["align_corners"].b):
-            raise NotImplementedError("ResizeBilinearGrad align_corners")
+        align = bool(ndef.attr["align_corners"].b)
         half_pixel = bool(ndef.attr["half_pixel_centers"].b)
         getters, parents = _parents(ins[0], ins[1])
 
@@ -1606,7 +1672,8 @@ def _convert_grad_data_op(ctx, ndef, op, ins):
                         return jax.image.resize(
                             a, (a.shape[0],) + size + (a.shape[-1],),
                             "bilinear")
-                    return _tf1_resize_bilinear(a, size)
+                    return _tf1_resize_bilinear(a, size,
+                                                align_corners=align)
                 _, vjp = jax.vjp(f, orig)
                 return vjp(gg.astype(orig.dtype))[0], state
         return "node", Node(_ResizeGrad(), parents)

@@ -587,3 +587,144 @@ class TestEdgeCases:
         ref = ((xc.transpose(0, 2, 3, 1) - mean) / np.sqrt(var + 1e-3)
                * scale + off).transpose(0, 3, 1, 2)
         np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_matmul_transpose_a(self):
+        a = np.random.randn(6, 4).astype(np.float32)
+        b = np.random.randn(6, 5).astype(np.float32)
+
+        def build(tf):
+            ap = tf.compat.v1.placeholder(tf.float32, (6, 4), name="a")
+            tf.identity(tf.raw_ops.MatMul(a=ap, b=tf.constant(b),
+                                          transpose_a=True), name="out")
+        _roundtrip(build, {"a": a}, "out")
+
+    def test_resize_bilinear_align_corners_fwd_and_grad(self):
+        x = np.random.randn(2, 5, 7, 3).astype(np.float32)
+        g = np.random.randn(2, 10, 14, 3).astype(np.float32)
+
+        def fwd(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 5, 7, 3),
+                                          name="x")
+            tf.identity(tf.raw_ops.ResizeBilinear(
+                images=xp, size=[10, 14], align_corners=True,
+                half_pixel_centers=False), name="out")
+        _roundtrip(fwd, {"x": x}, "out")
+
+        def bwd(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 5, 7, 3),
+                                          name="x")
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 10, 14, 3),
+                                          name="g")
+            tf.identity(tf.raw_ops.ResizeBilinearGrad(
+                grads=gp, original_image=xp, align_corners=True,
+                half_pixel_centers=False), name="out")
+        _roundtrip(bwd, {"x": x, "g": g}, "out")
+
+    def test_conv3d_ncdhw_and_dynamic_filter(self):
+        tf = pytest.importorskip("tensorflow")
+        x5 = np.random.randn(2, 3, 4, 6, 6).astype(np.float32)
+        w5 = np.random.randn(2, 3, 3, 3, 4).astype(np.float32)
+
+        def build(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 3, 4, 6, 6),
+                                          name="x")
+            tf.identity(tf.raw_ops.Conv3D(
+                input=xp, filter=tf.constant(w5), strides=[1, 1, 1, 1, 1],
+                padding="SAME", data_format="NCDHW"), name="out")
+        g = _build_graph(build)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "g.pb")
+            with open(path, "wb") as f:
+                f.write(g.as_graph_def().SerializeToString())
+            model = load_tf(path, inputs=["x"], outputs=["out"],
+                            input_specs={"x": x5.shape})
+            ours = np.asarray(model.forward(jnp.asarray(x5)))
+        # TF CPU cannot execute NCDHW: NHWC oracle on transposed data
+        ref_g = tf.Graph()
+        with ref_g.as_default():
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 4, 6, 6, 3),
+                                          name="x")
+            tf.identity(tf.nn.conv3d(xp, w5, [1, 1, 1, 1, 1], "SAME"),
+                        name="out")
+        with tf.compat.v1.Session(graph=ref_g) as sess:
+            ref = sess.run("out:0", {"x:0": x5.transpose(0, 2, 3, 4, 1)})
+        np.testing.assert_allclose(ours, ref.transpose(0, 4, 1, 2, 3),
+                                    rtol=1e-3, atol=1e-3)
+
+        def dyn(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 4, 6, 6, 3),
+                                          name="x")
+            wp = tf.compat.v1.placeholder(tf.float32, (2, 3, 3, 3, 4),
+                                          name="w")
+            tf.identity(tf.raw_ops.Conv3D(
+                input=xp, filter=wp, strides=[1, 1, 1, 1, 1],
+                padding="VALID"), name="out")
+        _roundtrip(dyn, {"x": x5.transpose(0, 2, 3, 4, 1).copy(),
+                         "w": w5}, "out", rtol=1e-3)
+
+    def test_ncdhw_conv3d_biasadd_and_backprops(self):
+        """NCDHW Conv3D + channels-first BiasAdd (rank-aware broadcast)
+        and the NCDHW Conv3DBackprop pair, vs the NHWC oracle on
+        transposed data (review findings: the BiasAdd reshape assumed
+        rank 4; the backprops assumed NDHWC)."""
+        tf = pytest.importorskip("tensorflow")
+        x5 = np.random.randn(2, 3, 4, 6, 6).astype(np.float32)
+        w5 = np.random.randn(2, 3, 3, 3, 5).astype(np.float32)
+        bias = np.random.randn(5).astype(np.float32)
+        gq = np.random.randn(2, 5, 4, 6, 6).astype(np.float32)
+
+        def load_run(build, feeds):
+            g = _build_graph(build)
+            with tempfile.TemporaryDirectory() as d:
+                path = os.path.join(d, "g.pb")
+                with open(path, "wb") as f:
+                    f.write(g.as_graph_def().SerializeToString())
+                m = load_tf(path, inputs=list(feeds), outputs=["out"],
+                            input_specs={n: v.shape
+                                         for n, v in feeds.items()})
+                xs = [jnp.asarray(v) for v in feeds.values()]
+                return np.asarray(m.forward(
+                    xs[0] if len(xs) == 1 else tuple(xs)))
+
+        def fwd(tf):
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 3, 4, 6, 6),
+                                          name="x")
+            y = tf.raw_ops.Conv3D(input=xp, filter=tf.constant(w5),
+                                  strides=[1, 1, 1, 1, 1], padding="SAME",
+                                  data_format="NCDHW")
+            y = tf.raw_ops.BiasAdd(value=y, bias=tf.constant(bias),
+                                   data_format="NCHW")
+            tf.identity(y, name="out")
+        ours = load_run(fwd, {"x": x5})
+        ref_g = tf.Graph()
+        with ref_g.as_default():
+            xp = tf.compat.v1.placeholder(tf.float32, (2, 4, 6, 6, 3),
+                                          name="x")
+            tf.identity(tf.nn.conv3d(xp, w5, [1, 1, 1, 1, 1], "SAME")
+                        + bias, name="out")
+        with tf.compat.v1.Session(graph=ref_g) as sess:
+            ref = sess.run("out:0", {"x:0": x5.transpose(0, 2, 3, 4, 1)})
+        np.testing.assert_allclose(ours, ref.transpose(0, 4, 1, 2, 3),
+                                   rtol=1e-3, atol=1e-3)
+
+        def bp_in(tf):
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 5, 4, 6, 6),
+                                          name="g")
+            tf.identity(tf.raw_ops.Conv3DBackpropInputV2(
+                input_sizes=[2, 3, 4, 6, 6], filter=tf.constant(w5),
+                out_backprop=gp, strides=[1, 1, 1, 1, 1], padding="SAME",
+                data_format="NCDHW"), name="out")
+        ours_in = load_run(bp_in, {"g": gq})
+        ref_g = tf.Graph()
+        with ref_g.as_default():
+            gp = tf.compat.v1.placeholder(tf.float32, (2, 4, 6, 6, 5),
+                                          name="g")
+            tf.identity(tf.raw_ops.Conv3DBackpropInputV2(
+                input_sizes=[2, 4, 6, 6, 3], filter=tf.constant(w5),
+                out_backprop=gp, strides=[1, 1, 1, 1, 1], padding="SAME"),
+                name="out")
+        with tf.compat.v1.Session(graph=ref_g) as sess:
+            ref_in = sess.run("out:0", {"g:0": gq.transpose(0, 2, 3, 4, 1)})
+        np.testing.assert_allclose(ours_in,
+                                   ref_in.transpose(0, 4, 1, 2, 3),
+                                   rtol=1e-3, atol=1e-3)
