@@ -26,8 +26,10 @@ import time
 
 
 def _honor_env_platforms():
-    from bigdl_tpu.utils.config import honor_env_platforms
+    from bigdl_tpu.utils.config import (enable_compilation_cache,
+                                        honor_env_platforms)
     honor_env_platforms()
+    enable_compilation_cache()
 
 
 def run_bench():

@@ -61,6 +61,21 @@ def redirect_spark_info_logs(path=None):
     return redirect_spark_info_logs(log_file=path or log_file())
 
 
+def enable_compilation_cache(path="/tmp/jax_cache"):
+    """Persistent XLA compilation cache: an earlier bench/evidence run in
+    the same round warms the big compiles for later runs.  The env var is
+    set BEFORE jax is imported so it applies even where
+    ``jax.config.update`` rejects the option."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", path)
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:
+        pass
+
+
 def honor_env_platforms():
     """Re-assert the JAX_PLATFORMS env var's intent.
 
@@ -69,6 +84,7 @@ def honor_env_platforms():
     CPU-forced runs must call this before touching jax.  (Shared helper --
     the same workaround used to be copy-pasted per entry point.)
     """
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     want = os.environ.get("JAX_PLATFORMS")
     if want:
         import jax

@@ -29,8 +29,10 @@ def _device_busy_from_xplane(trace_dir):
 
 
 def main():
-    from bigdl_tpu.utils.config import honor_env_platforms
+    from bigdl_tpu.utils.config import (enable_compilation_cache,
+                                        honor_env_platforms)
     honor_env_platforms()
+    enable_compilation_cache()
 
     import jax
     import jax.numpy as jnp
