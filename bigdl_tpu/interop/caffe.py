@@ -631,8 +631,9 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
         except Exception:
             cur_spec[0] = None   # spec tracking is best-effort
 
-    def walk(child, params, state, top):
-        """Emit ``child`` fed from ``top``; returns its output top."""
+    def walk(child, params, state, top, allow_multi=False):
+        """Emit ``child`` fed from ``top``; returns its output top (or
+        top list for a multi-output root graph)."""
         state = state if isinstance(state, dict) else {}
         if isinstance(child, nn.Sequential):
             for i, sub in enumerate(child.modules):
@@ -719,16 +720,35 @@ def save_caffe(model, prototxt_path, model_path, input_shape):
                             cur_spec[0] = None
                 specs[id(node)] = cur_spec[0]
             outs = [tops[id(o)] for o in child.output_nodes]
-            if len(outs) > 1:
+            if len(outs) > 1 and not allow_multi:
                 raise NotImplementedError(
-                    "caffe export: multi-output graphs")
+                    "caffe export: multi-output nested graph node")
             cur_spec[0] = specs.get(id(child.output_nodes[0]))
+            if len(outs) > 1:
+                # the importer discovers outputs as unconsumed tops in
+                # LAYER order; cap each output with an identity Power
+                # layer so (a) an output that also feeds another node
+                # stays an output and (b) the original output order is
+                # the terminal layer order
+                capped = []
+                for out_top in outs:
+                    l = net.layer.add()
+                    l.name = unique(out_top + "_out")
+                    l.type = "Power"
+                    l.bottom.append(out_top)
+                    l.top.append(l.name)
+                    l.power_param.power = 1.0
+                    l.power_param.scale = 1.0
+                    l.power_param.shift = 0.0
+                    capped.append(l.name)
+                return capped
             return outs[0]
         out = emit(child, params, [top], state)
         _advance_spec(child, params, state)
         return out
 
-    walk(model, model._params or {}, model._state or {}, "data")
+    walk(model, model._params or {}, model._state or {}, "data",
+         allow_multi=True)
 
     with open(prototxt_path, "w") as f:
         # definition only (blobs stripped)

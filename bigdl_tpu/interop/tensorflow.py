@@ -2408,28 +2408,38 @@ def save_tf(model, path, input_shape, input_name="input",
             elif isinstance(m, nn.CMaxTable):
                 tops[id(node)] = emit_nary("Maximum", bottoms)
             elif isinstance(m, nn.Graph):
-                tops[id(node)] = walk_graph(m, sub, substate, bottoms[0])
+                inner = walk_graph(m, sub, substate, bottoms[0])
+                if len(inner) > 1:
+                    raise NotImplementedError(
+                        "tf export: multi-output nested graph node")
+                tops[id(node)] = inner[0]
             else:
                 if len(bottoms) > 1:
                     raise NotImplementedError(
                         f"tf export: multi-input {type(m).__name__} node")
                 tops[id(node)] = emit(m, sub, bottoms[0], substate)
-        outs = [tops[id(n)] for n in graph_mod.output_nodes]
-        if len(outs) > 1:
-            raise NotImplementedError("tf export: multi-output graphs")
-        return outs[0]
+        return [tops[id(n)] for n in graph_mod.output_nodes]
 
     if isinstance(model, nn.Graph):
-        cur = walk_graph(model, model._params or {}, model._state or {},
-                         cur)
+        curs = walk_graph(model, model._params or {}, model._state or {},
+                          cur)
     else:
-        cur = emit(model, model._params or {}, cur, model._state or {})
+        curs = [emit(model, model._params or {}, cur, model._state or {})]
 
-    out = g.node.add()
-    out.name = output_name
-    out.op = "Identity"
-    out.input.append(cur)
-    out.attr["T"].type = tfpb.DT_FLOAT
+    # one named Identity per model output: "output" for single-output
+    # models, "output", "output_1", ... for multi-output graphs
+    existing = {n.name for n in g.node}
+    for i, cur in enumerate(curs):
+        name = output_name if i == 0 else f"{output_name}_{i}"
+        if name in existing:
+            raise ValueError(
+                f"tf export: output name {name!r} collides with an "
+                f"internal node; pass a different output_name")
+        out = g.node.add()
+        out.name = name
+        out.op = "Identity"
+        out.input.append(cur)
+        out.attr["T"].type = tfpb.DT_FLOAT
 
     with open(path, "wb") as f:
         f.write(g.SerializeToString())

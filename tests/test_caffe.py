@@ -304,3 +304,63 @@ class TestGraphExport:
         back.evaluate()
         theirs = np.asarray(back.forward(x))
         np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+    def test_multi_output_graph_roundtrip(self, tmp_path):
+        """Two-headed Graph exports as two unconsumed tops, which the
+        importer rediscovers as the graph outputs."""
+        from bigdl_tpu.nn.graph import Graph, Input, Node
+
+        RNG.set_seed(7)
+        inp = Input()
+        trunk = Node(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1,
+                                           data_format="NHWC"), [inp])
+        r = Node(nn.ReLU(), [trunk])
+        h1 = Node(nn.SpatialConvolution(4, 2, 1, 1, data_format="NHWC"),
+                  [r])
+        h2 = Node(nn.SpatialConvolution(4, 5, 1, 1, data_format="NHWC"),
+                  [r])
+        g = Graph([inp], [h1, h2])
+        g.build(jax.ShapeDtypeStruct((2, 6, 6, 3), jnp.float32))
+        g.evaluate()
+        x = jnp.asarray(np.random.default_rng(3).standard_normal(
+            (2, 6, 6, 3)), jnp.float32)
+        o1, o2 = [np.asarray(v) for v in g.forward(x)]
+        pt = str(tmp_path / "m.prototxt")
+        cm = str(tmp_path / "m.caffemodel")
+        save_caffe(g, pt, cm, (2, 6, 6, 3))
+        back = load_caffe(pt, cm)
+        back.evaluate()
+        b1, b2 = [np.asarray(v) for v in back.forward(x)]
+        # output ORDER is preserved (identity cap layers in output order)
+        np.testing.assert_allclose(o1, b1, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(o2, b2, rtol=1e-4, atol=1e-5)
+
+    def test_output_that_feeds_another_node(self, tmp_path):
+        """An output that ALSO feeds a downstream head must survive the
+        round-trip (the importer discovers outputs as unconsumed tops;
+        the exporter caps outputs so this works)."""
+        from bigdl_tpu.nn.graph import Graph, Input, Node
+
+        RNG.set_seed(9)
+        inp = Input()
+        r = Node(nn.SpatialConvolution(3, 4, 1, 1, data_format="NHWC"),
+                 [inp])
+        h = Node(nn.SpatialConvolution(4, 2, 1, 1, data_format="NHWC"),
+                 [r])
+        g = Graph([inp], [r, h])       # r is an output AND feeds h
+        g.build(jax.ShapeDtypeStruct((2, 5, 5, 3), jnp.float32))
+        g.evaluate()
+        x = jnp.asarray(np.random.default_rng(5).standard_normal(
+            (2, 5, 5, 3)), jnp.float32)
+        o1, o2 = [np.asarray(v) for v in g.forward(x)]
+        pt = str(tmp_path / "o.prototxt")
+        cm = str(tmp_path / "o.caffemodel")
+        save_caffe(g, pt, cm, (2, 5, 5, 3))
+        back = load_caffe(pt, cm)
+        back.evaluate()
+        outs = back.forward(x)
+        assert isinstance(outs, tuple) and len(outs) == 2
+        np.testing.assert_allclose(o1, np.asarray(outs[0]), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(o2, np.asarray(outs[1]), rtol=1e-4,
+                                   atol=1e-5)

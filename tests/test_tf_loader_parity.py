@@ -728,3 +728,40 @@ class TestEdgeCases:
         np.testing.assert_allclose(ours_in,
                                    ref_in.transpose(0, 4, 1, 2, 3),
                                    rtol=1e-3, atol=1e-3)
+
+    def test_multi_output_graph_export(self, tmp_path):
+        """A two-headed Graph exports with output/output_1 Identities."""
+        tf = pytest.importorskip("tensorflow")
+        import jax
+        from bigdl_tpu.interop.tensorflow import save_tf
+        from bigdl_tpu.nn.graph import Graph, Input, Node
+        from bigdl_tpu.utils.random_generator import RNG
+        import bigdl_tpu.nn as nn
+
+        RNG.set_seed(7)
+        inp = Input()
+        trunk = Node(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1,
+                                           data_format="NHWC"), [inp])
+        r = Node(nn.ReLU(), [trunk])
+        h1 = Node(nn.SpatialConvolution(4, 2, 1, 1, data_format="NHWC"),
+                  [r])
+        h2 = Node(nn.SpatialConvolution(4, 5, 1, 1, data_format="NHWC"),
+                  [r])
+        g = Graph([inp], [h1, h2])
+        g.build(jax.ShapeDtypeStruct((2, 6, 6, 3), jnp.float32))
+        g.evaluate()
+        x = np.random.default_rng(3).standard_normal(
+            (2, 6, 6, 3)).astype(np.float32)
+        o1, o2 = [np.asarray(v) for v in g.forward(jnp.asarray(x))]
+        path = str(tmp_path / "m.pb")
+        save_tf(g, path, (2, 6, 6, 3))
+        gd = tf.compat.v1.GraphDef()
+        with open(path, "rb") as f:
+            gd.ParseFromString(f.read())
+        gg = tf.Graph()
+        with gg.as_default():
+            tf.graph_util.import_graph_def(gd, name="")
+        with tf.compat.v1.Session(graph=gg) as sess:
+            r1, r2 = sess.run(["output:0", "output_1:0"], {"input:0": x})
+        np.testing.assert_allclose(o1, r1, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(o2, r2, rtol=1e-4, atol=1e-5)
