@@ -93,6 +93,55 @@ def _to_dataset(data, batch_size, one_based_labels="auto"):
     from bigdl.util.common import (Sample, samples_to_arrays,
                                    shift_one_based_labels)
 
+    inner = None
+    if hasattr(data, "getNumPartitions") or hasattr(data, "rdd") or (
+            hasattr(data, "num_partitions") and hasattr(data, "partition")):
+        from bigdl_tpu.dataset.distributed import source_of
+        inner = source_of(data)
+    elif (isinstance(data, (list, tuple)) and data
+            and isinstance(data[0], (list, tuple))):
+        from bigdl_tpu.dataset.distributed import source_of
+        inner = source_of(list(data))     # explicit list of partitions
+    if inner is not None:
+        # a pyspark RDD/DataFrame of Samples (the reference's
+        # training_rdd) or any partitioned source.  The "auto" 1-based
+        # label policy is resolved ONCE, from the first partition
+        # materialised, and reused everywhere -- per-partition decisions
+        # could shift one partition and not another; pass an explicit
+        # one_based_labels when the first partition is unrepresentative.
+        from bigdl_tpu.dataset import Sample as TpuSample
+        from bigdl_tpu.dataset.distributed import (PartitionedDataSet,
+                                                   PartitionedSource)
+        resolved = [one_based_labels]
+
+        class _CompatPartitions(PartitionedSource):
+            def num_partitions(self):
+                return inner.num_partitions()
+
+            def count(self):
+                return inner.count()
+
+            def partition(self, idx):
+                records = list(inner.partition(idx))
+                if records and isinstance(records[0], Sample):
+                    if resolved[0] == "auto":
+                        labs = np.concatenate(
+                            [np.asarray(r.label.to_ndarray()).ravel()
+                             for r in records])
+                        resolved[0] = bool(np.min(labs) >= 1
+                                           and np.all(labs ==
+                                                      np.round(labs)))
+                    x, y = samples_to_arrays(records, resolved[0])
+                    return [TpuSample(xi, yi) for xi, yi in zip(x, y)]
+                return records
+
+        # the pyspark-facade Optimizer is single-process (the reference's
+        # py4j driver); pin the whole source to this host -- multi-host
+        # pods use bigdl_tpu.optim.DistriOptimizer + PartitionedDataSet
+        # directly, which shard by process index
+        return PartitionedDataSet(_CompatPartitions(), host_index=0,
+                                  num_hosts=1) >> \
+            SampleToMiniBatch(batch_size)
     if isinstance(data, tuple) and len(data) == 2:
         x, y = data
         y = shift_one_based_labels(y, one_based_labels)
@@ -100,8 +149,9 @@ def _to_dataset(data, batch_size, one_based_labels="auto"):
         x, y = samples_to_arrays(data, one_based_labels)
     else:
         raise TypeError(
-            "training data must be a list of bigdl.util.common.Sample "
-            "or an (X, y) ndarray pair")
+            "training data must be a list of bigdl.util.common.Sample, "
+            "an (X, y) ndarray pair, a pyspark RDD of Samples, or a "
+            "partitioned source")
     return array_dataset(np.asarray(x), np.asarray(y)) >> \
         SampleToMiniBatch(batch_size)
 
