@@ -93,14 +93,13 @@ def _to_dataset(data, batch_size, one_based_labels="auto"):
     from bigdl.util.common import (Sample, samples_to_arrays,
                                    shift_one_based_labels)
 
+    from bigdl_tpu.dataset.distributed import is_partitioned, source_of
+
     inner = None
-    if hasattr(data, "getNumPartitions") or hasattr(data, "rdd") or (
-            hasattr(data, "num_partitions") and hasattr(data, "partition")):
-        from bigdl_tpu.dataset.distributed import source_of
+    if is_partitioned(data):
         inner = source_of(data)
     elif (isinstance(data, (list, tuple)) and data
             and isinstance(data[0], (list, tuple))):
-        from bigdl_tpu.dataset.distributed import source_of
         inner = source_of(list(data))     # explicit list of partitions
     if inner is not None:
         # a pyspark RDD/DataFrame of Samples (the reference's

@@ -79,6 +79,16 @@ class RDDSource(PartitionedSource):
         return self._count
 
 
+def is_partitioned(obj) -> bool:
+    """True for objects :func:`source_of` accepts as partitioned sources
+    by duck type (RDD / DataFrame / the three-method protocol); plain
+    record lists are NOT partitioned (even though an explicit
+    list-of-partitions coerces via ``source_of``)."""
+    return (hasattr(obj, "getNumPartitions") or hasattr(obj, "rdd")
+            or (hasattr(obj, "num_partitions")
+                and hasattr(obj, "partition")))
+
+
 def source_of(obj) -> PartitionedSource:
     """Coerce an RDD / DataFrame / list-of-partitions / PartitionedSource
     to a PartitionedSource."""

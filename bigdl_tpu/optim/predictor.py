@@ -50,19 +50,38 @@ class Predictor:
         """Reference: predictClass -- argmax over the last axis."""
         return [int(np.argmax(o, axis=-1)) for o in self.predict(data)]
 
-    def _batches(self, data):
-        if isinstance(data, AbstractDataSet):
-            yield from data.data(train=False)
-            return
-        buf = list(data)
-        for i in range(0, len(buf), self.batch_size):
-            chunk = buf[i:i + self.batch_size]
+    def _record_batches(self, records):
+        from bigdl_tpu.dataset.minibatch import MiniBatch
+
+        for i in range(0, len(records), self.batch_size):
+            chunk = records[i:i + self.batch_size]
             if isinstance(chunk[0], Sample):
                 yield samples_to_minibatch(chunk)
             else:
-                from bigdl_tpu.dataset.minibatch import MiniBatch
-
                 yield MiniBatch(np.stack(chunk))
+
+    def _batches(self, data):
+        from bigdl_tpu.dataset.distributed import is_partitioned, source_of
+
+        if isinstance(data, AbstractDataSet):
+            yield from data.data(train=False)
+            return
+        if is_partitioned(data):
+            # model.predict(rdd) analogue (reference: Predictor.scala:154
+            # maps partitions under a broadcast model): THIS host predicts
+            # the partitions congruent to its process index (the
+            # PartitionedDataSet locality contract), batch by batch
+            import jax
+
+            src = source_of(data)
+            n_hosts = jax.process_count()
+            host = jax.process_index()
+            for p in range(src.num_partitions()):
+                if p % n_hosts != host:
+                    continue
+                yield from self._record_batches(list(src.partition(p)))
+            return
+        yield from self._record_batches(list(data))
 
 
 class PredictionService:
