@@ -370,7 +370,18 @@ def main():
             failures.append(f"device probe: {perr or probe}")
         hang = probe is None and str(perr).startswith("timeout")
         no_tpu = probe is not None and probe.get("probe") != "tpu"
-        if hang or no_tpu:
+        if no_tpu or (hang and t is not None and t >= 180):
+            # a probe that hung through a GENEROUS timeout means the
+            # tunnel is dead (an alive one answers in ~40s) -- a full TPU
+            # attempt would hang the same way and starve the CPU fallback
+            # of budget; a non-tpu probe means the attempt would sweep
+            # ResNet-50 on CPU at batch 128.  Skip straight to the
+            # fallback; only fast transient init ERRORS keep the retry
+            # budget (round-1's failure story).
+            attempts = 0
+        elif hang:
+            # tight budget clamped the probe: a slow-but-alive tunnel
+            # could look hung, so keep one real attempt
             attempts = min(attempts, 1)
     for i in range(attempts):
         diagnostic(f"tpu attempt {i + 1}")

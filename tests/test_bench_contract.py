@@ -45,9 +45,23 @@ class TestBenchContract:
         proc = subprocess.Popen([sys.executable, BENCH], env=env,
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True)
-        time.sleep(5)                  # mid device-probe
-        proc.send_signal(signal.SIGTERM)
-        out, _ = proc.communicate(timeout=30)
-        rec = _last_json(out)
-        assert "incomplete" in rec["extra"]["error"]
-        assert rec["vs_baseline"] == 0.0
+        try:
+            time.sleep(5)              # mid device-probe
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=30)
+            rec = _last_json(out)
+            assert "incomplete" in rec["extra"]["error"]
+            assert rec["vs_baseline"] == 0.0
+            # the SIGTERM handler must have reaped the hung child group
+            time.sleep(1)
+            # anchor on the absolute script path at end-of-cmdline:
+            # a bare "bench.py" pattern also matches the test harness's
+            # own command line
+            left = subprocess.run(
+                ["pgrep", "-f", BENCH.replace(".", r"\.") + "$"],
+                capture_output=True, text=True).stdout.strip()
+            assert not left, f"leaked bench children: {left}"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
