@@ -102,7 +102,25 @@ class DLEstimator:
     def _prepare_labels(self, y):
         return np.asarray(y)
 
-    def fit(self, X, y) -> DLModel:
+    def fit(self, X, y=None) -> DLModel:
+        from bigdl_tpu.dataset.distributed import is_partitioned, source_of
+
+        if is_partitioned(X):
+            # a partitioned source / pyspark DataFrame-of-rows (the
+            # reference DLEstimator fits on Spark DataFrames,
+            # dlframes/DLEstimator.scala): records are (features, label)
+            # pairs or objects with .features/.label, converted per
+            # cached partition through PartitionedDataSet -- no up-front
+            # materialization of the whole source on one host
+            if y is not None:
+                raise TypeError(
+                    "labels ride inside the partitioned rows "
+                    "((features, label) pairs or .features/.label "
+                    "objects); pass y=None for partitioned sources")
+            return self._fit_partitioned(X)
+        if y is None:
+            raise TypeError("fit(X, y) needs labels unless X is a "
+                            "partitioned source of (features, label) rows")
         X = np.asarray(X, np.float32)
         # infer locally -- a later fit() with a new shape must re-infer
         feature_size = self.feature_size or X.shape[1:]
@@ -112,6 +130,49 @@ class DLEstimator:
             y = y.reshape((-1,) + self.label_size)
         dataset = array_dataset(X, y) >> SampleToMiniBatch(
             self.batch_size, drop_remainder=False)
+        opt = LocalOptimizer(self.model, dataset, self.criterion,
+                             self.optim_method)
+        opt.set_end_when(Trigger.max_epoch(self.max_epoch))
+        opt.optimize()
+        return self.model_cls(self.model, feature_size, self.batch_size)
+
+    def _fit_partitioned(self, source) -> DLModel:
+        from bigdl_tpu.dataset import PartitionedDataSet, Sample
+        from bigdl_tpu.dataset.distributed import (PartitionedSource,
+                                                   source_of)
+
+        src = source_of(source)
+        estimator = self
+
+        def split(r):
+            if hasattr(r, "features"):
+                return (np.asarray(r.features, np.float32),
+                        np.asarray(r.label))
+            f, l = r
+            return np.asarray(f, np.float32), np.asarray(l)
+
+        first_f, _ = split(next(iter(src.partition(0))))
+        feature_size = self.feature_size or first_f.shape
+
+        class _RowPartitions(PartitionedSource):
+            def num_partitions(self):
+                return src.num_partitions()
+
+            def count(self):
+                return src.count()
+
+            def partition(self, idx):
+                pairs = [split(r) for r in src.partition(idx)]
+                labels = estimator._prepare_labels(
+                    np.stack([l for _, l in pairs]))
+                if estimator.label_size:
+                    labels = labels.reshape((-1,)
+                                            + tuple(estimator.label_size))
+                return [Sample(f.reshape(feature_size), lab)
+                        for (f, _), lab in zip(pairs, labels)]
+
+        dataset = PartitionedDataSet(_RowPartitions()) >> \
+            SampleToMiniBatch(self.batch_size, drop_remainder=False)
         opt = LocalOptimizer(self.model, dataset, self.criterion,
                              self.optim_method)
         opt.set_end_when(Trigger.max_epoch(self.max_epoch))

@@ -115,3 +115,77 @@ class TestDLFrames:
         fitted = est.fit(X, Y)
         pred = fitted.transform(X[:16])
         assert np.abs(pred - Y[:16]).mean() < 0.2
+
+
+class TestDLFramesPartitioned:
+    def test_fit_from_partitioned_rows(self):
+        """Reference DLEstimator fits on Spark DataFrames; a partitioned
+        source of (features, label) rows works the same here."""
+        from bigdl_tpu.dlframes import DLClassifier
+        from bigdl_tpu.dataset import ListPartitionSource
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(0)
+        rng = np.random.default_rng(0)
+        rows = [(rng.standard_normal(6).astype(np.float32),
+                 int(rng.integers(0, 3))) for _ in range(64)]
+        src = ListPartitionSource([rows[:32], rows[32:]])
+        model = nn.Sequential().add(nn.Linear(6, 3)).add(nn.LogSoftMax())
+        est = DLClassifier(model, nn.ClassNLLCriterion(),
+                           feature_size=(6,))
+        fitted = est.fit(src)
+        preds = fitted.transform(np.stack([r[0] for r in rows[:8]]))
+        assert np.asarray(preds).shape == (8,)
+        assert set(int(p) for p in preds) <= {0, 1, 2}
+
+    def test_fit_without_labels_rejected(self):
+        from bigdl_tpu.dlframes import DLClassifier
+
+        model = nn.Sequential().add(nn.Linear(4, 2))
+        est = DLClassifier(model, nn.ClassNLLCriterion(),
+                           feature_size=(4,))
+        with pytest.raises(TypeError, match="labels"):
+            est.fit(np.zeros((4, 4), np.float32))
+
+    def test_partitioned_with_explicit_y_rejected(self):
+        """y alongside a partitioned source would be silently discarded
+        (review finding); it raises instead."""
+        from bigdl_tpu.dlframes import DLClassifier
+        from bigdl_tpu.dataset import ListPartitionSource
+
+        model = nn.Sequential().add(nn.Linear(4, 2))
+        est = DLClassifier(model, nn.ClassNLLCriterion(),
+                           feature_size=(4,))
+        src = ListPartitionSource([[(np.zeros(4, np.float32), 0)]])
+        with pytest.raises(TypeError, match="partitioned"):
+            est.fit(src, y=np.zeros(1))
+
+    def test_partitioned_fit_is_lazy(self):
+        """Partitions are pulled through the caching dataset, not
+        materialized up front (review finding): only one partition is
+        touched before optimize() runs."""
+        from bigdl_tpu.dlframes import DLClassifier
+        from bigdl_tpu.dataset import ListPartitionSource
+        from bigdl_tpu.utils.random_generator import RNG
+
+        fetched = []
+
+        class Spy(ListPartitionSource):
+            def partition(self, idx):
+                fetched.append(idx)
+                return super().partition(idx)
+
+        RNG.set_seed(0)
+        rng = np.random.default_rng(0)
+        rows = [(rng.standard_normal(6).astype(np.float32),
+                 int(rng.integers(0, 3))) for _ in range(32)]
+        src = Spy([rows[:16], rows[16:]])
+        model = nn.Sequential().add(nn.Linear(6, 3)).add(nn.LogSoftMax())
+        est = DLClassifier(model, nn.ClassNLLCriterion(),
+                           feature_size=(6,))
+        fitted = est.fit(src)
+        # partition 0 peeked once for the feature size, then both cached
+        # exactly once by the dataset -- never a full eager double-pull
+        assert fetched.count(1) == 1
+        preds = fitted.transform(np.stack([r[0] for r in rows[:4]]))
+        assert np.asarray(preds).shape == (4,)
