@@ -60,10 +60,17 @@ _IMAGENET_CFG = {
 }
 
 
-def ResNet(depth=50, class_num=1000):
+def ResNet(depth=50, class_num=1000, remat=False):
     """ImageNet ResNet; input (N, 224, 224, 3)
-    (reference: ResNet.scala apply with DatasetType.ImageNet)."""
+    (reference: ResNet.scala apply with DatasetType.ImageNet).
+
+    ``remat=True`` wraps every residual block in ``nn.Remat``: the train
+    step recomputes block activations during backward instead of storing
+    them -- a bandwidth-for-FLOPs trade for the HBM-bound TPU step
+    (docs/performance.md).  Numerically identical (tests
+    test_models.py::test_resnet_remat_equivalence)."""
     kind, layout = _IMAGENET_CFG[depth]
+    wrap = nn.Remat if remat else (lambda m: m)
     model = (nn.Sequential()
              .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
                                         with_bias=False, data_format="NHWC",
@@ -76,10 +83,10 @@ def ResNet(depth=50, class_num=1000):
         for i in range(count):
             stride = 2 if (stage > 0 and i == 0) else 1
             if kind == "basic":
-                model.add(basic_block(n_in, p, stride))
+                model.add(wrap(basic_block(n_in, p, stride)))
                 n_in = p
             else:
-                model.add(bottleneck(n_in, p, stride))
+                model.add(wrap(bottleneck(n_in, p, stride)))
                 n_in = p * 4
     model.add(nn.GlobalAveragePooling2D())
     model.add(nn.Linear(n_in, class_num))

@@ -7,7 +7,7 @@ from bigdl_tpu.nn.module import Module, Container, Criterion, Identity, child_rn
 from bigdl_tpu.nn.containers import (
     Sequential, Concat, ConcatTable, ParallelTable, MapTable,
     CAddTable, CMulTable, CSubTable, CDivTable, CMaxTable, CMinTable,
-    JoinTable, SelectTable, FlattenTable,
+    JoinTable, SelectTable, FlattenTable, Remat,
 )
 from bigdl_tpu.nn.graph import Graph, Node, Input
 from bigdl_tpu.nn.linear import Linear

@@ -222,3 +222,59 @@ class FlattenTable(Module):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         return tuple(jax.tree.leaves(input)), state
+
+
+class Remat(Container):
+    """Rematerialise the wrapped module's activations during backward
+    (``jax.checkpoint``).
+
+    TPU-first, no reference analogue: the reference's CPU executors are
+    compute-bound, but a TPU ResNet train step is HBM-bandwidth-bound
+    (docs/performance.md), so recomputing a block's forward inside the
+    backward pass trades idle MXU FLOPs for stored-activation HBM
+    traffic.  ``policy`` is forwarded to ``jax.checkpoint``; pass the
+    NAME of a ``jax.checkpoint_policies`` entry (e.g.
+    ``"dots_saveable"``) so the model stays serializable -- a raw
+    callable also works but cannot be saved.  The default saves only
+    the block inputs.
+
+    Inference (``training=False``) bypasses the checkpoint: there is no
+    backward to rematerialise for.
+
+    Params/state follow the Container keying invariant (child i <->
+    ``params[str(i)]``) so generic traversals (quantize, regularizers)
+    see through the wrapper.
+    """
+
+    def __init__(self, module: Module, policy=None, name=None):
+        super().__init__(name)
+        self.add(module)
+        self.policy = policy
+
+    def _policy(self):
+        if isinstance(self.policy, str):
+            return getattr(jax.checkpoint_policies, self.policy)
+        return self.policy
+
+    def setup(self, rng, input_spec):
+        p, s = self.modules[0].setup(rng, input_spec)
+        return {"0": p}, {"0": s}
+
+    def output_spec(self, params, state, input_spec, training=False):
+        return self.modules[0].output_spec(
+            params["0"], state["0"], input_spec, training=training)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        inner = self.modules[0]
+        if not training:
+            out, s = inner.apply(params["0"], state["0"], input,
+                                 training=False, rng=rng)
+            return out, {"0": s}
+
+        # state/rng are closed over: gradients flow only through params
+        # and input, which is exactly the differentiation surface.
+        def f(p, x):
+            return inner.apply(p, state["0"], x, training=True, rng=rng)
+
+        out, s = jax.checkpoint(f, policy=self._policy())(params["0"], input)
+        return out, {"0": s}

@@ -37,6 +37,35 @@ class TestBenchContract:
         assert rec["vs_baseline"] == 0.0
         assert rec["extra"]["failures"], rec
 
+    def test_hang_mid_sweep_salvages_completed_leg(self):
+        """A child that completes one sweep leg then wedges (big-batch
+        compile on a sick tunnel) must not lose the valid record: the
+        parent salvages the last flushed leg from the killed child."""
+        env = dict(os.environ)
+        env.update(BENCH_FAKE_HANG_MID_SWEEP="1", BENCH_TOTAL_BUDGET="120",
+                   BENCH_TIMEOUT="40", BENCH_RETRIES="1",
+                   BENCH_NO_CPU_FALLBACK="1")
+        proc = subprocess.run([sys.executable, BENCH], env=env,
+                              capture_output=True, text=True, timeout=200)
+        rec = _last_json(proc.stdout)
+        assert rec["value"] == 1234.0, rec
+        assert rec["vs_baseline"] == 0.5
+        assert "salvaged" in rec["extra"], rec
+
+    def test_crash_mid_sweep_salvages_completed_leg(self):
+        """A child that crashes (rc != 0) after a completed leg is
+        salvaged too, with the crash annotated -- not reported as a
+        clean full-sweep success."""
+        env = dict(os.environ)
+        env.update(BENCH_FAKE_CRASH_MID_SWEEP="1", BENCH_TOTAL_BUDGET="120",
+                   BENCH_TIMEOUT="40", BENCH_RETRIES="1",
+                   BENCH_NO_CPU_FALLBACK="1")
+        proc = subprocess.run([sys.executable, BENCH], env=env,
+                              capture_output=True, text=True, timeout=200)
+        rec = _last_json(proc.stdout)
+        assert rec["value"] == 1234.0, rec
+        assert "rc=3" in rec["extra"]["salvaged"], rec
+
     def test_kill_mid_probe_leaves_json(self):
         """SIGTERM at any moment (the driver's timeout) leaves the last
         printed line as a valid record and reaps the hung children."""

@@ -52,6 +52,38 @@ class TestVision:
         one_train_step(model, jnp.zeros((4, 32, 32, 3)),
                        jnp.zeros((4,), jnp.int32), nn.CrossEntropyCriterion())
 
+    def test_resnet_remat_equivalence(self):
+        """remat=True must change memory behavior only: same params after
+        one SGD step, same loss (nn.Remat recomputes, never re-randomises)."""
+        from bigdl_tpu.optim.train_step import make_train_step
+        from bigdl_tpu.utils.random_generator import RNG
+
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 32, 32, 3)), jnp.float32)
+        t = jnp.asarray([1, 5], jnp.int32)
+        results = {}
+        for remat in (False, True):
+            RNG.set_seed(42)
+            model = ResNet(depth=18, class_num=10, remat=remat)
+            model.build(jax.ShapeDtypeStruct(x.shape, x.dtype))
+            params, mstate = model.parameters()[0], model.state()
+            method = optim.SGD(learning_rate=0.05, momentum=0.9)
+            step = jax.jit(make_train_step(
+                model, nn.CrossEntropyCriterion(), method))
+            p2, ms2, _, loss = step(params, mstate,
+                                    method.init_state(params), x, t,
+                                    jax.random.key(0))
+            results[remat] = (p2, ms2, float(loss))
+        assert np.allclose(results[False][2], results[True][2], atol=1e-6)
+        flat_a = jax.tree.leaves(results[False][0])
+        flat_b = jax.tree.leaves(results[True][0])
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(results[False][1]),
+                        jax.tree.leaves(results[True][1])):
+            np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
     def test_vgg_cifar_shapes(self):
         model = VggForCifar10()
         y = model.forward(jnp.zeros((2, 32, 32, 3)))

@@ -227,6 +227,8 @@ EXAMPLES = {
         nn.Tanh()), lambda: _r(2, 4)),
     "MapTable": (lambda: nn.MapTable(nn.Linear(4, 3)),
                  lambda: (_r(2, 4), _r(2, 4))),
+    "Remat": (lambda: nn.Remat(nn.Linear(4, 3), policy="dots_saveable"),
+              lambda: _r(2, 4)),
     "ParallelTable": (lambda: nn.ParallelTable().add(nn.Linear(4, 3)).add(
         nn.Tanh()), lambda: (_r(2, 4), _r(2, 3))),
     "Sequential": (lambda: nn.Sequential().add(nn.Linear(4, 3)).add(

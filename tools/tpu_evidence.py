@@ -45,12 +45,14 @@ def main():
 
     batch = int(os.environ.get("PROF_BATCH", "128"))
     steps = int(os.environ.get("EV_STEPS", "16"))
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
     dev = jax.devices()[0]
     print(json.dumps({"phase": "init", "platform": dev.platform,
+                      "remat": remat,
                       "device_kind": getattr(dev, "device_kind", "")}),
           flush=True)
 
-    model = ResNet(depth=50, class_num=1000)
+    model = ResNet(depth=50, class_num=1000, remat=remat)
     model.build(jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16))
     params, mstate = model.parameters()[0], model.state()
     method = optim.SGD(learning_rate=0.02, momentum=0.9, dampening=0.0,
