@@ -37,14 +37,25 @@ def run_bench():
 
     On TPU, sweeps BENCH_SWEEP batch sizes (default "128,256") and reports
     the best physically-possible record -- larger batches usually lift MFU
-    on the MXU.  BENCH_BATCH overrides with a single batch size.
+    on the MXU.  A "r" suffix on a sweep entry (e.g. "512r") runs that leg
+    with block rematerialisation (nn.Remat; frees activation HBM for the
+    bigger batch).  BENCH_BATCH overrides with a single batch size;
+    BENCH_REMAT=1 sets the default remat mode for suffix-less entries.
     """
     _honor_env_platforms()
     steps = int(os.environ.get("BENCH_STEPS", "20"))
+    default_remat = os.environ.get("BENCH_REMAT", "0") == "1"
+
+    def parse(entry):
+        entry = entry.strip()
+        if entry.endswith("r"):
+            return int(entry[:-1]), True
+        return int(entry), default_remat
+
     if os.environ.get("BENCH_BATCH"):
-        batches = [int(os.environ["BENCH_BATCH"])]
+        batches = [parse(os.environ["BENCH_BATCH"])]
     else:
-        batches = [int(b) for b in
+        batches = [parse(b) for b in
                    os.environ.get("BENCH_SWEEP", "128,256").split(",")]
 
     records, failures = [], []
@@ -55,14 +66,16 @@ def run_bench():
         if len(records) > 1 or failures:
             best["extra"]["sweep"] = [
                 {"batch": r["extra"]["batch"], "mfu": r["extra"].get("mfu"),
+                 "remat": r["extra"].get("remat"),
                  "imgs_per_sec": r["value"]} for r in records] + failures
         return best
 
-    for batch in batches:
+    for batch, remat in batches:
         try:
-            records.append(_bench_one(batch, steps))
+            records.append(_bench_one(batch, steps, remat))
         except Exception as e:          # e.g. OOM at the larger batch:
-            failures.append({"batch": batch, "error": repr(e)[:300]})
+            failures.append({"batch": batch, "remat": remat,
+                             "error": repr(e)[:300]})
             if records:                 # keep the failure visible in any
                 print(json.dumps(best_so_far()), flush=True)  # salvage
             continue                    # keep any already-valid record
@@ -81,7 +94,7 @@ def run_bench():
     print(json.dumps({"bench_complete": True}), flush=True)
 
 
-def _bench_one(batch, steps):
+def _bench_one(batch, steps, remat=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -94,7 +107,6 @@ def _bench_one(batch, steps):
     dev = jax.devices()[0]
     platform = dev.platform
 
-    remat = os.environ.get("BENCH_REMAT", "0") == "1"
     model = ResNet(depth=50, class_num=1000, remat=remat)
     model.build(jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16))
     params, mstate = model.parameters()[0], model.state()

@@ -1,0 +1,74 @@
+"""One-shot perf A/B matrix on the live chip: batch x remat configs.
+
+Run the moment the tunnel is alive (each config is a fresh child process
+so one wedged compile cannot take down the earlier results):
+
+    python tools/perf_ab.py                      # default matrix
+    PERF_AB="128:0,256:0,256:1,512:1" python tools/perf_ab.py
+
+Prints one JSON line per config as it completes (crash/hang-safe), then
+a final summary line.  Timing is bench.py's chained-value-fetch method
+(docs/performance.md); child spawn/kill/salvage is bench.py's own
+_spawn_child, so a wedged or crashed config is reaped and annotated the
+same way the driver bench does.  Per-config wall budget: PERF_AB_TIMEOUT
+(420 s default -- a live-tunnel ResNet-50 compile is ~30 s with the
+persistent cache; a config that cannot finish in 7 min is wedged, move
+on).
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (the shared child-process machinery)
+
+
+def _run_config(batch, remat, steps, timeout):
+    rec, err = bench._spawn_child(
+        {"BENCH_BATCH": str(batch) + ("r" if remat else ""),
+         "BENCH_STEPS": str(steps)}, timeout)
+    if rec is None:
+        return {"batch": batch, "remat": remat, "error": err}
+    e = rec.get("extra", {})
+    out = {"batch": batch, "remat": remat,
+           "platform": e.get("platform"),
+           "imgs_per_sec": rec.get("value"),
+           "sec_per_step": e.get("sec_per_step"),
+           "mfu": e.get("mfu")}
+    for k in ("error", "salvaged", "teardown"):
+        if e.get(k):
+            out[k] = e[k]
+    return out
+
+
+def _valid(r):
+    """A record worth crowning: on-TPU, physically possible, unflagged."""
+    return (r.get("platform") == "tpu" and r.get("mfu")
+            and 0.0 < r["mfu"] <= 1.0 and not r.get("error"))
+
+
+def main():
+    signal.signal(signal.SIGTERM, bench._reap_children)
+    spec = os.environ.get("PERF_AB", "128:0,256:0,128:1,256:1,512:1")
+    steps = int(os.environ.get("PERF_AB_STEPS", "12"))
+    timeout = int(os.environ.get("PERF_AB_TIMEOUT", "420"))
+    results = []
+    for item in spec.split(","):
+        batch, _, remat = item.strip().partition(":")
+        t0 = time.perf_counter()
+        rec = _run_config(int(batch), int(remat or 0), steps, timeout)
+        rec["wall_sec"] = round(time.perf_counter() - t0, 1)
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    ok = [r for r in results if _valid(r)]
+    best = max(ok, key=lambda r: r["mfu"]) if ok else None
+    print(json.dumps({"summary": results, "best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
