@@ -37,20 +37,28 @@ def run_bench():
 
     On TPU, sweeps BENCH_SWEEP batch sizes (default "128,256") and reports
     the best physically-possible record -- larger batches usually lift MFU
-    on the MXU.  A "r" suffix on a sweep entry (e.g. "512r") runs that leg
-    with block rematerialisation (nn.Remat; frees activation HBM for the
-    bigger batch).  BENCH_BATCH overrides with a single batch size;
-    BENCH_REMAT=1 sets the default remat mode for suffix-less entries.
+    on the MXU.  Suffixes on a sweep entry select model variants: "r"
+    (e.g. "512r") runs that leg with block rematerialisation (nn.Remat;
+    frees activation HBM for the bigger batch), "s" with the
+    space-to-depth stem (nn.SpaceToDepthStem); "512rs" combines both.
+    BENCH_BATCH overrides with a single entry; BENCH_REMAT=1 /
+    BENCH_S2D=1 set the default for suffix-less entries.
     """
     _honor_env_platforms()
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     default_remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    default_s2d = os.environ.get("BENCH_S2D", "0") == "1"
 
     def parse(entry):
         entry = entry.strip()
-        if entry.endswith("r"):
-            return int(entry[:-1]), True
-        return int(entry), default_remat
+        remat, s2d = default_remat, default_s2d
+        while entry and entry[-1] in "rs":
+            if entry[-1] == "r":
+                remat = True
+            else:
+                s2d = True
+            entry = entry[:-1]
+        return int(entry), remat, s2d
 
     if os.environ.get("BENCH_BATCH"):
         batches = [parse(os.environ["BENCH_BATCH"])]
@@ -67,14 +75,15 @@ def run_bench():
             best["extra"]["sweep"] = [
                 {"batch": r["extra"]["batch"], "mfu": r["extra"].get("mfu"),
                  "remat": r["extra"].get("remat"),
+                 "s2d": r["extra"].get("s2d"),
                  "imgs_per_sec": r["value"]} for r in records] + failures
         return best
 
-    for batch, remat in batches:
+    for batch, remat, s2d in batches:
         try:
-            records.append(_bench_one(batch, steps, remat))
+            records.append(_bench_one(batch, steps, remat, s2d))
         except Exception as e:          # e.g. OOM at the larger batch:
-            failures.append({"batch": batch, "remat": remat,
+            failures.append({"batch": batch, "remat": remat, "s2d": s2d,
                              "error": repr(e)[:300]})
             if records:                 # keep the failure visible in any
                 print(json.dumps(best_so_far()), flush=True)  # salvage
@@ -94,7 +103,7 @@ def run_bench():
     print(json.dumps({"bench_complete": True}), flush=True)
 
 
-def _bench_one(batch, steps, remat=False):
+def _bench_one(batch, steps, remat=False, s2d=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -107,7 +116,7 @@ def _bench_one(batch, steps, remat=False):
     dev = jax.devices()[0]
     platform = dev.platform
 
-    model = ResNet(depth=50, class_num=1000, remat=remat)
+    model = ResNet(depth=50, class_num=1000, remat=remat, stem_s2d=s2d)
     model.build(jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16))
     params, mstate = model.parameters()[0], model.state()
     method = optim.SGD(learning_rate=0.02, momentum=0.9, dampening=0.0,
@@ -244,6 +253,7 @@ def _bench_one(batch, steps, remat=False):
             "batch": batch,
             "steps": steps,
             "remat": remat,
+            "s2d": s2d,
             "sec_per_step": round(sec_per_step, 4),
             "sec_per_step_chained": round(dt_chain / steps, 4),
             "sec_per_step_fetch": round(sec_per_step_fetch, 4),

@@ -530,6 +530,11 @@ def _is_regularizer(v):
     return isinstance(v, Regularizer)
 
 
+def _is_init_method(v):
+    from bigdl_tpu.nn.initialization import InitializationMethod
+    return isinstance(v, InitializationMethod)
+
+
 def _is_dtype_like(v):
     if isinstance(v, np.dtype):
         return True
@@ -583,6 +588,18 @@ def _encode_value(a, value, ctx):
         else:
             rv.regularizerType = pb.L1L2Regularizer
         rv.regularData.extend([l1, l2])
+    elif _is_init_method(value):
+        # initializer objects (MsraFiller, Xavier, ...) carry only
+        # primitive ctor state; encode as name + kwargs JSON.  They only
+        # matter for re-randomising a loaded architecture -- the saved
+        # weights are installed regardless -- but round-tripping them
+        # keeps e.g. ResNet(stem_s2d=True) saveable (its stem records
+        # weight_init=MsraFiller(False))
+        import json as _json
+        a.dataType = pb.STRING
+        a.subType = "initmethod"
+        a.stringValue = _json.dumps(
+            {"cls": type(value).__name__, "kw": value.__dict__})
     elif _is_dtype_like(value):
         a.dataType = pb.STRING
         a.subType = "dtype"
@@ -649,6 +666,13 @@ def _decode_value(a, ctx):
         return None
     if a.subType == "dtype":
         return jnp.dtype(a.stringValue)
+    if a.subType == "initmethod":
+        import json as _json
+
+        from bigdl_tpu.nn import initialization
+        spec = _json.loads(a.stringValue)
+        obj = getattr(initialization, spec["cls"])(**spec["kw"])
+        return obj
     which = a.WhichOneof("value")
     if which is None:
         return None

@@ -60,21 +60,28 @@ _IMAGENET_CFG = {
 }
 
 
-def ResNet(depth=50, class_num=1000, remat=False):
+def ResNet(depth=50, class_num=1000, remat=False, stem_s2d=False):
     """ImageNet ResNet; input (N, 224, 224, 3)
     (reference: ResNet.scala apply with DatasetType.ImageNet).
 
     ``remat=True`` wraps every residual block in ``nn.Remat``: the train
     step recomputes block activations during backward instead of storing
     them -- a bandwidth-for-FLOPs trade for the HBM-bound TPU step
-    (docs/performance.md).  Numerically identical (tests
-    test_models.py::test_resnet_remat_equivalence)."""
+    (docs/performance.md).  ``stem_s2d=True`` computes the 7x7/s2 stem
+    via ``nn.SpaceToDepthStem`` (identical weights, MXU-friendlier
+    shape).  Both options are numerically equivalent to the plain model
+    (tests test_models.py / test_conv.py)."""
     kind, layout = _IMAGENET_CFG[depth]
     wrap = nn.Remat if remat else (lambda m: m)
+    stem_cls = ((lambda: nn.SpaceToDepthStem(
+                    3, 64, 7, data_format="NHWC",
+                    weight_init=MsraFiller(False)))
+                if stem_s2d else
+                (lambda: nn.SpatialConvolution(
+                    3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
+                    data_format="NHWC", weight_init=MsraFiller(False))))
     model = (nn.Sequential()
-             .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
-                                        with_bias=False, data_format="NHWC",
-                                        weight_init=MsraFiller(False)))
+             .add(stem_cls())
              .add(_bn(64)).add(nn.ReLU())
              .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)))
     n_in = 64

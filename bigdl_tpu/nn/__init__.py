@@ -13,7 +13,7 @@ from bigdl_tpu.nn.graph import Graph, Node, Input
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.conv import (
     SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
-    TemporalConvolution, Conv1D,
+    TemporalConvolution, Conv1D, SpaceToDepthStem,
 )
 from bigdl_tpu.nn.pooling import (
     SpatialMaxPooling, SpatialAveragePooling,

@@ -105,6 +105,69 @@ class SpatialConvolution(Module):
         return y, state
 
 
+class SpaceToDepthStem(SpatialConvolution):
+    """Stride-2 odd-kernel conv computed over a 2x2 space-to-depth input.
+
+    The MLPerf-TPU "conv0" trick, TPU-first and no reference analogue: a
+    7x7/s2 conv on a 3-channel image leaves most of the MXU contraction
+    idle (7*7*3 = 147 tiny channels at 224x224).  Packing each 2x2 pixel
+    block into channels turns it into an equivalent 4x4/s1 conv on
+    112x112x12 -- bigger contraction, quarter the spatial positions,
+    friendlier layout.
+
+    Parameters are byte-identical to the plain ``SpatialConvolution``
+    stem (weight ``[k, k, cin, cout]``, same init): the space-to-depth
+    reshape of BOTH input and weight happens inside ``apply``, so
+    checkpoints, serialization and the param count are interchangeable
+    with the standard stem.  Equivalence is pinned by
+    tests/test_conv.py::test_space_to_depth_stem_equivalence.
+
+    Requires: square odd kernel, stride 2, pad (k-1)//2 with k % 4 == 3
+    (so the padded offset lands on a block boundary: 7x7/pad 3 is the
+    ResNet stem), even H/W, no groups/dilation.
+    """
+
+    def __init__(self, n_input_plane, n_output_plane, kernel=7, **kw):
+        kw.setdefault("with_bias", False)
+        super().__init__(
+            n_input_plane, n_output_plane, kernel, kernel, 2, 2,
+            (kernel - 1) // 2, (kernel - 1) // 2, **kw)
+        kh, kw_ = self.kernel
+        assert kh == kw_ and kh % 4 == 3, "kernel must be odd with pad+1 even"
+        assert self.n_group == 1 and self.dilation == (1, 1)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        n, h, w_sz, c = x.shape
+        assert h % 2 == 0 and w_sz % 2 == 0, "space-to-depth needs even H/W"
+        x = (x.reshape(n, h // 2, 2, w_sz // 2, 2, c)
+              .transpose(0, 1, 3, 2, 4, 5)
+              .reshape(n, h // 2, w_sz // 2, 4 * c))
+        wgt = params["weight"]                       # [k, k, c, o]
+        k, o = wgt.shape[0], wgt.shape[-1]
+        kb = (k + 1) // 2
+        # zero row/col at the top-left aligns the k-tap window onto 2x2
+        # blocks; splitting each padded axis as (block, in-block) then
+        # regrouping gives the equivalent block-space kernel
+        wgt = jnp.pad(wgt, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        wgt = (wgt.reshape(kb, 2, kb, 2, c, o)
+                  .transpose(0, 2, 1, 3, 4, 5)
+                  .reshape(kb, kb, 4 * c, o))
+        pb = (self.pad[0] + 1) // 2
+        pa = kb - 1 - pb
+        y = lax.conv_general_dilated(
+            x, wgt.astype(x.dtype), window_strides=(1, 1),
+            padding=((pb, pa), (pb, pa)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y, state
+
+
 class SpatialDilatedConvolution(SpatialConvolution):
     """Reference: nn/SpatialDilatedConvolution.scala."""
 

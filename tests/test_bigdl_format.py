@@ -134,3 +134,29 @@ class TestRoundTrip:
         assert msg.subModules[0].moduleType == \
             "com.intel.analytics.bigdl.nn.Linear"
         assert msg.subModules[0].attr["inputSize"].int32Value == 4
+
+
+class TestTpuVariantRoundTrip:
+    def test_resnet_s2d_remat_roundtrip(self, tmp_path):
+        """The TPU-only model variants (nn.Remat wrapper, SpaceToDepthStem
+        with a recorded MsraFiller weight_init) must survive the protobuf
+        format via the generic reflection path."""
+        import jax
+
+        from bigdl_tpu.models.resnet import ResNet
+
+        m = ResNet(depth=18, class_num=10, stem_s2d=True, remat=True)
+        m.build(jax.ShapeDtypeStruct((1, 32, 32, 3), jnp.float32))
+        path = str(tmp_path / "m.bigdl")
+        save_bigdl(m, path)
+        m2 = load_bigdl(path)
+        m.evaluate()
+        m2.evaluate()
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (1, 32, 32, 3)), jnp.float32)
+        np.testing.assert_allclose(np.asarray(m.forward(x)),
+                                   np.asarray(m2.forward(x)), atol=1e-5)
+        stem = m2.modules[0]
+        from bigdl_tpu.nn.initialization import MsraFiller
+        assert isinstance(stem.weight_init, MsraFiller)
+        assert stem.weight_init.variance_norm_average is False

@@ -5,6 +5,7 @@ import pytest
 import torch
 import torch.nn.functional as F
 
+import jax
 import jax.numpy as jnp
 
 import bigdl_tpu.nn as nn
@@ -262,3 +263,50 @@ class TestCriterions:
         ref = F.cross_entropy(torch.tensor(x.reshape(10, 4)),
                               torch.tensor(t.reshape(10)))
         assert_close(got, ref.numpy())
+
+
+class TestSpaceToDepthStem:
+    def test_space_to_depth_stem_equivalence(self):
+        """Same [7,7,3,64] weight, same output as the plain 7x7/s2 stem."""
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 32, 32, 3)), jnp.float32)
+        plain = nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
+                                      with_bias=False, data_format="NHWC")
+        plain.build(jax.ShapeDtypeStruct(x.shape, x.dtype))
+        w = plain.parameters()[0]["weight"]
+
+        s2d = nn.SpaceToDepthStem(3, 64, 7, data_format="NHWC")
+        s2d.build(jax.ShapeDtypeStruct(x.shape, x.dtype))
+        assert jax.tree.structure(
+            s2d.parameters()[0]) == jax.tree.structure(plain.parameters()[0])
+        s2d.set_weights([np.asarray(w)])
+
+        y_plain = plain.forward(x)
+        y_s2d = s2d.forward(x)
+        assert y_s2d.shape == y_plain.shape == (2, 16, 16, 64)
+        np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_plain),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_space_to_depth_stem_grads_match(self):
+        x = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (2, 16, 16, 3)), jnp.float32)
+        grads = {}
+        for cls, kwargs in (
+                (nn.SpatialConvolution,
+                 dict(kernel_w=7, kernel_h=7, stride_w=2, stride_h=2,
+                      pad_w=3, pad_h=3, with_bias=False)),
+                (nn.SpaceToDepthStem, dict(kernel=7))):
+            from bigdl_tpu.utils.random_generator import RNG
+            RNG.set_seed(7)
+            m = cls(3, 8, data_format="NHWC", **kwargs)
+            m.build(jax.ShapeDtypeStruct(x.shape, x.dtype))
+            y = m.forward(x)
+            gi = m.backward(x, jnp.ones_like(y))
+            grads[cls.__name__] = (m.parameters()[1], gi)
+        gw_a, gi_a = grads["SpatialConvolution"]
+        gw_b, gi_b = grads["SpaceToDepthStem"]
+        np.testing.assert_allclose(np.asarray(gi_a), np.asarray(gi_b),
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(gw_a["weight"]),
+                                   np.asarray(gw_b["weight"]),
+                                   atol=2e-4, rtol=2e-4)

@@ -229,6 +229,10 @@ EXAMPLES = {
                  lambda: (_r(2, 4), _r(2, 4))),
     "Remat": (lambda: nn.Remat(nn.Linear(4, 3), policy="dots_saveable"),
               lambda: _r(2, 4)),
+    "SpaceToDepthStem": (lambda: nn.SpaceToDepthStem(
+        3, 8, 7, weight_init=__import__(
+            "bigdl_tpu.nn.initialization", fromlist=["MsraFiller"]
+        ).MsraFiller(False)), lambda: _r(2, 8, 8, 3)),
     "ParallelTable": (lambda: nn.ParallelTable().add(nn.Linear(4, 3)).add(
         nn.Tanh()), lambda: (_r(2, 4), _r(2, 3))),
     "Sequential": (lambda: nn.Sequential().add(nn.Linear(4, 3)).add(

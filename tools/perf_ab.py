@@ -4,7 +4,10 @@ Run the moment the tunnel is alive (each config is a fresh child process
 so one wedged compile cannot take down the earlier results):
 
     python tools/perf_ab.py                      # default matrix
-    PERF_AB="128:0,256:0,256:1,512:1" python tools/perf_ab.py
+    PERF_AB="128:0,256:0,256:r,512:r,256:rs" python tools/perf_ab.py
+
+Config flags after the colon: "r" = nn.Remat blocks, "s" =
+space-to-depth stem, "1" = legacy alias for "r", "0"/empty = plain.
 
 Prints one JSON line per config as it completes (crash/hang-safe), then
 a final summary line.  Timing is bench.py's chained-value-fetch method
@@ -28,14 +31,18 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402  (the shared child-process machinery)
 
 
-def _run_config(batch, remat, steps, timeout):
+def _run_config(batch, remat, s2d, steps, timeout):
+    suffix = ("r" if remat else "") + ("s" if s2d else "")
+    # pin the env defaults to 0 so an inherited BENCH_REMAT/BENCH_S2D
+    # can't silently turn a labeled-plain leg into a variant run
     rec, err = bench._spawn_child(
-        {"BENCH_BATCH": str(batch) + ("r" if remat else ""),
-         "BENCH_STEPS": str(steps)}, timeout)
+        {"BENCH_BATCH": str(batch) + suffix,
+         "BENCH_STEPS": str(steps),
+         "BENCH_REMAT": "0", "BENCH_S2D": "0"}, timeout)
     if rec is None:
-        return {"batch": batch, "remat": remat, "error": err}
+        return {"batch": batch, "remat": remat, "s2d": s2d, "error": err}
     e = rec.get("extra", {})
-    out = {"batch": batch, "remat": remat,
+    out = {"batch": batch, "remat": remat, "s2d": s2d,
            "platform": e.get("platform"),
            "imgs_per_sec": rec.get("value"),
            "sec_per_step": e.get("sec_per_step"),
@@ -54,14 +61,17 @@ def _valid(r):
 
 def main():
     signal.signal(signal.SIGTERM, bench._reap_children)
-    spec = os.environ.get("PERF_AB", "128:0,256:0,128:1,256:1,512:1")
+    spec = os.environ.get(
+        "PERF_AB", "128:0,256:0,128:r,256:r,512:r,256:rs")
     steps = int(os.environ.get("PERF_AB_STEPS", "12"))
     timeout = int(os.environ.get("PERF_AB_TIMEOUT", "420"))
     results = []
     for item in spec.split(","):
-        batch, _, remat = item.strip().partition(":")
+        batch, _, flags = item.strip().partition(":")
+        remat = "r" in flags or "1" in flags
+        s2d = "s" in flags
         t0 = time.perf_counter()
-        rec = _run_config(int(batch), int(remat or 0), steps, timeout)
+        rec = _run_config(int(batch), remat, s2d, steps, timeout)
         rec["wall_sec"] = round(time.perf_counter() - t0, 1)
         results.append(rec)
         print(json.dumps(rec), flush=True)
