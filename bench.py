@@ -25,6 +25,37 @@ import sys
 import time
 
 
+# single source of truth for the model-variant flag vocabulary shared by
+# the sweep suffix syntax here, tools/perf_ab.py and tools/tpu_evidence.py:
+# (kwarg name, suffix letter, env var giving the suffix-less default)
+VARIANT_FLAGS = (("remat", "r", "BENCH_REMAT"),
+                 ("s2d", "s", "BENCH_S2D"),
+                 ("fused", "f", "BENCH_FUSED"))
+
+
+def variant_defaults(env=None):
+    """{name: bool} defaults from the BENCH_* env tier."""
+    env = os.environ if env is None else env
+    return {name: env.get(var, "0") == "1" for name, _, var in VARIANT_FLAGS}
+
+
+def parse_variant(entry, defaults=None):
+    """"512rf" -> (512, {"remat": True, "s2d": False, "fused": True})."""
+    entry = entry.strip()
+    flags = dict(variant_defaults() if defaults is None else defaults)
+    letters = {letter: name for name, letter, _ in VARIANT_FLAGS}
+    while entry and entry[-1] in letters:
+        flags[letters[entry[-1]]] = True
+        entry = entry[:-1]
+    return int(entry), flags
+
+
+def variant_suffix(flags):
+    """{"remat": True, ...} -> "r..." (inverse of parse_variant)."""
+    return "".join(letter for name, letter, _ in VARIANT_FLAGS
+                   if flags.get(name))
+
+
 def _honor_env_platforms():
     from bigdl_tpu.utils.config import (enable_compilation_cache,
                                         honor_env_platforms)
@@ -40,30 +71,19 @@ def run_bench():
     on the MXU.  Suffixes on a sweep entry select model variants: "r"
     (e.g. "512r") runs that leg with block rematerialisation (nn.Remat;
     frees activation HBM for the bigger batch), "s" with the
-    space-to-depth stem (nn.SpaceToDepthStem); "512rs" combines both.
+    space-to-depth stem (nn.SpaceToDepthStem), "f" with the flat fused
+    optimizer update (optim.Fused); "512rf" combines them.
     BENCH_BATCH overrides with a single entry; BENCH_REMAT=1 /
-    BENCH_S2D=1 set the default for suffix-less entries.
+    BENCH_S2D=1 / BENCH_FUSED=1 set the default for suffix-less entries.
     """
     _honor_env_platforms()
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    default_remat = os.environ.get("BENCH_REMAT", "0") == "1"
-    default_s2d = os.environ.get("BENCH_S2D", "0") == "1"
-
-    def parse(entry):
-        entry = entry.strip()
-        remat, s2d = default_remat, default_s2d
-        while entry and entry[-1] in "rs":
-            if entry[-1] == "r":
-                remat = True
-            else:
-                s2d = True
-            entry = entry[:-1]
-        return int(entry), remat, s2d
+    defaults = variant_defaults()
 
     if os.environ.get("BENCH_BATCH"):
-        batches = [parse(os.environ["BENCH_BATCH"])]
+        batches = [parse_variant(os.environ["BENCH_BATCH"], defaults)]
     else:
-        batches = [parse(b) for b in
+        batches = [parse_variant(b, defaults) for b in
                    os.environ.get("BENCH_SWEEP", "128,256").split(",")]
 
     records, failures = [], []
@@ -76,15 +96,15 @@ def run_bench():
                 {"batch": r["extra"]["batch"], "mfu": r["extra"].get("mfu"),
                  "remat": r["extra"].get("remat"),
                  "s2d": r["extra"].get("s2d"),
+                 "fused": r["extra"].get("fused"),
                  "imgs_per_sec": r["value"]} for r in records] + failures
         return best
 
-    for batch, remat, s2d in batches:
+    for batch, flags in batches:
         try:
-            records.append(_bench_one(batch, steps, remat, s2d))
+            records.append(_bench_one(batch, steps, **flags))
         except Exception as e:          # e.g. OOM at the larger batch:
-            failures.append({"batch": batch, "remat": remat, "s2d": s2d,
-                             "error": repr(e)[:300]})
+            failures.append({"batch": batch, "error": repr(e)[:300], **flags})
             if records:                 # keep the failure visible in any
                 print(json.dumps(best_so_far()), flush=True)  # salvage
             continue                    # keep any already-valid record
@@ -103,7 +123,7 @@ def run_bench():
     print(json.dumps({"bench_complete": True}), flush=True)
 
 
-def _bench_one(batch, steps, remat=False, s2d=False):
+def _bench_one(batch, steps, remat=False, s2d=False, fused=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -121,6 +141,10 @@ def _bench_one(batch, steps, remat=False, s2d=False):
     params, mstate = model.parameters()[0], model.state()
     method = optim.SGD(learning_rate=0.02, momentum=0.9, dampening=0.0,
                        weight_decay=1e-4)
+    if fused:
+        # flat-vector update: one HBM-bound kernel instead of ~100
+        # per-tensor fusions (docs/performance.md, Fused docstring)
+        method = optim.Fused(method)
     opt_state = method.init_state(params)
 
     step = jax.jit(
@@ -254,6 +278,7 @@ def _bench_one(batch, steps, remat=False, s2d=False):
             "steps": steps,
             "remat": remat,
             "s2d": s2d,
+            "fused": fused,
             "sec_per_step": round(sec_per_step, 4),
             "sec_per_step_chained": round(dt_chain / steps, 4),
             "sec_per_step_fetch": round(sec_per_step_fetch, 4),

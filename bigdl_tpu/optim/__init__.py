@@ -1,5 +1,6 @@
 from bigdl_tpu.optim.optim_method import (
     OptimMethod, SGD, Adam, ParallelAdam, Adagrad, Adadelta, RMSprop, Adamax, Ftrl,
+    Fused,
     LearningRateSchedule, Default, Step, MultiStep, Poly, Exponential,
     NaturalExp, Warmup, SequentialSchedule, EpochDecayWithWarmUp,
     EpochSchedule, EpochDecay, EpochStep, Plateau,

@@ -9,6 +9,31 @@ TF-shipped proto (no tensorboard plugin needed).
 
 import glob
 import os
+import re
+
+
+def _iter_device_planes(trace_dir):
+    """Yield every device (TPU/XLA) plane in the trace's xplane files.
+
+    Yields nothing when the TF proto is unavailable (e.g. CPU-only
+    environments) -- both public readers then return None.
+    """
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:
+        try:
+            from tensorflow.core.profiler.protobuf import xplane_pb2
+        except Exception:
+            return
+    for path in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                          recursive=True):
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            name = plane.name.lower()
+            if "tpu" in name or "device" in name or "xla" in name:
+                yield plane
 
 
 def device_busy(trace_dir):
@@ -18,42 +43,85 @@ def device_busy(trace_dir):
     (TPU/XLA) plane with the longest span, or None when no device plane
     or proto support is available (e.g. CPU-only traces).
     """
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception:
-        try:
-            from tensorflow.core.profiler.protobuf import xplane_pb2
-        except Exception:
-            return None
     best = None
-    for path in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
-                          recursive=True):
-        xs = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs.ParseFromString(f.read())
-        for plane in xs.planes:
-            name = plane.name.lower()
-            if not ("tpu" in name or "device" in name or "xla" in name):
-                continue
-            lo, hi, busiest = None, None, 0
-            for line in plane.lines:
-                # event offsets are relative to the LINE's timestamp;
-                # align to absolute picoseconds before comparing lines
-                base = line.timestamp_ns * 1000
-                line_busy = 0
-                for ev in line.events:
-                    start = base + ev.offset_ps
-                    end = start + ev.duration_ps
-                    lo = start if lo is None else min(lo, start)
-                    hi = end if hi is None else max(hi, end)
-                    line_busy += ev.duration_ps
-                # lines nest hierarchically (modules > ops): summing
-                # across lines double-counts, so busy = the busiest line
+    for plane in _iter_device_planes(trace_dir):
+        lo, hi, busiest = None, None, 0
+        for line in plane.lines:
+            # event offsets are relative to the LINE's timestamp;
+            # align to absolute picoseconds before comparing lines
+            base = line.timestamp_ns * 1000
+            line_busy = 0
+            for ev in line.events:
+                start = base + ev.offset_ps
+                end = start + ev.duration_ps
+                lo = start if lo is None else min(lo, start)
+                hi = end if hi is None else max(hi, end)
+                line_busy += ev.duration_ps
+            # lines nest hierarchically (modules > ops): summing
+            # across lines double-counts, and async lines (e.g.
+            # "Async XLA Ops") hold in-flight spans that overlap
+            # compute -- so busy = the busiest synchronous line
+            if "async" not in line.name.lower():
                 busiest = max(busiest, line_busy)
-            if hi is not None:
-                rec = {"plane": plane.name,
-                       "span_sec": (hi - lo) / 1e12,
-                       "busy_event_sec": busiest / 1e12}
-                if best is None or rec["span_sec"] > best["span_sec"]:
-                    best = rec
+        if hi is not None:
+            rec = {"plane": plane.name,
+                   "span_sec": (hi - lo) / 1e12,
+                   "busy_event_sec": busiest / 1e12}
+            if best is None or rec["span_sec"] > best["span_sec"]:
+                best = rec
+    return best
+
+
+def op_breakdown(trace_dir, top=30):
+    """Aggregate device-plane event time by op name and opcode category.
+
+    The per-op HLO time accounting the perf docs cite: for the device
+    plane's op-level line, sums event durations by name and returns
+    ``{"plane", "total_sec", "categories": [...], "ops": [{"name",
+    "sec", "pct", "count"}, ...]}`` with the top-N ops by total time, or
+    None when no device plane / proto support exists.  Event names are
+    resolved through the plane's metadata table (events carry metadata
+    ids, not strings).
+    """
+    best = None
+    for plane in _iter_device_planes(trace_dir):
+        meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+        # the op-level accounting line is "XLA Ops" (serialized,
+        # non-overlapping); fall back to the busiest line that is
+        # not an async (in-flight, overlapping) line
+        busiest_line, busiest = None, 0
+        for line in plane.lines:
+            if line.name == "XLA Ops":
+                busiest_line = line
+                break
+            if "async" in line.name.lower():
+                continue
+            line_busy = sum(ev.duration_ps for ev in line.events)
+            if line_busy > busiest:
+                busiest, busiest_line = line_busy, line
+        if busiest_line is None:
+            continue
+        by_op, by_cat = {}, {}
+        for ev in busiest_line.events:
+            op = meta.get(ev.metadata_id, str(ev.metadata_id))
+            sec, cnt = by_op.get(op, (0, 0))
+            by_op[op] = (sec + ev.duration_ps, cnt + 1)
+            m = re.search(r"= \S+ ([a-z][a-z0-9_-]*)\(", op)
+            cat = m.group(1) if m else op.split(".")[0].lstrip("%")
+            sec, cnt = by_cat.get(cat, (0, 0))
+            by_cat[cat] = (sec + ev.duration_ps, cnt + 1)
+        total = sum(s for s, _ in by_op.values())
+        if not total:
+            continue
+        ops = sorted(by_op.items(), key=lambda kv: -kv[1][0])[:top]
+        cats = sorted(by_cat.items(), key=lambda kv: -kv[1][0])
+        rec = {"plane": plane.name, "total_sec": total / 1e12,
+               "categories": [{"name": cat, "sec": s / 1e12,
+                               "pct": round(100.0 * s / total, 2),
+                               "count": c} for cat, (s, c) in cats],
+               "ops": [{"name": op, "sec": s / 1e12,
+                        "pct": round(100.0 * s / total, 2), "count": c}
+                       for op, (s, c) in ops]}
+        if best is None or rec["total_sec"] > best["total_sec"]:
+            best = rec
     return best

@@ -71,6 +71,13 @@ class TestBenchContract:
         printed line as a valid record and reaps the hung children."""
         env = dict(os.environ)
         env["BENCH_FAKE_HANG"] = "1"
+        # unique tag inherited by the whole bench process tree
+        # (_spawn_child copies os.environ), so the leak scan below cannot
+        # match bench children of an UNRELATED concurrent run (e.g.
+        # tools/perf_ab.py on the live chip)
+        value = f"{os.getpid()}_{time.time_ns()}"
+        env["BENCH_TEST_TOKEN"] = value
+        token = f"BENCH_TEST_TOKEN={value}"
         proc = subprocess.Popen([sys.executable, BENCH], env=env,
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True)
@@ -83,12 +90,16 @@ class TestBenchContract:
             assert rec["vs_baseline"] == 0.0
             # the SIGTERM handler must have reaped the hung child group
             time.sleep(1)
-            # anchor on the absolute script path at end-of-cmdline:
-            # a bare "bench.py" pattern also matches the test harness's
-            # own command line
-            left = subprocess.run(
-                ["pgrep", "-f", BENCH.replace(".", r"\.") + "$"],
-                capture_output=True, text=True).stdout.strip()
+            left = []
+            for pid in os.listdir("/proc"):
+                if not pid.isdigit() or int(pid) == proc.pid:
+                    continue
+                try:
+                    with open(f"/proc/{pid}/environ", "rb") as f:
+                        if token.encode() in f.read():
+                            left.append(pid)
+                except OSError:
+                    continue
             assert not left, f"leaked bench children: {left}"
         finally:
             if proc.poll() is None:

@@ -1,0 +1,114 @@
+"""Fused (flat-vector) optimizer wrapper: numerical equivalence.
+
+The wrapper exists for single-chip update throughput
+(docs/performance.md: per-tensor update fusions cost ~10 ms of a 46 ms
+ResNet-50 step); correctness bar is numerically equivalent trajectories
+(atol 1e-6) vs the unfused method -- elementwise math commutes with
+concatenation, but XLA may reassociate the fused kernel differently.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+from bigdl_tpu.optim.train_step import make_train_step
+
+
+def _model():
+    return (nn.Sequential()
+            .add(nn.Linear(12, 16)).add(nn.ReLU())
+            .add(nn.BatchNormalization(16)).add(nn.Linear(16, 5)))
+
+
+def _run(method, steps=4):
+    from bigdl_tpu.utils.random_generator import RNG
+    RNG.set_seed(42)
+    model = _model()
+    model.build(jax.ShapeDtypeStruct((8, 12), jnp.float32))
+    params, mstate = model.parameters()[0], model.state()
+    step = jax.jit(make_train_step(
+        model, CrossEntropyCriterion(), method,
+        compute_dtype=jnp.float32))
+    opt_state = method.init_state(params)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 12))
+    y = jnp.arange(8) % 5
+    losses = []
+    for i in range(steps):
+        params, mstate, opt_state, loss = step(
+            params, mstate, opt_state, x, y, jax.random.PRNGKey(i))
+        losses.append(float(loss))
+    return params, losses
+
+
+METHODS = [
+    lambda: optim.SGD(learning_rate=0.05, momentum=0.9, dampening=0.0,
+                      weight_decay=1e-4, nesterov=True),
+    lambda: optim.Adam(learning_rate=1e-2),
+    lambda: optim.RMSprop(learning_rate=1e-2),
+    lambda: optim.Adagrad(learning_rate=1e-2),
+]
+
+
+@pytest.mark.parametrize("mk", METHODS,
+                         ids=["sgd", "adam", "rmsprop", "adagrad"])
+def test_fused_matches_unfused(mk):
+    p_ref, l_ref = _run(mk())
+    p_fused, l_fused = _run(optim.Fused(mk()))
+    np.testing.assert_allclose(np.array(l_ref), np.array(l_fused),
+                               rtol=0, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_fused)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=1e-6)
+
+
+def test_fused_rejects_non_elementwise():
+    from bigdl_tpu.optim.lbfgs import LBFGS
+    with pytest.raises(TypeError):
+        optim.Fused(LBFGS())
+
+
+def test_fused_state_is_flat():
+    method = optim.Fused(optim.SGD(learning_rate=0.1, momentum=0.9))
+    params = {"a": jnp.zeros((3, 4)), "b": jnp.zeros((7,))}
+    state = method.init_state(params)
+    assert state["velocity"].shape == (19,)
+    assert float(method.get_learning_rate(state)) == pytest.approx(0.1)
+
+
+def test_fused_rejects_mixed_dtypes():
+    """ravel_pytree would silently promote to the widest dtype; the
+    wrapper must refuse instead of quietly changing numerics."""
+    method = optim.Fused(optim.SGD(learning_rate=0.1))
+    params = {"a": jnp.zeros((3,), jnp.float32),
+              "b": jnp.zeros((3,), jnp.bfloat16)}
+    with pytest.raises(TypeError):
+        method.init_state(params)
+
+
+def test_fused_learning_rate_is_mutable():
+    """DLEstimator.set_learning_rate assigns .learning_rate on any
+    OptimMethod; the wrapper must keep that contract."""
+    method = optim.Fused(optim.SGD(learning_rate=0.1))
+    method.learning_rate = 0.5
+    assert method.inner.learning_rate == 0.5
+    assert method.learning_rate == 0.5
+
+
+def test_fused_update_count_is_one_kernel():
+    """The point of the wrapper: the compiled step contains exactly one
+    parameter-update region -- the HLO has no per-tensor update fan-out.
+    Proxy check: the jaxpr of the update has a single concatenate of the
+    grads and a single concatenate of the params (ravel), not N subtracts
+    over N param leaves.
+    """
+    method = optim.Fused(optim.SGD(learning_rate=0.1))
+    params = {"a": jnp.ones((3, 4)), "b": jnp.ones((7,))}
+    grads = jax.tree.map(jnp.ones_like, params)
+    state = method.init_state(params)
+    jpr = jax.make_jaxpr(lambda g, s, p: method.update(g, s, p))(
+        grads, state, params)
+    subs = [e for e in jpr.jaxpr.eqns if e.primitive.name == "sub"]
+    assert len(subs) == 1

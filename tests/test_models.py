@@ -80,6 +80,8 @@ class TestVision:
                                     method.init_state(params), x, t,
                                     jax.random.key(0))
             results[remat] = (p2, ms2, float(loss))
+        # 1e-4, not 1e-6: remat recomputes activations in a separately
+        # fused backward, so XLA may reassociate reductions differently
         assert np.allclose(results[False][2], results[True][2], atol=1e-4)
         flat_a = jax.tree.leaves(results[False][0])
         flat_b = jax.tree.leaves(results[True][0])

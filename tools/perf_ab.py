@@ -7,7 +7,8 @@ so one wedged compile cannot take down the earlier results):
     PERF_AB="128:0,256:0,256:r,512:r,256:rs" python tools/perf_ab.py
 
 Config flags after the colon: "r" = nn.Remat blocks, "s" =
-space-to-depth stem, "1" = legacy alias for "r", "0"/empty = plain.
+space-to-depth stem, "f" = flat fused optimizer update (optim.Fused),
+"1" = legacy alias for "r", "0"/empty = plain.
 
 Prints one JSON line per config as it completes (crash/hang-safe), then
 a final summary line.  Timing is bench.py's chained-value-fetch method
@@ -31,18 +32,17 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402  (the shared child-process machinery)
 
 
-def _run_config(batch, remat, s2d, steps, timeout):
-    suffix = ("r" if remat else "") + ("s" if s2d else "")
-    # pin the env defaults to 0 so an inherited BENCH_REMAT/BENCH_S2D
+def _run_config(batch, flags, steps, timeout):
+    # pin every variant env default to 0 so an inherited BENCH_REMAT etc.
     # can't silently turn a labeled-plain leg into a variant run
-    rec, err = bench._spawn_child(
-        {"BENCH_BATCH": str(batch) + suffix,
-         "BENCH_STEPS": str(steps),
-         "BENCH_REMAT": "0", "BENCH_S2D": "0"}, timeout)
+    child_env = {"BENCH_BATCH": str(batch) + bench.variant_suffix(flags),
+                 "BENCH_STEPS": str(steps)}
+    child_env.update({var: "0" for _, _, var in bench.VARIANT_FLAGS})
+    rec, err = bench._spawn_child(child_env, timeout)
     if rec is None:
-        return {"batch": batch, "remat": remat, "s2d": s2d, "error": err}
+        return {"batch": batch, "error": err, **flags}
     e = rec.get("extra", {})
-    out = {"batch": batch, "remat": remat, "s2d": s2d,
+    out = {"batch": batch, **flags,
            "platform": e.get("platform"),
            "imgs_per_sec": rec.get("value"),
            "sec_per_step": e.get("sec_per_step"),
@@ -67,11 +67,14 @@ def main():
     timeout = int(os.environ.get("PERF_AB_TIMEOUT", "420"))
     results = []
     for item in spec.split(","):
-        batch, _, flags = item.strip().partition(":")
-        remat = "r" in flags or "1" in flags
-        s2d = "s" in flags
+        batch, _, letters = item.strip().partition(":")
+        if "1" in letters:              # legacy alias for "r"
+            letters = letters.replace("1", "r")
+        _, flags = bench.parse_variant(
+            batch + letters.replace("0", ""),
+            {name: False for name, _, _ in bench.VARIANT_FLAGS})
         t0 = time.perf_counter()
-        rec = _run_config(int(batch), remat, s2d, steps, timeout)
+        rec = _run_config(int(batch), flags, steps, timeout)
         rec["wall_sec"] = round(time.perf_counter() - t0, 1)
         results.append(rec)
         print(json.dumps(rec), flush=True)

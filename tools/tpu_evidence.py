@@ -45,18 +45,22 @@ def main():
 
     batch = int(os.environ.get("PROF_BATCH", "128"))
     steps = int(os.environ.get("EV_STEPS", "16"))
-    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    import bench
+    flags = bench.variant_defaults()
+    remat, s2d, fused = flags["remat"], flags["s2d"], flags["fused"]
     dev = jax.devices()[0]
     print(json.dumps({"phase": "init", "platform": dev.platform,
-                      "remat": remat,
+                      "remat": remat, "s2d": s2d, "fused": fused,
                       "device_kind": getattr(dev, "device_kind", "")}),
           flush=True)
 
-    model = ResNet(depth=50, class_num=1000, remat=remat)
+    model = ResNet(depth=50, class_num=1000, remat=remat, stem_s2d=s2d)
     model.build(jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.bfloat16))
     params, mstate = model.parameters()[0], model.state()
     method = optim.SGD(learning_rate=0.02, momentum=0.9, dampening=0.0,
                        weight_decay=1e-4)
+    if fused:
+        method = optim.Fused(method)
     opt_state = method.init_state(params)
     step = jax.jit(
         make_train_step(model, CrossEntropyCriterion(), method,
@@ -97,9 +101,10 @@ def main():
                       "loss": final}), flush=True)
 
     # phase 2: the same window under a profiler trace (independent witness)
+    suffix = bench.variant_suffix(flags)
     trace_dir = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "traces",
-        f"r4_{dev.platform}_b{batch}")
+        f"r4_{dev.platform}_b{batch}{suffix}")
     os.makedirs(trace_dir, exist_ok=True)
     t0 = time.perf_counter()
     with jax.profiler.trace(trace_dir):
@@ -114,6 +119,19 @@ def main():
                       "wall_sec_per_step": round(dt_traced / steps, 5),
                       "trace_dir": trace_dir,
                       "device_plane": plane}), flush=True)
+
+    # phase 2b: per-op time accounting from the same trace (where the
+    # device time actually goes -- drives the optimisation list in
+    # docs/performance.md)
+    from bigdl_tpu.utils.xplane import op_breakdown
+    bd = op_breakdown(trace_dir, top=8)
+    if bd:
+        print(json.dumps({"phase": "op_breakdown",
+                          "total_sec": round(bd["total_sec"], 4),
+                          "categories": [
+                              {k: (round(v, 5) if isinstance(v, float)
+                                   else v) for k, v in c.items()}
+                              for c in bd["categories"][:8]]}), flush=True)
 
     # phase 3: HLO fusion evidence
     txt = compiled.as_text()
