@@ -66,7 +66,9 @@ def _honor_env_platforms():
 def run_bench():
     """Run the benchmark in-process and print the result JSON line.
 
-    On TPU, sweeps BENCH_SWEEP batch sizes (default "128,256") and reports
+    On TPU, sweeps BENCH_SWEEP batch sizes (default "128,128f,256f" --
+    the plain-128 anchor plus the flat-fused-update legs the round-4 op
+    accounting motivates) and reports
     the best physically-possible record -- larger batches usually lift MFU
     on the MXU.  Suffixes on a sweep entry select model variants: "r"
     (e.g. "512r") runs that leg with block rematerialisation (nn.Remat;
@@ -84,7 +86,8 @@ def run_bench():
         batches = [parse_variant(os.environ["BENCH_BATCH"], defaults)]
     else:
         batches = [parse_variant(b, defaults) for b in
-                   os.environ.get("BENCH_SWEEP", "128,256").split(",")]
+                   os.environ.get("BENCH_SWEEP",
+                                  "128,128f,256f").split(",")]
 
     records, failures = [], []
 

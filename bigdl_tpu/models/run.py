@@ -212,12 +212,16 @@ def cmd_resnet_imagenet_train(args):
         x, y = _synthetic_images(max(args.synth_n // 4, args.batch * 2),
                                  224, 224, 3, 1000)
 
-    model = ResNet(depth=50, class_num=1000)
+    model = ResNet(depth=50, class_num=1000, remat=args.remat,
+                   stem_s2d=args.s2d)
     method = optim.SGD(
         learning_rate=base_lr, momentum=0.9, dampening=0.0,
         weight_decay=1e-4,
         learning_rate_schedule=optim.EpochDecayWithWarmUp(
             warmup_iteration, delta, steps_per_epoch))
+    if args.fused:
+        # one flat-vector parameter update kernel (docs/performance.md)
+        method = optim.Fused(method)
     opt = _build_optimizer(
         args, model, _to_dataset(x, y, args.batch), None,
         nn.CrossEntropyCriterion(), method, [optim.Top1Accuracy()])
@@ -364,7 +368,13 @@ def main(argv=None):
                          [("--depth", dict(type=int, default=20))]),
         "resnet-imagenet-train": (
             cmd_resnet_imagenet_train, 90,
-            [("--maxLr", dict(type=float, default=3.2, dest="max_lr"))]),
+            [("--maxLr", dict(type=float, default=3.2, dest="max_lr")),
+             ("--fused", dict(action="store_true",
+                              help="flat fused optimizer update")),
+             ("--remat", dict(action="store_true",
+                              help="rematerialise residual blocks")),
+             ("--s2d", dict(action="store_true",
+                            help="space-to-depth 7x7 stem"))]),
         "inception-train": (cmd_inception_train, 1,
                             [("--version", dict(default="v1",
                                                 choices=["v1", "v2"])),

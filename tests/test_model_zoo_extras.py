@@ -73,3 +73,8 @@ class TestRunCommandsSmoke:
         """The published warmup recipe wiring (models/resnet/README.md:
         131-149) runs on the synthetic stand-in."""
         self._run("resnet-imagenet-train")
+
+    def test_resnet_imagenet_recipe_perf_flags(self):
+        """--fused/--remat/--s2d select the measured-on-chip perf variants
+        without changing the recipe."""
+        self._run("resnet-imagenet-train", "--fused", "--remat", "--s2d")

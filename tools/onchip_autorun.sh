@@ -1,0 +1,37 @@
+#!/bin/bash
+# Poll the TPU tunnel; the moment it answers, run the round-4 fused/batch
+# A/B evidence sequence. Append everything to tools/onchip_autorun.log.
+# Usage: nohup bash tools/onchip_autorun.sh & (safe to re-run; uses a lock)
+cd "$(dirname "$0")/.." || exit 1
+LOG=tools/onchip_autorun.log
+LOCK=/tmp/onchip_autorun.lock
+exec 9>"$LOCK"
+flock -n 9 || { echo "another autorun holds the lock" >>"$LOG"; exit 0; }
+
+echo "=== autorun start $(date -u +%FT%TZ)" >>"$LOG"
+for i in $(seq 1 60); do            # up to ~5h of probing
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d; print(d)" >>"$LOG" 2>&1; then
+    echo "--- tunnel ALIVE at $(date -u +%FT%TZ); running evidence legs" >>"$LOG"
+    # leg 1: fused @128 (the A/B the op accounting motivates)
+    BENCH_FUSED=1 PROF_BATCH=128 EV_STEPS=16 timeout 1500 \
+      python tools/tpu_evidence.py >>"$LOG" 2>&1
+    echo "--- leg 128f done rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+    # leg 2: fused @256
+    BENCH_FUSED=1 PROF_BATCH=256 EV_STEPS=16 timeout 1500 \
+      python tools/tpu_evidence.py >>"$LOG" 2>&1
+    echo "--- leg 256f done rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+    # leg 3: fused+s2d+remat @512 (HBM headroom config)
+    BENCH_FUSED=1 BENCH_S2D=1 BENCH_REMAT=1 PROF_BATCH=512 EV_STEPS=12 \
+      timeout 1500 python tools/tpu_evidence.py >>"$LOG" 2>&1
+    echo "--- leg 512rsf done rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+    # leg 4: int8 vs bf16 inference (the BigQuant headline analogue)
+    QP_BATCH=128 QP_STEPS=16 timeout 1200 \
+      python tools/quant_perf.py >>"$LOG" 2>&1
+    echo "--- leg quant done rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+    echo "=== autorun complete $(date -u +%FT%TZ)" >>"$LOG"
+    exit 0
+  fi
+  echo "probe $i dead $(date -u +%FT%TZ)" >>"$LOG"
+  sleep 240
+done
+echo "=== autorun gave up $(date -u +%FT%TZ)" >>"$LOG"
