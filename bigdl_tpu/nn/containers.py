@@ -103,6 +103,12 @@ class MapTable(Container):
     def setup(self, rng, input_spec):
         return self.modules[0].setup(rng, input_spec[0])
 
+    def _param_child_items(self, params):
+        # the shared module's params ARE this container's params (no key
+        # level); the None key routes the whole subtree to it in the
+        # frozen-mask walk
+        return [(None, self.modules[0])]
+
     def apply(self, params, state, input, *, training=False, rng=None):
         outs = []
         s = state

@@ -77,6 +77,13 @@ class Graph(Container):
         ins = [values[id(p)] for p in node.inputs]
         return ins[0] if len(ins) == 1 else tuple(ins)
 
+    def _param_child_items(self, params):
+        # params are keyed by TOPO index (module-less Input nodes consume
+        # indices), not by child-list position -- align accordingly for
+        # the frozen-mask walk
+        return [(str(i), node.module) for i, node in enumerate(self._topo)
+                if node.module is not None]
+
     def setup(self, rng, input_spec):
         specs = {}
         in_specs = (

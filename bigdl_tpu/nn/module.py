@@ -83,6 +83,18 @@ def _install_pending_after_setup(cls):
     cls.setup = setup
 
 
+class _Name(str):
+    """Module name that is BOTH an attribute and callable.
+
+    The reference exposes the name as a METHOD (pyspark Layer.name(),
+    AbstractModule.getName), while this codebase reads ``module.name`` as
+    a plain string everywhere; a callable str subclass satisfies both
+    (``m.name`` and ``m.name()`` return the same string)."""
+
+    def __call__(self) -> str:
+        return str(self)
+
+
 def _auto_name(cls_name: str) -> str:
     n = _name_counters.get(cls_name, 0)
     _name_counters[cls_name] = n + 1
@@ -117,6 +129,17 @@ class Module:
         self._grads: Params = None
         self._last_rng = None
         self._build_spec = None
+
+    @property
+    def name(self) -> "_Name":
+        return self._name
+
+    @name.setter
+    def name(self, value):
+        # every assignment (constructors, deserializers, caffe importer)
+        # funnels through here, so the name()-callable parity survives a
+        # save/load round-trip
+        self._name = _Name(value)
 
     # ------------------------------------------------------------------ #
     # Functional contract -- override these two in every layer.
@@ -165,6 +188,18 @@ class Module:
             self._install_state_entries(pending_state)
         return self
 
+    def set_running_mean(self, running_mean) -> "Module":
+        """Install a BatchNormalization running mean (reference: pyspark
+        Layer.set_running_mean -> PythonBigDL.setRunningMean)."""
+        return self.set_state_entries({"running_mean": running_mean})
+
+    def set_running_std(self, running_std) -> "Module":
+        """Install a BatchNormalization running VARIANCE -- the reference
+        method is named *std* but stores into runningVar verbatim
+        (PythonBigDL.scala:2731 setRunningStd -> module.runningVar.set);
+        the naming quirk is kept for drop-in parity."""
+        return self.set_state_entries({"running_var": running_std})
+
     def set_state_entries(self, entries):
         """Install {key: array} into the state pytree by leaf-dict key name
         (e.g. BN running_mean/running_var).  Before build, kept pending and
@@ -173,7 +208,10 @@ class Module:
 
         entries = {k: np.asarray(v, np.float32) for k, v in entries.items()}
         if not self.is_built():
-            self._pending_state = entries
+            # MERGE: set_running_mean then set_running_std before build is
+            # the normal pyspark pattern; overwriting would drop the first
+            self._pending_state = {**(getattr(self, "_pending_state", None)
+                                      or {}), **entries}
             return self
         return self._install_state_entries(entries)
 
@@ -323,6 +361,90 @@ class Module:
         if self._params is not None:
             self._grads = jax.tree.map(jnp.zeros_like, self._params)
 
+    def update_parameters(self, learning_rate: float):
+        """In-place ``p -= lr * gradP`` over the accumulated facade
+        gradients (reference: AbstractModule.updateParameters /
+        pyspark Layer.update_parameters)."""
+        if self._params is None:
+            raise ValueError("update_parameters() before build()")
+        params, grads = self.parameters()
+        self._params = jax.tree.map(
+            lambda p, g: p - learning_rate * g, params, grads)
+        return self
+
+    def reset(self):
+        """Re-initialise weights from the recorded build spec with a fresh
+        RNG draw (reference: AbstractModule.reset)."""
+        if self._build_spec is None:
+            raise ValueError("reset() before build()")
+        return self.build(self._build_spec)
+
+    def set_name(self, name: str) -> "Module":
+        """Reference: pyspark Layer.set_name (also AbstractModule.setName)."""
+        self.name = _Name(name)
+        return self
+
+    def set_seed(self, seed: int = 123) -> "Module":
+        """Seed the global init RNG (reference: pyspark Layer.set_seed ->
+        RandomGenerator.RNG.setSeed)."""
+        RNG.set_seed(seed)
+        return self
+
+    def is_training(self) -> bool:
+        return self.train_mode
+
+    def is_with_weights(self) -> bool:
+        """Whether this (built) module carries any weights
+        (reference: pyspark Layer.is_with_weights)."""
+        return self._params is not None and bool(jax.tree.leaves(self._params))
+
+    def freeze(self, names=None) -> "Module":
+        """Stop parameter updates (reference: AbstractModule.freeze /
+        pyspark Layer.freeze).  With ``names``, freezes the matching
+        descendant modules; without, freezes this whole module.  Honored
+        by ``make_train_step`` (gradients zeroed AND parameters restored
+        after the optimizer update, so weight decay cannot leak in)."""
+        if names is None:
+            self._frozen = True
+        else:
+            self._freeze_named(set(names), True)
+        return self
+
+    def unfreeze(self, names=None) -> "Module":
+        """With ``names``, explicitly marks those modules trainable — this
+        OVERRIDES a frozen ancestor (tri-state: True=frozen, False=pinned
+        trainable, unset=inherit), matching the reference's
+        freeze-all-then-unfreeze-the-head fine-tune pattern.  Without
+        ``names``, clears every mark below (and on) this module."""
+        if names is None:
+            self._frozen = None
+            for m in self.children():
+                m.unfreeze()
+        else:
+            self._freeze_named(set(names), False)
+        return self
+
+    def _freeze_named(self, names, value):
+        found = []
+
+        def walk(m):
+            if str(m.name) in names:
+                m._frozen = value
+                found.append(str(m.name))
+            for c in m.children():
+                walk(c)
+
+        walk(self)
+        missing = names - set(found)
+        if missing:
+            raise ValueError(f"freeze: no modules named {sorted(missing)}")
+
+    def _param_child_items(self, params):
+        """[(params key, child module)] aligning this container's params
+        dict with its children for the frozen-mask walk.  Sequential-style
+        containers key children by index; Graph/MapTable override."""
+        return [(str(i), c) for i, c in enumerate(self.children())]
+
     def training(self) -> "Module":
         self.train_mode = True
         for m in self.children():
@@ -334,6 +456,14 @@ class Module:
         for m in self.children():
             m.evaluate()
         return self
+
+    def quantize(self) -> "Module":
+        """Rewrite this built model for int8 inference (reference:
+        AbstractModule.scala:919 ``quantize()`` -> Quantizer): Linear and
+        convolution layers swap to their int8 twins with weights
+        quantized in place; returns self in eval mode."""
+        from bigdl_tpu.nn.quantized import quantize as _quantize
+        return _quantize(self)
 
     def set_regularizer(self, w=None, b=None):
         """Attach per-layer weight/bias regularizers (reference:
@@ -391,6 +521,71 @@ class Module:
 
         return Predictor(self, batch_size).predict_class(data)
 
+    # pyspark Layer facade spellings (reference: pyspark/bigdl/nn/layer.py
+    # predict_local :372 / predict_distributed :426 and the _class
+    # variants).  The Predictor behind predict() already consumes local
+    # arrays, Samples, DataSets AND partitioned sources, so local /
+    # distributed collapse to the same call here.
+    def predict_local(self, X, batch_size: int = 128):
+        import numpy as np
+
+        return np.stack(self.predict(X, batch_size))
+
+    def predict_class_local(self, X, batch_size: int = 128):
+        import numpy as np
+
+        return np.asarray(self.predict_class(X, batch_size))
+
+    predict_distributed = predict
+    predict_class_distributed = predict_class
+
+    def predict_image(self, image_frame, output_layer=None,
+                      share_buffer=False, batch_per_partition=4,
+                      predict_key="predict"):
+        """Run inference over an ImageFrame, storing each output under
+        ``predict_key`` on its ImageFeature (reference: pyspark
+        Layer.predict_image :451 -> ImageFrame predict).  ``output_layer``
+        / ``share_buffer`` are JVM execution details with no analogue
+        here (one fused XLA program; buffers are XLA-owned)."""
+        samples = image_frame.to_samples()
+        outs = self.predict(samples, batch_size=batch_per_partition)
+        for feature, out in zip(image_frame.features, outs):
+            feature[predict_key] = out
+        return image_frame
+
+    def save_caffe(self, prototxt_path, model_path, use_v2=True,
+                   overwrite=False):
+        """Reference: pyspark Layer.save_caffe -> CaffePersister.  The
+        input shape comes from the recorded build spec."""
+        import os as _os
+
+        if self._build_spec is None:
+            raise ValueError("save_caffe() requires a built model")
+        if not overwrite and (_os.path.exists(prototxt_path)
+                              or _os.path.exists(model_path)):
+            raise FileExistsError(
+                f"{prototxt_path} / {model_path} exist (overwrite=False)")
+        from bigdl_tpu.interop.caffe import save_caffe as _save
+
+        shape = getattr(self._build_spec, "shape", None)
+        _save(self, prototxt_path, model_path, shape)
+        return self
+
+    def save_tensorflow(self, inputs, path, byte_order="little_endian",
+                        data_format="nhwc"):
+        """Reference: pyspark Layer.save_tensorflow -> TensorflowSaver.
+        ``inputs`` is the reference's [(name, shape)] list; the first
+        entry names the graph input."""
+        if byte_order != "little_endian":
+            raise ValueError("only little_endian byte order is supported")
+        if data_format != "nhwc":
+            raise ValueError("exported graphs are NHWC (TPU-native layout)")
+        from bigdl_tpu.interop.tensorflow import save_tf
+
+        (input_name, input_shape) = inputs[0]
+        save_tf(self, path, tuple(input_shape), input_name=input_name)
+        return self
+
     def evaluate_on(self, dataset, methods, compute_dtype=None):
         """Run validation methods over a dataset
         (reference: AbstractModule.evaluate :855; named evaluate_on because
@@ -442,6 +637,49 @@ class Container(Module):
         for m in self.modules:
             m.evaluate()
         return self
+
+
+def has_frozen(module: Module) -> bool:
+    """True if this module or any descendant was froze()n."""
+    if getattr(module, "_frozen", None) is True:
+        return True
+    return any(has_frozen(c) for c in module.children())
+
+
+def frozen_param_mask(module: Module, params=None):
+    """Pytree parallel to ``params`` with a python-bool leaf per array:
+    True = trainable, False = under a frozen module.
+
+    Alignment of param subtrees to child modules goes through each
+    container's ``_param_child_items`` (Sequential-style containers key
+    by child index; Graph keys by topo index; MapTable's params ARE the
+    shared child's), so freeze() works on every container family.  The
+    frozen mark is tri-state: an explicit ``unfreeze(names)`` (False)
+    overrides a frozen ancestor.  Static (python bools), so using the
+    mask inside a jitted step costs nothing at runtime.
+    """
+    if params is None:
+        params = module.parameters()[0]
+
+    def walk(m, p, inherited):
+        own = getattr(m, "_frozen", None)
+        frozen = inherited if own is None else own
+        items = m._param_child_items(p)
+        if len(items) == 1 and items[0][0] is None:
+            # the whole subtree belongs to one shared child (MapTable)
+            return walk(items[0][1], p, frozen)
+        if items and isinstance(p, dict):
+            by_key = dict(items)
+            out = {}
+            for k in p:
+                if k in by_key:
+                    out[k] = walk(by_key[k], p[k], frozen)
+                else:
+                    out[k] = jax.tree.map(lambda _: not frozen, p[k])
+            return out
+        return jax.tree.map(lambda _: not frozen, p)
+
+    return walk(module, params, False)
 
 
 class Criterion:

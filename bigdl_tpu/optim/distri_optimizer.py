@@ -65,9 +65,21 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
     the reference decompresses after aggregation.
     """
 
+    from bigdl_tpu.nn.module import frozen_param_mask, has_frozen
     from bigdl_tpu.optim.regularizer import (has_regularizers,
                                              regularization_loss)
     use_reg = has_regularizers(model)
+    # freeze() support on the flat parameter plane: the static bool mask
+    # flattens to a 0/1 vector laid out exactly like the params (padding
+    # = 0, i.e. held), chunked per device below
+    if has_frozen(model):
+        mask_tree = frozen_param_mask(model)
+        freeze_mask_flat = flat_space.flatten(jax.tree.map(
+            lambda _, keep: jnp.full(_.shape, 1.0 if keep else 0.0,
+                                     jnp.float32),
+            model.parameters()[0], mask_tree))
+    else:
+        freeze_mask_flat = None
 
     def step_body(params_flat, mstate, opt_state, x, target, rng):
         # per-device view: params_flat replicated, x/target = this device's shard
@@ -108,7 +120,14 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
             scale = jnp.minimum(1.0, clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
             gchunk = gchunk * scale
         pchunk = flat_space.chunk(params_flat, jax.lax.axis_index(axis))
+        if freeze_mask_flat is not None:
+            mchunk = flat_space.chunk(freeze_mask_flat,
+                                      jax.lax.axis_index(axis))
+            gchunk = gchunk * mchunk
         new_pchunk, new_opt_state = optim_method.update(gchunk, opt_state, pchunk)
+        if freeze_mask_flat is not None:
+            # restore frozen positions so weight decay cannot leak in
+            new_pchunk = mchunk * new_pchunk + (1.0 - mchunk) * pchunk
         new_flat = jax.lax.all_gather(new_pchunk, axis, tiled=True)
         # average replicated floating state (BN running stats) across shards
         new_mstate = jax.tree.map(

@@ -44,9 +44,15 @@ def make_train_step(
     bf16 forward/backward (MXU-native), fp32 update.
     """
 
+    from bigdl_tpu.nn.module import frozen_param_mask, has_frozen
     from bigdl_tpu.optim.regularizer import (has_regularizers,
                                              regularization_loss)
     use_reg = has_regularizers(model)
+    # freeze() support (reference: AbstractModule.freeze): a STATIC bool
+    # mask captured at trace time -- frozen gradients are zeroed (keeps
+    # optimizer state untouched) and frozen params restored after the
+    # update (so weight decay cannot leak in)
+    freeze_mask = frozen_param_mask(model) if has_frozen(model) else None
 
     def train_step(params, mstate, opt_state, input, target, rng):
         def loss_fn(p):
@@ -69,11 +75,19 @@ def make_train_step(
         grads = _cast_tree(grads, jnp.float32)
         if grad_transform is not None:
             grads = grad_transform(grads)
+        if freeze_mask is not None:
+            grads = jax.tree.map(
+                lambda g, keep: g if keep else jnp.zeros_like(g),
+                grads, freeze_mask)
         if clip_value is not None:
             grads = clip_by_value(grads, *clip_value)
         if clip_norm is not None:
             grads = clip_by_global_norm(grads, clip_norm)
         new_params, new_opt_state = optim_method.update(grads, opt_state, params)
+        if freeze_mask is not None:
+            new_params = jax.tree.map(
+                lambda n, o, keep: n if keep else o,
+                new_params, params, freeze_mask)
         return new_params, new_mstate, new_opt_state, loss
 
     return train_step

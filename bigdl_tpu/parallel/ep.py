@@ -55,6 +55,13 @@ def make_ep_train_step(model, criterion, optim_method, mesh,
     Task loss + ``aux_weight``  x  router load-balance loss; expert params
     (and their optimizer moments) updated where their shard lives.
     """
+    from bigdl_tpu.nn.module import has_frozen
+    if has_frozen(model):
+        raise NotImplementedError(
+            "freeze() is honored by make_train_step and the "
+            "DistriOptimizer flat-chunk step; this model-parallel engine "
+            "does not mask frozen parameters yet -- unfreeze() before "
+            "building, or train with LocalOptimizer/DistriOptimizer")
 
     def step(params, opt_state, x, y, rng):
         def loss_fn(p):

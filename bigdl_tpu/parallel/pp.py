@@ -229,6 +229,13 @@ def make_pp_train_step(model, criterion, optim_method, mesh,
     make_pp_loss_fn -- pass ("data", "pipe") on a 3-D data x pipe x model
     mesh to compose with GSPMD tensor parallelism.
     """
+    from bigdl_tpu.nn.module import has_frozen
+    if has_frozen(model):
+        raise NotImplementedError(
+            "freeze() is honored by make_train_step and the "
+            "DistriOptimizer flat-chunk step; this model-parallel engine "
+            "does not mask frozen parameters yet -- unfreeze() before "
+            "building, or train with LocalOptimizer/DistriOptimizer")
     loss_fn = make_pp_loss_fn(model, criterion, mesh, n_microbatches,
                               pipe_axis, data_axis, manual_axes)
 

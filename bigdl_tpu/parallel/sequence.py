@@ -31,6 +31,13 @@ def make_sp_train_step(model, criterion, optim_method, mesh,
     ``x``/``y``: (B, T) int token arrays, globally shaped; sharded
     (data_axis, seq_axis).
     """
+    from bigdl_tpu.nn.module import has_frozen
+    if has_frozen(model):
+        raise NotImplementedError(
+            "freeze() is honored by make_train_step and the "
+            "DistriOptimizer flat-chunk step; this model-parallel engine "
+            "does not mask frozen parameters yet -- unfreeze() before "
+            "building, or train with LocalOptimizer/DistriOptimizer")
     axes = tuple(a for a in (data_axis, seq_axis) if a is not None)
 
     def step_body(params, opt_state, x, y, rng):

@@ -62,6 +62,13 @@ def make_tp_train_step(model, criterion, optim_method, mesh,
     optimizer state inherits the param shardings (each device updates only
     its param shard -- optimizer-state parallelism for free).
     """
+    from bigdl_tpu.nn.module import has_frozen
+    if has_frozen(model):
+        raise NotImplementedError(
+            "freeze() is honored by make_train_step and the "
+            "DistriOptimizer flat-chunk step; this model-parallel engine "
+            "does not mask frozen parameters yet -- unfreeze() before "
+            "building, or train with LocalOptimizer/DistriOptimizer")
 
     def step(params, opt_state, x, y, rng):
         def loss_fn(p):

@@ -1,0 +1,299 @@
+"""pyspark Layer facade parity (reference: pyspark/bigdl/nn/layer.py).
+
+Round-4 sweep of the reference Layer method surface: every public method
+of the pyspark Layer must exist on Module (or the bigdl compat package)
+with reference semantics.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.utils.random_generator import RNG
+
+
+def _built_mlp(in_dim=6, out_dim=4):
+    m = nn.Sequential().add(nn.Linear(in_dim, 5)).add(nn.ReLU()) \
+        .add(nn.Linear(5, out_dim))
+    m.build(jax.ShapeDtypeStruct((2, in_dim), jnp.float32))
+    return m
+
+
+class TestNameSeedMisc:
+    def test_set_name_and_callable_name(self):
+        m = nn.Linear(3, 2).set_name("conv2")
+        assert m.name == "conv2"          # attribute read (native style)
+        assert m.name() == "conv2"        # method call (pyspark style)
+
+    def test_callable_name_survives_save_load(self, tmp_path):
+        """Deserializers assign plain strings to .name; the property
+        setter must keep the pyspark name() contract on loaded models."""
+        from bigdl_tpu.interop.bigdl_format import load_bigdl, save_bigdl
+
+        m = nn.Sequential().add(nn.Linear(3, 2).set_name("fc"))
+        m.build(jax.ShapeDtypeStruct((1, 3), jnp.float32))
+        path = str(tmp_path / "m.bigdl")
+        save_bigdl(m, path)
+        loaded = load_bigdl(path)
+        assert loaded.modules[0].name() == "fc"
+
+    def test_set_seed_reproduces_init(self):
+        a = nn.Linear(4, 3).set_seed(7)
+        a.build(jax.ShapeDtypeStruct((1, 4), jnp.float32))
+        b = nn.Linear(4, 3).set_seed(7)
+        b.build(jax.ShapeDtypeStruct((1, 4), jnp.float32))
+        np.testing.assert_array_equal(a.parameters()[0]["weight"],
+                                      b.parameters()[0]["weight"])
+
+    def test_is_training_tracks_mode(self):
+        m = _built_mlp()
+        assert m.is_training()
+        m.evaluate()
+        assert not m.is_training()
+
+    def test_is_with_weights(self):
+        assert _built_mlp().is_with_weights()
+        relu = nn.ReLU()
+        relu.build(jax.ShapeDtypeStruct((2, 3), jnp.float32))
+        assert not relu.is_with_weights()
+
+    def test_reset_redraws_weights(self):
+        RNG.set_seed(3)
+        m = _built_mlp()
+        w0 = np.asarray(m.parameters()[0]["0"]["weight"]).copy()
+        m.reset()
+        assert not np.allclose(w0, np.asarray(m.parameters()[0]["0"]["weight"]))
+
+
+class TestUpdateParameters:
+    def test_sgd_step_via_facade(self):
+        """forward/backward/update_parameters reproduces one manual SGD
+        step (reference updateParameters semantics)."""
+        m = nn.Linear(3, 2)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 3)),
+                        jnp.float32)
+        y = m.forward(x)
+        m.backward(x, jnp.ones_like(y))
+        w, g = m.parameters()[0]["weight"], m.parameters()[1]["weight"]
+        expect = np.asarray(w) - 0.5 * np.asarray(g)
+        m.update_parameters(0.5)
+        np.testing.assert_allclose(m.parameters()[0]["weight"], expect,
+                                   rtol=1e-6)
+
+
+class TestFreeze:
+    def _train(self, model, steps=3):
+        from bigdl_tpu.optim.train_step import make_train_step
+
+        method = optim.SGD(learning_rate=0.5, momentum=0.9,
+                           weight_decay=1e-2)
+        step = jax.jit(make_train_step(model, nn.MSECriterion(), method))
+        params, mstate = model.parameters()[0], model.state()
+        ostate = method.init_state(params)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(8, 6)), jnp.float32)
+        t = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+        for i in range(steps):
+            params, mstate, ostate, _ = step(params, mstate, ostate, x, t,
+                                             jax.random.PRNGKey(i))
+        return params
+
+    def test_freeze_named_layer_holds_weights(self):
+        RNG.set_seed(11)
+        m = _built_mlp()
+        first = m.modules[0].name
+        m.freeze([str(first)])
+        w0 = np.asarray(m.parameters()[0]["0"]["weight"]).copy()
+        w2 = np.asarray(m.parameters()[0]["2"]["weight"]).copy()
+        params = self._train(m)
+        # frozen layer bit-identical (weight decay must NOT leak in);
+        # unfrozen layer moved
+        np.testing.assert_array_equal(params["0"]["weight"], w0)
+        assert not np.allclose(params["2"]["weight"], w2)
+
+    def test_freeze_whole_model_then_unfreeze(self):
+        RNG.set_seed(12)
+        m = _built_mlp()
+        m.freeze()
+        w0 = jax.tree.map(lambda a: np.asarray(a).copy(),
+                          m.parameters()[0])
+        params = self._train(m)
+        for a, b in zip(jax.tree.leaves(w0), jax.tree.leaves(params)):
+            np.testing.assert_array_equal(a, b)
+        m.unfreeze()
+        params = self._train(m)
+        assert any(not np.allclose(a, b) for a, b in
+                   zip(jax.tree.leaves(w0), jax.tree.leaves(params)))
+
+    def test_freeze_unknown_name_raises(self):
+        with pytest.raises(ValueError):
+            _built_mlp().freeze(["nope"])
+
+    def test_unfreeze_named_overrides_frozen_ancestor(self):
+        """freeze-all-then-unfreeze-the-head fine-tune pattern: the
+        explicit unfreeze wins over the frozen root."""
+        RNG.set_seed(13)
+        m = _built_mlp()
+        head = m.modules[2].set_name("head")
+        m.freeze()
+        m.unfreeze(["head"])
+        w0 = np.asarray(m.parameters()[0]["0"]["weight"]).copy()
+        h0 = np.asarray(m.parameters()[0]["2"]["weight"]).copy()
+        params = self._train(m)
+        np.testing.assert_array_equal(params["0"]["weight"], w0)
+        assert not np.allclose(params["2"]["weight"], h0)
+
+    def test_freeze_on_graph_container(self):
+        """Graph keys params by topo index (Input nodes consume indices);
+        the mask must still hit the right layer."""
+        RNG.set_seed(14)
+        inp = nn.Input()
+        fc1 = nn.Linear(6, 5).set_name("fc1")
+        fc2 = nn.Linear(5, 4).set_name("fc2")
+        g = nn.Graph(inp, fc2(nn.ReLU()(fc1(inp))))
+        g.build(jax.ShapeDtypeStruct((8, 6), jnp.float32))
+        g.freeze(["fc1"])
+        from bigdl_tpu.nn.module import frozen_param_mask
+
+        params = g.parameters()[0]
+        mask = frozen_param_mask(g, params)
+        # find which topo keys hold fc1's / fc2's params by shape
+        for key, sub in params.items():
+            if not sub:
+                continue
+            leaves = jax.tree.leaves(mask[key])
+            if sub["weight"].shape == (6, 5):
+                assert not any(leaves), "fc1 must be fully masked"
+            elif sub["weight"].shape == (5, 4):
+                assert all(leaves), "fc2 must stay trainable"
+
+    def test_freeze_maptable_shared_child(self):
+        """MapTable's params ARE the shared child's subtree."""
+        from bigdl_tpu.nn.module import frozen_param_mask
+
+        RNG.set_seed(15)
+        inner = nn.Linear(3, 2).set_name("shared")
+        mt = nn.MapTable(inner)
+        mt.build((jax.ShapeDtypeStruct((2, 3), jnp.float32),
+                  jax.ShapeDtypeStruct((2, 3), jnp.float32)))
+        mt.freeze(["shared"])
+        mask = frozen_param_mask(mt, mt.parameters()[0])
+        assert not any(jax.tree.leaves(mask))
+
+    def test_freeze_rejected_by_model_parallel_engines(self):
+        from bigdl_tpu.parallel.tp import make_tp_train_step
+
+        m = _built_mlp()
+        m.freeze()
+        with pytest.raises(NotImplementedError):
+            make_tp_train_step(m, nn.MSECriterion(),
+                               optim.SGD(learning_rate=0.1), mesh=None)
+
+    def test_freeze_distri_flat_chunk_holds_weights(self):
+        """The DistriOptimizer ZeRO step masks the flat parameter plane."""
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.optim import DistriOptimizer, Trigger
+        from bigdl_tpu.utils.engine import Engine
+
+        RNG.set_seed(16)
+        m = _built_mlp()
+        m.modules[0].set_name("frozen_in")
+        m.freeze(["frozen_in"])
+        w0 = np.asarray(m.parameters()[0]["0"]["weight"]).copy()
+        w2 = np.asarray(m.parameters()[0]["2"]["weight"]).copy()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 6)).astype(np.float32)
+        y = rng.integers(0, 4, 64).astype(np.int32)
+        ds = array_dataset(x, y) >> SampleToMiniBatch(32)
+        opt = DistriOptimizer(m, ds, nn.CrossEntropyCriterion(),
+                              optim.SGD(learning_rate=0.5, momentum=0.9,
+                                        weight_decay=1e-2),
+                              mesh=Engine.build_mesh())
+        opt.set_end_when(Trigger.max_iteration(4))
+        opt.optimize()
+        params = m.parameters()[0]
+        np.testing.assert_array_equal(params["0"]["weight"], w0)
+        assert not np.allclose(params["2"]["weight"], w2)
+
+
+class TestPredictFacades:
+    def test_predict_local_and_class_local(self):
+        RNG.set_seed(5)
+        m = _built_mlp()
+        X = np.random.default_rng(1).normal(size=(10, 6)).astype(np.float32)
+        out = m.predict_local(X, batch_size=4)
+        assert out.shape == (10, 4)
+        cls = m.predict_class_local(X, batch_size=4)
+        np.testing.assert_array_equal(cls, out.argmax(-1))
+
+    def test_predict_distributed_aliases(self):
+        assert nn.Module.predict_distributed is nn.Module.predict
+        assert (nn.Module.predict_class_distributed
+                is nn.Module.predict_class)
+
+    def test_predict_image(self):
+        from bigdl_tpu.transform.vision import ImageFrame
+
+        RNG.set_seed(6)
+        m = nn.Sequential().add(nn.Reshape([12])).add(nn.Linear(12, 3))
+        m.build(jax.ShapeDtypeStruct((1, 2, 2, 3), jnp.float32))
+        images = [np.random.default_rng(i).normal(size=(2, 2, 3))
+                  .astype(np.float32) for i in range(5)]
+        frame = ImageFrame.from_arrays(images)
+        out = m.predict_image(frame, batch_per_partition=2)
+        assert out is frame
+        assert all(f["predict"].shape == (3,) for f in frame.features)
+
+
+class TestRunningStats:
+    def test_set_running_mean_and_std(self):
+        bn = nn.BatchNormalization(4)
+        bn.build(jax.ShapeDtypeStruct((2, 4), jnp.float32))
+        bn.set_running_mean(np.full(4, 1.5, np.float32))
+        bn.set_running_std(np.full(4, 2.0, np.float32))  # stores VARIANCE
+        state = bn.state()
+        np.testing.assert_allclose(state["running_mean"], 1.5)
+        np.testing.assert_allclose(state["running_var"], 2.0)
+
+    def test_both_setters_before_build_merge(self):
+        """pyspark layers are constructed eagerly and built later; the
+        second pending setter must not discard the first."""
+        bn = nn.BatchNormalization(3)
+        bn.set_running_mean(np.full(3, 1.25, np.float32))
+        bn.set_running_std(np.full(3, 4.0, np.float32))
+        bn.build(jax.ShapeDtypeStruct((2, 3), jnp.float32))
+        state = bn.state()
+        np.testing.assert_allclose(state["running_mean"], 1.25)
+        np.testing.assert_allclose(state["running_var"], 4.0)
+
+
+class TestSaveFacades:
+    def test_save_caffe_roundtrip(self, tmp_path):
+        from bigdl_tpu.interop.caffe import load_caffe
+
+        RNG.set_seed(8)
+        m = nn.Sequential().add(
+            nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1))
+        m.build(jax.ShapeDtypeStruct((1, 8, 8, 3), jnp.float32))
+        proto, weights = str(tmp_path / "m.prototxt"), str(tmp_path / "m.caffemodel")
+        m.save_caffe(proto, weights)
+        with pytest.raises(FileExistsError):
+            m.save_caffe(proto, weights)          # overwrite=False
+        m.save_caffe(proto, weights, overwrite=True)
+        loaded = load_caffe(proto, weights)
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(1, 8, 8, 3)),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                                   np.asarray(m.forward(x)), atol=1e-5)
+
+    def test_save_tensorflow(self, tmp_path):
+        RNG.set_seed(9)
+        m = nn.Sequential().add(nn.Reshape([12])).add(nn.Linear(12, 3))
+        m.build(jax.ShapeDtypeStruct((1, 2, 2, 3), jnp.float32))
+        path = str(tmp_path / "model.pb")
+        m.save_tensorflow([("input", [1, 2, 2, 3])], path)
+        import os
+        assert os.path.getsize(path) > 0

@@ -191,3 +191,20 @@ def test_quantized_weight_file_split(tmp_path):
     back = load_module(d, weight_path=w)
     np.testing.assert_allclose(y1, np.asarray(back.forward(x)),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_module_quantize_method():
+    """model.quantize() facade (reference AbstractModule.scala:919) is the
+    in-place Quantizer rewrite, returned in eval mode."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.quantized import QuantizedLinear
+
+    m = nn.Sequential().add(nn.Linear(6, 4)).add(nn.ReLU())
+    m.build(jax.ShapeDtypeStruct((2, 6), jnp.float32))
+    out = m.quantize()
+    assert out is m
+    assert not m.train_mode
+    assert isinstance(m.modules[0], QuantizedLinear)
