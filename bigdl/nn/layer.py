@@ -297,3 +297,24 @@ class Transpose(_nn.Transpose):
     def __init__(self, permutations, bigdl_type="float", name=None):
         super().__init__([(_dim(a), _dim(b)) for a, b in permutations],
                          name=name)
+
+
+class Tile(_nn.Tile):
+    """1-based dim (pyspark layer.py:5119)."""
+
+    def __init__(self, dim=1, copies=2, bigdl_type="float", name=None):
+        super().__init__(_dim(dim), copies, name=name)
+
+
+class SpatialConvolutionMap(_nn.SpatialConvolutionMap):
+    """pyspark layer.py:4901: Torch 1-based connection table, NCHW."""
+
+    def __init__(self, conn_table, kw, kh, dw=1, dh=1, pad_w=0, pad_h=0,
+                 wRegularizer=None, bRegularizer=None, bigdl_type="float",
+                 name=None):
+        table = np.asarray(conn_table)
+        table = np.where(table > 0, table - 1, table)   # 1-based -> 0-based
+        super().__init__(table, kw, kh, dw, dh, pad_w, pad_h,
+                         data_format="NCHW", name=name)
+        self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
+        _set_native_regs(self, wRegularizer, bRegularizer)

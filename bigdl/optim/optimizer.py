@@ -76,6 +76,58 @@ def SeveralIteration(n):
     return _Trigger.several_iteration(n)
 
 
+def MaxScore(max, bigdl_type="float"):
+    """Trigger when the validation score exceeds ``max`` (reference:
+    pyspark MaxScore :229)."""
+    return _Trigger.max_score(max)
+
+
+def MinLoss(min, bigdl_type="float"):
+    """Trigger when the training loss drops below ``min`` (reference:
+    pyspark MinLoss :247)."""
+    return _Trigger.min_loss(min)
+
+
+def TriggerAnd(first, *other):
+    """All triggers fire (reference: pyspark TriggerAnd :266)."""
+    return _Trigger.and_(first, *other)
+
+
+def TriggerOr(first, *other):
+    """Any trigger fires (reference: pyspark TriggerOr :286)."""
+    return _Trigger.or_(first, *other)
+
+
+# remaining reference names that map 1:1 onto native classes
+OptimMethod = _optim.OptimMethod
+LBFGS = _optim.LBFGS
+BaseOptimizer = _optim.BaseOptimizer
+
+
+def Plateau(monitor, factor=0.1, patience=10, mode="min", epsilon=1e-4,
+            cooldown=0, min_lr=0.0, bigdl_type="float"):
+    """pyspark Plateau signature adapter (monitor is REQUIRED in the
+    reference, pyspark/bigdl/optim/optimizer.py:381)."""
+    return _optim.Plateau(monitor=monitor, factor=factor, patience=patience,
+                          mode=mode, epsilon=epsilon, cooldown=cooldown,
+                          min_lr=min_lr)
+
+
+# the layer facades call reg._native(); the compat Regularizer classes in
+# bigdl.nn.layer carry that seam (+ the bigdl_type kwarg) -- re-export
+# those, NOT the natives
+from bigdl.nn.layer import (L1L2Regularizer, L1Regularizer,  # noqa: E402
+                            L2Regularizer)
+
+
+def ActivityRegularization(l1=0.0, l2=0.0, bigdl_type="float"):
+    """Reference: pyspark ActivityRegularization -> the nn layer of the
+    same name (penalises ACTIVATIONS, not weights)."""
+    import bigdl_tpu.nn as _nn
+
+    return _nn.ActivityRegularization(l1=l1, l2=l2)
+
+
 class TrainSummary:
     def __new__(cls, log_dir, app_name):
         from bigdl_tpu.visualization import TrainSummary as TS

@@ -13,7 +13,7 @@ from bigdl_tpu.nn.graph import Graph, Node, Input
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.conv import (
     SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
-    TemporalConvolution, Conv1D, SpaceToDepthStem,
+    TemporalConvolution, Conv1D, SpaceToDepthStem, SpatialConvolutionMap,
 )
 from bigdl_tpu.nn.pooling import (
     SpatialMaxPooling, SpatialAveragePooling,
@@ -31,7 +31,7 @@ from bigdl_tpu.nn.activations import (
 )
 from bigdl_tpu.nn.reshape import (
     Reshape, View, InferReshape, Flatten, Squeeze, Unsqueeze, Transpose,
-    Permute, Select, Narrow, Contiguous, Padding, Replicate,
+    Permute, Select, Narrow, Contiguous, Padding, Replicate, Tile,
 )
 from bigdl_tpu.nn.embedding import LookupTable
 from bigdl_tpu.nn.recurrent import (
@@ -64,7 +64,7 @@ from bigdl_tpu.nn.table_ops import (
     CAveTable, Bottle, SparseJoinTable,
 )
 from bigdl_tpu.nn.simple_layers import (
-    CAdd, CMul, Mul, Scale, Bilinear, Cosine, Euclidean, Maxout, Highway,
+    Add, CAdd, CMul, Mul, Scale, Bilinear, Cosine, Euclidean, Maxout, Highway,
     LocallyConnected1D, LocallyConnected2D, RReLU, SReLU, BinaryThreshold,
     GaussianDropout, GaussianNoise, GradientReversal, Masking, MaskedSelect,
     L1Penalty, ActivityRegularization, NegativeEntropyPenalty, Echo,

@@ -14,7 +14,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import lax
 
-from bigdl_tpu.nn.initialization import Xavier, Zeros
+from bigdl_tpu.nn.initialization import RandomUniform, Xavier, Zeros
 from bigdl_tpu.nn.module import Module, child_rng
 
 
@@ -313,3 +313,79 @@ class Conv1D(Module):
 
 
 TemporalConvolution = Conv1D
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution over a generic input->output connection table
+    (reference: nn/SpatialConvolutionMap.scala; Torch's legacy
+    nn.SpatialConvolutionMap).
+
+    ``conn_table``: ``(n_connections, 2)`` array of 0-BASED
+    ``[input_feature, output_feature]`` pairs (the pyspark compat layer
+    shifts Torch's 1-based tables down).  Parameters follow the Torch
+    layout -- one ``(kh, kw)`` kernel per CONNECTION plus one bias per
+    output plane -- and apply scatters them into a dense ``(kh, kw,
+    n_in, n_out)`` kernel for ONE full conv: the MXU-friendly
+    formulation of a sparse connection pattern (zeros contribute
+    nothing, gradients flow only to the scattered taps).
+    """
+
+    def __init__(self, conn_table, kernel_w, kernel_h, stride_w=1,
+                 stride_h=1, pad_w=0, pad_h=0, data_format="NHWC",
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name)
+        self.set_regularizer(w_regularizer, b_regularizer)
+        import numpy as _np
+        table = _np.asarray(conn_table, _np.int64).reshape(-1, 2)
+        self.conn_in = tuple(int(i) for i in table[:, 0])
+        self.conn_out = tuple(int(o) for o in table[:, 1])
+        self.n_input_plane = max(self.conn_in) + 1
+        self.n_output_plane = max(self.conn_out) + 1
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        assert data_format in ("NHWC", "NCHW")
+        self.data_format = data_format
+
+    def setup(self, rng, input_spec):
+        kh, kw = self.kernel
+        n_conn = len(self.conn_in)
+        # Torch reset: stdv over the per-OUTPUT fan-in (nInputPlane of a
+        # full table); use the busiest output's connection count
+        fan = kh * kw * max(
+            sum(1 for o in self.conn_out if o == out)
+            for out in set(self.conn_out))
+        init = RandomUniform(-1.0 / fan ** 0.5, 1.0 / fan ** 0.5)
+        return {
+            "weight": init.init(child_rng(rng, 0), (n_conn, kh, kw),
+                                fan, fan),
+            "bias": init.init(child_rng(rng, 1), (self.n_output_plane,),
+                              fan, fan),
+        }, ()
+
+    def _padding(self):
+        ph, pw = self.pad
+        if ph == -1 and pw == -1:  # reference convention: -1 => SAME
+            return "SAME"
+        return ((ph, ph), (pw, pw))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        kh, kw = self.kernel
+        dense = jnp.zeros((kh, kw, self.n_input_plane, self.n_output_plane),
+                          params["weight"].dtype)
+        dense = dense.at[:, :, jnp.asarray(self.conn_in),
+                         jnp.asarray(self.conn_out)].set(
+            jnp.moveaxis(params["weight"], 0, -1))
+        y = lax.conv_general_dilated(
+            x, dense.astype(x.dtype),
+            window_strides=self.stride,
+            padding=self._padding(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = y + params["bias"].astype(y.dtype)
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y, state

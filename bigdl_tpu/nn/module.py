@@ -188,6 +188,55 @@ class Module:
             self._install_state_entries(pending_state)
         return self
 
+    # static loaders (reference: Scala `object Module` + pyspark
+    # Model.load_torch/load_keras/load_caffe/load_caffe_model/
+    # load_tensorflow, pyspark/bigdl/nn/layer.py:772-850)
+    @staticmethod
+    def load_torch(path):
+        """Load a Torch .t7 serialized module."""
+        from bigdl_tpu.utils.torch_file import load_torch_module
+
+        return load_torch_module(path)
+
+    @staticmethod
+    def load_keras(json_path=None, hdf5_path=None, by_name=False):
+        """Load a Keras JSON/HDF5 model definition (+weights)."""
+        if by_name:
+            raise NotImplementedError(
+                "by_name weight matching is not supported; load the full "
+                "topology (json_path) with its weights instead")
+        from bigdl_tpu.keras.converter import load_keras
+
+        return load_keras(json_path=json_path, hdf5_path=hdf5_path)
+
+    @staticmethod
+    def load_caffe(model, defPath, modelPath, match_all=True):
+        """Copy caffe weights into an existing model (by layer name)."""
+        from bigdl_tpu.interop.caffe import load
+
+        return load(model, defPath, modelPath, match_all=match_all)
+
+    @staticmethod
+    def load_caffe_model(defPath, modelPath):
+        """Build a model purely from a caffe prototxt + caffemodel."""
+        from bigdl_tpu.interop.caffe import load_caffe
+
+        return load_caffe(defPath, modelPath)
+
+    @staticmethod
+    def load_tensorflow(path, inputs, outputs, byte_order="little_endian",
+                        bin_file=None):
+        """Import a frozen TF GraphDef as a trainable module."""
+        if byte_order != "little_endian":
+            raise ValueError("only little_endian byte order is supported")
+        if bin_file is not None:
+            raise NotImplementedError(
+                "separate dumped-weights bin_file is not supported; export "
+                "a frozen GraphDef with the weights folded in")
+        from bigdl_tpu.interop.tensorflow import load_tf
+
+        return load_tf(path, inputs, outputs)
+
     def set_running_mean(self, running_mean) -> "Module":
         """Install a BatchNormalization running mean (reference: pyspark
         Layer.set_running_mean -> PythonBigDL.setRunningMean)."""

@@ -166,3 +166,22 @@ class Replicate(Module):
     def apply(self, params, state, input, *, training=False, rng=None):
         return jnp.repeat(jnp.expand_dims(input, self.dim), self.n_features,
                           axis=self.dim), state
+
+
+class Tile(Module):
+    """Repeat the input ``copies`` times along ``dim``
+    (reference: nn/Tile.scala -- output size along ``dim`` is
+    ``copies * input_size[dim]``).  ``dim`` is 0-based here; the pyspark
+    compat layer translates Torch's 1-based dims."""
+
+    def __init__(self, dim=0, copies=2, name=None):
+        super().__init__(name)
+        if copies < 2:
+            raise ValueError("copies should be at least 2")
+        self.dim = int(dim)
+        self.copies = int(copies)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        reps = [1] * input.ndim
+        reps[self.dim] = self.copies
+        return jnp.tile(input, reps), state

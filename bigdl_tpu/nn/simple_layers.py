@@ -618,3 +618,25 @@ class GaussianSampler(Module):
             return mean, state
         eps = jax.random.normal(rng, mean.shape, mean.dtype)
         return mean + jnp.exp(log_var * 0.5) * eps, state
+
+
+class Add(Module):
+    """Learnable bias add over a flattened ``input_size`` vector
+    (reference: nn/Add.scala; Torch nn.Add): ``y = x + b`` with ``b``
+    broadcast over the batch dimension."""
+
+    def __init__(self, input_size, name=None):
+        super().__init__(name)
+        self.input_size = int(input_size)
+
+    def setup(self, rng, input_spec):
+        stdv = 1.0 / self.input_size ** 0.5
+        b = RandomUniform(-stdv, stdv).init(
+            rng, (self.input_size,), self.input_size, self.input_size)
+        return {"bias": b}, ()
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        b = params["bias"].astype(input.dtype)
+        if input.shape[1:] != b.shape:
+            b = b.reshape(input.shape[1:])
+        return input + b, state

@@ -297,3 +297,53 @@ class TestSaveFacades:
         m.save_tensorflow([("input", [1, 2, 2, 3])], path)
         import os
         assert os.path.getsize(path) > 0
+
+
+class TestStaticLoaders:
+    """Reference `object Module` static loaders exposed on Module
+    (pyspark Model.load_torch/load_caffe_model/... parity)."""
+
+    def test_load_caffe_model_static(self, tmp_path):
+        RNG.set_seed(21)
+        m = nn.Sequential().add(
+            nn.SpatialConvolution(3, 2, 3, 3, 1, 1, 1, 1))
+        m.build(jax.ShapeDtypeStruct((1, 6, 6, 3), jnp.float32))
+        proto = str(tmp_path / "m.prototxt")
+        weights = str(tmp_path / "m.caffemodel")
+        m.save_caffe(proto, weights)
+        loaded = nn.Module.load_caffe_model(proto, weights)
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(1, 6, 6, 3)),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(loaded.forward(x)),
+                                   np.asarray(m.forward(x)), atol=1e-5)
+
+    def test_load_torch_static(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from bigdl_tpu.utils.torch_file import save_t7
+
+        tl = torch.nn.Linear(3, 2)
+        path = str(tmp_path / "m.t7")
+        save_t7({"__torch_class__": "nn.Linear",
+                 "weight": tl.weight.detach().numpy().astype(np.float64),
+                 "bias": tl.bias.detach().numpy().astype(np.float64)}, path)
+        loaded = nn.Module.load_torch(path)
+        x = np.random.default_rng(4).normal(size=(2, 3)).astype(np.float32)
+        gold = tl(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(
+            np.asarray(loaded.forward(jnp.asarray(x))), gold, atol=1e-5)
+
+    def test_load_caffe_copies_into_existing(self, tmp_path):
+        RNG.set_seed(22)
+        src = nn.Sequential().add(
+            nn.SpatialConvolution(3, 2, 3, 3, 1, 1, 1, 1).set_name("c1"))
+        src.build(jax.ShapeDtypeStruct((1, 6, 6, 3), jnp.float32))
+        proto = str(tmp_path / "s.prototxt")
+        weights = str(tmp_path / "s.caffemodel")
+        src.save_caffe(proto, weights)
+        dst = nn.Sequential().add(
+            nn.SpatialConvolution(3, 2, 3, 3, 1, 1, 1, 1).set_name("c1"))
+        dst.build(jax.ShapeDtypeStruct((1, 6, 6, 3), jnp.float32))
+        nn.Module.load_caffe(dst, proto, weights)
+        np.testing.assert_allclose(
+            np.asarray(dst.parameters()[0]["0"]["weight"]),
+            np.asarray(src.parameters()[0]["0"]["weight"]), atol=1e-6)
