@@ -1,0 +1,54 @@
+"""Keras model/weights -> bigdl loaders, pyspark-compat spellings.
+
+Reference: pyspark/bigdl/keras/converter.py DefinitionLoader /
+WeightLoader.  The conversion engine is bigdl_tpu.keras.converter; this
+module provides the reference's classmethod entry points, including
+``from_kmodel`` which converts a LIVE Keras model object (via its
+to_json) and copies its in-memory weights.
+"""
+
+from bigdl_tpu.keras import converter as _conv
+
+
+class DefinitionLoader:
+
+    @classmethod
+    def from_kmodel(cls, kmodel):
+        model = _conv.model_from_json(kmodel.to_json())
+        model.build_model()
+        return model
+
+    @classmethod
+    def from_json_path(cls, json_path):
+        with open(json_path) as f:
+            return cls.from_json_str(f.read())
+
+    @classmethod
+    def from_json_str(cls, json_str):
+        model = _conv.model_from_json(json_str)
+        model.build_model()
+        return model
+
+
+class WeightLoader:
+
+    @staticmethod
+    def load_weights_from_kmodel(bmodel, kmodel):
+        """Copy the LIVE Keras model's weights layer-by-layer (reference:
+        WeightLoader.load_weights_from_kmodel)."""
+        if hasattr(bmodel, "modules"):      # Sequential: align by order
+            aligned = [klayer.get_weights() or None
+                       for klayer in kmodel.layers]
+            _conv.set_layer_weights(bmodel, aligned)
+        else:                               # functional Model: by name
+            by_name = {klayer.name: klayer.get_weights()
+                       for klayer in kmodel.layers if klayer.get_weights()}
+            _conv.set_graph_weights(bmodel, by_name)
+        return bmodel
+
+    @staticmethod
+    def load_weights_from_hdf5(bmodel, kmodel, filepath, by_name=False):
+        """Reference signature; ``kmodel`` is unused here because the
+        hdf5 layout itself names the layers."""
+        _conv.load_weights_hdf5(bmodel, filepath, by_name=by_name)
+        return bmodel

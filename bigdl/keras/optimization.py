@@ -1,0 +1,137 @@
+"""Keras -> bigdl training-config conversion.
+
+Reference: pyspark/bigdl/keras/optimization.py OptimConverter:27 — maps
+Keras loss names/functions, optimizer objects, and metric names to their
+bigdl analogues.  Works with either Keras optimizer objects (class-name
+matched, so Keras 1/2/3 all work) or plain strings.
+"""
+
+import warnings
+
+import numpy as np
+
+from bigdl_tpu import nn as bcriterion
+from bigdl.optim import optimizer as boptimizer
+
+
+def _scalar(v, default=None):
+    """Extract a python float from a Keras hyperparameter (a float, a
+    numpy scalar, or a backend variable with .numpy()).  A PRESENT value
+    that cannot be converted (e.g. a LearningRateSchedule) warns before
+    falling back -- silently training at the default would be worse."""
+    if v is None:
+        return default
+    try:
+        return float(np.asarray(getattr(v, "numpy", lambda: v)()))
+    except Exception:
+        warnings.warn(
+            f"cannot convert Keras hyperparameter {type(v).__name__} to a "
+            f"scalar (schedules are not supported); using {default}")
+        return default
+
+
+class OptimConverter:
+
+    @staticmethod
+    def to_bigdl_metrics(metrics):
+        metrics = metrics if isinstance(metrics, list) else [metrics]
+        out = []
+        for metric in metrics:
+            if metric in ("accuracy", "acc"):
+                out.append(boptimizer.Top1Accuracy())
+            elif metric in ("top5", "top_k_categorical_accuracy"):
+                out.append(boptimizer.Top5Accuracy())
+            elif metric in ("mae", "mean_absolute_error"):
+                out.append(boptimizer.MAE())
+            else:
+                raise Exception(f"Not supported metric: {metric}")
+        return out
+
+    @staticmethod
+    def to_bigdl_criterion(kloss):
+        name = kloss if isinstance(kloss, str) else \
+            getattr(kloss, "__name__", type(kloss).__name__)
+        name = name.lower()
+        table = {
+            "categorical_crossentropy": bcriterion.CategoricalCrossEntropy,
+            "categoricalcrossentropy": bcriterion.CategoricalCrossEntropy,
+            "mse": bcriterion.MSECriterion,
+            "mean_squared_error": bcriterion.MSECriterion,
+            "meansquarederror": bcriterion.MSECriterion,
+            "binary_crossentropy": bcriterion.BCECriterion,
+            "binarycrossentropy": bcriterion.BCECriterion,
+            "mae": bcriterion.AbsCriterion,
+            "mean_absolute_error": bcriterion.AbsCriterion,
+            "meanabsoluteerror": bcriterion.AbsCriterion,
+            "hinge": bcriterion.MarginCriterion,
+            "mean_absolute_percentage_error":
+                bcriterion.MeanAbsolutePercentageCriterion,
+            "mape": bcriterion.MeanAbsolutePercentageCriterion,
+            "mean_squared_logarithmic_error":
+                bcriterion.MeanSquaredLogarithmicCriterion,
+            "msle": bcriterion.MeanSquaredLogarithmicCriterion,
+            "kullback_leibler_divergence":
+                bcriterion.KullbackLeiblerDivergenceCriterion,
+            "kld": bcriterion.KullbackLeiblerDivergenceCriterion,
+            "poisson": bcriterion.PoissonCriterion,
+            "cosine_proximity": bcriterion.CosineProximityCriterion,
+            "cosine": bcriterion.CosineProximityCriterion,
+        }
+        if name in table:
+            return table[name]()
+        if name == "squared_hinge":
+            return bcriterion.MarginCriterion(squared=True)
+        if name in ("sparse_categorical_crossentropy",
+                    "sparsecategoricalcrossentropy"):
+            return bcriterion.ClassNLLCriterion(logProbAsInput=False)
+        raise Exception(f"Not supported loss: {kloss}")
+
+    @staticmethod
+    def to_bigdl_optim_method(koptim_method):
+        if isinstance(koptim_method, str):
+            name, k = koptim_method.lower(), None
+        else:
+            name, k = type(koptim_method).__name__.lower(), koptim_method
+        lr = _scalar(getattr(k, "learning_rate", getattr(k, "lr", None)),
+                     0.01) if k is not None else 0.01
+        decay = _scalar(getattr(k, "decay", None), 0.0) if k else 0.0
+        if name == "adagrad":
+            warnings.warn("For Adagrad, we don't support epsilon for now")
+            return boptimizer.Adagrad(learningrate=lr,
+                                      learningrate_decay=decay)
+        if name == "sgd":
+            return boptimizer.SGD(
+                learningrate=lr, learningrate_decay=decay,
+                momentum=_scalar(getattr(k, "momentum", None), 0.0) if k else 0.0,
+                nesterov=bool(getattr(k, "nesterov", False)) if k else False)
+        if name == "adam":
+            kw = {}
+            if k is not None:
+                kw = dict(beta1=_scalar(getattr(k, "beta_1", None), 0.9),
+                          beta2=_scalar(getattr(k, "beta_2", None), 0.999),
+                          epsilon=_scalar(getattr(k, "epsilon", None), 1e-8))
+            return boptimizer.Adam(learningrate=lr,
+                                   learningrate_decay=decay, **kw)
+        if name == "rmsprop":
+            kw = {}
+            if k is not None:
+                kw = dict(decayrate=_scalar(getattr(k, "rho", None), 0.9),
+                          epsilon=_scalar(getattr(k, "epsilon", None), 1e-8))
+            return boptimizer.RMSprop(learningrate=lr,
+                                      learningrate_decay=decay, **kw)
+        if name == "adadelta":
+            warnings.warn("For Adadelta, we don't support learning rate "
+                          "and learning rate decay for now")
+            kw = {}
+            if k is not None:
+                kw = dict(decayrate=_scalar(getattr(k, "rho", None), 0.95),
+                          epsilon=_scalar(getattr(k, "epsilon", None), 1e-8))
+            return boptimizer.Adadelta(**kw)
+        if name == "adamax":
+            kw = {}
+            if k is not None:
+                kw = dict(beta1=_scalar(getattr(k, "beta_1", None), 0.9),
+                          beta2=_scalar(getattr(k, "beta_2", None), 0.999),
+                          epsilon=_scalar(getattr(k, "epsilon", None), 1e-8))
+            return boptimizer.Adamax(learningrate=lr, **kw)
+        raise Exception(f"Not supported optimizer: {koptim_method}")

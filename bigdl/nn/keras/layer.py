@@ -1,0 +1,11 @@
+"""bigdl.nn.keras.layer — pyspark Keras-style layer API, drop-in names.
+
+Reference: pyspark/bigdl/nn/keras/layer.py (63 classes).  The working
+implementations live in bigdl_tpu.keras.layers; this module re-exports
+them under the reference import path so unmodified reference code
+(``from bigdl.nn.keras.layer import Dense, Convolution2D, ...``) runs.
+``InferShape``/``KerasCreator`` are py4j plumbing with no analogue.
+"""
+
+from bigdl_tpu.keras.layers import *          # noqa: F401,F403
+from bigdl_tpu.keras.topology import Input    # noqa: F401

@@ -1,0 +1,10 @@
+"""bigdl.nn.keras.topology — pyspark Keras-style model containers.
+
+Reference: pyspark/bigdl/nn/keras/topology.py (KerasModel base with
+compile/fit/evaluate/predict, Sequential, Model).  Re-exports the
+bigdl_tpu.keras containers, whose compile/fit surface follows the same
+reference contract.
+"""
+
+from bigdl_tpu.keras.topology import (KerasLayer as KerasModel,  # noqa: F401
+                                      Model, Sequential)

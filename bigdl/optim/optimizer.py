@@ -32,13 +32,70 @@ def SGD(learningrate=1e-3, learningrate_decay=0.0, weightdecay=0.0,
         dampening=momentum if dampening is None else dampening,
         nesterov=nesterov, learning_rate_schedule=leaningrate_schedule,
         **kw)
-Adam = _optim.Adam
-Adagrad = _optim.Adagrad
-Adadelta = _optim.Adadelta
-Adamax = _optim.Adamax
-RMSprop = _optim.RMSprop
-Ftrl = _optim.Ftrl
-ParallelAdam = _optim.ParallelAdam
+def Adam(learningrate=1e-3, learningrate_decay=0.0, beta1=0.9, beta2=0.999,
+         epsilon=1e-8, bigdl_type="float", **kw):
+    """pyspark Adam signature adapter (optimizer.py:567)."""
+    return _optim.Adam(learning_rate=kw.pop("learning_rate", learningrate),
+                       learning_rate_decay=learningrate_decay,
+                       beta1=beta1, beta2=beta2, epsilon=epsilon, **kw)
+
+
+def Adagrad(learningrate=1e-3, learningrate_decay=0.0, weightdecay=0.0,
+            bigdl_type="float", **kw):
+    """pyspark Adagrad signature adapter (optimizer.py:505)."""
+    return _optim.Adagrad(
+        learning_rate=kw.pop("learning_rate", learningrate),
+        learning_rate_decay=learningrate_decay, weight_decay=weightdecay,
+        **kw)
+
+
+def Adadelta(decayrate=0.9, epsilon=1e-10, bigdl_type="float", **kw):
+    """pyspark Adadelta signature adapter (optimizer.py:561)."""
+    return _optim.Adadelta(decay_rate=kw.pop("decay_rate", decayrate),
+                           epsilon=epsilon, **kw)
+
+
+def Adamax(learningrate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-38,
+           bigdl_type="float", **kw):
+    """pyspark Adamax signature adapter (optimizer.py:644)."""
+    return _optim.Adamax(learning_rate=kw.pop("learning_rate", learningrate),
+                         beta1=beta1, beta2=beta2, epsilon=epsilon, **kw)
+
+
+def RMSprop(learningrate=1e-2, learningrate_decay=0.0, decayrate=0.99,
+            epsilon=1e-8, bigdl_type="float", **kw):
+    """pyspark RMSprop signature adapter (optimizer.py:665)."""
+    return _optim.RMSprop(learning_rate=kw.pop("learning_rate", learningrate),
+                          learning_rate_decay=learningrate_decay,
+                          decay_rate=kw.pop("decay_rate", decayrate),
+                          epsilon=epsilon, **kw)
+
+
+def Ftrl(learningrate=1e-3, learningrate_power=-0.5,
+         initial_accumulator_value=0.1, l1_regularization_strength=0.0,
+         l2_regularization_strength=0.0,
+         l2_shrinkage_regularization_strength=0.0, bigdl_type="float", **kw):
+    """pyspark Ftrl signature adapter (optimizer.py:613)."""
+    return _optim.Ftrl(
+        learning_rate=kw.pop("learning_rate", learningrate),
+        learning_rate_power=learningrate_power,
+        initial_accumulator_value=initial_accumulator_value,
+        l1_regularization_strength=l1_regularization_strength,
+        l2_regularization_strength=l2_regularization_strength,
+        l2_shrinkage_regularization_strength=(
+            l2_shrinkage_regularization_strength), **kw)
+
+
+def ParallelAdam(learningrate=1e-3, learningrate_decay=0.0, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, parallel_num=-1,
+                 bigdl_type="float", **kw):
+    """pyspark ParallelAdam signature adapter (optimizer.py:589); the
+    chunk-parallelism seam is the mesh, so parallel_num is accepted and
+    ignored."""
+    return _optim.ParallelAdam(
+        learning_rate=kw.pop("learning_rate", learningrate),
+        learning_rate_decay=learningrate_decay,
+        beta1=beta1, beta2=beta2, epsilon=epsilon, **kw)
 
 # LR schedules
 Default = _optim.Default
@@ -140,7 +197,8 @@ class ValidationSummary:
         return VS(log_dir, app_name)
 
 
-def _to_dataset(data, batch_size, one_based_labels="auto"):
+def _to_dataset(data, batch_size, one_based_labels="auto",
+                drop_remainder=True):
     from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
     from bigdl.util.common import (Sample, samples_to_arrays,
                                    shift_one_based_labels)
@@ -192,7 +250,7 @@ def _to_dataset(data, batch_size, one_based_labels="auto"):
         # directly, which shard by process index
         return PartitionedDataSet(_CompatPartitions(), host_index=0,
                                   num_hosts=1) >> \
-            SampleToMiniBatch(batch_size)
+            SampleToMiniBatch(batch_size, drop_remainder=drop_remainder)
     if isinstance(data, tuple) and len(data) == 2:
         x, y = data
         y = shift_one_based_labels(y, one_based_labels)
@@ -204,7 +262,7 @@ def _to_dataset(data, batch_size, one_based_labels="auto"):
             "an (X, y) ndarray pair, a pyspark RDD of Samples, or a "
             "partitioned source")
     return array_dataset(np.asarray(x), np.asarray(y)) >> \
-        SampleToMiniBatch(batch_size)
+        SampleToMiniBatch(batch_size, drop_remainder=drop_remainder)
 
 
 class Optimizer:
@@ -236,7 +294,10 @@ class Optimizer:
     def set_validation(self, batch_size, val_rdd, trigger, val_method=None):
         self._opt.set_validation(
             trigger,
-            _to_dataset(val_rdd, batch_size, self._one_based),
+            # validation must see the trailing partial batch (one extra
+            # compile for the tail shape; correctness over a recompile)
+            _to_dataset(val_rdd, batch_size, self._one_based,
+                        drop_remainder=False),
             val_method or [Top1Accuracy()])
         return self
 

@@ -53,6 +53,11 @@ class Top1Accuracy(ValidationMethod):
 
     def batch_result(self, output, target):
         pred = jnp.argmax(output, axis=-1)
+        if target.ndim == pred.ndim + 1:
+            if target.shape[-1] == 1:        # (N, 1) label column
+                target = target[..., 0]
+            else:                            # one-hot targets (keras flow)
+                target = jnp.argmax(target, axis=-1)
         correct = jnp.sum(pred == target.astype(pred.dtype))
         return correct, target.shape[0]
 
@@ -62,6 +67,11 @@ class Top5Accuracy(ValidationMethod):
 
     def batch_result(self, output, target):
         top5 = jnp.argsort(output, axis=-1)[..., -5:]
+        if target.ndim == output.ndim:
+            if target.shape[-1] == 1:        # (N, 1) label column
+                target = target[..., 0]
+            else:                            # one-hot targets (keras flow)
+                target = jnp.argmax(target, axis=-1)
         correct = jnp.sum(jnp.any(top5 == target[..., None].astype(top5.dtype),
                                   axis=-1))
         return correct, target.shape[0]

@@ -1,0 +1,160 @@
+"""bigdl.keras backend compat: run a LIVE Keras model on this stack.
+
+Reference: pyspark/bigdl/keras/backend.py (KerasModelWrapper,
+with_bigdl_backend), optimization.py (OptimConverter), converter.py
+(DefinitionLoader/WeightLoader), and the bigdl.nn.keras drop-in import
+path.  Golden where real Keras is available.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+class TestOptimConverter:
+    def test_criterion_names(self):
+        from bigdl.keras.optimization import OptimConverter
+        from bigdl_tpu import nn
+
+        c = OptimConverter.to_bigdl_criterion
+        assert isinstance(c("mse"), nn.MSECriterion)
+        assert isinstance(c("categorical_crossentropy"),
+                          nn.CategoricalCrossEntropy)
+        assert isinstance(c("binary_crossentropy"), nn.BCECriterion)
+        assert isinstance(c("kld"), nn.KullbackLeiblerDivergenceCriterion)
+        sq = c("squared_hinge")
+        assert isinstance(sq, nn.MarginCriterion) and sq.squared
+        with pytest.raises(Exception):
+            c("nope")
+
+    def test_metrics(self):
+        from bigdl.keras.optimization import OptimConverter
+
+        ms = OptimConverter.to_bigdl_metrics(["accuracy"])
+        assert type(ms[0]).__name__ == "Top1Accuracy"
+
+    def test_optimizer_by_string_and_object(self):
+        from bigdl.keras.optimization import OptimConverter
+
+        m = OptimConverter.to_bigdl_optim_method("sgd")
+        assert type(m).__name__ == "SGD"
+
+        class FakeAdam:                      # duck-typed Keras optimizer
+            learning_rate = 0.005
+            beta_1, beta_2, epsilon = 0.8, 0.99, 1e-7
+        FakeAdam.__name__ = "Adam"
+        m = OptimConverter.to_bigdl_optim_method(FakeAdam())
+        assert type(m).__name__ == "Adam"
+        assert m.learning_rate == pytest.approx(0.005)
+        assert m.beta1 == pytest.approx(0.8)
+
+
+class TestPysparkOptimSignatures:
+    def test_one_word_spellings(self):
+        from bigdl.optim.optimizer import (Adadelta, Adagrad, Adam, Adamax,
+                                           Ftrl, ParallelAdam, RMSprop)
+
+        assert Adam(learningrate=0.02).learning_rate == pytest.approx(0.02)
+        assert Adagrad(weightdecay=0.1).weight_decay == pytest.approx(0.1)
+        assert Adadelta(decayrate=0.5).rho == pytest.approx(0.5)
+        assert Adamax(learningrate=0.01).learning_rate == pytest.approx(0.01)
+        assert RMSprop(learningrate=0.3).learning_rate == pytest.approx(0.3)
+        assert Ftrl(learningrate=0.2).learning_rate == pytest.approx(0.2)
+        # parallel_num is the JVM thread-pool width; accepted and ignored
+        assert ParallelAdam(parallel_num=8).learning_rate == pytest.approx(1e-3)
+
+
+@pytest.mark.slow
+class TestKerasModelWrapper:
+    def _kmodel(self):
+        keras = pytest.importorskip("keras")
+        from keras import layers
+
+        km = keras.Sequential([
+            layers.Input(shape=(8,)),
+            layers.Dense(16, activation="relu"),
+            layers.Dense(4, activation="softmax"),
+        ])
+        km.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                   loss="categorical_crossentropy", metrics=["accuracy"])
+        return keras, km
+
+    def test_predict_matches_keras(self):
+        keras, km = self._kmodel()
+        from bigdl.keras.backend import with_bigdl_backend
+
+        wrapped = with_bigdl_backend(km)
+        x = np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32)
+        gold = km.predict(x, verbose=0)
+        ours = wrapped.predict(x)
+        np.testing.assert_allclose(ours, gold, atol=1e-5)
+
+    def test_fit_and_evaluate(self):
+        keras, km = self._kmodel()
+        from bigdl.keras.backend import KerasModelWrapper
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        labels = rng.integers(0, 4, 64)
+        y = np.eye(4, dtype=np.float32)[labels]
+        wrapped = KerasModelWrapper(km)
+        wrapped.fit(x, y, batch_size=16, nb_epoch=3,
+                    validation_data=(x, y))
+        acc = wrapped.evaluate(x, y, batch_size=16)[0]
+        assert 0.0 <= acc <= 1.0
+
+    def test_unsupported_fit_flags_raise(self):
+        keras, km = self._kmodel()
+        from bigdl.keras.backend import KerasModelWrapper
+
+        wrapped = KerasModelWrapper(km)
+        with pytest.raises(Exception):
+            wrapped.fit(np.zeros((4, 8)), np.zeros((4, 4)),
+                        callbacks=[object()])
+
+
+def test_nn_keras_import_path():
+    """Reference import spelling works end-to-end on a tiny fit."""
+    from bigdl.nn.keras.layer import Dense
+    from bigdl.nn.keras.topology import Sequential
+
+    m = Sequential()
+    m.add(Dense(3, input_shape=(5,)))
+    m.compile(optimizer="sgd", loss="mse")
+    x = np.random.default_rng(2).normal(size=(8, 5)).astype(np.float32)
+    y = np.random.default_rng(3).normal(size=(8, 3)).astype(np.float32)
+    m.fit(x, y, batch_size=4, nb_epoch=1)
+    assert m.predict(x).shape == (8, 3)
+
+
+class TestMetricTargetShapes:
+    def test_top1_label_column_and_one_hot(self):
+        from bigdl_tpu.optim import Top1Accuracy
+
+        out = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+        # (N,) labels, (N,1) label column, (N,2) one-hot: all equivalent
+        for tgt in (jnp.asarray([0, 1]),
+                    jnp.asarray([[0], [1]]),
+                    jnp.asarray([[1.0, 0.0], [0.0, 1.0]])):
+            correct, count = Top1Accuracy().batch_result(out, tgt)
+            assert (int(correct), int(count)) == (2, 2), tgt.shape
+
+    def test_evaluate_smaller_than_batch_and_tail(self):
+        keras = pytest.importorskip("keras")
+        from keras import layers
+        from bigdl.keras.backend import KerasModelWrapper
+
+        km = keras.Sequential([layers.Input(shape=(4,)),
+                               layers.Dense(3, activation="softmax")])
+        km.compile(optimizer="sgd", loss="categorical_crossentropy",
+                   metrics=["accuracy"])
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(20, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 20)]
+        w = KerasModelWrapper(km)
+        acc = w.evaluate(x, y, batch_size=32)[0]   # smaller than batch
+        assert 0.0 <= acc <= 1.0
+        acc = w.evaluate(x, y, batch_size=16)[0]   # partial tail batch
+        assert 0.0 <= acc <= 1.0
