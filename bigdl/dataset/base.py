@@ -1,0 +1,73 @@
+"""bigdl.dataset.base — download/progress helpers.
+
+Reference: pyspark/bigdl/dataset/base.py (Progbar :28, maybe_download
+:176).  This environment has no egress, so maybe_download verifies the
+file exists locally (pre-staged) instead of fetching it.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+class Progbar:
+    """Console progress bar (reference: base.py:28, the Keras-1 bar)."""
+
+    def __init__(self, target, width=30, verbose=1):
+        self.width = width
+        self.target = target
+        self.sum_values = {}
+        self.unique_values = []
+        self.start = time.time()
+        self.total_width = 0
+        self.seen_so_far = 0
+        self.verbose = verbose
+
+    def update(self, current, values=(), force=False):
+        for k, v in values:
+            if k not in self.sum_values:
+                self.sum_values[k] = [v * (current - self.seen_so_far),
+                                      current - self.seen_so_far]
+                self.unique_values.append(k)
+            else:
+                self.sum_values[k][0] += v * (current - self.seen_so_far)
+                self.sum_values[k][1] += current - self.seen_so_far
+        self.seen_so_far = current
+        if self.verbose:
+            bar = f"{current}/{self.target}"
+            for k in self.unique_values:
+                s, n = self.sum_values[k]
+                bar += f" - {k}: {s / max(n, 1):.4f}"
+            sys.stdout.write("\r" + bar)
+            if current >= self.target:
+                sys.stdout.write("\n")
+            sys.stdout.flush()
+
+    def add(self, n, values=()):
+        self.update(self.seen_so_far + n, values)
+
+
+def display_table(rows, positions):
+    """Fixed-position table printer (reference: base.py:136)."""
+    line = ""
+    for i, field in enumerate(rows):
+        line += str(field)
+        line = line[: positions[i]]
+        line += " " * (positions[i] - len(line))
+    print(line)
+
+
+def maybe_download(filename, work_directory, source_url):
+    """Reference base.py:176 downloads from source_url; this offline
+    build only verifies a pre-staged copy exists."""
+    if not os.path.exists(work_directory):
+        os.makedirs(work_directory, exist_ok=True)
+    filepath = os.path.join(work_directory, filename)
+    if not os.path.exists(filepath):
+        raise FileNotFoundError(
+            f"{filepath} not found and this environment has no network "
+            f"egress; stage the file manually (reference source: "
+            f"{source_url})")
+    return filepath

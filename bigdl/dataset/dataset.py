@@ -1,0 +1,34 @@
+"""bigdl.dataset.dataset — DataSet over an ImageFrame.
+
+Reference: pyspark/bigdl/dataset/dataset.py DataSet:27 (image_frame
+classmethod + transform).  The frame's features flow into the native
+Sample pipeline when handed to the compat Optimizer.
+"""
+
+from bigdl_tpu.transform.vision import (DistributedImageFrame,
+                                        FeatureTransformer, ImageFrame)
+
+
+class DataSet:
+
+    def __init__(self, jvalue=None, image_frame=None, bigdl_type="float"):
+        self.bigdl_type = bigdl_type
+        self._frame = image_frame
+
+    @classmethod
+    def image_frame(cls, image_frame, bigdl_type="float"):
+        return DataSet(image_frame=image_frame)
+
+    def transform(self, transformer):
+        if isinstance(transformer, FeatureTransformer):
+            frame = self._frame
+            if isinstance(frame, (ImageFrame, DistributedImageFrame)):
+                frame = frame.transform(transformer)
+            return DataSet(image_frame=frame)
+        raise ValueError("transformer must be a FeatureTransformer")
+
+    def get_image_frame(self):
+        return self._frame
+
+    def to_samples(self):
+        return self._frame.to_samples()

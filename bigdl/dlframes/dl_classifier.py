@@ -1,0 +1,48 @@
+"""Reference: pyspark/bigdl/dlframes/dl_classifier.py."""
+
+from bigdl_tpu.dlframes import (DLClassifier, DLClassifierModel,  # noqa: F401
+                                DLEstimator, DLModel)
+
+
+class _HasParam:
+    """Spark-ML Params mixin stand-ins (reference: dl_classifier.py
+    HasBatchSize/HasMaxEpoch/HasFeatureSize/HasLearningRate).  The
+    native DLEstimator carries these as plain setters; the mixins keep
+    the reference class names importable and the get/set spellings
+    working."""
+
+
+class HasBatchSize(_HasParam):
+    def setBatchSize(self, val):
+        self.batch_size = val
+        return self
+
+    def getBatchSize(self):
+        return self.batch_size
+
+
+class HasMaxEpoch(_HasParam):
+    def setMaxEpoch(self, val):
+        self.max_epoch = val
+        return self
+
+    def getMaxEpoch(self):
+        return self.max_epoch
+
+
+class HasFeatureSize(_HasParam):
+    def setFeatureSize(self, val):
+        self.feature_size = val
+        return self
+
+    def getFeatureSize(self):
+        return self.feature_size
+
+
+class HasLearningRate(_HasParam):
+    def setLearningRate(self, val):
+        self.learning_rate = val
+        return self
+
+    def getLearningRate(self):
+        return self.learning_rate

@@ -1,0 +1,3 @@
+"""Reference: pyspark/bigdl/dlframes/dl_image_reader.py."""
+
+from bigdl_tpu.dlframes import DLImageReader  # noqa: F401

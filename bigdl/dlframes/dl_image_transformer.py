@@ -1,0 +1,3 @@
+"""Reference: pyspark/bigdl/dlframes/dl_image_transformer.py."""
+
+from bigdl_tpu.dlframes import DLImageTransformer  # noqa: F401

@@ -52,3 +52,58 @@ class WeightLoader:
         hdf5 layout itself names the layers."""
         _conv.load_weights_hdf5(bmodel, filepath, by_name=by_name)
         return bmodel
+
+
+class WeightsConverter:
+    """Keras-layer weight-array conversion entry points (reference:
+    pyspark converter.py:110).  Conversion itself lives in
+    bigdl_tpu.keras.converter's weight installers; these statics expose
+    the reference's read-side helpers."""
+
+    @staticmethod
+    def get_weights_from_kmodel(kmodel):
+        """All parameter arrays of a Keras model, layer-ordered
+        (reference :138)."""
+        out = []
+        for klayer in kmodel.layers:
+            out.extend(klayer.get_weights())
+        return out
+
+    @staticmethod
+    def get_bigdl_weights_from_klayer(klayer):
+        """Weights of one Keras layer in bigdl order (reference :133);
+        the native installers handle per-layer transposition, so the
+        arrays pass through unchanged here."""
+        return list(klayer.get_weights())
+
+    @staticmethod
+    def to_bigdl_weights(klayer, weights):
+        return list(weights)
+
+
+class LayerConverter:
+    """Per-layer definition converter (reference: converter.py:420).
+    The conversion dispatch lives in
+    bigdl_tpu.keras.converter.model_from_json; this entry point converts
+    a single layer config the same way."""
+
+    def __init__(self, klayer, kclayer=None, input_shape=None):
+        self.klayer = klayer
+        self.kclayer = kclayer
+        self.input_shape = input_shape
+
+    def create(self):
+        # precedence mirrors the reference call pattern: the kclayer
+        # config dict when provided, else the live layer's own config
+        spec = self.kclayer if isinstance(self.kclayer, dict) else None
+        if spec is None and isinstance(self.klayer, dict):
+            spec = self.klayer
+        if spec is None and hasattr(self.klayer, "get_config"):
+            spec = {"class_name": type(self.klayer).__name__,
+                    "config": self.klayer.get_config()}
+        if spec is None:
+            raise ValueError("klayer must be a config dict or Keras layer")
+        from bigdl_tpu.keras.converter import _build_layer
+
+        layer, _ = _build_layer(spec["class_name"], spec.get("config", {}))
+        return layer

@@ -80,3 +80,10 @@ class CrossEntropyCriterion(_CrossEntropy):
         if not self._targets_already_zero_based:
             target = _shift_labels(target)
         return super().apply(input, target)
+
+
+# remaining reference names (pyspark criterion.py class sweep)
+from bigdl_tpu.nn import Criterion                              # noqa: E402,F401
+from bigdl_tpu.nn import PGCriterion                            # noqa: E402,F401
+from bigdl_tpu.nn import SmoothL1CriterionWithWeights           # noqa: E402,F401
+from bigdl_tpu.nn import SoftmaxWithCriterion                   # noqa: E402,F401

@@ -1,0 +1,10 @@
+"""bigdl.nn.initialization_method — pyspark init-method names.
+
+Reference: pyspark/bigdl/nn/initialization_method.py.  Implementations:
+bigdl_tpu.nn.initialization.
+"""
+
+from bigdl_tpu.nn.initialization import *    # noqa: F401,F403
+from bigdl_tpu.nn.initialization import (    # noqa: F401
+    InitializationMethod, Zeros, Ones, RandomUniform, RandomNormal,
+    ConstInitMethod, Xavier, MsraFiller, BilinearFiller)

@@ -9,3 +9,38 @@ them under the reference import path so unmodified reference code
 
 from bigdl_tpu.keras.layers import *          # noqa: F401,F403
 from bigdl_tpu.keras.topology import Input    # noqa: F401
+
+
+class InferShape:
+    """Shape-introspection mixin (reference: pyspark/bigdl/nn/keras/
+    layer.py:27): get_input_shape/get_output_shape on a BUILT layer or
+    model; shapes are keras-style tuples with a None batch dim."""
+
+    @staticmethod
+    def _to_keras_shape(spec):
+        shape = spec.shape if hasattr(spec, "shape") else tuple(spec)
+        return (None,) + tuple(shape[1:])
+
+    def get_input_shape(self):
+        spec = getattr(self, "_build_spec", None)
+        if spec is None:
+            raise RuntimeError("build the layer/model first")
+        if isinstance(spec, (list, tuple)):
+            return [self._to_keras_shape(s) for s in spec]
+        return self._to_keras_shape(spec)
+
+    def get_output_shape(self):
+        spec = getattr(self, "_build_spec", None)
+        if spec is None:
+            raise RuntimeError("build the layer/model first")
+        out = self.output_spec(self._params, self._state, spec)
+        if isinstance(out, (list, tuple)):
+            return [self._to_keras_shape(s) for s in out]
+        return self._to_keras_shape(out)
+
+
+class KerasCreator:
+    """n/a stub (reference: py4j name-prefix plumbing, layer.py:58)."""
+
+    def jvm_class_constructor(self):
+        return "createKeras" + type(self).__name__

@@ -318,3 +318,15 @@ class SpatialConvolutionMap(_nn.SpatialConvolutionMap):
                          data_format="NCHW", name=name)
         self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
         _set_native_regs(self, wRegularizer, bRegularizer)
+
+
+class SharedStaticUtils:
+    """Static load helpers shared by Layer/Model (reference: pyspark
+    layer.py:64 — the py4j `of` plumbing is n/a; `load` delegates to the
+    native loader)."""
+
+    @staticmethod
+    def load(path, bigdl_type="float"):
+        from bigdl_tpu.utils.serializer import load_module
+
+        return load_module(path)

@@ -1,0 +1,13 @@
+"""bigdl.transform.vision.image — pyspark vision API, drop-in names.
+
+Reference: pyspark/bigdl/transform/vision/image.py (41 classes).  The
+implementations are the host-side numpy pipeline in
+bigdl_tpu.transform.vision (+ ROI label transforms in .vision_roi);
+this module re-exports them under the reference import path.
+"""
+
+from bigdl_tpu.transform.vision import *        # noqa: F401,F403
+from bigdl_tpu.transform.vision import (        # noqa: F401
+    ImageFeature, ImageFrame, LocalImageFrame, DistributedImageFrame,
+    FeatureTransformer, Pipeline, SeqFileFolder)
+from bigdl_tpu.transform.vision_roi import *    # noqa: F401,F403

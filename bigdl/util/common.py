@@ -115,3 +115,86 @@ def shift_one_based_labels(labs, one_based_labels="auto"):
             stacklevel=2)
         labs = labs - 1      # Torch 1-based -> 0-based
     return labs
+
+
+class EvaluatedResult:
+    """A testing result benchmarking model quality (reference:
+    pyspark/bigdl/util/common.py:115)."""
+
+    def __init__(self, result, total_num, method):
+        self.result = result
+        self.total_num = total_num
+        self.method = method
+
+    def __reduce__(self):
+        return EvaluatedResult, (self.result, self.total_num, self.method)
+
+    def __str__(self):
+        return (f"Evaluated result: {self.result}, total_num: "
+                f"{self.total_num}, method: {self.method}")
+
+
+class RNG:
+    """Seeded tensor-data generator (reference: common.py:389; the JVM
+    RandomGenerator facade)."""
+
+    def __init__(self, bigdl_type="float"):
+        from bigdl_tpu.utils.random_generator import RNG as _native
+        self._rng = _native
+
+    def set_seed(self, seed):
+        self._rng.set_seed(seed)
+
+    def uniform(self, a, b, size):
+        import numpy as np
+
+        return np.asarray(self._rng.uniform(tuple(size), low=a, high=b))
+
+
+class JavaValue:
+    """py4j value-holder base (reference: common.py:50).  There is no JVM
+    here; this stub preserves the attribute contract (``value`` /
+    ``bigdl_type``) so reference code subclassing or isinstance-checking
+    JavaValue imports and runs."""
+
+    def __init__(self, jvalue=None, bigdl_type="float", *args):
+        self.value = jvalue
+        self.bigdl_type = bigdl_type
+
+
+class SingletonMixin:
+    _instance = None
+
+    @classmethod
+    def instance(cls, *args, **kwargs):
+        if cls._instance is None:
+            cls._instance = cls(*args, **kwargs)
+        return cls._instance
+
+
+class JActivity:
+    """reference common.py: wraps an activity for py4j transport."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+class GatewayWrapper(SingletonMixin):
+    """n/a stub: there is no py4j gateway; kept for import parity."""
+
+    def __init__(self, bigdl_type="float", port=25333):
+        self.value = None
+
+
+class JavaCreator(SingletonMixin):
+    """n/a stub: JVM-side factory registry; kept for import parity."""
+
+    _java_creator_class = []
+
+    @classmethod
+    def get_creator_class(cls):
+        return cls._java_creator_class
+
+    @classmethod
+    def set_creator_class(cls, cclass):
+        cls._java_creator_class = [cclass]

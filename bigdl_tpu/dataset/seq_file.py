@@ -171,8 +171,10 @@ def read_name(key_text: str) -> str:
 
 
 def find_seq_files(folder):
-    """Sorted .seq files under a folder (reference: findFiles,
-    DataSet.scala:594)."""
+    """Sorted .seq files under a folder -- or the file itself when given
+    a single .seq path (reference: findFiles, DataSet.scala:594)."""
+    if os.path.isfile(folder):
+        return [folder]
     out = [os.path.join(folder, f) for f in sorted(os.listdir(folder))
            if f.endswith(".seq")]
     if not out:

@@ -70,3 +70,26 @@ class MsraFiller(InitializationMethod):
         n = (fan_in + fan_out) / 2.0 if self.variance_norm_average else fan_in
         std = math.sqrt(2.0 / max(n, 1))
         return std * jax.random.normal(rng, shape, dtype)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear-interpolation kernel init for upsampling deconvolutions
+    (reference: nn/InitializationMethod.scala:340 BilinearFiller, whose
+    JVM weights are (..., kH, kW)).  THIS repo's conv weights are HWIO
+    -- spatial axes FIRST (conv.py setup: (kh, kw, cin, cout)) -- so the
+    (square) kernel is built over the LEADING two axes and broadcast
+    across the channel axes."""
+
+    def init(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        kh, kw = shape[0], shape[1]
+        if kh != kw:
+            raise ValueError(f"Kernel {kh} x {kw} must be square")
+        f = int(jnp.ceil(kw / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        x = jnp.arange(kw, dtype=dtype)
+        y = jnp.arange(kh, dtype=dtype)
+        wx = 1.0 - jnp.abs(x / f - c)
+        wy = 1.0 - jnp.abs(y / f - c)
+        kernel = (wy[:, None] * wx[None, :]).reshape(
+            (kh, kw) + (1,) * (len(shape) - 2))
+        return jnp.broadcast_to(kernel, shape).astype(dtype)

@@ -496,3 +496,348 @@ def bilinear_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
     out = top * (1 - wy) + bot * wy
     return out.astype(np.float32)
+
+
+class Pipeline(FeatureTransformer):
+    """Chain a list of transformers (reference: FeatureTransformer
+    Pipeline, pyspark image.py:51)."""
+
+    def __init__(self, transformers: List[FeatureTransformer]):
+        self.transformers = list(transformers)
+
+    def transform(self, feature):
+        for t in self.transformers:
+            feature = t(feature)
+        return feature
+
+
+class PixelNormalize(PixelNormalizer):
+    """pyspark spelling of PixelNormalizer; accepts the means as a flat
+    H*W*C array and reshapes against the incoming image
+    (reference: pyspark image.py:390 PixelNormalize)."""
+
+    def transform(self, feature):
+        img = feature["image"]
+        feature["image"] = img - self.means.reshape(img.shape)
+        return feature
+
+
+class FixedCrop(FeatureTransformer):
+    """Crop a fixed area; coordinates normalized to [0,1] or absolute
+    (reference: pyspark FixedCrop :426)."""
+
+    def __init__(self, x1, y1, x2, y2, normalized=True, is_clip=True):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+        self.is_clip = is_clip
+
+    def _crop(self, img, box):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = box
+        if self.normalized:
+            x1, y1, x2, y2 = x1 * w, y1 * h, x2 * w, y2 * h
+        if self.is_clip:
+            x1, x2 = max(0.0, x1), min(float(w), x2)
+            y1, y2 = max(0.0, y1), min(float(h), y2)
+        x1, y1, x2, y2 = (int(round(v)) for v in (x1, y1, x2, y2))
+        return img[y1:y2, x1:x2]
+
+    def transform(self, feature):
+        feature["image"] = self._crop(feature["image"], self.box)
+        return feature
+
+
+class DetectionCrop(FixedCrop):
+    """Crop to the detection stored under ``roi_key`` (first box, layout
+    [..., x1, y1, x2, y2] tail -- reference: DetectionCrop.scala)."""
+
+    def __init__(self, roi_key, normalized=True):
+        super().__init__(0, 0, 1, 1, normalized=normalized, is_clip=True)
+        self.roi_key = roi_key
+
+    def transform(self, feature):
+        roi = np.asarray(feature[self.roi_key], np.float32).reshape(-1)
+        feature["image"] = self._crop(feature["image"], tuple(roi[-4:]))
+        return feature
+
+
+class MatToFloats(FeatureTransformer):
+    """Expose the decoded image as a flat float array under ``out_key``
+    (reference: pyspark MatToFloats :583; the mat-release/share-buffer
+    mechanics are OpenCV memory management with no analogue here)."""
+
+    def __init__(self, valid_height=300, valid_width=300, valid_channel=3,
+                 out_key="floats", share_buffer=True):
+        self.valid = (valid_height, valid_width, valid_channel)
+        self.out_key = out_key
+
+    def transform(self, feature):
+        img = feature.get("image")
+        if img is None:                      # invalid image: typed zeros
+            img = np.zeros(self.valid, np.float32)
+        feature[self.out_key] = np.asarray(img, np.float32)
+        return feature
+
+
+class MatToTensor(FeatureTransformer):
+    """Store the image as a CHW float tensor under ``tensor_key``
+    (reference: pyspark MatToTensor :598 -- the JVM tensor is CHW).
+    ``to_rgb`` flips the channel order (the reference's mats are BGR;
+    images decoded here are already RGB, so this flips only when the
+    pipeline upstream produced reversed channels)."""
+
+    def __init__(self, to_rgb=False, tensor_key="imageTensor"):
+        self.to_rgb = to_rgb
+        self.tensor_key = tensor_key
+
+    def transform(self, feature):
+        img = np.asarray(feature["image"], np.float32)
+        if self.to_rgb:
+            img = img[..., ::-1]
+        feature[self.tensor_key] = np.transpose(img, (2, 0, 1)).copy()
+        return feature
+
+
+class ImageFrameToSample(FeatureTransformer):
+    """Build the Sample from stored tensors (reference: pyspark
+    ImageFrameToSample :651)."""
+
+    def __init__(self, input_keys=("imageTensor",), target_keys=None,
+                 sample_key="sample"):
+        self.input_keys = list(input_keys)
+        self.target_keys = list(target_keys) if target_keys else None
+        self.sample_key = sample_key
+
+    def transform(self, feature):
+        ins = [np.asarray(feature[k], np.float32) for k in self.input_keys]
+        tgts = None
+        if self.target_keys:
+            tgts = [np.asarray(feature[k], np.float32)
+                    for k in self.target_keys]
+            tgts = tgts[0] if len(tgts) == 1 else tgts
+        feature[self.sample_key] = Sample(
+            ins[0] if len(ins) == 1 else ins, tgts)
+        return feature
+
+
+class BytesToMat(FeatureTransformer):
+    """Decode an original image file's bytes into the image array
+    (reference: pyspark BytesToMat :644)."""
+
+    def __init__(self, byte_key="bytes"):
+        self.byte_key = byte_key
+
+    def transform(self, feature):
+        import io
+
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(feature[self.byte_key])).convert("RGB")
+        feature["image"] = np.asarray(img, np.float32)
+        feature["original_size"] = feature["image"].shape
+        return feature
+
+
+class PixelBytesToMat(FeatureTransformer):
+    """Raw HWC pixel bytes -> image array; the pixel buffer carries no
+    shape, so the feature must hold ``original_size``
+    (reference: pyspark PixelBytesToMat :657)."""
+
+    def __init__(self, byte_key="bytes"):
+        self.byte_key = byte_key
+
+    def transform(self, feature):
+        shape = tuple(feature["original_size"])
+        buf = np.frombuffer(feature[self.byte_key], np.uint8)
+        feature["image"] = buf.reshape(shape).astype(np.float32)
+        return feature
+
+
+class FixExpand(FeatureTransformer):
+    """Expand to (expand_height, expand_width), original image centered,
+    blank filled with zeros (reference: pyspark FixExpand :664)."""
+
+    def __init__(self, expand_height, expand_width):
+        self.eh, self.ew = int(expand_height), int(expand_width)
+
+    def transform(self, feature):
+        img = feature["image"]
+        h, w = img.shape[:2]
+        out = np.zeros((self.eh, self.ew) + img.shape[2:], img.dtype)
+        y0, x0 = (self.eh - h) // 2, (self.ew - w) // 2
+        out[y0:y0 + h, x0:x0 + w] = img
+        feature["image"] = out
+        return feature
+
+
+class RandomAspectScale(FeatureTransformer):
+    """Aspect-preserving resize with the short-side target drawn from
+    ``scales`` (reference: pyspark RandomAspectScale :633)."""
+
+    def __init__(self, scales, scale_multiple_of=1, max_size=1000,
+                 seed: Optional[int] = None):
+        self.scales = list(scales)
+        self.multiple_of = int(scale_multiple_of)
+        self.max_size = int(max_size)
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        img = feature["image"]
+        h, w = img.shape[:2]
+        scale = self.scales[int(self.rng.integers(0, len(self.scales)))]
+        ratio = scale / min(h, w)
+        if max(h, w) * ratio > self.max_size:
+            ratio = self.max_size / max(h, w)
+        nh, nw = int(round(h * ratio)), int(round(w * ratio))
+        if self.multiple_of > 1:
+            nh -= nh % self.multiple_of
+            nw -= nw % self.multiple_of
+        feature["image"] = bilinear_resize(img, max(nh, 1), max(nw, 1))
+        return feature
+
+
+class RandomAlterAspect(FeatureTransformer):
+    """Random area-ratio crop with aspect jitter, resized to a square of
+    ``crop_length`` (reference: pyspark RandomAlterAspect :685 -- the
+    caffe PCA-style aspect augmentation)."""
+
+    def __init__(self, min_area_ratio, max_area_ratio,
+                 min_aspect_ratio_change, interp_mode="CUBIC",
+                 crop_length=224, seed: Optional[int] = None):
+        self.min_area = float(min_area_ratio)
+        self.max_area = float(max_area_ratio)
+        self.aspect_change = float(min_aspect_ratio_change)
+        self.crop_length = int(crop_length)
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        img = feature["image"]
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * self.rng.uniform(self.min_area, self.max_area)
+            aspect = self.rng.uniform(self.aspect_change,
+                                      1.0 / max(self.aspect_change, 1e-6))
+            ch = int(round(np.sqrt(target / aspect)))
+            cw = int(round(np.sqrt(target * aspect)))
+            if ch <= h and cw <= w and ch > 0 and cw > 0:
+                y0 = int(self.rng.integers(0, h - ch + 1))
+                x0 = int(self.rng.integers(0, w - cw + 1))
+                img = img[y0:y0 + ch, x0:x0 + cw]
+                break
+        feature["image"] = bilinear_resize(img, self.crop_length,
+                                           self.crop_length)
+        return feature
+
+
+class RandomCropper(FeatureTransformer):
+    """Fixed-size crop (random or center) with random mirror
+    (reference: pyspark RandomCropper :705; cropper_method "Random" or
+    "Center")."""
+
+    def __init__(self, crop_w, crop_h, mirror=True, cropper_method="Random",
+                 channels=3, seed: Optional[int] = None):
+        self.w, self.h = int(crop_w), int(crop_h)
+        self.mirror = mirror
+        self.method = cropper_method
+        self.rng = np.random.default_rng(seed)
+
+    def transform(self, feature):
+        img = feature["image"]
+        h, w = img.shape[:2]
+        if str(self.method).lower() == "random":
+            y0 = int(self.rng.integers(0, max(h - self.h, 0) + 1))
+            x0 = int(self.rng.integers(0, max(w - self.w, 0) + 1))
+        else:
+            y0, x0 = (h - self.h) // 2, (w - self.w) // 2
+        img = img[y0:y0 + self.h, x0:x0 + self.w]
+        if self.mirror and self.rng.uniform() < 0.5:
+            img = img[:, ::-1]
+        feature["image"] = np.ascontiguousarray(img)
+        return feature
+
+
+class LocalImageFrame(ImageFrame):
+    """Explicitly host-local frame (reference: ImageFrame.scala
+    LocalImageFrame); ImageFrame already is local here."""
+
+
+class DistributedImageFrame:
+    """ImageFrame over a partitioned source of ImageFeatures (reference:
+    DistributedImageFrame over an RDD).  Transforms apply lazily per
+    partition through the same PartitionedSource protocol the training
+    ingest uses (dataset/distributed.py)."""
+
+    def __init__(self, source, transformers=None):
+        self.source = source
+        self.transformers = list(transformers or [])
+
+    def transform(self, transformer) -> "DistributedImageFrame":
+        self.transformers.append(transformer)
+        return self
+
+    __rshift__ = transform
+
+    def num_partitions(self):
+        return self.source.num_partitions()
+
+    def partition(self, idx) -> List[ImageFeature]:
+        feats = list(self.source.partition(idx))
+        for t in self.transformers:
+            feats = [t(f) for f in feats]
+        return feats
+
+    def to_samples(self) -> List[Sample]:
+        out = []
+        to_sample = MatToSample()
+        for i in range(self.num_partitions()):
+            for f in self.partition(i):
+                if "sample" not in f:
+                    f = to_sample(f)
+                out.append(f["sample"])
+        return out
+
+
+class _SeqFilePartitions:
+    """Lazy PartitionedSource: one partition per .seq file, decoded on
+    demand -- ImageNet-scale folders must not materialise in memory."""
+
+    def __init__(self, files, class_num, resize):
+        self.files, self.class_num, self.resize = files, class_num, resize
+
+    def num_partitions(self):
+        return len(self.files)
+
+    def count(self):
+        return sum(1 for i in range(len(self.files))
+                   for _ in self.partition(i))
+
+    def partition(self, idx):
+        import io
+
+        from PIL import Image
+
+        from bigdl_tpu.dataset.seq_file import read_byte_records
+
+        for data, label in read_byte_records(self.files[idx],
+                                             class_num=self.class_num):
+            img = Image.open(io.BytesIO(data)).convert("RGB")
+            if self.resize:
+                img = img.resize((self.resize, self.resize))
+            yield ImageFeature(np.asarray(img, np.float32),
+                               label=int(float(label)) - 1)
+
+
+class SeqFileFolder:
+    """Hadoop SequenceFile folder -> DistributedImageFrame (reference:
+    pyspark SeqFileFolder.files_to_image_frame :726, the ImageNet
+    ingest).  One lazy partition per .seq file: memory stays bounded by
+    a partition, like the reference's RDD."""
+
+    @classmethod
+    def files_to_image_frame(cls, url, sc=None, class_num=1000,
+                             partition_num=-1, resize=None):
+        from bigdl_tpu.dataset.seq_file import find_seq_files
+
+        return DistributedImageFrame(
+            _SeqFilePartitions(find_seq_files(url), class_num, resize))
