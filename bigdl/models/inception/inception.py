@@ -1,0 +1,16 @@
+"""bigdl.models.inception — reference: pyspark inception.py.
+
+The builders delegate to the native Inception family (models/
+inception.py, Concat towers over NHWC); reference names kept.
+"""
+
+from bigdl_tpu.models.inception import (InceptionV1,
+                                        InceptionV1NoAuxClassifier)
+
+
+def inception_v1_no_aux_classifier(class_num, has_dropout=True):
+    return InceptionV1NoAuxClassifier(class_num, has_dropout=has_dropout)
+
+
+def inception_v1(class_num, has_dropout=True):
+    return InceptionV1(class_num, has_dropout=has_dropout)

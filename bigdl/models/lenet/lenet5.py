@@ -1,0 +1,14 @@
+"""bigdl.models.lenet.lenet5 — reference: pyspark lenet5.py:26.
+
+``build_model`` delegates to the native LeNet-5 (models/lenet.py), whose
+topology IS the reference's (conv5x5(6)-tanh-pool / conv5x5(12)-tanh-
+pool / fc100-tanh / fc-logsoftmax).  The native model is NHWC; the
+pyspark flow feeds flat 28*28 MNIST rows which Reshape handles either
+way.
+"""
+
+from bigdl_tpu.models.lenet import LeNet5 as _LeNet5
+
+
+def build_model(class_num):
+    return _LeNet5(class_num=class_num)

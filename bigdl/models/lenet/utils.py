@@ -1,0 +1,18 @@
+"""bigdl.models.lenet.utils — reference: pyspark lenet/utils.py.
+
+The mnist helpers delegate to bigdl.dataset.mnist (synthetic fallback
+when idx files are absent); trigger helpers mirror get_end_trigger.
+"""
+
+from bigdl.dataset import mnist
+from bigdl.optim.optimizer import MaxEpoch, MaxIteration
+
+
+def get_mnist(sc=None, data_type="train", location="/tmp/mnist"):
+    return mnist.read_data_sets(location, kind=data_type)
+
+
+def get_end_trigger(options):
+    if getattr(options, "endTriggerType", "epoch") == "epoch":
+        return MaxEpoch(options.endTriggerNum)
+    return MaxIteration(options.endTriggerNum)

@@ -1,0 +1,4 @@
+"""Reference: pyspark models/local_lenet/local_lenet.py — LeNet on
+local ndarrays (the LocalOptimizer path)."""
+
+from bigdl.models.lenet.lenet5 import build_model  # noqa: F401

@@ -1,0 +1,5 @@
+"""Reference: pyspark models/ml_pipeline/dl_classifier.py — the same
+estimator/classifier family as bigdl.dlframes."""
+
+from bigdl_tpu.dlframes import (DLClassifier, DLClassifierModel,  # noqa: F401
+                                DLEstimator, DLModel)

@@ -1,0 +1,40 @@
+"""bigdl.models.textclassifier — reference: pyspark textclassifier.py:72.
+
+``build_model`` builds the same three variants (cnn via
+TemporalConvolution, lstm/gru via Recurrent) over the compat layer
+names, parameterised explicitly instead of the reference's module-level
+globals.
+"""
+
+from bigdl.nn.layer import (GRU, LSTM, Linear, LogSoftMax, Recurrent,
+                            ReLU, Select, Sequential, Squeeze,
+                            TemporalConvolution, TemporalMaxPooling)
+
+
+def build_model(class_num, model_type="cnn", embedding_dim=128,
+                sequence_len=500, p=0.0):
+    model = Sequential()
+    if model_type.lower() == "cnn":
+        model.add(TemporalConvolution(embedding_dim, 256, 5)) \
+             .add(ReLU()) \
+             .add(TemporalMaxPooling(sequence_len - 5 + 1)) \
+             .add(Squeeze(2))
+    elif model_type.lower() == "lstm":
+        if p:
+            raise NotImplementedError(
+                "in-cell dropout (p > 0) is not supported by the native "
+                "LSTM cell; use p=0 (the reference default)")
+        model.add(Recurrent().add(LSTM(embedding_dim, 256)))
+        model.add(Select(2, -1))
+    elif model_type.lower() == "gru":
+        if p:
+            raise NotImplementedError(
+                "in-cell dropout (p > 0) is not supported by the native "
+                "GRU cell; use p=0 (the reference default)")
+        model.add(Recurrent().add(GRU(embedding_dim, 256)))
+        model.add(Select(2, -1))
+    else:
+        raise ValueError(f"unknown model type: {model_type}")
+    model.add(Linear(256, 128)).add(ReLU()).add(Linear(128, class_num)) \
+         .add(LogSoftMax())
+    return model

@@ -108,9 +108,9 @@ def InceptionV2(class_num=1000):
     )
 
 
-def InceptionV1NoAuxClassifier(class_num=1000):
-    """Input (N, 224, 224, 3)
-    (reference: Inception_v1_NoAuxClassifier.scala)."""
+def _v1_feature1():
+    """Stem through inception_4a (shared by both v1 builders;
+    reference Inception_v1.scala feature1)."""
     return (
         nn.Sequential()
         .add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, data_format="NHWC"))
@@ -124,16 +124,67 @@ def InceptionV1NoAuxClassifier(class_num=1000):
         .add(inception_module(192, 64, 96, 128, 16, 32, 32))
         .add(inception_module(256, 128, 128, 192, 32, 96, 64))
         .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
-        .add(inception_module(480, 192, 96, 208, 16, 48, 64))
+        .add(inception_module(480, 192, 96, 208, 16, 48, 64)))
+
+
+def _v1_feature2():
+    """inception_4b..4d (shared; reference feature2)."""
+    return (
+        nn.Sequential()
         .add(inception_module(512, 160, 112, 224, 24, 64, 64))
         .add(inception_module(512, 128, 128, 256, 24, 64, 64))
-        .add(inception_module(512, 112, 144, 288, 32, 64, 64))
+        .add(inception_module(512, 112, 144, 288, 32, 64, 64)))
+
+
+def _v1_tail():
+    """inception_4e..5b + global pool (shared; reference output3 head)."""
+    return (
+        nn.Sequential()
         .add(inception_module(528, 256, 160, 320, 32, 128, 128))
         .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
         .add(inception_module(832, 256, 160, 320, 32, 128, 128))
         .add(inception_module(832, 384, 192, 384, 48, 128, 128))
-        .add(nn.GlobalAveragePooling2D())
-        .add(nn.Dropout(0.4))
-        .add(nn.Linear(1024, class_num))
-        .add(nn.LogSoftMax())
-    )
+        .add(nn.GlobalAveragePooling2D()))
+
+
+def InceptionV1NoAuxClassifier(class_num=1000, has_dropout=True):
+    """Input (N, 224, 224, 3)
+    (reference: Inception_v1_NoAuxClassifier.scala)."""
+    model = nn.Sequential().add(_v1_feature1()).add(_v1_feature2()) \
+        .add(_v1_tail())
+    if has_dropout:
+        model.add(nn.Dropout(0.4))
+    return model.add(nn.Linear(1024, class_num)).add(nn.LogSoftMax())
+
+
+def InceptionV1(class_num=1000, has_dropout=True):
+    """GoogLeNet WITH the two auxiliary training heads (reference:
+    Inception_v1.scala:190-280): the three LogSoftMax classifier outputs
+    concatenate along the class axis -> (N, 3 * class_num), ordered
+    [main, aux2, aux1] exactly like the reference's nested Concat(2)
+    (split2 = [output3, output2]; split1 = [mainBranch, output1]).
+    Serving slices the first ``class_num`` columns (the main head).
+    """
+    def aux_head(n_in, name):
+        head = (nn.Sequential(name=name)
+                .add(nn.SpatialAveragePooling(5, 5, 3, 3).ceil())
+                .add(_conv(n_in, 128, 1))
+                .add(nn.Flatten())
+                .add(nn.Linear(128 * 4 * 4, 1024))
+                .add(nn.ReLU()))
+        if has_dropout:
+            head.add(nn.Dropout(0.7))
+        return head.add(nn.Linear(1024, class_num)).add(nn.LogSoftMax())
+
+    feature1 = _v1_feature1()
+    feature2 = _v1_feature2()
+
+    output3 = _v1_tail()
+    if has_dropout:
+        output3.add(nn.Dropout(0.4))
+    output3.add(nn.Linear(1024, class_num)).add(nn.LogSoftMax())
+
+    split2 = nn.Concat(1).add(output3).add(aux_head(528, "loss2"))
+    main_branch = nn.Sequential().add(feature2).add(split2)
+    split1 = nn.Concat(1).add(main_branch).add(aux_head(512, "loss1"))
+    return nn.Sequential().add(feature1).add(split1)

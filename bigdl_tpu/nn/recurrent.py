@@ -14,6 +14,8 @@ Inputs are batch-first (N, T, F), matching the reference's default
 ``batchNormParams``-free layout.
 """
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -199,13 +201,29 @@ class Recurrent(Container):
     input (N, T, F) -> output (N, T, H).
     """
 
-    def __init__(self, cell: Cell, reverse=False, name=None):
+    def __init__(self, cell: Optional[Cell] = None, reverse=False,
+                 name=None):
+        # cell may arrive via .add() instead (the reference pyspark
+        # pattern ``Recurrent().add(LSTM(...))``, Recurrent.scala addAll)
         super().__init__(name)
         self.cell = cell
         self.reverse = reverse
-        self.add(cell)
+        if cell is not None:
+            self.add(cell)
+
+    def add(self, module):
+        if self.cell is None:
+            self.cell = module
+        elif module is self.cell:
+            return self                 # idempotent: already held
+        else:
+            raise ValueError("Recurrent holds exactly ONE cell")
+        return super().add(module)
 
     def setup(self, rng, input_spec):
+        if self.cell is None:
+            raise ValueError("Recurrent needs a cell: Recurrent(cell) "
+                             "or Recurrent().add(cell)")
         xt_spec = jax.ShapeDtypeStruct(
             (input_spec.shape[0],) + input_spec.shape[2:], input_spec.dtype)
         return self.cell.setup(rng, xt_spec)

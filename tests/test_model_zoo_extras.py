@@ -78,3 +78,42 @@ class TestRunCommandsSmoke:
         """--fused/--remat/--s2d select the measured-on-chip perf variants
         without changing the recipe."""
         self._run("resnet-imagenet-train", "--fused", "--remat", "--s2d")
+
+
+class TestPysparkModelShims:
+    """bigdl.models.* reference import paths delegate to the native zoo."""
+
+    def test_lenet_builder(self):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl.models.lenet.lenet5 import build_model
+
+        m = build_model(10)
+        m.build(jax.ShapeDtypeStruct((2, 28 * 28), jnp.float32))
+        assert m.forward(jnp.zeros((2, 28 * 28), jnp.float32)).shape == (2, 10)
+
+    def test_textclassifier_builders(self):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl.models.textclassifier.textclassifier import build_model
+
+        for kind in ("cnn", "lstm", "gru"):
+            m = build_model(5, model_type=kind, embedding_dim=16,
+                            sequence_len=12)
+            m.build(jax.ShapeDtypeStruct((2, 12, 16), jnp.float32))
+            out = m.forward(jnp.zeros((2, 12, 16), jnp.float32))
+            assert out.shape == (2, 5), kind
+
+    def test_inception_v1_aux_heads(self):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl.models.inception.inception import inception_v1
+
+        m = inception_v1(7)
+        m.build(jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32))
+        out = m.forward(jnp.zeros((1, 224, 224, 3), jnp.float32))
+        # [main, aux2, aux1] heads concatenated along the class axis
+        assert out.shape == (1, 21)
