@@ -1,5 +1,6 @@
 """Reference: pyspark models/ml_pipeline/dl_classifier.py — the same
-estimator/classifier family as bigdl.dlframes."""
+estimator/classifier family (and Params mixins) as bigdl.dlframes."""
 
-from bigdl_tpu.dlframes import (DLClassifier, DLClassifierModel,  # noqa: F401
-                                DLEstimator, DLModel)
+from bigdl.dlframes.dl_classifier import (  # noqa: F401
+    DLClassifier, DLClassifierModel, DLEstimator, DLModel, HasBatchSize,
+    HasFeatureSize, HasLearningRate, HasMaxEpoch)

@@ -206,10 +206,10 @@ class Recurrent(Container):
         # cell may arrive via .add() instead (the reference pyspark
         # pattern ``Recurrent().add(LSTM(...))``, Recurrent.scala addAll)
         super().__init__(name)
-        self.cell = cell
+        self.cell = None
         self.reverse = reverse
         if cell is not None:
-            self.add(cell)
+            self.add(cell)          # registers as the Container child too
 
     def add(self, module):
         if self.cell is None:

@@ -73,3 +73,7 @@ class TestExamples:
                     argv=["--records", "64", "--batch", "32",
                           "--epochs", "1", "--engine", "ir"])
         assert math.isfinite(loss)
+
+    def test_keras_backend(self):
+        pytest.importorskip("keras")
+        _run("keras_backend")

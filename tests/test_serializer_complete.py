@@ -98,6 +98,7 @@ EXAMPLES = {
     "Padding": (lambda: nn.Padding(1, 2, 0.0), X34),
     "Permute": (lambda: nn.Permute((1, 0, 2)), X34),
     "Replicate": (lambda: nn.Replicate(3, 1), X34),
+    "Tile": (lambda: nn.Tile(1, 2), X34),
     "Reshape": (lambda: nn.Reshape((4, 3)), X34),
     "Reverse": (lambda: nn.Reverse(1), X34),
     "Select": (lambda: nn.Select(1, 1), X34),
@@ -117,6 +118,7 @@ EXAMPLES = {
                            lambda: _r(3, 4)),
     "Bilinear": (lambda: nn.Bilinear(3, 4, 5),
                  lambda: (_r(2, 3), _r(2, 4))),
+    "Add": (lambda: nn.Add(4), lambda: _r(2, 4)),
     "CAdd": (lambda: nn.CAdd((4,)), lambda: _r(2, 4)),
     "CMul": (lambda: nn.CMul((4,)), lambda: _r(2, 4)),
     "Cosine": (lambda: nn.Cosine(4, 3), lambda: _r(2, 4)),
@@ -142,6 +144,9 @@ EXAMPLES = {
     # conv / pool
     "Conv1D": (lambda: nn.Conv1D(4, 6, 3), SEQ),
     "SpatialConvolution": (lambda: nn.SpatialConvolution(3, 4, 3, 3), IMG),
+    "SpatialConvolutionMap": (
+        lambda: nn.SpatialConvolutionMap([[0, 0], [1, 1], [2, 2]], 3, 3,
+                                         pad_w=1, pad_h=1), IMG),
     "SpatialDilatedConvolution": (
         lambda: nn.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 1, 1, 2, 2),
         IMG),
