@@ -18,6 +18,7 @@ import time
 import numpy as np
 
 
+# image models: (module, ctor, input shape, classes)
 MODELS = {
     "lenet": ("bigdl_tpu.models.lenet", "LeNet5", (28, 28, 1), 10),
     "alexnet": ("bigdl_tpu.models.alexnet", "AlexNetOWT", (224, 224, 3), 1000),
@@ -31,11 +32,31 @@ MODELS = {
 }
 
 
-def build_model(name):
+# token models (the BASELINE.md "SimpleRNN LM sample throughput" row and
+# the transformer flagship): (module, ctor, ctor args/kwargs, vocab, seq_len)
+TOKEN_MODELS = {
+    "simplernn": ("bigdl_tpu.models.rnn", "SimpleRNN",
+                  (4000, 40, 4000), {}, 4000, 25),
+    "lstm_lm": ("bigdl_tpu.models.rnn", "LSTMLanguageModel",
+                (10000, 128, 256), {}, 10000, 35),
+    "transformer": ("bigdl_tpu.nn.attention", "TransformerLM",
+                    (8000, 256, 4, 4), {"max_len": 256}, 8000, 256),
+}
+
+
+def _resolve(mod_name, fn_name):
     import importlib
+    return getattr(importlib.import_module(mod_name), fn_name)
+
+
+def build_model(name):
     mod_name, fn_name, shape, classes = MODELS[name]
-    fn = getattr(importlib.import_module(mod_name), fn_name)
-    return fn(), shape, classes
+    return _resolve(mod_name, fn_name)(), shape, classes
+
+
+def build_token_model(name):
+    mod_name, fn_name, args, kwargs, vocab, seq_len = TOKEN_MODELS[name]
+    return _resolve(mod_name, fn_name)(*args, **kwargs), vocab, seq_len
 
 
 def run_perf(model_name="resnet50", batch=32, iterations=20, distributed=False):
@@ -46,12 +67,30 @@ def run_perf(model_name="resnet50", batch=32, iterations=20, distributed=False):
     from bigdl_tpu import optim
     from bigdl_tpu.optim.train_step import make_train_step
 
-    model, shape, classes = build_model(model_name)
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(batch,) + shape), jnp.float32)
-    target = jnp.asarray(rng.integers(0, classes, size=batch))
-
-    criterion = nn.ClassNLLCriterion()
+    if model_name in TOKEN_MODELS:
+        if distributed:
+            raise NotImplementedError(
+                "--distributed drives the image-model DistriOptimizer "
+                "path; token models run the single-chip fused step")
+        # LM perf (reference: models/rnn/README.md throughput log + the
+        # transformer flagship): (N, T) tokens -> per-token NLL
+        model, vocab, seq_len = build_token_model(model_name)
+        x = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)),
+                        jnp.int32)
+        target = jnp.asarray(rng.integers(0, vocab, size=(batch, seq_len)))
+        if model_name == "transformer":
+            # TimeDistributed flattens (N,T,V)->(N*T,V), which is the
+            # shape that engages the Pallas fused-CE kernel
+            criterion = nn.TimeDistributedCriterion(
+                nn.FusedSoftmaxCrossEntropyCriterion())
+        else:
+            criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    else:
+        model, shape, classes = build_model(model_name)
+        x = jnp.asarray(rng.normal(size=(batch,) + shape), jnp.float32)
+        target = jnp.asarray(rng.integers(0, classes, size=batch))
+        criterion = nn.ClassNLLCriterion()
     method = optim.SGD(learning_rate=0.01)
 
     if distributed:
@@ -110,7 +149,8 @@ def _honor_env_platforms():
 def main(argv=None):
     _honor_env_platforms()
     p = argparse.ArgumentParser(prog="bigdl_tpu.models.perf")
-    p.add_argument("--model", default="resnet50", choices=sorted(MODELS))
+    p.add_argument("--model", default="resnet50",
+                   choices=sorted(MODELS) + sorted(TOKEN_MODELS))
     p.add_argument("-b", "--batchSize", type=int, default=32, dest="batch")
     p.add_argument("-i", "--iteration", type=int, default=20,
                    dest="iterations")

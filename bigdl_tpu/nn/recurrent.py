@@ -220,6 +220,12 @@ class Recurrent(Container):
             raise ValueError("Recurrent holds exactly ONE cell")
         return super().add(module)
 
+    def _param_child_items(self, params):
+        # setup() returns the CELL's params directly (no index level),
+        # like MapTable -- route the whole subtree to it for the
+        # frozen-mask walk
+        return [(None, self.cell)] if self.cell is not None else []
+
     def setup(self, rng, input_spec):
         if self.cell is None:
             raise ValueError("Recurrent needs a cell: Recurrent(cell) "

@@ -347,3 +347,19 @@ class TestStaticLoaders:
         np.testing.assert_allclose(
             np.asarray(dst.parameters()[0]["0"]["weight"]),
             np.asarray(src.parameters()[0]["0"]["weight"]), atol=1e-6)
+
+
+def test_freeze_recurrent_cell_masks_params():
+    """Recurrent's params ARE the cell's subtree (MapTable-style routing
+    in the frozen-mask walk): freezing the cell by name must mask every
+    leaf instead of silently matching nothing."""
+    from bigdl_tpu.nn.module import frozen_param_mask
+    from bigdl_tpu.nn.recurrent import LSTM, Recurrent
+
+    RNG.set_seed(70)
+    m = nn.Sequential().add(
+        nn.Recurrent(nn.LSTM(4, 8, name="enc"))).add(nn.Select(1, -1))
+    m.build(jax.ShapeDtypeStruct((2, 5, 4), jnp.float32))
+    m.freeze(["enc"])
+    mask = frozen_param_mask(m, m.parameters()[0])
+    assert not any(jax.tree.leaves(mask))

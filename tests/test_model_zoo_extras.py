@@ -42,6 +42,13 @@ class TestCliMains:
         rate = perf.run_perf("lenet", batch=16, iterations=2)
         assert rate > 0
 
+    def test_perf_driver_token_models(self):
+        """The LM rows (BASELINE.md SimpleRNN throughput; transformer
+        flagship) run through the same fused-step perf harness."""
+        from bigdl_tpu.models import perf
+        assert perf.run_perf("simplernn", batch=4, iterations=2) > 0
+        assert perf.run_perf("transformer", batch=2, iterations=2) > 0
+
 
 @pytest.mark.slow
 class TestRunCommandsSmoke:
