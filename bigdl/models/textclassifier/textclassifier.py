@@ -20,18 +20,10 @@ def build_model(class_num, model_type="cnn", embedding_dim=128,
              .add(TemporalMaxPooling(sequence_len - 5 + 1)) \
              .add(Squeeze(2))
     elif model_type.lower() == "lstm":
-        if p:
-            raise NotImplementedError(
-                "in-cell dropout (p > 0) is not supported by the native "
-                "LSTM cell; use p=0 (the reference default)")
-        model.add(Recurrent().add(LSTM(embedding_dim, 256)))
+        model.add(Recurrent().add(LSTM(embedding_dim, 256, p=p)))
         model.add(Select(2, -1))
     elif model_type.lower() == "gru":
-        if p:
-            raise NotImplementedError(
-                "in-cell dropout (p > 0) is not supported by the native "
-                "GRU cell; use p=0 (the reference default)")
-        model.add(Recurrent().add(GRU(embedding_dim, 256)))
+        model.add(Recurrent().add(GRU(embedding_dim, 256, p=p)))
         model.add(Select(2, -1))
     else:
         raise ValueError(f"unknown model type: {model_type}")

@@ -32,13 +32,33 @@ class Cell(Module):
     """
 
     hidden_size: int
+    p: float = 0.0          # in-cell dropout prob (reference LSTM.scala:57)
 
     def init_hidden(self, batch_size, dtype=jnp.float32):
         raise NotImplementedError
 
-    def step(self, params, x_t, hidden):
-        """-> (output_t, new_hidden)"""
+    def step(self, params, x_t, hidden, drop_key=None):
+        """-> (output_t, new_hidden); ``drop_key`` is a per-timestep PRNG
+        key, passed only when training with in-cell dropout (p > 0)."""
         raise NotImplementedError
+
+    def _gate_matmul(self, x, weight, n_gates, drop_key):
+        """x @ weight.T computed per GATE with an independent dropout
+        mask on x for each gate (reference LSTM.scala:93-106: four
+        Dropout(p) nodes feeding four Linears).  With drop_key None the
+        fused single matmul is used."""
+        dt = x.dtype
+        w = weight.astype(dt)
+        if drop_key is None or self.p <= 0.0:
+            return x @ w.T
+        h = w.shape[0] // n_gates
+        keep = 1.0 - self.p
+        masks = jax.random.bernoulli(
+            drop_key, keep, (n_gates,) + x.shape).astype(dt) / keep
+        wg = w.reshape(n_gates, h, w.shape[1])
+        # (g,N,i) x (g,h,i) -> (N, g*h), matching the fused layout
+        out = jnp.einsum("gni,ghi->ngh", x[None] * masks, wg)
+        return out.reshape(x.shape[0], n_gates * h)
 
     def apply(self, params, state, input, *, training=False, rng=None):
         x_t, hidden = input
@@ -68,7 +88,7 @@ class RnnCell(Cell):
     def init_hidden(self, batch_size, dtype=jnp.float32):
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
-    def step(self, params, x_t, h):
+    def step(self, params, x_t, h, drop_key=None):
         pre = (x_t @ params["weight_ih"].astype(x_t.dtype).T
                + params["bias_ih"].astype(x_t.dtype)
                + h @ params["weight_hh"].astype(x_t.dtype).T
@@ -80,10 +100,11 @@ class RnnCell(Cell):
 class LSTM(Cell):
     """LSTM cell, gate order i,f,g,o (reference: nn/LSTM.scala)."""
 
-    def __init__(self, input_size, hidden_size, name=None):
+    def __init__(self, input_size, hidden_size, p=0.0, name=None):
         super().__init__(name)
         self.input_size = input_size
         self.hidden_size = hidden_size
+        self.p = float(p)
 
     def setup(self, rng, input_spec):
         init = RandomUniform()
@@ -99,12 +120,15 @@ class LSTM(Cell):
         return (jnp.zeros((batch_size, self.hidden_size), dtype),
                 jnp.zeros((batch_size, self.hidden_size), dtype))
 
-    def step(self, params, x_t, hidden):
+    def step(self, params, x_t, hidden, drop_key=None):
         h, c = hidden
         dt = x_t.dtype
-        gates = (x_t @ params["weight_ih"].astype(dt).T
+        ki = kh = None
+        if drop_key is not None:
+            ki, kh = jax.random.split(drop_key)
+        gates = (self._gate_matmul(x_t, params["weight_ih"], 4, ki)
                  + params["bias_ih"].astype(dt)
-                 + h @ params["weight_hh"].astype(dt).T
+                 + self._gate_matmul(h, params["weight_hh"], 4, kh)
                  + params["bias_hh"].astype(dt))
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
@@ -125,10 +149,12 @@ class GRU(Cell):
     diagonal, so importers must match the source convention.
     """
 
-    def __init__(self, input_size, hidden_size, reset_after=True, name=None):
+    def __init__(self, input_size, hidden_size, p=0.0, reset_after=True,
+                 name=None):
         super().__init__(name)
         self.input_size = input_size
         self.hidden_size = hidden_size
+        self.p = float(p)
         self.reset_after = reset_after
 
     def setup(self, rng, input_spec):
@@ -144,25 +170,35 @@ class GRU(Cell):
     def init_hidden(self, batch_size, dtype=jnp.float32):
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
-    def step(self, params, x_t, h):
+    def step(self, params, x_t, h, drop_key=None):
         dt = x_t.dtype
         nh = self.hidden_size
-        gi = x_t @ params["weight_ih"].astype(dt).T + params["bias_ih"].astype(dt)
+        ki = kh = None
+        if drop_key is not None:
+            ki, kh = jax.random.split(drop_key)
+        gi = (self._gate_matmul(x_t, params["weight_ih"], 3, ki)
+              + params["bias_ih"].astype(dt))
         i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
-        W_hh = params["weight_hh"].astype(dt)
+        W_hh = params["weight_hh"]
         b_hh = params["bias_hh"].astype(dt)
         if self.reset_after:
-            gh = h @ W_hh.T + b_hh
+            gh = self._gate_matmul(h, W_hh, 3, kh) + b_hh
             h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
             r = jax.nn.sigmoid(i_r + h_r)
             z = jax.nn.sigmoid(i_z + h_z)
             n = jnp.tanh(i_n + r * h_n)
         else:
-            gh = h @ W_hh[: 2 * nh].T + b_hh[: 2 * nh]
+            kh1 = kh2 = None
+            if kh is not None:
+                kh1, kh2 = jax.random.split(kh)
+            gh = (self._gate_matmul(h, W_hh[: 2 * nh], 2, kh1)
+                  + b_hh[: 2 * nh])
             h_r, h_z = jnp.split(gh, 2, axis=-1)
             r = jax.nn.sigmoid(i_r + h_r)
             z = jax.nn.sigmoid(i_z + h_z)
-            n = jnp.tanh(i_n + (r * h) @ W_hh[2 * nh:].T + b_hh[2 * nh:])
+            n = jnp.tanh(i_n
+                         + self._gate_matmul(r * h, W_hh[2 * nh:], 1, kh2)
+                         + b_hh[2 * nh:])
         h_new = (1.0 - z) * n + z * h
         return h_new, h_new
 
@@ -175,6 +211,15 @@ class MultiRNNCell(Cell):
         self.cells = cells
         self.hidden_size = cells[-1].hidden_size
 
+    def children(self):
+        return list(self.cells)
+
+    @property
+    def p(self):
+        # any inner cell with dropout makes the stack dropout-bearing,
+        # so Recurrent threads per-timestep keys through
+        return max((getattr(c, "p", 0.0) for c in self.cells), default=0.0)
+
     def setup(self, rng, input_spec):
         params = {}
         for i, c in enumerate(self.cells):
@@ -185,11 +230,14 @@ class MultiRNNCell(Cell):
     def init_hidden(self, batch_size, dtype=jnp.float32):
         return tuple(c.init_hidden(batch_size, dtype) for c in self.cells)
 
-    def step(self, params, x_t, hidden):
+    def step(self, params, x_t, hidden, drop_key=None):
+        keys = (jax.random.split(drop_key, len(self.cells))
+                if drop_key is not None else [None] * len(self.cells))
         new_hidden = []
         out = x_t
         for i, c in enumerate(self.cells):
-            out, h = c.step(params[str(i)], out, hidden[i])
+            out, h = c.step(params[str(i)], out, hidden[i],
+                            drop_key=keys[i])
             new_hidden.append(h)
         return out, tuple(new_hidden)
 
@@ -241,11 +289,23 @@ class Recurrent(Container):
             xs = xs[::-1]
         h0 = self.cell.init_hidden(n, input.dtype)
 
-        def body(h, x_t):
-            out, h_new = self.cell.step(params, x_t, h)
-            return h_new, out
+        use_drop = (training and rng is not None
+                    and getattr(self.cell, "p", 0.0) > 0.0)
+        if use_drop:
+            keys = jax.random.split(rng, xs.shape[0])
 
-        _, outs = jax.lax.scan(body, h0, xs)
+            def body(h, xk):
+                x_t, k = xk
+                out, h_new = self.cell.step(params, x_t, h, drop_key=k)
+                return h_new, out
+
+            _, outs = jax.lax.scan(body, h0, (xs, keys))
+        else:
+            def body(h, x_t):
+                out, h_new = self.cell.step(params, x_t, h)
+                return h_new, out
+
+            _, outs = jax.lax.scan(body, h0, xs)
         if self.reverse:
             outs = outs[::-1]
         return jnp.swapaxes(outs, 0, 1), state
@@ -268,9 +328,17 @@ class BiRecurrent(Container):
         pb, _ = self.bwd.setup(child_rng(rng, 1), input_spec)
         return {"fwd": pf, "bwd": pb}, ()
 
+    def _param_child_items(self, params):
+        return [("fwd", self.fwd), ("bwd", self.bwd)]
+
     def apply(self, params, state, input, *, training=False, rng=None):
-        yf, _ = self.fwd.apply(params["fwd"], (), input, training=training)
-        yb, _ = self.bwd.apply(params["bwd"], (), input, training=training)
+        rf = rb = None
+        if rng is not None:
+            rf, rb = jax.random.split(rng)
+        yf, _ = self.fwd.apply(params["fwd"], (), input, training=training,
+                               rng=rf)
+        yb, _ = self.bwd.apply(params["bwd"], (), input, training=training,
+                               rng=rb)
         if self.merge == "concat":
             return jnp.concatenate([yf, yb], axis=-1), state
         return yf + yb, state
@@ -290,19 +358,34 @@ class RecurrentDecoder(Container):
         self.seq_length = seq_length
         self.add(cell)
 
+    def _param_child_items(self, params):
+        # setup() returns the cell's params directly
+        return [(None, self.cell)]
+
     def setup(self, rng, input_spec):
         return self.cell.setup(rng, input_spec)
 
     def apply(self, params, state, input, *, training=False, rng=None):
         h0 = self.cell.init_hidden(input.shape[0], input.dtype)
+        use_drop = (training and rng is not None
+                    and getattr(self.cell, "p", 0.0) > 0.0)
+        if use_drop:
+            keys = jax.random.split(rng, self.seq_length)
 
-        def body(carry, _):
-            x, h = carry
-            out, h_new = self.cell.step(params, x, h)
-            return (out, h_new), out
+            def body(carry, k):
+                x, h = carry
+                out, h_new = self.cell.step(params, x, h, drop_key=k)
+                return (out, h_new), out
 
-        _, outs = jax.lax.scan(body, (input, h0), None,
-                               length=self.seq_length)
+            _, outs = jax.lax.scan(body, (input, h0), keys)
+        else:
+            def body(carry, _):
+                x, h = carry
+                out, h_new = self.cell.step(params, x, h)
+                return (out, h_new), out
+
+            _, outs = jax.lax.scan(body, (input, h0), None,
+                                   length=self.seq_length)
         return jnp.swapaxes(outs, 0, 1), state
 
 
@@ -316,6 +399,10 @@ class TimeDistributed(Container):
         super().__init__(name)
         self.module = module
         self.add(module)
+
+    def _param_child_items(self, params):
+        # setup() returns the inner module's params directly
+        return [(None, self.module)]
 
     def setup(self, rng, input_spec):
         inner = jax.ShapeDtypeStruct(
@@ -364,7 +451,7 @@ class LSTMPeephole(Cell):
         return (jnp.zeros((batch_size, self.hidden_size), dtype),
                 jnp.zeros((batch_size, self.hidden_size), dtype))
 
-    def step(self, params, x_t, hidden):
+    def step(self, params, x_t, hidden, drop_key=None):
         h, c = hidden
         dt = x_t.dtype
         gates = (x_t @ params["weight_ih"].astype(dt).T
@@ -436,7 +523,7 @@ class _ConvLSTMBase(Cell):
         shape = (batch_size, self.output_size) + self._spatial
         return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
-    def step(self, params, x_t, hidden):
+    def step(self, params, x_t, hidden, drop_key=None):
         h, c = hidden
         gates = (self._conv(x_t, params["weight_ih"], params["bias"])
                  + self._conv(h, params["weight_hh"]))

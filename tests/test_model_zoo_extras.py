@@ -47,6 +47,7 @@ class TestCliMains:
         flagship) run through the same fused-step perf harness."""
         from bigdl_tpu.models import perf
         assert perf.run_perf("simplernn", batch=4, iterations=2) > 0
+        assert perf.run_perf("lstm_lm", batch=2, iterations=2) > 0
         assert perf.run_perf("transformer", batch=2, iterations=2) > 0
 
 

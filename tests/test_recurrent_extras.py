@@ -191,3 +191,157 @@ def test_tree_nn_accuracy_root_index():
     res = TreeNNAccuracy(root_index=1)(out, tgt)
     v, n = res.result()
     assert n == 2 and abs(v - 1.0) < 1e-9
+
+
+class TestInCellDropout:
+    """reference LSTM.scala:57/GRU.scala p: per-gate dropout on the
+    projections, fresh masks per timestep."""
+
+    def _run(self, cell, training, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(80)
+        m = nn.Recurrent(cell)
+        m.build(jax.ShapeDtypeStruct((2, 5, 4), jnp.float32))
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 5, 4)),
+                        jnp.float32)
+        out, _ = m.apply(m.parameters()[0], m.state(), x,
+                         training=training, rng=jax.random.PRNGKey(seed))
+        return np.asarray(out)
+
+    def test_eval_mode_matches_p0(self):
+        import numpy as np
+
+        from bigdl_tpu import nn
+
+        a = self._run(nn.LSTM(4, 8, p=0.5), training=False)
+        b = self._run(nn.LSTM(4, 8), training=False)
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_training_mode_applies_masks(self):
+        import numpy as np
+
+        from bigdl_tpu import nn
+
+        base = self._run(nn.LSTM(4, 8), training=True)
+        dropped = self._run(nn.LSTM(4, 8, p=0.5), training=True)
+        assert not np.allclose(base, dropped)
+        assert np.isfinite(dropped).all()
+        # fresh masks per seed
+        other = self._run(nn.LSTM(4, 8, p=0.5), training=True, seed=9)
+        assert not np.allclose(dropped, other)
+
+    def test_gru_dropout_and_grads(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(81)
+        m = nn.Recurrent(nn.GRU(4, 6, p=0.3))
+        m.build(jax.ShapeDtypeStruct((2, 3, 4), jnp.float32))
+        params = m.parameters()[0]
+        x = jnp.ones((2, 3, 4), jnp.float32)
+
+        def loss(p):
+            out, _ = m.apply(p, m.state(), x, training=True,
+                             rng=jax.random.PRNGKey(0))
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(params)
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree.leaves(g))
+
+    def test_textclassifier_p_builds(self):
+        from bigdl.models.textclassifier.textclassifier import build_model
+
+        for kind in ("lstm", "gru"):
+            m = build_model(5, model_type=kind, embedding_dim=8,
+                            sequence_len=6, p=0.5)
+            import jax
+            import jax.numpy as jnp
+
+            m.build(jax.ShapeDtypeStruct((2, 6, 8), jnp.float32))
+
+    def test_gru_hidden_side_dropout(self):
+        """GRU drops BOTH projections (GRU.scala:91-106)."""
+        import numpy as np
+
+        from bigdl_tpu import nn
+
+        for reset_after in (True, False):
+            base = self._run(nn.GRU(4, 8, reset_after=reset_after),
+                             training=True)
+            heavy = self._run(nn.GRU(4, 8, p=0.9,
+                                     reset_after=reset_after),
+                              training=True)
+            assert not np.allclose(base, heavy), reset_after
+
+    def test_birecurrent_threads_rng(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(82)
+        m = nn.BiRecurrent(nn.LSTM(4, 6, p=0.5), nn.LSTM(4, 6, p=0.5))
+        m.build(jax.ShapeDtypeStruct((2, 5, 4), jnp.float32))
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 5, 4)),
+                        jnp.float32)
+        a, _ = m.apply(m.parameters()[0], m.state(), x, training=True,
+                       rng=jax.random.PRNGKey(0))
+        b, _ = m.apply(m.parameters()[0], m.state(), x, training=True,
+                       rng=jax.random.PRNGKey(7))
+        assert not np.allclose(np.asarray(a), np.asarray(b)), \
+            "different rng keys must give different dropout masks"
+
+    def test_multirnncell_routes_dropout_and_freeze(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.nn.module import frozen_param_mask
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(83)
+        stack = nn.MultiRNNCell([nn.LSTM(4, 6, p=0.5, name="lower"),
+                                 nn.GRU(6, 5)])
+        assert stack.p == 0.5
+        m = nn.Recurrent(stack)
+        m.build(jax.ShapeDtypeStruct((2, 3, 4), jnp.float32))
+        x = jnp.ones((2, 3, 4), jnp.float32)
+        a, _ = m.apply(m.parameters()[0], m.state(), x, training=True,
+                       rng=jax.random.PRNGKey(0))
+        b, _ = m.apply(m.parameters()[0], m.state(), x, training=False,
+                       rng=None)
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+        # freeze reaches the inner cell by name through children()
+        m.freeze(["lower"])
+        mask = frozen_param_mask(m, m.parameters()[0])
+        lower = jax.tree.leaves(mask["0"])
+        upper = jax.tree.leaves(mask["1"])
+        assert not any(lower) and all(upper)
+
+    def test_timedistributed_freeze_masks(self):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.nn.module import frozen_param_mask
+
+        m = nn.Sequential().add(
+            nn.TimeDistributed(nn.Linear(4, 2, name="head")))
+        m.build(jax.ShapeDtypeStruct((2, 3, 4), jnp.float32))
+        m.freeze(["head"])
+        mask = frozen_param_mask(m, m.parameters()[0])
+        assert not any(jax.tree.leaves(mask))
