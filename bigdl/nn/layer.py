@@ -330,3 +330,57 @@ class SharedStaticUtils:
         from bigdl_tpu.utils.serializer import load_module
 
         return load_module(path)
+
+
+def _check_rnn_activations(activation, inner_activation, which):
+    """The native cells hard-code the standard tanh/sigmoid gate
+    activations (the MXU-fused formulation); reject anything else loudly
+    instead of silently ignoring it."""
+    def name_of(a):
+        if a is None:
+            return None
+        if isinstance(a, str):
+            return a.lower()
+        return type(a).__name__.lower()
+
+    act, inner = name_of(activation), name_of(inner_activation)
+    if act not in (None, "tanh"):
+        raise NotImplementedError(
+            f"{which}: only the standard tanh cell activation is "
+            f"supported, got {activation!r}")
+    if inner not in (None, "sigmoid"):
+        raise NotImplementedError(
+            f"{which}: only the standard sigmoid gate activation is "
+            f"supported, got {inner_activation!r}")
+
+
+class LSTM(_nn.LSTM):
+    """pyspark signature (layer.py:1634): p third, then activations and
+    regularizers."""
+
+    def __init__(self, input_size, hidden_size, p=0.0, activation=None,
+                 inner_activation=None, wRegularizer=None, uRegularizer=None,
+                 bRegularizer=None, bigdl_type="float", name=None):
+        _check_rnn_activations(activation, inner_activation, "LSTM")
+        super().__init__(input_size, hidden_size, p=p, name=name)
+        self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
+        _set_native_regs(self, wRegularizer, bRegularizer)
+        if uRegularizer is not None:
+            self.set_regularizer(u=uRegularizer._native())
+
+
+class GRU(_nn.GRU):
+    """pyspark signature (layer.py GRU): p third, then activations and
+    regularizers; the reference GRU applies the reset gate BEFORE the
+    recurrent matmul (keras-1 convention) -> reset_after=False."""
+
+    def __init__(self, input_size, hidden_size, p=0.0, activation=None,
+                 inner_activation=None, wRegularizer=None, uRegularizer=None,
+                 bRegularizer=None, bigdl_type="float", name=None):
+        _check_rnn_activations(activation, inner_activation, "GRU")
+        super().__init__(input_size, hidden_size, p=p, reset_after=False,
+                         name=name)
+        self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
+        _set_native_regs(self, wRegularizer, bRegularizer)
+        if uRegularizer is not None:
+            self.set_regularizer(u=uRegularizer._native())

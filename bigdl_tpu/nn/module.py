@@ -514,14 +514,18 @@ class Module:
         from bigdl_tpu.nn.quantized import quantize as _quantize
         return _quantize(self)
 
-    def set_regularizer(self, w=None, b=None):
-        """Attach per-layer weight/bias regularizers (reference:
-        wRegularizer/bRegularizer params on layer constructors,
-        optim/Regularizer.scala).  Consumed by the train step's loss."""
+    def set_regularizer(self, w=None, b=None, u=None):
+        """Attach per-layer weight/bias/recurrent regularizers (reference:
+        wRegularizer/bRegularizer/uRegularizer params on layer
+        constructors, optim/Regularizer.scala).  Consumed by the train
+        step's loss; ``u`` applies to recurrent (hidden-to-hidden)
+        weights -- param keys named weight_hh."""
         if w is not None:
             self.w_regularizer = w
         if b is not None:
             self.b_regularizer = b
+        if u is not None:
+            self.u_regularizer = u
         return self
 
     def children(self):

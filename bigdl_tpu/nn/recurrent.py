@@ -62,7 +62,9 @@ class Cell(Module):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         x_t, hidden = input
-        out, new_hidden = self.step(params, x_t, hidden)
+        drop_key = (rng if training and rng is not None and self.p > 0.0
+                    else None)
+        out, new_hidden = self.step(params, x_t, hidden, drop_key=drop_key)
         return (out, new_hidden), state
 
 

@@ -69,30 +69,36 @@ def _children_of(module):
 def regularization_loss(module, params):
     """Sum the tree's regularization terms over the given params pytree.
 
-    Mirrors the container param keying: Container children i <->
-    params[str(i)]; Graph modules keyed by topological index the same way
-    (nn/graph.py setup).
+    Param-subtree <-> child-module alignment goes through each
+    container's ``_param_child_items`` (the same routing the frozen-mask
+    walk uses), so Graph's topo keying, MapTable/Recurrent's
+    params-are-the-child's layout, and BiRecurrent's fwd/bwd keys all
+    resolve.  Key matching: every ``weight*`` leaf takes
+    ``w_regularizer`` except the recurrent ``weight_hh``, which prefers
+    ``u_regularizer`` (reference uRegularizer) when present; ``bias*``
+    leaves take ``b_regularizer``.
     """
     total = jnp.zeros((), jnp.float32)
-    if isinstance(params, dict):
-        wreg = getattr(module, "w_regularizer", None)
-        breg = getattr(module, "b_regularizer", None)
-        if wreg is not None and "weight" in params:
-            total = total + wreg(params["weight"].astype(jnp.float32))
-        if breg is not None and "bias" in params:
-            total = total + breg(params["bias"].astype(jnp.float32))
-        topo = getattr(module, "_topo", None)
-        if topo is not None:
-            # Graph: params keyed by topological index (nn/graph.py setup),
-            # which skips module-less Input nodes -- children() order would
-            # not line up
-            for i, node in enumerate(topo):
-                if node.module is not None and str(i) in params:
-                    total = total + regularization_loss(
-                        node.module, params[str(i)])
-        else:
-            for i, child in enumerate(module.children()):
-                key = str(i)
-                if key in params:
-                    total = total + regularization_loss(child, params[key])
+    if not isinstance(params, dict):
+        return total
+    wreg = getattr(module, "w_regularizer", None)
+    breg = getattr(module, "b_regularizer", None)
+    ureg = getattr(module, "u_regularizer", None)
+    for key, leaf in params.items():
+        if isinstance(leaf, dict) or not hasattr(leaf, "astype"):
+            continue
+        if key.startswith("weight"):
+            reg = (ureg if key == "weight_hh" and ureg is not None
+                   else wreg)
+            if reg is not None:
+                total = total + reg(leaf.astype(jnp.float32))
+        elif key.startswith("bias") and breg is not None:
+            total = total + breg(leaf.astype(jnp.float32))
+    items = module._param_child_items(params)
+    if len(items) == 1 and items[0][0] is None:
+        return total + regularization_loss(items[0][1], params)
+    by_key = dict(items)
+    for key, sub in params.items():
+        if isinstance(sub, dict) and key in by_key:
+            total = total + regularization_loss(by_key[key], sub)
     return total

@@ -345,3 +345,56 @@ class TestInCellDropout:
         m.freeze(["head"])
         mask = frozen_param_mask(m, m.parameters()[0])
         assert not any(jax.tree.leaves(mask))
+
+    def test_rnn_regularizers_contribute(self):
+        """wRegularizer/uRegularizer/bRegularizer on recurrent cells must
+        produce a non-zero penalty (the walk descends Recurrent's
+        un-indexed params and matches weight_ih/weight_hh/bias_* keys)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        import bigdl.nn.layer as L
+        from bigdl_tpu import nn
+        from bigdl_tpu.optim.regularizer import (has_regularizers,
+                                                 regularization_loss)
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(84)
+        cell = L.LSTM(4, 6, 0.0, wRegularizer=L.L2Regularizer(0.5),
+                      uRegularizer=L.L2Regularizer(0.25),
+                      bRegularizer=L.L1Regularizer(0.1))
+        m = nn.Sequential().add(nn.Recurrent(cell))
+        m.build(jax.ShapeDtypeStruct((2, 3, 4), jnp.float32))
+        assert has_regularizers(m)
+        params = m.parameters()[0]
+        loss = float(regularization_loss(m, params))
+        # independent recomputation
+        p = params["0"]
+        expect = (0.5 / 2 * float(jnp.sum(p["weight_ih"] ** 2))
+                  + 0.25 / 2 * float(jnp.sum(p["weight_hh"] ** 2))
+                  + 0.1 * float(jnp.sum(jnp.abs(p["bias_ih"]))
+                                + jnp.sum(jnp.abs(p["bias_hh"]))))
+        np.testing.assert_allclose(loss, expect, rtol=1e-4)
+
+    def test_standalone_cell_applies_dropout(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(85)
+        cell = nn.LSTM(4, 6, p=0.5)
+        cell.build((jax.ShapeDtypeStruct((2, 4), jnp.float32),
+                    (jax.ShapeDtypeStruct((2, 6), jnp.float32),
+                     jax.ShapeDtypeStruct((2, 6), jnp.float32))))
+        params = cell.parameters()[0]
+        x = jnp.ones((2, 4), jnp.float32)
+        h0 = cell.init_hidden(2)
+        (a, _), _ = cell.apply(params, (), (x, h0), training=True,
+                               rng=jax.random.PRNGKey(0))
+        (b, _), _ = cell.apply(params, (), (x, h0), training=False,
+                               rng=None)
+        assert not np.allclose(np.asarray(a), np.asarray(b))
