@@ -332,6 +332,14 @@ class SharedStaticUtils:
         return load_module(path)
 
 
+def _install_rnn_regs(module, wRegularizer, uRegularizer, bRegularizer):
+    """Shared w/u/b regularizer wiring for the recurrent adapters."""
+    module.wRegularizer, module.bRegularizer = wRegularizer, bRegularizer
+    _set_native_regs(module, wRegularizer, bRegularizer)
+    if uRegularizer is not None:
+        module.set_regularizer(u=uRegularizer._native())
+
+
 def _check_rnn_activations(activation, inner_activation, which):
     """The native cells hard-code the standard tanh/sigmoid gate
     activations (the MXU-fused formulation); reject anything else loudly
@@ -363,10 +371,7 @@ class LSTM(_nn.LSTM):
                  bRegularizer=None, bigdl_type="float", name=None):
         _check_rnn_activations(activation, inner_activation, "LSTM")
         super().__init__(input_size, hidden_size, p=p, name=name)
-        self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
-        _set_native_regs(self, wRegularizer, bRegularizer)
-        if uRegularizer is not None:
-            self.set_regularizer(u=uRegularizer._native())
+        _install_rnn_regs(self, wRegularizer, uRegularizer, bRegularizer)
 
 
 class GRU(_nn.GRU):
@@ -380,7 +385,57 @@ class GRU(_nn.GRU):
         _check_rnn_activations(activation, inner_activation, "GRU")
         super().__init__(input_size, hidden_size, p=p, reset_after=False,
                          name=name)
-        self.wRegularizer, self.bRegularizer = wRegularizer, bRegularizer
-        _set_native_regs(self, wRegularizer, bRegularizer)
-        if uRegularizer is not None:
-            self.set_regularizer(u=uRegularizer._native())
+        _install_rnn_regs(self, wRegularizer, uRegularizer, bRegularizer)
+
+
+class _ConvLSTMCompat:
+    """Shared pyspark-signature adapter for the ConvLSTM family
+    (pyspark layer.py:5070/5138): padding=-1 means SAME (the only mode
+    the native cells implement), the standard tanh/sigmoid activations
+    are required, and regularizers map w->input conv, u->recurrent conv,
+    b->bias; cRegularizer (peephole weights) is not supported."""
+
+    @staticmethod
+    def _check(padding, activation, inner_activation, cRegularizer, which,
+               stride=1):
+        if padding != -1:
+            raise NotImplementedError(
+                f"{which}: only padding=-1 (SAME) is supported")
+        if stride != 1:
+            raise NotImplementedError(
+                f"{which}: only stride=1 is supported (SAME-padding "
+                f"conv-LSTM keeps spatial dims)")
+        _check_rnn_activations(activation, inner_activation, which)
+        if cRegularizer is not None:
+            raise NotImplementedError(
+                f"{which}: cRegularizer (peephole weights) is not "
+                f"supported")
+
+    _install_regs = staticmethod(_install_rnn_regs)
+
+
+class ConvLSTMPeephole(_nn.ConvLSTMPeephole, _ConvLSTMCompat):
+    def __init__(self, input_size, output_size, kernel_i, kernel_c,
+                 stride=1, padding=-1, activation=None,
+                 inner_activation=None, wRegularizer=None, uRegularizer=None,
+                 bRegularizer=None, cRegularizer=None, with_peephole=True,
+                 bigdl_type="float", name=None):
+        self._check(padding, activation, inner_activation, cRegularizer,
+                    "ConvLSTMPeephole", stride=stride)
+        super().__init__(input_size, output_size, kernel_i, kernel_c,
+                         stride=stride, with_peephole=with_peephole,
+                         name=name)
+        self._install_regs(self, wRegularizer, uRegularizer, bRegularizer)
+
+
+class ConvLSTMPeephole3D(_nn.ConvLSTMPeephole3D, _ConvLSTMCompat):
+    def __init__(self, input_size, output_size, kernel_i, kernel_c,
+                 stride=1, padding=-1, wRegularizer=None, uRegularizer=None,
+                 bRegularizer=None, cRegularizer=None, with_peephole=True,
+                 bigdl_type="float", name=None):
+        self._check(padding, None, None, cRegularizer, "ConvLSTMPeephole3D",
+                    stride=stride)
+        super().__init__(input_size, output_size, kernel_i, kernel_c,
+                         stride=stride, with_peephole=with_peephole,
+                         name=name)
+        self._install_regs(self, wRegularizer, uRegularizer, bRegularizer)

@@ -220,3 +220,33 @@ class TestUtilCommonAdditions:
              "config": {"units": 4, "activation": "linear",
                         "name": "d1"}}).create()
         assert type(layer).__name__ == "Dense"
+
+
+class TestConvLSTMCompatSignature:
+    def test_reference_positional_call(self):
+        """The reference's full positional signature (padding=-1, then
+        activations and four regularizers) binds correctly -- before the
+        adapter, the 6th positional arg landed on with_peephole."""
+        import jax
+        import jax.numpy as jnp
+
+        import bigdl.nn.layer as L
+
+        m = L.ConvLSTMPeephole(3, 4, 3, 3, 1, -1, None, None,
+                               L.L2Regularizer(0.1), L.L2Regularizer(0.2),
+                               L.L2Regularizer(0.3), None, True)
+        assert m.with_peephole is True
+        cell = m
+        r = L.Recurrent().add(cell)
+        r.build(jax.ShapeDtypeStruct((2, 3, 3, 6, 6), jnp.float32))
+        from bigdl_tpu.optim.regularizer import regularization_loss
+        assert float(regularization_loss(r, r.parameters()[0])) > 0
+
+    def test_unsupported_modes_raise(self):
+        import bigdl.nn.layer as L
+        import pytest as _pytest
+
+        with _pytest.raises(NotImplementedError):
+            L.ConvLSTMPeephole(3, 4, 3, 3, 1, 2)          # explicit pad
+        with _pytest.raises(NotImplementedError):
+            L.ConvLSTMPeephole(3, 4, 3, 3, cRegularizer=L.L2Regularizer(0.1))
