@@ -4,6 +4,11 @@
 # Usage: nohup bash tools/onchip_autorun.sh & (safe to re-run; uses a lock)
 cd "$(dirname "$0")/.." || exit 1
 LOG=tools/onchip_autorun.log
+# leg results ALSO go to a committed file: the driver auto-commits
+# uncommitted work at round end, so evidence landing after the last
+# interactive turn still reaches the repo (the .log is gitignored)
+RESULTS=docs/traces/autorun_results_r4.log
+mkdir -p docs/traces
 LOCK=/tmp/onchip_autorun.lock
 exec 9>"$LOCK"
 flock -n 9 || { echo "another autorun holds the lock" >>"$LOG"; exit 0; }
@@ -14,20 +19,20 @@ for i in $(seq 1 60); do            # up to ~5h of probing
     echo "--- tunnel ALIVE at $(date -u +%FT%TZ); running evidence legs" >>"$LOG"
     # leg 1: fused @128 (the A/B the op accounting motivates)
     BENCH_FUSED=1 PROF_BATCH=128 EV_STEPS=16 timeout 1500 \
-      python tools/tpu_evidence.py >>"$LOG" 2>&1
-    echo "--- leg 128f done rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+      python tools/tpu_evidence.py >>"$RESULTS" 2>&1
+    echo "--- leg 128f done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
     # leg 2: fused @256
     BENCH_FUSED=1 PROF_BATCH=256 EV_STEPS=16 timeout 1500 \
-      python tools/tpu_evidence.py >>"$LOG" 2>&1
-    echo "--- leg 256f done rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+      python tools/tpu_evidence.py >>"$RESULTS" 2>&1
+    echo "--- leg 256f done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
     # leg 3: fused+s2d+remat @512 (HBM headroom config)
     BENCH_FUSED=1 BENCH_S2D=1 BENCH_REMAT=1 PROF_BATCH=512 EV_STEPS=12 \
-      timeout 1500 python tools/tpu_evidence.py >>"$LOG" 2>&1
-    echo "--- leg 512rsf done rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+      timeout 1500 python tools/tpu_evidence.py >>"$RESULTS" 2>&1
+    echo "--- leg 512rsf done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
     # leg 4: int8 vs bf16 inference (the BigQuant headline analogue)
     QP_BATCH=128 QP_STEPS=16 timeout 1200 \
-      python tools/quant_perf.py >>"$LOG" 2>&1
-    echo "--- leg quant done rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+      python tools/quant_perf.py >>"$RESULTS" 2>&1
+    echo "--- leg quant done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
     echo "=== autorun complete $(date -u +%FT%TZ)" >>"$LOG"
     exit 0
   fi
