@@ -250,3 +250,23 @@ class TestConvLSTMCompatSignature:
             L.ConvLSTMPeephole(3, 4, 3, 3, 1, 2)          # explicit pad
         with _pytest.raises(NotImplementedError):
             L.ConvLSTMPeephole(3, 4, 3, 3, cRegularizer=L.L2Regularizer(0.1))
+
+
+def test_reference_model_builders_resolve():
+    """The pyspark models tree (excluded from the class sweep: script
+    modules) still exposes its builder functions at the reference import
+    paths."""
+    from bigdl.models.inception.inception import (
+        inception_v1, inception_v1_no_aux_classifier)
+    from bigdl.models.lenet.lenet5 import build_model as lenet_build
+    from bigdl.models.local_lenet.local_lenet import (
+        build_model as local_lenet_build)
+    from bigdl.models.ml_pipeline.dl_classifier import (DLClassifier,
+                                                        DLEstimator)
+    from bigdl.models.textclassifier.textclassifier import (
+        build_model as tc_build)
+
+    for fn in (inception_v1, inception_v1_no_aux_classifier, lenet_build,
+               local_lenet_build, tc_build):
+        assert callable(fn)
+    assert DLClassifier is not None and DLEstimator is not None
