@@ -59,7 +59,8 @@ def build_token_model(name):
     return _resolve(mod_name, fn_name)(*args, **kwargs), vocab, seq_len
 
 
-def run_perf(model_name="resnet50", batch=32, iterations=20, distributed=False):
+def run_perf(model_name="resnet50", batch=32, iterations=20,
+             distributed=False, fused=False):
     import jax
     import jax.numpy as jnp
 
@@ -92,6 +93,9 @@ def run_perf(model_name="resnet50", batch=32, iterations=20, distributed=False):
         target = jnp.asarray(rng.integers(0, classes, size=batch))
         criterion = nn.ClassNLLCriterion()
     method = optim.SGD(learning_rate=0.01)
+    if fused:
+        # one flat-vector update kernel (docs/performance.md op accounting)
+        method = optim.Fused(method)
 
     if distributed:
         # DistriOptimizerPerf equivalent: run the sharded DistriOptimizer
@@ -155,8 +159,11 @@ def main(argv=None):
     p.add_argument("-i", "--iteration", type=int, default=20,
                    dest="iterations")
     p.add_argument("--distributed", action="store_true")
+    p.add_argument("--fused", action="store_true",
+                   help="flat fused optimizer update (optim.Fused)")
     args = p.parse_args(argv)
-    run_perf(args.model, args.batch, args.iterations, args.distributed)
+    run_perf(args.model, args.batch, args.iterations, args.distributed,
+             fused=args.fused)
 
 
 if __name__ == "__main__":
