@@ -78,10 +78,16 @@ def _layers(net):
 
 
 _DATA_TYPES = {"Data", "ImageData", "HDF5Data", "MemoryData", "WindowData",
-               "DummyData", "Input"}
+               "DummyData", "Input", "AnnotatedData"}
+#: SigmoidCrossEntropyLoss is NOT here: the reference keeps its
+#: inference-time activation (Converter.scala: SIGMOIDCROSSENTROPYLOSS ->
+#: fromCaffeSigmoid), so it converts to a Sigmoid module below.
 _LOSS_TYPES = {"SoftmaxWithLoss", "EuclideanLoss", "HingeLoss",
-               "SigmoidCrossEntropyLoss", "InfogainLoss", "ContrastiveLoss",
+               "InfogainLoss", "ContrastiveLoss",
                "MultinomialLogisticLoss", "Accuracy", "Silence"}
+#: n-ary / multi-output layer types wired directly in load_caffe (not via
+#: the single-input _build_module path)
+_STRUCTURAL_TYPES = {"Split", "Concat", "Eltwise", "Slice"}
 
 
 def _hw(param, field, default=None):
@@ -189,6 +195,84 @@ def _build_module(type_str, lpb, in_channels, customized):
     if type_str == "Threshold":
         return nn.Threshold(float(lpb.threshold_param.threshold)), \
             in_channels
+    if type_str == "Deconvolution":
+        # reference: Converter.scala registers DECONVOLUTION through
+        # fromCaffeConvolution; ours maps to the transposed conv directly
+        p = lpb.convolution_param
+        kh, kw = _hw(p, "kernel_size")
+        sh, sw = _hw(p, "stride", (1, 1))
+        ph, pw = _hw(p, "pad", (0, 0))
+        nout = int(p.num_output)
+        if int(p.group) not in (0, 1):
+            raise NotImplementedError(
+                "caffe grouped Deconvolution (group>1) has no converter; "
+                "pass customized_layers to split the groups by hand")
+        if any(int(d) != 1 for d in p.dilation):
+            raise NotImplementedError(
+                "caffe dilated Deconvolution has no converter "
+                "(SpatialFullConvolution is stride/adj only)")
+        m = nn.SpatialFullConvolution(
+            in_channels, nout, kw, kh, sw, sh, pw, ph,
+            with_bias=bool(p.bias_term))
+        return m, nout
+    if type_str == "PReLU":
+        # per-channel learnable slope (reference: fromCaffePreLU,
+        # Converter.scala:190); channel = NHWC last axis here.
+        # channel_shared stores a single slope -> nn.PReLU(0) (shared)
+        shared = bool(lpb.prelu_param.channel_shared) \
+            if lpb.HasField("prelu_param") else False
+        return nn.PReLU(0 if shared else in_channels), in_channels
+    if type_str == "Log":
+        return nn.Log(), in_channels
+    if type_str == "BNLL":
+        return nn.SoftPlus(), in_channels      # log(1 + e^x)
+    if type_str == "SigmoidCrossEntropyLoss":
+        return nn.Sigmoid(), in_channels
+    if type_str == "Reshape":
+        p = lpb.reshape_param
+        if int(p.axis) != 0 or int(p.num_axes) != -1:
+            raise NotImplementedError(
+                "caffe partial Reshape (axis/num_axes restricting the "
+                "reshaped span) has no converter; only the full-shape "
+                "default (axis=0, num_axes=-1) does")
+        dims = tuple(int(d) for d in p.shape.dim)
+        cout = dims[1] if len(dims) > 1 and dims[1] > 0 else in_channels
+        return _ReshapeNCHW(dims), cout
+    if type_str == "Tile":
+        p = lpb.tile_param
+        axis = int(p.axis) if p.HasField("axis") else 1
+        if axis < 0:
+            # the activation rank is unknown here, so a negative axis
+            # cannot be normalized for channel bookkeeping -- fail loudly
+            # rather than mis-size downstream channel-sensitive layers
+            raise NotImplementedError(
+                f"caffe Tile with negative axis {axis} has no converter; "
+                "rewrite the prototxt with the equivalent positive axis")
+        tiles = int(p.tiles)
+        cout = in_channels * tiles if axis == 1 else in_channels
+        return _TileNCHW(axis, tiles), cout
+    if type_str == "Bias":
+        # learnable per-channel bias (reference: fromCaffeBias -> Add;
+        # LayerConverter.scala:196); two-bottom runtime-bias form is the
+        # Eltwise SUM path, not this layer
+        if len(lpb.bottom) > 1:
+            raise NotImplementedError(
+                "caffe Bias with a second bottom (runtime-supplied bias) "
+                "has no converter; only the learned-parameter form does")
+        p = getattr(lpb, "bias_param", None)   # absent from the vendored proto
+        axis = int(p.axis) if p is not None and p.HasField("axis") else 1
+        if axis != 1:
+            raise NotImplementedError(
+                f"caffe Bias axis={axis}; only the per-channel default "
+                "(axis=1) has a converter")
+        return _ChannelBias(in_channels), in_channels
+    if type_str in ("Recurrent", "RNN"):
+        raise NotImplementedError(
+            "caffe Recurrent/RNN: the reference converter emits a cell-less "
+            "Recurrent() that cannot execute (Converter.scala:200-203), so "
+            "there is no working semantics to match; build the recurrent "
+            "stack with bigdl_tpu.nn.Recurrent + a cell and copy_weights, "
+            "or pass customized_layers")
     if customized and type_str in customized:
         return customized[type_str](lpb), in_channels
     raise NotImplementedError(
@@ -215,6 +299,63 @@ def _ChannelAffine(n, with_bias):
             return y, state
 
     return ChannelAffine()
+
+
+def _ChannelBias(n):
+    """caffe Bias layer: learnable per-channel additive bias
+    (reference: LayerConverter.fromCaffeBias -> Add)."""
+    from bigdl_tpu.nn.module import Module
+    import jax.numpy as jnp
+
+    class ChannelBias(Module):
+        def setup(self, rng, input_spec):
+            return {"bias": jnp.zeros((n,), jnp.float32)}, ()
+
+        def apply(self, params, state, input, *, training=False, rng=None):
+            return input + params["bias"].astype(input.dtype), state
+
+    return ChannelBias()
+
+
+def _ReshapeNCHW(dims):
+    """caffe Reshape: dims are NCHW-ordered with 0 = copy input dim and
+    -1 = infer (reference: LayerConverter.fromCaffeReshape ->
+    InferReshape).  Activations here are NHWC, so rank-4 tensors round-trip
+    through NCHW for the reshape itself."""
+    from bigdl_tpu.nn.module import Module
+    import jax.numpy as jnp
+
+    class ReshapeNCHW(Module):
+        def apply(self, params, state, input, *, training=False, rng=None):
+            x = input
+            if x.ndim == 4:
+                x = jnp.transpose(x, (0, 3, 1, 2))
+            shape = tuple(x.shape[i] if d == 0 else d
+                          for i, d in enumerate(dims))
+            y = jnp.reshape(x, shape)
+            if y.ndim == 4:
+                y = jnp.transpose(y, (0, 2, 3, 1))
+            return y, state
+
+    return ReshapeNCHW()
+
+
+def _TileNCHW(axis, tiles):
+    """caffe Tile: repeat ``tiles`` times along an NCHW ``axis``
+    (reference: LayerConverter.fromCaffeTile -> Tile)."""
+    from bigdl_tpu.nn.module import Module
+    import jax.numpy as jnp
+
+    class TileNCHW(Module):
+        def apply(self, params, state, input, *, training=False, rng=None):
+            a = axis + (input.ndim if axis < 0 else 0)
+            if input.ndim == 4:
+                a = {0: 0, 1: 3, 2: 1, 3: 2}.get(a, a)
+            reps = [1] * input.ndim
+            reps[a] = tiles
+            return jnp.tile(input, reps), state
+
+    return TileNCHW()
 
 
 def load_caffe(prototxt_path, model_path=None, input_shape=None,
@@ -316,6 +457,48 @@ def load_caffe(prototxt_path, model_path=None, input_shape=None,
             ranks[tops[0]] = ranks.get(bottoms[0], 4)
             module_blobs.append((mod, None))
             continue
+        if type_str == "Slice":
+            # multi-output split along an NCHW axis (reference:
+            # fromCaffeSlice -> SplitTable, Converter.scala:219); one
+            # Narrow node per top
+            p = lpb.slice_param
+            axis = int(p.axis) if p.HasField("axis") else (
+                int(p.slice_dim) if p.HasField("slice_dim") else 1)
+            rank = ranks.get(bottoms[0], 4)
+            if axis < 0:
+                axis += rank
+            our_axis = ({0: 0, 1: 3, 2: 1, 3: 2}.get(axis, axis)
+                        if rank == 4 else axis)
+            points = [int(q) for q in p.slice_point]
+            cin = channels.get(bottoms[0], input_shape[-1])
+            if points:
+                offsets = [0] + points
+                lengths = [offsets[i + 1] - offsets[i]
+                           for i in range(len(offsets) - 1)]
+                # last segment runs to the end; its extent is known on the
+                # channel axis (cin - last point) for channel bookkeeping
+                lengths.append(cin - points[-1] if axis == 1 else -1)
+            else:
+                if axis != 1:
+                    raise NotImplementedError(
+                        f"caffe Slice without slice_point on axis {axis}: "
+                        "the equal-split size is only known on the channel "
+                        "axis")
+                if cin % len(tops):
+                    raise ValueError(
+                        f"caffe Slice: {cin} channels not divisible into "
+                        f"{len(tops)} tops")
+                seg = cin // len(tops)
+                offsets = [i * seg for i in range(len(tops))]
+                lengths = [seg] * len(tops)
+            for t, off, ln in zip(tops, offsets, lengths):
+                mod = nn.Narrow(our_axis, off, ln)
+                node = Node(mod, [top_nodes[bottoms[0]]])
+                top_nodes[t] = node
+                channels[t] = ln if (axis == 1 and ln > 0) else cin
+                ranks[t] = rank
+                module_blobs.append((mod, None))
+            continue
 
         bottom = bottoms[0]
         cin = channels.get(bottom, input_shape[-1])
@@ -330,6 +513,8 @@ def load_caffe(prototxt_path, model_path=None, input_shape=None,
                 or (type_str == "Pooling"
                     and lpb.pooling_param.global_pooling)):
             ranks[out_top] = 2          # these collapse to (batch, features)
+        elif type_str == "Reshape":
+            ranks[out_top] = len(lpb.reshape_param.shape.dim)
         else:
             ranks[out_top] = ranks.get(bottom, 4)
         module_blobs.append((mod, weights.get(name)))
@@ -417,6 +602,19 @@ def _install_blobs(mod, params, state, blobs, strict_shapes=True):
         put(params, "weight", blobs[0].reshape(-1), "Scale")
         if len(blobs) > 1 and "bias" in params:
             put(params, "bias", blobs[1].reshape(-1), "Scale")
+        return True
+    if isinstance(mod, nn.SpatialFullConvolution):
+        # caffe Deconvolution blob: (in, out, kH, kW) -> ours (kH, kW, in, out)
+        w = blobs[0].reshape(blobs[0].shape[-4:])
+        put(params, "weight", w.transpose(2, 3, 0, 1), "deconv")
+        if len(blobs) > 1 and "bias" in params:
+            put(params, "bias", blobs[1].reshape(-1), "deconv")
+        return True
+    if isinstance(mod, nn.PReLU):
+        put(params, "weight", blobs[0].reshape(-1), "PReLU")
+        return True
+    if type(mod).__name__ == "ChannelBias":    # caffe Bias layer
+        put(params, "bias", blobs[0].reshape(-1), "Bias")
         return True
     return False
 

@@ -364,3 +364,283 @@ class TestGraphExport:
                                    atol=1e-5)
         np.testing.assert_allclose(o2, np.asarray(outs[1]), rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestCaffeConverterParity:
+    """Round-5 converter-registry parity (VERDICT r4 ask #6).
+
+    The reference registers exactly these types (Converter.scala:630-668
+    ``init()``); every one must either convert, be an explicit skip
+    (reference maps it to null), or fail with a documented message."""
+
+    # frozen from /root/reference/.../utils/caffe/Converter.scala init()
+    REFERENCE_REGISTRY = """CONVOLUTION DECONVOLUTION INNERPRODUCT
+        INNER_PRODUCT RELU LRN POOLING DROPOUT SOFTMAX SOFTMAX_LOSS
+        SOFTMAXWITHLOSS TANH SIGMOID SIGMOIDCROSSENTROPYLOSS ABSVAL
+        BATCHNORM CONCAT ELU FLATTEN LOG POWER PRELU RECURRENT RNN RESHAPE
+        SCALE BIAS THRESHOLD EXP SLICE TILE ELTWISE INPUT DATA DUMMYDATA
+        ANNOTATEDDATA MEMORYDATA ACCURACY SILENCE""".split()
+
+    #: reference maps these to null (skipped layers)
+    NULL_IN_REFERENCE = {"SOFTMAX_LOSS", "SOFTMAXWITHLOSS", "ACCURACY",
+                         "SILENCE"}
+    #: reference's own converter is degenerate (cell-less Recurrent that
+    #: cannot execute); ours raises a documented NotImplementedError
+    DEGENERATE_IN_REFERENCE = {"RECURRENT", "RNN"}
+
+    # upper-case registry key -> new-style prototxt type string
+    TO_NEW_STYLE = {
+        "CONVOLUTION": "Convolution", "DECONVOLUTION": "Deconvolution",
+        "INNERPRODUCT": "InnerProduct", "INNER_PRODUCT": "InnerProduct",
+        "RELU": "ReLU", "LRN": "LRN", "POOLING": "Pooling",
+        "DROPOUT": "Dropout", "SOFTMAX": "Softmax", "TANH": "TanH",
+        "SIGMOID": "Sigmoid",
+        "SIGMOIDCROSSENTROPYLOSS": "SigmoidCrossEntropyLoss",
+        "SOFTMAX_LOSS": "SoftmaxWithLoss",
+        "SOFTMAXWITHLOSS": "SoftmaxWithLoss",
+        "ABSVAL": "AbsVal", "BATCHNORM": "BatchNorm", "CONCAT": "Concat",
+        "ELU": "ELU", "FLATTEN": "Flatten", "LOG": "Log", "POWER": "Power",
+        "PRELU": "PReLU", "RECURRENT": "Recurrent", "RNN": "RNN",
+        "RESHAPE": "Reshape", "SCALE": "Scale", "BIAS": "Bias",
+        "THRESHOLD": "Threshold", "EXP": "Exp", "SLICE": "Slice",
+        "TILE": "Tile", "ELTWISE": "Eltwise", "INPUT": "Input",
+        "DATA": "Data", "DUMMYDATA": "DummyData",
+        "ANNOTATEDDATA": "AnnotatedData", "MEMORYDATA": "MemoryData",
+        "ACCURACY": "Accuracy", "SILENCE": "Silence",
+    }
+
+    def test_registry_closure(self):
+        from bigdl_tpu.interop import caffe_pb2
+        from bigdl_tpu.interop.caffe import (_DATA_TYPES, _LOSS_TYPES,
+                                             _STRUCTURAL_TYPES,
+                                             _build_module)
+
+        def minimal_lpb(t):
+            lpb = caffe_pb2.LayerParameter()
+            if t in ("Convolution", "Deconvolution"):
+                lpb.convolution_param.num_output = 2
+                lpb.convolution_param.kernel_size.append(1)
+            if t == "InnerProduct":
+                lpb.inner_product_param.num_output = 2
+            if t == "Reshape":
+                lpb.reshape_param.shape.dim.extend([0, -1])
+            if t == "Tile":
+                lpb.tile_param.tiles = 2
+            return lpb
+
+        for key in self.REFERENCE_REGISTRY:
+            t = self.TO_NEW_STYLE.get(key, key)
+            if key in self.NULL_IN_REFERENCE:
+                assert t in _LOSS_TYPES or key in ("SOFTMAX_LOSS",), key
+                continue
+            if t in _DATA_TYPES or t in _STRUCTURAL_TYPES:
+                continue           # wired directly in load_caffe
+            if key in self.DEGENERATE_IN_REFERENCE:
+                with pytest.raises(NotImplementedError,
+                                   match="Recurrent"):
+                    _build_module(t, minimal_lpb(t), 4, {})
+                continue
+            mod, cout = _build_module(t, minimal_lpb(t), 4, {})
+            assert mod is not None, f"no converter for {key} ({t})"
+
+
+class TestCaffeNewTypes:
+    """Golden tests for the round-5 importer additions."""
+
+    def _write_model(self, path, layers):
+        """layers: [(name, type, [np blobs])] -> binary caffemodel."""
+        from bigdl_tpu.interop import caffe_pb2
+        net = caffe_pb2.NetParameter()
+        for name, t, blobs in layers:
+            l = net.layer.add()
+            l.name, l.type = name, t
+            for arr in blobs:
+                b = l.blobs.add()
+                b.shape.dim.extend(arr.shape)
+                b.data.extend(np.asarray(arr, np.float32).ravel().tolist())
+        with open(path, "wb") as f:
+            f.write(net.SerializeToString())
+
+    def test_prelu_deconv_golden_vs_torch(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+
+        proto = tmp_path / "m.prototxt"
+        proto.write_text("""
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 5 input_dim: 5
+layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+  convolution_param { num_output: 4 kernel_size: 3 } }
+layer { name: "pre" type: "PReLU" bottom: "conv" top: "pre" }
+layer { name: "up" type: "Deconvolution" bottom: "pre" top: "up"
+  convolution_param { num_output: 2 kernel_size: 3 stride: 2 } }
+""")
+        rng = np.random.default_rng(0)
+        wc = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        bc = rng.standard_normal((4,)).astype(np.float32)
+        slope = rng.uniform(0.1, 0.5, (4,)).astype(np.float32)
+        wd = rng.standard_normal((4, 2, 3, 3)).astype(np.float32)
+        bd = rng.standard_normal((2,)).astype(np.float32)
+        cm = tmp_path / "m.caffemodel"
+        self._write_model(str(cm), [("conv", "Convolution", [wc, bc]),
+                                    ("pre", "PReLU", [slope]),
+                                    ("up", "Deconvolution", [wd, bd])])
+        g = load_caffe(str(proto), str(cm))
+        g.evaluate()
+        x = rng.standard_normal((1, 5, 5, 3)).astype(np.float32)
+        ours = np.asarray(g.forward(jnp.asarray(x)))
+
+        xt = torch.tensor(np.transpose(x, (0, 3, 1, 2)))
+        h = F.conv2d(xt, torch.tensor(wc), torch.tensor(bc))
+        h = F.prelu(h, torch.tensor(slope))
+        h = F.conv_transpose2d(h, torch.tensor(wd), torch.tensor(bd),
+                               stride=2)
+        golden = np.transpose(h.numpy(), (0, 2, 3, 1))
+        np.testing.assert_allclose(ours, golden, rtol=1e-4, atol=1e-4)
+
+    def test_slice_concat_identity(self, tmp_path):
+        proto = tmp_path / "s.prototxt"
+        proto.write_text("""
+input: "data"
+input_dim: 2 input_dim: 6 input_dim: 3 input_dim: 3
+layer { name: "sl" type: "Slice" bottom: "data" top: "a" top: "b"
+  slice_param { axis: 1 slice_point: 2 } }
+layer { name: "cat" type: "Concat" bottom: "a" bottom: "b" top: "cat"
+  concat_param { axis: 1 } }
+""")
+        g = load_caffe(str(proto))
+        g.evaluate()
+        x = np.random.default_rng(1).standard_normal(
+            (2, 3, 3, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(g.forward(jnp.asarray(x))), x, atol=1e-6)
+
+    def test_slice_equal_split_channels(self, tmp_path):
+        proto = tmp_path / "s2.prototxt"
+        proto.write_text("""
+input: "data"
+input_dim: 1 input_dim: 6 input_dim: 2 input_dim: 2
+layer { name: "sl" type: "Slice" bottom: "data" top: "a" top: "b" top: "c" }
+""")
+        g = load_caffe(str(proto))
+        g.evaluate()
+        x = np.random.default_rng(2).standard_normal(
+            (1, 2, 2, 6)).astype(np.float32)
+        outs = g.forward(jnp.asarray(x))
+        assert len(outs) == 3
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(
+                np.asarray(o), x[..., 2 * i:2 * i + 2], atol=1e-6)
+
+    def test_reshape_tile_bias_log_bnll(self, tmp_path):
+        proto = tmp_path / "r.prototxt"
+        proto.write_text("""
+input: "data"
+input_dim: 2 input_dim: 4 input_dim: 2 input_dim: 2
+layer { name: "t" type: "Tile" bottom: "data" top: "t"
+  tile_param { axis: 1 tiles: 2 } }
+layer { name: "bias" type: "Bias" bottom: "t" top: "bias" }
+layer { name: "rs" type: "Reshape" bottom: "bias" top: "rs"
+  reshape_param { shape { dim: 0 dim: -1 } } }
+""")
+        rng = np.random.default_rng(3)
+        bias = rng.standard_normal((8,)).astype(np.float32)
+        cm = tmp_path / "r.caffemodel"
+        self._write_model(str(cm), [("bias", "Bias", [bias])])
+        g = load_caffe(str(proto), str(cm))
+        g.evaluate()
+        x = rng.standard_normal((2, 2, 2, 4)).astype(np.float32)
+        ours = np.asarray(g.forward(jnp.asarray(x)))
+        nchw = np.transpose(x, (0, 3, 1, 2))
+        tiled = np.tile(nchw, (1, 2, 1, 1))
+        biased = tiled + bias[None, :, None, None]
+        golden = biased.reshape(2, -1)
+        np.testing.assert_allclose(ours, golden, rtol=1e-5, atol=1e-5)
+
+    def test_log_bnll_sigmoid_loss(self, tmp_path):
+        proto = tmp_path / "l.prototxt"
+        proto.write_text("""
+input: "data"
+input_dim: 1 input_dim: 2 input_dim: 2 input_dim: 2
+layer { name: "lg" type: "Log" bottom: "data" top: "lg" }
+layer { name: "bn" type: "BNLL" bottom: "lg" top: "bn" }
+layer { name: "sg" type: "SigmoidCrossEntropyLoss" bottom: "bn" top: "sg" }
+""")
+        g = load_caffe(str(proto))
+        g.evaluate()
+        x = np.random.default_rng(4).uniform(
+            0.5, 2.0, (1, 2, 2, 2)).astype(np.float32)
+        ours = np.asarray(g.forward(jnp.asarray(x)))
+        golden = 1.0 / (1.0 + np.exp(-np.log1p(np.exp(np.log(x)))))
+        np.testing.assert_allclose(ours, golden, rtol=1e-5, atol=1e-5)
+
+    def test_slice_last_top_feeds_channel_sensitive_layer(self, tmp_path):
+        """Regression: the last Slice output's channel count must be the
+        remainder (cin - last slice_point), not the full input count."""
+        proto = tmp_path / "s3.prototxt"
+        proto.write_text("""
+input: "data"
+input_dim: 1 input_dim: 6 input_dim: 4 input_dim: 4
+layer { name: "sl" type: "Slice" bottom: "data" top: "a" top: "b"
+  slice_param { axis: 1 slice_point: 2 } }
+layer { name: "cv" type: "Convolution" bottom: "b" top: "cv"
+  convolution_param { num_output: 3 kernel_size: 1 } }
+""")
+        g = load_caffe(str(proto))
+        g.evaluate()
+        x = np.random.default_rng(5).standard_normal(
+            (1, 4, 4, 6)).astype(np.float32)
+        outs = g.forward(jnp.asarray(x))
+        shapes = sorted(tuple(np.asarray(o).shape) for o in outs)
+        assert shapes == [(1, 4, 4, 2), (1, 4, 4, 3)]
+
+    def test_bias_second_bottom_raises(self, tmp_path):
+        proto = tmp_path / "b2.prototxt"
+        proto.write_text("""
+input: "data"
+input_dim: 1 input_dim: 2 input_dim: 2 input_dim: 2
+layer { name: "sp" type: "Split" bottom: "data" top: "x" top: "y" }
+layer { name: "bias" type: "Bias" bottom: "x" bottom: "y" top: "out" }
+""")
+        with pytest.raises(NotImplementedError, match="second bottom"):
+            load_caffe(str(proto))
+
+    def test_prelu_channel_shared(self, tmp_path):
+        proto = tmp_path / "ps.prototxt"
+        proto.write_text("""
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 2 input_dim: 2
+layer { name: "pre" type: "PReLU" bottom: "data" top: "pre"
+  prelu_param { channel_shared: true } }
+""")
+        slope = np.asarray([0.3], np.float32)
+        cm = tmp_path / "ps.caffemodel"
+        self._write_model(str(cm), [("pre", "PReLU", [slope])])
+        g = load_caffe(str(proto), str(cm))
+        g.evaluate()
+        x = np.random.default_rng(6).standard_normal(
+            (1, 2, 2, 3)).astype(np.float32)
+        ours = np.asarray(g.forward(jnp.asarray(x)))
+        np.testing.assert_allclose(ours, np.where(x >= 0, x, 0.3 * x),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_unhonorable_attrs_fail_loudly(self, tmp_path):
+        """Dilated deconv, partial reshape and negative tile axes have no
+        converter: they must raise, not silently drop the attribute."""
+        cases = [
+            ("""layer { name: "l" type: "Deconvolution" bottom: "data"
+                 top: "l" convolution_param { num_output: 2 kernel_size: 3
+                 dilation: 2 } }""", "dilated Deconvolution"),
+            ("""layer { name: "l" type: "Reshape" bottom: "data" top: "l"
+                 reshape_param { axis: 1 shape { dim: -1 } } }""",
+             "partial Reshape"),
+            ("""layer { name: "l" type: "Tile" bottom: "data" top: "l"
+                 tile_param { axis: -3 tiles: 2 } }""", "negative axis"),
+        ]
+        for body, msg in cases:
+            proto = tmp_path / "bad.prototxt"
+            proto.write_text(
+                'input: "data"\ninput_dim: 1 input_dim: 4 '
+                'input_dim: 2 input_dim: 2\n' + body)
+            with pytest.raises(NotImplementedError, match=msg):
+                load_caffe(str(proto))

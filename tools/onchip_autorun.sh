@@ -1,5 +1,5 @@
 #!/bin/bash
-# Poll the TPU tunnel; the moment it answers, run the round-4 fused/batch
+# Poll the TPU tunnel; the moment it answers, run the round-5 fused/batch
 # A/B evidence sequence. Append everything to tools/onchip_autorun.log.
 # Usage: nohup bash tools/onchip_autorun.sh & (safe to re-run; uses a lock)
 cd "$(dirname "$0")/.." || exit 1
@@ -7,17 +7,18 @@ LOG=tools/onchip_autorun.log
 # leg results ALSO go to a committed file: the driver auto-commits
 # uncommitted work at round end, so evidence landing after the last
 # interactive turn still reaches the repo (the .log is gitignored)
-RESULTS=docs/traces/autorun_results_r4.log
+RESULTS=docs/traces/autorun_results_r5.log
 mkdir -p docs/traces
 LOCK=/tmp/onchip_autorun.lock
 exec 9>"$LOCK"
 flock -n 9 || { echo "another autorun holds the lock" >>"$LOG"; exit 0; }
 
-echo "=== autorun start $(date -u +%FT%TZ)" >>"$LOG"
-for i in $(seq 1 60); do            # up to ~5h of probing
+echo "=== autorun r5 start $(date -u +%FT%TZ)" >>"$LOG"
+for i in $(seq 1 160); do           # up to ~11h of probing
   if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d; print(d)" >>"$LOG" 2>&1; then
     echo "--- tunnel ALIVE at $(date -u +%FT%TZ); running evidence legs" >>"$LOG"
-    # leg 1: fused @128 (the A/B the op accounting motivates)
+    echo "=== r5 legs start $(date -u +%FT%TZ)" >>"$RESULTS"
+    # leg 1: fused @128 (the A/B the round-4 op accounting motivates)
     BENCH_FUSED=1 PROF_BATCH=128 EV_STEPS=16 timeout 1500 \
       python tools/tpu_evidence.py >>"$RESULTS" 2>&1
     echo "--- leg 128f done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
@@ -25,18 +26,27 @@ for i in $(seq 1 60); do            # up to ~5h of probing
     BENCH_FUSED=1 PROF_BATCH=256 EV_STEPS=16 timeout 1500 \
       python tools/tpu_evidence.py >>"$RESULTS" 2>&1
     echo "--- leg 256f done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
-    # leg 3: fused+s2d+remat @512 (HBM headroom config)
-    BENCH_FUSED=1 BENCH_S2D=1 BENCH_REMAT=1 PROF_BATCH=512 EV_STEPS=12 \
-      timeout 1500 python tools/tpu_evidence.py >>"$RESULTS" 2>&1
-    echo "--- leg 512rsf done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
-    # leg 4: int8 vs bf16 inference (the BigQuant headline analogue)
+    # leg 3: plain @128 control (same config as the round-4 0.31-MFU
+    # trace; rerun so the A/B rides one tunnel session, not cross-round)
+    PROF_BATCH=128 EV_STEPS=16 timeout 1500 \
+      python tools/tpu_evidence.py >>"$RESULTS" 2>&1
+    echo "--- leg 128plain done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
+    # leg 4: fused+s2d @256 (stem space-to-depth A/B)
+    BENCH_FUSED=1 BENCH_S2D=1 PROF_BATCH=256 EV_STEPS=16 timeout 1500 \
+      python tools/tpu_evidence.py >>"$RESULTS" 2>&1
+    echo "--- leg 256sf done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
+    # leg 5: int8 vs bf16 inference (the BigQuant headline analogue)
     QP_BATCH=128 QP_STEPS=16 timeout 1200 \
       python tools/quant_perf.py >>"$RESULTS" 2>&1
     echo "--- leg quant done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
-    echo "=== autorun complete $(date -u +%FT%TZ)" >>"$LOG"
+    # leg 6: authoritative bench record while the tunnel is alive
+    timeout 1800 python bench.py >>"$RESULTS" 2>&1
+    echo "--- leg bench done rc=$? $(date -u +%FT%TZ)" >>"$RESULTS"
+    echo "=== autorun r5 complete $(date -u +%FT%TZ)" >>"$LOG"
+    echo "=== r5 legs complete $(date -u +%FT%TZ)" >>"$RESULTS"
     exit 0
   fi
   echo "probe $i dead $(date -u +%FT%TZ)" >>"$LOG"
-  sleep 240
+  sleep 180
 done
-echo "=== autorun gave up $(date -u +%FT%TZ)" >>"$LOG"
+echo "=== autorun r5 gave up $(date -u +%FT%TZ)" >>"$LOG"

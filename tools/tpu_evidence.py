@@ -102,9 +102,10 @@ def main():
 
     # phase 2: the same window under a profiler trace (independent witness)
     suffix = bench.variant_suffix(flags)
+    tag = os.environ.get("EV_TAG", "r5")
     trace_dir = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "traces",
-        f"r4_{dev.platform}_b{batch}{suffix}")
+        f"{tag}_{dev.platform}_b{batch}{suffix}")
     os.makedirs(trace_dir, exist_ok=True)
     t0 = time.perf_counter()
     with jax.profiler.trace(trace_dir):
