@@ -22,4 +22,5 @@ from bigdl_tpu.optim.local_optimizer import (
 from bigdl_tpu.optim.distri_optimizer import (
     DistriOptimizer, ParallelOptimizer, make_distri_train_step,
 )
+from bigdl_tpu.optim.strategy_optimizer import StrategyOptimizer
 from bigdl_tpu.optim.predictor import Predictor, PredictionService, evaluate

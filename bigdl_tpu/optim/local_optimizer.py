@@ -410,13 +410,29 @@ def validate(model, params, mstate, dataset, methods, compute_dtype=None):
 
 class Optimizer:
     """Factory mirroring the reference (optim/Optimizer.scala:476,602-676):
-    picks Local vs Distri based on the dataset/devices."""
+    picks Local vs Distri based on the dataset/devices; ``strategy=``
+    additionally routes to the model-parallel engines (tensor/pipeline/
+    sequence/expert parallelism) with the same builder surface:
+
+        Optimizer(model, ds, crit, method, strategy="tp", mesh=mesh)
+        Optimizer(model, ds, crit, method, strategy="pp", mesh=mesh,
+                  n_microbatches=4)
+    """
 
     def __new__(cls, model=None, dataset=None, criterion=None,
-                optim_method=None, distributed: Optional[bool] = None):
+                optim_method=None, distributed: Optional[bool] = None,
+                strategy: Optional[str] = None, **strategy_kw):
         from bigdl_tpu.dataset.dataset import DistributedDataSet
         from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 
+        if strategy not in (None, "dp"):
+            from bigdl_tpu.optim.strategy_optimizer import StrategyOptimizer
+            return StrategyOptimizer(model, dataset, criterion, optim_method,
+                                     strategy=strategy, **strategy_kw)
+        if strategy_kw:
+            raise TypeError(
+                f"unexpected arguments {sorted(strategy_kw)} without a "
+                "model-parallel strategy= selection")
         if distributed is None:
             distributed = isinstance(dataset, DistributedDataSet)
         klass = DistriOptimizer if distributed else LocalOptimizer

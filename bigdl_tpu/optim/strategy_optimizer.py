@@ -1,0 +1,323 @@
+"""Model-parallel training strategies behind the Optimizer builder facade.
+
+Reference: the Optimizer factory is the ONE user entry point for every
+training topology (optim/Optimizer.scala:602-676 routes to Local/Distri
+optimizers from the dataset type).  The reference has no tensor/pipeline/
+sequence/expert parallelism to route; this stack does, and round 4 left
+them as bare ``make_*_train_step`` library calls.  This module gives them
+the same ergonomics as dp: ``Optimizer(model, dataset, criterion, method,
+strategy="tp", mesh=mesh)`` with the full builder surface (triggers,
+validation, checkpoints, summaries) working unchanged.
+
+Strategies (all one jitted XLA program per step over the ICI mesh):
+
+- ``tp``: Megatron-style GSPMD tensor parallelism (parallel/tp.py) over a
+  ``model`` mesh axis, optionally composed with a ``data`` axis.
+- ``pp``: GPipe pipeline parallelism (parallel/pp.py) over a ``pipe``
+  axis; ``n_microbatches=``, composes with ``data`` and (via
+  ``tensor_parallel=True``) a GSPMD ``model`` axis.
+- ``sp``: ring-attention / Ulysses sequence parallelism (parallel/
+  sequence.py) over a ``seq`` axis (the model's ``seq_mode`` picks the
+  attention comm pattern).
+- ``ep``: expert parallelism for MoE models (parallel/ep.py) over an
+  ``expert`` axis.
+
+The dp+ZeRO-1 path stays in DistriOptimizer (it additionally shards
+optimizer state over the flat parameter plane and handles BN state).
+"""
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.optim.local_optimizer import (BaseOptimizer, PREDICTED_END,
+                                             validate)
+from bigdl_tpu.utils import file_io
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.random_generator import RNG
+
+log = logging.getLogger("bigdl_tpu.optim")
+
+STRATEGIES = ("tp", "pp", "sp", "ep")
+
+#: strategy -> keyword arguments its step factory understands; anything
+#: else is a configuration error, not a silent no-op
+_STRATEGY_KW = {
+    "tp": {"rules"},
+    "ep": {"rules", "aux_weight"},
+    "sp": {"seq_axis"},
+    "pp": {"pipe_axis", "n_microbatches", "tensor_parallel"},
+}
+
+
+class _ClippingMethod:
+    """OptimMethod proxy that clips gradients before the base update.
+
+    The strategy step factories call ``optim_method.update`` on the full
+    logical gradient tree (GSPMD shards the arithmetic; shard_map paths
+    pmean first), so value clipping is elementwise and the global-norm
+    sum spans every parameter -- identical semantics to the clipping in
+    make_train_step / the DistriOptimizer chunk step."""
+
+    def __init__(self, base, clip_value, clip_norm):
+        self._base = base
+        self._clip_value = clip_value
+        self._clip_norm = clip_norm
+
+    def init_state(self, params):
+        return self._base.init_state(params)
+
+    def update(self, grads, opt_state, params):
+        from bigdl_tpu.optim.optim_method import (clip_by_global_norm,
+                                                  clip_by_value)
+        if self._clip_value is not None:
+            grads = clip_by_value(grads, *self._clip_value)
+        if self._clip_norm is not None:
+            grads = clip_by_global_norm(grads, self._clip_norm)
+        return self._base.update(grads, opt_state, params)
+
+    def __getattr__(self, name):   # schedule, get_learning_rate, ...
+        return getattr(self._base, name)
+
+
+class StrategyOptimizer(BaseOptimizer):
+    """Driver loop for the model-parallel strategies.
+
+    Accepts the same builder setters as Local/Distri optimizers; the
+    strategy only changes how the step program lays out parameters and
+    batches over the mesh.  Extra keyword arguments are forwarded to the
+    strategy's step factory (``n_microbatches``, ``seq_axis``, ``rules``,
+    ``aux_weight``, ``tensor_parallel`` ...).
+    """
+
+    def __init__(self, model, dataset, criterion, optim_method=None,
+                 strategy="tp", mesh=None, data_axis="data", **strategy_kw):
+        super().__init__(model, dataset, criterion, optim_method)
+        if strategy not in STRATEGIES:
+            raise ValueError(
+                f"unknown parallel strategy {strategy!r}; expected one of "
+                f"{STRATEGIES} (data parallelism is the default Optimizer "
+                f"path, not a strategy= value)")
+        self.strategy = strategy
+        self.mesh = mesh or Engine.mesh()
+        #: data axis is optional for pure model-parallel meshes
+        self.data_axis = (data_axis if data_axis in self.mesh.axis_names
+                          else None)
+        unknown = set(strategy_kw) - _STRATEGY_KW[strategy]
+        if unknown:
+            raise TypeError(
+                f"strategy={strategy!r} does not understand "
+                f"{sorted(unknown)}; accepted options: "
+                f"{sorted(_STRATEGY_KW[strategy])}")
+        self.strategy_kw = dict(strategy_kw)
+
+    # ----- strategy wiring ------------------------------------------------- #
+
+    def _check_stateless(self):
+        """tp/pp/sp/ep steps run the model with empty mutable state; a
+        model carrying running statistics (BatchNorm) must train on the
+        dp path, which averages that state across shards."""
+        state = self.model.state()
+        if any(jnp.issubdtype(getattr(l, "dtype", jnp.int32), jnp.floating)
+               for l in jax.tree.leaves(state)):
+            raise NotImplementedError(
+                f"strategy={self.strategy!r} trains with empty module "
+                "state, but this model carries floating state (e.g. "
+                "BatchNorm running stats); train it data-parallel "
+                "(DistriOptimizer) instead")
+
+    def _prepare(self, params_tree):
+        """-> (step, params, opt_state, place_batch, finalize).
+
+        ``step(params, opt_state, x, y, rng) -> (params, opt_state, loss)``
+        is the shared convention of every make_*_train_step factory.
+        ``finalize(params)`` maps strategy-native params back to the
+        model's own parameter tree.
+        """
+        m, crit, meth = self.model, self.criterion, self.optim_method
+        if self.clip_value is not None or self.clip_norm is not None:
+            meth = _ClippingMethod(meth, self.clip_value, self.clip_norm)
+        mesh, kw = self.mesh, self.strategy_kw
+        identity = lambda p: p
+
+        if self.strategy == "tp":
+            from bigdl_tpu.parallel.tp import (TRANSFORMER_TP_RULES,
+                                               init_opt_state_sharded,
+                                               make_tp_train_step,
+                                               shard_params)
+            rules = kw.get("rules", TRANSFORMER_TP_RULES)
+            step = make_tp_train_step(
+                m, crit, meth, mesh, data_axis=self.data_axis, rules=rules,
+                compute_dtype=self.compute_dtype)(params_tree)
+            params = shard_params(params_tree, mesh, rules)
+            opt_state = init_opt_state_sharded(meth, params, mesh, rules)
+            sharding = NamedSharding(mesh, P(self.data_axis))
+            place = lambda a: jax.device_put(jnp.asarray(a), sharding)
+            return step, params, opt_state, place, identity
+
+        if self.strategy == "ep":
+            from bigdl_tpu.parallel.ep import (MOE_EP_RULES, ep_shard_params,
+                                               init_ep_opt_state,
+                                               make_ep_train_step)
+            rules = kw.get("rules", MOE_EP_RULES)
+            step = make_ep_train_step(
+                m, crit, meth, mesh, data_axis=self.data_axis,
+                aux_weight=kw.get("aux_weight", 0.01),
+                rules=rules, compute_dtype=self.compute_dtype)(params_tree)
+            params = ep_shard_params(params_tree, mesh, rules)
+            opt_state = init_ep_opt_state(meth, params, mesh, rules)
+            sharding = NamedSharding(mesh, P(self.data_axis))
+            place = lambda a: jax.device_put(jnp.asarray(a), sharding)
+            return step, params, opt_state, place, identity
+
+        if self.strategy == "sp":
+            from bigdl_tpu.parallel.sequence import (make_sp_train_step,
+                                                     shard_tokens)
+            seq_axis = kw.get("seq_axis", "seq")
+            step = make_sp_train_step(
+                m, crit, meth, mesh, seq_axis=seq_axis,
+                data_axis=self.data_axis, compute_dtype=self.compute_dtype)
+            params = params_tree
+            opt_state = meth.init_state(params)
+            place = lambda a: shard_tokens(a, mesh, seq_axis=seq_axis,
+                                           data_axis=self.data_axis)
+            return step, params, opt_state, place, identity
+
+        # pp
+        from bigdl_tpu.parallel.pp import (make_pp_train_step, pp_shardings,
+                                           pp_tp_shardings,
+                                           stack_stage_params,
+                                           unstack_stage_params)
+        from bigdl_tpu.parallel.zero import shard_opt_state
+        pipe_axis = kw.get("pipe_axis", "pipe")
+        n_stages = self.mesh.shape[pipe_axis]
+        n_micro = kw.get("n_microbatches", n_stages)
+        tensor_parallel = kw.get("tensor_parallel", False)
+        manual = (tuple(a for a in (self.data_axis, pipe_axis) if a)
+                  if tensor_parallel else None)
+        step = make_pp_train_step(
+            m, crit, meth, mesh, n_microbatches=n_micro,
+            pipe_axis=pipe_axis, data_axis=self.data_axis,
+            manual_axes=manual, compute_dtype=self.compute_dtype)
+        pp = stack_stage_params(m, n_stages)
+        sh = (pp_tp_shardings(pp, mesh, pipe_axis=pipe_axis)
+              if tensor_parallel else pp_shardings(pp, mesh, pipe_axis))
+        pp = jax.tree.map(jax.device_put, pp, sh)
+        opt_state = shard_opt_state(meth, pp, sh, mesh)
+        place = jnp.asarray          # the pp loss fn reshapes + shards
+        finalize = lambda p: unstack_stage_params(m, p)
+        return step, pp, opt_state, place, finalize
+
+    def _validate_sp(self, params, place):
+        """Validation for sequence parallelism: forward under shard_map
+        (the seq axis is bound there), metrics on the gathered logits."""
+        import jax.numpy as jnp
+
+        if getattr(self, "_sp_eval", None) is None:
+            from bigdl_tpu.parallel.sequence import make_sp_eval_step
+            self._sp_eval = make_sp_eval_step(
+                self.model, self.mesh,
+                seq_axis=self.strategy_kw.get("seq_axis", "seq"),
+                data_axis=self.data_axis,
+                compute_dtype=self.compute_dtype)
+        totals = [None] * len(self.validation_methods)
+        for batch in self.validation_dataset.data(train=False):
+            x = jax.tree.map(place, batch.get_input())
+            target = jax.tree.map(jnp.asarray, batch.get_target())
+            out = self._sp_eval(params, x)
+            for i, m in enumerate(self.validation_methods):
+                r = m(out, target)
+                totals[i] = r if totals[i] is None else totals[i] + r
+        return totals
+
+    # ----- driver loop ----------------------------------------------------- #
+    # NOTE: this loop mirrors LocalOptimizer._optimize_impl's staging /
+    # trigger / summary choreography (incl. the round-3 deferred-fetch
+    # liveness fix) with a strategy step signature; keep the two in sync
+    # when touching either.
+
+    def _optimize_impl(self):
+        self._reshuffle_pending = False
+        train_iter = self.dataset.data(train=True)
+        first_batch = next(train_iter)
+        params_tree, _ = self._init_model(first_batch)
+        self._check_stateless()
+        step, params, opt_state, place, finalize = self._prepare(params_tree)
+
+        if getattr(self, "_resume", None):
+            snap = self._resume
+            params = jax.tree.map(
+                lambda l, s: jax.device_put(jnp.asarray(l), s.sharding),
+                snap["model_params"], params)
+            opt_state = jax.tree.map(
+                lambda l, s: jax.device_put(jnp.asarray(l), s.sharding),
+                snap["opt_state"], opt_state)
+            self.driver_state.update(snap["driver_state"])
+
+        epoch_size = self.dataset.size()
+        state = self.driver_state
+        batch = first_batch
+        while not self.end_trigger(state):
+            t0 = time.time()
+            if batch is None:
+                batch, train_iter = self._stage_next_batch(
+                    train_iter, state, 0, epoch_size, force=True)
+            x = jax.tree.map(place, batch.get_input())
+            y = jax.tree.map(place, batch.get_target())
+            params, opt_state, loss = step(params, opt_state, x, y,
+                                           RNG.next_key())
+            n = batch.size()
+            next_batch, train_iter = self._stage_next_batch(
+                train_iter, state, n, epoch_size)
+            loss = float(loss)
+            dt = time.time() - t0
+            state["loss"] = loss
+            state["record_count"] += n
+            state["throughput"] = n / max(dt, 1e-9)
+            self._log_progress(loss, state["throughput"])
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss, state["neval"])
+                self.train_summary.add_scalar(
+                    "Throughput", state["throughput"], state["neval"])
+                self.train_summary.add_scalar(
+                    "LearningRate",
+                    float(self.optim_method.get_learning_rate(opt_state)),
+                    state["neval"])
+                # histograms over the strategy-native tree (pp: stacked)
+                self._histograms(params, state)
+            state["neval"] += 1
+            if state["record_count"] >= epoch_size:
+                state["epoch"] += 1
+                state["record_count"] = 0
+                if next_batch is None:
+                    self._reshuffle_pending = True
+
+            if (self.validation_trigger is not None
+                    and self.validation_trigger(state)):
+                if self.strategy == "sp":
+                    # the model's attention binds the seq mesh axis, so
+                    # plain-jit validate() cannot run it (unbound axis);
+                    # evaluate under the same shard_map topology instead
+                    results = self._validate_sp(params, place)
+                else:
+                    results = validate(self.model, finalize(params), (),
+                                       self.validation_dataset,
+                                       self.validation_methods,
+                                       self.compute_dtype)
+                self._record_validation(results, state)
+                opt_state = self._feed_plateau(state, opt_state)
+            if (self.checkpoint_trigger is not None
+                    and self.checkpoint_trigger(state)):
+                file_io.save_checkpoint(
+                    self.checkpoint_path, state["neval"],
+                    params, (), opt_state, state)
+
+            batch = None if next_batch is PREDICTED_END else next_batch
+
+        final = finalize(params)
+        self.model.set_parameters(final)
+        return self.model

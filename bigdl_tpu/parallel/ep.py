@@ -49,13 +49,15 @@ def ep_shard_params(params, mesh, rules=MOE_EP_RULES):
 
 def make_ep_train_step(model, criterion, optim_method, mesh,
                        data_axis: Optional[str] = "data",
-                       aux_weight: float = 0.01, rules=MOE_EP_RULES):
+                       aux_weight: float = 0.01, rules=MOE_EP_RULES,
+                       compute_dtype=None):
     """-> compile_for(params) -> jitted step with expert-parallel params.
 
     Task loss + ``aux_weight``  x  router load-balance loss; expert params
     (and their optimizer moments) updated where their shard lives.
     """
     from bigdl_tpu.nn.module import has_frozen
+    from bigdl_tpu.optim.train_step import _cast_tree
     if has_frozen(model):
         raise NotImplementedError(
             "freeze() is honored by make_train_step and the "
@@ -65,12 +67,15 @@ def make_ep_train_step(model, criterion, optim_method, mesh,
 
     def step(params, opt_state, x, y, rng):
         def loss_fn(p):
-            logits, st = model.apply(p, (), x, training=True, rng=rng)
+            cp = _cast_tree(p, compute_dtype)
+            logits, st = model.apply(cp, (), x, training=True, rng=rng)
             task = criterion.apply(logits.astype(jnp.float32), y)
-            return task + aux_weight * st["aux_loss"], task
+            return task + aux_weight * st["aux_loss"].astype(jnp.float32), \
+                task
 
         (loss, task), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params)
+        grads = _cast_tree(grads, jnp.float32)
         new_params, new_opt = optim_method.update(grads, opt_state, params)
         return new_params, new_opt, task
 

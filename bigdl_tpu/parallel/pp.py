@@ -122,7 +122,8 @@ def pp_tp_shardings(pp_params, mesh, pipe_axis="pipe", model_axis="model",
 def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
                     pipe_axis: str = "pipe",
                     data_axis: Optional[str] = None,
-                    manual_axes: Optional[tuple] = None):
+                    manual_axes: Optional[tuple] = None,
+                    compute_dtype=None):
     """-> loss(pp_params, x_tokens, y_tokens) with the GPipe schedule inside.
 
     ``x``/``y``: int32 (batch, T); batch must divide n_microbatches (times
@@ -146,6 +147,9 @@ def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
 
     def per_device(pp_params, x, y, rng):
         # x, y: (n_micro, mb_local, T) on this device
+        from bigdl_tpu.optim.train_step import _cast_tree
+        pp_params = _cast_tree(pp_params, compute_dtype)
+        cdt = compute_dtype or jnp.float32
         stage = lax.axis_index(pipe_axis)
         sp = jax.tree.map(lambda a: a[0], pp_params["stages"])
         emb = pp_params["embed"]
@@ -170,8 +174,8 @@ def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
             send = lax.ppermute(out, pipe_axis, fwd_perm)
             return (send, outs), None
 
-        init = (jnp.zeros((mb, t, d), jnp.float32),
-                jnp.zeros((n_micro, mb, t, d), jnp.float32))
+        init = (jnp.zeros((mb, t, d), cdt),
+                jnp.zeros((n_micro, mb, t, d), cdt))
         (_, outs), _ = lax.scan(tick, init,
                                 jnp.arange(n_micro + n_stages - 1))
         # replicated tail on the collected last-stage activations
@@ -219,7 +223,8 @@ def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
 def make_pp_train_step(model, criterion, optim_method, mesh,
                        n_microbatches: int, pipe_axis: str = "pipe",
                        data_axis: Optional[str] = None,
-                       manual_axes: Optional[tuple] = None):
+                       manual_axes: Optional[tuple] = None,
+                       compute_dtype=None):
     """-> jitted step(pp_params, opt_state, x, y, rng) -> (params', opt', loss).
 
     Stage-stacked params (and their optimizer moments) live sharded over the
@@ -237,7 +242,8 @@ def make_pp_train_step(model, criterion, optim_method, mesh,
             "does not mask frozen parameters yet -- unfreeze() before "
             "building, or train with LocalOptimizer/DistriOptimizer")
     loss_fn = make_pp_loss_fn(model, criterion, mesh, n_microbatches,
-                              pipe_axis, data_axis, manual_axes)
+                              pipe_axis, data_axis, manual_axes,
+                              compute_dtype)
 
     def step(pp_params, opt_state, x, y, rng):
         loss, grads = jax.value_and_grad(loss_fn)(pp_params, x, y, rng)

@@ -67,6 +67,28 @@ def make_sp_train_step(model, criterion, optim_method, mesh,
     ), donate_argnums=(0, 1))
 
 
+def make_sp_eval_step(model, mesh, seq_axis: str = "seq",
+                      data_axis: Optional[str] = None, compute_dtype=None):
+    """-> jitted forward (params, x) -> fp32 logits for validation.
+
+    The model's attention binds ``seq_axis`` via lax.axis_index, so plain
+    ``jit`` cannot evaluate it -- the eval forward must run under the same
+    shard_map topology as the train step."""
+
+    def fwd(params, x):
+        cp = _cast_tree(params, compute_dtype)
+        out, _ = model.apply(cp, (), x, training=False, rng=None)
+        return out.astype(jnp.float32)
+
+    batch_spec = P(data_axis, seq_axis)
+    return jax.jit(jax.shard_map(
+        fwd, mesh=mesh,
+        in_specs=(P(), batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    ))
+
+
 def shard_tokens(x, mesh, seq_axis="seq", data_axis=None):
     """Place a host token array with (data, seq) sharding."""
     import numpy as np

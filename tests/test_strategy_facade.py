@@ -1,0 +1,278 @@
+"""Round-5 parallel-strategy facade (VERDICT r4 ask #3).
+
+The tp/pp/sp/ep engines existed as bare make_*_train_step library calls;
+``Optimizer(strategy=...)`` now routes to them with the full builder
+surface.  Every strategy leg asserts LOSS EQUIVALENCE with a plain
+single-device forward on identically-seeded init (the same bar as the
+driver dryrun), plus builder-surface smoke (validation/checkpoint/
+summary) on one strategy.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+from bigdl_tpu.nn.attention import TransformerLM
+from bigdl_tpu.optim import Optimizer, StrategyOptimizer, Trigger
+from bigdl_tpu.utils.random_generator import RNG
+
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    return jax.sharding.Mesh(devs, names)
+
+
+def _forward_loss(model, crit, x, y):
+    def f(p):
+        out, _ = model.apply(p, (), jnp.asarray(x), training=True,
+                             rng=jax.random.key(0))
+        return crit.apply(out.astype(jnp.float32), jnp.asarray(y))
+    return float(jax.jit(f)(model._params))
+
+
+def _lm_data(rng, batch, seqlen, vocab=64):
+    x = rng.integers(0, vocab, (batch, seqlen)).astype(np.int32)
+    y = rng.integers(0, vocab, (batch, seqlen)).astype(np.int32)
+    return x, y
+
+
+def _run_one_step(model, crit, x, y, **optimizer_kw):
+    ds = array_dataset(x, y) >> SampleToMiniBatch(x.shape[0])
+    opt = Optimizer(model, ds, crit,
+                    optim.SGD(learning_rate=0.1, momentum=0.9,
+                              dampening=0.0), **optimizer_kw)
+    opt.set_end_when(Trigger.max_iteration(1))
+    opt.optimize()
+    return opt
+
+
+class TestStrategyFacade:
+    def test_factory_routes_and_rejects(self):
+        ds = array_dataset(np.zeros((4, 8), np.int32),
+                           np.zeros((4, 8), np.int32)) >> SampleToMiniBatch(4)
+        m = TransformerLM(64, 32, 4, 2, max_len=32)
+        mesh = _mesh((4, 2), ("data", "model"))
+        opt = Optimizer(m, ds, nn.CrossEntropyCriterion(), strategy="tp",
+                        mesh=mesh)
+        assert isinstance(opt, StrategyOptimizer)
+        with pytest.raises(ValueError, match="unknown parallel strategy"):
+            Optimizer(m, ds, nn.CrossEntropyCriterion(), strategy="zz",
+                      mesh=mesh)
+        with pytest.raises(TypeError, match="without a"):
+            Optimizer(m, ds, nn.CrossEntropyCriterion(), n_microbatches=2)
+
+    def test_tp_facade_loss_matches(self):
+        RNG.set_seed(0)
+        model = TransformerLM(64, 32, 4, 2, max_len=32)
+        model.build(jax.ShapeDtypeStruct((4, 16), jnp.int32))
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 4, 16)
+        ref = _forward_loss(model, crit, x, y)
+        opt = _run_one_step(model, crit, x, y, strategy="tp",
+                            mesh=_mesh((4, 2), ("data", "model")))
+        assert abs(opt.driver_state["loss"] - ref) / ref < 5e-4
+
+    def test_sp_facade_loss_matches(self):
+        RNG.set_seed(0)
+        model = TransformerLM(64, 32, 4, 2, max_len=64,
+                              seq_axis_name="seq")
+        model.build(jax.ShapeDtypeStruct((2, 4), jnp.int32))
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 4, 32)
+        RNG.set_seed(0)
+        ref_model = TransformerLM(64, 32, 4, 2, max_len=64)
+        ref_model.build(jax.ShapeDtypeStruct((2, 4), jnp.int32))
+        ref = _forward_loss(ref_model, crit, x, y)
+        opt = _run_one_step(model, crit, x, y, strategy="sp",
+                            mesh=_mesh((2, 4), ("data", "seq")))
+        assert abs(opt.driver_state["loss"] - ref) / ref < 5e-4
+
+    def test_pp_facade_loss_matches(self):
+        RNG.set_seed(0)
+        model = TransformerLM(64, 32, 4, num_layers=4, max_len=32)
+        model.build(jax.ShapeDtypeStruct((4, 16), jnp.int32))
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 4, 16)
+        ref = _forward_loss(model, crit, x, y)
+        opt = _run_one_step(model, crit, x, y, strategy="pp",
+                            mesh=_mesh((2, 4), ("data", "pipe")),
+                            n_microbatches=2)
+        assert abs(opt.driver_state["loss"] - ref) / ref < 5e-4
+        # finalize() folded the stage-stacked params back into the model
+        assert "block3" in model._params
+
+    def test_ep_facade_loss_matches(self):
+        from bigdl_tpu.nn.moe import MoETransformerLM
+        RNG.set_seed(0)
+        model = MoETransformerLM(64, 32, 4, 2, num_experts=4, max_len=32,
+                                 capacity_factor=4.0)
+        model.build(jax.ShapeDtypeStruct((2, 8), jnp.int32))
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 4, 8)
+        ref = _forward_loss(model, crit, x, y)
+        ds = array_dataset(x, y) >> SampleToMiniBatch(4)
+        opt = Optimizer(model, ds, crit, optim.Adam(learning_rate=1e-2),
+                        strategy="ep", mesh=_mesh((2, 4), ("data", "expert")))
+        opt.set_end_when(Trigger.max_iteration(1))
+        opt.optimize()
+        assert abs(opt.driver_state["loss"] - ref) / ref < 5e-4
+
+    def test_builder_surface_validation_and_checkpoint(self, tmp_path):
+        """Triggers, validation and checkpoints work unchanged behind the
+        strategy facade (the whole point of productizing)."""
+        RNG.set_seed(0)
+        model = TransformerLM(64, 32, 4, 2, max_len=32)
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 8, 16)
+        ds = array_dataset(x, y) >> SampleToMiniBatch(4)
+        opt = Optimizer(model, ds, crit, optim.SGD(learning_rate=0.1),
+                        strategy="tp", mesh=_mesh((4, 2), ("data", "model")))
+        opt.set_end_when(Trigger.max_iteration(3))
+        opt.set_validation(Trigger.several_iteration(1), ds, [optim.Loss(crit)])
+        opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        opt.optimize()
+        assert opt.driver_state["neval"] == 4
+        assert "Loss" in opt.driver_state
+        from bigdl_tpu.utils import file_io
+        assert file_io.latest_checkpoint(str(tmp_path)) is not None
+
+    def test_checkpoint_resume_bit_exact(self, tmp_path):
+        """2 steps straight == 1 step + checkpoint + resume + 1 step."""
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 4, 16)
+        mesh = _mesh((4, 2), ("data", "model"))
+
+        def fresh():
+            RNG.set_seed(7)
+            m = TransformerLM(64, 32, 4, 2, max_len=32)
+            ds = array_dataset(x, y) >> SampleToMiniBatch(4)
+            return m, Optimizer(m, ds, crit, optim.SGD(
+                learning_rate=0.1, momentum=0.9, dampening=0.0),
+                strategy="tp", mesh=mesh)
+
+        m2, straight = fresh()
+        straight.set_end_when(Trigger.max_iteration(2))
+        straight.optimize()
+
+        m1, first = fresh()
+        first.set_end_when(Trigger.max_iteration(1))
+        first.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        first.optimize()
+
+        mr, resumed = fresh()
+        resumed.set_end_when(Trigger.max_iteration(2))
+        resumed.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        resumed.resume_from_checkpoint()
+        resumed.optimize()
+        for a, b in zip(jax.tree.leaves(m2._params),
+                        jax.tree.leaves(mr._params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_stateful_model_rejected(self):
+        RNG.set_seed(0)
+        from bigdl_tpu.models.resnet import ResNetCifar
+        model = ResNetCifar(depth=8, class_num=10)
+        x = np.zeros((4, 16, 16, 3), np.float32)
+        y = np.zeros((4,), np.int32)
+        ds = array_dataset(x, y) >> SampleToMiniBatch(4)
+        opt = Optimizer(model, ds, nn.CrossEntropyCriterion(),
+                        strategy="tp", mesh=_mesh((4, 2), ("data", "model")))
+        opt.set_end_when(Trigger.max_iteration(1))
+        with pytest.raises(NotImplementedError, match="floating state"):
+            opt.optimize()
+
+    def test_unknown_strategy_kwarg_rejected(self):
+        ds = array_dataset(np.zeros((4, 8), np.int32),
+                           np.zeros((4, 8), np.int32)) >> SampleToMiniBatch(4)
+        m = TransformerLM(64, 32, 4, 2, max_len=32)
+        with pytest.raises(TypeError, match="does not understand"):
+            Optimizer(m, ds, nn.CrossEntropyCriterion(), strategy="tp",
+                      mesh=_mesh((4, 2), ("data", "model")),
+                      n_microbatches=8)
+        with pytest.raises(TypeError, match="does not understand"):
+            Optimizer(m, ds, nn.CrossEntropyCriterion(), strategy="ep",
+                      mesh=_mesh((4, 2), ("data", "expert")),
+                      aux_wieght=0.1)
+
+    def test_clipping_honored_matches_local(self):
+        """set_gradient_clipping_by_l2_norm must bite on the tp path:
+        params after one clipped tp step == params after one clipped
+        single-device step (identical seed/data)."""
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 4, 16)
+
+        def fresh():
+            RNG.set_seed(3)
+            m = TransformerLM(64, 32, 4, 2, max_len=32)
+            ds = array_dataset(x, y) >> SampleToMiniBatch(4)
+            return m, ds
+
+        m_ref, ds_ref = fresh()
+        ref_opt = optim.LocalOptimizer(
+            m_ref, ds_ref, crit,
+            optim.SGD(learning_rate=0.5, momentum=0.9, dampening=0.0))
+        ref_opt.set_gradient_clipping_by_l2_norm(0.1)  # small enough to bite
+        ref_opt.set_end_when(Trigger.max_iteration(1))
+        ref_opt.optimize()
+
+        m_tp, ds_tp = fresh()
+        opt = Optimizer(m_tp, ds_tp, crit,
+                        optim.SGD(learning_rate=0.5, momentum=0.9,
+                                  dampening=0.0),
+                        strategy="tp", mesh=_mesh((4, 2), ("data", "model")))
+        opt.set_gradient_clipping_by_l2_norm(0.1)
+        opt.set_end_when(Trigger.max_iteration(1))
+        opt.optimize()
+
+        for a, b in zip(jax.tree.leaves(m_ref._params),
+                        jax.tree.leaves(m_tp._params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_pp_compute_dtype_runs_bf16(self):
+        RNG.set_seed(0)
+        model = TransformerLM(64, 32, 4, num_layers=4, max_len=32)
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 4, 16)
+        ds = array_dataset(x, y) >> SampleToMiniBatch(4)
+        opt = Optimizer(model, ds, crit, optim.SGD(learning_rate=0.1),
+                        strategy="pp", mesh=_mesh((2, 4), ("data", "pipe")),
+                        n_microbatches=2)
+        opt.set_compute_dtype(jnp.bfloat16)
+        opt.set_end_when(Trigger.max_iteration(1))
+        opt.optimize()
+        assert np.isfinite(opt.driver_state["loss"])
+        # master params stayed fp32 (the cast is inside the loss)
+        assert all(l.dtype == jnp.float32
+                   for l in jax.tree.leaves(model._params)
+                   if jnp.issubdtype(l.dtype, jnp.floating))
+
+    def test_sp_validation_runs_under_shard_map(self):
+        """Regression: sp validation must not hit 'unbound axis seq'."""
+        RNG.set_seed(0)
+        model = TransformerLM(64, 32, 4, 2, max_len=64, seq_axis_name="seq")
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 4, 32)
+        ds = array_dataset(x, y) >> SampleToMiniBatch(4)
+        opt = Optimizer(model, ds, crit, optim.SGD(learning_rate=0.1),
+                        strategy="sp", mesh=_mesh((2, 4), ("data", "seq")))
+        opt.set_end_when(Trigger.max_iteration(2))
+        opt.set_validation(Trigger.several_iteration(1), ds,
+                           [optim.Loss(crit)])
+        opt.optimize()
+        assert np.isfinite(opt.driver_state["Loss"])
