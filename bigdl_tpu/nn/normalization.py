@@ -4,11 +4,35 @@ Reference: nn/BatchNormalization.scala:51, nn/SpatialBatchNormalization.scala,
 nn/Dropout.scala, nn/SpatialCrossMapLRN.scala, nn/Normalize.scala.
 """
 
+from contextlib import contextmanager
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from bigdl_tpu.nn.module import Module
+
+#: trace-time switch: when a mesh axis name (or tuple of names) is set,
+#: training-mode batch statistics are cross-replica (pmean over the axis)
+#: -- SyncBN.  Per-shard statistics remain the default, matching the
+#: reference's per-replica BN semantics (nn/BatchNormalization.scala
+#: normalizes each worker's local batch).
+_SYNC_AXIS = None
+
+
+@contextmanager
+def sync_batchnorm(axis):
+    """Within this context (at TRACE time, e.g. around ``model.apply``
+    inside a shard_map), BatchNormalization layers normalize with
+    cross-replica batch statistics over the mesh ``axis`` -- the
+    distributed step then matches the single-device full-batch math
+    instead of per-shard statistics."""
+    global _SYNC_AXIS
+    prev, _SYNC_AXIS = _SYNC_AXIS, axis
+    try:
+        yield
+    finally:
+        _SYNC_AXIS = prev
 
 
 class BatchNormalization(Module):
@@ -54,9 +78,15 @@ class BatchNormalization(Module):
                             dtype=jnp.float32)
             sq = jnp.mean(jnp.square(input.astype(jnp.float32)),
                           axis=self.reduce_axes, dtype=jnp.float32)
-            var = jnp.maximum(sq - jnp.square(mean), 0.0)
             n = input.size // input.shape[-1]
-            unbiased = var * n / max(n - 1, 1)
+            if _SYNC_AXIS is not None:
+                # SyncBN: moments pooled across replicas (grad of pmean is
+                # pmean, so backward stat reductions sync the same way)
+                mean = lax.pmean(mean, _SYNC_AXIS)
+                sq = lax.pmean(sq, _SYNC_AXIS)
+                n = n * lax.psum(1, _SYNC_AXIS)
+            var = jnp.maximum(sq - jnp.square(mean), 0.0)
+            unbiased = var * n / jnp.maximum(n - 1, 1)
             m = self.momentum
             state = {
                 "running_mean": (1 - m) * state["running_mean"] + m * mean,

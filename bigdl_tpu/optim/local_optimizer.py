@@ -425,14 +425,19 @@ class Optimizer:
         from bigdl_tpu.dataset.dataset import DistributedDataSet
         from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 
-        if strategy not in (None, "dp"):
+        if strategy is not None and strategy != "dp":
             from bigdl_tpu.optim.strategy_optimizer import StrategyOptimizer
             return StrategyOptimizer(model, dataset, criterion, optim_method,
                                      strategy=strategy, **strategy_kw)
+        if strategy == "dp":
+            # dp options (mesh, axis, grad_compression, sync_bn) forward to
+            # DistriOptimizer; unknown names fail in its constructor
+            return DistriOptimizer(model, dataset, criterion, optim_method,
+                                   **strategy_kw)
         if strategy_kw:
             raise TypeError(
-                f"unexpected arguments {sorted(strategy_kw)} without a "
-                "model-parallel strategy= selection")
+                f"unexpected arguments {sorted(strategy_kw)}; pass "
+                "strategy= ('dp', 'tp', 'pp', 'sp' or 'ep') to route them")
         if distributed is None:
             distributed = isinstance(dataset, DistributedDataSet)
         klass = DistriOptimizer if distributed else LocalOptimizer

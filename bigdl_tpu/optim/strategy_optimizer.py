@@ -104,9 +104,17 @@ class StrategyOptimizer(BaseOptimizer):
                 f"path, not a strategy= value)")
         self.strategy = strategy
         self.mesh = mesh or Engine.mesh()
-        #: data axis is optional for pure model-parallel meshes
-        self.data_axis = (data_axis if data_axis in self.mesh.axis_names
-                          else None)
+        #: data axis is optional for pure model-parallel meshes: the
+        #: "data" default degrades to None when the mesh has no such axis,
+        #: but an EXPLICIT axis name must exist (typos are config errors)
+        if data_axis is None or data_axis in self.mesh.axis_names:
+            self.data_axis = data_axis
+        elif data_axis == "data":
+            self.data_axis = None
+        else:
+            raise ValueError(
+                f"data_axis={data_axis!r} is not an axis of the mesh "
+                f"{tuple(self.mesh.axis_names)}")
         unknown = set(strategy_kw) - _STRATEGY_KW[strategy]
         if unknown:
             raise TypeError(

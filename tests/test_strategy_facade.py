@@ -63,7 +63,7 @@ class TestStrategyFacade:
         with pytest.raises(ValueError, match="unknown parallel strategy"):
             Optimizer(m, ds, nn.CrossEntropyCriterion(), strategy="zz",
                       mesh=mesh)
-        with pytest.raises(TypeError, match="without a"):
+        with pytest.raises(TypeError, match="to route them"):
             Optimizer(m, ds, nn.CrossEntropyCriterion(), n_microbatches=2)
 
     def test_tp_facade_loss_matches(self):
@@ -276,3 +276,23 @@ class TestStrategyFacade:
                            [optim.Loss(crit)])
         opt.optimize()
         assert np.isfinite(opt.driver_state["Loss"])
+
+    def test_bad_data_axis_rejected(self):
+        ds = array_dataset(np.zeros((4, 8), np.int32),
+                           np.zeros((4, 8), np.int32)) >> SampleToMiniBatch(4)
+        m = TransformerLM(64, 32, 4, 2, max_len=32)
+        with pytest.raises(ValueError, match="not an axis of the mesh"):
+            Optimizer(m, ds, nn.CrossEntropyCriterion(), strategy="tp",
+                      mesh=_mesh((4, 2), ("data", "model")),
+                      data_axis="batch")
+
+    def test_dp_strategy_forwards_to_distri(self):
+        from bigdl_tpu.optim import DistriOptimizer
+        ds = array_dataset(np.zeros((8, 4, 4, 3), np.float32),
+                           np.zeros((8,), np.int32)) >> SampleToMiniBatch(8)
+        m = TransformerLM(64, 32, 4, 2, max_len=32)   # any module works here
+        mesh = _mesh((8,), ("data",))
+        opt = Optimizer(m, ds, nn.CrossEntropyCriterion(), strategy="dp",
+                        mesh=mesh, sync_bn=True)
+        assert isinstance(opt, DistriOptimizer)
+        assert opt.sync_bn and opt.mesh is mesh
