@@ -50,7 +50,8 @@ _STRATEGY_KW = {
     "tp": {"rules"},
     "ep": {"rules", "aux_weight"},
     "sp": {"seq_axis"},
-    "pp": {"pipe_axis", "n_microbatches", "tensor_parallel"},
+    "pp": {"pipe_axis", "n_microbatches", "tensor_parallel", "boundaries",
+           "schedule"},
 }
 
 
@@ -138,7 +139,7 @@ class StrategyOptimizer(BaseOptimizer):
                 "BatchNorm running stats); train it data-parallel "
                 "(DistriOptimizer) instead")
 
-    def _prepare(self, params_tree):
+    def _prepare(self, params_tree, first_batch=None):
         """-> (step, params, opt_state, place_batch, finalize).
 
         ``step(params, opt_state, x, y, rng) -> (params, opt_state, loss)``
@@ -196,21 +197,84 @@ class StrategyOptimizer(BaseOptimizer):
             return step, params, opt_state, place, identity
 
         # pp
-        from bigdl_tpu.parallel.pp import (make_pp_train_step, pp_shardings,
+        import bigdl_tpu.nn as nn_pkg
+        pipe_axis = kw.get("pipe_axis", "pipe")
+        n_stages = self.mesh.shape[pipe_axis]
+        n_micro = kw.get("n_microbatches", n_stages)
+        schedule = kw.get("schedule", "gpipe")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pp schedule {schedule!r}; "
+                             "expected 'gpipe' or '1f1b'")
+        is_sequential = isinstance(m, nn_pkg.Sequential)
+        # options bound to one engine are config errors on the other, per
+        # this module's no-silent-no-op contract
+        if is_sequential and (schedule != "gpipe"
+                              or kw.get("tensor_parallel", False)):
+            raise NotImplementedError(
+                "pipelined Sequential models run the heterogeneous GPipe "
+                "engine; schedule='1f1b' and tensor_parallel are only "
+                "available for stage-stacked transformer models")
+        if not is_sequential and kw.get("boundaries") is not None:
+            raise TypeError(
+                "boundaries= applies to Sequential (heterogeneous) "
+                "pipelining; stage-stacked transformer models split "
+                "evenly by block count")
+
+        if is_sequential:
+            # arbitrary (uneven, heterogeneous) Sequential: lax.switch
+            # stage bodies + padded flat ring (parallel/pp_het.py)
+            from bigdl_tpu.parallel.pp_het import (make_het_pp_train_step,
+                                                   merge_stage_params)
+            x0 = first_batch.get_input()
+            data_size = (mesh.shape[self.data_axis]
+                         if self.data_axis else 1)
+            global_batch = np.shape(x0)[0]
+            if global_batch % (n_micro * data_size):
+                raise ValueError(
+                    f"batch {global_batch} not divisible by "
+                    f"{n_micro} microbatches x {data_size} data shards")
+            mb = global_batch // n_micro // data_size
+            input_spec = jax.ShapeDtypeStruct(
+                (mb,) + np.shape(x0)[1:], jnp.asarray(x0).dtype)
+            step, stage_params = make_het_pp_train_step(
+                m, crit, meth, mesh, n_micro, input_spec,
+                boundaries=kw.get("boundaries"), pipe_axis=pipe_axis,
+                data_axis=self.data_axis,
+                compute_dtype=self.compute_dtype)
+            rep = NamedSharding(mesh, P())
+            params = jax.tree.map(lambda l: jax.device_put(l, rep),
+                                  stage_params)
+            opt_state = jax.jit(
+                meth.init_state,
+                out_shardings=jax.tree.map(
+                    lambda _: rep,
+                    jax.eval_shape(meth.init_state, params)))(params)
+            return (step, params, opt_state, jnp.asarray,
+                    lambda p: merge_stage_params(m, p))
+
+        from bigdl_tpu.parallel.pp import (make_pp_1f1b_train_step,
+                                           make_pp_train_step, pp_shardings,
                                            pp_tp_shardings,
                                            stack_stage_params,
                                            unstack_stage_params)
         from bigdl_tpu.parallel.zero import shard_opt_state
-        pipe_axis = kw.get("pipe_axis", "pipe")
-        n_stages = self.mesh.shape[pipe_axis]
-        n_micro = kw.get("n_microbatches", n_stages)
         tensor_parallel = kw.get("tensor_parallel", False)
         manual = (tuple(a for a in (self.data_axis, pipe_axis) if a)
                   if tensor_parallel else None)
-        step = make_pp_train_step(
-            m, crit, meth, mesh, n_microbatches=n_micro,
-            pipe_axis=pipe_axis, data_axis=self.data_axis,
-            manual_axes=manual, compute_dtype=self.compute_dtype)
+        if schedule == "1f1b":
+            if tensor_parallel or self.compute_dtype is not None:
+                raise NotImplementedError(
+                    "pp schedule='1f1b' does not compose with "
+                    "tensor_parallel or compute_dtype yet; use the "
+                    "default gpipe schedule for those")
+            step = make_pp_1f1b_train_step(
+                m, crit, meth, mesh, n_microbatches=n_micro,
+                pipe_axis=pipe_axis, data_axis=self.data_axis)
+        else:
+            step = make_pp_train_step(
+                m, crit, meth, mesh, n_microbatches=n_micro,
+                pipe_axis=pipe_axis, data_axis=self.data_axis,
+                manual_axes=manual, compute_dtype=self.compute_dtype)
         pp = stack_stage_params(m, n_stages)
         sh = (pp_tp_shardings(pp, mesh, pipe_axis=pipe_axis)
               if tensor_parallel else pp_shardings(pp, mesh, pipe_axis))
@@ -254,7 +318,8 @@ class StrategyOptimizer(BaseOptimizer):
         first_batch = next(train_iter)
         params_tree, _ = self._init_model(first_batch)
         self._check_stateless()
-        step, params, opt_state, place, finalize = self._prepare(params_tree)
+        step, params, opt_state, place, finalize = self._prepare(
+            params_tree, first_batch)
 
         if getattr(self, "_resume", None):
             snap = self._resume

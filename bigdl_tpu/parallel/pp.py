@@ -253,6 +253,191 @@ def make_pp_train_step(model, criterion, optim_method, mesh,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
+def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
+                            n_microbatches: int, pipe_axis: str = "pipe",
+                            data_axis: Optional[str] = None):
+    """GPipe-equivalent gradients with the 1F1B (PipeDream-flush) schedule
+    and a BOUNDED activation stash.
+
+    The GPipe path (make_pp_train_step) differentiates straight through
+    its scan, so autodiff stashes one residual set per tick -- memory
+    grows with ``n_microbatches``.  Here the schedule is hand-written in
+    ONE scan of ``M + 2S - 1`` ticks: device ``d`` runs the forward of
+    microbatch ``t - d`` and the backward of microbatch ``t - (2S-1-d)``
+    in the same tick (one-forward-one-backward steady state).  Backward
+    uses per-stage ``jax.vjp`` with the stage INPUT rematerialised from a
+    ring stash of ``2S`` slots -- the in-flight window of the 1F1B
+    schedule -- so activation memory is O(S), independent of M.  Weights
+    update once at the flush, so gradients are numerically the GPipe/
+    single-device gradients (asserted in tests), not the PipeDream
+    weight-stashing approximation.
+
+    Activations ride the forward ring (+1 ppermute) and gradients the
+    reverse ring (-1 ppermute), one hop each per tick -- both
+    nearest-neighbour on the ICI.
+
+    Same model scope as make_pp_loss_fn: a built TransformerLM with
+    stage-stacked block params (embed/tail replicated).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    lps = len(model.blocks) // n_stages
+    M = n_microbatches
+    S = n_stages
+    W = 2 * S                     # stash slots >= max residual lifetime 2S-1
+
+    def stage_fn(stage_params, x, rng):
+        for j in range(lps):
+            x, _ = model.blocks[0].apply(
+                stage_params[f"layer{j}"], (), x, training=True,
+                rng=child_rng(rng, j))
+        return x
+
+    def per_device(pp_params, x, y, rng):
+        # x, y: (M, mb, T) int tokens on this device's data shard
+        stage = lax.axis_index(pipe_axis)
+        sp = jax.tree.map(lambda a: a[0], pp_params["stages"])
+        emb, tail = pp_params["embed"], pp_params["tail"]
+        n_micro, mb, t = x.shape
+        d_model = emb["wte"].shape[1]
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        def embed_fn(e, tok):
+            h = jnp.take(e["wte"], tok, axis=0)
+            return h + e["wpe"][:t][None]
+
+        def tail_loss(tl, h, tok_y):
+            hn, _ = model.ln_f.apply(tl["ln_f"], (), h)
+            logits = hn @ tl["head"].astype(hn.dtype).T
+            # mean over this microbatch; the flush divides by M so the
+            # total equals the criterion's full-batch mean
+            return criterion.apply(logits.astype(jnp.float32), tok_y)
+
+        def mrng(m):
+            # keyed like the GPipe path's forward tick tk = m + stage
+            # (make_pp_loss_fn), so (a) each stage draws distinct dropout
+            # masks and (b) 1F1B gradients equal GPipe's under dropout;
+            # the backward recompute reuses the same key by construction
+            return child_rng(child_rng(rng, 7), m + stage)
+
+        zeros_g = {
+            "embed": jax.tree.map(jnp.zeros_like, emb),
+            "stages": jax.tree.map(jnp.zeros_like, sp),
+            "tail": jax.tree.map(jnp.zeros_like, tail),
+        }
+
+        def tick(carry, tk):
+            fwd_recv, bwd_recv, stash, seeds, gacc, loss_acc = carry
+
+            # ---- forward leg: microbatch mf = tk - stage ------------- #
+            mf = tk - stage
+            mf_ok = (mf >= 0) & (mf < M)
+            mf_i = jnp.clip(mf, 0, M - 1)
+            fwd_in = jnp.where(stage == 0,
+                               embed_fn(emb, x[mf_i]), fwd_recv)
+            out = stage_fn(sp, fwd_in, mrng(mf_i))
+            stash = stash.at[mf_i % W].set(
+                jnp.where(mf_ok, fwd_in, stash[mf_i % W]))
+
+            # last stage: loss + seed gradient + tail grads via one vjp
+            def tail_both(tl, h):
+                return tail_loss(tl, h, y[mf_i])
+            loss_m, tail_vjp = jax.vjp(tail_both, tail, out)
+            dtail_m, seed_m = tail_vjp(jnp.ones((), jnp.float32))
+            is_last = stage == S - 1
+            take_loss = mf_ok & is_last
+            loss_acc = loss_acc + jnp.where(take_loss, loss_m, 0.0)
+            gacc = dict(gacc)
+            gacc["tail"] = jax.tree.map(
+                lambda a, g: a + jnp.where(take_loss, g, 0.0),
+                gacc["tail"], dtail_m)
+            seeds = seeds.at[mf_i % 2].set(
+                jnp.where(take_loss, seed_m, seeds[mf_i % 2]))
+
+            # ---- backward leg: microbatch mbk = tk - (2S-1-stage) ---- #
+            mbk = tk - (2 * S - 1 - stage)
+            mb_ok = (mbk >= 0) & (mbk < M)
+            mb_i = jnp.clip(mbk, 0, M - 1)
+            xin = stash[mb_i % W]
+            gin = jnp.where(stage == S - 1, seeds[mb_i % 2], bwd_recv)
+
+            def stage_both(p, xi):
+                return stage_fn(p, xi, mrng(mb_i))
+            _, stage_vjp = jax.vjp(stage_both, sp, xin)
+            dsp, dx = stage_vjp(gin)
+            gacc["stages"] = jax.tree.map(
+                lambda a, g: a + jnp.where(mb_ok, g, 0.0),
+                gacc["stages"], dsp)
+
+            # stage 0 consumes dx into the embedding instead of the ring
+            def embed_only(e):
+                return embed_fn(e, x[mb_i])
+            _, emb_vjp = jax.vjp(embed_only, emb)
+            (demb,) = emb_vjp(dx)
+            take_emb = mb_ok & (stage == 0)
+            gacc["embed"] = jax.tree.map(
+                lambda a, g: a + jnp.where(take_emb, g, 0.0),
+                gacc["embed"], demb)
+
+            fwd_recv = lax.ppermute(out, pipe_axis, fwd_perm)
+            bwd_recv = lax.ppermute(dx, pipe_axis, bwd_perm)
+            return (fwd_recv, bwd_recv, stash, seeds, gacc, loss_acc), None
+
+        init = (
+            jnp.zeros((mb, t, d_model), jnp.float32),
+            jnp.zeros((mb, t, d_model), jnp.float32),
+            jnp.zeros((W, mb, t, d_model), jnp.float32),
+            jnp.zeros((2, mb, t, d_model), jnp.float32),
+            zeros_g,
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, _, gacc, loss_acc), _ = lax.scan(
+            tick, init, jnp.arange(M + 2 * S - 1))
+
+        # flush: per-microbatch means -> full-batch mean
+        loss = lax.psum(loss_acc, pipe_axis) / M
+        grads = {
+            "embed": jax.tree.map(
+                lambda g: lax.psum(g, pipe_axis) / M, gacc["embed"]),
+            # stage grads live where the stage lives; restack the leading
+            # stage dim so the tree matches pp_params["stages"]
+            "stages": jax.tree.map(
+                lambda g: g[None] / M, gacc["stages"]),
+            "tail": jax.tree.map(
+                lambda g: lax.psum(g, pipe_axis) / M, gacc["tail"]),
+        }
+        if data_axis is not None:
+            loss = lax.pmean(loss, data_axis)
+            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+        return loss, grads
+
+    batch_spec = P(None, data_axis) if data_axis else P()
+    smapped = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=({"embed": P(), "stages": P(pipe_axis), "tail": P()},
+                  batch_spec, batch_spec, P()),
+        out_specs=(P(), {"embed": P(), "stages": P(pipe_axis), "tail": P()}),
+        check_vma=False,
+    )
+
+    def step(pp_params, opt_state, x, y, rng):
+        n, t = x.shape
+        assert n % n_microbatches == 0, (n, n_microbatches)
+        if data_axis is not None:
+            mbs = n // n_microbatches
+            assert mbs % mesh.shape[data_axis] == 0, (
+                f"microbatch size {mbs} must divide over the "
+                f"'{data_axis}' axis ({mesh.shape[data_axis]} devices)")
+        xm = x.reshape(n_microbatches, n // n_microbatches, t)
+        ym = y.reshape(n_microbatches, n // n_microbatches, t)
+        loss, grads = smapped(pp_params, xm, ym, rng)
+        new_params, new_opt = optim_method.update(grads, opt_state,
+                                                  pp_params)
+        return new_params, new_opt, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
 def init_pp_opt_state(optim_method, pp_params, mesh, pipe_axis="pipe"):
     """Optimizer state device_put with the same shardings as its params."""
     from bigdl_tpu.parallel.zero import shard_opt_state
