@@ -357,6 +357,16 @@ def _honor_env_platforms():
 
 def main(argv=None):
     _honor_env_platforms()
+    # progress must be visible out of the box (epoch/iteration/loss lines
+    # come through logging.INFO); jax/XLA noise goes to bigdl.log via the
+    # LoggerFilter analogue
+    import logging
+
+    from bigdl_tpu.utils.logger_filter import redirect_spark_info_logs
+    logging.basicConfig(
+        level=os.environ.get("BIGDL_LOG_LEVEL", "INFO").upper(),
+        format="%(asctime)s %(levelname)-5s %(message)s")
+    redirect_spark_info_logs()
     parser = argparse.ArgumentParser(prog="bigdl_tpu.models.run")
     sub = parser.add_subparsers(dest="command", required=True)
 

@@ -225,6 +225,11 @@ class StrategyOptimizer(BaseOptimizer):
             # stage bodies + padded flat ring (parallel/pp_het.py)
             from bigdl_tpu.parallel.pp_het import (make_het_pp_train_step,
                                                    merge_stage_params)
+            if first_batch is None:
+                raise ValueError(
+                    "Sequential pipelining infers per-stage activation "
+                    "shapes from the data; _prepare needs the first "
+                    "minibatch (pass first_batch)")
             x0 = first_batch.get_input()
             data_size = (mesh.shape[self.data_axis]
                          if self.data_axis else 1)
@@ -235,7 +240,7 @@ class StrategyOptimizer(BaseOptimizer):
                     f"{n_micro} microbatches x {data_size} data shards")
             mb = global_batch // n_micro // data_size
             input_spec = jax.ShapeDtypeStruct(
-                (mb,) + np.shape(x0)[1:], jnp.asarray(x0).dtype)
+                (mb,) + np.shape(x0)[1:], np.asarray(x0).dtype)
             step, stage_params = make_het_pp_train_step(
                 m, crit, meth, mesh, n_micro, input_spec,
                 boundaries=kw.get("boundaries"), pipe_axis=pipe_axis,

@@ -47,6 +47,17 @@ class Engine:
         runtime handles rendezvous; collectives then ride ICI within a slice
         and DCN across slices automatically.
         """
+        if coordinator_address is None:
+            # launcher-script surface (reference: scripts/*-with-bigdl.sh
+            # export SPARK_* conf): a k8s manifest or mpirun wrapper sets
+            # these so every CLI entry point joins the rendezvous without
+            # code changes (see docker/k8s-multihost.yaml)
+            coordinator_address = os.environ.get("BIGDL_COORDINATOR")
+            if coordinator_address is not None:
+                if num_processes is None and "BIGDL_NUM_PROCESSES" in os.environ:
+                    num_processes = int(os.environ["BIGDL_NUM_PROCESSES"])
+                if process_id is None and "BIGDL_PROCESS_ID" in os.environ:
+                    process_id = int(os.environ["BIGDL_PROCESS_ID"])
         if coordinator_address is not None and not cls._initialized:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

@@ -1,0 +1,104 @@
+"""Round-5 deployment story (VERDICT r4 ask #8): the Docker image's
+out-of-the-box command, the k8s multi-host manifest, and the launcher
+env-var rendezvous path all stay valid."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestDockerSurface:
+    def test_copy_sources_exist_and_cmd_resolves(self):
+        src = open(os.path.join(REPO, "docker", "Dockerfile")).read()
+        for line in src.splitlines():
+            if line.startswith("COPY"):
+                for tok in line.split()[1:-1]:
+                    assert os.path.exists(os.path.join(REPO, tok)), tok
+        cmd = json.loads(re.search(r"^CMD\s+(\[.*\])\s*$", src, re.M).group(1))
+        assert cmd[0] == "bigdl-tpu-train"
+        # the subcommand must exist in the CLI spec table
+        run_src = open(os.path.join(
+            REPO, "bigdl_tpu", "models", "run.py")).read()
+        assert f'"{cmd[1]}"' in run_src
+        # and the console entry point must resolve
+        import tomllib
+        with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+            scripts = tomllib.load(f)["project"]["scripts"]
+        mod, fn = scripts["bigdl-tpu-train"].split(":")
+        import importlib
+        assert callable(getattr(importlib.import_module(mod), fn))
+
+    def test_smoke_script_validates(self):
+        """The CI-light gate itself must pass (no-docker branch)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            ["bash", os.path.join(REPO, "tools", "docker_smoke.sh")],
+            capture_output=True, text=True, env=env, timeout=420)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "deployment smoke OK" in out.stdout
+
+    def test_k8s_manifest(self):
+        yaml = pytest.importorskip("yaml")
+        docs = list(yaml.safe_load_all(
+            open(os.path.join(REPO, "docker", "k8s-multihost.yaml"))))
+        svc, job = docs
+        # headless marker is the literal string "None" in k8s yaml
+        assert svc["kind"] == "Service" and svc["spec"]["clusterIP"] == "None"
+        spec = job["spec"]
+        assert spec["completionMode"] == "Indexed"
+        assert spec["completions"] == spec["parallelism"]
+        c = spec["template"]["spec"]["containers"][0]
+        env = {e["name"] for e in c["env"]}
+        assert {"BIGDL_COORDINATOR", "BIGDL_NUM_PROCESSES",
+                "BIGDL_PROCESS_ID"} <= env
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"
+
+
+class TestLauncherEnv:
+    def test_engine_init_reads_coordinator_env(self, monkeypatch):
+        import jax
+
+        from bigdl_tpu.utils.engine import Engine
+
+        calls = {}
+
+        def fake_init(coordinator_address=None, num_processes=None,
+                      process_id=None):
+            calls.update(addr=coordinator_address, n=num_processes,
+                         pid=process_id)
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setenv("BIGDL_COORDINATOR", "coord:8476")
+        monkeypatch.setenv("BIGDL_NUM_PROCESSES", "4")
+        monkeypatch.setenv("BIGDL_PROCESS_ID", "2")
+        Engine.reset()
+        try:
+            Engine.init()
+            assert calls == {"addr": "coord:8476", "n": 4, "pid": 2}
+        finally:
+            Engine.reset()
+            Engine.init()        # restore the default single-host state
+
+    def test_engine_init_without_env_is_local(self, monkeypatch):
+        import jax
+
+        from bigdl_tpu.utils.engine import Engine
+
+        def boom(**kw):          # must NOT be called
+            raise AssertionError("distributed init without coordinator")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        monkeypatch.delenv("BIGDL_COORDINATOR", raising=False)
+        Engine.reset()
+        try:
+            Engine.init()
+            assert Engine.node_number() == 1
+        finally:
+            Engine.reset()
+            Engine.init()

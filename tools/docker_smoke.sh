@@ -1,0 +1,52 @@
+#!/bin/bash
+# CI-light deployment smoke (VERDICT r4 ask #8).
+#
+# With a Docker daemon: build the image and run its default command
+# (LeNet on synthetic MNIST -- the out-of-the-box proof).
+# Without one (this CI): validate the Dockerfile's COPY sources and run
+# the EXACT default command the image would run, in the local env.
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== validating docker/Dockerfile COPY sources"
+for src in $(awk '/^COPY/ {for (i=2; i<NF; i++) print $i}' docker/Dockerfile); do
+  [ -e "$src" ] || { echo "MISSING COPY source: $src"; exit 1; }
+  echo "  ok: $src"
+done
+
+echo "== validating manifest"
+python - <<'EOF'
+import yaml
+docs = list(yaml.safe_load_all(open("docker/k8s-multihost.yaml")))
+kinds = [d["kind"] for d in docs]
+assert kinds == ["Service", "Job"], kinds
+tpl = docs[1]["spec"]["template"]["spec"]
+env = {e["name"] for e in tpl["containers"][0]["env"]}
+assert {"BIGDL_COORDINATOR", "BIGDL_NUM_PROCESSES",
+        "BIGDL_PROCESS_ID"} <= env, env
+print("  ok: Service + Indexed Job, coordinator env wired")
+EOF
+
+if command -v docker >/dev/null 2>&1 && docker info >/dev/null 2>&1; then
+  echo "== docker build"
+  docker build -t bigdl-tpu-smoke -f docker/Dockerfile .
+  echo "== docker run (default CMD)"
+  docker run --rm bigdl-tpu-smoke
+else
+  echo "== no docker daemon; running the image's default command locally"
+  cmd=$(python - <<'EOF'
+import json, re
+src = open("docker/Dockerfile").read()
+m = re.search(r'^CMD\s+(\[.*\])\s*$', src, re.M)
+print(" ".join(json.loads(m.group(1))))
+EOF
+)
+  echo "  CMD: $cmd"
+  # console script -> module form so an uninstalled checkout works too
+  if command -v bigdl-tpu-train >/dev/null 2>&1; then
+    $cmd --maxIteration 5
+  else
+    python -m bigdl_tpu.models.run ${cmd#bigdl-tpu-train } --maxIteration 5
+  fi
+fi
+echo "== deployment smoke OK"
