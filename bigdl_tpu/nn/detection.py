@@ -418,7 +418,7 @@ class NormalizeScale(Module):
                 jnp.sum(jnp.power(jnp.abs(x), self.p), axis=1, keepdims=True),
                 1.0 / self.p,
             ) + self.eps
-        return (x / norm) * params["weight"], state
+        return (x / norm) * params["weight"].astype(x.dtype), state
 
 
 # --------------------------------------------------------------------------- #

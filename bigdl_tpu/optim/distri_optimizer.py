@@ -31,7 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from bigdl_tpu.optim.local_optimizer import (BaseOptimizer, PREDICTED_END,
                                              validate, _device_batch)
 from bigdl_tpu.optim.optim_method import clip_by_value
-from bigdl_tpu.optim.train_step import _cast_tree
+from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
 from bigdl_tpu.parallel.zero import FlatParamSpace
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.engine import Engine
@@ -87,7 +87,7 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
 
         def loss_fn(pflat):
             params = flat_space.unflatten(pflat)
-            cp = _cast_tree(params, compute_dtype)
+            cp = _cast_params(params, compute_dtype)
             cx = _cast_tree(x, compute_dtype)
             # sync_bn: cross-replica BN statistics -- the distributed step
             # then matches single-device full-batch math (~1e-6) instead

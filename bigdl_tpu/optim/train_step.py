@@ -28,6 +28,27 @@ def _cast_tree(tree, dtype):
     )
 
 
+def _cast_params(tree, dtype):
+    """Compute-dtype cast for PARAMETER trees: rank>=2 leaves only.
+
+    Vectors and scalars (biases, BN/LayerNorm affine, PReLU slopes) stay
+    fp32 masters: they feed VPU elementwise ops where bf16 buys nothing,
+    every layer already casts them at its use site (``astype(input.dtype)``
+    -- or, for BN, does its scale/shift math in fp32 on purpose), and
+    pre-casting them only manufactured convert traffic.  The round-4
+    ResNet-50 trace counted 1182 convert ops/step; ~2/3 were exactly this
+    rank<=1 f32->bf16->f32 round trip (VERDICT r4 ask #2).  Matmul/conv
+    weights (rank>=2, the MXU operands) still cast here.
+    """
+    if dtype is None:
+        return tree
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.ndim >= 2 else x,
+        tree,
+    )
+
+
 def make_train_step(
     model,
     criterion,
@@ -56,7 +77,7 @@ def make_train_step(
 
     def train_step(params, mstate, opt_state, input, target, rng):
         def loss_fn(p):
-            cp = _cast_tree(p, compute_dtype)
+            cp = _cast_params(p, compute_dtype)
             x = _cast_tree(input, compute_dtype)
             out, new_mstate = model.apply(cp, mstate, x, training=True, rng=rng)
             out32 = _cast_tree(out, jnp.float32)
@@ -97,7 +118,7 @@ def make_eval_step(model, compute_dtype=None):
     """(params, mstate, input) -> output (eval mode, no state update)."""
 
     def eval_step(params, mstate, input):
-        cp = _cast_tree(params, compute_dtype)
+        cp = _cast_params(params, compute_dtype)
         x = _cast_tree(input, compute_dtype)
         out, _ = model.apply(cp, mstate, x, training=False, rng=None)
         return _cast_tree(out, jnp.float32)

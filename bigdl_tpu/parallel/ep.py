@@ -65,9 +65,29 @@ def make_ep_train_step(model, criterion, optim_method, mesh,
             "does not mask frozen parameters yet -- unfreeze() before "
             "building, or train with LocalOptimizer/DistriOptimizer")
 
+    def _cast_ep_params(p):
+        """Compute-dtype cast with the stacked-layout correction: expert
+        biases are stored stacked as (E, features) -- rank 2 -- but are
+        still VPU vector operands per expert, so they keep the fp32
+        master treatment the rank rule gives unstacked biases (the MoE
+        layer casts them at its use site, nn/moe.py:102-105)."""
+        if compute_dtype is None:
+            return p
+        from jax.tree_util import keystr, tree_flatten_with_path, \
+            tree_unflatten
+        leaves, treedef = tree_flatten_with_path(p)
+        out = []
+        for path, leaf in leaves:
+            bias_like = re.search(r"\['b[12]'\]$", keystr(path))
+            if (jnp.issubdtype(leaf.dtype, jnp.floating)
+                    and leaf.ndim >= 2 and not bias_like):
+                leaf = leaf.astype(compute_dtype)
+            out.append(leaf)
+        return tree_unflatten(treedef, out)
+
     def step(params, opt_state, x, y, rng):
         def loss_fn(p):
-            cp = _cast_tree(p, compute_dtype)
+            cp = _cast_ep_params(p)
             logits, st = model.apply(cp, (), x, training=True, rng=rng)
             task = criterion.apply(logits.astype(jnp.float32), y)
             return task + aux_weight * st["aux_loss"].astype(jnp.float32), \

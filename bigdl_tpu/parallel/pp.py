@@ -147,12 +147,17 @@ def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
 
     def per_device(pp_params, x, y, rng):
         # x, y: (n_micro, mb_local, T) on this device
-        from bigdl_tpu.optim.train_step import _cast_tree
-        pp_params = _cast_tree(pp_params, compute_dtype)
+        from bigdl_tpu.optim.train_step import _cast_params
         cdt = compute_dtype or jnp.float32
         stage = lax.axis_index(pipe_axis)
-        sp = jax.tree.map(lambda a: a[0], pp_params["stages"])
-        emb = pp_params["embed"]
+        # slice the stage dim off BEFORE the compute-dtype cast, so the
+        # rank>=2 rule sees the true per-leaf ranks (a stacked bias is
+        # (n_stages, C) -- rank 2 -- but is still a VPU vector operand
+        # that must stay an fp32 master)
+        sp = _cast_params(jax.tree.map(lambda a: a[0],
+                                       pp_params["stages"]), compute_dtype)
+        emb = _cast_params(pp_params["embed"], compute_dtype)
+        tailp = _cast_params(pp_params["tail"], compute_dtype)
         n_micro, mb, t = x.shape
 
         def embed(tok):
@@ -180,8 +185,8 @@ def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
                                 jnp.arange(n_micro + n_stages - 1))
         # replicated tail on the collected last-stage activations
         h = outs.reshape(n_micro * mb, t, d)
-        h, _ = model.ln_f.apply(pp_params["tail"]["ln_f"], (), h)
-        logits = h @ pp_params["tail"]["head"].astype(h.dtype).T
+        h, _ = model.ln_f.apply(tailp["ln_f"], (), h)
+        logits = h @ tailp["head"].astype(h.dtype).T
         loss_local = criterion.apply(logits.astype(jnp.float32),
                                      y.reshape(n_micro * mb, t))
         loss = lax.psum(

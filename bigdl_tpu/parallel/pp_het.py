@@ -38,7 +38,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from bigdl_tpu.nn.module import child_rng
-from bigdl_tpu.optim.train_step import _cast_tree
+from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
 
 
 def partition_sequential(model, n_stages: int,
@@ -169,7 +169,7 @@ def make_het_pp_train_step(model, criterion, optim_method, mesh,
         fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         branches = [
             lambda flat, rng, s=s: stage_body(
-                s, _cast_tree(stage_params_list[s], compute_dtype),
+                s, _cast_params(stage_params_list[s], compute_dtype),
                 flat, rng)
             for s in range(n_stages)
         ]

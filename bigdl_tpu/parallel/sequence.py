@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from bigdl_tpu.optim.train_step import _cast_tree
+from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
 
 
 def make_sp_train_step(model, criterion, optim_method, mesh,
@@ -45,7 +45,7 @@ def make_sp_train_step(model, criterion, optim_method, mesh,
             rng = jax.random.fold_in(rng, lax.axis_index(a) + i * 131)
 
         def loss_fn(p):
-            cp = _cast_tree(p, compute_dtype)
+            cp = _cast_params(p, compute_dtype)
             out, _ = model.apply(cp, (), x, training=True, rng=rng)
             return criterion.apply(out.astype(jnp.float32), y)
 
@@ -76,7 +76,7 @@ def make_sp_eval_step(model, mesh, seq_axis: str = "seq",
     shard_map topology as the train step."""
 
     def fwd(params, x):
-        cp = _cast_tree(params, compute_dtype)
+        cp = _cast_params(params, compute_dtype)
         out, _ = model.apply(cp, (), x, training=False, rng=None)
         return out.astype(jnp.float32)
 

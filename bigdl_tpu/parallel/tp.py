@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
 
-from bigdl_tpu.optim.train_step import _cast_tree
+from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
 
 #: path-regex -> per-dim sharding over the model axis.  None entries mean
 #: replicated.  Applied to TransformerLM parameter paths.
@@ -72,7 +72,7 @@ def make_tp_train_step(model, criterion, optim_method, mesh,
 
     def step(params, opt_state, x, y, rng):
         def loss_fn(p):
-            cp = _cast_tree(p, compute_dtype)
+            cp = _cast_params(p, compute_dtype)
             out, _ = model.apply(cp, (), x, training=True, rng=rng)
             return criterion.apply(out.astype(jnp.float32), y)
 
