@@ -19,7 +19,6 @@ metrics are kept instead (SURVEY.md section 5).
 """
 
 import logging
-import time
 from functools import partial
 from typing import Optional
 
@@ -28,8 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from bigdl_tpu.optim.local_optimizer import (BaseOptimizer, PREDICTED_END,
-                                             validate, _device_batch)
+from bigdl_tpu.optim.local_optimizer import (BaseOptimizer, validate,
+                                             _device_batch)
 from bigdl_tpu.optim.optim_method import clip_by_value
 from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
 from bigdl_tpu.parallel.zero import FlatParamSpace
@@ -239,7 +238,14 @@ class DistriOptimizer(BaseOptimizer):
         return x, t
 
     def _optimize_impl(self):
-        self._reshuffle_pending = False   # no stale flag from a prior run
+        if getattr(self, "_optim_methods_map", None):
+            raise NotImplementedError(
+                "set_optim_methods is incompatible with the dp+ZeRO-1 "
+                "step: its chunks slice the FLAT parameter vector across "
+                "devices, not per-submodule subtrees (reference "
+                "DistriOptimizer keeps per-submodule aggregation instead "
+                "of chunk ownership for this case); train with "
+                "LocalOptimizer or a model-parallel strategy")
         if jax.process_count() > 1:
             # record accounting multiplies the local batch by the process
             # count, which is only correct for host-sharded datasets whose
@@ -330,75 +336,50 @@ class DistriOptimizer(BaseOptimizer):
         step = wrap(opt_state_eval)
 
         batch_sharding = NamedSharding(self.mesh, P(self.axis))
-        epoch_size = self.dataset.size()
-        state = self.driver_state
-        batch = first_batch
-        while not self.end_trigger(state):
-            t0 = time.time()  # includes a deferred (unoverlapped) fetch
-            if batch is None:     # exotic trigger defeated the prediction
-                batch, train_iter = self._stage_next_batch(
-                    train_iter, state, 0, epoch_size, force=True)
+
+        def dispatch(batch):
+            nonlocal params_flat, mstate, opt_state
             x, target = self._shard_batch(batch, batch_sharding)
             params_flat, mstate, opt_state, loss = step(
                 params_flat, mstate, opt_state, x, target, RNG.next_key())
-            # host/device pipeline: stage the NEXT batch while the devices
-            # run this step; float(loss) below is the sync point.
-            # _shard_batch treats each host's minibatch as process-LOCAL
-            # (jax.make_array_from_process_local_data), so the records
-            # consumed globally per step = local batch x process count
-            # (reference driverState counts global records)
-            n = batch.size() * jax.process_count()
-            next_batch, train_iter = self._stage_next_batch(
-                train_iter, state, n, epoch_size)
-            loss = float(loss)
-            dt = time.time() - t0
-            state["loss"] = loss
-            state["record_count"] += n
-            state["throughput"] = n / max(dt, 1e-9)
-            self._log_progress(loss, state["throughput"])
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar(
-                    "Throughput", state["throughput"], state["neval"])
-            state["neval"] += 1
-            if state["record_count"] >= epoch_size:
-                state["epoch"] += 1
-                state["record_count"] = 0
-                if next_batch is None:   # fetch deferred past the reset:
-                    self._reshuffle_pending = True
+            return loss
 
-            if (self.validation_trigger is not None
-                    and self.validation_trigger(state)):
-                self._validate_distri(params_flat, flat_space, mstate, state)
-                opt_state = self._feed_plateau(state, opt_state)
-            if (self.checkpoint_trigger is not None
-                    and self.checkpoint_trigger(state)):
-                if getattr(self, "sharded_checkpoint_path", None):
-                    self._sharded_save(state["neval"], params_flat, mstate,
-                                       opt_state, state)
-                else:
-                    file_io.save_checkpoint(
-                        self.checkpoint_path, state["neval"],
-                        {"model_params_flat": params_flat}, mstate,
-                        opt_state, state)
+        def validate_cb():
+            # reference getModel + Evaluator: reassemble full weights,
+            # then eval (optim/DistriOptimizer.scala:645-695)
+            params_tree = jax.jit(flat_space.unflatten)(params_flat)
+            return validate(self.model, params_tree, mstate,
+                            self.validation_dataset,
+                            self.validation_methods, self.compute_dtype)
 
-            # next_batch None = deferred: the top-of-loop fetch runs only
-            # after the end trigger has decided training continues
-            batch = None if next_batch is PREDICTED_END else next_batch
+        def feed_plateau(state):
+            nonlocal opt_state
+            opt_state = self._feed_plateau(state, opt_state)
+
+        def checkpoint_cb(state):
+            if getattr(self, "sharded_checkpoint_path", None):
+                self._sharded_save(state["neval"], params_flat, mstate,
+                                   opt_state, state)
+            else:
+                file_io.save_checkpoint(
+                    self.checkpoint_path, state["neval"],
+                    {"model_params_flat": params_flat}, mstate,
+                    opt_state, state)
+
+        # _shard_batch treats each host's minibatch as process-LOCAL
+        # (jax.make_array_from_process_local_data), so the records
+        # consumed globally per step = local batch x process count
+        # (reference driverState counts global records)
+        self._run_driver_loop(
+            train_iter, first_batch, dispatch=dispatch,
+            records_of=lambda b: b.size() * jax.process_count(),
+            validate_cb=validate_cb, feed_plateau=feed_plateau,
+            checkpoint_cb=checkpoint_cb)
 
         params_tree = jax.jit(flat_space.unflatten)(params_flat)
         self.model.set_parameters(params_tree)
         self.model.set_state(mstate)
         return self.model
-
-    def _validate_distri(self, params_flat, flat_space, mstate, state):
-        """Reference getModel + Evaluator: reassemble full weights, then eval
-        (optim/DistriOptimizer.scala:645-695)."""
-        params_tree = jax.jit(flat_space.unflatten)(params_flat)
-        results = validate(self.model, params_tree, mstate,
-                           self.validation_dataset, self.validation_methods,
-                           self.compute_dtype)
-        return self._record_validation(results, state)
 
 
 class ParallelOptimizer(DistriOptimizer):

@@ -103,6 +103,23 @@ class BaseOptimizer:
         self.compute_dtype = dtype
         return self
 
+    def set_optim_methods(self, methods):
+        """One OptimMethod per named submodule (reference:
+        Optimizer.setOptimMethods, optim/Optimizer.scala:377).  Names
+        resolve anywhere in the module tree; together the subtrees must
+        cover every trainable parameter.  Resolved against the built
+        model at optimize() time (LocalOptimizer and the tp/sp/ep
+        strategies; the flat-chunk dp step and pipeline restructured
+        layouts raise)."""
+        self._optim_methods_map = dict(methods)
+        return self
+
+    def _resolve_optim_methods(self, params_tree):
+        if getattr(self, "_optim_methods_map", None):
+            from bigdl_tpu.optim.optim_method import build_composite_method
+            self.optim_method = build_composite_method(
+                self.model, params_tree, self._optim_methods_map)
+
     def resume_from_checkpoint(self, path: Optional[str] = None):
         """Reference resume semantics: Module.load + OptimMethod.load
         (models/lenet/Train.scala:48-69); iteration-accurate via driver state."""
@@ -243,6 +260,12 @@ class BaseOptimizer:
                 return self._optimize_impl()
             except KeyboardInterrupt:
                 raise
+            except (ValueError, TypeError, NotImplementedError):
+                # deterministic configuration/capability errors: a retry
+                # replays the identical failure after burning a restore
+                # cycle (and masks the message when no checkpoint exists
+                # yet) -- fail fast, mirroring _check_plateau_monitor
+                raise
             except Exception:
                 sharded = getattr(self, "sharded_checkpoint_path", None)
                 if retries_left <= 0 or (self.checkpoint_path is None
@@ -305,15 +328,88 @@ class BaseOptimizer:
             "Epoch %d [iteration %d] loss %.6f, %.1f records/s",
             s["epoch"], s["neval"], loss, throughput)
 
+    def _run_driver_loop(self, train_iter, first_batch, *, dispatch,
+                        records_of=None, extra_summaries=None,
+                        validate_cb=None, feed_plateau=None,
+                        checkpoint_cb=None):
+        """The ONE training driver loop shared by Local/Distri/Strategy
+        optimizers (they differ only in the step signature and how
+        batches reach the devices, injected via the callbacks).
+
+        Encodes the staging/trigger choreography that must not diverge:
+        the next batch is prefetched while the device executes the
+        current step (``float(loss)`` is the sync point), the end
+        trigger is evaluated exactly once per completed step, and the
+        fetch is DEFERRED past the trigger decision for stateful /
+        output-reading triggers (round-3 liveness fix -- an eager fetch
+        one batch past the end blocks forever on a stream dataset).
+
+        - ``dispatch(batch) -> device loss``: runs the step; owns the
+          params/opt_state closure.
+        - ``records_of(batch)``: global records this step (default
+          ``batch.size()``).
+        - ``extra_summaries(state)``: extra train-summary scalars
+          (called only when a summary is set, after Loss/Throughput).
+        - ``validate_cb() -> results``: validation results (recorded via
+          _record_validation); ``feed_plateau(state)`` then lets the
+          caller thread the Plateau schedule through its opt_state.
+        - ``checkpoint_cb(state)``: write a checkpoint.
+        """
+        self._reshuffle_pending = False   # no stale flag from a prior run
+        epoch_size = self.dataset.size()
+        state = self.driver_state
+        batch = first_batch
+        records_of = records_of or (lambda b: b.size())
+        while not self.end_trigger(state):
+            t0 = time.time()  # includes a deferred (unoverlapped) fetch
+            if batch is None:     # exotic trigger defeated the prediction
+                batch, train_iter = self._stage_next_batch(
+                    train_iter, state, 0, epoch_size, force=True)
+            loss_dev = dispatch(batch)
+            n = records_of(batch)
+            next_batch, train_iter = self._stage_next_batch(
+                train_iter, state, n, epoch_size)
+            loss = float(loss_dev)
+            dt = time.time() - t0
+            state["loss"] = loss
+            state["record_count"] += n
+            state["throughput"] = n / max(dt, 1e-9)
+            self._log_progress(loss, state["throughput"])
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss, state["neval"])
+                self.train_summary.add_scalar(
+                    "Throughput", state["throughput"], state["neval"])
+                if extra_summaries is not None:
+                    extra_summaries(state)
+            state["neval"] += 1
+            if state["record_count"] >= epoch_size:
+                state["epoch"] += 1
+                state["record_count"] = 0
+                if next_batch is None:   # fetch deferred past the reset:
+                    self._reshuffle_pending = True
+
+            if (self.validation_trigger is not None
+                    and self.validation_trigger(state)):
+                self._record_validation(validate_cb(), state)
+                if feed_plateau is not None:
+                    feed_plateau(state)
+            if (self.checkpoint_trigger is not None
+                    and self.checkpoint_trigger(state)):
+                checkpoint_cb(state)
+
+            # next_batch None = deferred: the top-of-loop fetch runs only
+            # after the end trigger has decided training continues
+            batch = None if next_batch is PREDICTED_END else next_batch
+
 
 class LocalOptimizer(BaseOptimizer):
     """Reference: optim/LocalOptimizer.scala:45."""
 
     def _optimize_impl(self):
-        self._reshuffle_pending = False   # no stale flag from a prior run
         train_iter = self.dataset.data(train=True)
         first_batch = next(train_iter)
         params, mstate = self._init_model(first_batch)
+        self._resolve_optim_methods(params)
         opt_state = self.optim_method.init_state(params)
 
         if getattr(self, "_resume", None):
@@ -328,69 +424,43 @@ class LocalOptimizer(BaseOptimizer):
             compute_dtype=self.compute_dtype, clip_value=self.clip_value,
             clip_norm=self.clip_norm), donate_argnums=(0, 1, 2))
 
-        epoch_size = self.dataset.size()
-        state = self.driver_state
-        batch = first_batch
-        # the end trigger is evaluated EXACTLY once per completed step
-        # (plus this entry check) -- stateful triggers like every_epoch
-        # consume their firing edge on evaluation
-        while not self.end_trigger(state):
-            t0 = time.time()  # includes a deferred (unoverlapped) fetch
-            if batch is None:     # exotic trigger defeated the prediction
-                batch, train_iter = self._stage_next_batch(
-                    train_iter, state, 0, epoch_size, force=True)
+        def dispatch(batch):
+            nonlocal params, mstate, opt_state
             x, target = _device_batch(batch)
             params, mstate, opt_state, loss = step(
                 params, mstate, opt_state, x, target, RNG.next_key())
-            # host/device pipeline: decode + stage the NEXT batch while the
-            # device executes this step -- the float(loss) below is the
-            # synchronization point (the reference overlaps the same way
-            # with its prefetch thread, MTLabeledBGRImgToBatch)
-            n = batch.size()
-            next_batch, train_iter = self._stage_next_batch(
-                train_iter, state, n, epoch_size)
-            loss = float(loss)
-            dt = time.time() - t0
-            state["loss"] = loss
-            state["record_count"] += n
-            state["throughput"] = n / max(dt, 1e-9)
-            self._log_progress(loss, state["throughput"])
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar(
-                    "Throughput", state["throughput"], state["neval"])
+            return loss
+
+        def extra_summaries(state):
+            rates = getattr(self.optim_method, "learning_rates", None)
+            if rates is not None:     # composite: one scalar per submodule
+                for name, lr in rates(opt_state).items():
+                    self.train_summary.add_scalar(
+                        f"LearningRate/{name}", float(lr), state["neval"])
+            else:
                 self.train_summary.add_scalar(
                     "LearningRate",
                     float(self.optim_method.get_learning_rate(opt_state)),
                     state["neval"])
-                self._histograms(params, state)
-            state["neval"] += 1
-            if state["record_count"] >= epoch_size:
-                state["epoch"] += 1
-                state["record_count"] = 0
-                if next_batch is None:   # fetch deferred past the reset:
-                    self._reshuffle_pending = True
+            self._histograms(params, state)
 
-            if (self.validation_trigger is not None
-                    and self.validation_trigger(state)):
-                self._validate(params, mstate, state)
-                opt_state = self._feed_plateau(state, opt_state)
-            if (self.checkpoint_trigger is not None
-                    and self.checkpoint_trigger(state)):
-                self._checkpoint(params, mstate, opt_state)
+        def feed_plateau(state):
+            nonlocal opt_state
+            opt_state = self._feed_plateau(state, opt_state)
 
-            # next_batch None = deferred: the top-of-loop fetch runs only
-            # after the end trigger has decided training continues
-            batch = None if next_batch is PREDICTED_END else next_batch
+        self._run_driver_loop(
+            train_iter, first_batch, dispatch=dispatch,
+            extra_summaries=extra_summaries,
+            validate_cb=lambda: validate(
+                self.model, params, mstate, self.validation_dataset,
+                self.validation_methods, self.compute_dtype),
+            feed_plateau=feed_plateau,
+            checkpoint_cb=lambda state: self._checkpoint(
+                params, mstate, opt_state))
 
         self.model.set_parameters(params)
         self.model.set_state(mstate)
         return self.model
-
-    def _validate(self, params, mstate, state):
-        results = validate(self.model, params, mstate, self.validation_dataset,
-                           self.validation_methods, self.compute_dtype)
-        return self._record_validation(results, state)
 
 
 def validate(model, params, mstate, dataset, methods, compute_dtype=None):

@@ -669,3 +669,147 @@ class Fused(OptimMethod):
 
 
 Fused._ELEMENTWISE = (SGD, Adam, Adagrad, Adadelta, RMSprop, Adamax, Ftrl)
+
+
+# --------------------------------------------------------------------------- #
+# per-submodule optimizer methods (reference: Optimizer.setOptimMethods)
+# --------------------------------------------------------------------------- #
+
+
+def _subtree(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _set_subtree(tree, path, value):
+    if not path:
+        return value
+    out = dict(tree)
+    out[path[0]] = _set_subtree(tree[path[0]], path[1:], value)
+    return out
+
+
+class CompositeOptimMethod(OptimMethod):
+    """One OptimMethod per model subtree (reference: Optimizer.
+    setOptimMethods, optim/Optimizer.scala:377 -- a Map[submoduleName,
+    OptimMethod] applied to disjoint slices of the parameter vector).
+
+    ``assignments``: list of (path, method) where ``path`` is a tuple of
+    parameter-tree keys addressing the submodule's param subtree.  Build
+    via :func:`build_composite_method`, which resolves submodule NAMES
+    the way the reference does (Optimizer.scala:492 checkSubModules:
+    every name must resolve, own trainable parameters, and not overlap)
+    and additionally requires full coverage -- an uncovered subtree
+    would silently never train.
+    """
+
+    def __init__(self, assignments):
+        #: [(submodule name, param-tree path, method)]
+        self.assignments = [(n, tuple(p), m) for n, p, m in assignments]
+
+    def init_state(self, params):
+        return {"/".join(p): m.init_state(_subtree(params, p))
+                for _, p, m in self.assignments}
+
+    def update(self, grads, state, params):
+        new_params = params
+        new_state = dict(state)
+        for _, path, method in self.assignments:
+            key = "/".join(path)
+            sub_p, sub_s = method.update(
+                _subtree(grads, path), state[key], _subtree(params, path))
+            new_params = _set_subtree(new_params, path, sub_p)
+            new_state[key] = sub_s
+        return new_params, new_state
+
+    def get_learning_rate(self, state):
+        """First assignment's LR (the single-scalar facade); the driver
+        loops additionally log one LearningRate/<name> scalar per
+        assignment via learning_rates()."""
+        _, path, method = self.assignments[0]
+        return method.get_learning_rate(state["/".join(path)])
+
+    def learning_rates(self, state):
+        """{submodule name: lr} for per-assignment summary scalars."""
+        return {n: m.get_learning_rate(state["/".join(p)])
+                for n, p, m in self.assignments}
+
+
+def build_composite_method(model, params, methods):
+    """Resolve {submodule name -> OptimMethod} against a built model.
+
+    Mirrors the reference checks (Optimizer.scala:492): every name must
+    resolve to exactly one submodule with trainable parameters; subtrees
+    must be disjoint; and together they must cover every trainable leaf.
+    """
+    import jax
+
+    def find_paths(module, sub_params, name, prefix=()):
+        """Walk via each container's own params<->children alignment
+        (_param_child_items: Sequential keys by child index, Graph by
+        topo index, MapTable shares the child's tree) -- the same walk
+        frozen_param_mask uses, so names resolve on every container
+        family."""
+        hits = []
+        items = (module._param_child_items(sub_params)
+                 if hasattr(module, "_param_child_items")
+                 and isinstance(sub_params, dict) else [])
+        for key, child in items:
+            if key is None:      # shared child: params ARE the child's
+                if getattr(child, "name", None) == name:
+                    hits.append(prefix)
+                hits += find_paths(child, sub_params, name, prefix)
+                continue
+            if key not in sub_params:
+                continue
+            if getattr(child, "name", None) == name:
+                hits.append(prefix + (key,))
+            hits += find_paths(child, sub_params[key], name,
+                               prefix + (key,))
+        return hits
+
+    assignments = []
+    for name, method in methods.items():
+        sched = getattr(method, "schedule", None)
+        if sched is not None and hasattr(sched, "record"):
+            raise ValueError(
+                "set_optim_methods: a Plateau-style schedule inside a "
+                f"per-submodule method ({name!r}) would never receive "
+                "the monitored metric (the driver feeds the TOP-LEVEL "
+                "method's schedule only); attach Plateau to a single "
+                "global method instead")
+        paths = find_paths(model, params, name)
+        if not paths:
+            raise ValueError(
+                f"set_optim_methods: no submodule named {name!r} in "
+                f"{type(model).__name__} (name= your layers at "
+                "construction)")
+        if len(paths) > 1:
+            raise ValueError(
+                f"set_optim_methods: {name!r} is ambiguous "
+                f"({len(paths)} submodules carry that name)")
+        sub = _subtree(params, paths[0])
+        if not any(jnp.issubdtype(l.dtype, jnp.floating)
+                   for l in jax.tree.leaves(sub)):
+            raise ValueError(
+                f"set_optim_methods: {name!r} has no trainable "
+                "parameters")
+        assignments.append((name, paths[0], method))
+
+    for i, (_, a, _) in enumerate(assignments):
+        for _, b, _ in assignments[i + 1:]:
+            if a[:len(b)] == b or b[:len(a)] == a:
+                raise ValueError(
+                    f"set_optim_methods: subtrees {'/'.join(a)} and "
+                    f"{'/'.join(b)} overlap")
+
+    covered = sum(len(jax.tree.leaves(_subtree(params, p)))
+                  for _, p, _ in assignments)
+    total = len(jax.tree.leaves(params))
+    if covered != total:
+        raise ValueError(
+            f"set_optim_methods: the named submodules cover {covered} of "
+            f"{total} parameter leaves; every trainable submodule needs a "
+            "method (an uncovered subtree would silently never train)")
+    return CompositeOptimMethod(assignments)

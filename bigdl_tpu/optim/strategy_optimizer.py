@@ -27,15 +27,13 @@ optimizer state over the flat parameter plane and handles BN state).
 """
 
 import logging
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from bigdl_tpu.optim.local_optimizer import (BaseOptimizer, PREDICTED_END,
-                                             validate)
+from bigdl_tpu.optim.local_optimizer import BaseOptimizer, validate
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.random_generator import RNG
@@ -312,17 +310,20 @@ class StrategyOptimizer(BaseOptimizer):
         return totals
 
     # ----- driver loop ----------------------------------------------------- #
-    # NOTE: this loop mirrors LocalOptimizer._optimize_impl's staging /
-    # trigger / summary choreography (incl. the round-3 deferred-fetch
-    # liveness fix) with a strategy step signature; keep the two in sync
-    # when touching either.
 
     def _optimize_impl(self):
-        self._reshuffle_pending = False
         train_iter = self.dataset.data(train=True)
         first_batch = next(train_iter)
         params_tree, _ = self._init_model(first_batch)
         self._check_stateless()
+        if getattr(self, "_optim_methods_map", None):
+            if self.strategy == "pp":
+                raise NotImplementedError(
+                    "set_optim_methods addresses the model's own tree; "
+                    "pipeline layouts restructure it (stage-stacked / "
+                    "per-stage subtrees) -- use tp/sp/ep or the local "
+                    "path for per-submodule methods")
+            self._resolve_optim_methods(params_tree)
         step, params, opt_state, place, finalize = self._prepare(
             params_tree, first_batch)
 
@@ -336,65 +337,49 @@ class StrategyOptimizer(BaseOptimizer):
                 snap["opt_state"], opt_state)
             self.driver_state.update(snap["driver_state"])
 
-        epoch_size = self.dataset.size()
-        state = self.driver_state
-        batch = first_batch
-        while not self.end_trigger(state):
-            t0 = time.time()
-            if batch is None:
-                batch, train_iter = self._stage_next_batch(
-                    train_iter, state, 0, epoch_size, force=True)
+        def dispatch(batch):
+            nonlocal params, opt_state
             x = jax.tree.map(place, batch.get_input())
             y = jax.tree.map(place, batch.get_target())
             params, opt_state, loss = step(params, opt_state, x, y,
                                            RNG.next_key())
-            n = batch.size()
-            next_batch, train_iter = self._stage_next_batch(
-                train_iter, state, n, epoch_size)
-            loss = float(loss)
-            dt = time.time() - t0
-            state["loss"] = loss
-            state["record_count"] += n
-            state["throughput"] = n / max(dt, 1e-9)
-            self._log_progress(loss, state["throughput"])
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar(
-                    "Throughput", state["throughput"], state["neval"])
+            return loss
+
+        def extra_summaries(state):
+            rates = getattr(self.optim_method, "learning_rates", None)
+            if rates is not None:     # composite: one scalar per submodule
+                for name, lr in rates(opt_state).items():
+                    self.train_summary.add_scalar(
+                        f"LearningRate/{name}", float(lr), state["neval"])
+            else:
                 self.train_summary.add_scalar(
                     "LearningRate",
                     float(self.optim_method.get_learning_rate(opt_state)),
                     state["neval"])
-                # histograms over the strategy-native tree (pp: stacked)
-                self._histograms(params, state)
-            state["neval"] += 1
-            if state["record_count"] >= epoch_size:
-                state["epoch"] += 1
-                state["record_count"] = 0
-                if next_batch is None:
-                    self._reshuffle_pending = True
+            # histograms over the strategy-native tree (pp: stacked)
+            self._histograms(params, state)
 
-            if (self.validation_trigger is not None
-                    and self.validation_trigger(state)):
-                if self.strategy == "sp":
-                    # the model's attention binds the seq mesh axis, so
-                    # plain-jit validate() cannot run it (unbound axis);
-                    # evaluate under the same shard_map topology instead
-                    results = self._validate_sp(params, place)
-                else:
-                    results = validate(self.model, finalize(params), (),
-                                       self.validation_dataset,
-                                       self.validation_methods,
-                                       self.compute_dtype)
-                self._record_validation(results, state)
-                opt_state = self._feed_plateau(state, opt_state)
-            if (self.checkpoint_trigger is not None
-                    and self.checkpoint_trigger(state)):
-                file_io.save_checkpoint(
-                    self.checkpoint_path, state["neval"],
-                    params, (), opt_state, state)
+        def validate_cb():
+            if self.strategy == "sp":
+                # the model's attention binds the seq mesh axis, so
+                # plain-jit validate() cannot run it (unbound axis);
+                # evaluate under the same shard_map topology instead
+                return self._validate_sp(params, place)
+            return validate(self.model, finalize(params), (),
+                            self.validation_dataset,
+                            self.validation_methods, self.compute_dtype)
 
-            batch = None if next_batch is PREDICTED_END else next_batch
+        def feed_plateau(state):
+            nonlocal opt_state
+            opt_state = self._feed_plateau(state, opt_state)
+
+        self._run_driver_loop(
+            train_iter, first_batch, dispatch=dispatch,
+            extra_summaries=extra_summaries, validate_cb=validate_cb,
+            feed_plateau=feed_plateau,
+            checkpoint_cb=lambda state: file_io.save_checkpoint(
+                self.checkpoint_path, state["neval"],
+                params, (), opt_state, state))
 
         final = finalize(params)
         self.model.set_parameters(final)

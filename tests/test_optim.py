@@ -413,3 +413,185 @@ class TestRegularizers:
         np.testing.assert_allclose(
             float(regularization_loss(back, back.parameters()[0])),
             float(regularization_loss(m, m.parameters()[0])), rtol=1e-6)
+
+
+class TestPerSubmoduleOptimMethods:
+    """Reference: Optimizer.setOptimMethods (optim/Optimizer.scala:377)
+    -- one OptimMethod per named submodule, resolved with the reference's
+    checkSubModules rules (names exist, trainable, disjoint) plus full
+    coverage."""
+
+    def _model(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.utils.random_generator import RNG
+        RNG.set_seed(0)
+        m = (nn.Sequential()
+             .add(nn.Sequential(name="features")
+                  .add(nn.Linear(8, 16)).add(nn.ReLU()))
+             .add(nn.Sequential(name="classifier")
+                  .add(nn.Linear(16, 4))))
+        m.build(jax.ShapeDtypeStruct((2, 8), jnp.float32))
+        return m
+
+    def _data(self):
+        rng = np.random.default_rng(0)
+        return (rng.standard_normal((8, 8)).astype(np.float32),
+                rng.integers(0, 4, 8).astype(np.int32))
+
+    def test_distinct_methods_apply_per_subtree(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.optim import LocalOptimizer, Trigger
+
+        x, y = self._data()
+        m = self._model()
+        before = jax.tree.map(np.asarray, m._params)
+        opt = LocalOptimizer(
+            m, array_dataset(x, y) >> SampleToMiniBatch(8),
+            nn.CrossEntropyCriterion())
+        # classifier frozen via lr=0 SGD; features on a real lr
+        opt.set_optim_methods({
+            "features": optim.SGD(learning_rate=0.5),
+            "classifier": optim.SGD(learning_rate=0.0)})
+        opt.set_end_when(Trigger.max_iteration(2))
+        opt.optimize()
+        moved = np.abs(np.asarray(m._params["0"]["0"]["weight"])
+                       - before["0"]["0"]["weight"]).max()
+        held = np.abs(np.asarray(m._params["1"]["0"]["weight"])
+                      - before["1"]["0"]["weight"]).max()
+        assert moved > 1e-4 and held == 0.0, (moved, held)
+
+    def test_composite_equals_single_when_methods_match(self):
+        """Same method everywhere == one global method, bit-exact."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.optim import LocalOptimizer, Trigger
+
+        x, y = self._data()
+
+        def run(split):
+            m = self._model()
+            opt = LocalOptimizer(
+                m, array_dataset(x, y) >> SampleToMiniBatch(8),
+                nn.CrossEntropyCriterion(),
+                None if split else optim.SGD(learning_rate=0.2,
+                                             momentum=0.9, dampening=0.0))
+            if split:
+                opt.set_optim_methods({
+                    "features": optim.SGD(learning_rate=0.2, momentum=0.9,
+                                          dampening=0.0),
+                    "classifier": optim.SGD(learning_rate=0.2, momentum=0.9,
+                                            dampening=0.0)})
+            opt.set_end_when(Trigger.max_iteration(3))
+            opt.optimize()
+            return m._params
+
+        a, b = run(False), run(True)
+        for l1, l2 in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+    def test_reference_checks(self):
+        import pytest
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.optim import LocalOptimizer, Trigger
+        from bigdl_tpu.optim.optim_method import build_composite_method
+
+        x, y = self._data()
+        m = self._model()
+        with pytest.raises(ValueError, match="no submodule named"):
+            build_composite_method(m, m._params, {"nope": optim.SGD()})
+        with pytest.raises(ValueError, match="cover"):
+            build_composite_method(m, m._params,
+                                   {"features": optim.SGD()})
+        # dp flat-chunk path refuses loudly
+        from bigdl_tpu.optim import DistriOptimizer
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:8]).reshape(8,), ("data",))
+        opt = DistriOptimizer(
+            self._model(), array_dataset(x, y) >> SampleToMiniBatch(8),
+            nn.CrossEntropyCriterion(), mesh=mesh)
+        opt.set_optim_methods({"features": optim.SGD()})
+        opt.set_end_when(Trigger.max_iteration(1))
+        with pytest.raises(NotImplementedError, match="FLAT parameter"):
+            opt.optimize()
+
+    def test_pipeline_strategy_refuses_composite(self):
+        import pytest
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.nn.attention import TransformerLM
+        from bigdl_tpu.optim import Optimizer, Trigger
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(0)
+        m = TransformerLM(64, 32, 4, num_layers=4, max_len=32)
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 64, (4, 16)).astype(np.int32)
+        y = rng.integers(0, 64, (4, 16)).astype(np.int32)
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(2, 4), ("data", "pipe"))
+        opt = Optimizer(m, array_dataset(x, y) >> SampleToMiniBatch(4),
+                        nn.TimeDistributedCriterion(
+                            nn.CrossEntropyCriterion()),
+                        optim.SGD(), strategy="pp", mesh=mesh,
+                        n_microbatches=2)
+        opt.set_optim_methods({"whatever": optim.SGD()})
+        opt.set_end_when(Trigger.max_iteration(1))
+        with pytest.raises(NotImplementedError, match="stage-stacked"):
+            opt.optimize()
+
+    def test_graph_container_name_resolution(self):
+        """Names resolve through Graph containers too (params keyed by
+        topo index, not child position -- the walk rides each
+        container's own _param_child_items)."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.graph import Graph, Input, Node
+        from bigdl_tpu.optim.optim_method import (_subtree,
+                                                  build_composite_method)
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(0)
+        inp = Input()
+        h = Node(nn.Linear(8, 8, name="enc"), [inp])
+        o = Node(nn.Linear(8, 4, name="head"), [h])
+        g = Graph([inp], [o])
+        g.build(jax.ShapeDtypeStruct((2, 8), jnp.float32))
+        comp = build_composite_method(
+            g, g._params, {"enc": optim.SGD(learning_rate=0.0),
+                           "head": optim.SGD(learning_rate=0.0)})
+        by_name = {n: p for n, p, _ in comp.assignments}
+        enc_sub = _subtree(g._params, by_name["enc"])
+        head_sub = _subtree(g._params, by_name["head"])
+        assert enc_sub["weight"].shape == (8, 8)
+        assert head_sub["weight"].shape == (4, 8)
+
+    def test_plateau_inside_composite_rejected(self):
+        import pytest
+        from bigdl_tpu.optim.optim_method import build_composite_method
+        m = self._model()
+        with pytest.raises(ValueError, match="Plateau"):
+            build_composite_method(
+                m, m._params,
+                {"features": optim.SGD(
+                    learning_rate_schedule=optim.Plateau()),
+                 "classifier": optim.SGD()})
+
+    def test_config_error_not_retried(self, tmp_path):
+        """Deterministic config errors must escape the failure-retry loop
+        immediately, even with a checkpoint configured."""
+        import pytest
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.optim import LocalOptimizer, Trigger
+
+        x, y = self._data()
+        m = self._model()
+        opt = LocalOptimizer(
+            m, array_dataset(x, y) >> SampleToMiniBatch(8),
+            nn.CrossEntropyCriterion())
+        opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        opt.set_optim_methods({"nope": optim.SGD()})
+        opt.set_end_when(Trigger.max_iteration(1))
+        with pytest.raises(ValueError, match="no submodule named"):
+            opt.optimize()      # one shot -- no retry/restore masking
