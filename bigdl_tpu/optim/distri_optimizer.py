@@ -238,8 +238,9 @@ class DistriOptimizer(BaseOptimizer):
         return x, t
 
     def _optimize_impl(self):
+        from bigdl_tpu.utils.errors import UnsupportedFeatureError
         if getattr(self, "_optim_methods_map", None):
-            raise NotImplementedError(
+            raise UnsupportedFeatureError(
                 "set_optim_methods is incompatible with the dp+ZeRO-1 "
                 "step: its chunks slice the FLAT parameter vector across "
                 "devices, not per-submodule subtrees (reference "

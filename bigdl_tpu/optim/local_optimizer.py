@@ -22,6 +22,8 @@ from bigdl_tpu.optim.train_step import make_eval_step, make_train_step
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.utils import file_io
+from bigdl_tpu.utils.errors import (ConfigurationError,
+                                    UnsupportedFeatureError)
 from bigdl_tpu.utils.random_generator import RNG
 from bigdl_tpu.utils.shape import spec_of
 
@@ -108,17 +110,38 @@ class BaseOptimizer:
         Optimizer.setOptimMethods, optim/Optimizer.scala:377).  Names
         resolve anywhere in the module tree; together the subtrees must
         cover every trainable parameter.  Resolved against the built
-        model at optimize() time (LocalOptimizer and the tp/sp/ep
-        strategies; the flat-chunk dp step and pipeline restructured
-        layouts raise)."""
+        model at optimize() time (LocalOptimizer and the sp strategy;
+        the flat-chunk dp step, the pipeline restructured layouts and
+        the sharded-state tp/ep paths refuse loudly)."""
         self._optim_methods_map = dict(methods)
         return self
 
     def _resolve_optim_methods(self, params_tree):
         if getattr(self, "_optim_methods_map", None):
             from bigdl_tpu.optim.optim_method import build_composite_method
+            sched = getattr(self.optim_method, "schedule", None)
+            if sched is not None and hasattr(sched, "record"):
+                raise ConfigurationError(
+                    "set_optim_methods replaces the constructor's "
+                    "optim_method, whose Plateau-style schedule would "
+                    "silently never fire; drop one of the two")
             self.optim_method = build_composite_method(
                 self.model, params_tree, self._optim_methods_map)
+
+    def _log_learning_rates(self, opt_state, state):
+        """LearningRate summary scalars: one per submodule for composite
+        methods, a single scalar otherwise (shared by the Local and
+        Strategy extra_summaries callbacks)."""
+        rates = getattr(self.optim_method, "learning_rates", None)
+        if rates is not None:
+            for name, lr in rates(opt_state).items():
+                self.train_summary.add_scalar(
+                    f"LearningRate/{name}", float(lr), state["neval"])
+        else:
+            self.train_summary.add_scalar(
+                "LearningRate",
+                float(self.optim_method.get_learning_rate(opt_state)),
+                state["neval"])
 
     def resume_from_checkpoint(self, path: Optional[str] = None):
         """Reference resume semantics: Module.load + OptimMethod.load
@@ -260,11 +283,13 @@ class BaseOptimizer:
                 return self._optimize_impl()
             except KeyboardInterrupt:
                 raise
-            except (ValueError, TypeError, NotImplementedError):
+            except (ConfigurationError, UnsupportedFeatureError):
                 # deterministic configuration/capability errors: a retry
                 # replays the identical failure after burning a restore
                 # cycle (and masks the message when no checkpoint exists
-                # yet) -- fail fast, mirroring _check_plateau_monitor
+                # yet) -- fail fast, mirroring _check_plateau_monitor.
+                # Plain ValueError/RuntimeError stay retryable: a flaky
+                # remote read mid-epoch is exactly what the loop is for.
                 raise
             except Exception:
                 sharded = getattr(self, "sharded_checkpoint_path", None)
@@ -432,16 +457,7 @@ class LocalOptimizer(BaseOptimizer):
             return loss
 
         def extra_summaries(state):
-            rates = getattr(self.optim_method, "learning_rates", None)
-            if rates is not None:     # composite: one scalar per submodule
-                for name, lr in rates(opt_state).items():
-                    self.train_summary.add_scalar(
-                        f"LearningRate/{name}", float(lr), state["neval"])
-            else:
-                self.train_summary.add_scalar(
-                    "LearningRate",
-                    float(self.optim_method.get_learning_rate(opt_state)),
-                    state["neval"])
+            self._log_learning_rates(opt_state, state)
             self._histograms(params, state)
 
         def feed_plateau(state):

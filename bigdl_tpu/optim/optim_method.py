@@ -676,6 +676,9 @@ Fused._ELEMENTWISE = (SGD, Adam, Adagrad, Adadelta, RMSprop, Adamax, Ftrl)
 # --------------------------------------------------------------------------- #
 
 
+from bigdl_tpu.utils.errors import ConfigurationError
+
+
 def _subtree(tree, path):
     for k in path:
         tree = tree[k]
@@ -773,7 +776,7 @@ def build_composite_method(model, params, methods):
     for name, method in methods.items():
         sched = getattr(method, "schedule", None)
         if sched is not None and hasattr(sched, "record"):
-            raise ValueError(
+            raise ConfigurationError(
                 "set_optim_methods: a Plateau-style schedule inside a "
                 f"per-submodule method ({name!r}) would never receive "
                 "the monitored metric (the driver feeds the TOP-LEVEL "
@@ -781,18 +784,18 @@ def build_composite_method(model, params, methods):
                 "global method instead")
         paths = find_paths(model, params, name)
         if not paths:
-            raise ValueError(
+            raise ConfigurationError(
                 f"set_optim_methods: no submodule named {name!r} in "
                 f"{type(model).__name__} (name= your layers at "
                 "construction)")
         if len(paths) > 1:
-            raise ValueError(
+            raise ConfigurationError(
                 f"set_optim_methods: {name!r} is ambiguous "
                 f"({len(paths)} submodules carry that name)")
         sub = _subtree(params, paths[0])
         if not any(jnp.issubdtype(l.dtype, jnp.floating)
                    for l in jax.tree.leaves(sub)):
-            raise ValueError(
+            raise ConfigurationError(
                 f"set_optim_methods: {name!r} has no trainable "
                 "parameters")
         assignments.append((name, paths[0], method))
@@ -800,7 +803,7 @@ def build_composite_method(model, params, methods):
     for i, (_, a, _) in enumerate(assignments):
         for _, b, _ in assignments[i + 1:]:
             if a[:len(b)] == b or b[:len(a)] == a:
-                raise ValueError(
+                raise ConfigurationError(
                     f"set_optim_methods: subtrees {'/'.join(a)} and "
                     f"{'/'.join(b)} overlap")
 
@@ -808,7 +811,7 @@ def build_composite_method(model, params, methods):
                   for _, p, _ in assignments)
     total = len(jax.tree.leaves(params))
     if covered != total:
-        raise ValueError(
+        raise ConfigurationError(
             f"set_optim_methods: the named submodules cover {covered} of "
             f"{total} parameter leaves; every trainable submodule needs a "
             "method (an uncovered subtree would silently never train)")

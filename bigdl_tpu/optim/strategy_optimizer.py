@@ -121,6 +121,36 @@ class StrategyOptimizer(BaseOptimizer):
                 f"{sorted(unknown)}; accepted options: "
                 f"{sorted(_STRATEGY_KW[strategy])}")
         self.strategy_kw = dict(strategy_kw)
+        if strategy == "pp":
+            # everything below is a pure function of the configuration --
+            # validate at construction, before the failure-retry loop
+            import bigdl_tpu.nn as nn_pkg
+            from bigdl_tpu.utils.errors import UnsupportedFeatureError
+            schedule = strategy_kw.get("schedule", "gpipe")
+            if schedule not in ("gpipe", "1f1b"):
+                raise ValueError(f"unknown pp schedule {schedule!r}; "
+                                 "expected 'gpipe' or '1f1b'")
+            is_sequential = isinstance(model, nn_pkg.Sequential)
+            if is_sequential and (schedule != "gpipe"
+                                  or strategy_kw.get("tensor_parallel",
+                                                     False)):
+                raise UnsupportedFeatureError(
+                    "pipelined Sequential models run the heterogeneous "
+                    "GPipe engine; schedule='1f1b' and tensor_parallel "
+                    "are only available for stage-stacked transformer "
+                    "models")
+            if not is_sequential \
+                    and strategy_kw.get("boundaries") is not None:
+                raise TypeError(
+                    "boundaries= applies to Sequential (heterogeneous) "
+                    "pipelining; stage-stacked transformer models split "
+                    "evenly by block count")
+            if schedule == "1f1b" and strategy_kw.get("tensor_parallel",
+                                                      False):
+                raise UnsupportedFeatureError(
+                    "pp schedule='1f1b' does not compose with "
+                    "tensor_parallel yet; use the default gpipe "
+                    "schedule for the 3-D mesh")
 
     # ----- strategy wiring ------------------------------------------------- #
 
@@ -128,10 +158,11 @@ class StrategyOptimizer(BaseOptimizer):
         """tp/pp/sp/ep steps run the model with empty mutable state; a
         model carrying running statistics (BatchNorm) must train on the
         dp path, which averages that state across shards."""
+        from bigdl_tpu.utils.errors import UnsupportedFeatureError
         state = self.model.state()
         if any(jnp.issubdtype(getattr(l, "dtype", jnp.int32), jnp.floating)
                for l in jax.tree.leaves(state)):
-            raise NotImplementedError(
+            raise UnsupportedFeatureError(
                 f"strategy={self.strategy!r} trains with empty module "
                 "state, but this model carries floating state (e.g. "
                 "BatchNorm running stats); train it data-parallel "
@@ -194,31 +225,14 @@ class StrategyOptimizer(BaseOptimizer):
                                            data_axis=self.data_axis)
             return step, params, opt_state, place, identity
 
-        # pp
+        # pp (cross-engine option validation happened at construction)
         import bigdl_tpu.nn as nn_pkg
         pipe_axis = kw.get("pipe_axis", "pipe")
         n_stages = self.mesh.shape[pipe_axis]
         n_micro = kw.get("n_microbatches", n_stages)
         schedule = kw.get("schedule", "gpipe")
-        if schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"unknown pp schedule {schedule!r}; "
-                             "expected 'gpipe' or '1f1b'")
-        is_sequential = isinstance(m, nn_pkg.Sequential)
-        # options bound to one engine are config errors on the other, per
-        # this module's no-silent-no-op contract
-        if is_sequential and (schedule != "gpipe"
-                              or kw.get("tensor_parallel", False)):
-            raise NotImplementedError(
-                "pipelined Sequential models run the heterogeneous GPipe "
-                "engine; schedule='1f1b' and tensor_parallel are only "
-                "available for stage-stacked transformer models")
-        if not is_sequential and kw.get("boundaries") is not None:
-            raise TypeError(
-                "boundaries= applies to Sequential (heterogeneous) "
-                "pipelining; stage-stacked transformer models split "
-                "evenly by block count")
 
-        if is_sequential:
+        if isinstance(m, nn_pkg.Sequential):
             # arbitrary (uneven, heterogeneous) Sequential: lax.switch
             # stage bodies + padded flat ring (parallel/pp_het.py)
             from bigdl_tpu.parallel.pp_het import (make_het_pp_train_step,
@@ -265,14 +279,10 @@ class StrategyOptimizer(BaseOptimizer):
         manual = (tuple(a for a in (self.data_axis, pipe_axis) if a)
                   if tensor_parallel else None)
         if schedule == "1f1b":
-            if tensor_parallel or self.compute_dtype is not None:
-                raise NotImplementedError(
-                    "pp schedule='1f1b' does not compose with "
-                    "tensor_parallel or compute_dtype yet; use the "
-                    "default gpipe schedule for those")
             step = make_pp_1f1b_train_step(
                 m, crit, meth, mesh, n_microbatches=n_micro,
-                pipe_axis=pipe_axis, data_axis=self.data_axis)
+                pipe_axis=pipe_axis, data_axis=self.data_axis,
+                compute_dtype=self.compute_dtype)
         else:
             step = make_pp_train_step(
                 m, crit, meth, mesh, n_microbatches=n_micro,
@@ -317,12 +327,20 @@ class StrategyOptimizer(BaseOptimizer):
         params_tree, _ = self._init_model(first_batch)
         self._check_stateless()
         if getattr(self, "_optim_methods_map", None):
+            from bigdl_tpu.utils.errors import UnsupportedFeatureError
             if self.strategy == "pp":
-                raise NotImplementedError(
+                raise UnsupportedFeatureError(
                     "set_optim_methods addresses the model's own tree; "
                     "pipeline layouts restructure it (stage-stacked / "
-                    "per-stage subtrees) -- use tp/sp/ep or the local "
-                    "path for per-submodule methods")
+                    "per-stage subtrees) -- use sp or the local path "
+                    "for per-submodule methods")
+            if self.strategy in ("tp", "ep"):
+                raise UnsupportedFeatureError(
+                    "set_optim_methods on the tp/ep paths would fall "
+                    "back to REPLICATED optimizer state (the sharded "
+                    "init matches the single-method state layout only), "
+                    "multiplying optimizer HBM by the mesh size; use sp "
+                    "or the local path for per-submodule methods")
             self._resolve_optim_methods(params_tree)
         step, params, opt_state, place, finalize = self._prepare(
             params_tree, first_batch)
@@ -346,16 +364,7 @@ class StrategyOptimizer(BaseOptimizer):
             return loss
 
         def extra_summaries(state):
-            rates = getattr(self.optim_method, "learning_rates", None)
-            if rates is not None:     # composite: one scalar per submodule
-                for name, lr in rates(opt_state).items():
-                    self.train_summary.add_scalar(
-                        f"LearningRate/{name}", float(lr), state["neval"])
-            else:
-                self.train_summary.add_scalar(
-                    "LearningRate",
-                    float(self.optim_method.get_learning_rate(opt_state)),
-                    state["neval"])
+            self._log_learning_rates(opt_state, state)
             # histograms over the strategy-native tree (pp: stacked)
             self._histograms(params, state)
 

@@ -260,7 +260,8 @@ def make_pp_train_step(model, criterion, optim_method, mesh,
 
 def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
                             n_microbatches: int, pipe_axis: str = "pipe",
-                            data_axis: Optional[str] = None):
+                            data_axis: Optional[str] = None,
+                            compute_dtype=None):
     """GPipe-equivalent gradients with the 1F1B (PipeDream-flush) schedule
     and a BOUNDED activation stash.
 
@@ -299,9 +300,15 @@ def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
 
     def per_device(pp_params, x, y, rng):
         # x, y: (M, mb, T) int tokens on this device's data shard
+        from bigdl_tpu.optim.train_step import _cast_params
+        cdt = compute_dtype or jnp.float32
         stage = lax.axis_index(pipe_axis)
-        sp = jax.tree.map(lambda a: a[0], pp_params["stages"])
-        emb, tail = pp_params["embed"], pp_params["tail"]
+        # slice the stage dim BEFORE the cast so the rank>=2 rule sees
+        # true per-leaf ranks (stacked biases stay fp32 masters)
+        sp = _cast_params(jax.tree.map(lambda a: a[0],
+                                       pp_params["stages"]), compute_dtype)
+        emb = _cast_params(pp_params["embed"], compute_dtype)
+        tail = _cast_params(pp_params["tail"], compute_dtype)
         n_micro, mb, t = x.shape
         d_model = emb["wte"].shape[1]
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
@@ -325,10 +332,15 @@ def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
             # the backward recompute reuses the same key by construction
             return child_rng(child_rng(rng, 7), m + stage)
 
+        # fp32 gradient accumulators shaped like the UNCAST master params
+        # (the per-tick vjp cotangents arrive in the compute dtype and are
+        # upcast on accumulation -- the same master-grad semantics the
+        # GPipe path gets from differentiating through its cast)
         zeros_g = {
-            "embed": jax.tree.map(jnp.zeros_like, emb),
-            "stages": jax.tree.map(jnp.zeros_like, sp),
-            "tail": jax.tree.map(jnp.zeros_like, tail),
+            "embed": jax.tree.map(jnp.zeros_like, pp_params["embed"]),
+            "stages": jax.tree.map(
+                lambda a: jnp.zeros_like(a[0]), pp_params["stages"]),
+            "tail": jax.tree.map(jnp.zeros_like, pp_params["tail"]),
         }
 
         def tick(carry, tk):
@@ -354,7 +366,7 @@ def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
             loss_acc = loss_acc + jnp.where(take_loss, loss_m, 0.0)
             gacc = dict(gacc)
             gacc["tail"] = jax.tree.map(
-                lambda a, g: a + jnp.where(take_loss, g, 0.0),
+                lambda a, g: a + jnp.where(take_loss, g, 0.0).astype(a.dtype),
                 gacc["tail"], dtail_m)
             seeds = seeds.at[mf_i % 2].set(
                 jnp.where(take_loss, seed_m, seeds[mf_i % 2]))
@@ -371,7 +383,7 @@ def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
             _, stage_vjp = jax.vjp(stage_both, sp, xin)
             dsp, dx = stage_vjp(gin)
             gacc["stages"] = jax.tree.map(
-                lambda a, g: a + jnp.where(mb_ok, g, 0.0),
+                lambda a, g: a + jnp.where(mb_ok, g, 0.0).astype(a.dtype),
                 gacc["stages"], dsp)
 
             # stage 0 consumes dx into the embedding instead of the ring
@@ -381,7 +393,7 @@ def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
             (demb,) = emb_vjp(dx)
             take_emb = mb_ok & (stage == 0)
             gacc["embed"] = jax.tree.map(
-                lambda a, g: a + jnp.where(take_emb, g, 0.0),
+                lambda a, g: a + jnp.where(take_emb, g, 0.0).astype(a.dtype),
                 gacc["embed"], demb)
 
             fwd_recv = lax.ppermute(out, pipe_axis, fwd_perm)
@@ -389,10 +401,10 @@ def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
             return (fwd_recv, bwd_recv, stash, seeds, gacc, loss_acc), None
 
         init = (
-            jnp.zeros((mb, t, d_model), jnp.float32),
-            jnp.zeros((mb, t, d_model), jnp.float32),
-            jnp.zeros((W, mb, t, d_model), jnp.float32),
-            jnp.zeros((2, mb, t, d_model), jnp.float32),
+            jnp.zeros((mb, t, d_model), cdt),
+            jnp.zeros((mb, t, d_model), cdt),
+            jnp.zeros((W, mb, t, d_model), cdt),
+            jnp.zeros((2, mb, t, d_model), cdt),
             zeros_g,
             jnp.zeros((), jnp.float32),
         )

@@ -1,0 +1,18 @@
+"""Deterministic configuration/capability errors.
+
+The failure-retry loop (BaseOptimizer.optimize, reference retryNum
+semantics) restores the last checkpoint and retries on RUNTIME failures;
+these two classes mark errors that are deterministic functions of the
+configuration -- retrying would replay the identical failure after
+burning a restore cycle, so the loop re-raises them immediately.  They
+subclass the builtin types the call sites historically raised, so
+callers matching ValueError/NotImplementedError keep working.
+"""
+
+
+class ConfigurationError(ValueError):
+    """A setting that can never work (bad name, uncovered subtree, ...)."""
+
+
+class UnsupportedFeatureError(NotImplementedError):
+    """A valid-looking combination this engine deliberately refuses."""

@@ -595,3 +595,50 @@ class TestPerSubmoduleOptimMethods:
         opt.set_end_when(Trigger.max_iteration(1))
         with pytest.raises(ValueError, match="no submodule named"):
             opt.optimize()      # one shot -- no retry/restore masking
+
+    def test_sharded_state_strategies_refuse_composite(self):
+        """tp/ep would silently fall back to replicated optimizer state
+        under a composite method; they refuse instead."""
+        import pytest
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.nn.attention import TransformerLM
+        from bigdl_tpu.optim import Optimizer, Trigger
+        from bigdl_tpu.utils.random_generator import RNG
+
+        RNG.set_seed(0)
+        m = TransformerLM(64, 32, 4, 2, max_len=32)
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 64, (4, 16)).astype(np.int32)
+        y = rng.integers(0, 64, (4, 16)).astype(np.int32)
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(4, 2), ("data", "model"))
+        opt = Optimizer(m, array_dataset(x, y) >> SampleToMiniBatch(4),
+                        nn.TimeDistributedCriterion(
+                            nn.CrossEntropyCriterion()),
+                        optim.SGD(), strategy="tp", mesh=mesh)
+        opt.set_optim_methods({"whatever": optim.SGD()})
+        opt.set_end_when(Trigger.max_iteration(1))
+        with pytest.raises(NotImplementedError, match="REPLICATED"):
+            opt.optimize()
+
+    def test_global_plateau_discard_rejected(self):
+        import pytest
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.optim import LocalOptimizer, Trigger
+
+        x, y = self._data()
+        m = self._model()
+        opt = LocalOptimizer(
+            m, array_dataset(x, y) >> SampleToMiniBatch(8),
+            nn.CrossEntropyCriterion(),
+            optim.SGD(learning_rate_schedule=optim.Plateau()))
+        opt.set_optim_methods({"features": optim.SGD(),
+                               "classifier": optim.SGD()})
+        opt.set_end_when(Trigger.max_iteration(1))
+        opt.set_validation(Trigger.several_iteration(1),
+                           array_dataset(x, y) >> SampleToMiniBatch(8),
+                           [optim.Loss(nn.CrossEntropyCriterion())])
+        with pytest.raises(ValueError, match="silently never fire"):
+            opt.optimize()

@@ -367,3 +367,31 @@ class Test1F1BSchedule:
         with pytest.raises(TypeError, match="boundaries"):
             Optimizer(lm, dslm, critlm, optim.SGD(), strategy="pp",
                       mesh=mesh, boundaries=[1])._prepare(lm._params, None)
+
+    def test_1f1b_bf16_tracks_gpipe_bf16(self):
+        """compute_dtype=bf16 composes with the 1F1B schedule; loss
+        tracks the bf16 GPipe step (same cast points, same schedule
+        semantics) and master params/grads stay fp32."""
+        from bigdl_tpu.parallel.pp import (init_pp_opt_state,
+                                           make_pp_1f1b_train_step,
+                                           make_pp_train_step, pp_shardings,
+                                           stack_stage_params)
+        mesh = pipe_mesh()
+        x, y = tokens(8, 16, seed=13)
+
+        def run(make):
+            model, crit, method = self._setup(seed=13)
+            pp = stack_stage_params(model, 4)
+            pp = jax.tree.map(jax.device_put, pp, pp_shardings(pp, mesh))
+            opt_state = init_pp_opt_state(method, pp, mesh)
+            step = make(model, crit, method, mesh, n_microbatches=2,
+                        data_axis="data", compute_dtype=jnp.bfloat16)
+            new_pp, _, loss = step(pp, opt_state, jnp.asarray(x),
+                                   jnp.asarray(y), jax.random.key(0))
+            assert all(l.dtype == jnp.float32
+                       for l in jax.tree.leaves(new_pp))
+            return float(loss)
+
+        loss_g = run(make_pp_train_step)
+        loss_f = run(make_pp_1f1b_train_step)
+        assert abs(loss_f - loss_g) / abs(loss_g) < 5e-3, (loss_f, loss_g)
