@@ -77,3 +77,9 @@ class TestExamples:
     def test_keras_backend(self):
         pytest.importorskip("keras")
         _run("keras_backend")
+
+    @pytest.mark.parametrize("strategy", ["tp", "sp", "pp", "pp-cnn"])
+    def test_strategy_parallel(self, monkeypatch, strategy):
+        _run("strategy_parallel",
+             patched_argv=["--strategy", strategy, "--maxIteration", "1"],
+             monkeypatch=monkeypatch)
