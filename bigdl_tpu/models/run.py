@@ -53,15 +53,15 @@ def _to_dataset(x, y, batch):
 
 
 def _build_optimizer(args, model, train_ds, val_ds, criterion, method,
-                     val_methods):
+                     val_methods, strategy_kw=None):
     import bigdl_tpu.nn as nn  # noqa: F401  (registers layers for load)
     from bigdl_tpu.optim import Optimizer, Trigger
     from bigdl_tpu.utils.engine import Engine
 
     Engine.init()
+    route = strategy_kw or {"distributed": args.distributed}
     opt = Optimizer(model=model, dataset=train_ds, criterion=criterion,
-                    optim_method=method,
-                    distributed=args.distributed)
+                    optim_method=method, **route)
     opt.set_end_when(Trigger.max_epoch(args.max_epoch)
                      if args.max_iteration is None
                      else Trigger.max_iteration(args.max_iteration))
@@ -295,53 +295,58 @@ def cmd_transformer_train(args):
     # elsewhere (ops/cross_entropy.py)
     crit = nn.TimeDistributedCriterion(nn.FusedSoftmaxCrossEntropyCriterion())
 
-    if args.sp > 1:
-        from bigdl_tpu.parallel.sequence import make_sp_train_step
+    if args.sp > 1 and args.pp > 1:
+        raise ValueError("pick ONE of --sp / --pp (compose them in code "
+                         "via parallel.pp_tp_shardings on a 3-D mesh)")
+    if args.sp > 1 or args.pp > 1:
         from bigdl_tpu.utils.engine import Engine
-        from bigdl_tpu.utils.random_generator import RNG
 
+        from bigdl_tpu.models.transformer import CONFIGS
+
+        deg = args.sp if args.sp > 1 else args.pp
         n_dev = jax.device_count()
-        data_deg = n_dev // max(args.sp, 1)
+        data_deg = n_dev // deg
+        layers = CONFIGS[args.size][2]
         problems = []
-        if n_dev % args.sp:
-            problems.append(f"device count {n_dev} % sp {args.sp} != 0")
-        if seq % args.sp:
+        if n_dev % deg:
+            problems.append(f"device count {n_dev} % degree {deg} != 0")
+        if args.sp > 1 and seq % args.sp:
             problems.append(f"--seq-len {seq} % sp {args.sp} != 0")
+        if args.pp > 1 and layers % args.pp:
+            problems.append(f"--size {args.size} has {layers} "
+                            f"blocks, not divisible into {args.pp} stages")
+        if args.pp > 1 and args.batch % args.pp:
+            problems.append(f"--batchSize {args.batch} % {args.pp} "
+                            f"microbatches != 0")
+        if (args.pp > 1 and args.batch % args.pp == 0
+                and data_deg and (args.batch // args.pp) % data_deg):
+            problems.append(f"microbatch {args.batch // args.pp} % "
+                            f"data-parallel degree {data_deg} != 0")
         if data_deg and args.batch % data_deg:
             problems.append(f"--batchSize {args.batch} % data-parallel "
                             f"degree {data_deg} != 0")
         if problems:
-            raise ValueError("sequence-parallel shape requirements: "
+            raise ValueError("model-parallel shape requirements: "
                              + "; ".join(problems))
-        for attr, flag in (("checkpoint", "--checkpoint"),
-                           ("summary_dir", "--summaryDir")):
-            if getattr(args, attr, None):
-                print(f"warning: {flag} is not supported with --sp yet; "
-                      f"ignored")
-        mesh = Engine.build_mesh((data_deg, args.sp), ("data", "seq"))
+        axis = "seq" if args.sp > 1 else "pipe"
+        mesh = Engine.build_mesh((data_deg, deg), ("data", axis))
         model = transformer_lm(args.size, vocab, max_len=seq,
-                               seq_axis_name="seq")
-        model.build(jax.ShapeDtypeStruct((args.batch, seq // args.sp),
-                                         jnp.int32))
-        params = model.parameters()[0]
-        method = optim.Adam(learning_rate=args.lr)
-        opt_state = method.init_state(params)
-        step = make_sp_train_step(model, crit, method, mesh,
-                                  data_axis="data")
+                               seq_axis_name="seq" if args.sp > 1 else None)
+        strategy_kw = {"strategy": "sp" if args.sp > 1 else "pp",
+                       "mesh": mesh}
+        if args.pp > 1:
+            strategy_kw.update(n_microbatches=args.pp,
+                               schedule=args.pp_schedule)
         # full batches only: shard_map needs the batch axis divisible
         n_full = (len(x) // args.batch) * args.batch
         if n_full == 0:
             raise ValueError(f"--synthN {len(x)} < --batchSize {args.batch}")
         x, y = x[:n_full], y[:n_full]
-        steps = args.max_iteration if args.max_iteration is not None \
-            else args.max_epoch * (len(x) // args.batch)
-        for i in range(steps):
-            lo = (i * args.batch) % len(x)
-            bx = jnp.asarray(x[lo:lo + args.batch])
-            by = jnp.asarray(y[lo:lo + args.batch])
-            params, opt_state, loss = step(params, opt_state, bx, by,
-                                           RNG.next_key())
-            print(f"step {i + 1}/{steps} loss {float(loss):.4f}")
+        opt = _build_optimizer(args, model, _to_dataset(x, y, args.batch),
+                               None, crit,
+                               optim.Adam(learning_rate=args.lr), [],
+                               strategy_kw=strategy_kw)
+        opt.optimize()
         return
 
     model = transformer_lm(args.size, vocab, max_len=seq)
@@ -402,7 +407,13 @@ def main(argv=None):
                              choices=["tiny", "small", "medium", "large"])),
              ("--sp", dict(type=int, default=1,
                            help="sequence-parallel degree (ring attention "
-                                "over a data x seq mesh)"))]),
+                                "over a data x seq mesh)")),
+             ("--pp", dict(type=int, default=1,
+                           help="pipeline-parallel stages (data x pipe "
+                                "mesh; microbatches = stages)")),
+             ("--pp-schedule", dict(default="gpipe",
+                                    choices=["gpipe", "1f1b"],
+                                    dest="pp_schedule"))]),
     }
     for name, (fn, epochs, extra) in specs.items():
         p = sub.add_parser(name)
@@ -413,6 +424,8 @@ def main(argv=None):
         if name == "resnet-imagenet-train":
             # recipe defaults (models/resnet/README.md:131-149)
             p.set_defaults(lr=0.1)
+        if name == "transformer-train":
+            p.set_defaults(lr=1e-3)      # Adam-scale default
 
     args = parser.parse_args(argv)
     args.fn(args)

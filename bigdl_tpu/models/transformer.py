@@ -15,22 +15,25 @@ import numpy as np
 from bigdl_tpu.nn.attention import TransformerLM
 
 
+#: size -> (hidden, heads, layers); the single source of truth the CLI's
+#: pipeline-stage validation reads too
+CONFIGS = {
+    "tiny":  (256,   4,    4),
+    "small": (768,  12,   12),
+    "medium": (1024, 16,  24),
+    "large": (1536, 16,   36),
+}
+
+
 def transformer_lm(size: str = "tiny", vocab_size: int = 32000,
                    max_len: int = 2048,
                    seq_axis_name: Optional[str] = None,
                    seq_mode: str = "ring") -> TransformerLM:
     """Named configs; 'tiny'/'small' fit a chip's HBM comfortably, larger
     sizes pair with tp/pp/sp shardings."""
-    configs = {
-        #        hidden heads layers
-        "tiny":  (256,   4,    4),
-        "small": (768,  12,   12),
-        "medium": (1024, 16,  24),
-        "large": (1536, 16,   36),
-    }
-    if size not in configs:
-        raise ValueError(f"unknown size {size!r}; pick from {list(configs)}")
-    hidden, heads, layers = configs[size]
+    if size not in CONFIGS:
+        raise ValueError(f"unknown size {size!r}; pick from {list(CONFIGS)}")
+    hidden, heads, layers = CONFIGS[size]
     return TransformerLM(vocab_size, hidden, heads, layers, max_len=max_len,
                          seq_axis_name=seq_axis_name, seq_mode=seq_mode)
 

@@ -18,9 +18,9 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+from bigdl_tpu.utils.config import honor_env_platforms  # noqa: E402
+
+honor_env_platforms()
 
 
 def main():

@@ -203,3 +203,14 @@ class TestTransformerFamily:
         run.main(["transformer-train", "--sp", "4", "--maxIteration", "2",
                   "--synthN", "32", "--vocab", "32", "--seq-len", "16",
                   "-b", "8", "--learningRate", "0.003"])
+
+    def test_cli_pp_path(self):
+        """transformer-train --pp routes through the strategy facade
+        (gpipe and 1f1b schedules) with the full builder surface."""
+        from bigdl_tpu.models import run
+
+        for schedule in ("gpipe", "1f1b"):
+            run.main(["transformer-train", "--pp", "4",
+                      "--pp-schedule", schedule, "--maxIteration", "2",
+                      "--synthN", "32", "--vocab", "32", "--seq-len", "16",
+                      "-b", "8"])
