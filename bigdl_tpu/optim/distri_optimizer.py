@@ -40,15 +40,6 @@ from bigdl_tpu.utils.shape import spec_of
 log = logging.getLogger("bigdl_tpu.optim")
 
 
-def _abs_local(path):
-    """Absolute path for plain local paths (orbax requirement); remote
-    URL-schemed paths (gs://, hdfs://) pass through untouched."""
-    import os
-
-    return path if "://" in str(path) else os.path.abspath(path)
-
-
-
 def make_distri_train_step(model, criterion, optim_method, flat_space,
                            mesh, axis="data", compute_dtype=None,
                            clip_value=None, clip_norm=None,
@@ -193,32 +184,8 @@ class DistriOptimizer(BaseOptimizer):
         self.grad_compression = dtype
         return self
 
-    def set_sharded_checkpoint(self, path, trigger):
-        """Orbax sharded snapshots: every device/host writes its own
-        parameter + optimizer-state shards, no gather to one host.  The
-        reference must reassemble full weights on the driver before each
-        save (getModel, optim/DistriOptimizer.scala:645-695); at TPU pod
-        scale the flat vector may not fit one host, so the sharded path is
-        the big-model checkpoint story (SURVEY.md hard-parts: orbax-style
-        sharded checkpoint alongside the protobuf compat format)."""
-        self.sharded_checkpoint_path = _abs_local(path)
-        self.checkpoint_trigger = trigger
-        return self
-
-    def resume_from_sharded_checkpoint(self, path=None):
-        base = _abs_local(path or self.sharded_checkpoint_path)
-        snaps = [d for d in file_io.listdir(base)
-                 if d.startswith("snap_") and d.split("_")[1].isdigit()
-                 # a crash between the orbax finalize and the driver-state
-                 # sidecar write leaves an unusable snapshot: skip it so
-                 # retry/resume falls back to the previous complete one
-                 and file_io.exists(file_io.join(base, d) + ".driver")]
-        if not snaps:
-            return self
-        latest = max(snaps, key=lambda d: int(d.split("_")[1]))
-        self._resume_sharded = file_io.join(base, latest)
-        log.info("Resuming from sharded snapshot %s", self._resume_sharded)
-        return self
+    #: flat-plane orbax snapshots (set_sharded_checkpoint on BaseOptimizer)
+    _supports_sharded_checkpoint = True
 
     def _sharded_save(self, neval, params_flat, mstate, opt_state, state):
         import orbax.checkpoint as ocp
@@ -295,12 +262,14 @@ class DistriOptimizer(BaseOptimizer):
 
         if getattr(self, "_resume", None):
             snap = self._resume
-            params_flat = jnp.asarray(snap["model_params_flat"])
+            # save_checkpoint nests the 3rd argument under "model_params"
+            params_flat = jnp.asarray(
+                snap["model_params"]["model_params_flat"])
             mstate = jax.tree.map(jnp.asarray, snap["model_state"])
             opt_state = jax.tree.map(
                 lambda l, s: jax.device_put(jnp.asarray(l), s),
                 snap["opt_state"], opt_shardings)
-            self.driver_state.update(snap["driver_state"])
+            self._apply_driver_state(snap["driver_state"])
 
         if getattr(self, "_resume_sharded", None):
             import orbax.checkpoint as ocp
@@ -323,7 +292,7 @@ class DistriOptimizer(BaseOptimizer):
             params_flat = restored["params_flat"]
             mstate = restored["mstate"]
             opt_state = restored["opt_state"]
-            self.driver_state.update(file_io.load(d + ".driver"))
+            self._apply_driver_state(file_io.load(d + ".driver"))
             # consumed: a later failure-retry must re-resolve the LATEST
             # snapshot, not replay this one
             self._resume_sharded = None

@@ -152,6 +152,39 @@ class StrategyOptimizer(BaseOptimizer):
                     "tensor_parallel yet; use the default gpipe "
                     "schedule for the 3-D mesh")
 
+    # ----- sharded checkpoints (orbax; surface on BaseOptimizer) ----------- #
+    #: snapshots are of the STRATEGY-NATIVE trees (tp/ep-sharded,
+    #: pp-stage-stacked)
+    _supports_sharded_checkpoint = True
+
+    def _sharded_save(self, neval, params, opt_state, state):
+        import orbax.checkpoint as ocp
+
+        d = file_io.join(self.sharded_checkpoint_path, f"snap_{neval}")
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(d, {"params": params, "opt_state": opt_state},
+                       force=True)
+        file_io.save(dict(state), d + ".driver")
+
+    def _sharded_restore(self, params, opt_state):
+        """-> (params, opt_state) restored with the PREPARED shardings
+        (the abstract tree comes from the live strategy layout, so shards
+        land where the mesh expects them)."""
+        import orbax.checkpoint as ocp
+
+        d = self._resume_sharded
+        abstract = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                           sharding=l.sharding),
+            {"params": params, "opt_state": opt_state})
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(d, abstract)
+        self._apply_driver_state(file_io.load(d + ".driver"))
+        # consumed: a later failure-retry must re-resolve the LATEST
+        # snapshot, not replay this one
+        self._resume_sharded = None
+        return restored["params"], restored["opt_state"]
+
     # ----- strategy wiring ------------------------------------------------- #
 
     def _check_stateless(self):
@@ -353,7 +386,9 @@ class StrategyOptimizer(BaseOptimizer):
             opt_state = jax.tree.map(
                 lambda l, s: jax.device_put(jnp.asarray(l), s.sharding),
                 snap["opt_state"], opt_state)
-            self.driver_state.update(snap["driver_state"])
+            self._apply_driver_state(snap["driver_state"])
+        if getattr(self, "_resume_sharded", None):
+            params, opt_state = self._sharded_restore(params, opt_state)
 
         def dispatch(batch):
             nonlocal params, opt_state
@@ -382,13 +417,18 @@ class StrategyOptimizer(BaseOptimizer):
             nonlocal opt_state
             opt_state = self._feed_plateau(state, opt_state)
 
+        def checkpoint_cb(state):
+            if getattr(self, "sharded_checkpoint_path", None):
+                self._sharded_save(state["neval"], params, opt_state, state)
+            else:
+                file_io.save_checkpoint(
+                    self.checkpoint_path, state["neval"],
+                    params, (), opt_state, state)
+
         self._run_driver_loop(
             train_iter, first_batch, dispatch=dispatch,
             extra_summaries=extra_summaries, validate_cb=validate_cb,
-            feed_plateau=feed_plateau,
-            checkpoint_cb=lambda state: file_io.save_checkpoint(
-                self.checkpoint_path, state["neval"],
-                params, (), opt_state, state))
+            feed_plateau=feed_plateau, checkpoint_cb=checkpoint_cb)
 
         final = finalize(params)
         self.model.set_parameters(final)

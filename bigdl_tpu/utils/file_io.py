@@ -90,6 +90,12 @@ def save(obj: Any, path: str):
         pickle.dump(_to_numpy(obj), f)
 
 
+def abs_local(path: str) -> str:
+    """Absolute path for plain local paths (orbax requirement); remote
+    URL-schemed paths (gs://, hdfs://) pass through untouched."""
+    return path if "://" in str(path) else os.path.abspath(path)
+
+
 def load(path: str) -> Any:
     with open_file(path, "rb") as f:
         return pickle.load(f)

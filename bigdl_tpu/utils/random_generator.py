@@ -44,6 +44,20 @@ class RandomGenerator:
             self._key, sub = jax.random.split(self._key)
             return sub
 
+    def get_state(self):
+        """Serializable stream position (checkpoints carry it so a resumed
+        run draws the same key sequence as an uninterrupted one)."""
+        import numpy as np
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
+            return np.asarray(jax.random.key_data(self._key))
+
+    def set_state(self, data) -> "RandomGenerator":
+        with self._lock:
+            self._key = jax.random.wrap_key_data(jax.numpy.asarray(data))
+        return self
+
     def uniform(self, shape, low=0.0, high=1.0, dtype="float32"):
         return jax.random.uniform(
             self.next_key(), shape, minval=low, maxval=high, dtype=dtype

@@ -377,3 +377,51 @@ class TestShardedCheckpoint:
                                            optim.Trigger.max_epoch(3)))
         opt.optimize()
         assert len(shuffles) >= 2, shuffles    # reshuffled between epochs
+
+
+class TestDistriPlainCheckpointResume:
+    """Regression: the pickle-checkpoint resume path read the flat params
+    from the wrong snapshot level and ALWAYS raised KeyError (the
+    failure-retry loop then masked the original error)."""
+
+    def test_resume_bit_exact(self, tmp_path):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu import optim
+        from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+        from bigdl_tpu.models.lenet import LeNet5
+        from bigdl_tpu.optim import DistriOptimizer, Trigger
+        from bigdl_tpu.utils.random_generator import RNG
+
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:8]).reshape(8,), ("data",))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 28, 28, 1)).astype(np.float32)
+        y = rng.integers(1, 11, 16).astype(np.int32)
+
+        def fresh():
+            RNG.set_seed(5)
+            m = LeNet5()
+            ds = array_dataset(x, y) >> SampleToMiniBatch(16)
+            return m, DistriOptimizer(m, ds, nn.ClassNLLCriterion(),
+                                      optim.SGD(learning_rate=0.05,
+                                                momentum=0.9,
+                                                dampening=0.0), mesh=mesh)
+
+        m2, straight = fresh()
+        straight.set_end_when(Trigger.max_iteration(2))
+        straight.optimize()
+
+        _, first = fresh()
+        first.set_end_when(Trigger.max_iteration(1))
+        first.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        first.optimize()
+
+        mr, resumed = fresh()
+        resumed.set_end_when(Trigger.max_iteration(2))
+        resumed.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        resumed.resume_from_checkpoint()
+        resumed.optimize()
+        for a, b in zip(jax.tree.leaves(m2._params),
+                        jax.tree.leaves(mr._params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
