@@ -19,23 +19,19 @@ metrics are kept instead (SURVEY.md section 5).
 """
 
 import logging
-from functools import partial
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from bigdl_tpu.optim.local_optimizer import (BaseOptimizer, validate,
-                                             _device_batch)
+from bigdl_tpu.optim.local_optimizer import BaseOptimizer, validate
 from bigdl_tpu.optim.optim_method import clip_by_value
 from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
 from bigdl_tpu.parallel.zero import FlatParamSpace
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.random_generator import RNG
-from bigdl_tpu.utils.shape import spec_of
 
 log = logging.getLogger("bigdl_tpu.optim")
 

@@ -9,7 +9,6 @@ ZeRO-1 path lives in optim/distri_optimizer.py; they compose in later
 rounds via chunking over the data axis).
 """
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -10,7 +10,7 @@ sub-layer, which GSPMD derives automatically from these annotations.
 """
 
 import re
-from typing import Dict, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
