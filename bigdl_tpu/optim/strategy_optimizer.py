@@ -145,12 +145,6 @@ class StrategyOptimizer(BaseOptimizer):
                     "boundaries= applies to Sequential (heterogeneous) "
                     "pipelining; stage-stacked transformer models split "
                     "evenly by block count")
-            if schedule == "1f1b" and strategy_kw.get("tensor_parallel",
-                                                      False):
-                raise UnsupportedFeatureError(
-                    "pp schedule='1f1b' does not compose with "
-                    "tensor_parallel yet; use the default gpipe "
-                    "schedule for the 3-D mesh")
 
     # ----- sharded checkpoints (orbax; surface on BaseOptimizer) ----------- #
     #: snapshots are of the STRATEGY-NATIVE trees (tp/ep-sharded,
@@ -315,7 +309,7 @@ class StrategyOptimizer(BaseOptimizer):
             step = make_pp_1f1b_train_step(
                 m, crit, meth, mesh, n_microbatches=n_micro,
                 pipe_axis=pipe_axis, data_axis=self.data_axis,
-                compute_dtype=self.compute_dtype)
+                compute_dtype=self.compute_dtype, manual_axes=manual)
         else:
             step = make_pp_train_step(
                 m, crit, meth, mesh, n_microbatches=n_micro,

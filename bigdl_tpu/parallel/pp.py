@@ -261,6 +261,7 @@ def make_pp_train_step(model, criterion, optim_method, mesh,
 def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
                             n_microbatches: int, pipe_axis: str = "pipe",
                             data_axis: Optional[str] = None,
+                            manual_axes: Optional[tuple] = None,
                             compute_dtype=None):
     """GPipe-equivalent gradients with the 1F1B (PipeDream-flush) schedule
     and a BOUNDED activation stash.
@@ -429,12 +430,20 @@ def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
         return loss, grads
 
     batch_spec = P(None, data_axis) if data_axis else P()
+    smap_kwargs = {}
+    if manual_axes is not None:
+        # axes not listed (a tensor-parallel "model" axis on a 3-D mesh)
+        # stay automatic: GSPMD partitions the per-stage math and the
+        # per-stage vjp from the argument shardings (pp_tp_shardings),
+        # exactly as on the GPipe path
+        smap_kwargs["axis_names"] = frozenset(manual_axes)
     smapped = jax.shard_map(
         per_device, mesh=mesh,
         in_specs=({"embed": P(), "stages": P(pipe_axis), "tail": P()},
                   batch_spec, batch_spec, P()),
         out_specs=(P(), {"embed": P(), "stages": P(pipe_axis), "tail": P()}),
         check_vma=False,
+        **smap_kwargs,
     )
 
     def step(pp_params, opt_state, x, y, rng):
