@@ -404,7 +404,6 @@ class Test1F1BSchedule:
         from bigdl_tpu.optim import Optimizer, Trigger
         mesh = Mesh(np.asarray(jax.devices()).reshape(2, 2, 2),
                     ("data", "pipe", "model"))
-        model, crit, _ = self._setup(num_layers=2, seed=17)
         x, y = tokens(4, 16, seed=17)
         ref_params, ref_loss = self._single_device_step(17, x, y,
                                                         num_layers=2)
@@ -426,3 +425,40 @@ class Test1F1BSchedule:
                             jax.tree.leaves(model._params[k])):
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=2e-3, atol=2e-5)
+
+    def test_het_cnn_bf16_compute_dtype(self):
+        """The heterogeneous pipeline honors compute_dtype: bf16 ring
+        buffers/stage math, fp32 master params, finite matching loss."""
+        from bigdl_tpu.parallel.pp_het import make_het_pp_train_step
+        mesh = pipe_mesh()
+        RNG.set_seed(23)
+        m = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+             .add(nn.ReLU())
+             .add(nn.SpatialConvolution(8, 8, 3, 3, 1, 1, 1, 1))
+             .add(nn.ReLU())
+             .add(nn.Flatten())
+             .add(nn.Linear(8 * 8 * 8, 10)))
+        m.build(jax.ShapeDtypeStruct((4, 8, 8, 3), jnp.float32))
+        crit = nn.CrossEntropyCriterion()
+        rng = np.random.default_rng(23)
+        x = rng.standard_normal((8, 8, 8, 3)).astype(np.float32)
+        y = rng.integers(0, 10, 8).astype(np.int32)
+
+        def f32_ref(p):
+            out, _ = m.apply(p, m._state, jnp.asarray(x), training=True,
+                             rng=jax.random.key(0))
+            return crit.apply(out.astype(jnp.float32), jnp.asarray(y))
+        ref = float(jax.jit(f32_ref)(m._params))
+
+        method = optim.SGD(learning_rate=0.1)
+        spec = jax.ShapeDtypeStruct((2, 8, 8, 3), jnp.float32)
+        step, sp = make_het_pp_train_step(
+            m, crit, method, mesh, n_microbatches=2, input_spec=spec,
+            data_axis="data", compute_dtype=jnp.bfloat16)
+        new_sp, _, loss = step(sp, method.init_state(sp), jnp.asarray(x),
+                               jnp.asarray(y), jax.random.key(0))
+        # bf16 tracks fp32 within mixed-precision tolerance
+        assert abs(float(loss) - ref) / abs(ref) < 5e-2, (float(loss), ref)
+        assert all(l.dtype == jnp.float32
+                   for l in jax.tree.leaves(new_sp))
