@@ -236,18 +236,14 @@ def _bench_one(batch, steps, remat=False, s2d=False, fused=False):
             trace_witness = {"error": repr(e)[:200]}   # measurement
 
     imgs_per_sec = batch / sec_per_step
-    # bf16 peak FLOP/s by device kind; CPU: meaningless, use 1 TF.
+    # bf16 peak FLOP/s by device kind -- the ONE table, shared with the
+    # telemetry/report MFU math so the two can never disagree.  Any
+    # non-TPU platform gets the nominal 1 TF peak (previously only CPU
+    # did): MFU off-TPU is not chip-meaningful, and the validity guard
+    # below flags it rather than reporting against an invented peak
+    from bigdl_tpu.observability import peak_flops
     kind = getattr(dev, "device_kind", "") or ""
-    if platform == "cpu":
-        peak = 1e12
-    elif "v6" in kind:
-        peak = 918e12
-    elif "v5p" in kind:
-        peak = 459e12
-    elif "v4" in kind:
-        peak = 275e12
-    else:  # v5e and unknown TPUs: assume v5e (197 TFLOP/s bf16)
-        peak = 197e12
+    peak = peak_flops(dev)
     mfu = (flops_per_step / sec_per_step) / peak
     mfu_fetch = (flops_per_step / sec_per_step_fetch) / peak
 

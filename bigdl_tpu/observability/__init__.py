@@ -1,0 +1,30 @@
+"""Unified training/inference observability.
+
+Three layers over the one shared driver loop:
+
+- ``StepTelemetry`` -- structured per-step JSONL events (split
+  wall/data-wait/device timers, loss, records/s, memory stats) plus a
+  run header with the compiled step's flops (``telemetry.py``).
+- ``SpanTracer`` / ``span`` -- host-side chrome-trace spans, Perfetto-
+  viewable alongside the device xplane traces (``spans.py``).
+- ``RecompileWatchdog`` / ``MemoryWatchdog`` -- WARNING-level detectors
+  for silent per-step recompiles and monotonic device-memory growth
+  (``watchdogs.py``).
+
+``tools/obs_report.py`` merges a run's JSONL + xplane trace into one
+report; the event schema is documented in ``docs/observability.md``.
+"""
+
+from bigdl_tpu.observability.spans import SpanTracer, span
+from bigdl_tpu.observability.telemetry import (StepTelemetry,
+                                               device_memory_stats,
+                                               peak_flops)
+from bigdl_tpu.observability.watchdogs import (MemoryWatchdog,
+                                               RecompileWatchdog,
+                                               backend_compile_count)
+
+__all__ = [
+    "StepTelemetry", "SpanTracer", "span", "RecompileWatchdog",
+    "MemoryWatchdog", "backend_compile_count", "device_memory_stats",
+    "peak_flops",
+]

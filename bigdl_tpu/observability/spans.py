@@ -1,0 +1,159 @@
+"""Host-side span tracer writing chrome-trace (catapult) JSON.
+
+The device side already has first-class traces: ``jax.profiler.trace``
+writes xplane protos that ``utils/xplane.py`` can read back.  What the
+host loop does between dispatches -- batch staging, the deferred fetch,
+validation, checkpoint writes -- was invisible.  ``SpanTracer`` records
+those stages as complete ("X") events in the chrome-trace JSON *array*
+format, so one Perfetto tab can show the host timeline next to the
+device planes.
+
+Events stream straight to disk (no in-memory accumulation -- a
+multi-day run records millions of spans).  ``close()`` terminates the
+JSON array; a crash leaves an unterminated array, which Perfetto
+accepts by spec and ``tools/obs_report.py`` repairs on read.
+
+Usage::
+
+    tracer = SpanTracer(path)          # or via StepTelemetry(out_dir)
+    with tracer:                       # makes it the ambient tracer
+        with span("stage_batch"):      # module-level: ambient or no-op
+            ...
+
+The module-level ``span(name)`` is what library code uses: it records
+into the innermost active tracer, and costs a no-op context manager
+when none is active -- instrumentation points stay in place without a
+telemetry dependency.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+#: innermost-last stack of active tracers (``span()`` targets [-1])
+_ACTIVE = []
+_ACTIVE_LOCK = threading.Lock()
+
+
+def span(name, **args):
+    """Record ``name`` in the ambient tracer; no-op when none is active."""
+    with _ACTIVE_LOCK:
+        tracer = _ACTIVE[-1] if _ACTIVE else None
+    if tracer is None:
+        return contextlib.nullcontext()
+    return tracer.span(name, **args)
+
+
+class SpanTracer:
+    """Streaming chrome-trace JSON writer for host-side stage spans.
+
+    Timestamps are microseconds from tracer creation (``perf_counter``
+    based, monotonic); the wall-clock origin rides on the leading
+    ``wall_time_origin`` instant event so reports can align the trace
+    with JSONL event timestamps.
+    """
+
+    def __init__(self, path, process_name="bigdl_tpu host"):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._origin = time.perf_counter()
+        self._origin_wall = time.time()
+        self._lock = threading.Lock()
+        self._thread_seen = set()
+        self._n = 0
+        self._closed = False
+        self._f = open(path, "w")
+        self._f.write("[\n")
+        pid = os.getpid()
+        self._emit({"name": "process_name", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"name": process_name}})
+        self._emit({"name": "wall_time_origin", "ph": "i", "s": "g",
+                    "ts": 0, "pid": pid, "tid": 0,
+                    "args": {"wall_time_origin": self._origin_wall}})
+
+    def _now_us(self):
+        return (time.perf_counter() - self._origin) * 1e6
+
+    def _emit(self, ev):
+        """Append one event to the stream (comma BEFORE each event after
+        the first, so the array needs only ``]`` to be valid JSON)."""
+        with self._lock:
+            if self._closed:
+                return
+            tid = ev.get("tid", 0)
+            if tid and tid not in self._thread_seen:
+                self._thread_seen.add(tid)
+                self._write({"name": "thread_name", "ph": "M",
+                             "pid": ev["pid"], "tid": tid,
+                             "args": {"name":
+                                      threading.current_thread().name}})
+            self._write(ev)
+
+    def _write(self, ev):
+        if self._n:
+            self._f.write(",\n")
+        self._n += 1
+        self._f.write(json.dumps(ev))
+
+    @contextlib.contextmanager
+    def span(self, name, **args):
+        t0 = self._now_us()
+        try:
+            yield
+        finally:
+            ev = {"name": name, "ph": "X", "ts": t0,
+                  "dur": self._now_us() - t0,
+                  "pid": os.getpid(), "tid": threading.get_ident()}
+            if args:
+                ev["args"] = args
+            self._emit(ev)
+
+    def instant(self, name, **args):
+        """Record a zero-duration marker (chrome-trace "i" event)."""
+        ev = {"name": name, "ph": "i", "s": "p", "ts": self._now_us(),
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def flush(self):
+        with self._lock:
+            if not self._closed:
+                self._f.flush()
+
+    def close(self):
+        """Terminate the JSON array and close the file (idempotent);
+        later spans are dropped."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._f.write("\n]\n")
+            self._f.close()
+        self.deactivate()
+
+    # ----- ambient activation --------------------------------------------- #
+    def activate(self):
+        """Push onto the ambient stack: module-level ``span()`` calls
+        record here until ``deactivate()``."""
+        with _ACTIVE_LOCK:
+            _ACTIVE.append(self)
+        return self
+
+    def deactivate(self):
+        with _ACTIVE_LOCK:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+        if not self._closed:
+            self.flush()
+
+    def __enter__(self):
+        return self.activate()
+
+    def __exit__(self, *exc):
+        self.close()           # close() also deactivates
+        return False

@@ -1,0 +1,260 @@
+"""Structured per-step training telemetry (JSONL) + run metadata.
+
+One ``StepTelemetry`` instance owns a run directory and produces:
+
+- ``telemetry.jsonl`` -- one JSON object per line.  The first event is
+  the run header (``kind: "header"``: devices, platform, jax version,
+  and the compiled step's ``cost_analysis`` flops/bytes when attached);
+  every training step appends a ``kind: "step"`` event carrying the
+  split timers (``wall_s`` / ``data_wait_s`` / ``device_s``), loss,
+  ``records_per_s``, epoch/step counters, and per-device memory stats.
+- ``trace.json`` -- chrome-trace host spans (see ``spans.SpanTracer``),
+  viewable in Perfetto next to the device xplane traces.
+
+The watchdogs (``watchdogs.py``) ride on the same step cadence:
+``step_begin``/``record_step`` bracket the no-compile window for the
+recompile detector, and each step's ``bytes_in_use`` feeds the
+memory-growth detector.  ``tools/obs_report.py`` merges the JSONL with
+an xplane trace into one run report.
+
+The recorder is driver-agnostic: the shared driver loop
+(``optim/local_optimizer.py:_run_driver_loop``) emits the events, so
+Local/Distri/Strategy training all produce the identical schema.
+"""
+
+import json
+import os
+import time
+
+from bigdl_tpu.observability.spans import SpanTracer
+from bigdl_tpu.observability.watchdogs import (MemoryWatchdog,
+                                               RecompileWatchdog)
+
+#: JSONL schema version (bump on breaking key changes)
+SCHEMA_VERSION = 1
+
+
+def peak_flops(device=None):
+    """Peak bf16 FLOP/s for a device kind (bench.py's table); CPU and
+    unknown hosts get a nominal 1 TFLOP/s so MFU stays computable (and
+    obviously not chip-meaningful)."""
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    platform = getattr(device, "platform", "cpu")
+    if platform != "tpu":
+        return 1e12
+    if "v6" in kind:
+        return 918e12
+    if "v5p" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    return 197e12  # v5e and unknown TPUs
+
+
+def device_memory_stats():
+    """Per-device ``{label: {"bytes_in_use", "peak_bytes_in_use"}}``, or
+    None where the backend exposes no allocator stats (CPU)."""
+    import jax
+
+    out = {}
+    for d in jax.devices():
+        try:
+            s = d.memory_stats()
+        except Exception:
+            s = None
+        if not s:
+            continue
+        rec = {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in s:
+                rec[key] = int(s[key])
+        if rec:
+            out[f"{d.platform}:{d.id}"] = rec
+    return out or None
+
+
+def _normalize_cost(analysis):
+    """``compiled.cost_analysis()`` returns a dict (or a 1-list of dicts
+    on older jax); pull out the portable totals."""
+    if analysis is None:
+        return None
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    out = {}
+    if "flops" in analysis:
+        out["flops_per_step"] = float(analysis["flops"])
+    if "bytes accessed" in analysis:
+        out["bytes_accessed_per_step"] = float(analysis["bytes accessed"])
+    return out or None
+
+
+class StepTelemetry:
+    """Per-run structured telemetry recorder.
+
+    >>> tel = StepTelemetry(run_dir)
+    >>> opt.set_telemetry(tel)         # any of the optimizer drivers
+    >>> opt.optimize()
+    >>> tel.close()
+
+    The driver loop calls ``step_begin``/``record_step`` around every
+    step and ``flush`` when training ends, so artifacts are complete
+    even if the caller forgets ``close()``.
+    """
+
+    def __init__(self, out_dir, run_name="train", trace=True,
+                 recompile_warmup_steps=1, memory_window=25):
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self.run_name = run_name
+        self.jsonl_path = os.path.join(out_dir, "telemetry.jsonl")
+        # truncate: one run dir = one run (two appended headers would
+        # silently merge runs in obs_report); pick a fresh dir to keep
+        # a previous attempt's artifacts
+        self._f = open(self.jsonl_path, "w")
+        self.tracer = SpanTracer(os.path.join(out_dir, "trace.json")) \
+            if trace else None
+        self.recompile_watchdog = RecompileWatchdog(recompile_warmup_steps)
+        self.memory_watchdog = MemoryWatchdog(memory_window)
+        self._cost = None
+        self._wrote_header = False
+        self._closed = False
+
+    # ----- generic event plumbing ----------------------------------------- #
+    def record(self, kind, **fields):
+        """Append one JSONL event (header is written lazily first)."""
+        if kind != "header" and not self._wrote_header:
+            self.write_header()
+        event = {"kind": kind, "ts": time.time(), **fields}
+        self._f.write(json.dumps(event) + "\n")
+        self._f.flush()
+        return event
+
+    def write_header(self, **extra):
+        """Run-level metadata event; called lazily before the first step
+        (or eagerly by a driver once the compiled step's cost is known)."""
+        if self._wrote_header:
+            return None
+        self._wrote_header = True
+        fields = {"run": self.run_name, "schema_version": SCHEMA_VERSION}
+        try:
+            import jax
+            dev = jax.devices()[0]
+            fields.update(
+                jax_version=jax.__version__,
+                platform=dev.platform,
+                device_kind=getattr(dev, "device_kind", ""),
+                device_count=jax.device_count(),
+                process_count=jax.process_count(),
+                peak_flops=peak_flops(dev))
+        except Exception:
+            pass
+        if self._cost:
+            fields["cost"] = self._cost
+        fields.update(extra)
+        return self.record("header", **fields)
+
+    # ----- step cadence ---------------------------------------------------- #
+    def step_begin(self, step):
+        """Open the no-compile window (call right before dispatch)."""
+        self.recompile_watchdog.step_begin(step)
+
+    def record_step(self, event):
+        """Close the step window and append the step event.
+
+        ``event`` must carry ``step``, ``wall_s``, ``data_wait_s`` and
+        ``records_per_s`` (the documented schema); memory stats and any
+        watchdog findings are attached here.
+        """
+        wd = self.recompile_watchdog
+        compiles = wd.step_end(event.get("step"))
+        if compiles:
+            # "compiles": any backend compile inside the step window
+            # (warmup included); "recompiles": only watchdog-FLAGGED
+            # post-warmup compiles -- what reports alarm on
+            event["compiles"] = compiles
+            if wd.events and wd.events[-1]["step"] == event.get("step"):
+                event["recompiles"] = compiles
+        mem = device_memory_stats()
+        if mem:
+            event["memory"] = mem
+            flagged = self.memory_watchdog.observe(
+                event.get("step"),
+                {dev: s["bytes_in_use"] for dev, s in mem.items()
+                 if "bytes_in_use" in s})
+            if flagged:
+                event["memory_growth"] = flagged
+        return self.record("step", **event)
+
+    # ----- compiled-step cost ---------------------------------------------- #
+    def attach_cost(self, jitted, *example_args, records_per_step=None):
+        """Lower the step for ``cost_analysis`` and put the flops/bytes
+        totals on the run header.  The lowering's own cost analysis is
+        preferred -- it needs no backend compile, so enabling telemetry
+        does not pay the train step's XLA compile twice; only when the
+        lowering exposes nothing is the AOT compile consulted.  Failure
+        is never fatal -- cost is an annotation, not a dependency."""
+        try:
+            lowered = jitted.lower(*example_args)
+        except Exception:
+            return None
+        try:
+            cost = _normalize_cost(lowered.cost_analysis())
+        except Exception:
+            cost = None
+        if cost is None:
+            try:
+                cost = _normalize_cost(lowered.compile().cost_analysis())
+            except Exception:
+                cost = None
+        if cost is None:
+            return None
+        if records_per_step:
+            cost["records_per_step"] = int(records_per_step)
+        self._cost = cost
+        if not self._wrote_header:
+            self.write_header()           # header carries the cost block
+        else:
+            self.record("cost", cost=cost)
+        return cost
+
+    # ----- spans ------------------------------------------------------------ #
+    def span(self, name, **args):
+        import contextlib
+
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **args)
+
+    # ----- lifecycle -------------------------------------------------------- #
+    def flush(self):
+        self._f.flush()
+        if self.tracer is not None:
+            self.tracer.flush()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if not self._wrote_header:
+            self.write_header()
+        self._f.flush()
+        self._f.close()
+        if self.tracer is not None:
+            self.tracer.close()           # deactivates + terminates JSON
+
+    def __enter__(self):
+        """Context use additionally makes the tracer ambient, so
+        module-level ``span()`` calls anywhere (user code, serving)
+        land in this run's trace until exit."""
+        if self.tracer is not None:
+            self.tracer.activate()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,151 @@
+"""Silent-failure watchdogs: recompiles and device-memory growth.
+
+Two things go wrong on an accelerator without any exception being
+raised: the jitted step silently recompiles every iteration (a shape or
+static-arg leak -- each "step" is now a multi-second XLA compile), and
+device memory creeps up until an OOM hundreds of steps later.  Both are
+invisible in loss curves; both are cheap to detect on the host.
+
+``RecompileWatchdog`` counts backend compiles per step window via
+``jax.monitoring``'s duration listener (every real XLA compile emits
+``/jax/core/compile/backend_compile_duration``); where that API is
+unavailable it falls back to polling the jit cache size of explicitly
+``watch()``-ed functions.  Any compile after the warmup steps logs a
+WARNING with the offending step number.
+
+``MemoryWatchdog`` tracks per-device ``bytes_in_use`` and flags a
+monotonic increase sustained across N consecutive observations.
+"""
+
+import logging
+import threading
+
+log = logging.getLogger("bigdl_tpu.observability")
+
+#: duration events that indicate a real backend (XLA) compile
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_counter_lock = threading.Lock()
+_compile_count = 0
+_listener_state = None  # None = not tried, True = active, False = unavailable
+
+
+def _on_duration(name, duration_secs=None, **kwargs):
+    global _compile_count
+    if name == _COMPILE_EVENT:
+        with _counter_lock:
+            _compile_count += 1
+
+
+def _ensure_listener():
+    """Register the (process-global, permanent) compile listener once."""
+    global _listener_state
+    if _listener_state is not None:
+        return _listener_state
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _listener_state = True
+    except Exception:  # pragma: no cover - jax without monitoring
+        _listener_state = False
+    return _listener_state
+
+
+def backend_compile_count():
+    """Process-wide count of backend compiles seen by the listener."""
+    _ensure_listener()
+    with _counter_lock:
+        return _compile_count
+
+
+class RecompileWatchdog:
+    """Flags backend compiles that happen after warmup.
+
+    Drive it with ``step_begin(step)`` / ``step_end(step)`` around the
+    window where NO compile is expected (dispatch + loss sync in the
+    driver loop; validation/checkpoint compiles stay outside the window
+    and are never false-flagged).  The first ``warmup_steps`` completed
+    steps are exempt -- that is where the train step legitimately
+    compiles.
+    """
+
+    def __init__(self, warmup_steps=1):
+        self.warmup_steps = warmup_steps
+        self.events = []          # [{"step", "compiles"}] -- one per firing
+        self._watched = []        # jitted fns for the cache-size fallback
+        self._begin = None
+        self._steps_seen = 0
+        self._use_monitoring = _ensure_listener()
+
+    def watch(self, fn):
+        """Register a jitted function whose cache size becomes the
+        compile signal.  Preferred over the process-global monitoring
+        counter: cache growth is PER-FUNCTION, so a concurrent thread
+        compiling something else (e.g. a serving request with a new
+        shape) can never be misattributed to the training step."""
+        if hasattr(fn, "_cache_size"):
+            self._watched.append(fn)
+        return fn
+
+    def _signal(self):
+        if self._watched:
+            return sum(f._cache_size() for f in self._watched)
+        if self._use_monitoring:
+            return backend_compile_count()
+        return 0
+
+    def step_begin(self, step):
+        self._begin = self._signal()
+
+    def step_end(self, step):
+        """Close the step window; returns the number of compiles seen
+        inside it (0 when clean), WARNING-logging post-warmup compiles."""
+        if self._begin is None:
+            return 0
+        delta = self._signal() - self._begin
+        self._begin = None
+        self._steps_seen += 1
+        if delta > 0 and self._steps_seen > self.warmup_steps:
+            self.events.append({"step": step, "compiles": delta})
+            log.warning(
+                "recompile detected at step %d (%d backend compile%s inside "
+                "the step window): a shape or static argument is changing "
+                "per step -- every such step pays a full XLA compile",
+                step, delta, "s" if delta > 1 else "")
+        return delta
+
+
+class MemoryWatchdog:
+    """Flags monotonic device-memory growth sustained over ``window``
+    consecutive observations (a leak signature: steady-state training
+    should plateau after the first steps)."""
+
+    def __init__(self, window=25):
+        self.window = window
+        self.events = []          # [{"step", "device", "bytes_in_use"}]
+        self._last = {}
+        self._streak = {}
+
+    def observe(self, step, bytes_in_use_by_device):
+        """Feed ``{device_label: bytes_in_use}`` for one step; returns
+        the devices flagged this call (usually empty)."""
+        flagged = []
+        for dev, used in (bytes_in_use_by_device or {}).items():
+            prev = self._last.get(dev)
+            self._last[dev] = used
+            if prev is not None and used > prev:
+                self._streak[dev] = self._streak.get(dev, 0) + 1
+            else:
+                self._streak[dev] = 0
+            if self._streak[dev] >= self.window:
+                self._streak[dev] = 0      # re-arm: fire again after N more
+                self.events.append(
+                    {"step": step, "device": dev, "bytes_in_use": used})
+                flagged.append(dev)
+                log.warning(
+                    "device %s memory grew monotonically for %d consecutive "
+                    "steps (now %.1f MiB in use) at step %d -- possible "
+                    "leak (host-retained device arrays, growing cache, or "
+                    "per-step constants)",
+                    dev, self.window, used / 2**20, step)
+        return flagged

@@ -303,6 +303,17 @@ class DistriOptimizer(BaseOptimizer):
 
         batch_sharding = NamedSharding(self.mesh, P(self.axis))
 
+        if self.telemetry is not None:
+            self.telemetry.recompile_watchdog.watch(step)
+            # real sharded arrays (one extra transfer of the first batch,
+            # once at startup): the lowering's avals must carry the
+            # GLOBAL shapes/shardings _shard_batch assembles, which
+            # host-local specs cannot express under multi-process
+            xc, tc = self._shard_batch(first_batch, batch_sharding)
+            self.telemetry.attach_cost(
+                step, params_flat, mstate, opt_state, xc, tc,
+                jax.random.key(0), records_per_step=global_batch)
+
         def dispatch(batch):
             nonlocal params_flat, mstate, opt_state
             x, target = self._shard_batch(batch, batch_sharding)

@@ -8,6 +8,7 @@ TPU-native: no replica threads -- one jitted step fuses fwd/bwd/update and
 saturates the chip; the host loop only feeds batches and evaluates triggers.
 """
 
+import contextlib
 import logging
 import time
 from typing import Dict, List, Optional
@@ -17,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.train_step import make_eval_step, make_train_step
 from bigdl_tpu.optim.trigger import Trigger
@@ -63,6 +65,10 @@ class BaseOptimizer:
         self.compute_dtype = None
         self.clip_value = None
         self.clip_norm = None
+        self.telemetry = None
+        #: host-side counters: data_wait_s vs device_s per step (the
+        #: reference's Metrics accumulators, optim/Metrics.scala:31)
+        self.metrics = Metrics()
         self.driver_state: Dict = {"epoch": 1, "neval": 1,
                                    "record_count": 0}
 
@@ -130,6 +136,14 @@ class BaseOptimizer:
 
     def set_train_summary(self, summary):
         self.train_summary = summary
+        return self
+
+    def set_telemetry(self, telemetry):
+        """Attach a ``StepTelemetry`` recorder: one structured JSONL
+        event per step, host-span chrome trace, and the recompile /
+        memory watchdogs, all driven by the shared driver loop
+        (``bigdl_tpu/observability/``, docs/observability.md)."""
+        self.telemetry = telemetry
         return self
 
     def set_validation_summary(self, summary):
@@ -277,6 +291,10 @@ class BaseOptimizer:
             if self.validation_summary is not None:
                 self.validation_summary.add_scalar(
                     method.name, value, state["neval"])
+            if self.telemetry is not None:
+                self.telemetry.record("validation", step=state["neval"],
+                                      epoch=state["epoch"],
+                                      method=method.name, value=float(value))
         return results
 
     def _stage_next_batch(self, train_iter, state, n, epoch_size,
@@ -403,11 +421,12 @@ class BaseOptimizer:
                     "Parameters" + keystr(path), np.asarray(leaf),
                     state["neval"])
 
-    def _log_progress(self, loss, throughput):
+    def _log_progress(self, loss, throughput, data_wait_s=0.0):
         s = self.driver_state
         log.info(
-            "Epoch %d [iteration %d] loss %.6f, %.1f records/s",
-            s["epoch"], s["neval"], loss, throughput)
+            "Epoch %d [iteration %d] loss %.6f, %.1f records/s "
+            "(data-wait %.1f ms)",
+            s["epoch"], s["neval"], loss, throughput, data_wait_s * 1e3)
 
     def _run_driver_loop(self, train_iter, first_batch, *, dispatch,
                         records_of=None, extra_summaries=None,
@@ -435,54 +454,96 @@ class BaseOptimizer:
           _record_validation); ``feed_plateau(state)`` then lets the
           caller thread the Plateau schedule through its opt_state.
         - ``checkpoint_cb(state)``: write a checkpoint.
+
+        Timing is split, not conflated: ``data_wait_s`` covers the
+        deferred (unoverlapped) fetch at the top of the iteration, and
+        ``device_s`` covers dispatch -> loss sync (which already
+        overlaps the prefetch of the next batch).  Both go to
+        ``self.metrics`` and, when a ``StepTelemetry`` is attached, into
+        one structured JSONL event per step that the TensorBoard
+        scalars are also derived from (single source of truth).
         """
         self._reshuffle_pending = False   # no stale flag from a prior run
         epoch_size = self.dataset.size()
         state = self.driver_state
         batch = first_batch
         records_of = records_of or (lambda b: b.size())
-        while not self.end_trigger(state):
-            t0 = time.time()  # includes a deferred (unoverlapped) fetch
-            if batch is None:     # exotic trigger defeated the prediction
-                batch, train_iter = self._stage_next_batch(
-                    train_iter, state, 0, epoch_size, force=True)
-            loss_dev = dispatch(batch)
-            n = records_of(batch)
-            next_batch, train_iter = self._stage_next_batch(
-                train_iter, state, n, epoch_size)
-            loss = float(loss_dev)
-            dt = time.time() - t0
-            state["loss"] = loss
-            state["record_count"] += n
-            state["throughput"] = n / max(dt, 1e-9)
-            self._log_progress(loss, state["throughput"])
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar(
-                    "Throughput", state["throughput"], state["neval"])
-                if extra_summaries is not None:
-                    extra_summaries(state)
-            state["neval"] += 1
-            if state["record_count"] >= epoch_size:
-                state["epoch"] += 1
-                state["record_count"] = 0
-                if next_batch is None:   # fetch deferred past the reset:
-                    self._reshuffle_pending = True
+        tel = self.telemetry
+        sp = tel.span if tel is not None else \
+            (lambda name, **kw: contextlib.nullcontext())
+        try:
+            while not self.end_trigger(state):
+                t0 = time.perf_counter()
+                if batch is None:  # exotic trigger defeated the prediction
+                    with sp("data_wait", step=state["neval"]):
+                        batch, train_iter = self._stage_next_batch(
+                            train_iter, state, 0, epoch_size, force=True)
+                data_wait = time.perf_counter() - t0
+                if tel is not None:   # open the no-compile watchdog window
+                    tel.step_begin(state["neval"])
+                with sp("dispatch", step=state["neval"]):
+                    loss_dev = dispatch(batch)
+                n = records_of(batch)
+                with sp("stage_next_batch", step=state["neval"]):
+                    next_batch, train_iter = self._stage_next_batch(
+                        train_iter, state, n, epoch_size)
+                with sp("loss_sync", step=state["neval"]):
+                    loss = float(loss_dev)
+                wall = time.perf_counter() - t0
+                device_s = wall - data_wait
+                state["loss"] = loss
+                state["record_count"] += n
+                state["throughput"] = n / max(wall, 1e-9)
+                self.metrics.add("data_wait_s", data_wait)
+                self.metrics.add("device_s", device_s)
+                event = {"step": state["neval"], "epoch": state["epoch"],
+                         "wall_s": wall, "data_wait_s": data_wait,
+                         "device_s": device_s, "loss": loss, "records": n,
+                         "records_per_s": state["throughput"]}
+                if tel is not None:
+                    tel.record_step(event)
+                self._log_progress(loss, state["throughput"], data_wait)
+                if self.train_summary is not None:
+                    # scalars derive from the SAME event dict the JSONL
+                    # records -- the two channels cannot disagree
+                    add_event = getattr(
+                        self.train_summary, "add_step_event", None)
+                    if add_event is not None:
+                        add_event(event)
+                    else:   # duck-typed summary: raw scalars
+                        self.train_summary.add_scalar(
+                            "Loss", loss, state["neval"])
+                        self.train_summary.add_scalar(
+                            "Throughput", state["throughput"],
+                            state["neval"])
+                    if extra_summaries is not None:
+                        extra_summaries(state)
+                state["neval"] += 1
+                if state["record_count"] >= epoch_size:
+                    state["epoch"] += 1
+                    state["record_count"] = 0
+                    if next_batch is None:  # fetch deferred past the reset:
+                        self._reshuffle_pending = True
 
-            if (self.validation_trigger is not None
-                    and self.validation_trigger(state)):
-                self._record_validation(validate_cb(), state)
-                if feed_plateau is not None:
-                    feed_plateau(state)
-            if (self.checkpoint_trigger is not None
-                    and self.checkpoint_trigger(state)):
-                # snapshot the RNG stream position alongside the counters
-                state["rng_state"] = RNG.get_state()
-                checkpoint_cb(state)
+                if (self.validation_trigger is not None
+                        and self.validation_trigger(state)):
+                    with sp("validation", step=state["neval"]):
+                        self._record_validation(validate_cb(), state)
+                        if feed_plateau is not None:
+                            feed_plateau(state)
+                if (self.checkpoint_trigger is not None
+                        and self.checkpoint_trigger(state)):
+                    # snapshot the RNG stream position with the counters
+                    state["rng_state"] = RNG.get_state()
+                    with sp("checkpoint", step=state["neval"]):
+                        checkpoint_cb(state)
 
-            # next_batch None = deferred: the top-of-loop fetch runs only
-            # after the end trigger has decided training continues
-            batch = None if next_batch is PREDICTED_END else next_batch
+                # next_batch None = deferred: the top-of-loop fetch runs
+                # only after the end trigger decided training continues
+                batch = None if next_batch is PREDICTED_END else next_batch
+        finally:
+            if tel is not None:
+                tel.flush()   # artifacts complete even on an exception
 
 
 class LocalOptimizer(BaseOptimizer):
@@ -506,6 +567,20 @@ class LocalOptimizer(BaseOptimizer):
             self.model, self.criterion, self.optim_method,
             compute_dtype=self.compute_dtype, clip_value=self.clip_value,
             clip_norm=self.clip_norm), donate_argnums=(0, 1, 2))
+
+        if self.telemetry is not None:
+            self.telemetry.recompile_watchdog.watch(step)
+            # shape/dtype specs only -- lowering for cost_analysis needs
+            # avals, not a device copy of the batch
+            spec = lambda a: jax.ShapeDtypeStruct(
+                np.shape(a), jax.dtypes.canonicalize_dtype(
+                    np.asarray(a).dtype))
+            xc = jax.tree.map(spec, first_batch.get_input())
+            tgt = first_batch.get_target()
+            tc = None if tgt is None else jax.tree.map(spec, tgt)
+            self.telemetry.attach_cost(
+                step, params, mstate, opt_state, xc, tc, jax.random.key(0),
+                records_per_step=first_batch.size())
 
         def dispatch(batch):
             nonlocal params, mstate, opt_state

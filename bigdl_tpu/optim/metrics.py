@@ -48,6 +48,15 @@ class Metrics:
         parts = [f"{k}: {self.value(k):.6f}" for k in sorted(self._sums)]
         return ", ".join(parts)
 
+    def to_dict(self) -> Dict[str, Dict[str, float]]:
+        """Structured export: ``{name: {"sum", "count", "mean"}}`` --
+        what obs_report / telemetry consumers serialize instead of the
+        human-readable summary() line."""
+        return {name: {"sum": self._sums[name],
+                       "count": self._counts[name],
+                       "mean": self.value(name)}
+                for name in sorted(self._sums)}
+
     def reset(self):
         self._sums.clear()
         self._counts.clear()

@@ -12,6 +12,7 @@ a semaphore, while all callers share one compiled function.
 """
 
 import threading
+import time
 from typing import List, Optional
 
 import jax
@@ -20,30 +21,61 @@ import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.minibatch import Sample, samples_to_minibatch
+from bigdl_tpu.observability.spans import span
 from bigdl_tpu.optim.train_step import make_eval_step
 
 
 class Predictor:
     """Batched prediction over a DataSet or array of Samples
-    (reference: optim/Predictor.scala:154)."""
+    (reference: optim/Predictor.scala:154).
 
-    def __init__(self, model, batch_size: int = 128, compute_dtype=None):
+    ``telemetry``: optional ``StepTelemetry`` -- each batch appends a
+    ``kind: "inference"`` JSONL event with the same split-timer keys as
+    training steps, and batch fetch/eval land in the host span trace.
+    """
+
+    def __init__(self, model, batch_size: int = 128, compute_dtype=None,
+                 telemetry=None):
         if not model.is_built():
             raise ValueError("build the model (or train it) before predicting")
         self.model = model
         self.batch_size = batch_size
+        self.telemetry = telemetry
         self._eval = jax.jit(make_eval_step(model, compute_dtype))
 
     def predict_minibatch(self, batch):
         x = jax.tree.map(jnp.asarray, batch.get_input())
         return self._eval(self.model.parameters()[0], self.model.state(), x)
 
+    def _span(self, name, **kw):
+        """Own telemetry's tracer when attached, else the ambient one."""
+        if self.telemetry is not None:
+            return self.telemetry.span(name, **kw)
+        return span(name, **kw)
+
     def predict(self, data) -> List[np.ndarray]:
         """data: AbstractDataSet of MiniBatches, or list of Samples."""
         outs = []
-        for batch in self._batches(data):
-            y = self.predict_minibatch(batch)
-            outs.extend(np.asarray(y))
+        it = self._batches(data)
+        step = 0
+        while True:
+            t0 = time.perf_counter()
+            with self._span("predict_fetch"):
+                batch = next(it, None)
+            if batch is None:
+                break
+            data_wait = time.perf_counter() - t0
+            step += 1
+            with self._span("predict_batch", step=step):
+                y = self.predict_minibatch(batch)
+                outs.extend(np.asarray(y))   # host sync
+            if self.telemetry is not None:
+                wall = time.perf_counter() - t0
+                n = batch.size()
+                self.telemetry.record(
+                    "inference", step=step, wall_s=wall,
+                    data_wait_s=data_wait, device_s=wall - data_wait,
+                    records=n, records_per_s=n / max(wall, 1e-9))
         return outs
 
     def predict_class(self, data) -> List[int]:
@@ -99,7 +131,7 @@ class PredictionService:
     def predict(self, activity):
         """Single-activity request -> output activity
         (reference: PredictionService.predict :79-126)."""
-        with self._sem:
+        with self._sem, span("serve_request"):
             x = jax.tree.map(lambda a: jnp.asarray(a)[None], activity)
             y = self.predictor._eval(
                 self.predictor.model.parameters()[0],

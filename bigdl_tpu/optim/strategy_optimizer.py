@@ -384,6 +384,17 @@ class StrategyOptimizer(BaseOptimizer):
         if getattr(self, "_resume_sharded", None):
             params, opt_state = self._sharded_restore(params, opt_state)
 
+        if self.telemetry is not None:
+            self.telemetry.recompile_watchdog.watch(step)
+            # placed arrays (one extra transfer, once at startup): the
+            # strategy's `place` encodes per-leaf shardings the lowering
+            # needs and plain shape specs cannot express
+            xc = jax.tree.map(place, first_batch.get_input())
+            yc = jax.tree.map(place, first_batch.get_target())
+            self.telemetry.attach_cost(
+                step, params, opt_state, xc, yc, jax.random.key(0),
+                records_per_step=first_batch.size())
+
         def dispatch(batch):
             nonlocal params, opt_state
             x = jax.tree.map(place, batch.get_input())

@@ -4,32 +4,177 @@ Used to cross-validate wall-clock step timings with the device plane's
 own busy time (docs/performance.md: the chained-value-fetch clock needs
 an independent witness through the tunneled transport).  Parses the
 ``*.xplane.pb`` files a ``jax.profiler.trace`` context writes, via the
-TF-shipped proto (no tensorboard plugin needed).
+TF-shipped proto when available, else a hand-rolled decoder for the few
+XSpace fields the readers touch (the twin of the hand-rolled Event
+encoder in ``visualization/tensorboard.py`` -- no TF dependency on the
+read side either).
+
+Both public readers (``device_busy``, ``op_breakdown``) return None --
+never raise -- on a missing/empty/corrupt trace dir, so report tooling
+can always call them unconditionally.
 """
 
 import glob
 import os
 import re
 
+_UNSET = object()
+_xplane_pb2 = _UNSET  # import not attempted yet (None = unavailable)
+
+
+def _load_proto():
+    """The TF-shipped XSpace proto module, or None (cached)."""
+    global _xplane_pb2
+    if _xplane_pb2 is _UNSET:
+        try:
+            from tensorflow.tsl.profiler.protobuf import xplane_pb2
+            _xplane_pb2 = xplane_pb2
+        except Exception:
+            try:
+                from tensorflow.core.profiler.protobuf import xplane_pb2
+                _xplane_pb2 = xplane_pb2
+            except Exception:
+                _xplane_pb2 = None
+    return _xplane_pb2
+
+
+# --------------------------------------------------------------------------- #
+# Pure-python XSpace decoder (fallback when TF's proto is absent).  Only
+# the fields the readers consume: XSpace.planes / XPlane.{name, lines,
+# event_metadata} / XLine.{name, timestamp_ns, events} /
+# XEvent.{metadata_id, offset_ps, duration_ps}.
+# --------------------------------------------------------------------------- #
+
+
+def _uvarint(data, off):
+    shift = n = 0
+    while True:
+        b = data[off]
+        off += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, off
+        shift += 7
+
+
+def _decode_fields(data):
+    off = 0
+    while off < len(data):
+        key, off = _uvarint(data, off)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, off = _uvarint(data, off)
+        elif wire == 1:
+            val = data[off:off + 8]
+            off += 8
+        elif wire == 2:
+            ln, off = _uvarint(data, off)
+            val = data[off:off + ln]
+            off += ln
+        elif wire == 5:
+            val = data[off:off + 4]
+            off += 4
+        else:
+            return
+        yield field, wire, val
+
+
+class _PureEvent:
+    __slots__ = ("metadata_id", "offset_ps", "duration_ps")
+
+    def __init__(self, data):
+        self.metadata_id = self.offset_ps = self.duration_ps = 0
+        for f, w, v in _decode_fields(data):
+            if w != 0:
+                continue
+            if f == 1:
+                self.metadata_id = v
+            elif f == 2:
+                self.offset_ps = v
+            elif f == 3:
+                self.duration_ps = v
+
+
+class _PureLine:
+    __slots__ = ("name", "timestamp_ns", "events")
+
+    def __init__(self, data):
+        self.name, self.timestamp_ns, self.events = "", 0, []
+        for f, w, v in _decode_fields(data):
+            if f == 2 and w == 2:
+                self.name = v.decode("utf-8", "replace")
+            elif f == 3 and w == 0:
+                self.timestamp_ns = v
+            elif f == 4 and w == 2:
+                self.events.append(_PureEvent(v))
+
+
+class _PureEventMetadata:
+    __slots__ = ("id", "name")
+
+    def __init__(self, data):
+        self.id, self.name = 0, ""
+        for f, w, v in _decode_fields(data):
+            if f == 1 and w == 0:
+                self.id = v
+            elif f == 2 and w == 2:
+                self.name = v.decode("utf-8", "replace")
+
+
+class _PurePlane:
+    __slots__ = ("name", "lines", "event_metadata")
+
+    def __init__(self, data):
+        self.name, self.lines, self.event_metadata = "", [], {}
+        for f, w, v in _decode_fields(data):
+            if f == 2 and w == 2:
+                self.name = v.decode("utf-8", "replace")
+            elif f == 3 and w == 2:
+                self.lines.append(_PureLine(v))
+            elif f == 4 and w == 2:   # map<int64, XEventMetadata> entry
+                key, meta = 0, None
+                for f2, w2, v2 in _decode_fields(v):
+                    if f2 == 1 and w2 == 0:
+                        key = v2
+                    elif f2 == 2 and w2 == 2:
+                        meta = _PureEventMetadata(v2)
+                if meta is not None:
+                    self.event_metadata[key or meta.id] = meta
+
+
+class _PureXSpace:
+    __slots__ = ("planes",)
+
+    def __init__(self, data):
+        self.planes = [_PurePlane(v) for f, w, v in _decode_fields(data)
+                       if f == 1 and w == 2]
+
+
+def _parse_xspace(data):
+    pb2 = _load_proto()
+    if pb2 is not None:
+        xs = pb2.XSpace()
+        xs.ParseFromString(data)
+        return xs
+    return _PureXSpace(data)
+
 
 def _iter_device_planes(trace_dir):
     """Yield every device (TPU/XLA) plane in the trace's xplane files.
 
-    Yields nothing when the TF proto is unavailable (e.g. CPU-only
-    environments) -- both public readers then return None.
+    Yields nothing (so both public readers return None) for a None /
+    nonexistent / empty trace dir; a corrupt xplane file is skipped
+    rather than raised.
     """
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception:
-        try:
-            from tensorflow.core.profiler.protobuf import xplane_pb2
-        except Exception:
-            return
-    for path in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+    if not trace_dir or not os.path.isdir(str(trace_dir)):
+        return
+    for path in glob.glob(os.path.join(str(trace_dir), "**", "*.xplane.pb"),
                           recursive=True):
-        xs = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs.ParseFromString(f.read())
+        try:
+            with open(path, "rb") as f:
+                xs = _parse_xspace(f.read())
+        except Exception:
+            continue   # partial/corrupt trace file: skip, never raise
         for plane in xs.planes:
             name = plane.name.lower()
             if "tpu" in name or "device" in name or "xla" in name:

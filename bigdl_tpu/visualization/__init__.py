@@ -41,6 +41,18 @@ class TrainSummary(Summary):
         self._triggers[name] = trigger
         return self
 
+    def add_step_event(self, event):
+        """Write the per-step scalars from ONE telemetry step event
+        (the same dict the observability JSONL records), so TensorBoard
+        and telemetry.jsonl can never disagree on loss/throughput
+        (docs/observability.md)."""
+        step = event["step"]
+        self.add_scalar("Loss", event["loss"], step)
+        self.add_scalar("Throughput", event["records_per_s"], step)
+        if "data_wait_s" in event:
+            self.add_scalar("DataWaitSeconds", event["data_wait_s"], step)
+        return self
+
     def get_summary_trigger(self, name: str):
         return self._triggers.get(name)
 

@@ -1,0 +1,99 @@
+"""Regenerate ``synthetic.xplane.pb`` -- a tiny hand-encoded XSpace.
+
+One fake TPU plane with three lines ("XLA Modules", "XLA Ops",
+"Async XLA Ops") plus an ignorable host plane, exercising everything
+``utils/xplane.py`` reads: metadata-resolved op names, line timestamp
+alignment, async-line exclusion, and the map<int64, XEventMetadata>
+entries.  Encoded by hand (same wire-format helpers as the pure-python
+decoder it tests against), so regeneration needs no tensorflow:
+
+    python tests/fixtures/gen_xplane_fixture.py
+"""
+
+import os
+
+
+def _varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _tag(field, wire):
+    return _varint(field << 3 | wire)
+
+
+def _vint(field, value):
+    return _tag(field, 0) + _varint(value)
+
+
+def _blob(field, data):
+    if isinstance(data, str):
+        data = data.encode()
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def event(metadata_id, offset_ps, duration_ps):
+    return (_vint(1, metadata_id) + _vint(2, offset_ps)
+            + _vint(3, duration_ps))
+
+
+def line(name, timestamp_ns, events):
+    return (_blob(2, name) + _vint(3, timestamp_ns)
+            + b"".join(_blob(4, e) for e in events))
+
+
+def meta_entry(mid, name):
+    """map<int64, XEventMetadata> entry: key=1, value=2 {id=1, name=2}."""
+    return _vint(1, mid) + _blob(2, _vint(1, mid) + _blob(2, name))
+
+
+def plane(name, lines, metadata):
+    body = _blob(2, name)
+    body += b"".join(_blob(3, ln) for ln in lines)
+    body += b"".join(_blob(4, meta_entry(mid, mname))
+                     for mid, mname in metadata)
+    return body
+
+
+#: the numbers the unit test asserts against (picoseconds)
+OPS = [  # (metadata_id, offset_ps, duration_ps) on the "XLA Ops" line
+    (1, 0, 4_000_000),
+    (2, 4_500_000, 3_000_000),
+    (1, 8_000_000, 1_500_000),
+    (3, 9_600_000, 400_000),
+]
+METADATA = [
+    (1, "%fusion.1 = f32[128,256]{1,0} fusion(%p0, %p1), kind=kOutput"),
+    (2, "%convolution.7 = f32[128,64,56,56]{3,2,1,0} convolution(%a, %b)"),
+    (3, "%all-reduce.9 = f32[1024]{0} all-reduce(%g)"),
+    (4, "jit_step"),
+]
+
+
+def build():
+    tpu = plane(
+        "/device:TPU:0 Synthetic",
+        [
+            line("XLA Modules", 1000, [event(4, 0, 10_000_000)]),
+            line("XLA Ops", 1000, [event(*e) for e in OPS]),
+            line("Async XLA Ops", 1000, [event(3, 0, 50_000_000)]),
+        ],
+        METADATA)
+    host = plane("/host:CPU", [line("python", 1000, [event(4, 0, 500)])],
+                 [(4, "jit_step")])
+    return _blob(1, tpu) + _blob(1, host)
+
+
+if __name__ == "__main__":
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "synthetic.xplane.pb")
+    data = build()
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"wrote {out} ({len(data)} bytes)")

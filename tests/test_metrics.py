@@ -1,0 +1,73 @@
+"""Direct unit coverage for optim/metrics.py (previously only exercised
+indirectly through test_profiling.py)."""
+
+import time
+
+import pytest
+
+from bigdl_tpu.optim.metrics import Metrics
+
+
+class TestCounters:
+    def test_set_overwrites_add_accumulates(self):
+        m = Metrics()
+        m.set("a", 3.0)
+        m.set("a", 5.0)
+        assert m.value("a") == 5.0
+        m.add("b", 1.0)
+        m.add("b", 3.0)
+        assert m.value("b") == 2.0          # mean of the adds
+
+    def test_value_of_unknown_name_is_zero(self):
+        assert Metrics().value("nope") == 0.0
+
+    def test_summary_and_reset(self):
+        m = Metrics()
+        m.add("x", 1.0)
+        assert "x: 1.000000" in m.summary()
+        m.reset()
+        assert m.summary() == ""
+        assert m.to_dict() == {}
+
+    def test_to_dict_structure(self):
+        m = Metrics()
+        m.add("data_wait_s", 0.25)
+        m.add("data_wait_s", 0.75)
+        m.set("device_s", 2.0)
+        d = m.to_dict()
+        assert d["data_wait_s"] == {"sum": 1.0, "count": 2, "mean": 0.5}
+        assert d["device_s"] == {"sum": 2.0, "count": 1, "mean": 2.0}
+        assert list(d) == sorted(d)          # deterministic key order
+
+
+class TestTimer:
+    def test_timer_records_elapsed(self):
+        m = Metrics()
+        with m.timer("t"):
+            time.sleep(0.01)
+        d = m.to_dict()["t"]
+        assert d["count"] == 1
+        assert d["sum"] >= 0.009
+
+    def test_timer_reentrancy_same_name(self):
+        """Nested timers on ONE name must each record their own span
+        (local t0 per with-block -- no shared mutable start state)."""
+        m = Metrics()
+        with m.timer("t"):
+            time.sleep(0.01)
+            with m.timer("t"):
+                time.sleep(0.01)
+        d = m.to_dict()["t"]
+        assert d["count"] == 2
+        # outer (>= 0.02) + inner (>= 0.01)
+        assert d["sum"] >= 0.028
+        # the outer span contains the inner one, so the mean exceeds
+        # the inner duration alone
+        assert d["mean"] >= 0.014
+
+    def test_timer_records_on_exception(self):
+        m = Metrics()
+        with pytest.raises(RuntimeError):
+            with m.timer("t"):
+                raise RuntimeError("boom")
+        assert m.to_dict()["t"]["count"] == 1

@@ -1,0 +1,244 @@
+"""Merge one run's telemetry artifacts into a single run report.
+
+Inputs (produced by ``StepTelemetry``, see docs/observability.md):
+
+- ``RUN_DIR/telemetry.jsonl`` -- header + per-step structured events
+- ``RUN_DIR/trace.json``      -- host-span chrome trace (optional)
+- an xplane trace dir         -- device planes (optional; ``--xplane``,
+  default ``RUN_DIR/xplane`` when it exists)
+
+Output: step-time percentiles, the data-wait fraction of wall time, the
+device-busy fraction from the xplane witness, MFU from the compiled
+step's ``cost_analysis`` flops, watchdog findings, host-span totals,
+and the top-N HLO ops by device time.
+
+    python tools/obs_report.py runs/resnet50   [--xplane DIR] [--json]
+
+No jax import -- the report runs anywhere the artifacts were copied.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# load utils/xplane.py by file path: going through the bigdl_tpu package
+# would import jax (utils.engine) at package init, breaking the
+# "runs anywhere the artifacts were copied" contract
+_spec = importlib.util.spec_from_file_location(
+    "_obs_xplane", os.path.join(REPO, "bigdl_tpu", "utils", "xplane.py"))
+_xplane = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_xplane)
+device_busy, op_breakdown = _xplane.device_busy, _xplane.op_breakdown
+
+
+def load_events(jsonl_path):
+    """-> (header dict or None, [step events], [other events])."""
+    header, steps, other = None, [], []
+    with open(jsonl_path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                ev = json.loads(ln)
+            except ValueError:
+                continue   # truncated tail of a crashed run
+            kind = ev.get("kind")
+            if kind == "header" and header is None:
+                header = ev
+            elif kind == "step":
+                steps.append(ev)
+            else:
+                other.append(ev)
+    return header, steps, other
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile over a pre-sorted list."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def load_trace_events(trace_path):
+    """Chrome-trace events from either container format: the streamed
+    JSON array (possibly unterminated after a crash -- repaired here,
+    as Perfetto does) or the object form with a ``traceEvents`` key."""
+    try:
+        with open(trace_path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        try:   # unterminated streamed array from a crashed run
+            doc = json.loads(text.rstrip().rstrip(",") + "]")
+        except ValueError:
+            return None
+    return doc if isinstance(doc, list) else doc.get("traceEvents")
+
+
+def span_totals(trace_path):
+    """Aggregate the chrome trace's complete events by span name."""
+    events = load_trace_events(trace_path)
+    totals = {}
+    for ev in events or []:
+        if ev.get("ph") != "X":
+            continue
+        sec, cnt = totals.get(ev["name"], (0.0, 0))
+        totals[ev["name"]] = (sec + ev.get("dur", 0.0) / 1e6, cnt + 1)
+    if not totals:
+        return None
+    return [{"name": name, "sec": round(sec, 6), "count": cnt}
+            for name, (sec, cnt) in
+            sorted(totals.items(), key=lambda kv: -kv[1][0])]
+
+
+def build_report(run_dir, xplane_dir=None, top=10):
+    jsonl = os.path.join(run_dir, "telemetry.jsonl")
+    if not os.path.isfile(jsonl):
+        raise FileNotFoundError(f"no telemetry.jsonl under {run_dir}")
+    header, steps, other = load_events(jsonl)
+
+    rep = {"run_dir": run_dir, "header": header, "n_steps": len(steps)}
+    if steps:
+        walls = sorted(e["wall_s"] for e in steps)
+        waits = [e.get("data_wait_s", 0.0) for e in steps]
+        rates = sorted(e["records_per_s"] for e in steps)
+        total_wall = sum(walls)
+        rep["steps"] = {
+            "wall_s_p50": percentile(walls, 50),
+            "wall_s_p90": percentile(walls, 90),
+            "wall_s_p99": percentile(walls, 99),
+            "wall_s_total": total_wall,
+            "data_wait_fraction": sum(waits) / max(total_wall, 1e-12),
+            "records_per_s_p50": percentile(rates, 50),
+            "records_total": sum(e.get("records", 0) for e in steps),
+            "loss_first": steps[0].get("loss"),
+            "loss_last": steps[-1].get("loss"),
+        }
+        # MFU: flops of the compiled step over the median step's wall
+        # time.  Cost lives on the header, or on a later standalone
+        # "cost" event when attach_cost ran after the lazy header write.
+        cost = (header or {}).get("cost") or {}
+        for ev in other:
+            if ev.get("kind") == "cost" and ev.get("cost"):
+                cost = ev["cost"]
+        peak = (header or {}).get("peak_flops")
+        if cost.get("flops_per_step") and peak and rep["steps"]["wall_s_p50"]:
+            rep["steps"]["mfu_p50"] = (
+                cost["flops_per_step"] / rep["steps"]["wall_s_p50"] / peak)
+        mems = [e["memory"] for e in steps if e.get("memory")]
+        if mems:
+            rep["memory_last"] = mems[-1]
+        recompiles = [{"step": e["step"], "compiles": e["recompiles"]}
+                      for e in steps if e.get("recompiles")]
+        growth = [{"step": e["step"], "devices": e["memory_growth"]}
+                  for e in steps if e.get("memory_growth")]
+        rep["watchdogs"] = {"recompile_steps": recompiles,
+                            "memory_growth": growth}
+    validations = [e for e in other if e.get("kind") == "validation"]
+    if validations:
+        rep["validations"] = validations
+
+    rep["host_spans"] = span_totals(os.path.join(run_dir, "trace.json"))
+
+    if xplane_dir is None:
+        cand = os.path.join(run_dir, "xplane")
+        xplane_dir = cand if os.path.isdir(cand) else None
+    if xplane_dir:
+        busy = device_busy(xplane_dir)
+        rep["device"] = busy
+        if busy and busy.get("span_sec"):
+            rep["device"]["busy_fraction"] = (
+                busy["busy_event_sec"] / busy["span_sec"])
+        ops = op_breakdown(xplane_dir, top=top)
+        if ops:
+            rep["top_ops"] = ops["ops"][:top]
+            rep["op_categories"] = ops["categories"][:top]
+    return rep
+
+
+def _fmt_s(v):
+    return "-" if v is None else f"{v * 1e3:.2f} ms"
+
+
+def format_report(rep):
+    out = [f"== run report: {rep['run_dir']} =="]
+    h = rep.get("header") or {}
+    if h:
+        out.append(
+            f"platform {h.get('platform', '?')} "
+            f"({h.get('device_kind', '?')} x{h.get('device_count', '?')}), "
+            f"jax {h.get('jax_version', '?')}, run '{h.get('run', '?')}'")
+        cost = h.get("cost") or {}
+        if cost.get("flops_per_step"):
+            out.append(f"compiled step: {cost['flops_per_step']:.3e} flops, "
+                       f"{cost.get('bytes_accessed_per_step', 0):.3e} bytes "
+                       "accessed")
+    s = rep.get("steps")
+    if s:
+        out.append(f"steps: {rep['n_steps']}  "
+                   f"wall p50/p90/p99: {_fmt_s(s['wall_s_p50'])} / "
+                   f"{_fmt_s(s['wall_s_p90'])} / {_fmt_s(s['wall_s_p99'])}")
+        out.append(f"data-wait fraction: {s['data_wait_fraction']:.2%}   "
+                   f"records/s p50: {s['records_per_s_p50']:.1f}   "
+                   f"records total: {s['records_total']}")
+        out.append(f"loss: {s['loss_first']:.6f} -> {s['loss_last']:.6f}")
+        if s.get("mfu_p50") is not None:
+            out.append(f"MFU @ p50 step time: {s['mfu_p50']:.2%} "
+                       f"(peak {h.get('peak_flops', 0):.0f} FLOP/s assumed)")
+    wd = rep.get("watchdogs") or {}
+    if wd.get("recompile_steps"):
+        out.append("RECOMPILES after warmup at steps: "
+                   + ", ".join(str(r["step"])
+                               for r in wd["recompile_steps"]))
+    if wd.get("memory_growth"):
+        out.append("MEMORY GROWTH flagged at steps: "
+                   + ", ".join(str(g["step"]) for g in wd["memory_growth"]))
+    for v in rep.get("validations", [])[-4:]:
+        out.append(f"validation @ step {v.get('step')}: "
+                   f"{v.get('method')} = {v.get('value'):.6f}")
+    if rep.get("host_spans"):
+        out.append("host spans (total sec):")
+        for sp in rep["host_spans"][:8]:
+            out.append(f"  {sp['name']:<20} {sp['sec']:>10.4f}s "
+                       f"x{sp['count']}")
+    dev = rep.get("device")
+    if dev:
+        out.append(f"device plane '{dev['plane']}': span {dev['span_sec']:.4f}s, "
+                   f"busy {dev['busy_event_sec']:.4f}s "
+                   f"({dev.get('busy_fraction', 0):.2%} busy)")
+    if rep.get("top_ops"):
+        out.append("top HLO ops by device time:")
+        for op in rep["top_ops"]:
+            name = op["name"]
+            out.append(f"  {op['pct']:>6.2f}%  {op['sec']:.6f}s  "
+                       f"x{op['count']:<5} {name[:90]}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", help="directory holding telemetry.jsonl")
+    ap.add_argument("--xplane", default=None,
+                    help="xplane trace dir (default: RUN_DIR/xplane)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many HLO ops to list")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report dict as JSON")
+    args = ap.parse_args(argv)
+    rep = build_report(args.run_dir, xplane_dir=args.xplane, top=args.top)
+    print(json.dumps(rep, indent=2) if args.json else format_report(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
