@@ -63,6 +63,130 @@ def _honor_env_platforms():
     enable_compilation_cache()
 
 
+# --------------------------------------------------------------------------- #
+# Input-pipeline micro-benchmark (ISSUE 2): synthetic per-sample host
+# latency, synchronous vs PrefetchDataSet, data-wait fraction measured
+# from the StepTelemetry JSONL via tools/obs_report.build_report.
+# --------------------------------------------------------------------------- #
+
+def _obs_report_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_bench_obs_report",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _pipeline_leg(run_dir, num_workers, latency_s, steps, batch,
+                  queue_depth=8, hidden=3072):
+    """One training leg (synchronous when ``num_workers == 0``) with a
+    ``latency_s``-per-sample synthetic transform; returns the obs_report
+    ``steps`` block for the leg's telemetry JSONL."""
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.dataset import (FnTransformer, SampleToMiniBatch,
+                                   array_dataset)
+    from bigdl_tpu.observability import StepTelemetry
+
+    rng = np.random.default_rng(0)
+    # one epoch covers the whole run: an epoch rollover re-creates the
+    # pipeline (reshuffle semantics), and the queue-refill stall would
+    # measure epoch churn rather than steady-state pipeline behaviour
+    n = batch * max(8, steps + 2)
+    x = rng.standard_normal((n, 16)).astype("float32")
+    y = rng.integers(0, 4, n).astype("int32")
+
+    def slow_identity(sample):
+        time.sleep(latency_s)       # the injected host-side transform cost
+        return sample
+
+    ds = (array_dataset(x, y) >> FnTransformer(slow_identity)
+          >> SampleToMiniBatch(batch))
+    if num_workers:
+        ds = ds.prefetch(num_workers=num_workers, queue_depth=queue_depth)
+    # enough device work per step that a hidden transform actually shows
+    # up as a lower data-wait FRACTION, not just a lower absolute wait
+    model = (nn.Sequential().add(nn.Linear(16, hidden)).add(nn.ReLU())
+             .add(nn.Linear(hidden, hidden)).add(nn.ReLU())
+             .add(nn.Linear(hidden, 4)))
+    tel = StepTelemetry(run_dir, run_name=f"pipe-w{num_workers}",
+                        trace=False)
+    opt = optim.LocalOptimizer(model, ds, nn.CrossEntropyCriterion(),
+                               optim.SGD(learning_rate=0.05))
+    opt.set_end_when(optim.Trigger.max_iteration(steps))
+    opt.set_telemetry(tel)
+    opt.optimize()
+    tel.close()
+    return _obs_report_module().build_report(run_dir)["steps"]
+
+
+def run_pipeline_bench(latency_s=None, steps=None, batch=None,
+                       num_workers=None, hidden=None, out_dir=None):
+    """A/B the input pipeline: synchronous vs prefetch workers.
+
+    Knobs (env tier): BENCH_PIPE_LATENCY_MS (default 5), BENCH_PIPE_STEPS
+    (default 24), BENCH_PIPE_BATCH (default 32), BENCH_PIPE_WORKERS
+    (default 4), BENCH_PIPE_HIDDEN (default 3072 -- sized so the device
+    step is comparable to the injected transform cost; a hidden
+    transform then shows up as a lower data-wait FRACTION, not just a
+    lower absolute wait).  Prints ONE JSON record whose ``vs_baseline``
+    is the data-wait-fraction reduction factor (>= 2 is the ISSUE-2
+    target).
+    """
+    _honor_env_platforms()
+    import tempfile
+
+    env = os.environ
+    latency_s = (float(env.get("BENCH_PIPE_LATENCY_MS", "5")) / 1e3
+                 if latency_s is None else latency_s)
+    steps = int(env.get("BENCH_PIPE_STEPS", "24")) if steps is None else steps
+    batch = int(env.get("BENCH_PIPE_BATCH", "32")) if batch is None else batch
+    num_workers = (int(env.get("BENCH_PIPE_WORKERS", "4"))
+                   if num_workers is None else num_workers)
+    hidden = (int(env.get("BENCH_PIPE_HIDDEN", "3072"))
+              if hidden is None else hidden)
+
+    def _run(base):
+        sync = _pipeline_leg(os.path.join(base, "sync"), 0,
+                             latency_s, steps, batch, hidden=hidden)
+        pre = _pipeline_leg(os.path.join(base, f"prefetch{num_workers}"),
+                            num_workers, latency_s, steps, batch,
+                            hidden=hidden)
+        return sync, pre
+
+    if out_dir is None:
+        with tempfile.TemporaryDirectory() as td:
+            sync, pre = _run(td)
+    else:
+        sync, pre = _run(out_dir)
+    reduction = (sync["data_wait_fraction"]
+                 / max(pre["data_wait_fraction"], 1e-9))
+    record = {
+        "metric": "pipeline_data_wait_fraction_reduction",
+        "value": round(reduction, 2),
+        "unit": "x",
+        "vs_baseline": round(reduction / 2.0, 4),   # target: >= 2x
+        "extra": {
+            "latency_ms_per_sample": latency_s * 1e3,
+            "steps": steps, "batch": batch, "num_workers": num_workers,
+            "hidden": hidden,
+            "sync": {"data_wait_fraction": sync["data_wait_fraction"],
+                     "wall_s_p50": sync["wall_s_p50"]},
+            "prefetch": {"data_wait_fraction": pre["data_wait_fraction"],
+                         "wall_s_p50": pre["wall_s_p50"],
+                         "queue": pre.get("prefetch_queue")},
+        },
+    }
+    print(json.dumps(record), flush=True)
+    return record
+
+
 def run_bench():
     """Run the benchmark in-process and print the result JSON line.
 
@@ -388,6 +512,11 @@ def _spawn_child(extra_env, timeout):
 
 
 def main():
+    if os.environ.get("BENCH_PIPELINE") or "pipeline" in sys.argv[1:]:
+        # input-pipeline A/B: in-process and CPU-runnable (no TPU probe /
+        # retry orchestration -- the measurement is host-side by design)
+        run_pipeline_bench()
+        return
     if os.environ.get("BENCH_CHILD"):
         if os.environ.get("BENCH_FAKE_HANG"):  # test hook: dead-tunnel sim
             time.sleep(100000)

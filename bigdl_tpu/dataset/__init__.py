@@ -9,6 +9,7 @@ from bigdl_tpu.dataset.dataset import (
     AbstractDataSet, LocalDataSet, TransformedDataSet, DistributedDataSet,
     array_dataset,
 )
+from bigdl_tpu.dataset.prefetch import PrefetchDataSet
 from bigdl_tpu.dataset.distributed import (
     ListPartitionSource, PartitionedDataSet, PartitionedSource, RDDSource,
     rdd_dataset)

@@ -36,6 +36,15 @@ class AbstractDataSet:
         """Reference's ``->`` composition (dataset/DataSet.scala:87)."""
         return self.transform(transformer)
 
+    def prefetch(self, num_workers: int = 2, queue_depth: int = 4):
+        """Run this dataset's transformer chain in background worker
+        threads feeding a bounded queue (``dataset/prefetch.py``) --
+        the TPU-native analogue of the reference's per-partition Spark
+        task threads.  Terminal: apply AFTER the full ``>>`` chain."""
+        from bigdl_tpu.dataset.prefetch import PrefetchDataSet
+        return PrefetchDataSet(self, num_workers=num_workers,
+                               queue_depth=queue_depth)
+
 
 class LocalDataSet(AbstractDataSet):
     """In-memory dataset over a list/array of elements (reference:

@@ -39,6 +39,13 @@ class MiniBatch:
     def get_target(self):
         return self.target
 
+    def tree(self):
+        """``(input, target)`` as ONE pytree (target may be None -- an
+        empty subtree), so the host->device move is a single
+        ``jax.device_put`` over the whole batch instead of a blocking
+        per-leaf conversion."""
+        return self.input, self.target
+
     def size(self) -> int:
         leaf = self.input
         while isinstance(leaf, (tuple, list)):

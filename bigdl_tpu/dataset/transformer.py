@@ -14,7 +14,14 @@ from bigdl_tpu.dataset.minibatch import (PaddingParam, Sample,
 
 
 class Transformer:
-    """apply(iterator) -> iterator; compose with ``a >> b`` (reference ``->``)."""
+    """apply(iterator) -> iterator; compose with ``a >> b`` (reference ``->``).
+
+    A stage that maps elements INDEPENDENTLY (no cross-element state, no
+    batching) may additionally define ``apply_one(x) -> y``; the async
+    input pipeline (``dataset/prefetch.py``) fans such stages out across
+    worker threads while order-dependent stages (``SampleToMiniBatch``)
+    run serially on the reordered stream.
+    """
 
     def apply(self, it: Iterator) -> Iterator:
         raise NotImplementedError
@@ -35,10 +42,25 @@ class ChainedTransformer(Transformer):
 
 
 class FnTransformer(Transformer):
-    """Wrap a per-element function."""
+    """Wrap a per-element function.
 
-    def __init__(self, fn):
+    ``parallel_safe`` (default True) declares that ``fn`` is pure per
+    element, so ``.prefetch()`` may fan it across worker threads.  Pass
+    ``parallel_safe=False`` for a stateful fn -- one drawing from a
+    shared seeded RNG (random augmentation), or mutating captured state
+    -- which must run single-threaded in source order to keep the
+    prefetched batch sequence identical to the synchronous path.
+    """
+
+    def __init__(self, fn, parallel_safe: bool = True):
         self.fn = fn
+        if not parallel_safe:
+            # shadow the class method: prefetch's split_parallel sees no
+            # usable apply_one and keeps this stage on the serial path
+            self.apply_one = None
+
+    def apply_one(self, x):
+        return self.fn(x)
 
     def apply(self, it):
         return (self.fn(x) for x in it)
@@ -81,7 +103,9 @@ class Normalizer(Transformer):
         self.mean = np.asarray(mean, np.float32)
         self.std = np.asarray(std, np.float32)
 
+    def apply_one(self, s):
+        return Sample((np.asarray(s.feature, np.float32) - self.mean)
+                      / self.std, s.label)
+
     def apply(self, it):
-        for s in it:
-            yield Sample((np.asarray(s.feature, np.float32) - self.mean)
-                         / self.std, s.label)
+        return (self.apply_one(s) for s in it)

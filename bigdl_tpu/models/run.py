@@ -59,12 +59,19 @@ def _build_optimizer(args, model, train_ds, val_ds, criterion, method,
     from bigdl_tpu.utils.engine import Engine
 
     Engine.init()
+    if getattr(args, "num_workers", 0):
+        # async input pipeline (docs/performance.md, Input pipeline):
+        # transform workers + bounded queue in front of the driver loop
+        train_ds = train_ds.prefetch(num_workers=args.num_workers,
+                                     queue_depth=args.queue_depth)
     route = strategy_kw or {"distributed": args.distributed}
     opt = Optimizer(model=model, dataset=train_ds, criterion=criterion,
                     optim_method=method, **route)
     opt.set_end_when(Trigger.max_epoch(args.max_epoch)
                      if args.max_iteration is None
                      else Trigger.max_iteration(args.max_iteration))
+    if getattr(args, "sync_every", 1) != 1:
+        opt.set_sync_every(args.sync_every)
     if val_ds is not None and val_methods:
         opt.set_validation(Trigger.every_epoch(), val_ds, val_methods)
     if args.checkpoint:
@@ -92,6 +99,12 @@ def _common_flags(p, default_epochs=5):
     p.add_argument("--model", default=None,
                    help="snapshot to load (resume / test)")
     p.add_argument("--synthN", type=int, default=2048, dest="synth_n")
+    p.add_argument("--numWorkers", type=int, default=0, dest="num_workers",
+                   help="prefetch transform workers (0 = synchronous)")
+    p.add_argument("--queueDepth", type=int, default=4, dest="queue_depth",
+                   help="prefetch queue depth (batches held ahead)")
+    p.add_argument("--syncEvery", type=int, default=1, dest="sync_every",
+                   help="block on the device loss every k-th step only")
 
 
 def cmd_lenet_train(args):

@@ -7,7 +7,10 @@ One ``StepTelemetry`` instance owns a run directory and produces:
   and the compiled step's ``cost_analysis`` flops/bytes when attached);
   every training step appends a ``kind: "step"`` event carrying the
   split timers (``wall_s`` / ``data_wait_s`` / ``device_s``), loss,
-  ``records_per_s``, epoch/step counters, and per-device memory stats.
+  ``records_per_s``, epoch/step counters, per-device memory stats,
+  the deferred-loss-sync staleness (``sync_skew``, 0 when the loss is
+  fresh) and -- when a ``PrefetchDataSet`` feeds the run -- the
+  prefetch queue occupancy (``queue_depth`` / ``queue_capacity``).
 - ``trace.json`` -- chrome-trace host spans (see ``spans.SpanTracer``),
   viewable in Perfetto next to the device xplane traces.
 

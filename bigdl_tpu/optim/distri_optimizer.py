@@ -32,6 +32,7 @@ from bigdl_tpu.parallel.zero import FlatParamSpace
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.random_generator import RNG
+from bigdl_tpu.utils.compat import shard_map
 
 log = logging.getLogger("bigdl_tpu.optim")
 
@@ -137,7 +138,7 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
     def wrap(opt_state_eval):
         opt_specs = jax.tree.map(opt_spec, opt_state_eval)
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 step_body,
                 mesh=mesh,
                 in_specs=(P(), P(), opt_specs, P(axis), P(axis), P()),
@@ -314,9 +315,14 @@ class DistriOptimizer(BaseOptimizer):
                 step, params_flat, mstate, opt_state, xc, tc,
                 jax.random.key(0), records_per_step=global_batch)
 
-        def dispatch(batch):
+        def stage_device(batch):
+            # global sharded arrays assembled while the previous step
+            # executes (driver-loop double buffering)
+            return self._shard_batch(batch, batch_sharding)
+
+        def dispatch(staged):
             nonlocal params_flat, mstate, opt_state
-            x, target = self._shard_batch(batch, batch_sharding)
+            x, target = staged
             params_flat, mstate, opt_state, loss = step(
                 params_flat, mstate, opt_state, x, target, RNG.next_key())
             return loss
@@ -349,6 +355,7 @@ class DistriOptimizer(BaseOptimizer):
         # (reference driverState counts global records)
         self._run_driver_loop(
             train_iter, first_batch, dispatch=dispatch,
+            stage_device=stage_device,
             records_of=lambda b: b.size() * jax.process_count(),
             validate_cb=validate_cb, feed_plateau=feed_plateau,
             checkpoint_cb=checkpoint_cb)

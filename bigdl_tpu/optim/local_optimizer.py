@@ -20,7 +20,7 @@ import numpy as np
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
-from bigdl_tpu.optim.train_step import make_eval_step, make_train_step
+from bigdl_tpu.optim.train_step import make_train_step
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.utils import file_io
@@ -38,9 +38,12 @@ PREDICTED_END = object()
 
 
 def _device_batch(batch):
-    x = jax.tree.map(jnp.asarray, batch.get_input())
-    t = batch.get_target()
-    return x, (None if t is None else jax.tree.map(jnp.asarray, t))
+    """ONE async ``jax.device_put`` over the whole ``(input, target)``
+    tree -- a single dispatch that the runtime overlaps with in-flight
+    compute, replacing the old per-leaf blocking ``jnp.asarray`` walk.
+    The batch is never donated (``donate_argnums`` on the train step
+    covers params/mstate/opt_state only), so donation is unaffected."""
+    return jax.device_put(batch.tree())
 
 
 class BaseOptimizer:
@@ -66,6 +69,7 @@ class BaseOptimizer:
         self.clip_value = None
         self.clip_norm = None
         self.telemetry = None
+        self.sync_every = 1
         #: host-side counters: data_wait_s vs device_s per step (the
         #: reference's Metrics accumulators, optim/Metrics.scala:31)
         self.metrics = Metrics()
@@ -163,6 +167,21 @@ class BaseOptimizer:
     def set_compute_dtype(self, dtype):
         """bf16 mixed precision (TPU-native; no reference analogue)."""
         self.compute_dtype = dtype
+        return self
+
+    def set_sync_every(self, k: int):
+        """Block on the device loss only every ``k``-th step (default 1 =
+        the classic per-step sync).  With ``k > 1`` the host loop keeps
+        dispatching ahead of the device, so XLA's async dispatch actually
+        pipelines steps; loss/throughput in logs and telemetry are then
+        fresh only at sync points (``sync_skew`` in the step event counts
+        the staleness).  Output-reading triggers (min_loss/max_score)
+        force ``k = 1``, and a validation or checkpoint firing forces a
+        point sync, so Plateau schedules always see a fresh loss
+        (docs/performance.md, Input pipeline)."""
+        if int(k) < 1:
+            raise ConfigurationError(f"sync_every must be >= 1, got {k}")
+        self.sync_every = int(k)
         return self
 
     def set_optim_methods(self, methods):
@@ -421,31 +440,59 @@ class BaseOptimizer:
                     "Parameters" + keystr(path), np.asarray(leaf),
                     state["neval"])
 
-    def _log_progress(self, loss, throughput, data_wait_s=0.0):
+    def _log_progress(self, loss, throughput, data_wait_s=0.0, sync_skew=0):
         s = self.driver_state
+        shown = "%.6f" % loss
+        if sync_skew:   # deferred sync: the loss is sync_skew steps stale
+            shown += " [%d-step-old sync]" % sync_skew
         log.info(
-            "Epoch %d [iteration %d] loss %.6f, %.1f records/s "
+            "Epoch %d [iteration %d] loss %s, %.1f records/s "
             "(data-wait %.1f ms)",
-            s["epoch"], s["neval"], loss, throughput, data_wait_s * 1e3)
+            s["epoch"], s["neval"], shown, throughput, data_wait_s * 1e3)
+
+    def _effective_sync_every(self):
+        """``sync_every`` collapsed to 1 when a configured trigger reads
+        step OUTPUTS (min_loss/max_score): those predicates consult
+        state["loss"]/["score"] on every evaluation, which a deferred
+        sync would leave stale.  Count-based triggers keep the deferred
+        cadence -- validation/checkpoint firings force a point sync in
+        the loop instead, so Plateau schedules (including monitor="loss")
+        always record against a fresh value."""
+        k = max(1, int(getattr(self, "sync_every", 1)))
+        if k == 1:
+            return 1
+        for t in (self.end_trigger, self.validation_trigger,
+                  self.checkpoint_trigger):
+            if t is not None and getattr(t, "uses_outputs", False):
+                log.info(
+                    "sync_every=%d forced to 1: a configured trigger "
+                    "reads step outputs (loss/score) every step", k)
+                return 1
+        return k
 
     def _run_driver_loop(self, train_iter, first_batch, *, dispatch,
-                        records_of=None, extra_summaries=None,
-                        validate_cb=None, feed_plateau=None,
-                        checkpoint_cb=None):
+                        stage_device=None, records_of=None,
+                        extra_summaries=None, validate_cb=None,
+                        feed_plateau=None, checkpoint_cb=None):
         """The ONE training driver loop shared by Local/Distri/Strategy
         optimizers (they differ only in the step signature and how
         batches reach the devices, injected via the callbacks).
 
         Encodes the staging/trigger choreography that must not diverge:
         the next batch is prefetched while the device executes the
-        current step (``float(loss)`` is the sync point), the end
-        trigger is evaluated exactly once per completed step, and the
-        fetch is DEFERRED past the trigger decision for stateful /
-        output-reading triggers (round-3 liveness fix -- an eager fetch
-        one batch past the end blocks forever on a stream dataset).
+        current step, its host->device transfer is started immediately
+        (double buffering: batch k+1 rides the wire while step k
+        executes), the end trigger is evaluated exactly once per
+        completed step, and the fetch is DEFERRED past the trigger
+        decision for stateful / output-reading triggers (round-3
+        liveness fix -- an eager fetch one batch past the end blocks
+        forever on a stream dataset).
 
-        - ``dispatch(batch) -> device loss``: runs the step; owns the
-          params/opt_state closure.
+        - ``dispatch(staged) -> device loss``: runs the step on the
+          device-staged payload; owns the params/opt_state closure.
+        - ``stage_device(batch) -> staged``: start the batch's
+          host->device move (async; placed on the step's sharding).
+          Default identity for drivers that stage inside dispatch.
         - ``records_of(batch)``: global records this step (default
           ``batch.size()``).
         - ``extra_summaries(state)``: extra train-summary scalars
@@ -455,22 +502,57 @@ class BaseOptimizer:
           caller thread the Plateau schedule through its opt_state.
         - ``checkpoint_cb(state)``: write a checkpoint.
 
-        Timing is split, not conflated: ``data_wait_s`` covers the
-        deferred (unoverlapped) fetch at the top of the iteration, and
-        ``device_s`` covers dispatch -> loss sync (which already
-        overlaps the prefetch of the next batch).  Both go to
-        ``self.metrics`` and, when a ``StepTelemetry`` is attached, into
-        one structured JSONL event per step that the TensorBoard
-        scalars are also derived from (single source of truth).
+        The per-step loss sync (``float(loss)``) runs every
+        ``sync_every``-th step only (default 1 = classic behavior; see
+        ``set_sync_every``): between syncs the host stays ahead of the
+        device and ``sync_skew`` in the step event counts the staleness
+        of the reported loss.  A validation or checkpoint firing forces
+        a point sync so downstream consumers (Plateau schedules,
+        checkpointed driver state) always see a fresh loss.
+
+        Timing is split, not conflated: ``data_wait_s`` is ALL host
+        input work this step -- the deferred fetch at the top of the
+        iteration, the in-loop fetch/transform of the next batch, and
+        both batches' device staging -- while ``device_s`` (= wall -
+        data_wait) covers dispatch + loss sync, the device-bound
+        remainder.  A synchronous transformer chain therefore shows up
+        as data-wait even though the device computes concurrently: that
+        host time bounds how far the loop can run ahead, and it is
+        exactly what ``PrefetchDataSet`` moves off the critical path.
+        Both timers go to ``self.metrics`` and, when a ``StepTelemetry``
+        is attached, into one structured JSONL event per step that the
+        TensorBoard scalars are also derived from (single source of
+        truth); a prefetching dataset additionally contributes its
+        ``queue_depth``/``queue_capacity`` occupancy to each event.
         """
         self._reshuffle_pending = False   # no stale flag from a prior run
         epoch_size = self.dataset.size()
         state = self.driver_state
         batch = first_batch
+        dev = None                        # device-staged payload for `batch`
         records_of = records_of or (lambda b: b.size())
+        stage_device = stage_device or (lambda b: b)
+        queue_stats = getattr(self.dataset, "queue_stats", None)
+        sync_every = self._effective_sync_every()
+        loss = float("nan")               # last synced loss value
+        # primed so the FIRST step always syncs: every published loss is
+        # a real (at worst stale) value, never the NaN placeholder, and
+        # the warmup compile lands in a synced step
+        sync_skew = sync_every - 1        # steps since the last loss sync
+        loss_dev = None
         tel = self.telemetry
         sp = tel.span if tel is not None else \
             (lambda name, **kw: contextlib.nullcontext())
+
+        def point_sync(reason):
+            """Force a loss sync outside the cadence (validation/
+            checkpoint firing): consumers there need a fresh value."""
+            nonlocal loss, sync_skew
+            with sp("loss_sync", step=state["neval"], forced=reason):
+                loss = float(loss_dev)
+            sync_skew = 0
+            state["loss"] = loss
+
         try:
             while not self.end_trigger(state):
                 t0 = time.perf_counter()
@@ -478,17 +560,37 @@ class BaseOptimizer:
                     with sp("data_wait", step=state["neval"]):
                         batch, train_iter = self._stage_next_batch(
                             train_iter, state, 0, epoch_size, force=True)
+                if dev is None:    # first iteration / deferred-fetch path
+                    with sp("device_stage", step=state["neval"]):
+                        dev = stage_device(batch)
                 data_wait = time.perf_counter() - t0
                 if tel is not None:   # open the no-compile watchdog window
                     tel.step_begin(state["neval"])
                 with sp("dispatch", step=state["neval"]):
-                    loss_dev = dispatch(batch)
+                    loss_dev = dispatch(dev)
                 n = records_of(batch)
+                qdepth = queue_stats() if queue_stats is not None else None
+                t_fetch = time.perf_counter()
                 with sp("stage_next_batch", step=state["neval"]):
                     next_batch, train_iter = self._stage_next_batch(
                         train_iter, state, n, epoch_size)
-                with sp("loss_sync", step=state["neval"]):
-                    loss = float(loss_dev)
+                next_dev = None
+                if next_batch is not None and next_batch is not PREDICTED_END:
+                    # double buffering: batch k+1's host->device transfer
+                    # overlaps step k's execution
+                    with sp("device_stage", step=state["neval"] + 1):
+                        next_dev = stage_device(next_batch)
+                # the in-loop fetch runs while the device executes, but it
+                # is still host time the loop cannot dispatch through --
+                # the input-pipeline cost prefetch workers are there to
+                # take off this path
+                data_wait += time.perf_counter() - t_fetch
+                if sync_skew + 1 >= sync_every:
+                    with sp("loss_sync", step=state["neval"]):
+                        loss = float(loss_dev)
+                    sync_skew = 0
+                else:
+                    sync_skew += 1    # deferred: host runs ahead of device
                 wall = time.perf_counter() - t0
                 device_s = wall - data_wait
                 state["loss"] = loss
@@ -499,10 +601,14 @@ class BaseOptimizer:
                 event = {"step": state["neval"], "epoch": state["epoch"],
                          "wall_s": wall, "data_wait_s": data_wait,
                          "device_s": device_s, "loss": loss, "records": n,
-                         "records_per_s": state["throughput"]}
+                         "records_per_s": state["throughput"],
+                         "sync_skew": sync_skew}
+                if qdepth is not None:
+                    event["queue_depth"], event["queue_capacity"] = qdepth
                 if tel is not None:
                     tel.record_step(event)
-                self._log_progress(loss, state["throughput"], data_wait)
+                self._log_progress(loss, state["throughput"], data_wait,
+                                   sync_skew)
                 if self.train_summary is not None:
                     # scalars derive from the SAME event dict the JSONL
                     # records -- the two channels cannot disagree
@@ -527,12 +633,16 @@ class BaseOptimizer:
 
                 if (self.validation_trigger is not None
                         and self.validation_trigger(state)):
+                    if sync_skew:
+                        point_sync("validation")
                     with sp("validation", step=state["neval"]):
                         self._record_validation(validate_cb(), state)
                         if feed_plateau is not None:
                             feed_plateau(state)
                 if (self.checkpoint_trigger is not None
                         and self.checkpoint_trigger(state)):
+                    if sync_skew:
+                        point_sync("checkpoint")
                     # snapshot the RNG stream position with the counters
                     state["rng_state"] = RNG.get_state()
                     with sp("checkpoint", step=state["neval"]):
@@ -541,7 +651,15 @@ class BaseOptimizer:
                 # next_batch None = deferred: the top-of-loop fetch runs
                 # only after the end trigger decided training continues
                 batch = None if next_batch is PREDICTED_END else next_batch
+                dev = next_dev
+            if sync_skew and loss_dev is not None:
+                # drain: the run's final loss lands in driver_state even
+                # when the last steps deferred their sync
+                point_sync("drain")
         finally:
+            shutdown = getattr(self.dataset, "shutdown", None)
+            if callable(shutdown):
+                shutdown()    # prefetch workers must not outlive the run
             if tel is not None:
                 tel.flush()   # artifacts complete even on an exception
 
@@ -582,9 +700,9 @@ class LocalOptimizer(BaseOptimizer):
                 step, params, mstate, opt_state, xc, tc, jax.random.key(0),
                 records_per_step=first_batch.size())
 
-        def dispatch(batch):
+        def dispatch(staged):
             nonlocal params, mstate, opt_state
-            x, target = _device_batch(batch)
+            x, target = staged
             params, mstate, opt_state, loss = step(
                 params, mstate, opt_state, x, target, RNG.next_key())
             return loss
@@ -599,6 +717,7 @@ class LocalOptimizer(BaseOptimizer):
 
         self._run_driver_loop(
             train_iter, first_batch, dispatch=dispatch,
+            stage_device=_device_batch,
             extra_summaries=extra_summaries,
             validate_cb=lambda: validate(
                 self.model, params, mstate, self.validation_dataset,
@@ -614,12 +733,16 @@ class LocalOptimizer(BaseOptimizer):
 
 def validate(model, params, mstate, dataset, methods, compute_dtype=None):
     """Shared evaluation loop (reference: optim/Evaluator.scala /
-    DistriValidator)."""
-    eval_step = jax.jit(make_eval_step(model, compute_dtype))
+    DistriValidator).
+
+    The jitted eval step is cached per (model, dtype) in
+    ``validation.compiled_eval_step`` -- a fresh ``jax.jit`` wrapper per
+    call would silently recompile on EVERY validation interval."""
+    from bigdl_tpu.optim.validation import compiled_eval_step
+    eval_step = compiled_eval_step(model, compute_dtype)
     totals: List[Optional[ValidationResult]] = [None] * len(methods)
     for batch in dataset.data(train=False):
-        x = jax.tree.map(jnp.asarray, batch.get_input())
-        target = jax.tree.map(jnp.asarray, batch.get_target())
+        x, target = jax.device_put((batch.get_input(), batch.get_target()))
         out = eval_step(params, mstate, x)
         for i, m in enumerate(methods):
             r = m(out, target)

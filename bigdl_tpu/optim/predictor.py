@@ -22,7 +22,7 @@ import numpy as np
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.minibatch import Sample, samples_to_minibatch
 from bigdl_tpu.observability.spans import span
-from bigdl_tpu.optim.train_step import make_eval_step
+from bigdl_tpu.optim.validation import compiled_eval_step
 
 
 class Predictor:
@@ -41,10 +41,12 @@ class Predictor:
         self.model = model
         self.batch_size = batch_size
         self.telemetry = telemetry
-        self._eval = jax.jit(make_eval_step(model, compute_dtype))
+        # shared per-(model, dtype) compiled step: a Predictor built for
+        # an already-validated model reuses validation's executable
+        self._eval = compiled_eval_step(model, compute_dtype)
 
     def predict_minibatch(self, batch):
-        x = jax.tree.map(jnp.asarray, batch.get_input())
+        x = jax.device_put(batch.get_input())   # one async tree transfer
         return self._eval(self.model.parameters()[0], self.model.state(), x)
 
     def _span(self, name, **kw):
@@ -54,21 +56,27 @@ class Predictor:
         return span(name, **kw)
 
     def predict(self, data) -> List[np.ndarray]:
-        """data: AbstractDataSet of MiniBatches, or list of Samples."""
+        """data: AbstractDataSet of MiniBatches, or list of Samples.
+
+        The batch-k+1 fetch overlaps batch k's device execution (the
+        eval dispatch is async; the host sync is the ``np.asarray``
+        readback), mirroring the training loop's staging choreography.
+        """
         outs = []
         it = self._batches(data)
+        with self._span("predict_fetch"):
+            batch = next(it, None)
         step = 0
-        while True:
+        while batch is not None:
             t0 = time.perf_counter()
-            with self._span("predict_fetch"):
-                batch = next(it, None)
-            if batch is None:
-                break
-            data_wait = time.perf_counter() - t0
             step += 1
             with self._span("predict_batch", step=step):
-                y = self.predict_minibatch(batch)
-                outs.extend(np.asarray(y))   # host sync
+                y = self.predict_minibatch(batch)   # async dispatch
+                tf = time.perf_counter()
+                with self._span("predict_fetch"):
+                    next_batch = next(it, None)     # overlapped fetch
+                data_wait = time.perf_counter() - tf
+                outs.extend(np.asarray(y))          # host sync
             if self.telemetry is not None:
                 wall = time.perf_counter() - t0
                 n = batch.size()
@@ -76,6 +84,7 @@ class Predictor:
                     "inference", step=step, wall_s=wall,
                     data_wait_s=data_wait, device_s=wall - data_wait,
                     records=n, records_per_s=n / max(wall, 1e-9))
+            batch = next_batch
         return outs
 
     def predict_class(self, data) -> List[int]:

@@ -395,10 +395,16 @@ class StrategyOptimizer(BaseOptimizer):
                 step, params, opt_state, xc, yc, jax.random.key(0),
                 records_per_step=first_batch.size())
 
-        def dispatch(batch):
-            nonlocal params, opt_state
+        def stage_device(batch):
+            # strategy-native placement (per-leaf shardings) started while
+            # the previous step executes (driver-loop double buffering)
             x = jax.tree.map(place, batch.get_input())
             y = jax.tree.map(place, batch.get_target())
+            return x, y
+
+        def dispatch(staged):
+            nonlocal params, opt_state
+            x, y = staged
             params, opt_state, loss = step(params, opt_state, x, y,
                                            RNG.next_key())
             return loss
@@ -432,6 +438,7 @@ class StrategyOptimizer(BaseOptimizer):
 
         self._run_driver_loop(
             train_iter, first_batch, dispatch=dispatch,
+            stage_device=stage_device,
             extra_summaries=extra_summaries, validate_cb=validate_cb,
             feed_plateau=feed_plateau, checkpoint_cb=checkpoint_cb)
 

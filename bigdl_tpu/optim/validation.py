@@ -6,10 +6,43 @@ MAE, HitRatio, NDCG) and optim/ValidationResult (mergeable partial results).
 Each method has a pure, jit-able kernel ``batch_result(output, target) ->
 (numerator, denominator)``; results merge with ``+`` across batches and
 devices (a psum on the distributed path).
+
+``compiled_eval_step`` additionally owns the cache of jitted eval steps
+keyed per (model, compute dtype): the evaluation loop
+(``local_optimizer.validate``) and the serving path (``optim.Predictor``)
+share one compiled program per model instead of each ``jax.jit`` call
+site paying its own XLA compile -- previously every validation interval
+recompiled the eval step from scratch.
 """
 
 import jax.numpy as jnp
 import numpy as np
+
+
+def compiled_eval_step(model, compute_dtype=None):
+    """The jitted eval step for ``model`` at ``compute_dtype``, compiled
+    once per (model, dtype).  A NEW ``jax.jit`` wrapper per call would
+    recompile on every invocation (fresh closure identity); reusing the
+    wrapper makes repeat validation/serving hit jax's trace cache, so
+    the RecompileWatchdog stays silent across intervals.
+
+    The cache lives ON the model instance (the jitted closure references
+    the model anyway, so a side table keyed by model -- even weakly --
+    would pin every model it ever saw); dropping the model drops its
+    compiled executables with it.  The serializer walks the module
+    structure, not ``__dict__``, so the attribute never leaks into
+    saved artifacts."""
+    import jax
+
+    from bigdl_tpu.optim.train_step import make_eval_step
+
+    cache = model.__dict__.setdefault("_compiled_eval_steps", {})
+    key = "f32" if compute_dtype is None else np.dtype(compute_dtype).name
+    fn = cache.get(key)
+    if fn is None:
+        fn = jax.jit(make_eval_step(model, compute_dtype))
+        cache[key] = fn
+    return fn
 
 
 class ValidationResult:

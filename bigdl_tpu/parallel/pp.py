@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.nn.module import child_rng
+from bigdl_tpu.utils.compat import shard_map
 
 
 def stack_stage_params(model, n_stages: int):
@@ -199,7 +200,7 @@ def make_pp_loss_fn(model, criterion, mesh, n_microbatches: int,
     smap_kwargs = {}
     if manual_axes is not None:
         smap_kwargs["axis_names"] = frozenset(manual_axes)
-    smapped = jax.shard_map(
+    smapped = shard_map(
         per_device, mesh=mesh,
         in_specs=({"embed": P(), "stages": P(pipe_axis), "tail": P()},
                   batch_spec, batch_spec, P()),
@@ -437,7 +438,7 @@ def make_pp_1f1b_train_step(model, criterion, optim_method, mesh,
         # per-stage vjp from the argument shardings (pp_tp_shardings),
         # exactly as on the GPipe path
         smap_kwargs["axis_names"] = frozenset(manual_axes)
-    smapped = jax.shard_map(
+    smapped = shard_map(
         per_device, mesh=mesh,
         in_specs=({"embed": P(), "stages": P(pipe_axis), "tail": P()},
                   batch_spec, batch_spec, P()),

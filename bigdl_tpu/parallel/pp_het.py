@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 
 from bigdl_tpu.nn.module import child_rng
 from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
+from bigdl_tpu.utils.compat import shard_map
 
 
 def partition_sequential(model, n_stages: int,
@@ -206,7 +207,7 @@ def make_het_pp_train_step(model, criterion, optim_method, mesh,
         return loss
 
     batch_spec = P(None, data_axis) if data_axis else P()
-    smapped = jax.shard_map(
+    smapped = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(), batch_spec, batch_spec, P()),
         out_specs=P(),

@@ -24,6 +24,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+from bigdl_tpu.utils.compat import shard_map
 
 
 def ring_self_attention(q, k, v, axis_name: str, causal: bool = False):
@@ -81,7 +82,7 @@ def sequence_shard_attention(q, k, v, mesh, axis_name="seq", causal=False):
     """Convenience wrapper: global (B, T, H, D) arrays -> shard_map'd ring."""
     from jax.sharding import PartitionSpec as P
 
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_self_attention, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),

@@ -17,6 +17,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
+from bigdl_tpu.utils.compat import shard_map
 
 
 def make_sp_train_step(model, criterion, optim_method, mesh,
@@ -57,7 +58,7 @@ def make_sp_train_step(model, criterion, optim_method, mesh,
         return new_params, new_opt, lax.pmean(loss, axes)
 
     batch_spec = P(data_axis, seq_axis)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         step_body,
         mesh=mesh,
         in_specs=(P(), P(), batch_spec, batch_spec, P()),
@@ -80,7 +81,7 @@ def make_sp_eval_step(model, mesh, seq_axis: str = "seq",
         return out.astype(jnp.float32)
 
     batch_spec = P(data_axis, seq_axis)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         fwd, mesh=mesh,
         in_specs=(P(), batch_spec),
         out_specs=batch_spec,

@@ -1,0 +1,31 @@
+"""jax version compatibility seams.
+
+The stack targets the current jax API; where an installed jax predates a
+rename, the shim maps the new spelling onto the old one so the SAME call
+sites run on both.  Keep this module dependency-light: it is imported
+from inside step builders.
+"""
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+    """``jax.shard_map`` (new API) with fallback to
+    ``jax.experimental.shard_map.shard_map`` (pre-0.5 jax), where the
+    replication-checking flag was spelled ``check_rep`` instead of
+    ``check_vma``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma, **kw)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` (new API) with the classic
+    ``psum(1, axis)`` fallback where the helper is absent."""
+    import jax.lax as lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

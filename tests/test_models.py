@@ -197,6 +197,9 @@ class TestTransformerFamily:
             first = first if first is not None else float(loss)
         assert float(loss) < first * 0.75, (first, float(loss))
 
+    # heavy 8-device shard_map compile: full/slow CI tier (the dryrun
+    # drives the same CLI strategy paths)
+    @pytest.mark.slow
     def test_cli_sp_path(self):
         from bigdl_tpu.models import run
 
@@ -204,6 +207,9 @@ class TestTransformerFamily:
                   "--synthN", "32", "--vocab", "32", "--seq-len", "16",
                   "-b", "8", "--learningRate", "0.003"])
 
+    # heavy 8-device shard_map compile: full/slow CI tier (the dryrun
+    # drives the same CLI strategy paths)
+    @pytest.mark.slow
     def test_cli_pp_path(self):
         """transformer-train --pp routes through the strategy facade
         (gpipe and 1f1b schedules) with the full builder surface."""

@@ -20,6 +20,12 @@ from bigdl_tpu.nn.attention import TransformerLM
 from bigdl_tpu.nn.moe import MoETransformerLM
 from bigdl_tpu.utils.random_generator import RNG
 
+requires_modern_jax = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="old-jax compat fallback lacks the donation/resharding "
+           "semantics this test depends on")
+
+
 pytestmark = pytest.mark.skipif(
     jax.device_count() < 8, reason="needs the 8-device virtual CPU mesh")
 
@@ -118,6 +124,9 @@ class TestPPEquivalence:
 
 
 class Test3DComposition:
+    # old-jax (pre-0.5, utils/compat.py fallback) lacks the donation/
+    # resharding semantics this path depends on; auto-re-enables on new jax
+    @requires_modern_jax
     def test_pp_tp_dp_one_step_matches_single_device(self):
         """3-D mesh (data x pipe x model): GPipe shard_map manual on
         data/pipe, Megatron shardings on the model axis left to GSPMD
@@ -159,6 +168,9 @@ class Test3DComposition:
 
 
 class TestEPEquivalence:
+    # old-jax (pre-0.5, utils/compat.py fallback) lacks the donation/
+    # resharding semantics this path depends on; auto-re-enables on new jax
+    @requires_modern_jax
     def test_one_step_matches_single_device(self):
         from bigdl_tpu.parallel.ep import (ep_shard_params,
                                            init_ep_opt_state,
@@ -255,6 +267,9 @@ class TestSyncBatchNorm:
         opt.optimize()
         return model, float(opt.driver_state["loss"])
 
+    # heavy 8-device shard_map compile: full/slow CI tier (tier-1 keeps a
+    # cheaper gate for this path)
+    @pytest.mark.slow
     def test_sync_bn_matches_single_device_tightly(self):
         model_d, loss_d, (x, y) = self._one_step(sync=True)
         model_l, loss_l = self._local_step(x, y)
@@ -270,6 +285,9 @@ class TestSyncBatchNorm:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-3, atol=5e-4)
 
+    # heavy 8-device shard_map compile: full/slow CI tier (tier-1 keeps a
+    # cheaper gate for this path)
+    @pytest.mark.slow
     def test_per_shard_default_drifts(self):
         """Default per-shard stats (reference per-replica semantics) give a
         CLOSE but not tight loss -- documents why sync is opt-in."""

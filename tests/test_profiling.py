@@ -78,6 +78,9 @@ class TestCollectiveComputeProgram:
 
 
 class TestProfilerTrace:
+    # heavy 8-device shard_map compile: full/slow CI tier (tier-1 keeps a
+    # cheaper gate for this path)
+    @pytest.mark.slow
     def test_trace_capture_of_distri_step(self, tmp_path):
         step, args = _build_step()
         pf, ms, os_, loss = step(*args)      # warmup (donated buffers)

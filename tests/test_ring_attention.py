@@ -14,6 +14,7 @@ from bigdl_tpu.nn.attention import (MultiHeadAttention, TransformerLM,
 from bigdl_tpu.parallel.ring_attention import sequence_shard_attention
 from bigdl_tpu.parallel.sequence import make_sp_train_step, shard_tokens
 from bigdl_tpu.utils.random_generator import RNG
+from bigdl_tpu.utils.compat import shard_map
 
 
 def seq_mesh(n=8):
@@ -51,6 +52,9 @@ class TestRingAttention:
             np.asarray(got, np.float32), np.asarray(want, np.float32),
             rtol=0.1, atol=0.05)
 
+    # heavy 8-device shard_map compile: full/slow CI tier (tier-1 keeps a
+    # cheaper gate for this path)
+    @pytest.mark.slow
     def test_grads_flow_through_ring(self):
         q, k, v = rand_qkv(t=16)
         mesh = seq_mesh()
@@ -87,7 +91,7 @@ class TestSequenceParallelTransformer:
         y_local = local.forward(jnp.asarray(x))
 
         mesh = seq_mesh()
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda p, xx: sp.apply(p, (), xx, training=False)[0],
             mesh=mesh, in_specs=(P(), P(None, "seq")),
             out_specs=P(None, "seq"), check_vma=False))
@@ -95,6 +99,9 @@ class TestSequenceParallelTransformer:
         np.testing.assert_allclose(np.asarray(y_sp), np.asarray(y_local),
                                    rtol=2e-4, atol=2e-4)
 
+    # heavy 8-device shard_map compile: full/slow CI tier (tier-1 keeps a
+    # cheaper gate for this path)
+    @pytest.mark.slow
     def test_sp_train_step_matches_local_step(self):
         x, y = self._tokens()
         mesh = seq_mesh()

@@ -14,6 +14,7 @@ from bigdl_tpu.nn.attention import TransformerLM, dot_product_attention
 from bigdl_tpu.parallel.sequence import make_sp_train_step, shard_tokens
 from bigdl_tpu.parallel.ulysses import ulysses_self_attention
 from bigdl_tpu.utils.random_generator import RNG
+from bigdl_tpu.utils.compat import shard_map
 
 pytestmark = pytest.mark.skipif(
     jax.device_count() < 8, reason="needs the 8-device virtual CPU mesh")
@@ -30,7 +31,7 @@ def _rand_qkv(b=2, t=32, h=4, d=8):
 
 
 def _sharded(q, k, v, mesh, causal):
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda a, b, c: ulysses_self_attention(a, b, c, "seq",
                                                causal=causal),
         mesh=mesh, in_specs=(P(None, "seq"),) * 3,

@@ -124,6 +124,28 @@ def build_report(run_dir, xplane_dir=None, top=10):
             "loss_first": steps[0].get("loss"),
             "loss_last": steps[-1].get("loss"),
         }
+        skews = [e.get("sync_skew", 0) for e in steps]
+        if any(skews):
+            # deferred loss sync was active: loss/throughput per step are
+            # fresh only at sync points (sync_skew counts the staleness)
+            rep["steps"]["sync_skew_max"] = max(skews)
+        # prefetch-queue occupancy: a STARVED queue (occupancy pinned at
+        # 0 -> high data-wait) is a pipeline problem; a FULL one with high
+        # wall times is a slow device.  Percentiles make the two
+        # distinguishable at a glance.
+        depths = sorted(e["queue_depth"] for e in steps
+                        if "queue_depth" in e)
+        if depths:
+            caps = [e.get("queue_capacity") for e in steps
+                    if e.get("queue_capacity")]
+            rep["steps"]["prefetch_queue"] = {
+                "depth_p10": percentile(depths, 10),
+                "depth_p50": percentile(depths, 50),
+                "depth_p90": percentile(depths, 90),
+                "capacity": max(caps) if caps else None,
+                "starved_fraction": sum(1 for d in depths if d == 0)
+                / len(depths),
+            }
         # MFU: flops of the compiled step over the median step's wall
         # time.  Cost lives on the header, or on a later standalone
         # "cost" event when attach_cost ran after the lazy header write.
@@ -191,6 +213,17 @@ def format_report(rep):
         out.append(f"data-wait fraction: {s['data_wait_fraction']:.2%}   "
                    f"records/s p50: {s['records_per_s_p50']:.1f}   "
                    f"records total: {s['records_total']}")
+        q = s.get("prefetch_queue")
+        if q:
+            cap = q["capacity"] if q["capacity"] is not None else "?"
+            out.append(
+                f"prefetch queue occupancy p10/p50/p90: "
+                f"{q['depth_p10']}/{q['depth_p50']}/{q['depth_p90']} "
+                f"of {cap}   starved {q['starved_fraction']:.1%} of steps")
+        if s.get("sync_skew_max"):
+            out.append(f"deferred loss sync: skew up to "
+                       f"{s['sync_skew_max']} steps (loss/throughput "
+                       f"fresh at sync points only)")
         out.append(f"loss: {s['loss_first']:.6f} -> {s['loss_last']:.6f}")
         if s.get("mfu_p50") is not None:
             out.append(f"MFU @ p50 step time: {s['mfu_p50']:.2%} "
