@@ -1,6 +1,6 @@
 """Unified training/inference observability.
 
-Three layers over the one shared driver loop:
+Four layers over the one shared driver loop:
 
 - ``StepTelemetry`` -- structured per-step JSONL events (split
   wall/data-wait/device timers, loss, records/s, memory stats) plus a
@@ -10,21 +10,34 @@ Three layers over the one shared driver loop:
 - ``RecompileWatchdog`` / ``MemoryWatchdog`` -- WARNING-level detectors
   for silent per-step recompiles and monotonic device-memory growth
   (``watchdogs.py``).
+- ``HealthMonitor`` + ``NonFiniteWatchdog`` / ``LossSpikeWatchdog`` --
+  sampled ON-DEVICE numerics stats fused into the jitted train step
+  (per-layer grad norms, update ratios, non-finite counts) with a
+  warn/dump/halt anomaly policy and re-executable incident bundles
+  (``health.py``).
 
 ``tools/obs_report.py`` merges a run's JSONL + xplane trace into one
 report; the event schema is documented in ``docs/observability.md``.
 """
 
+from bigdl_tpu.observability.health import (HealthMonitor, dump_incident,
+                                            global_grad_norm, layer_labels,
+                                            load_incident,
+                                            per_layer_grad_norms)
 from bigdl_tpu.observability.spans import SpanTracer, span
 from bigdl_tpu.observability.telemetry import (StepTelemetry,
                                                device_memory_stats,
                                                peak_flops)
-from bigdl_tpu.observability.watchdogs import (MemoryWatchdog,
+from bigdl_tpu.observability.watchdogs import (LossSpikeWatchdog,
+                                               MemoryWatchdog,
+                                               NonFiniteWatchdog,
                                                RecompileWatchdog,
                                                backend_compile_count)
 
 __all__ = [
     "StepTelemetry", "SpanTracer", "span", "RecompileWatchdog",
-    "MemoryWatchdog", "backend_compile_count", "device_memory_stats",
-    "peak_flops",
+    "MemoryWatchdog", "NonFiniteWatchdog", "LossSpikeWatchdog",
+    "HealthMonitor", "backend_compile_count", "device_memory_stats",
+    "peak_flops", "layer_labels", "per_layer_grad_norms",
+    "global_grad_norm", "dump_incident", "load_incident",
 ]

@@ -1,0 +1,614 @@
+"""Model-health observability: on-device numerics telemetry + incident dumps.
+
+PR 1's ``StepTelemetry`` sees *timing*; this module sees *numerics*.
+The fused XLA train step is a black box between batch-in and loss-out,
+so a diverging run otherwise surfaces only as a NaN loss many steps
+after the first bad gradient, with no record of which layer or which
+batch caused it.  Three pieces close that gap:
+
+- **On-device stats** (``tree_health_stats`` / ``flat_health_stats``):
+  a small tree -- loss, global grad norm, per-layer grad norms,
+  per-layer update-to-weight ratios, per-layer non-finite counts for
+  grads and params -- computed INSIDE the jitted step under
+  ``jax.lax.cond`` every ``stats_every``-th step, so non-sample steps
+  pay nothing and ``stats_every=None`` is bit-identical to the plain
+  step.  All three drivers emit the same tree: the local step computes
+  it on the param tree, the dp+ZeRO-1 step on the flat chunk plane via
+  ``segment_sum`` + ``psum`` (replica-consistent post-collective), and
+  the model-parallel strategies via ``HealthProbeMethod``, an
+  OptimMethod proxy that computes the stats where the full logical
+  gradient tree is in scope and threads them through the optimizer
+  state.
+
+- **``HealthMonitor``**: the host-side policy engine.  On each sampled
+  step it builds a ``kind: "health"`` telemetry event, feeds the
+  ``NonFiniteWatchdog`` / ``LossSpikeWatchdog`` (``watchdogs.py``) and
+  applies the configured policy: ``warn`` logs, ``dump`` additionally
+  writes an incident bundle, ``halt`` additionally raises
+  ``TrainingHaltedError`` (never retried by the failure-retry loop).
+
+- **Incident bundles** (``dump_incident`` / ``load_incident``): the
+  offending ``MiniBatch``, the last *healthy* params/opt-state/RNG
+  snapshot, the ring of recent step+health events and an env/config
+  manifest -- enough to re-execute the failing step offline
+  (docs/observability.md, "Incident bundles").
+
+Schema and overhead trade-offs are documented in docs/observability.md.
+"""
+
+import json
+import logging
+import os
+import time
+from collections import deque
+
+import numpy as np
+
+from bigdl_tpu.utils.errors import ConfigurationError, TrainingHaltedError
+
+log = logging.getLogger("bigdl_tpu.observability")
+
+#: watchdog-response policies, in escalation order: each includes the
+#: previous one's behavior (halt also dumps, dump also warns)
+POLICIES = ("warn", "dump", "halt")
+
+#: reserved optimizer-state keys used by HealthProbeMethod (strategy
+#: drivers thread the stats tree through opt_state under these)
+HEALTH_STATE_KEY = "__health__"
+HEALTH_STEP_KEY = "__health_neval__"
+
+
+# --------------------------------------------------------------------------- #
+# Tree flattening with stable per-layer labels (shared with
+# utils/gradient_checker.py -- ONE naming scheme for "which layer").
+# --------------------------------------------------------------------------- #
+
+
+def flatten_with_labels(tree):
+    """-> (labels, leaves, treedef) where ``labels[i]`` is the keystr
+    path of ``leaves[i]``.  Leaf order matches ``jax.tree.leaves`` (and
+    therefore ``ravel_pytree``), so the labels index every per-layer
+    stats vector this module produces."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    leaves_with_path, treedef = tree_flatten_with_path(tree)
+    labels = [keystr(path) for path, _ in leaves_with_path]
+    leaves = [leaf for _, leaf in leaves_with_path]
+    return labels, leaves, treedef
+
+
+def layer_labels(tree):
+    """Per-leaf labels in ``jax.tree.leaves`` order."""
+    return flatten_with_labels(tree)[0]
+
+
+# --------------------------------------------------------------------------- #
+# On-device stats (traceable; safe under jit / GSPMD / shard_map).
+# --------------------------------------------------------------------------- #
+
+
+def per_layer_sq_norms(tree):
+    """fp32 squared L2 norm per leaf, stacked to a length-L vector."""
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.stack([jnp.sum(jnp.square(l.astype(jnp.float32)))
+                      for l in jax.tree.leaves(tree)])
+
+
+def per_layer_grad_norms(tree):
+    """L2 norm per leaf -- the helper the health telemetry and
+    GradientChecker share, so "layer 12's grad norm" means the same
+    number in both."""
+    import jax.numpy as jnp
+
+    return jnp.sqrt(per_layer_sq_norms(tree))
+
+
+def global_grad_norm(tree):
+    import jax.numpy as jnp
+
+    return jnp.sqrt(jnp.sum(per_layer_sq_norms(tree)))
+
+
+def _per_layer_nonfinite(tree):
+    import jax
+    import jax.numpy as jnp
+
+    def count(l):
+        if not jnp.issubdtype(l.dtype, jnp.floating):
+            return jnp.zeros((), jnp.int32)
+        return jnp.sum(~jnp.isfinite(l)).astype(jnp.int32)
+
+    return jnp.stack([count(l) for l in jax.tree.leaves(tree)])
+
+
+def _update_ratios(usq, psq):
+    """||update|| / ||weight|| per layer; a zero-norm layer (fresh
+    zero-initialized bias) reports its raw update norm instead -- the
+    classic eps-denominator definition turns those into meaningless
+    1e+10 ratios that drown the real signal."""
+    import jax.numpy as jnp
+
+    return jnp.where(psq > 0,
+                     jnp.sqrt(usq) / jnp.sqrt(jnp.maximum(psq, 1e-30)),
+                     jnp.sqrt(usq))
+
+
+def tree_health_stats(grads, params, new_params, loss):
+    """The on-device stats tree (scalars + length-L vectors, replicated).
+
+    ``grads`` should be the POST-aggregation, PRE-clip gradient -- clip
+    would hide exactly the explosions this exists to surface.  The
+    update-to-weight ratio uses the applied update (``new - old``), so
+    clipping/freezing are reflected there.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    gsq = per_layer_sq_norms(grads)
+    psq = per_layer_sq_norms(params)
+    usq = per_layer_sq_norms(
+        jax.tree.map(lambda n, p: n.astype(jnp.float32) - p.astype(jnp.float32),
+                     new_params, params))
+    return {
+        "loss": jnp.asarray(loss, jnp.float32),
+        "grad_norm": jnp.sqrt(jnp.sum(gsq)),
+        "layer_grad_norms": jnp.sqrt(gsq),
+        "layer_update_ratios": _update_ratios(usq, psq),
+        "layer_nonfinite_grads": _per_layer_nonfinite(grads),
+        "layer_nonfinite_params": _per_layer_nonfinite(new_params),
+        "sampled": jnp.ones((), jnp.bool_),
+    }
+
+
+def empty_health_stats(n_layers):
+    """The cond false-branch / placeholder tree (``sampled`` = False)."""
+    import jax.numpy as jnp
+
+    L = int(n_layers)
+    return {
+        "loss": jnp.zeros((), jnp.float32),
+        "grad_norm": jnp.zeros((), jnp.float32),
+        "layer_grad_norms": jnp.zeros((L,), jnp.float32),
+        "layer_update_ratios": jnp.zeros((L,), jnp.float32),
+        "layer_nonfinite_grads": jnp.zeros((L,), jnp.int32),
+        "layer_nonfinite_params": jnp.zeros((L,), jnp.int32),
+        "sampled": jnp.zeros((), jnp.bool_),
+    }
+
+
+def layer_segment_ids(params_tree, padded_size):
+    """int32 layer-id map for the ZeRO-1 flat plane: element i of the
+    padded flat vector belongs to leaf ``ids[i]`` (padding rides in the
+    extra segment L and is dropped by ``flat_health_stats``).  Host-side;
+    the result is device_put with the flat vector's sharding so each
+    device naturally holds its chunk's ids."""
+    import jax
+
+    sizes = [int(np.prod(np.shape(l), dtype=np.int64))
+             for l in jax.tree.leaves(params_tree)]
+    ids = np.repeat(np.arange(len(sizes), dtype=np.int32), sizes)
+    return np.pad(ids, (0, int(padded_size) - ids.size),
+                  constant_values=len(sizes))
+
+
+def flat_health_stats(gchunk, pchunk, new_pchunk, loss, seg_chunk,
+                      n_layers, axis):
+    """ZeRO-1 chunk variant of ``tree_health_stats``: per-layer sums via
+    ``segment_sum`` over this device's layer-id slice, then ``psum`` over
+    the data axis -- every replica ends with the identical stats for the
+    GLOBAL mean gradient / parameter plane, so device 0 suffices."""
+    import jax
+    import jax.numpy as jnp
+
+    L = int(n_layers)
+
+    def seg(values):
+        per_dev = jax.ops.segment_sum(values, seg_chunk,
+                                      num_segments=L + 1)[:L]
+        return jax.lax.psum(per_dev, axis)
+
+    gsq = seg(jnp.square(gchunk.astype(jnp.float32)))
+    psq = seg(jnp.square(pchunk.astype(jnp.float32)))
+    usq = seg(jnp.square((new_pchunk - pchunk).astype(jnp.float32)))
+    nf_g = seg((~jnp.isfinite(gchunk)).astype(jnp.int32))
+    nf_p = seg((~jnp.isfinite(new_pchunk)).astype(jnp.int32))
+    return {
+        "loss": jnp.asarray(loss, jnp.float32),
+        "grad_norm": jnp.sqrt(jnp.sum(gsq)),
+        "layer_grad_norms": jnp.sqrt(gsq),
+        "layer_update_ratios": _update_ratios(usq, psq),
+        "layer_nonfinite_grads": nf_g,
+        "layer_nonfinite_params": nf_p,
+        "sampled": jnp.ones((), jnp.bool_),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Strategy seam: an OptimMethod proxy (the tp/pp/sp/ep step factories all
+# call ``optim_method.update`` on the full logical gradient tree -- the one
+# place inside those steps where grads, params and new params coexist).
+# --------------------------------------------------------------------------- #
+
+
+class HealthProbeMethod:
+    """OptimMethod proxy computing the health-stats tree inside the
+    strategy engines' jitted steps.
+
+    The stats ride in the optimizer state under ``HEALTH_STATE_KEY`` /
+    ``HEALTH_STEP_KEY`` (a device-side sample counter drives the
+    ``lax.cond``); the proxy filters them back out before delegating to
+    the base method, so base methods that rebuild their state dict
+    (Adam & friends) and ones that preserve unknown keys (SGD, Plateau's
+    ``record``) both compose.  ``shard_opt_state`` replicates the health
+    leaves (their structure never matches the param shardings), which is
+    exactly right: they are post-collective scalars/vectors.
+
+    Wrap OUTSIDE any clipping proxy so the stats see the pre-clip
+    gradient, matching ``make_train_step``'s placement.
+    """
+
+    def __init__(self, base, stats_every):
+        self._base = base
+        self._stats_every = int(stats_every)
+
+    def init_state(self, params):
+        import jax
+        import jax.numpy as jnp
+
+        state = dict(self._base.init_state(params))
+        state[HEALTH_STATE_KEY] = empty_health_stats(
+            len(jax.tree.leaves(params)))
+        state[HEALTH_STEP_KEY] = jnp.zeros((), jnp.int32)
+        return state
+
+    def update(self, grads, opt_state, params):
+        import jax
+        import jax.numpy as jnp
+
+        base_state = {k: v for k, v in opt_state.items()
+                      if k not in (HEALTH_STATE_KEY, HEALTH_STEP_KEY)}
+        new_params, new_base = self._base.update(grads, base_state, params)
+        counter = opt_state[HEALTH_STEP_KEY]
+        n_layers = len(jax.tree.leaves(grads))
+        stats = jax.lax.cond(
+            counter % self._stats_every == 0,
+            # loss is not in scope inside update(); the driver loop
+            # substitutes its (point-synced) loss into the host event
+            lambda: tree_health_stats(grads, params, new_params,
+                                      jnp.nan),
+            lambda: empty_health_stats(n_layers))
+        new_state = dict(new_base)
+        new_state[HEALTH_STATE_KEY] = stats
+        new_state[HEALTH_STEP_KEY] = counter + 1
+        return new_params, new_state
+
+    def __getattr__(self, name):   # schedule, get_learning_rate, ...
+        return getattr(self._base, name)
+
+
+# --------------------------------------------------------------------------- #
+# Host-side event building.
+# --------------------------------------------------------------------------- #
+
+
+def build_health_event(raw, labels, loss=None):
+    """Fetched device stats -> the JSONL-ready ``health`` event fields.
+
+    ``labels`` index the per-layer vectors (``layer_labels`` of the tree
+    the step computed stats on).  ``loss`` overrides the device tree's
+    loss (the strategy proxy has no loss in scope; the driver loop's
+    point-synced loss is substituted everywhere for consistency).
+    """
+    gn = np.asarray(raw["layer_grad_norms"], np.float64)
+    ur = np.asarray(raw["layer_update_ratios"], np.float64)
+    nfg = np.asarray(raw["layer_nonfinite_grads"], np.int64)
+    nfp = np.asarray(raw["layer_nonfinite_params"], np.int64)
+    n = min(len(labels), gn.size)
+    loss = float(raw["loss"]) if loss is None else float(loss)
+
+    # worst layer: any layer carrying non-finite values wins outright;
+    # otherwise the largest grad norm
+    worst = None
+    if n:
+        bad = (~np.isfinite(gn[:n])) | (nfg[:n] > 0) | (nfp[:n] > 0)
+        idx = int(np.argmax(bad)) if bad.any() else \
+            int(np.nanargmax(np.where(np.isfinite(gn[:n]), gn[:n], -1.0)))
+        worst = labels[idx]
+    layers = {
+        labels[i]: {
+            "grad_norm": float(gn[i]),
+            "update_ratio": float(ur[i]),
+            "nonfinite_grads": int(nfg[i]),
+            "nonfinite_params": int(nfp[i]),
+        }
+        for i in range(n)
+    }
+    return {
+        "loss": loss,
+        "grad_norm": float(raw["grad_norm"]),
+        "update_ratio_max": float(np.max(ur)) if ur.size else 0.0,
+        "nonfinite_grads": int(nfg.sum()),
+        "nonfinite_params": int(nfp.sum()),
+        "worst_layer": worst,
+        "layers": layers,
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Incident bundles.
+# --------------------------------------------------------------------------- #
+
+
+def _json_safe(obj):
+    """Non-finite floats -> None, recursively: manifest.json must parse
+    under strict JSON consumers (jq, JS) -- and the canonical incident
+    is exactly a NaN blow-up.  Raw values live on in events.jsonl."""
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
+def dump_incident(out_dir, finding, event, batch=None, snapshot=None,
+                  recent_events=(), extra_manifest=None):
+    """Write one incident bundle directory and return its path.
+
+    Layout (docs/observability.md):
+
+    - ``manifest.json``  -- step/watchdog/worst-layer detail, env header
+      (jax version, devices), layer labels, snapshot provenance
+    - ``batch.pkl``      -- the offending host ``MiniBatch`` (pickle via
+      ``file_io.save``: numpy trees, structure preserved)
+    - ``snapshot.pkl``   -- last HEALTHY ``{"params", ..., "rng_state"}``
+      host snapshot (absent when snapshotting is off)
+    - ``events.jsonl``   -- ring of the last N step/health events
+    """
+    from bigdl_tpu.utils import file_io
+
+    d = os.path.join(out_dir,
+                     "step_%06d_%s" % (int(finding.get("step", 0)),
+                                       finding.get("watchdog", "anomaly")))
+    os.makedirs(d, exist_ok=True)
+    manifest = {
+        "schema_version": 1,
+        "created": time.time(),
+        "finding": {k: v for k, v in finding.items() if k != "layers"},
+        "health_event": {k: v for k, v in event.items() if k != "layers"},
+        "layers": event.get("layers"),
+    }
+    try:
+        import jax
+        dev = jax.devices()[0]
+        manifest["env"] = {
+            "jax_version": jax.__version__,
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", ""),
+            "device_count": jax.device_count(),
+        }
+    except Exception:
+        pass
+    if snapshot is not None:
+        manifest["snapshot_step"] = snapshot.get("step")
+        file_io.save({k: v for k, v in snapshot.items() if k != "step"},
+                     os.path.join(d, "snapshot.pkl"))
+    if batch is not None:
+        # saved as the (input, target) pytree: file_io.save maps leaves
+        # to numpy, and load_incident rebuilds the MiniBatch
+        file_io.save({"input": batch.get_input(),
+                      "target": batch.get_target()},
+                     os.path.join(d, "batch.pkl"))
+    if extra_manifest:
+        manifest.update(extra_manifest)
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(_json_safe(manifest), f, indent=2, allow_nan=False)
+        f.flush()
+        os.fsync(f.fileno())
+    with open(os.path.join(d, "events.jsonl"), "w") as f:
+        for ev in recent_events:
+            f.write(json.dumps(ev) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return d
+
+
+def load_incident(bundle_dir):
+    """-> {"manifest", "batch", "snapshot", "events"} (absent artifacts
+    load as None/[]).  The snapshot's ``params``/``mstate``/``opt_state``
+    are numpy trees; ``rng_state`` restores via ``RNG.set_state`` --
+    together with ``batch`` that re-executes the failing step (see
+    tests/test_health.py for the end-to-end recipe)."""
+    from bigdl_tpu.utils import file_io
+
+    out = {"manifest": None, "batch": None, "snapshot": None, "events": []}
+    man = os.path.join(bundle_dir, "manifest.json")
+    if os.path.isfile(man):
+        with open(man) as f:
+            out["manifest"] = json.load(f)
+    p = os.path.join(bundle_dir, "batch.pkl")
+    if os.path.isfile(p):
+        from bigdl_tpu.dataset.minibatch import MiniBatch
+        data = file_io.load(p)
+        out["batch"] = MiniBatch(data["input"], data["target"])
+    p = os.path.join(bundle_dir, "snapshot.pkl")
+    if os.path.isfile(p):
+        out["snapshot"] = file_io.load(p)
+    ev = os.path.join(bundle_dir, "events.jsonl")
+    if os.path.isfile(ev):
+        with open(ev, errors="replace") as f:
+            for ln in f:
+                try:
+                    out["events"].append(json.loads(ln))
+                except ValueError:
+                    continue
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# The monitor: sampling cadence + watchdog policy engine.
+# --------------------------------------------------------------------------- #
+
+
+class HealthMonitor:
+    """Host-side driver of the sampled numerics telemetry.
+
+    >>> opt.set_health_monitor(stats_every=10, policy="dump")
+
+    ``stats_every=K`` samples steps 1, K+1, 2K+1, ... (None disables --
+    the train step is then bit-identical to the plain one).  A sample
+    forces a loss point sync under ``set_sync_every(k>1)``, exactly like
+    a validation trigger.
+
+    ``policy`` escalation: ``warn`` logs WARNINGs, ``dump`` additionally
+    writes an incident bundle per anomaly (at most ``max_incidents``),
+    ``halt`` additionally raises ``TrainingHaltedError`` (which the
+    failure-retry loop re-raises instead of restoring a checkpoint --
+    retrying a numerics blow-up replays it).
+
+    ``snapshot``: keep a host copy of the last HEALTHY sampled
+    params/opt-state/RNG so a bundle can re-execute the failing step.
+    Defaults to on for ``dump``/``halt`` (it costs a device->host
+    transfer of the params per sampled step; see the overhead notes in
+    docs/observability.md).
+    """
+
+    def __init__(self, stats_every=10, policy="warn", spike_sigma=6.0,
+                 spike_beta=0.9, spike_warmup=5, history=64,
+                 incident_dir=None, max_incidents=4, snapshot=None):
+        from bigdl_tpu.observability.watchdogs import (LossSpikeWatchdog,
+                                                       NonFiniteWatchdog)
+        if stats_every is not None and int(stats_every) < 1:
+            raise ConfigurationError(
+                f"stats_every must be >= 1 (or None to disable), "
+                f"got {stats_every}")
+        if policy not in POLICIES:
+            raise ConfigurationError(
+                f"unknown health policy {policy!r}; expected one of "
+                f"{POLICIES}")
+        self.stats_every = None if stats_every is None else int(stats_every)
+        self.policy = policy
+        self.nonfinite_watchdog = NonFiniteWatchdog()
+        self.loss_spike_watchdog = LossSpikeWatchdog(
+            sigma=spike_sigma, beta=spike_beta, warmup=spike_warmup)
+        self.recent = deque(maxlen=int(history))
+        self.incidents = []           # bundle dirs written this run
+        self.max_incidents = int(max_incidents)
+        self.samples = 0
+        self._snapshot_enabled = (policy != "warn") if snapshot is None \
+            else bool(snapshot)
+        self._incident_dir = incident_dir
+        self._labels = []
+        self._params_fn = None
+        self._snap = None             # last healthy host snapshot
+
+    # ----- driver binding --------------------------------------------------- #
+    @property
+    def enabled(self):
+        return self.stats_every is not None
+
+    def due(self, neval):
+        """True when step ``neval`` (1-based) is a sample step.  Matches
+        the device-side counter in every step builder: steps 1, K+1, ..."""
+        return self.enabled and (int(neval) - 1) % self.stats_every == 0
+
+    def bind(self, labels, params_fn=None):
+        """Driver handshake before the loop: per-layer ``labels`` for
+        the stats vectors and a ``params_fn`` returning a host snapshot
+        of the live training state (for incident bundles).  Takes the
+        initial snapshot immediately, so an anomaly on the FIRST
+        sampled step still bundles a re-executable pre-step state."""
+        self._labels = list(labels)
+        self._params_fn = params_fn
+        if self._snapshot_enabled and self._params_fn is not None:
+            self._take_snapshot(step=0)
+        return self
+
+    def _take_snapshot(self, step):
+        from bigdl_tpu.utils.random_generator import RNG
+        try:
+            snap = {"step": int(step), "state": self._params_fn(),
+                    "rng_state": RNG.get_state()}
+        except Exception:
+            log.exception("health snapshot failed at step %d "
+                          "(incident bundles will lack params)", step)
+            return
+        self._snap = snap
+
+    def note_event(self, event):
+        """Ring-buffer a step/health event for incident bundles."""
+        self.recent.append(dict(event))
+
+    # ----- the sampled-step hook -------------------------------------------- #
+    def on_sample(self, state, raw_stats, loss=None, batch=None,
+                  telemetry=None, summary=None):
+        """Handle one fetched stats tree: build + record the ``health``
+        event, run the watchdogs, apply the policy.  Called by the shared
+        driver loop on sample steps; raises ``TrainingHaltedError`` under
+        the ``halt`` policy."""
+        step = int(state.get("neval", 0))
+        self.samples += 1
+        event = {"step": step, "epoch": int(state.get("epoch", 0)),
+                 **build_health_event(raw_stats, self._labels, loss=loss)}
+        if telemetry is not None:
+            telemetry.record("health", **event)
+        if summary is not None:
+            add = getattr(summary, "add_health_event", None)
+            if add is not None:
+                add(event)
+        self.note_event({"kind": "health", **event})
+
+        findings = []
+        f = self.nonfinite_watchdog.observe(step, event)
+        if f:
+            findings.append(f)
+        f = self.loss_spike_watchdog.observe(step, event["loss"])
+        if f:
+            findings.append(f)
+
+        for finding in findings:
+            anomaly = {"policy": self.policy, **finding}
+            if self.policy in ("dump", "halt"):
+                if len(self.incidents) < self.max_incidents:
+                    d = dump_incident(
+                        self._incident_root(telemetry), finding, event,
+                        batch=batch, snapshot=self._snap,
+                        recent_events=list(self.recent),
+                        extra_manifest={"policy": self.policy,
+                                        "stats_every": self.stats_every})
+                    self.incidents.append(d)
+                    anomaly["incident_dir"] = d
+                    log.warning("incident bundle written to %s", d)
+                else:
+                    anomaly["incident_dir"] = None   # cap hit; see earlier
+            if telemetry is not None:
+                telemetry.record("anomaly", **anomaly)
+            self.note_event({"kind": "anomaly", **anomaly})
+
+        if not findings and self._snapshot_enabled \
+                and self._params_fn is not None:
+            self._take_snapshot(step)
+        if findings and self.policy == "halt":
+            raise TrainingHaltedError(
+                "health watchdog halted training at step %d: %s "
+                "(incidents: %s)" % (
+                    step,
+                    "; ".join(f.get("reason", f.get("watchdog", "?"))
+                              for f in findings),
+                    self.incidents or "none"))
+        return event
+
+    def _incident_root(self, telemetry=None):
+        """Explicit ``incident_dir`` wins; else bundles live next to the
+        run's other artifacts (``<telemetry.out_dir>/incidents``); a
+        telemetry-less run falls back to the working directory."""
+        d = self._incident_dir
+        if d is None and telemetry is not None:
+            d = os.path.join(telemetry.out_dir, "incidents")
+        if d is None:
+            d = os.path.join(os.getcwd(), "health_incidents")
+        os.makedirs(d, exist_ok=True)
+        return d

@@ -17,7 +17,12 @@ One ``StepTelemetry`` instance owns a run directory and produces:
 The watchdogs (``watchdogs.py``) ride on the same step cadence:
 ``step_begin``/``record_step`` bracket the no-compile window for the
 recompile detector, and each step's ``bytes_in_use`` feeds the
-memory-growth detector.  ``tools/obs_report.py`` merges the JSONL with
+memory-growth detector.  When a ``HealthMonitor`` is attached
+(``health.py``), sampled steps additionally append ``kind: "health"``
+numerics events (grad norms, update ratios, non-finite counts) and
+``kind: "anomaly"`` watchdog findings -- both fsynced on write, so a
+run that dies right after detecting its own divergence still leaves
+the evidence on disk.  ``tools/obs_report.py`` merges the JSONL with
 an xplane trace into one run report.
 
 The recorder is driver-agnostic: the shared driver loop
@@ -35,6 +40,11 @@ from bigdl_tpu.observability.watchdogs import (MemoryWatchdog,
 
 #: JSONL schema version (bump on breaking key changes)
 SCHEMA_VERSION = 1
+
+#: event kinds that must survive a crash on the NEXT line: flushed AND
+#: fsynced to disk the moment they are recorded (a run that blows up
+#: right after a health anomaly must leave the evidence on disk)
+DURABLE_KINDS = frozenset({"health", "anomaly"})
 
 
 def peak_flops(device=None):
@@ -129,12 +139,19 @@ class StepTelemetry:
 
     # ----- generic event plumbing ----------------------------------------- #
     def record(self, kind, **fields):
-        """Append one JSONL event (header is written lazily first)."""
+        """Append one JSONL event (header is written lazily first).
+        Health/anomaly/incident events are additionally fsynced: they
+        are exactly the lines a crashing run must not lose."""
         if kind != "header" and not self._wrote_header:
             self.write_header()
         event = {"kind": kind, "ts": time.time(), **fields}
         self._f.write(json.dumps(event) + "\n")
         self._f.flush()
+        if kind in DURABLE_KINDS:
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:  # pragma: no cover - exotic filesystems
+                pass
         return event
 
     def write_header(self, **extra):
@@ -246,6 +263,10 @@ class StepTelemetry:
         if not self._wrote_header:
             self.write_header()
         self._f.flush()
+        try:
+            os.fsync(self._f.fileno())    # the artifact is the deliverable
+        except OSError:  # pragma: no cover - exotic filesystems
+            pass
         self._f.close()
         if self.tracer is not None:
             self.tracer.close()           # deactivates + terminates JSON

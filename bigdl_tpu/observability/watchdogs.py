@@ -1,10 +1,12 @@
-"""Silent-failure watchdogs: recompiles and device-memory growth.
+"""Silent-failure watchdogs: recompiles, memory growth, bad numerics.
 
-Two things go wrong on an accelerator without any exception being
-raised: the jitted step silently recompiles every iteration (a shape or
-static-arg leak -- each "step" is now a multi-second XLA compile), and
-device memory creeps up until an OOM hundreds of steps later.  Both are
-invisible in loss curves; both are cheap to detect on the host.
+Things go wrong on an accelerator without any exception being raised:
+the jitted step silently recompiles every iteration (a shape or
+static-arg leak -- each "step" is now a multi-second XLA compile),
+device memory creeps up until an OOM hundreds of steps later, a
+gradient goes non-finite and poisons the params long before the loss
+shows it, or the loss spikes off its trend.  All are invisible in loss
+curves at the moment they start; all are cheap to detect on the host.
 
 ``RecompileWatchdog`` counts backend compiles per step window via
 ``jax.monitoring``'s duration listener (every real XLA compile emits
@@ -15,9 +17,14 @@ WARNING with the offending step number.
 
 ``MemoryWatchdog`` tracks per-device ``bytes_in_use`` and flags a
 monotonic increase sustained across N consecutive observations.
+
+``NonFiniteWatchdog`` / ``LossSpikeWatchdog`` ride the sampled numerics
+stream (``health.HealthMonitor`` feeds them each ``health`` event) and
+back the warn/dump/halt anomaly policy -- see docs/observability.md.
 """
 
 import logging
+import math
 import threading
 
 log = logging.getLogger("bigdl_tpu.observability")
@@ -149,3 +156,101 @@ class MemoryWatchdog:
                     "per-step constants)",
                     dev, self.window, used / 2**20, step)
         return flagged
+
+
+class NonFiniteWatchdog:
+    """Flags the first (and every) health sample carrying non-finite
+    numerics: NaN/Inf in gradients, in the updated params, or in the
+    loss itself.  Because the stats are sampled every ``stats_every``
+    steps INSIDE the compiled step, the firing step bounds when the
+    numerics went bad to one sampling window -- versus the many-steps-
+    later NaN loss that is otherwise the first visible symptom."""
+
+    def __init__(self):
+        self.events = []
+        self.first_step = None        # first sampled step seen non-finite
+
+    def observe(self, step, event):
+        """Feed one ``health`` event dict; returns a finding dict when
+        the sample carries non-finite values, else None."""
+        nf_g = int(event.get("nonfinite_grads", 0))
+        nf_p = int(event.get("nonfinite_params", 0))
+        loss = event.get("loss")
+        loss_bad = loss is not None and not math.isfinite(loss)
+        gn = event.get("grad_norm")
+        gn_bad = gn is not None and not math.isfinite(gn)
+        if not (nf_g or nf_p or loss_bad or gn_bad):
+            return None
+        if self.first_step is None:
+            self.first_step = step
+        worst = event.get("worst_layer")
+        finding = {
+            "watchdog": "nonfinite", "step": step,
+            "nonfinite_grads": nf_g, "nonfinite_params": nf_p,
+            "loss_finite": not loss_bad, "worst_layer": worst,
+            "reason": "non-finite numerics (layer %s)" % worst,
+        }
+        self.events.append(finding)
+        log.warning(
+            "non-finite numerics at step %d: %d grad / %d param elements "
+            "non-finite%s, worst layer %s -- the divergence started within "
+            "the last sampling window",
+            step, nf_g, nf_p, "" if not loss_bad else " (loss non-finite)",
+            worst)
+        return finding
+
+
+class LossSpikeWatchdog:
+    """Flags a loss that jumps ``sigma`` standard deviations above its
+    exponential moving average (EMA of the loss + EMA of its squared
+    deviation, bias-corrected).  The first ``warmup`` samples only train
+    the EMAs -- early training legitimately moves fast."""
+
+    def __init__(self, sigma=6.0, beta=0.9, warmup=5):
+        self.sigma = float(sigma)
+        self.beta = float(beta)
+        self.warmup = int(warmup)
+        self.events = []
+        self._mean = 0.0
+        self._var = 0.0
+        self._n = 0
+
+    def observe(self, step, loss):
+        """Feed one sampled loss; returns a finding dict on a spike,
+        else None.  Non-finite losses are NonFiniteWatchdog's business
+        and only reset nothing here (the EMAs ignore them)."""
+        if loss is None or not math.isfinite(loss):
+            return None
+        finding = None
+        if self._n >= self.warmup:
+            bc = 1.0 - self.beta ** self._n      # bias correction
+            mean = self._mean / bc
+            sd = math.sqrt(max(self._var / bc, 0.0))
+            # absolute + relative floor: a perfectly flat loss stream
+            # must not flag numeric dust as a "spike"
+            sd = max(sd, 1e-8, 1e-3 * abs(mean))
+            threshold = mean + self.sigma * sd
+            if loss > threshold:
+                finding = {
+                    "watchdog": "loss_spike", "step": step,
+                    "loss": float(loss), "ema": mean, "sd": sd,
+                    "sigma": self.sigma,
+                    "reason": "loss %.6g > EMA %.6g + %g sigma (%.6g)"
+                              % (loss, mean, self.sigma, threshold),
+                }
+                self.events.append(finding)
+                log.warning(
+                    "loss spike at step %d: %.6g vs EMA %.6g (+%.1f sigma "
+                    "threshold %.6g)", step, loss, mean, self.sigma,
+                    threshold)
+        # the spiked value still feeds the EMAs: a persistent new level
+        # re-normalizes instead of firing forever
+        self._mean = self.beta * self._mean + (1 - self.beta) * loss
+        # _mean now aggregates n+1 samples -- correct with beta**(n+1):
+        # a stale beta**n here seeds phantom variance on a flat stream,
+        # masking real spikes for dozens of samples after warmup
+        bc = 1.0 - self.beta ** (self._n + 1)
+        dev = loss - self._mean / bc
+        self._var = self.beta * self._var + (1 - self.beta) * dev * dev
+        self._n += 1
+        return finding

@@ -40,7 +40,8 @@ log = logging.getLogger("bigdl_tpu.optim")
 def make_distri_train_step(model, criterion, optim_method, flat_space,
                            mesh, axis="data", compute_dtype=None,
                            clip_value=None, clip_norm=None,
-                           grad_compression=None, sync_bn=False):
+                           grad_compression=None, sync_bn=False,
+                           health_stats=False):
     """Build the per-device step body and its shard_map wrapper.
 
     ``grad_compression``: dtype the gradients ride the wire in (e.g.
@@ -50,12 +51,21 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
     bf16-native so this matters for DCN-crossing mesh axes; the reduction
     output converts back to fp32 before the optimizer update, exactly like
     the reference decompresses after aggregation.
+
+    ``health_stats=True`` appends two traced args (``sample`` bool,
+    ``seg_ids`` = this plane's layer-id map sharded like the flat
+    vector) and a fifth output: the per-layer numerics tree of
+    ``observability.health.flat_health_stats``, computed from each
+    device's chunk via ``segment_sum`` + ``psum`` under ``lax.cond`` --
+    replica-consistent stats of the GLOBAL mean gradient, so device 0
+    suffices and non-sample steps pay nothing.
     """
 
     from bigdl_tpu.nn.module import frozen_param_mask, has_frozen
     from bigdl_tpu.optim.regularizer import (has_regularizers,
                                              regularization_loss)
     use_reg = has_regularizers(model)
+    n_layers = len(jax.tree.leaves(model.parameters()[0]))
     # freeze() support on the flat parameter plane: the static bool mask
     # flattens to a 0/1 vector laid out exactly like the params (padding
     # = 0, i.e. held), chunked per device below
@@ -68,7 +78,8 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
     else:
         freeze_mask_flat = None
 
-    def step_body(params_flat, mstate, opt_state, x, target, rng):
+    def step_body(params_flat, mstate, opt_state, x, target, rng,
+                  sample=None, seg_ids=None):
         # per-device view: params_flat replicated, x/target = this device's shard
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
 
@@ -106,6 +117,14 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
         else:
             gchunk = jax.lax.psum_scatter(gflat, axis, tiled=True)
         gchunk = gchunk / jax.lax.psum(1, axis)
+        mchunk = flat_space.chunk(freeze_mask_flat,
+                                  jax.lax.axis_index(axis)) \
+            if freeze_mask_flat is not None else None
+        # stats gradient: post-freeze (a frozen layer's raw NaN is
+        # harmless -- it never updates params -- and must not trip the
+        # watchdogs), PRE-clip (clip hides explosions); matches
+        # make_train_step's capture point exactly
+        raw_gchunk = gchunk if mchunk is None else gchunk * mchunk
         if clip_value is not None:
             gchunk = clip_by_value(gchunk, *clip_value)
         if clip_norm is not None:
@@ -115,9 +134,7 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
             scale = jnp.minimum(1.0, clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
             gchunk = gchunk * scale
         pchunk = flat_space.chunk(params_flat, jax.lax.axis_index(axis))
-        if freeze_mask_flat is not None:
-            mchunk = flat_space.chunk(freeze_mask_flat,
-                                      jax.lax.axis_index(axis))
+        if mchunk is not None:
             gchunk = gchunk * mchunk
         new_pchunk, new_opt_state = optim_method.update(gchunk, opt_state, pchunk)
         if freeze_mask_flat is not None:
@@ -130,19 +147,42 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
             if jnp.issubdtype(s.dtype, jnp.floating) else s,
             new_mstate)
         loss = jax.lax.pmean(loss, axis)
-        return new_flat, new_mstate, new_opt_state, loss
+        if sample is None:
+            return new_flat, new_mstate, new_opt_state, loss
+        from bigdl_tpu.observability.health import (empty_health_stats,
+                                                    flat_health_stats)
+        stats = jax.lax.cond(
+            sample,
+            lambda: flat_health_stats(raw_gchunk, pchunk, new_pchunk, loss,
+                                      seg_ids, n_layers, axis),
+            lambda: empty_health_stats(n_layers))
+        return new_flat, new_mstate, new_opt_state, loss, stats
 
     def opt_spec(leaf):
         return P(axis) if getattr(leaf, "ndim", 0) >= 1 else P()
 
+    #: every health-stats leaf is replicated (psum'd post-collective)
+    _HEALTH_SPECS = {
+        "loss": P(), "grad_norm": P(), "layer_grad_norms": P(),
+        "layer_update_ratios": P(), "layer_nonfinite_grads": P(),
+        "layer_nonfinite_params": P(), "sampled": P(),
+    }
+
     def wrap(opt_state_eval):
         opt_specs = jax.tree.map(opt_spec, opt_state_eval)
+        if health_stats:
+            in_specs = (P(), P(), opt_specs, P(axis), P(axis), P(),
+                        P(), P(axis))
+            out_specs = (P(), P(), opt_specs, P(), dict(_HEALTH_SPECS))
+        else:
+            in_specs = (P(), P(), opt_specs, P(axis), P(axis), P())
+            out_specs = (P(), P(), opt_specs, P())
         return jax.jit(
             shard_map(
                 step_body,
                 mesh=mesh,
-                in_specs=(P(), P(), opt_specs, P(axis), P(axis), P()),
-                out_specs=(P(), P(), opt_specs, P()),
+                in_specs=in_specs,
+                out_specs=out_specs,
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2),
@@ -203,6 +243,12 @@ class DistriOptimizer(BaseOptimizer):
 
     def _optimize_impl(self):
         from bigdl_tpu.utils.errors import UnsupportedFeatureError
+        if self.grad_transform is not None:
+            raise UnsupportedFeatureError(
+                "set_grad_transform operates on the model's gradient "
+                "TREE; the dp+ZeRO-1 step reduces into per-device chunks "
+                "of the flat plane -- use LocalOptimizer for gradient "
+                "transforms")
         if getattr(self, "_optim_methods_map", None):
             raise UnsupportedFeatureError(
                 "set_optim_methods is incompatible with the dp+ZeRO-1 "
@@ -296,13 +342,32 @@ class DistriOptimizer(BaseOptimizer):
 
         params_flat = jax.device_put(params_flat, rep_sharding)
 
+        mon = self.health_monitor
+        use_health = mon is not None and mon.enabled
         _, wrap = make_distri_train_step(
             self.model, self.criterion, self.optim_method, flat_space,
             self.mesh, self.axis, self.compute_dtype, self.clip_value,
-            self.clip_norm, self.grad_compression, self.sync_bn)
+            self.clip_norm, self.grad_compression, self.sync_bn,
+            health_stats=use_health)
         step = wrap(opt_state_eval)
 
         batch_sharding = NamedSharding(self.mesh, P(self.axis))
+
+        seg_ids = None
+        if use_health:
+            from bigdl_tpu.observability.health import (layer_labels,
+                                                        layer_segment_ids)
+            # layer-id map of the flat plane, sharded like the vector:
+            # each device holds exactly its chunk's ids
+            seg_ids = jax.device_put(
+                jnp.asarray(layer_segment_ids(params_tree,
+                                              flat_space.padded_size)),
+                vec_sharding)
+            mon.bind(
+                layer_labels(params_tree),
+                params_fn=lambda: jax.device_get(
+                    {"params_flat": params_flat, "mstate": mstate,
+                     "opt_state": opt_state}))
 
         if self.telemetry is not None:
             self.telemetry.recompile_watchdog.watch(step)
@@ -311,20 +376,33 @@ class DistriOptimizer(BaseOptimizer):
             # GLOBAL shapes/shardings _shard_batch assembles, which
             # host-local specs cannot express under multi-process
             xc, tc = self._shard_batch(first_batch, batch_sharding)
+            cost_args = (params_flat, mstate, opt_state, xc, tc,
+                         jax.random.key(0))
+            if use_health:
+                cost_args += (jax.ShapeDtypeStruct((), jnp.bool_), seg_ids)
             self.telemetry.attach_cost(
-                step, params_flat, mstate, opt_state, xc, tc,
-                jax.random.key(0), records_per_step=global_batch)
+                step, *cost_args, records_per_step=global_batch)
 
         def stage_device(batch):
             # global sharded arrays assembled while the previous step
             # executes (driver-loop double buffering)
             return self._shard_batch(batch, batch_sharding)
 
+        stats_holder = [None]
+
         def dispatch(staged):
             nonlocal params_flat, mstate, opt_state
             x, target = staged
-            params_flat, mstate, opt_state, loss = step(
-                params_flat, mstate, opt_state, x, target, RNG.next_key())
+            if use_health:
+                params_flat, mstate, opt_state, loss, stats = step(
+                    params_flat, mstate, opt_state, x, target,
+                    RNG.next_key(),
+                    mon.due(self.driver_state["neval"]), seg_ids)
+                stats_holder[0] = stats
+            else:
+                params_flat, mstate, opt_state, loss = step(
+                    params_flat, mstate, opt_state, x, target,
+                    RNG.next_key())
             return loss
 
         def validate_cb():
@@ -358,7 +436,9 @@ class DistriOptimizer(BaseOptimizer):
             stage_device=stage_device,
             records_of=lambda b: b.size() * jax.process_count(),
             validate_cb=validate_cb, feed_plateau=feed_plateau,
-            checkpoint_cb=checkpoint_cb)
+            checkpoint_cb=checkpoint_cb,
+            health_cb=(lambda: jax.device_get(stats_holder[0]))
+            if use_health else None)
 
         params_tree = jax.jit(flat_space.unflatten)(params_flat)
         self.model.set_parameters(params_tree)

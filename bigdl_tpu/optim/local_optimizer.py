@@ -25,6 +25,7 @@ from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.errors import (ConfigurationError,
+                                    TrainingHaltedError,
                                     UnsupportedFeatureError)
 from bigdl_tpu.utils.random_generator import RNG
 from bigdl_tpu.utils.shape import spec_of
@@ -69,6 +70,8 @@ class BaseOptimizer:
         self.clip_value = None
         self.clip_norm = None
         self.telemetry = None
+        self.health_monitor = None
+        self.grad_transform = None
         self.sync_every = 1
         #: host-side counters: data_wait_s vs device_s per step (the
         #: reference's Metrics accumulators, optim/Metrics.scala:31)
@@ -148,6 +151,39 @@ class BaseOptimizer:
         memory watchdogs, all driven by the shared driver loop
         (``bigdl_tpu/observability/``, docs/observability.md)."""
         self.telemetry = telemetry
+        return self
+
+    def set_health_monitor(self, monitor=None, **kw):
+        """Sampled on-device numerics telemetry + anomaly watchdogs
+        (``observability/health.py``, docs/observability.md):
+
+            opt.set_health_monitor(stats_every=10, policy="dump")
+
+        Every ``stats_every``-th step the jitted train step additionally
+        returns loss, global + per-layer grad norms, update-to-weight
+        ratios and non-finite counts (``jax.lax.cond``: non-sample steps
+        pay nothing); the monitor records them as ``health`` telemetry
+        events / TB scalars and drives the NonFinite + LossSpike
+        watchdogs under the warn/dump/halt policy.  Pass a prebuilt
+        ``HealthMonitor`` or its keyword arguments; ``None`` with no
+        kwargs disables."""
+        if monitor is not None and kw:
+            raise ConfigurationError(
+                "pass EITHER a HealthMonitor instance OR its keyword "
+                f"arguments, not both (got monitor + {sorted(kw)})")
+        if monitor is None and kw:
+            from bigdl_tpu.observability.health import HealthMonitor
+            monitor = HealthMonitor(**kw)
+        self.health_monitor = monitor
+        return self
+
+    def set_grad_transform(self, fn):
+        """Arbitrary pure gradient transform applied inside the jitted
+        step after aggregation, before clipping (fault injection,
+        custom scaling, ...).  LocalOptimizer only: the distributed
+        layouts transform chunked/sharded planes where a user tree
+        function has no meaning."""
+        self.grad_transform = fn
         return self
 
     def set_validation_summary(self, summary):
@@ -376,11 +412,14 @@ class BaseOptimizer:
                 return self._optimize_impl()
             except KeyboardInterrupt:
                 raise
-            except (ConfigurationError, UnsupportedFeatureError):
+            except (ConfigurationError, UnsupportedFeatureError,
+                    TrainingHaltedError):
                 # deterministic configuration/capability errors: a retry
                 # replays the identical failure after burning a restore
                 # cycle (and masks the message when no checkpoint exists
                 # yet) -- fail fast, mirroring _check_plateau_monitor.
+                # TrainingHaltedError is the health watchdogs' halt
+                # policy: retrying replays the same numerics blow-up.
                 # Plain ValueError/RuntimeError stay retryable: a flaky
                 # remote read mid-epoch is exactly what the loop is for.
                 raise
@@ -473,7 +512,8 @@ class BaseOptimizer:
     def _run_driver_loop(self, train_iter, first_batch, *, dispatch,
                         stage_device=None, records_of=None,
                         extra_summaries=None, validate_cb=None,
-                        feed_plateau=None, checkpoint_cb=None):
+                        feed_plateau=None, checkpoint_cb=None,
+                        health_cb=None):
         """The ONE training driver loop shared by Local/Distri/Strategy
         optimizers (they differ only in the step signature and how
         batches reach the devices, injected via the callbacks).
@@ -501,6 +541,12 @@ class BaseOptimizer:
           _record_validation); ``feed_plateau(state)`` then lets the
           caller thread the Plateau schedule through its opt_state.
         - ``checkpoint_cb(state)``: write a checkpoint.
+        - ``health_cb() -> host stats tree``: fetch the current step's
+          on-device numerics stats (drivers stash the device tree in
+          their dispatch closure).  Called only on sampled steps (the
+          attached ``HealthMonitor`` decides the cadence); a sample
+          forces a loss point sync like a validation firing, and the
+          monitor handles event recording + watchdog policy.
 
         The per-step loss sync (``float(loss)``) runs every
         ``sync_every``-th step only (default 1 = classic behavior; see
@@ -541,6 +587,9 @@ class BaseOptimizer:
         sync_skew = sync_every - 1        # steps since the last loss sync
         loss_dev = None
         tel = self.telemetry
+        mon = self.health_monitor
+        health_on = (mon is not None and mon.enabled
+                     and health_cb is not None)
         sp = tel.span if tel is not None else \
             (lambda name, **kw: contextlib.nullcontext())
 
@@ -585,7 +634,11 @@ class BaseOptimizer:
                 # the input-pipeline cost prefetch workers are there to
                 # take off this path
                 data_wait += time.perf_counter() - t_fetch
-                if sync_skew + 1 >= sync_every:
+                health_due = health_on and mon.due(state["neval"])
+                if sync_skew + 1 >= sync_every or health_due:
+                    # a health sample forces a point sync (same contract
+                    # as validation triggers): the published event pairs
+                    # the stats with a FRESH loss
                     with sp("loss_sync", step=state["neval"]):
                         loss = float(loss_dev)
                     sync_skew = 0
@@ -624,6 +677,19 @@ class BaseOptimizer:
                             state["neval"])
                     if extra_summaries is not None:
                         extra_summaries(state)
+                if health_on and mon.policy != "warn":
+                    # incident-bundle event ring (kind-tagged like the
+                    # JSONL); only dump_incident ever reads it, so a
+                    # warn-policy or disabled monitor pays nothing
+                    mon.note_event({"kind": "step", **event})
+                if health_due:
+                    # fetch the on-device stats (blocks on the step, the
+                    # point sync above already did) and hand them to the
+                    # monitor: health event + watchdogs + warn/dump/halt
+                    with sp("health_sample", step=state["neval"]):
+                        mon.on_sample(state, health_cb(), loss=loss,
+                                      batch=batch, telemetry=tel,
+                                      summary=self.train_summary)
                 state["neval"] += 1
                 if state["record_count"] >= epoch_size:
                     state["epoch"] += 1
@@ -681,10 +747,13 @@ class LocalOptimizer(BaseOptimizer):
             opt_state = jax.tree.map(jnp.asarray, snap["opt_state"])
             self._apply_driver_state(snap["driver_state"])
 
+        mon = self.health_monitor
+        use_health = mon is not None and mon.enabled
         step = jax.jit(make_train_step(
             self.model, self.criterion, self.optim_method,
             compute_dtype=self.compute_dtype, clip_value=self.clip_value,
-            clip_norm=self.clip_norm), donate_argnums=(0, 1, 2))
+            clip_norm=self.clip_norm, grad_transform=self.grad_transform,
+            health_stats=use_health), donate_argnums=(0, 1, 2))
 
         if self.telemetry is not None:
             self.telemetry.recompile_watchdog.watch(step)
@@ -696,16 +765,35 @@ class LocalOptimizer(BaseOptimizer):
             xc = jax.tree.map(spec, first_batch.get_input())
             tgt = first_batch.get_target()
             tc = None if tgt is None else jax.tree.map(spec, tgt)
+            cost_args = (params, mstate, opt_state, xc, tc,
+                         jax.random.key(0))
+            if use_health:
+                cost_args += (jax.ShapeDtypeStruct((), jnp.bool_),)
             self.telemetry.attach_cost(
-                step, params, mstate, opt_state, xc, tc, jax.random.key(0),
-                records_per_step=first_batch.size())
+                step, *cost_args, records_per_step=first_batch.size())
+
+        stats_holder = [None]         # device stats tree of the live step
 
         def dispatch(staged):
             nonlocal params, mstate, opt_state
             x, target = staged
-            params, mstate, opt_state, loss = step(
-                params, mstate, opt_state, x, target, RNG.next_key())
+            if use_health:
+                params, mstate, opt_state, loss, stats = step(
+                    params, mstate, opt_state, x, target, RNG.next_key(),
+                    mon.due(self.driver_state["neval"]))
+                stats_holder[0] = stats
+            else:
+                params, mstate, opt_state, loss = step(
+                    params, mstate, opt_state, x, target, RNG.next_key())
             return loss
+
+        if use_health:
+            from bigdl_tpu.observability.health import layer_labels
+            mon.bind(
+                layer_labels(params),
+                params_fn=lambda: jax.device_get(
+                    {"params": params, "mstate": mstate,
+                     "opt_state": opt_state}))
 
         def extra_summaries(state):
             self._log_learning_rates(opt_state, state)
@@ -724,7 +812,9 @@ class LocalOptimizer(BaseOptimizer):
                 self.validation_methods, self.compute_dtype),
             feed_plateau=feed_plateau,
             checkpoint_cb=lambda state: self._checkpoint(
-                params, mstate, opt_state))
+                params, mstate, opt_state),
+            health_cb=(lambda: jax.device_get(stats_holder[0]))
+            if use_health else None)
 
         self.model.set_parameters(params)
         self.model.set_state(mstate)

@@ -206,6 +206,13 @@ class StrategyOptimizer(BaseOptimizer):
         m, crit, meth = self.model, self.criterion, self.optim_method
         if self.clip_value is not None or self.clip_norm is not None:
             meth = _ClippingMethod(meth, self.clip_value, self.clip_norm)
+        if self.health_monitor is not None and self.health_monitor.enabled:
+            # OUTSIDE the clipping proxy: the stats see the pre-clip
+            # gradient, matching make_train_step / the dp chunk step.
+            # The probe threads the stats tree through opt_state under
+            # reserved keys; shard_opt_state & friends replicate them.
+            from bigdl_tpu.observability.health import HealthProbeMethod
+            meth = HealthProbeMethod(meth, self.health_monitor.stats_every)
         mesh, kw = self.mesh, self.strategy_kw
         identity = lambda p: p
 
@@ -349,6 +356,12 @@ class StrategyOptimizer(BaseOptimizer):
     # ----- driver loop ----------------------------------------------------- #
 
     def _optimize_impl(self):
+        if self.grad_transform is not None:
+            from bigdl_tpu.utils.errors import UnsupportedFeatureError
+            raise UnsupportedFeatureError(
+                "set_grad_transform operates on the model's gradient "
+                "TREE; the strategy engines restructure/shard it -- use "
+                "LocalOptimizer for gradient transforms")
         train_iter = self.dataset.data(train=True)
         first_batch = next(train_iter)
         params_tree, _ = self._init_model(first_batch)
@@ -383,6 +396,18 @@ class StrategyOptimizer(BaseOptimizer):
             self._apply_driver_state(snap["driver_state"])
         if getattr(self, "_resume_sharded", None):
             params, opt_state = self._sharded_restore(params, opt_state)
+
+        mon = self.health_monitor
+        use_health = mon is not None and mon.enabled
+        if use_health:
+            from bigdl_tpu.observability.health import layer_labels
+            # labels index the STRATEGY-NATIVE tree the step updates
+            # (tp/ep/sp: the model tree; pp: stage-stacked) -- the same
+            # flatten order HealthProbeMethod's stats vectors use
+            mon.bind(
+                layer_labels(params),
+                params_fn=lambda: jax.device_get(
+                    {"params": params, "opt_state": opt_state}))
 
         if self.telemetry is not None:
             self.telemetry.recompile_watchdog.watch(step)
@@ -436,11 +461,18 @@ class StrategyOptimizer(BaseOptimizer):
                     self.checkpoint_path, state["neval"],
                     params, (), opt_state, state)
 
+        def health_cb():
+            # the probe threads the stats through the optimizer state;
+            # post-dispatch `opt_state` is the updated one
+            from bigdl_tpu.observability.health import HEALTH_STATE_KEY
+            return jax.device_get(opt_state[HEALTH_STATE_KEY])
+
         self._run_driver_loop(
             train_iter, first_batch, dispatch=dispatch,
             stage_device=stage_device,
             extra_summaries=extra_summaries, validate_cb=validate_cb,
-            feed_plateau=feed_plateau, checkpoint_cb=checkpoint_cb)
+            feed_plateau=feed_plateau, checkpoint_cb=checkpoint_cb,
+            health_cb=health_cb if use_health else None)
 
         final = finalize(params)
         self.model.set_parameters(final)

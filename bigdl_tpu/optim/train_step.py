@@ -57,12 +57,22 @@ def make_train_step(
     clip_value: Optional[tuple] = None,
     clip_norm: Optional[float] = None,
     grad_transform: Optional[Callable] = None,
+    health_stats: bool = False,
 ):
     """Single-device fused step: (params, mstate, opt_state, input, target, rng)
     -> (params, mstate, opt_state, loss).
 
     ``compute_dtype=jnp.bfloat16`` gives mixed precision: fp32 master params,
     bf16 forward/backward (MXU-native), fp32 update.
+
+    ``health_stats=True`` adds a trailing traced ``sample`` bool argument
+    and a fifth output: the on-device numerics tree of
+    ``observability.health.tree_health_stats`` (loss, global + per-layer
+    grad norms of the pre-clip gradient, per-layer update-to-weight
+    ratios, per-layer non-finite counts), computed under ``jax.lax.cond``
+    so non-sample steps pay only the branch.  ``health_stats=False``
+    (default) traces the exact pre-existing program -- bit-identical
+    step, no extra compilation.
     """
 
     from bigdl_tpu.nn.module import frozen_param_mask, has_frozen
@@ -75,7 +85,7 @@ def make_train_step(
     # update (so weight decay cannot leak in)
     freeze_mask = frozen_param_mask(model) if has_frozen(model) else None
 
-    def train_step(params, mstate, opt_state, input, target, rng):
+    def _step(params, mstate, opt_state, input, target, rng, sample=None):
         def loss_fn(p):
             cp = _cast_params(p, compute_dtype)
             x = _cast_tree(input, compute_dtype)
@@ -100,6 +110,7 @@ def make_train_step(
             grads = jax.tree.map(
                 lambda g, keep: g if keep else jnp.zeros_like(g),
                 grads, freeze_mask)
+        raw_grads = grads             # pre-clip: clip hides explosions
         if clip_value is not None:
             grads = clip_by_value(grads, *clip_value)
         if clip_norm is not None:
@@ -109,7 +120,23 @@ def make_train_step(
             new_params = jax.tree.map(
                 lambda n, o, keep: n if keep else o,
                 new_params, params, freeze_mask)
-        return new_params, new_mstate, new_opt_state, loss
+        if sample is None:
+            return new_params, new_mstate, new_opt_state, loss
+        from bigdl_tpu.observability.health import (empty_health_stats,
+                                                    tree_health_stats)
+        stats = jax.lax.cond(
+            sample,
+            lambda: tree_health_stats(raw_grads, params, new_params, loss),
+            lambda: empty_health_stats(len(jax.tree.leaves(raw_grads))))
+        return new_params, new_mstate, new_opt_state, loss, stats
+
+    if health_stats:
+        def train_step(params, mstate, opt_state, input, target, rng, sample):
+            return _step(params, mstate, opt_state, input, target, rng,
+                         sample)
+    else:
+        def train_step(params, mstate, opt_state, input, target, rng):
+            return _step(params, mstate, opt_state, input, target, rng)
 
     return train_step
 

@@ -16,3 +16,11 @@ class ConfigurationError(ValueError):
 
 class UnsupportedFeatureError(NotImplementedError):
     """A valid-looking combination this engine deliberately refuses."""
+
+
+class TrainingHaltedError(RuntimeError):
+    """A health watchdog's ``halt`` policy stopped the run
+    (observability/health.py).  Deliberately NOT retried by the
+    failure-retry loop: restoring a checkpoint and replaying the same
+    batches reproduces the same numerics blow-up, burning retry cycles
+    while destroying the incident evidence window."""

@@ -4,12 +4,21 @@ Reference: test/.../GradientChecker.scala — perturbs each input/weight
 entry and compares (f(x+e) - f(x-e)) / 2e with the analytic backward.
 Here the analytic side is jax.grad of the module's pure apply, so the
 checker validates both the layer's forward math and its differentiability.
+
+Per-layer flattening/labelling and norm math are shared with the health
+telemetry (``observability/health.py``): ``layer_grad_norms`` returns
+exactly the numbers a ``HealthMonitor`` samples on-device, so "layer
+['2']['weight'] has grad norm X" means the same thing in a gradient
+check and in a run's ``health`` events.
 """
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from bigdl_tpu.observability.health import (flatten_with_labels,
+                                            per_layer_grad_norms)
 
 
 class GradientChecker:
@@ -55,20 +64,27 @@ class GradientChecker:
             max_err = max(max_err, abs(numeric - analytic.ravel()[i]) / denom)
         return max_err < self.precision
 
-    def check_weight(self, module, input, sample=20, seed=0):
-        """True iff numeric and analytic weight-gradients agree."""
+    @staticmethod
+    def _analytic_weight_grads(module, input):
+        """-> (params, scalar_loss, jax.grad tree): the shared prelude
+        of check_weight and layer_grad_norms, so the gradient check and
+        the health-norm helper cannot silently diverge."""
         if not module.is_built():
             from bigdl_tpu.utils.shape import spec_of
             module.build(spec_of(input))
-        state = module._state
-        params = module._params
+        params, state = module._params, module._state
 
         def scalar_loss(p):
             y, _ = module.apply(p, state, input, training=False, rng=None)
             return sum(jnp.sum(l) for l in jax.tree.leaves(y))
 
-        analytic = jax.grad(scalar_loss)(params)
-        leaves, treedef = jax.tree.flatten(params)
+        return params, scalar_loss, jax.grad(scalar_loss)(params)
+
+    def check_weight(self, module, input, sample=20, seed=0):
+        """True iff numeric and analytic weight-gradients agree."""
+        params, scalar_loss, analytic = self._analytic_weight_grads(
+            module, input)
+        _, leaves, treedef = flatten_with_labels(params)
         an_leaves = jax.tree.leaves(analytic)
         rng = np.random.default_rng(seed)
         eps = self.perturbation
@@ -94,3 +110,14 @@ class GradientChecker:
                 denom = max(abs(numeric), abs(g[i]), 1.0)
                 max_err = max(max_err, abs(numeric - g[i]) / denom)
         return max_err < self.precision
+
+    def layer_grad_norms(self, module, input):
+        """{layer label: analytic weight-gradient L2 norm} via the SAME
+        per-layer helper the on-device health telemetry uses
+        (``observability.health.per_layer_grad_norms``), so a gradient
+        check and a run's ``health`` events name and measure layers
+        identically."""
+        _, _, analytic = self._analytic_weight_grads(module, input)
+        labels = flatten_with_labels(analytic)[0]
+        norms = np.asarray(per_layer_grad_norms(analytic))
+        return dict(zip(labels, norms.tolist()))

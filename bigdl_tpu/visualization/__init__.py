@@ -53,6 +53,27 @@ class TrainSummary(Summary):
             self.add_scalar("DataWaitSeconds", event["data_wait_s"], step)
         return self
 
+    def add_health_event(self, event):
+        """Write the numerics scalars from ONE ``kind: "health"``
+        telemetry event (the sampled on-device stats --
+        observability/health.py): run-level ``Health/*`` plus per-layer
+        ``Health/GradNorm<path>`` / ``Health/UpdateRatio<path>``.  Same
+        single-source-of-truth contract as ``add_step_event``."""
+        step = event["step"]
+        self.add_scalar("Health/GradNorm", event["grad_norm"], step)
+        self.add_scalar("Health/UpdateRatioMax",
+                        event["update_ratio_max"], step)
+        self.add_scalar("Health/NonFiniteGrads",
+                        event["nonfinite_grads"], step)
+        self.add_scalar("Health/NonFiniteParams",
+                        event["nonfinite_params"], step)
+        for name, rec in (event.get("layers") or {}).items():
+            self.add_scalar("Health/GradNorm" + name,
+                            rec["grad_norm"], step)
+            self.add_scalar("Health/UpdateRatio" + name,
+                            rec["update_ratio"], step)
+        return self
+
     def get_summary_trigger(self, name: str):
         return self._triggers.get(name)
 

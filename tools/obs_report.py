@@ -9,10 +9,17 @@ Inputs (produced by ``StepTelemetry``, see docs/observability.md):
 
 Output: step-time percentiles, the data-wait fraction of wall time, the
 device-busy fraction from the xplane witness, MFU from the compiled
-step's ``cost_analysis`` flops, watchdog findings, host-span totals,
-and the top-N HLO ops by device time.
+step's ``cost_analysis`` flops, watchdog findings, model-health
+numerics (grad-norm trajectory, worst-layer table, first non-finite
+step, anomalies -- when a ``HealthMonitor`` fed the run), host-span
+totals, and the top-N HLO ops by device time.
 
-    python tools/obs_report.py runs/resnet50   [--xplane DIR] [--json]
+    python tools/obs_report.py runs/resnet50 [--xplane DIR] [--format json]
+
+``--format json`` emits the same dict the text renderer consumes, with
+non-finite floats mapped to null (strictly valid JSON), so CI and
+bench.py can assert on health/occupancy numbers.  The reader tolerates
+a truncated final JSONL line / undecodable bytes from a crashed run.
 
 No jax import -- the report runs anywhere the artifacts were copied.
 """
@@ -20,6 +27,7 @@ No jax import -- the report runs anywhere the artifacts were copied.
 import argparse
 import importlib.util
 import json
+import math
 import os
 import sys
 
@@ -36,9 +44,14 @@ device_busy, op_breakdown = _xplane.device_busy, _xplane.op_breakdown
 
 
 def load_events(jsonl_path):
-    """-> (header dict or None, [step events], [other events])."""
+    """-> (header dict or None, [step events], [other events]).
+
+    Crash-tolerant by contract: a truncated final line (process died
+    mid-write) fails its json parse and is skipped, and
+    ``errors="replace"`` keeps a half-written multibyte character from
+    killing the whole read."""
     header, steps, other = None, [], []
-    with open(jsonl_path) as f:
+    with open(jsonl_path, errors="replace") as f:
         for ln in f:
             ln = ln.strip()
             if not ln:
@@ -99,6 +112,68 @@ def span_totals(trace_path):
     return [{"name": name, "sec": round(sec, 6), "count": cnt}
             for name, (sec, cnt) in
             sorted(totals.items(), key=lambda kv: -kv[1][0])]
+
+
+def _finite(v):
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+def _health_section(events):
+    """Summarize ``health`` + ``anomaly`` events: grad-norm trajectory,
+    first non-finite step, worst-layer table (or None without any)."""
+    health = [e for e in events if e.get("kind") == "health"]
+    anomalies = [e for e in events if e.get("kind") == "anomaly"]
+    if not health and not anomalies:
+        return None
+    sec = {"samples": len(health),
+           "anomalies": [{k: v for k, v in a.items()
+                          if k not in ("kind", "ts")} for a in anomalies]}
+    if not health:
+        return sec
+    norms = [(e.get("step"), e.get("grad_norm")) for e in health]
+    finite = [g for _, g in norms if _finite(g)]
+    sec["grad_norm_first"] = norms[0][1] if _finite(norms[0][1]) else None
+    sec["grad_norm_last"] = norms[-1][1] if _finite(norms[-1][1]) else None
+    sec["grad_norm_max"] = max(finite) if finite else None
+    stride = max(1, len(norms) // 40)     # <= ~40 trajectory points
+    sec["grad_norm_trajectory"] = [
+        {"step": s, "grad_norm": g if _finite(g) else None}
+        for s, g in norms[::stride]]
+    ratios = [e.get("update_ratio_max") for e in health]
+    fin_ur = [u for u in ratios if _finite(u)]
+    if fin_ur:
+        sec["update_ratio_max"] = max(fin_ur)
+    for e in health:
+        bad = (e.get("nonfinite_grads") or e.get("nonfinite_params")
+               or (e.get("loss") is not None and not _finite(e["loss"]))
+               or (e.get("grad_norm") is not None
+                   and not _finite(e["grad_norm"])))
+        if bad:
+            sec["first_nonfinite_step"] = e.get("step")
+            sec["first_nonfinite_layer"] = e.get("worst_layer")
+            break
+    last = health[-1]
+    layers = last.get("layers") or {}
+
+    def badness(item):
+        _, rec = item
+        nf = int(rec.get("nonfinite_grads", 0)) \
+            + int(rec.get("nonfinite_params", 0))
+        gn = rec.get("grad_norm")
+        return (nf > 0, not _finite(gn), gn if _finite(gn) else 0.0)
+
+    worst = sorted(layers.items(), key=badness, reverse=True)[:5]
+    sec["worst_layers"] = [
+        {"layer": name,
+         "grad_norm": rec.get("grad_norm") if _finite(rec.get("grad_norm"))
+         else None,
+         "update_ratio": rec.get("update_ratio")
+         if _finite(rec.get("update_ratio")) else None,
+         "nonfinite": int(rec.get("nonfinite_grads", 0))
+         + int(rec.get("nonfinite_params", 0))}
+        for name, rec in worst]
+    sec["last_sample_step"] = last.get("step")
+    return sec
 
 
 def build_report(run_dir, xplane_dir=None, top=10):
@@ -169,6 +244,9 @@ def build_report(run_dir, xplane_dir=None, top=10):
     validations = [e for e in other if e.get("kind") == "validation"]
     if validations:
         rep["validations"] = validations
+    health = _health_section(other)
+    if health:
+        rep["health"] = health
 
     rep["host_spans"] = span_totals(os.path.join(run_dir, "trace.json"))
 
@@ -228,6 +306,37 @@ def format_report(rep):
         if s.get("mfu_p50") is not None:
             out.append(f"MFU @ p50 step time: {s['mfu_p50']:.2%} "
                        f"(peak {h.get('peak_flops', 0):.0f} FLOP/s assumed)")
+    hl = rep.get("health")
+    if hl:
+        def _g(v):
+            return "non-finite" if v is None else f"{v:.4g}"
+        if hl.get("samples"):
+            out.append(
+                f"health: {hl['samples']} samples  grad-norm "
+                f"{_g(hl.get('grad_norm_first'))} -> "
+                f"{_g(hl.get('grad_norm_last'))}"
+                + (f" (max {hl['grad_norm_max']:.4g})"
+                   if hl.get("grad_norm_max") is not None else ""))
+        if hl.get("first_nonfinite_step") is not None:
+            out.append(
+                f"FIRST NON-FINITE numerics at step "
+                f"{hl['first_nonfinite_step']} "
+                f"(layer {hl.get('first_nonfinite_layer')})")
+        if hl.get("worst_layers"):
+            out.append(f"worst layers (sample @ step "
+                       f"{hl.get('last_sample_step')}):")
+            for w in hl["worst_layers"]:
+                line = (f"  {w['layer']:<32} grad-norm {_g(w['grad_norm'])}"
+                        f"  update-ratio {_g(w['update_ratio'])}")
+                if w.get("nonfinite"):
+                    line += f"  NONFINITE x{w['nonfinite']}"
+                out.append(line)
+        for a in hl.get("anomalies", []):
+            line = (f"ANOMALY [{a.get('watchdog')}] at step {a.get('step')}"
+                    f" (policy {a.get('policy')})")
+            if a.get("incident_dir"):
+                line += f" -> {a['incident_dir']}"
+            out.append(line)
     wd = rep.get("watchdogs") or {}
     if wd.get("recompile_steps"):
         out.append("RECOMPILES after warmup at steps: "
@@ -258,6 +367,20 @@ def format_report(rep):
     return "\n".join(out)
 
 
+def _json_safe(obj):
+    """Non-finite floats -> null, recursively: the --format json output
+    is strictly valid JSON (NaN grad norms are real data in telemetry
+    .jsonl, but machine consumers get null + the explicit
+    first_nonfinite_step field instead of a parser error)."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("run_dir", help="directory holding telemetry.jsonl")
@@ -265,11 +388,18 @@ def main(argv=None):
                     help="xplane trace dir (default: RUN_DIR/xplane)")
     ap.add_argument("--top", type=int, default=10,
                     help="how many HLO ops to list")
+    ap.add_argument("--format", choices=("text", "json"), default=None,
+                    help="text (default) or json -- the same dict the "
+                         "text renderer uses, strictly-valid JSON")
     ap.add_argument("--json", action="store_true",
-                    help="emit the raw report dict as JSON")
+                    help="alias for --format json")
     args = ap.parse_args(argv)
+    fmt = args.format or ("json" if args.json else "text")
     rep = build_report(args.run_dir, xplane_dir=args.xplane, top=args.top)
-    print(json.dumps(rep, indent=2) if args.json else format_report(rep))
+    if fmt == "json":
+        print(json.dumps(_json_safe(rep), indent=2, allow_nan=False))
+    else:
+        print(format_report(rep))
     return 0
 
 
