@@ -57,10 +57,14 @@ def variant_suffix(flags):
 
 
 def _honor_env_platforms():
-    from bigdl_tpu.utils.config import (enable_compilation_cache,
+    from bigdl_tpu.utils.config import (compilation_cache_note,
+                                        enable_compilation_cache,
                                         honor_env_platforms)
     honor_env_platforms()
     enable_compilation_cache()
+    # one-line hit/miss note (stderr: stdout is the JSON artifact
+    # channel) -- a warm cache is why repeat bench runs start fast
+    print(compilation_cache_note(), file=sys.stderr, flush=True)
 
 
 # --------------------------------------------------------------------------- #
@@ -195,8 +199,12 @@ def run_pipeline_bench(latency_s=None, steps=None, batch=None,
 # the fast smoke, the CLI leg measures the real overhead).
 # --------------------------------------------------------------------------- #
 
-def _health_leg(run_dir, stats_every, steps, batch, hidden, seed=0):
-    """One training leg; returns (obs_report steps block, loss stream)."""
+def _mlp_leg(run_dir, run_name, make_opt, steps, batch, hidden, seed=0):
+    """The shared micro-bench leg recipe (health + qcomm A/Bs): seeded
+    synthetic data sized so one epoch covers the run, a 3-layer MLP,
+    StepTelemetry, train ``steps`` iterations, return the obs_report
+    steps block + the raw step events.  ``make_opt(model, ds)`` builds
+    the optimizer under test (Local vs Distri, monitors, compression)."""
     import numpy as np
 
     import bigdl_tpu.nn as nn
@@ -214,21 +222,34 @@ def _health_leg(run_dir, stats_every, steps, batch, hidden, seed=0):
     model = (nn.Sequential().add(nn.Linear(16, hidden)).add(nn.ReLU())
              .add(nn.Linear(hidden, hidden)).add(nn.ReLU())
              .add(nn.Linear(hidden, 4)))
-    tel = StepTelemetry(run_dir, run_name=f"health-k{stats_every}",
-                        trace=False)
-    opt = optim.LocalOptimizer(model, ds, nn.CrossEntropyCriterion(),
-                               optim.SGD(learning_rate=0.05))
+    tel = StepTelemetry(run_dir, run_name=run_name, trace=False)
+    opt = make_opt(model, ds)
     opt.set_end_when(optim.Trigger.max_iteration(steps))
     opt.set_telemetry(tel)
-    if stats_every is not None:
-        opt.set_health_monitor(stats_every=stats_every, policy="warn")
     opt.optimize()
     tel.close()
     rep_mod = _obs_report_module()
     _, step_events, _ = rep_mod.load_events(
         os.path.join(run_dir, "telemetry.jsonl"))
-    losses = [e["loss"] for e in step_events]
-    return rep_mod.build_report(run_dir)["steps"], losses
+    return rep_mod.build_report(run_dir)["steps"], step_events
+
+
+def _health_leg(run_dir, stats_every, steps, batch, hidden, seed=0):
+    """One training leg; returns (obs_report steps block, loss stream)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+
+    def make_opt(model, ds):
+        opt = optim.LocalOptimizer(model, ds, nn.CrossEntropyCriterion(),
+                                   optim.SGD(learning_rate=0.05))
+        if stats_every is not None:
+            opt.set_health_monitor(stats_every=stats_every, policy="warn")
+        return opt
+
+    steps_block, events = _mlp_leg(
+        run_dir, f"health-k{stats_every}", make_opt, steps, batch, hidden,
+        seed)
+    return steps_block, [e["loss"] for e in events]
 
 
 def run_health_bench(stats_every=None, steps=None, batch=None,
@@ -289,6 +310,111 @@ def run_health_bench(stats_every=None, steps=None, batch=None,
             # the monitored run's loss stream must MATCH the plain one:
             # the stats branch reads, never perturbs, the step math
             "monitored_loss_matches": loss_on == loss_off,
+        },
+    }
+    print(json.dumps(record), flush=True)
+    return record
+
+
+# --------------------------------------------------------------------------- #
+# Quantized-collective micro-benchmark (ISSUE 4): A/B the dp step's wire
+# formats -- fp32 vs bf16 cast vs blockwise int8 + error feedback -- on
+# sec/step and wire bytes, read back from the StepTelemetry JSONL.
+# --------------------------------------------------------------------------- #
+
+def _qcomm_leg(run_dir, compression, steps, batch, hidden, seed=0):
+    """One DistriOptimizer leg under ``compression``; returns the
+    obs_report steps block + the step event's wire/compression fields."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()
+
+    def make_opt(model, ds):
+        return optim.DistriOptimizer(model, ds, nn.CrossEntropyCriterion(),
+                                     optim.SGD(learning_rate=0.05),
+                                     grad_compression=compression)
+
+    steps_block, events = _mlp_leg(run_dir, "qcomm", make_opt, steps,
+                                   batch, hidden, seed)
+    last = events[-1]
+    comm = {k: last.get(k) for k in
+            ("wire_bytes", "grad_wire_bytes", "weight_wire_bytes",
+             "compression_ratio", "grad_compression_ratio")}
+    return steps_block, comm
+
+
+def run_qcomm_bench(steps=None, batch=None, hidden=None, out_dir=None):
+    """A/B the dp data plane's wire formats: fp32 vs bf16 cast vs
+    blockwise int8 + error feedback (docs/performance.md, "Gradient
+    compression").
+
+    Knobs (env tier): BENCH_QCOMM_STEPS (default 20), BENCH_QCOMM_BATCH
+    (default 64; must divide by the device count), BENCH_QCOMM_HIDDEN
+    (default 512), BENCH_QCOMM_BLOCK (default 256).  Prints ONE JSON
+    record whose ``value`` is the int8-vs-fp32 gradient wire-byte
+    reduction read from the step telemetry and ``vs_baseline`` is that
+    reduction over the 3.5x acceptance floor.  The per-leg sec/step is
+    reported for completeness: on a single host (no DCN) the wire is
+    memory bandwidth, so the time win only materializes on real
+    cross-slice meshes -- the bytes number is the contract.
+    """
+    _honor_env_platforms()
+    import tempfile
+
+    import jax
+
+    from bigdl_tpu.ops.quantization import CompressionSpec
+
+    env = os.environ
+    steps = int(env.get("BENCH_QCOMM_STEPS", "20")) if steps is None else steps
+    batch = int(env.get("BENCH_QCOMM_BATCH", "64")) if batch is None else batch
+    hidden = (int(env.get("BENCH_QCOMM_HIDDEN", "512"))
+              if hidden is None else hidden)
+    block = int(env.get("BENCH_QCOMM_BLOCK", "256"))
+    n_dev = jax.device_count()
+    if batch % n_dev:
+        batch = max(n_dev, batch // n_dev * n_dev)
+
+    legs = [
+        ("fp32", None),
+        ("bf16", "bf16"),
+        ("int8_ef", CompressionSpec(wire="int8", block_size=block,
+                                    error_feedback=True)),
+    ]
+
+    def _run(base):
+        out = {}
+        for name, spec in legs:
+            out[name] = _qcomm_leg(os.path.join(base, name), spec,
+                                   steps, batch, hidden)
+        return out
+
+    if out_dir is None:
+        with tempfile.TemporaryDirectory() as td:
+            results = _run(td)
+    else:
+        results = _run(out_dir)
+
+    grad_fp32 = results["fp32"][1]["grad_wire_bytes"]
+    grad_int8 = results["int8_ef"][1]["grad_wire_bytes"]
+    reduction = grad_fp32 / max(grad_int8, 1)
+    record = {
+        "metric": "qcomm_grad_wire_byte_reduction",
+        "value": round(reduction, 2),
+        "unit": "x",
+        "vs_baseline": round(reduction / 3.5, 4),   # target: >= 3.5x
+        "extra": {
+            "steps": steps, "batch": batch, "hidden": hidden,
+            "block_size": block, "devices": n_dev,
+            "legs": {
+                name: {
+                    "sec_per_step_p50": results[name][0]["wall_s_p50"],
+                    "loss_last": results[name][0]["loss_last"],
+                    **results[name][1],
+                } for name, _ in legs
+            },
         },
     }
     print(json.dumps(record), flush=True)
@@ -628,6 +754,11 @@ def main():
     if os.environ.get("BENCH_HEALTH") or "health" in sys.argv[1:]:
         # health-stats overhead A/B: in-process and CPU-runnable
         run_health_bench()
+        return
+    if os.environ.get("BENCH_QCOMM") or "qcomm" in sys.argv[1:]:
+        # wire-format A/B on the dp step: in-process and CPU-runnable
+        # (the wire-byte accounting is exact on any device count)
+        run_qcomm_bench()
         return
     if os.environ.get("BENCH_CHILD"):
         if os.environ.get("BENCH_FAKE_HANG"):  # test hook: dead-tunnel sim

@@ -105,6 +105,10 @@ def _common_flags(p, default_epochs=5):
                    help="prefetch queue depth (batches held ahead)")
     p.add_argument("--syncEvery", type=int, default=1, dest="sync_every",
                    help="block on the device loss every k-th step only")
+    p.add_argument("--compilationCache", default=None,
+                   dest="compilation_cache", metavar="DIR",
+                   help="persistent XLA compilation cache dir: repeat "
+                        "runs of the same program skip recompilation")
 
 
 def cmd_lenet_train(args):
@@ -441,6 +445,11 @@ def main(argv=None):
             p.set_defaults(lr=1e-3)      # Adam-scale default
 
     args = parser.parse_args(argv)
+    if getattr(args, "compilation_cache", None):
+        from bigdl_tpu.utils.config import (compilation_cache_note,
+                                            enable_compilation_cache)
+        enable_compilation_cache(args.compilation_cache)
+        logging.getLogger("bigdl_tpu").info(compilation_cache_note())
     args.fn(args)
 
 

@@ -325,7 +325,7 @@ def build_health_event(raw, labels, loss=None):
         }
         for i in range(n)
     }
-    return {
+    out = {
         "loss": loss,
         "grad_norm": float(raw["grad_norm"]),
         "update_ratio_max": float(np.max(ur)) if ur.size else 0.0,
@@ -334,6 +334,11 @@ def build_health_event(raw, labels, loss=None):
         "worst_layer": worst,
         "layers": layers,
     }
+    if "ef_residual_norm" in raw:
+        # the dp driver's error-feedback residual (gradient compression,
+        # docs/performance.md): host-computed, rides the health sample
+        out["ef_residual_norm"] = float(raw["ef_residual_norm"])
+    return out
 
 
 # --------------------------------------------------------------------------- #

@@ -133,6 +133,10 @@ class StepTelemetry:
             if trace else None
         self.recompile_watchdog = RecompileWatchdog(recompile_warmup_steps)
         self.memory_watchdog = MemoryWatchdog(memory_window)
+        # sampled at construction -- BEFORE this run's own compiles land
+        # in the cache dir, which a lazy header write would miscount
+        from bigdl_tpu.utils.config import compilation_cache_status
+        self._cache_status = compilation_cache_status()
         self._cost = None
         self._wrote_header = False
         self._closed = False
@@ -173,6 +177,10 @@ class StepTelemetry:
                 peak_flops=peak_flops(dev))
         except Exception:
             pass
+        if self._cache_status is not None:
+            # hit/miss note for the run report: a warm cache means the
+            # big XLA compiles were (probably) skipped this run
+            fields["compilation_cache"] = self._cache_status
         if self._cost:
             fields["cost"] = self._cost
         fields.update(extra)

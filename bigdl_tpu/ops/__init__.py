@@ -1,1 +1,3 @@
 from bigdl_tpu.ops.flash_attention import flash_attention
+from bigdl_tpu.ops.quantization import (CompressionSpec, dequantize_blockwise,
+                                        quantize_blockwise)

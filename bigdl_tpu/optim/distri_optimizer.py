@@ -13,6 +13,12 @@ program per step over the ICI mesh:
          shards OptimMethod state per node)
       -> all_gather(weights)    [replaces sendWeightPartition/getWeights]
 
+The collectives' WIRE FORMAT is first-class (``grad_compression=``,
+``ops/quantization.py``): narrow-float casts, or blockwise int8 over an
+``all_to_all`` with per-block scales and an optional EF-SGD residual
+plane -- the generalization of the reference's FP16CompressedTensor
+(docs/performance.md, "Gradient compression").
+
 Straggler dropping (optim/DistriOptimizer.scala:177-186) has no analogue:
 ICI collectives are synchronous and chips don't straggle; per-step wall-time
 metrics are kept instead (SURVEY.md section 5).
@@ -25,6 +31,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from bigdl_tpu.ops.quantization import (CompressionSpec,
+                                        dequantize_blockwise,
+                                        quantize_blockwise,
+                                        quantized_reduce_chunks,
+                                        uncompressed_wire_summary)
 from bigdl_tpu.optim.local_optimizer import BaseOptimizer, validate
 from bigdl_tpu.optim.optim_method import clip_by_value
 from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
@@ -44,22 +55,68 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
                            health_stats=False):
     """Build the per-device step body and its shard_map wrapper.
 
-    ``grad_compression``: dtype the gradients ride the wire in (e.g.
-    ``jnp.bfloat16`` or ``jnp.float16``) -- the TPU analogue of the
+    ``grad_compression``: the wire format of the data-plane collectives
+    -- any spelling ``CompressionSpec.parse`` accepts (the legacy
+    ``jnp.bfloat16`` / ``jnp.float16`` dtypes, ``"bf16"``-style strings,
+    or a full ``CompressionSpec``) -- the TPU analogue of the
     reference's fp16 on-the-wire compression
-    (parameters/FP16CompressedTensor.scala:26,173-199).  On-chip ICI is
-    bf16-native so this matters for DCN-crossing mesh axes; the reduction
-    output converts back to fp32 before the optimizer update, exactly like
-    the reference decompresses after aggregation.
+    (parameters/FP16CompressedTensor.scala:26,173-199), generalized:
+
+    - ``"bf16"`` / ``"fp16"``: the historical cast path -- gradients
+      ride ``psum_scatter`` in the narrow dtype and the reduction output
+      converts back to fp32 before the optimizer update, exactly like
+      the reference decompresses after aggregation.  Parity guarantee
+      (pinned by tests/test_quant_collectives.py): on an MLP-scale
+      model the cast step's loss trajectory tracks the fp32 step's
+      within ~1e-2 relative after tens of steps -- the wire rounds each
+      gradient element to ~8 (bf16) / ~11 (fp16) mantissa bits, a
+      zero-mean perturbation the optimizer averages out; it does NOT
+      change convergence class.  fp16's narrow exponent (max ~65504)
+      can saturate pathological gradients where bf16 cannot -- prefer
+      bf16 unless reproducing the reference bit-for-bit.
+    - ``"int8"`` (``CompressionSpec(wire="int8", ...)``): blockwise
+      quantized wire (ops/quantization.py).  The ``psum_scatter``
+      becomes quantize -> ``all_to_all`` of int8 payload + per-block
+      scales over the data axis -> local dequant-and-sum in fp32 ->
+      own ZeRO-1 chunk; ~4x less wire than fp32.  With
+      ``error_feedback=True`` the step carries an EF-SGD residual
+      plane (one fp32 local-gradient buffer per device, sharded over
+      the data axis like the optimizer state): each device adds its
+      accumulated quantization error to the next step's local gradient
+      before quantizing, so the applied updates telescope to the fp32
+      trajectory.  ``compress_weight_gather=True`` additionally rides
+      the weight ``all_gather`` in the same block format as a
+      quantized DELTA applied to the replicated fp32 master vector
+      (masters never drop to int8 precision; replicas stay
+      bit-identical because every device applies the same dequantized
+      bytes).
 
     ``health_stats=True`` appends two traced args (``sample`` bool,
     ``seg_ids`` = this plane's layer-id map sharded like the flat
-    vector) and a fifth output: the per-layer numerics tree of
+    vector) and a final output: the per-layer numerics tree of
     ``observability.health.flat_health_stats``, computed from each
     device's chunk via ``segment_sum`` + ``psum`` under ``lax.cond`` --
     replica-consistent stats of the GLOBAL mean gradient, so device 0
-    suffices and non-sample steps pay nothing.
+    suffices and non-sample steps pay nothing.  Under a compressed wire
+    the sampled branch re-reduces the raw gradient in fp32
+    (one extra reduce-scatter on sampled steps only): the stats read
+    the PRE-quantization gradient, so per-layer norms stay comparable
+    across compression settings.
+
+    Step signature (positional, after the fixed six): ``ef_residual``
+    (when the spec has error feedback), then ``sample, seg_ids`` (when
+    ``health_stats``).  Outputs append in the same order.
     """
+    spec = CompressionSpec.parse(grad_compression)
+    use_ef = spec is not None and spec.error_feedback
+    n_chunks = flat_space.num_chunks
+    if spec is not None and spec.quantized \
+            and flat_space.chunk_size % spec.block_size != 0:
+        raise ValueError(
+            f"ZeRO-1 chunk size {flat_space.chunk_size} is not a "
+            f"multiple of the quantization block "
+            f"({spec.block_size}); build the FlatParamSpace with "
+            f"block_size={spec.block_size}")
 
     from bigdl_tpu.nn.module import frozen_param_mask, has_frozen
     from bigdl_tpu.optim.regularizer import (has_regularizers,
@@ -78,8 +135,16 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
     else:
         freeze_mask_flat = None
 
-    def step_body(params_flat, mstate, opt_state, x, target, rng,
-                  sample=None, seg_ids=None):
+    def step_body(params_flat, mstate, opt_state, x, target, rng, *extra):
+        # optional traced args ride positionally after the fixed six:
+        # [ef_residual] (wire spec has error feedback), [sample, seg_ids]
+        # (health_stats) -- mirrored by wrap()'s in_specs
+        i = 0
+        ef = None
+        if use_ef:
+            ef, i = extra[0], 1
+        sample, seg_ids = (extra[i], extra[i + 1]) if health_stats \
+            else (None, None)
         # per-device view: params_flat replicated, x/target = this device's shard
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
 
@@ -109,14 +174,29 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
 
         (_, (loss, new_mstate)), gflat = jax.value_and_grad(
             loss_fn, has_aux=True)(params_flat)
+        n_dev = jax.lax.psum(1, axis)
+        raw_gflat = gflat            # pre-wire, pre-EF: the stats source
+        new_ef = None
         # mean-reduce gradients; each device keeps only its chunk (ZeRO-1)
-        if grad_compression is not None:
-            wire = gflat.astype(grad_compression)
+        if spec is None:
+            gchunk = jax.lax.psum_scatter(gflat, axis, tiled=True)
+        elif spec.quantized:
+            if use_ef:
+                # EF-SGD: fold the residual (this device's accumulated
+                # quantization error) into the local gradient BEFORE
+                # quantizing; the new residual is exactly what this
+                # step's wire dropped
+                gflat = gflat + ef[0]
+            gchunk, err = quantized_reduce_chunks(
+                gflat, n_chunks, axis, spec,
+                jax.random.fold_in(rng, 0x5149))
+            if use_ef:
+                new_ef = err[None, :]
+        else:
+            wire = gflat.astype(spec.wire_dtype)
             gchunk = jax.lax.psum_scatter(wire, axis,
                                           tiled=True).astype(gflat.dtype)
-        else:
-            gchunk = jax.lax.psum_scatter(gflat, axis, tiled=True)
-        gchunk = gchunk / jax.lax.psum(1, axis)
+        gchunk = gchunk / n_dev
         mchunk = flat_space.chunk(freeze_mask_flat,
                                   jax.lax.axis_index(axis)) \
             if freeze_mask_flat is not None else None
@@ -140,23 +220,56 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
         if freeze_mask_flat is not None:
             # restore frozen positions so weight decay cannot leak in
             new_pchunk = mchunk * new_pchunk + (1.0 - mchunk) * pchunk
-        new_flat = jax.lax.all_gather(new_pchunk, axis, tiled=True)
+        if spec is not None and spec.compress_weight_gather:
+            # the weight all_gather rides the same block format -- as a
+            # quantized DELTA on top of the replicated fp32 master
+            # vector: gathering raw int8 weights would clamp the
+            # masters to int8 precision every step, whereas the delta's
+            # error is bounded by the UPDATE's block absmax/127 (second
+            # order in the learning rate).  Frozen positions have delta
+            # exactly 0 and quantize to exactly 0.
+            delta = new_pchunk - pchunk
+            dq, ds = quantize_blockwise(
+                delta, spec.block_size, stochastic=spec.stochastic,
+                rng=jax.random.fold_in(rng, 0x5157),
+                scale_dtype=spec.scale_dtype)
+            dqf = jax.lax.all_gather(dq, axis, tiled=True)
+            dsf = jax.lax.all_gather(ds, axis, tiled=True)
+            new_flat = params_flat + dequantize_blockwise(
+                dqf, dsf, spec.block_size)
+        else:
+            new_flat = jax.lax.all_gather(new_pchunk, axis, tiled=True)
         # average replicated floating state (BN running stats) across shards
         new_mstate = jax.tree.map(
             lambda s: jax.lax.pmean(s, axis)
             if jnp.issubdtype(s.dtype, jnp.floating) else s,
             new_mstate)
         loss = jax.lax.pmean(loss, axis)
+        out = (new_flat, new_mstate, new_opt_state, loss)
+        if new_ef is not None:
+            out = out + (new_ef,)
         if sample is None:
-            return new_flat, new_mstate, new_opt_state, loss
+            return out
         from bigdl_tpu.observability.health import (empty_health_stats,
                                                     flat_health_stats)
-        stats = jax.lax.cond(
-            sample,
-            lambda: flat_health_stats(raw_gchunk, pchunk, new_pchunk, loss,
-                                      seg_ids, n_layers, axis),
-            lambda: empty_health_stats(n_layers))
-        return new_flat, new_mstate, new_opt_state, loss, stats
+
+        def sampled_stats():
+            if spec is None:
+                stats_chunk = raw_gchunk
+            else:
+                # PRE-quantization gradient: re-reduce the raw local
+                # gradients in fp32 (sampled steps only, inside the
+                # cond) so per-layer norms stay comparable across
+                # compression settings
+                c = jax.lax.psum_scatter(raw_gflat, axis,
+                                         tiled=True) / n_dev
+                stats_chunk = c if mchunk is None else c * mchunk
+            return flat_health_stats(stats_chunk, pchunk, new_pchunk,
+                                     loss, seg_ids, n_layers, axis)
+
+        stats = jax.lax.cond(sample, sampled_stats,
+                             lambda: empty_health_stats(n_layers))
+        return out + (stats,)
 
     def opt_spec(leaf):
         return P(axis) if getattr(leaf, "ndim", 0) >= 1 else P()
@@ -170,22 +283,28 @@ def make_distri_train_step(model, criterion, optim_method, flat_space,
 
     def wrap(opt_state_eval):
         opt_specs = jax.tree.map(opt_spec, opt_state_eval)
+        in_specs = [P(), P(), opt_specs, P(axis), P(axis), P()]
+        out_specs = [P(), P(), opt_specs, P()]
+        donate = [0, 1, 2]
+        if use_ef:
+            # the EF residual plane: global (n_dev, padded), one row --
+            # this device's full local-gradient error -- per device;
+            # donated like the opt state it lives beside
+            in_specs.append(P(axis))
+            out_specs.append(P(axis))
+            donate.append(6)
         if health_stats:
-            in_specs = (P(), P(), opt_specs, P(axis), P(axis), P(),
-                        P(), P(axis))
-            out_specs = (P(), P(), opt_specs, P(), dict(_HEALTH_SPECS))
-        else:
-            in_specs = (P(), P(), opt_specs, P(axis), P(axis), P())
-            out_specs = (P(), P(), opt_specs, P())
+            in_specs += [P(), P(axis)]
+            out_specs.append(dict(_HEALTH_SPECS))
         return jax.jit(
             shard_map(
                 step_body,
                 mesh=mesh,
-                in_specs=in_specs,
-                out_specs=out_specs,
+                in_specs=tuple(in_specs),
+                out_specs=tuple(out_specs),
                 check_vma=False,
             ),
-            donate_argnums=(0, 1, 2),
+            donate_argnums=tuple(donate),
         )
 
     return step_body, wrap
@@ -201,6 +320,8 @@ class DistriOptimizer(BaseOptimizer):
         super().__init__(model, dataset, criterion, optim_method)
         self.mesh = mesh or Engine.mesh()
         self.axis = axis
+        # parse eagerly: a bad spec fails HERE, not steps into training
+        CompressionSpec.parse(grad_compression)
         self.grad_compression = grad_compression
         self.sync_bn = sync_bn
 
@@ -214,23 +335,40 @@ class DistriOptimizer(BaseOptimizer):
         self.sync_bn = enabled
         return self
 
-    def set_gradient_compression(self, dtype=jnp.bfloat16):
-        """Gradients ride the allreduce wire in ``dtype`` (the analogue of
-        the reference's fp16 compression for slow/DCN-crossing axes,
-        parameters/FP16CompressedTensor.scala:26)."""
-        self.grad_compression = dtype
+    def set_gradient_compression(self, spec=jnp.bfloat16):
+        """Choose the data-plane wire format (the analogue of the
+        reference's fp16 compression for slow/DCN-crossing axes,
+        parameters/FP16CompressedTensor.scala:26), generalized to any
+        ``CompressionSpec.parse`` spelling:
+
+        - legacy dtypes / strings -- ``jnp.bfloat16`` (default),
+          ``jnp.float16``, ``"bf16"``, ``"fp16"``: the plain cast path
+        - ``"int8"`` or ``CompressionSpec(wire="int8", block_size=256,
+          stochastic=..., error_feedback=..., ...)``: blockwise
+          quantized collectives, optionally with the EF-SGD residual
+          plane (docs/performance.md, "Gradient compression")
+        """
+        CompressionSpec.parse(spec)       # fail fast on a bad spelling
+        self.grad_compression = spec
         return self
 
     #: flat-plane orbax snapshots (set_sharded_checkpoint on BaseOptimizer)
     _supports_sharded_checkpoint = True
 
-    def _sharded_save(self, neval, params_flat, mstate, opt_state, state):
+    def _sharded_save(self, neval, params_flat, mstate, opt_state, state,
+                      ef_state=None):
         import orbax.checkpoint as ocp
 
         d = file_io.join(self.sharded_checkpoint_path, f"snap_{neval}")
+        payload = {"params_flat": params_flat, "mstate": mstate,
+                   "opt_state": opt_state}
+        if ef_state is not None:
+            # the error-feedback residual plane is part of the training
+            # state: dropping it on resume would replay the accumulated
+            # quantization error into the wire uncompensated
+            payload["ef_residual"] = ef_state
         with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(d, {"params_flat": params_flat, "mstate": mstate,
-                           "opt_state": opt_state}, force=True)
+            ckptr.save(d, payload, force=True)
         file_io.save(dict(state), d + ".driver")
 
     def _shard_batch(self, batch, sharding):
@@ -284,7 +422,14 @@ class DistriOptimizer(BaseOptimizer):
                 f"not divisible by {n_dev} devices on axis '{self.axis}'")
 
         params_tree, mstate = self._init_model(first_batch)
-        flat_space = FlatParamSpace(params_tree, n_dev)
+        spec = CompressionSpec.parse(self.grad_compression)
+        use_ef = spec is not None and spec.error_feedback
+        # the chunk layout rounds to the quantization block so a block
+        # never straddles a device boundary on the wire
+        flat_space = FlatParamSpace(
+            params_tree, n_dev,
+            block_size=spec.block_size
+            if spec is not None and spec.quantized else 1)
         params_flat = flat_space.flatten(params_tree)
 
         # ZeRO-1: optimizer state over the full flat vector, sharded on the
@@ -303,15 +448,51 @@ class DistriOptimizer(BaseOptimizer):
             self.optim_method.init_state, out_shardings=opt_shardings,
         )(jnp.zeros((flat_space.padded_size,), jnp.float32))
 
+        # EF-SGD residual plane: one fp32 local-gradient buffer per
+        # device (row i = device i's accumulated quantization error),
+        # sharded over the data axis beside the ZeRO-1 opt state
+        ef_state = None
+        if use_ef:
+            ef_state = jax.jit(
+                lambda: jnp.zeros((n_dev, flat_space.padded_size),
+                                  jnp.float32),
+                out_shardings=vec_sharding)()
+
         if getattr(self, "_resume", None):
             snap = self._resume
             # save_checkpoint nests the 3rd argument under "model_params"
-            params_flat = jnp.asarray(
-                snap["model_params"]["model_params_flat"])
+            old_padded = int(np.shape(
+                snap["model_params"]["model_params_flat"])[0])
+
+            def refit(a):
+                # a compression-spec change can change the BLOCK
+                # ROUNDING of the flat plane; the layouts differ only
+                # in padding (never read by the model math), so flat-
+                # plane leaves resize by zero-pad / tail-truncate
+                a = jnp.asarray(a)
+                if a.ndim >= 1 and a.shape[-1] == old_padded \
+                        and old_padded != flat_space.padded_size:
+                    if old_padded > flat_space.padded_size:
+                        return a[..., :flat_space.padded_size]
+                    pad = [(0, 0)] * (a.ndim - 1) + \
+                        [(0, flat_space.padded_size - old_padded)]
+                    return jnp.pad(a, pad)
+                return a
+
+            params_flat = refit(snap["model_params"]["model_params_flat"])
             mstate = jax.tree.map(jnp.asarray, snap["model_state"])
             opt_state = jax.tree.map(
-                lambda l, s: jax.device_put(jnp.asarray(l), s),
+                lambda l, s: jax.device_put(refit(l), s),
                 snap["opt_state"], opt_shardings)
+            if use_ef:
+                if "ef_residual" in snap["model_params"]:
+                    ef_state = jax.device_put(
+                        refit(snap["model_params"]["ef_residual"]),
+                        vec_sharding)
+                else:
+                    log.warning(
+                        "checkpoint snapshot has no ef_residual plane; "
+                        "starting error feedback from a zero residual")
             self._apply_driver_state(snap["driver_state"])
 
         if getattr(self, "_resume_sharded", None):
@@ -330,11 +511,64 @@ class DistriOptimizer(BaseOptimizer):
                                                       sharding=s),
                     opt_state, opt_shardings),
             }
+            if use_ef:
+                abstract["ef_residual"] = jax.ShapeDtypeStruct(
+                    ef_state.shape, ef_state.dtype, sharding=vec_sharding)
+            def _layout_error(first_err):
+                # both attempts failing means the snapshot's FLAT
+                # LAYOUT differs in a way this orbax will not reshape
+                # (int8 block rounding changes padded_size)
+                return ValueError(
+                    f"cannot restore {d} under the current "
+                    f"grad_compression: the flat-plane layout (padded "
+                    f"size {flat_space.padded_size}, block "
+                    f"{flat_space.block_size}) does not match the "
+                    f"snapshot's -- resume under the snapshot's "
+                    f"original compression spec or restart training")
+
             with ocp.StandardCheckpointer() as ckptr:
-                restored = ckptr.restore(d, abstract)
+                try:
+                    restored = ckptr.restore(d, abstract)
+                except Exception as first_err:
+                    if use_ef:
+                        # snapshot predates error feedback (taken
+                        # before the EF spec was turned on): retry
+                        # without the residual plane and keep the
+                        # zeros init, matching the non-sharded path's
+                        # graceful degrade
+                        abstract.pop("ef_residual")
+                        try:
+                            restored = ckptr.restore(d, abstract)
+                        except Exception:
+                            raise _layout_error(first_err) from first_err
+                        restored["ef_residual"] = ef_state
+                        log.warning(
+                            "sharded snapshot %s has no ef_residual "
+                            "plane; starting error feedback from a "
+                            "zero residual", d)
+                    else:
+                        # the snapshot may carry an ef_residual plane
+                        # the current (EF-off) spec does not use:
+                        # restore it alongside and discard, instead of
+                        # surfacing orbax's raw key-mismatch error
+                        abstract["ef_residual"] = jax.ShapeDtypeStruct(
+                            (n_dev, flat_space.padded_size), jnp.float32,
+                            sharding=vec_sharding)
+                        try:
+                            restored = ckptr.restore(d, abstract)
+                        except Exception:
+                            raise _layout_error(first_err) from first_err
+                        restored.pop("ef_residual")
+                        log.warning(
+                            "sharded snapshot %s carries an ef_residual "
+                            "plane the current grad_compression does "
+                            "not use; discarding it (error feedback "
+                            "restarts from zero if re-enabled later)", d)
             params_flat = restored["params_flat"]
             mstate = restored["mstate"]
             opt_state = restored["opt_state"]
+            if use_ef:
+                ef_state = restored["ef_residual"]
             self._apply_driver_state(file_io.load(d + ".driver"))
             # consumed: a later failure-retry must re-resolve the LATEST
             # snapshot, not replay this one
@@ -378,6 +612,8 @@ class DistriOptimizer(BaseOptimizer):
             xc, tc = self._shard_batch(first_batch, batch_sharding)
             cost_args = (params_flat, mstate, opt_state, xc, tc,
                          jax.random.key(0))
+            if use_ef:
+                cost_args += (ef_state,)
             if use_health:
                 cost_args += (jax.ShapeDtypeStruct((), jnp.bool_), seg_ids)
             self.telemetry.attach_cost(
@@ -391,18 +627,22 @@ class DistriOptimizer(BaseOptimizer):
         stats_holder = [None]
 
         def dispatch(staged):
-            nonlocal params_flat, mstate, opt_state
+            nonlocal params_flat, mstate, opt_state, ef_state
             x, target = staged
+            args = [params_flat, mstate, opt_state, x, target,
+                    RNG.next_key()]
+            if use_ef:
+                args.append(ef_state)
             if use_health:
-                params_flat, mstate, opt_state, loss, stats = step(
-                    params_flat, mstate, opt_state, x, target,
-                    RNG.next_key(),
-                    mon.due(self.driver_state["neval"]), seg_ids)
-                stats_holder[0] = stats
-            else:
-                params_flat, mstate, opt_state, loss = step(
-                    params_flat, mstate, opt_state, x, target,
-                    RNG.next_key())
+                args += [mon.due(self.driver_state["neval"]), seg_ids]
+            out = step(*args)
+            params_flat, mstate, opt_state, loss = out[:4]
+            i = 4
+            if use_ef:
+                ef_state = out[i]
+                i += 1
+            if use_health:
+                stats_holder[0] = out[i]
             return loss
 
         def validate_cb():
@@ -420,12 +660,32 @@ class DistriOptimizer(BaseOptimizer):
         def checkpoint_cb(state):
             if getattr(self, "sharded_checkpoint_path", None):
                 self._sharded_save(state["neval"], params_flat, mstate,
-                                   opt_state, state)
+                                   opt_state, state, ef_state=ef_state)
             else:
+                pdict = {"model_params_flat": params_flat}
+                if use_ef:
+                    pdict["ef_residual"] = ef_state
                 file_io.save_checkpoint(
-                    self.checkpoint_path, state["neval"],
-                    {"model_params_flat": params_flat}, mstate,
+                    self.checkpoint_path, state["neval"], pdict, mstate,
                     opt_state, state)
+
+        def health_cb():
+            raw = jax.device_get(stats_holder[0])
+            if use_ef:
+                # residual-norm trajectory: how much quantization error
+                # the EF plane is carrying (flat when healthy; growth
+                # means the wire is systematically dropping signal)
+                raw = dict(raw)
+                raw["ef_residual_norm"] = float(jnp.linalg.norm(ef_state))
+            return raw
+
+        # the flat plane's per-step wire footprint (both collectives),
+        # stamped on every step event: wire_bytes / compression_ratio
+        # feed the obs_report "Communication" section and the
+        # BENCH_QCOMM A/B
+        comm_fields = (uncompressed_wire_summary(flat_space.padded_size)
+                       if spec is None
+                       else spec.wire_summary(flat_space.padded_size))
 
         # _shard_batch treats each host's minibatch as process-LOCAL
         # (jax.make_array_from_process_local_data), so the records
@@ -437,8 +697,8 @@ class DistriOptimizer(BaseOptimizer):
             records_of=lambda b: b.size() * jax.process_count(),
             validate_cb=validate_cb, feed_plateau=feed_plateau,
             checkpoint_cb=checkpoint_cb,
-            health_cb=(lambda: jax.device_get(stats_holder[0]))
-            if use_health else None)
+            health_cb=health_cb if use_health else None,
+            event_fields=comm_fields)
 
         params_tree = jax.jit(flat_space.unflatten)(params_flat)
         self.model.set_parameters(params_tree)

@@ -513,7 +513,7 @@ class BaseOptimizer:
                         stage_device=None, records_of=None,
                         extra_summaries=None, validate_cb=None,
                         feed_plateau=None, checkpoint_cb=None,
-                        health_cb=None):
+                        health_cb=None, event_fields=None):
         """The ONE training driver loop shared by Local/Distri/Strategy
         optimizers (they differ only in the step signature and how
         batches reach the devices, injected via the callbacks).
@@ -547,6 +547,9 @@ class BaseOptimizer:
           attached ``HealthMonitor`` decides the cadence); a sample
           forces a loss point sync like a validation firing, and the
           monitor handles event recording + watchdog policy.
+        - ``event_fields``: a static dict merged into every step event
+          (e.g. the dp driver's ``wire_bytes`` / ``compression_ratio``
+          communication footprint).
 
         The per-step loss sync (``float(loss)``) runs every
         ``sync_every``-th step only (default 1 = classic behavior; see
@@ -658,6 +661,8 @@ class BaseOptimizer:
                          "sync_skew": sync_skew}
                 if qdepth is not None:
                     event["queue_depth"], event["queue_capacity"] = qdepth
+                if event_fields:
+                    event.update(event_fields)
                 if tel is not None:
                     tel.record_step(event)
                 self._log_progress(loss, state["throughput"], data_wait,

@@ -14,8 +14,13 @@ section 2.4), and we keep the same ownership layout on the TPU mesh:
 - weights:    ``all_gather`` rebuilds the replicated flat vector (the
   analogue of sendWeightPartition + getWeights, :193-220, :307-320).
 
-fp16 wire compression is unnecessary on ICI (bf16 compute is native); XLA
-picks the collective algorithm.
+Wire compression (the analogue of the reference's fp16-on-the-wire) is
+a property of the COLLECTIVE's format, not of this layout: see
+``ops/quantization.py`` (``CompressionSpec``) and the dp step's
+quantized ``all_to_all`` path -- this module only guarantees the chunk
+layout rounds to the quantization block (``block_size=``).  On-chip ICI
+rarely needs it (bf16 compute is native); XLA picks the collective
+algorithm.
 """
 
 from typing import Any, Tuple
@@ -32,15 +37,23 @@ class FlatParamSpace:
     ``num_chunks`` devices each own ``chunk_size`` contiguous elements,
     mirroring the reference's chunk ownership
     (AllReduceParameter.scala:147-167).
+
+    ``block_size > 1`` additionally rounds each chunk up to a whole
+    number of quantization blocks, so the blockwise int8 wire format
+    (``ops/quantization.py``) never straddles a chunk boundary: padding
+    is chosen as the least multiple of ``num_chunks * block_size`` that
+    holds every parameter.  The default (1) keeps the historical layout
+    bit-for-bit.
     """
 
-    def __init__(self, params_tree: Any, num_chunks: int):
+    def __init__(self, params_tree: Any, num_chunks: int,
+                 block_size: int = 1):
         flat, self._unravel = ravel_pytree(params_tree)
         self.true_size = int(flat.size)
         self.num_chunks = int(num_chunks)
-        self.padded_size = (
-            (self.true_size + num_chunks - 1) // num_chunks * num_chunks
-        )
+        self.block_size = max(1, int(block_size))
+        unit = self.num_chunks * self.block_size
+        self.padded_size = (self.true_size + unit - 1) // unit * unit
         self.chunk_size = self.padded_size // num_chunks
         self.dtype = flat.dtype
 

@@ -61,12 +61,20 @@ def redirect_spark_info_logs(path=None):
     return redirect_spark_info_logs(log_file=path or log_file())
 
 
-def enable_compilation_cache(path="/tmp/jax_cache"):
+def enable_compilation_cache(path=None):
     """Persistent XLA compilation cache: an earlier bench/evidence run in
     the same round warms the big compiles for later runs.  The env var is
     set BEFORE jax is imported so it applies even where
-    ``jax.config.update`` rejects the option."""
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", path)
+    ``jax.config.update`` rejects the option.
+
+    ``path=None`` defaults to ``/tmp/jax_cache`` WITHOUT overriding an
+    env var already in force; an explicit ``path`` (e.g. the
+    ``--compilationCache`` CLI flag) wins over the env var.  Returns the
+    active cache directory."""
+    if path is None:
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    else:
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = path
     try:
         import jax
 
@@ -74,6 +82,36 @@ def enable_compilation_cache(path="/tmp/jax_cache"):
                           os.environ["JAX_COMPILATION_CACHE_DIR"])
     except Exception:
         pass
+    return os.environ["JAX_COMPILATION_CACHE_DIR"]
+
+
+def compilation_cache_status():
+    """``{"dir", "entries", "warm"}`` for the active compilation cache,
+    or ``None`` when no cache dir is configured.  The ONE place the
+    entry counting lives -- the log note below and the telemetry
+    header both consume this, so they cannot disagree.  Sample it at
+    run START: a lazily-taken count sees the run's own first compiles
+    and misreports cold as warm."""
+    d = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not d:
+        return None
+    try:
+        n = len(os.listdir(d)) if os.path.isdir(d) else 0
+    except OSError:
+        n = 0
+    return {"dir": d, "entries": n, "warm": n > 0}
+
+
+def compilation_cache_note():
+    """One-line warm/cold note for logs and the telemetry header:
+    whether the active compilation cache already holds compiled
+    programs (repeat runs skip the big XLA compiles) or starts cold."""
+    status = compilation_cache_status()
+    if status is None:
+        return "compilation cache: disabled"
+    n = status["entries"]
+    return (f"compilation cache at {status['dir']}: {n} cached programs "
+            f"({'warm -- repeat compiles will hit' if n else 'cold'})")
 
 
 def honor_env_platforms():

@@ -67,6 +67,11 @@ class TrainSummary(Summary):
                         event["nonfinite_grads"], step)
         self.add_scalar("Health/NonFiniteParams",
                         event["nonfinite_params"], step)
+        if "ef_residual_norm" in event:
+            # gradient-compression error-feedback residual (the
+            # docs/performance.md "watch for growth" signal)
+            self.add_scalar("Health/EfResidualNorm",
+                            event["ef_residual_norm"], step)
         for name, rec in (event.get("layers") or {}).items():
             self.add_scalar("Health/GradNorm" + name,
                             rec["grad_norm"], step)

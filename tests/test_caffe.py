@@ -202,7 +202,11 @@ class TestGraphExport:
     reference CaffePersister — Concat towers and Graph DAGs, not just
     Sequential chains."""
 
+    @pytest.mark.slow
     def test_inception_v1_roundtrip(self, tmp_path):
+        # slow tier: full 224x224 InceptionV1 build+export (~28s); the
+        # grouped-conv/Concat/Graph DAG export paths stay tier-1 via
+        # the smaller round-trip tests in this module
         from bigdl_tpu.models.inception import InceptionV1NoAuxClassifier
 
         RNG.set_seed(0)

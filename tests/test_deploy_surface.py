@@ -27,10 +27,15 @@ class TestDockerSurface:
             REPO, "bigdl_tpu", "models", "run.py")).read()
         assert f'"{cmd[1]}"' in run_src
         # and the console entry point must resolve
-        import tomllib
-        with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
-            scripts = tomllib.load(f)["project"]["scripts"]
-        mod, fn = scripts["bigdl-tpu-train"].split(":")
+        try:
+            import tomllib
+            with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+                entry = tomllib.load(f)["project"]["scripts"]["bigdl-tpu-train"]
+        except ModuleNotFoundError:      # tomllib is 3.11+; 3.10 regexes
+            toml = open(os.path.join(REPO, "pyproject.toml")).read()
+            entry = re.search(
+                r'^bigdl-tpu-train\s*=\s*"([^"]+)"', toml, re.M).group(1)
+        mod, fn = entry.split(":")
         import importlib
         assert callable(getattr(importlib.import_module(mod), fn))
 

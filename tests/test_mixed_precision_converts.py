@@ -25,6 +25,12 @@ from bigdl_tpu.nn import CrossEntropyCriterion
 from bigdl_tpu.optim.train_step import _cast_params, make_train_step
 from bigdl_tpu.utils.random_generator import RNG
 
+#: cross-platform export (CPU host -> TPU-lowered StableHLO) needs the
+#: stable jax.export API, absent from pre-0.5 jax builds
+requires_modern_jax = pytest.mark.skipif(
+    not hasattr(jax, "export"),
+    reason="jax.export (stable export API) unavailable on this jax")
+
 
 def _exported_step_text():
     RNG.set_seed(0)
@@ -53,6 +59,7 @@ class TestConvertTraffic:
         assert out["s"].dtype == jnp.float32
         assert out["i"].dtype == jnp.int32
 
+    @requires_modern_jax
     def test_exported_step_convert_budget(self):
         """TPU-lowered StableHLO of the bf16 fused train step: the
         measured counts are 112 total / 48 vector converts for the

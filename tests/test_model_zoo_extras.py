@@ -25,7 +25,11 @@ class TestAlexNet:
 
 
 class TestInceptionV2:
+    @pytest.mark.slow
     def test_forward_shape(self):
+        # slow tier: a ~26s full 224x224 InceptionV2 compile; the
+        # (already slow-marked) inception-train v2 CLI smoke covers the
+        # same build path
         y = InceptionV2(7).forward(jnp.zeros((1, 224, 224, 3)))
         assert y.shape == (1, 7)
 
@@ -37,14 +41,43 @@ class TestCliMains:
                   "--maxIteration", "2"])
         run.main(["lenet-test", "--synthN", "128", "-b", "32"])
 
+    def test_compilation_cache_flag(self, tmp_path, monkeypatch):
+        """--compilationCache DIR routes through
+        utils.config.enable_compilation_cache (the bench's warm-compile
+        path) and populates the cache; the note helper reports state."""
+        import os
+
+        from bigdl_tpu.models import run
+        from bigdl_tpu.utils import config
+
+        cache = str(tmp_path / "xla_cache")
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        try:
+            run.main(["lenet-train", "--synthN", "64", "-b", "32",
+                      "--maxIteration", "1", "--compilationCache", cache])
+            assert os.environ["JAX_COMPILATION_CACHE_DIR"] == cache
+            note = config.compilation_cache_note()
+            assert cache in note
+            # the explicit flag wins over a pre-set env var too
+            monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR",
+                               "/tmp/elsewhere")
+            assert config.enable_compilation_cache(cache) == cache
+        finally:
+            # tmp_path dies with the test; point the GLOBAL jax config
+            # back at the durable default so later tests never compile
+            # against a deleted cache dir
+            config.enable_compilation_cache("/tmp/jax_cache")
+
     def test_perf_driver(self):
         from bigdl_tpu.models import perf
         rate = perf.run_perf("lenet", batch=16, iterations=2)
         assert rate > 0
 
+    @pytest.mark.slow
     def test_perf_driver_token_models(self):
         """The LM rows (BASELINE.md SimpleRNN throughput; transformer
-        flagship) run through the same fused-step perf harness."""
+        flagship) run through the same fused-step perf harness.  Slow
+        tier (~27s of compiles); test_perf_driver pins the harness."""
         from bigdl_tpu.models import perf
         assert perf.run_perf("simplernn", batch=4, iterations=2) > 0
         assert perf.run_perf("lstm_lm", batch=2, iterations=2) > 0

@@ -52,10 +52,13 @@ class TestVision:
         one_train_step(model, jnp.zeros((4, 32, 32, 3)),
                        jnp.zeros((4,), jnp.int32), nn.CrossEntropyCriterion())
 
+    @pytest.mark.slow
     def test_resnet_remat_equivalence(self):
         """remat=True must change memory behavior only: same params after
         one SGD step, same loss (nn.Remat recomputes, never
-        re-randomises).  stem_s2d equivalence is pinned at MODULE level
+        re-randomises).  Slow tier (~16s of double ResNet compiles; the
+        remat build path stays tier-1 via the serializer round-trip in
+        test_bigdl_format).  stem_s2d equivalence is pinned at MODULE level
         (test_conv.py::TestSpaceToDepthStem) instead: its ~1e-6
         fp32-reassociation difference is amplified exponentially by
         fresh-init train-mode BatchNorm (divide by batch std ~1.8x per
@@ -168,9 +171,12 @@ class TestTransformerFamily:
         with pytest.raises(ValueError):
             transformer_lm("giant")
 
+    @pytest.mark.slow
     def test_markov_corpus_learnable(self):
         """Loss on the synthetic Markov stream drops well below uniform
-        (ln V) -- the corpus has learnable structure by construction."""
+        (ln V) -- the corpus has learnable structure by construction.
+        Slow tier: a ~30s convergence E2E (the structural transformer
+        pins above stay tier-1)."""
         import jax
 
         import bigdl_tpu.nn as nn

@@ -80,6 +80,19 @@ class TestStepTelemetrySchema:
         assert header["cost"]["flops_per_step"] > 0
         assert header["cost"]["records_per_step"] == 32
 
+    def test_header_notes_compilation_cache(self, tmp_path, monkeypatch):
+        """The hit/miss note: a configured XLA compilation cache shows
+        up on the header with its entry count (warm vs cold)."""
+        d = str(tmp_path / "cache")
+        os.makedirs(d)
+        open(os.path.join(d, "entry0"), "w").close()
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", d)
+        tel = StepTelemetry(str(tmp_path / "run"), trace=False)
+        header = tel.write_header()
+        tel.close()
+        assert header["compilation_cache"] == {
+            "dir": d, "entries": 1, "warm": True}
+
     def test_three_step_events_with_schema(self, run):
         steps = [e for e in run["events"] if e["kind"] == "step"]
         assert [e["step"] for e in steps] == [1, 2, 3]
@@ -147,7 +160,10 @@ class TestObsReportCLI:
         assert "top HLO ops" in out and "%fusion.1" in out
         assert "busy" in out
 
+    @pytest.mark.slow
     def test_report_json_mode(self, run):
+        # slow tier (~20s subprocess leg); the tier-1 CLI smoke of both
+        # report formats lives in test_health.py::TestObsReportCLI
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
              run["dir"], "--json"],

@@ -176,6 +176,41 @@ def _health_section(events):
     return sec
 
 
+def _communication_section(steps, other):
+    """Summarize the dp wire plane: per-step wire bytes / compression
+    ratio (stamped on every distributed step event) and the
+    error-feedback residual-norm trajectory (riding the health samples
+    when the compression spec has error feedback on).  None for runs
+    without wire telemetry (local training)."""
+    wired = [e for e in steps if "wire_bytes" in e]
+    residuals = [(e.get("step"), e["ef_residual_norm"])
+                 for e in other
+                 if e.get("kind") == "health" and "ef_residual_norm" in e]
+    if not wired and not residuals:
+        return None
+    sec = {}
+    if wired:
+        last = wired[-1]
+        sec["wire_bytes_per_step"] = last["wire_bytes"]
+        sec["wire_bytes_total"] = sum(e["wire_bytes"] for e in wired)
+        for key in ("grad_wire_bytes", "weight_wire_bytes",
+                    "compression_ratio", "grad_compression_ratio"):
+            if key in last:
+                sec[key] = last[key]
+    if residuals:
+        finite = [r for _, r in residuals if _finite(r)]
+        sec["ef_residual_norm_first"] = residuals[0][1] \
+            if _finite(residuals[0][1]) else None
+        sec["ef_residual_norm_last"] = residuals[-1][1] \
+            if _finite(residuals[-1][1]) else None
+        sec["ef_residual_norm_max"] = max(finite) if finite else None
+        stride = max(1, len(residuals) // 40)
+        sec["ef_residual_trajectory"] = [
+            {"step": s, "residual_norm": r if _finite(r) else None}
+            for s, r in residuals[::stride]]
+    return sec
+
+
 def build_report(run_dir, xplane_dir=None, top=10):
     jsonl = os.path.join(run_dir, "telemetry.jsonl")
     if not os.path.isfile(jsonl):
@@ -247,6 +282,9 @@ def build_report(run_dir, xplane_dir=None, top=10):
     health = _health_section(other)
     if health:
         rep["health"] = health
+    comm = _communication_section(steps, other)
+    if comm:
+        rep["communication"] = comm
 
     rep["host_spans"] = span_totals(os.path.join(run_dir, "trace.json"))
 
@@ -337,6 +375,31 @@ def format_report(rep):
             if a.get("incident_dir"):
                 line += f" -> {a['incident_dir']}"
             out.append(line)
+    cm = rep.get("communication")
+    if cm:
+        if cm.get("wire_bytes_per_step") is not None:
+            line = (f"communication: {cm['wire_bytes_per_step']:,} wire "
+                    f"bytes/step")
+            if cm.get("grad_wire_bytes") is not None:
+                line += (f" (grad {cm['grad_wire_bytes']:,} + weights "
+                         f"{cm.get('weight_wire_bytes', 0):,})")
+            if cm.get("compression_ratio") is not None:
+                line += (f"   compression {cm['compression_ratio']:.2f}x"
+                         f" (grad plane "
+                         f"{cm.get('grad_compression_ratio', 0):.2f}x)")
+            out.append(line)
+        # gate on residual data being PRESENT, not on the last sample
+        # being finite -- a blown-up residual is the case the line
+        # exists to surface ("non-finite" renders via _r)
+        if cm.get("ef_residual_trajectory"):
+            def _r(v):
+                return "non-finite" if v is None else f"{v:.4g}"
+            out.append(
+                f"error-feedback residual norm: "
+                f"{_r(cm.get('ef_residual_norm_first'))} -> "
+                f"{_r(cm.get('ef_residual_norm_last'))}"
+                + (f" (max {cm['ef_residual_norm_max']:.4g})"
+                   if cm.get("ef_residual_norm_max") is not None else ""))
     wd = rep.get("watchdogs") or {}
     if wd.get("recompile_steps"):
         out.append("RECOMPILES after warmup at steps: "
