@@ -317,6 +317,186 @@ def run_health_bench(stats_every=None, steps=None, batch=None,
 
 
 # --------------------------------------------------------------------------- #
+# Inference-serving micro-benchmark (ISSUE 5): a closed-loop load
+# generator A/Bs the semaphore-serial PredictionService against the
+# coalesced+bucketed ServingEngine at fixed offered load (C concurrent
+# clients), reporting requests/sec and p99 latency plus the serving
+# telemetry section from the engine leg's JSONL.
+# --------------------------------------------------------------------------- #
+
+def _serve_model(hidden):
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.random_generator import RNG
+
+    RNG.set_seed(0)
+    m = (nn.Sequential().add(nn.Linear(16, hidden)).add(nn.ReLU())
+         .add(nn.Linear(hidden, hidden)).add(nn.ReLU())
+         .add(nn.Linear(hidden, 10)))
+    m.build(jax.ShapeDtypeStruct((2, 16), jnp.float32))
+    return m
+
+
+def _closed_loop(predict, xs, concurrency, per_client):
+    """C client threads, each issuing ``per_client`` sequential
+    requests (closed loop: a client's next request waits for its
+    previous response).  Returns ({(client, j): (sample_idx, out)},
+    sorted latencies, wall seconds)."""
+    import threading
+
+    outs, errors = {}, []
+    lats = [[] for _ in range(concurrency)]
+
+    def worker(w):
+        try:
+            for j in range(per_client):
+                i = (w * per_client + j) % len(xs)
+                t0 = time.perf_counter()
+                y = predict(xs[i])
+                lats[w].append(time.perf_counter() - t0)
+                outs[(w, j)] = (i, y)
+        except Exception as e:           # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return outs, sorted(lat for per in lats for lat in per), wall
+
+
+def run_serve_bench(concurrency=None, per_client=None, hidden=None,
+                    max_batch=None, max_wait_ms=None, out_dir=None):
+    """A/B inference serving: semaphore-serial vs coalesced+bucketed.
+
+    Knobs (env tier): BENCH_SERVE_CONC (default 8 concurrent clients),
+    BENCH_SERVE_REQS (default 50 requests per client),
+    BENCH_SERVE_HIDDEN (default 512), BENCH_SERVE_BATCH (default =
+    concurrency, so a full coalescing tick matches the offered load),
+    BENCH_SERVE_WAIT_MS (default 2).  Prints ONE JSON record whose
+    ``value`` is the coalesced-over-serial requests/sec ratio
+    (``vs_baseline`` = value / 2.0, the ISSUE-5 target at concurrency
+    >= 8 on CPU).  ``extra.bit_exact`` witnesses the identical-outputs
+    contract: a coalesced burst's per-sample logits equal the same
+    requests served UNBATCHED at the same bucket, bit for bit (within
+    one bucket shape XLA's reduction order is fixed and eval-mode rows
+    are independent -- docs/performance.md, "Inference serving"), and
+    ``extra.recompiles_after_precompile`` must be 0.
+    """
+    _honor_env_platforms()
+    import tempfile
+
+    import numpy as np
+
+    from bigdl_tpu import optim
+    from bigdl_tpu.observability import StepTelemetry
+    from bigdl_tpu.observability.watchdogs import backend_compile_count
+    from bigdl_tpu.serving import ServingEngine
+
+    env = os.environ
+    concurrency = (int(env.get("BENCH_SERVE_CONC", "8"))
+                   if concurrency is None else concurrency)
+    per_client = (int(env.get("BENCH_SERVE_REQS", "50"))
+                  if per_client is None else per_client)
+    hidden = (int(env.get("BENCH_SERVE_HIDDEN", "512"))
+              if hidden is None else hidden)
+    max_batch = (int(env.get("BENCH_SERVE_BATCH", str(concurrency)))
+                 if max_batch is None else max_batch)
+    max_wait_ms = (float(env.get("BENCH_SERVE_WAIT_MS", "2"))
+                   if max_wait_ms is None else max_wait_ms)
+
+    model = _serve_model(hidden)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((256, 16)).astype("float32")
+    total = concurrency * per_client
+
+    # leg A: the semaphore-serial baseline (batch-1 eval per request)
+    svc = optim.PredictionService(model, num_threads=concurrency)
+    svc.predict(xs[0])                  # batch-1 warmup compile
+    outs_a, lats_a, wall_a = _closed_loop(svc.predict, xs, concurrency,
+                                          per_client)
+    rps_a = total / wall_a
+
+    def _engine_leg(run_dir):
+        tel = StepTelemetry(run_dir, run_name="serve", trace=False)
+        eng = ServingEngine(model, max_batch_size=max_batch,
+                            max_wait_ms=max_wait_ms, telemetry=tel)
+        try:
+            precompiles = eng.precompile()
+            before = backend_compile_count()
+            outs_b, lats_b, wall_b = _closed_loop(eng.predict, xs,
+                                                  concurrency, per_client)
+            recompiles = backend_compile_count() - before
+            # identical-outputs witness: a coalesced burst, bit-compared
+            # against each request served unbatched at the SAME bucket
+            idxs = [i % len(xs) for i in range(max_batch)]
+            futs = [eng.submit(xs[i]) for i in idxs]
+            rows = [f.result(30) for f in futs]
+            bit_exact = all(
+                np.array_equal(rows[k], eng.predict_at(xs[i], f.bucket))
+                for k, (i, f) in enumerate(zip(idxs, futs)))
+        finally:
+            eng.close()
+            tel.close()
+        serving = _obs_report_module().build_report(run_dir).get("serving")
+        return outs_b, lats_b, wall_b, precompiles, recompiles, bit_exact, \
+            serving
+
+    import contextlib
+
+    run_dir = tempfile.TemporaryDirectory() if out_dir is None \
+        else contextlib.nullcontext(out_dir)
+    with run_dir as d:
+        (outs_b, lats_b, wall_b, precompiles, recompiles, bit_exact,
+         serving) = _engine_leg(d)
+    rps_b = total / wall_b
+    # cross-leg outputs agree to float rounding (different bucket shapes
+    # pick different XLA reduction blockings; bit-exactness is the
+    # within-bucket witness above)
+    outputs_close = all(
+        np.allclose(outs_b[k][1], outs_a[k][1], rtol=1e-5, atol=1e-6)
+        for k in outs_a)
+
+    # one nearest-rank percentile definition: the record's p50/p99 must
+    # agree with the serving_report's, computed by the same function
+    _p = _obs_report_module().percentile
+
+    speedup = rps_b / max(rps_a, 1e-9)
+    record = {
+        "metric": "serving_coalesced_rps_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup / 2.0, 4),    # target: >= 2x
+        "extra": {
+            "concurrency": concurrency, "requests": total,
+            "hidden": hidden, "max_batch_size": max_batch,
+            "max_wait_ms": max_wait_ms,
+            "serial": {"requests_per_s": round(rps_a, 1),
+                       "p50_ms": round(_p(lats_a, 50) * 1e3, 3),
+                       "p99_ms": round(_p(lats_a, 99) * 1e3, 3)},
+            "coalesced": {"requests_per_s": round(rps_b, 1),
+                          "p50_ms": round(_p(lats_b, 50) * 1e3, 3),
+                          "p99_ms": round(_p(lats_b, 99) * 1e3, 3)},
+            "precompiles": precompiles,
+            "recompiles_after_precompile": recompiles,
+            "bit_exact": bool(bit_exact),
+            "outputs_close": bool(outputs_close),
+            "serving_report": serving,
+        },
+    }
+    print(json.dumps(record), flush=True)
+    return record
+
+
+# --------------------------------------------------------------------------- #
 # Quantized-collective micro-benchmark (ISSUE 4): A/B the dp step's wire
 # formats -- fp32 vs bf16 cast vs blockwise int8 + error feedback -- on
 # sec/step and wire bytes, read back from the StepTelemetry JSONL.
@@ -759,6 +939,11 @@ def main():
         # wire-format A/B on the dp step: in-process and CPU-runnable
         # (the wire-byte accounting is exact on any device count)
         run_qcomm_bench()
+        return
+    if os.environ.get("BENCH_SERVE") or "serve" in sys.argv[1:]:
+        # serving A/B (semaphore-serial vs coalesced+bucketed):
+        # in-process and CPU-runnable by design
+        run_serve_bench()
         return
     if os.environ.get("BENCH_CHILD"):
         if os.environ.get("BENCH_FAKE_HANG"):  # test hook: dead-tunnel sim

@@ -11,6 +11,9 @@ Re-designed from scratch for TPU (JAX/XLA/Pallas/pjit):
 - ``bigdl_tpu.parallel`` -- Mesh management, sharded train steps, ZeRO-1 flat-parameter
                             chunking (the TPU-native replacement for BigDL's
                             AllReduceParameter BlockManager parameter server).
+- ``bigdl_tpu.serving``  -- Dynamic-batched inference serving: request coalescing,
+                            bucketed shape padding, sharded multi-device predict.
+                            Reference: .../bigdl/optim/PredictionService.scala.
 - ``bigdl_tpu.utils``    -- Engine runtime config, RNG, file IO, directed graph.
 - ``bigdl_tpu.models``   -- LeNet5 / VGG / ResNet / RNN model zoo with Train entry points.
 """

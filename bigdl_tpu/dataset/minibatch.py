@@ -61,6 +61,42 @@ class MiniBatch:
         return MiniBatch(cut(self.input),
                          None if self.target is None else cut(self.target))
 
+    def pad_to(self, batch_to: int, pad_target: bool = True) -> "MiniBatch":
+        """Zero-pad the batch axis up to ``batch_to`` rows (the serving
+        bucket ladder's Sample->padded-MiniBatch path): padded rows are
+        inert in eval mode (batch-row-independent layers) and the
+        caller slices them off the output.  Identity when already
+        sized; a SMALLER target is an error, not a truncation.
+
+        ``pad_target=False`` passes the target through UNTOUCHED (its
+        batch axis stays at the real row count) -- the predict path
+        never reads it, so padding it would be a wasted copy and an
+        object-dtype label tree must not veto padding the input."""
+        n = self.size()
+        if batch_to == n:
+            return self
+        if batch_to < n:
+            raise ValueError(
+                f"pad_to({batch_to}) cannot shrink a batch of {n}")
+        # lazy import: serving imports this module at load time
+        from bigdl_tpu.serving.buckets import pad_batch_axis
+
+        def check(x, label):
+            if isinstance(x, (tuple, list)):
+                for e in x:
+                    check(e, label)
+            elif np.asarray(x).dtype == object:   # e.g. SparseTensor leaves
+                raise TypeError(
+                    f"pad_to cannot zero-pad non-array {label} leaves "
+                    f"({type(x).__name__})")
+
+        check(self.input, "input")
+        target = self.target
+        if pad_target and target is not None:
+            check(target, "target")
+            target = pad_batch_axis(target, batch_to)
+        return MiniBatch(pad_batch_axis(self.input, batch_to), target)
+
 
 class PaddingParam:
     """Pad variable-length features to a common shape

@@ -31,7 +31,9 @@ Local/Distri/Strategy training all produce the identical schema.
 """
 
 import json
+import logging
 import os
+import threading
 import time
 
 from bigdl_tpu.observability.spans import SpanTracer
@@ -45,6 +47,8 @@ SCHEMA_VERSION = 1
 #: fsynced to disk the moment they are recorded (a run that blows up
 #: right after a health anomaly must leave the evidence on disk)
 DURABLE_KINDS = frozenset({"health", "anomaly"})
+
+log = logging.getLogger("bigdl_tpu.observability")
 
 
 def peak_flops(device=None):
@@ -140,51 +144,69 @@ class StepTelemetry:
         self._cost = None
         self._wrote_header = False
         self._closed = False
+        # a ServingEngine records inference events from its dispatcher
+        # thread while the owning thread may be training against the
+        # same run dir: serialize the lazy header write and the JSONL
+        # appends (reentrant -- record() calls write_header())
+        self._write_lock = threading.RLock()
 
     # ----- generic event plumbing ----------------------------------------- #
     def record(self, kind, **fields):
         """Append one JSONL event (header is written lazily first).
         Health/anomaly/incident events are additionally fsynced: they
         are exactly the lines a crashing run must not lose."""
-        if kind != "header" and not self._wrote_header:
-            self.write_header()
-        event = {"kind": kind, "ts": time.time(), **fields}
-        self._f.write(json.dumps(event) + "\n")
-        self._f.flush()
-        if kind in DURABLE_KINDS:
-            try:
-                os.fsync(self._f.fileno())
-            except OSError:  # pragma: no cover - exotic filesystems
-                pass
-        return event
+        with self._write_lock:
+            if self._closed:
+                # a still-running serving dispatcher may outlive the
+                # owner's close(); dropping the event beats raising
+                # "I/O operation on closed file" into its tick -- but a
+                # DURABLE kind is exactly the line a run must not lose,
+                # so its loss is at least loud
+                if kind in DURABLE_KINDS:
+                    log.warning(
+                        "dropping %r telemetry event recorded after "
+                        "close(): %s", kind, json.dumps(fields, default=str))
+                return None
+            if kind != "header" and not self._wrote_header:
+                self.write_header()
+            event = {"kind": kind, "ts": time.time(), **fields}
+            self._f.write(json.dumps(event) + "\n")
+            self._f.flush()
+            if kind in DURABLE_KINDS:
+                try:
+                    os.fsync(self._f.fileno())
+                except OSError:  # pragma: no cover - exotic filesystems
+                    pass
+            return event
 
     def write_header(self, **extra):
         """Run-level metadata event; called lazily before the first step
         (or eagerly by a driver once the compiled step's cost is known)."""
-        if self._wrote_header:
-            return None
-        self._wrote_header = True
-        fields = {"run": self.run_name, "schema_version": SCHEMA_VERSION}
-        try:
-            import jax
-            dev = jax.devices()[0]
-            fields.update(
-                jax_version=jax.__version__,
-                platform=dev.platform,
-                device_kind=getattr(dev, "device_kind", ""),
-                device_count=jax.device_count(),
-                process_count=jax.process_count(),
-                peak_flops=peak_flops(dev))
-        except Exception:
-            pass
-        if self._cache_status is not None:
-            # hit/miss note for the run report: a warm cache means the
-            # big XLA compiles were (probably) skipped this run
-            fields["compilation_cache"] = self._cache_status
-        if self._cost:
-            fields["cost"] = self._cost
-        fields.update(extra)
-        return self.record("header", **fields)
+        with self._write_lock:   # held through the record() below, so a
+            if self._wrote_header:   # concurrent first event can't land
+                return None          # ahead of the header line
+            self._wrote_header = True
+            fields = {"run": self.run_name, "schema_version": SCHEMA_VERSION}
+            try:
+                import jax
+                dev = jax.devices()[0]
+                fields.update(
+                    jax_version=jax.__version__,
+                    platform=dev.platform,
+                    device_kind=getattr(dev, "device_kind", ""),
+                    device_count=jax.device_count(),
+                    process_count=jax.process_count(),
+                    peak_flops=peak_flops(dev))
+            except Exception:
+                pass
+            if self._cache_status is not None:
+                # hit/miss note for the run report: a warm cache means the
+                # big XLA compiles were (probably) skipped this run
+                fields["compilation_cache"] = self._cache_status
+            if self._cost:
+                fields["cost"] = self._cost
+            fields.update(extra)
+            return self.record("header", **fields)
 
     # ----- step cadence ---------------------------------------------------- #
     def step_begin(self, step):
@@ -260,22 +282,25 @@ class StepTelemetry:
 
     # ----- lifecycle -------------------------------------------------------- #
     def flush(self):
-        self._f.flush()
+        with self._write_lock:   # same shared-owner ordering as record():
+            if not self._closed:     # a finally-path flush after another
+                self._f.flush()      # owner's close() must not raise
         if self.tracer is not None:
             self.tracer.flush()
 
     def close(self):
-        if self._closed:
-            return
-        self._closed = True
-        if not self._wrote_header:
-            self.write_header()
-        self._f.flush()
-        try:
-            os.fsync(self._f.fileno())    # the artifact is the deliverable
-        except OSError:  # pragma: no cover - exotic filesystems
-            pass
-        self._f.close()
+        with self._write_lock:            # don't close the file out from
+            if self._closed:              # under a mid-record dispatcher
+                return
+            if not self._wrote_header:
+                self.write_header()
+            self._closed = True
+            self._f.flush()
+            try:
+                os.fsync(self._f.fileno())  # the artifact is the deliverable
+            except OSError:  # pragma: no cover - exotic filesystems
+                pass
+            self._f.close()
         if self.tracer is not None:
             self.tracer.close()           # deactivates + terminates JSON
 

@@ -372,12 +372,13 @@ class DistriOptimizer(BaseOptimizer):
         file_io.save(dict(state), d + ".driver")
 
     def _shard_batch(self, batch, sharding):
-        x, t = batch.get_input(), batch.get_target()
-        to_global = lambda a: jax.make_array_from_process_local_data(
-            sharding, np.asarray(a))
-        x = jax.tree.map(to_global, x)
-        t = None if t is None else jax.tree.map(to_global, t)
-        return x, t
+        # the staging path is shared with the sharded serving engine
+        # (bigdl_tpu/serving): one definition of "host batch -> global
+        # array on the data axis" for training and inference
+        from bigdl_tpu.parallel.zero import stage_batch_global
+
+        return (stage_batch_global(batch.get_input(), sharding),
+                stage_batch_global(batch.get_target(), sharding))
 
     def _optimize_impl(self):
         from bigdl_tpu.utils.errors import UnsupportedFeatureError

@@ -9,17 +9,113 @@ devices (a psum on the distributed path).
 
 ``compiled_eval_step`` additionally owns the cache of jitted eval steps
 keyed per (model, compute dtype): the evaluation loop
-(``local_optimizer.validate``) and the serving path (``optim.Predictor``)
-share one compiled program per model instead of each ``jax.jit`` call
-site paying its own XLA compile -- previously every validation interval
-recompiled the eval step from scratch.
+(``local_optimizer.validate``), the serving paths (``optim.Predictor``,
+``bigdl_tpu.serving``) share one compiled program per model instead of
+each ``jax.jit`` call site paying its own XLA compile -- previously
+every validation interval recompiled the eval step from scratch.  The
+returned ``CompiledEvalStep`` is a thin callable wrapper that tracks
+the per-shape executable count against an eviction-free bound and can
+warm a bucket ladder up front (``precompile``) so steady-state serving
+never compiles on the request path.
 """
+
+import logging
 
 import jax.numpy as jnp
 import numpy as np
 
+log = logging.getLogger("bigdl_tpu.optim")
 
-def compiled_eval_step(model, compute_dtype=None):
+#: default eviction-free bound on live eval executables per (model,
+#: dtype): a full power-of-two bucket ladder to 1024 is 11 shapes, plus
+#: validation's own batch and a sharded-serving variant or two -- past
+#: ~32 live shapes something is leaking shapes, not bucketing them
+DEFAULT_EVAL_EXECUTABLE_BOUND = 32
+
+
+class CompiledEvalStep:
+    """One jitted eval step, callable as ``step(params, mstate, x)``.
+
+    jax's jit cache already keys executables by input shape; what this
+    wrapper adds for the serving path is (a) ``precompile`` -- execute
+    the step once per bucket shape so the whole ladder is compiled
+    BEFORE traffic arrives, and (b) an EVICTION-FREE bound
+    (``max_executables``): evicting would re-pay a multi-second XLA
+    compile on the request path, so an overflowing cache logs a loud
+    warning (a shape is leaking past the bucket ladder) instead of
+    silently thrashing.
+    """
+
+    def __init__(self, fn, max_executables: int = DEFAULT_EVAL_EXECUTABLE_BOUND):
+        self._fn = fn
+        self.max_executables = max_executables
+        self._warned_at = 0
+        self._has_cache_size = hasattr(fn, "_cache_size")
+
+    def __getattr__(self, name):
+        # ``_cache_size`` is exposed only when the underlying jit
+        # supports it, so the RecompileWatchdog's hasattr-gated watch()
+        # keeps working on old jax without the API.  The bound method is
+        # materialized LAZILY: storing ``fn._cache_size`` on the
+        # instance would put a C-level method object into the
+        # model -> cache -> wrapper -> jit-closure -> model cycle that
+        # the garbage collector cannot traverse, pinning every model
+        # this cache ever served (tests/test_prefetch.py pins
+        # collectability).
+        if name == "_cache_size" and self.__dict__.get("_has_cache_size"):
+            return self.__dict__["_fn"]._cache_size
+        raise AttributeError(name)
+
+    def __call__(self, params, mstate, x):
+        out = self._fn(params, mstate, x)
+        self._check_bound()
+        return out
+
+    def executables(self):
+        """Live executable count, or None where jax can't report it."""
+        return self._fn._cache_size() if self._has_cache_size else None
+
+    def _check_bound(self):
+        n = self.executables()
+        if n is not None and n > self.max_executables and n > self._warned_at:
+            self._warned_at = n
+            log.warning(
+                "eval-step executable cache holds %d entries (bound %d): "
+                "a batch/length shape is leaking past the bucket ladder; "
+                "every new shape pays a full XLA compile on the request "
+                "path (the cache never evicts -- re-compiling would be "
+                "worse)", n, self.max_executables)
+
+    def precompile(self, params, mstate, sample_spec, buckets,
+                   stage=None):
+        """Compile the step for every batch bucket up front.
+
+        ``sample_spec``: ONE sample's feature activity (arrays or
+        ShapeDtypeStructs, no batch axis).  ``stage`` optionally maps
+        the host zero-batch onto the serving path's device layout (the
+        sharded engine stages through the mesh so the warmed executable
+        is the one traffic will hit).  Returns the number of backend
+        compiles this warmup performed (0 when already warm).
+        """
+        import jax
+
+        from bigdl_tpu.observability.watchdogs import backend_compile_count
+
+        before = backend_compile_count()
+        for b in buckets:
+            x = jax.tree.map(
+                lambda s: np.zeros(
+                    (int(b),) + tuple(getattr(s, "shape", np.shape(s))),
+                    dtype=getattr(s, "dtype", np.float32)),
+                sample_spec)
+            if stage is not None:
+                x = stage(x)
+            jax.block_until_ready(self._fn(params, mstate, x))
+        self._check_bound()
+        return backend_compile_count() - before
+
+
+def compiled_eval_step(model, compute_dtype=None) -> CompiledEvalStep:
     """The jitted eval step for ``model`` at ``compute_dtype``, compiled
     once per (model, dtype).  A NEW ``jax.jit`` wrapper per call would
     recompile on every invocation (fresh closure identity); reusing the
@@ -40,7 +136,7 @@ def compiled_eval_step(model, compute_dtype=None):
     key = "f32" if compute_dtype is None else np.dtype(compute_dtype).name
     fn = cache.get(key)
     if fn is None:
-        fn = jax.jit(make_eval_step(model, compute_dtype))
+        fn = CompiledEvalStep(jax.jit(make_eval_step(model, compute_dtype)))
         cache[key] = fn
     return fn
 

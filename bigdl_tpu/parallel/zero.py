@@ -71,6 +71,24 @@ class FlatParamSpace:
             flat, (index * self.chunk_size,), (self.chunk_size,))
 
 
+def stage_batch_global(tree, sharding):
+    """Host batch pytree -> global device arrays under ``sharding``.
+
+    The per-step staging path of the dp driver
+    (``DistriOptimizer._shard_batch``) and of the sharded serving
+    engine (``bigdl_tpu/serving``): each host contributes its
+    process-local rows and jax assembles the global array, so the same
+    call works single-host (a plain sharded transfer) and multi-host
+    (each process places its shard, no gather).  ``None`` subtrees
+    (absent targets) pass through untouched.
+    """
+    if tree is None:
+        return None
+    to_global = lambda a: jax.make_array_from_process_local_data(
+        sharding, np.asarray(a))
+    return jax.tree.map(to_global, tree)
+
+
 def shard_opt_state(optim_method, params, param_shardings, mesh):
     """Optimizer state placed with the same shardings as its params.
 

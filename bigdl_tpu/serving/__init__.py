@@ -1,0 +1,19 @@
+"""High-throughput inference serving (the TPU-native redesign of the
+reference's ``optim/PredictionService.scala`` instance pool).
+
+- ``ServingEngine`` -- request coalescing behind a bounded queue with a
+  ``max_batch_size`` / ``max_wait_ms`` deadline policy, bucketed shape
+  padding (closed executable set, ``precompile()`` warms it), and
+  sharded multi-device predict over a mesh's data axis with host-side
+  round-robin as the fallback.
+- ``BucketLadder`` -- the shape ladder (batch and, for sequence
+  models, length buckets).
+
+See docs/performance.md ("Inference serving") and docs/observability.md
+(extended ``kind: "inference"`` event schema).
+"""
+
+from bigdl_tpu.serving.buckets import BucketLadder
+from bigdl_tpu.serving.engine import ServeFuture, ServingEngine
+
+__all__ = ["BucketLadder", "ServeFuture", "ServingEngine"]

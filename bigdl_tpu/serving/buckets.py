@@ -1,0 +1,214 @@
+"""Bucketed shape padding for inference serving.
+
+XLA compiles one executable per input shape, so a serving workload with
+ragged request counts (1, 3, 7, ...) would compile an executable per
+distinct batch size -- each "new" size a multi-second stall on the
+request path.  A bucket ladder closes the shape set: batch sizes round
+up to a fixed geometric ladder (1/2/4/.../max by default), padded rows
+ride along as zeros and are discarded on return, and the executable
+cache holds at most ``len(ladder)`` entries -- all warmable up front
+(``ServingEngine.precompile``).
+
+The same mechanism serves sequence models on the TIME axis: a length
+ladder pads the stacked batch's axis 1 up to the next rung, so mixed
+request lengths hit a closed (batch-bucket x length-bucket) key set.
+
+Within one bucket shape the padded rows cannot perturb the real rows:
+eval-mode layers are batch-row-independent (BN uses running stats), and
+XLA's reduction blocking is fixed per shape, so a sample's logits are
+BIT-EXACT whether it shares the bucket with 1 or ``bucket - 1`` other
+requests (pinned by tests/test_serving.py).  Across DIFFERENT bucket
+shapes XLA may pick different GEMM blockings, so logits agree only to
+float rounding -- see docs/performance.md, "Inference serving".
+"""
+
+import bisect
+import threading
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+
+class BucketLadder:
+    """A sorted set of allowed sizes; ``bucket_for(n)`` rounds up.
+
+    ``align`` forces every rung to a multiple (the sharded predict path
+    needs batch buckets divisible by the mesh's data-axis size);
+    ``growth`` is the geometric step between rungs (2 by default, so
+    pad waste is bounded by ~2x on any rung).
+
+    Thread-safe: the engine's dispatcher thread can grow the ladder
+    (an over-max length in ``pad_length_axis``) while caller threads
+    read it (``predict_at``, ``precompile``), so lookups/mutation take
+    a lock and iteration walks a snapshot.
+    """
+
+    def __init__(self, max_size: int, min_size: int = 1, growth: int = 2,
+                 align: int = 1):
+        if min_size < 1 or max_size < min_size:
+            raise ValueError(
+                f"need 1 <= min_size <= max_size, got {min_size}/{max_size}")
+        if growth < 2:
+            raise ValueError(f"growth must be >= 2, got {growth}")
+        self.align = max(1, int(align))
+        self._lock = threading.Lock()
+        rungs = set()
+        b = int(min_size)
+        while b < max_size:
+            rungs.add(self._aligned(b))
+            b *= growth
+        rungs.add(self._aligned(int(max_size)))
+        self.rungs: List[int] = sorted(rungs)
+
+    def _aligned(self, n: int) -> int:
+        return -(-n // self.align) * self.align
+
+    @property
+    def max(self) -> int:
+        return self.rungs[-1]
+
+    @property
+    def min(self) -> int:
+        return self.rungs[0]
+
+    def bucket_for(self, n: int) -> Optional[int]:
+        """Smallest rung >= n, or None when n exceeds the ladder."""
+        with self._lock:
+            i = bisect.bisect_left(self.rungs, n)
+            return self.rungs[i] if i < len(self.rungs) else None
+
+    def add(self, n: int) -> int:
+        """Insert (the aligned) ``n`` as a rung; returns the rung."""
+        n = self._aligned(int(n))
+        with self._lock:
+            i = bisect.bisect_left(self.rungs, n)
+            if i == len(self.rungs) or self.rungs[i] != n:
+                self.rungs.insert(i, n)
+        return n
+
+    def copy(self) -> "BucketLadder":
+        """An independent ladder with the same rungs and alignment.
+        Consumers that grow their ladder (``add`` on over-max sizes)
+        copy at construction, so a ladder shared between consumers
+        never accumulates rungs another consumer added -- each keeps
+        its own closed, warmable shape set."""
+        new = BucketLadder.__new__(BucketLadder)
+        new.align = self.align
+        new._lock = threading.Lock()
+        with self._lock:
+            new.rungs = list(self.rungs)
+        return new
+
+    def __iter__(self) -> Iterator[int]:
+        with self._lock:
+            return iter(list(self.rungs))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.rungs)
+
+    def __contains__(self, n) -> bool:
+        with self._lock:
+            return n in self.rungs
+
+    def __repr__(self):
+        return f"BucketLadder({self.rungs}, align={self.align})"
+
+
+def _pad0(a, target: int):
+    a = np.asarray(a)
+    if a.shape[0] == target:
+        return a
+    if a.shape[0] > target:
+        raise ValueError(f"batch {a.shape[0]} exceeds bucket {target}")
+    out = np.zeros((target,) + a.shape[1:], a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+def pad_batch_axis(tree, target: int):
+    """Zero-pad every leaf's batch axis (0) up to ``target`` rows.
+    Nested tuple/list activities are walked like the MiniBatch pytree."""
+    if isinstance(tree, (tuple, list)):
+        return type(tree)(pad_batch_axis(e, target) for e in tree)
+    return _pad0(tree, target)
+
+
+def walk_length_leaves(tree, select, leaf_fn, batched: bool = True):
+    """THE depth-first walk behind length bucketing: apply ``leaf_fn``
+    to every leaf eligible for time-axis bucketing, pass the others
+    through.  ``pad_length_axis`` (traffic, batched-rank leaves) and
+    ``ServingEngine.precompile`` (warmup, sample-rank leaves,
+    ``batched=False``) share this ONE walker so their leaf numbering,
+    rank gate, and ``select`` semantics can never drift apart -- the
+    ``select`` predicate always sees the leaf at batched rank either
+    way."""
+    counter = [0]
+    min_rank = 2 if batched else 1
+
+    def walk(t):
+        if isinstance(t, (tuple, list)):
+            return type(t)(walk(e) for e in t)
+        a = np.asarray(t)
+        i = counter[0]
+        counter[0] += 1
+        if a.ndim < min_rank:
+            return a
+        if select is not None and not select(i, a if batched else a[None]):
+            return a
+        return leaf_fn(a)
+
+    return walk(tree)
+
+
+def pad_length_axis(tree, ladder: BucketLadder, select=None):
+    """Round every rank>=2 leaf's TIME axis (1) up to the length
+    ladder (sequence models: tokens beyond the true length are zero
+    padding the model must already mask, exactly as in training).
+
+    ``select``: optional ``(leaf_index, leaf) -> bool`` choosing which
+    rank>=2 leaves get their axis 1 bucketed (leaves are numbered in
+    depth-first order over the whole tree; the leaf is always passed
+    at batched rank, here and in ``ServingEngine.precompile``).  Default pads ALL of them,
+    which is wrong for a multi-input model with a fixed-width rank>=2
+    side input -- its feature dimension would be padded to a rung and
+    break the layer expecting it; exclude such leaves here (the
+    ``ServingEngine(length_select=)`` knob)."""
+
+    def pad(a):
+        target = ladder.bucket_for(a.shape[1])
+        if target is None:
+            # over-max length: grow the ladder (like the batch path's
+            # ladder.add) so the new rung is REUSED -- otherwise every
+            # distinct over-max length would compile its own executable
+            target = ladder.add(a.shape[1])
+        if target == a.shape[1]:
+            return a
+        out = np.zeros((a.shape[0], target) + a.shape[2:], a.dtype)
+        out[:, : a.shape[1]] = a
+        return out
+
+    return walk_length_leaves(tree, select, pad, batched=True)
+
+
+def slice_batch_axis(tree, n: int):
+    """Inverse of ``pad_batch_axis``: keep the first ``n`` (real) rows."""
+    if isinstance(tree, (tuple, list)):
+        return type(tree)(slice_batch_axis(e, n) for e in tree)
+    return tree[:n]
+
+
+def ladder_or_default(ladder: Optional[BucketLadder], max_size: int,
+                      align: int = 1) -> BucketLadder:
+    """A COPY of the caller-supplied ladder (validated against
+    ``align``) or the default geometric one covering [align, max_size].
+    The copy keeps the consumer's own rung growth (``add``) from
+    leaking into a ladder the caller shares with other consumers."""
+    if ladder is None:
+        return BucketLadder(max_size, min_size=1, align=align)
+    bad = [r for r in ladder if r % align]
+    if bad:
+        raise ValueError(
+            f"ladder rungs {bad} not divisible by the device alignment "
+            f"{align} (sharded predict splits the batch axis evenly)")
+    return ladder.copy()

@@ -1,0 +1,550 @@
+"""Dynamic-batched inference serving: request coalescing over a
+bucketed, precompiled eval step.
+
+Reference: optim/PredictionService.scala:56 keeps an instance pool of
+model clones behind a blocking queue -- concurrency there means more
+JVM threads each running their own forward.  On TPU one compiled
+program already saturates the chip, so concurrency is won by BATCHING:
+concurrent callers submit single activities to a bounded queue, a
+dispatcher thread drains it under a ``max_batch_size`` /
+``max_wait_ms`` deadline policy, and every tick runs ONE padded device
+batch instead of N serialized batch-1 dispatches.  The pad target
+comes from a bucket ladder (``buckets.BucketLadder``) so the compiled
+executable cache has a small, closed, warmable key set -- steady-state
+serving performs zero XLA compiles (``precompile``).
+
+Three device layouts behind one engine:
+
+- single device (default): the model's own placement, like Predictor;
+- sharded (``mesh=``): the batch axis splits over the mesh's data axis
+  (``parallel/zero.stage_batch_global`` -- the dp driver's staging
+  path) with params replicated once, so one tick runs data-parallel
+  over every chip;
+- host-side round-robin (``round_robin=True``): the fallback when no
+  mesh program is wanted -- whole ticks rotate across local devices
+  with per-device weight replicas, the literal analogue of the
+  reference's cloned-instance pool.
+
+Every tick emits a ``kind: "inference"`` telemetry event extended with
+queue depth, bucket id, batch fill fraction, pad waste and the
+per-request latencies (``tools/obs_report.py`` "Serving" section).
+"""
+
+import collections
+import logging
+import threading
+import time
+from concurrent.futures import Future, TimeoutError as FutureTimeoutError
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from bigdl_tpu.dataset.minibatch import PaddingParam, Sample, \
+    samples_to_minibatch
+from bigdl_tpu.observability.spans import span
+from bigdl_tpu.optim.validation import compiled_eval_step
+from bigdl_tpu.serving.buckets import (BucketLadder, ladder_or_default,
+                                       pad_batch_axis, pad_length_axis,
+                                       slice_batch_axis, walk_length_leaves)
+
+log = logging.getLogger("bigdl_tpu.serving")
+
+
+class ServeFuture(Future):
+    """Per-request handle: ``result(timeout)`` plus, once served, the
+    ``bucket`` the request rode in and its end-to-end ``latency_s``."""
+
+    def __init__(self):
+        super().__init__()
+        self.bucket: Optional[int] = None
+        self.latency_s: Optional[float] = None
+        self._t_submit = time.perf_counter()
+
+
+# --------------------------------------------------------------------------- #
+# Eval backends: where a tick's padded batch actually runs.
+# --------------------------------------------------------------------------- #
+
+class _LocalEval:
+    """Default single-device layout -- the model's own placement."""
+
+    kind = "local"
+    align = 1
+
+    def __init__(self, model, compute_dtype=None):
+        self.model = model
+        self.step = compiled_eval_step(model, compute_dtype)
+
+    def eval(self, x, tick=0):
+        params, mstate = self.model.parameters()[0], self.model.state()
+        return self.step(params, mstate, x)
+
+    def precompile(self, sample_spec, buckets):
+        params, mstate = self.model.parameters()[0], self.model.state()
+        return self.step.precompile(params, mstate, sample_spec, buckets)
+
+
+class _ShardedEval:
+    """Data-parallel eval over the mesh's data axis: the batch axis is
+    split across devices (the dp driver's ``_shard_batch`` staging
+    path, ``parallel/zero.stage_batch_global``), params/state are
+    replicated ON DEVICE once at construction (call ``refresh_params``
+    after mutating the model's weights)."""
+
+    kind = "sharded"
+
+    def __init__(self, model, mesh, axis="data", compute_dtype=None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.model = model
+        self.mesh = mesh
+        self.axis = axis
+        self.align = int(mesh.shape[axis])
+        self.step = compiled_eval_step(model, compute_dtype)
+        self._batch_sharding = NamedSharding(mesh, P(axis))
+        self._rep = NamedSharding(mesh, P())
+        self.refresh_params()
+
+    def refresh_params(self):
+        self._params = jax.device_put(self.model.parameters()[0], self._rep)
+        mstate = self.model.state()
+        self._mstate = mstate if not jax.tree.leaves(mstate) else \
+            jax.device_put(mstate, self._rep)
+
+    def _stage(self, x):
+        from bigdl_tpu.parallel.zero import stage_batch_global
+
+        return stage_batch_global(x, self._batch_sharding)
+
+    def eval(self, x, tick=0):
+        return self.step(self._params, self._mstate, self._stage(x))
+
+    def precompile(self, sample_spec, buckets):
+        return self.step.precompile(self._params, self._mstate, sample_spec,
+                                    buckets, stage=self._stage)
+
+
+class _RoundRobinEval:
+    """Whole ticks rotate across local devices, each holding its own
+    weight replica -- the host-side fallback when no mesh program is
+    available, and the literal TPU analogue of the reference's pooled
+    model clones (PredictionService.scala:64-77: N instances, each
+    serving whole requests)."""
+
+    kind = "round_robin"
+    align = 1
+
+    def __init__(self, model, devices=None, compute_dtype=None):
+        self.model = model
+        self.devices = list(devices) if devices else jax.local_devices()
+        self.step = compiled_eval_step(model, compute_dtype)
+        self.refresh_params()
+
+    def refresh_params(self):
+        # per-device replicas (the "clone pool"), remade on demand
+        params, mstate = self.model.parameters()[0], self.model.state()
+        self._replicas = [jax.device_put((params, mstate), d)
+                          for d in self.devices]
+
+    def eval(self, x, tick=0):
+        dev = self.devices[tick % len(self.devices)]
+        params, mstate = self._replicas[tick % len(self.devices)]
+        return self.step(params, mstate, jax.device_put(x, dev))
+
+    def precompile(self, sample_spec, buckets):
+        # jax keys executables on placement too: warm every device
+        total = 0
+        for dev, (params, mstate) in zip(self.devices, self._replicas):
+            total += self.step.precompile(
+                params, mstate, sample_spec, buckets,
+                stage=lambda t, _d=dev: jax.device_put(t, _d))
+        return total
+
+
+# --------------------------------------------------------------------------- #
+# The engine.
+# --------------------------------------------------------------------------- #
+
+class ServingEngine:
+    """Coalescing, bucketed, (optionally) sharded inference server.
+
+    >>> eng = ServingEngine(model, max_batch_size=32, max_wait_ms=2.0)
+    >>> eng.precompile()                  # warm the whole bucket ladder
+    >>> y = eng.predict(feature)          # blocking single request
+    >>> fut = eng.submit(feature)         # or async; fut.result()
+
+    Deadline policy: a tick dispatches as soon as ``max_batch_size``
+    requests are pending, or when the OLDEST pending request has waited
+    ``max_wait_ms`` -- the knob trading batch fill (throughput) against
+    added latency at low offered load (docs/performance.md, "Inference
+    serving").  ``queue_capacity`` bounds pending requests; a full
+    queue back-pressures ``submit`` instead of growing without bound.
+
+    A tick that raises (poisoned input, device error) fails only that
+    tick's requests -- the exception is set on each of its futures (so
+    every affected caller sees it) and the dispatcher keeps serving
+    subsequent traffic.
+    """
+
+    def __init__(self, model, max_batch_size: int = 32,
+                 max_wait_ms: float = 2.0, queue_capacity: int = 1024,
+                 ladder: Optional[BucketLadder] = None,
+                 length_ladder: Optional[BucketLadder] = None,
+                 length_select=None,
+                 feature_padding: Optional[PaddingParam] = None,
+                 compute_dtype=None, mesh=None, axis: str = "data",
+                 round_robin: bool = False, telemetry=None,
+                 max_executables: Optional[int] = None):
+        if not model.is_built():
+            raise ValueError("build the model (or train it) before serving")
+        if max_batch_size < 1:
+            raise ValueError(f"max_batch_size must be >= 1, got "
+                             f"{max_batch_size}")
+        if queue_capacity < 1:
+            # 0 would make the first submit() wait on _not_full forever
+            raise ValueError(f"queue_capacity must be >= 1, got "
+                             f"{queue_capacity}")
+        self.model = model
+        if mesh is not None and int(mesh.shape[axis]) > 1:
+            self._backend = _ShardedEval(model, mesh, axis, compute_dtype)
+        elif round_robin and len(jax.local_devices()) > 1:
+            self._backend = _RoundRobinEval(model,
+                                            compute_dtype=compute_dtype)
+        else:
+            self._backend = _LocalEval(model, compute_dtype)
+        align = self._backend.align
+        self.max_batch_size = -(-int(max_batch_size) // align) * align
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.queue_capacity = int(queue_capacity)
+        self.ladder = ladder_or_default(ladder, self.max_batch_size, align)
+        if self.ladder.max < self.max_batch_size:
+            self.ladder.add(self.max_batch_size)
+        if self.ladder.min > self.max_batch_size:
+            raise ValueError(
+                f"ladder's smallest rung {self.ladder.min} exceeds "
+                f"max_batch_size {self.max_batch_size}: a tick can never "
+                f"hold that many requests, so every dispatch would pad "
+                f"past the largest batch it can ever fill")
+        # copied like the batch ladder (ladder_or_default): over-max
+        # lengths grow this ladder under traffic, and that growth must
+        # not leak into a ladder the caller shares with other engines
+        self.length_ladder = None if length_ladder is None \
+            else length_ladder.copy()
+        self.length_select = length_select
+        self.feature_padding = feature_padding
+        self.telemetry = telemetry
+        self._explicit_bound = max_executables is not None
+        if self._explicit_bound:
+            # the bound lives on the per-(model, dtype) compiled step,
+            # which validate()/Predictor/other engines on the same model
+            # share -- it governs that one shared cache (last writer
+            # wins), because the executable count being bounded IS the
+            # shared jit cache's
+            self._backend.step.max_executables = max_executables
+        else:
+            self._fit_bound(len(self.ladder))
+        self._pending = collections.deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._running = True
+        self._tick = 0
+        self._dispatcher = threading.Thread(
+            target=self._loop, name="bigdl-serving-dispatcher", daemon=True)
+        self._dispatcher.start()
+
+    # ----- request surface -------------------------------------------------- #
+    def submit(self, feature,
+               timeout: Optional[float] = None) -> ServeFuture:
+        """Enqueue one activity (array tree or ``Sample``); returns a
+        future.  Blocks when ``queue_capacity`` requests are pending;
+        with ``timeout``, a queue still full after that many seconds
+        raises ``concurrent.futures.TimeoutError`` instead of waiting
+        for the backlog to drain."""
+        fut = ServeFuture()
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        with self._lock:
+            if not self._running:
+                raise RuntimeError("ServingEngine is closed")
+            while self._running and \
+                    len(self._pending) >= self.queue_capacity:
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    raise FutureTimeoutError(
+                        f"submit timed out after {timeout}s: queue full "
+                        f"({self.queue_capacity} requests pending)")
+                self._not_full.wait(timeout=remaining)
+            if not self._running:
+                raise RuntimeError("ServingEngine is closed")
+            self._pending.append((feature, fut))
+            self._not_empty.notify()
+        return fut
+
+    def predict(self, feature, timeout: Optional[float] = None):
+        """Blocking single-request predict (the PredictionService
+        surface): submit, wait, return this request's output rows.
+        ``timeout`` bounds the WHOLE call -- admission into a full
+        queue spends from the same budget as waiting for the result.
+        A timed-out request is cancelled: if still pending, its tick
+        drops it (a timeout/retry loop must not fill the queue with
+        zombie requests nobody will read)."""
+        t0 = time.perf_counter()
+        fut = self.submit(feature, timeout=timeout)
+        remaining = None if timeout is None \
+            else max(0.0, timeout - (time.perf_counter() - t0))
+        try:
+            return fut.result(remaining)
+        except FutureTimeoutError:
+            self._abandon(fut)
+            raise
+
+    def predict_many(self, features, timeout: Optional[float] = None):
+        """Submit a burst and wait for every result.  Like ``predict``,
+        ``timeout`` bounds the WHOLE call (queue admission of each
+        request and all the result waits draw down one shared budget --
+        N requests never wait N times the timeout) and a timeout
+        cancels every still-pending request of the burst."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+
+        def remaining():
+            return None if deadline is None \
+                else max(0.0, deadline - time.perf_counter())
+
+        futs: List[ServeFuture] = []
+        try:
+            for f in features:
+                futs.append(self.submit(f, timeout=remaining()))
+            return [f.result(remaining()) for f in futs]
+        except FutureTimeoutError:
+            for f in futs:
+                self._abandon(f)     # no-op on already-served futures
+            raise
+
+    def _abandon(self, fut: ServeFuture):
+        """Cancel a timed-out request AND free its queue slot now: a
+        cancelled entry left in ``_pending`` would keep counting toward
+        capacity / tick fill / the oldest-request deadline until a tick
+        drained it, blocking the very retry the caller is about to
+        make."""
+        if not fut.cancel():         # already claimed by a tick (or done)
+            return
+        with self._lock:
+            for entry in self._pending:
+                if entry[1] is fut:
+                    self._pending.remove(entry)
+                    self._not_full.notify()
+                    break
+
+    def predict_at(self, feature, bucket: int):
+        """UNBATCHED reference predict: this one request, padded to
+        ``bucket``, evaluated synchronously outside the queue.  Within
+        one bucket shape XLA's reduction order is fixed and eval-mode
+        rows are independent, so this is bit-exact to the same request
+        served in a coalesced tick of the same bucket (the bench's
+        identical-outputs witness)."""
+        x = self._form_batch([feature], bucket)
+        y = self._backend.eval(x, tick=0)
+        return jax.tree.map(lambda a: np.asarray(a)[0], y)
+
+    def _fit_bound(self, n_buckets):
+        """Raise the shared step's eviction-free executable bound to fit
+        this engine's closed shape set (batch rungs x length rungs, x
+        per-device replicas for round-robin) plus headroom for
+        validation's own batch shape -- the default bound is sized for a
+        single ladder and would cry "shape leak" on a legitimately
+        warmed larger one.  No-op when the caller set an explicit
+        ``max_executables`` (their bound, their warnings)."""
+        if self._explicit_bound:
+            return
+        combos = n_buckets * (len(self.length_ladder)
+                              if self.length_ladder is not None else 1)
+        if isinstance(self._backend, _RoundRobinEval):
+            combos *= len(self._backend.devices)
+        step = self._backend.step
+        step.max_executables = max(step.max_executables, combos + 8)
+
+    # ----- warmup ----------------------------------------------------------- #
+    def _sample_spec(self, example_feature=None):
+        if example_feature is not None:
+            feat = example_feature.feature \
+                if isinstance(example_feature, Sample) else example_feature
+            return jax.tree.map(np.asarray, feat)
+        spec = getattr(self.model, "_build_spec", None)
+        if spec is None:
+            raise ValueError(
+                "precompile() needs the per-sample feature shape: the "
+                "model records none (built lazily?) -- pass "
+                "example_feature=")
+        # the build spec is batched: drop the leading batch axis
+        return jax.tree.map(
+            lambda s: np.zeros(tuple(s.shape[1:]), dtype=s.dtype), spec)
+
+    def precompile(self, buckets=None, example_feature=None) -> int:
+        """Compile the eval step for every bucket BEFORE traffic
+        arrives; returns the number of backend compiles performed.
+        After this, a workload of mixed request sizes within the
+        ladder performs zero XLA compiles (the acceptance contract,
+        pinned by tests/test_serving.py via ``RecompileWatchdog``).
+
+        With a ``length_ladder``, every (batch bucket x length rung)
+        combination is warmed -- each bucketed feature leaf's leading
+        (time) axis is set to the rung, mirroring what
+        ``pad_length_axis`` does to traffic (``length_select`` excludes
+        fixed side inputs from both, and is always called with a
+        BATCHED-rank leaf so a shape-based predicate selects the same
+        leaves at warmup as under traffic).  A request mixing different
+        rungs across bucketed leaves would still compile once on first
+        sight."""
+        spec = self._sample_spec(example_feature)
+        if buckets is None:
+            buckets = list(self.ladder)
+        else:
+            buckets = [int(b) for b in buckets]
+            # the ladder= path validates this in ladder_or_default; an
+            # explicit bucket list must not sneak past it into an opaque
+            # sharding error when the batch can't split over the mesh
+            bad = [b for b in buckets
+                   if b < 1 or b % self._backend.align]
+            if bad:
+                raise ValueError(
+                    f"buckets {bad} not divisible by the device alignment "
+                    f"{self._backend.align} (sharded predict splits the "
+                    f"batch axis evenly)")
+        self._fit_bound(len(buckets))
+        if self.length_ladder is None:
+            return self._backend.precompile(spec, buckets)
+
+        total = 0
+        for rung in self.length_ladder:
+            # the same walker pad_length_axis uses under traffic, on
+            # sample-rank spec leaves (batched=False): identical leaf
+            # numbering, rank gate, and length_select semantics, so the
+            # warmed shapes are exactly the ones ticks will produce
+            at_rung = walk_length_leaves(
+                spec, self.length_select,
+                lambda a, _r=int(rung): np.zeros((_r,) + a.shape[1:],
+                                                 a.dtype),
+                batched=False)
+            total += self._backend.precompile(at_rung, buckets)
+        return total
+
+    # ----- dispatcher ------------------------------------------------------- #
+    def _loop(self):
+        # a queue_capacity below max_batch_size caps how full a tick can
+        # ever get -- waiting for more would stall EVERY tick for the
+        # whole max_wait_ms at saturation (submitters blocked on a full
+        # queue can never raise _pending past capacity)
+        fill = min(self.max_batch_size, self.queue_capacity)
+        while True:
+            with self._lock:
+                while self._running and not self._pending:
+                    self._not_empty.wait()
+                if not self._running and not self._pending:
+                    return
+                # deadline anchored on the OLDEST pending request
+                deadline = self._pending[0][1]._t_submit + self.max_wait_s
+                while self._running and len(self._pending) < fill:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._not_empty.wait(timeout=remaining)
+                take = min(self.max_batch_size, len(self._pending))
+                reqs = [self._pending.popleft() for _ in range(take)]
+                qdepth = len(self._pending)
+                self._not_full.notify_all()
+            # claim each future (PENDING -> RUNNING) so a caller's
+            # cancel() can no longer race the result-setting below --
+            # set_result on a CANCELLED future raises InvalidStateError,
+            # which would kill the dispatcher thread and hang the engine
+            reqs = [r for r in reqs if r[1].set_running_or_notify_cancel()]
+            if not reqs:
+                continue
+            self._tick += 1
+            self._run_tick(reqs, qdepth)
+
+    def _form_batch(self, features, bucket):
+        samples = [f if isinstance(f, Sample) else Sample(f)
+                   for f in features]
+        mb = samples_to_minibatch(samples,
+                                  feature_padding=self.feature_padding)
+        x = pad_batch_axis(mb.get_input(), bucket)
+        if self.length_ladder is not None:
+            x = pad_length_axis(x, self.length_ladder, self.length_select)
+        return x
+
+    def _span(self, name, **kw):
+        if self.telemetry is not None:
+            return self.telemetry.span(name, **kw)
+        return span(name, **kw)
+
+    def _run_tick(self, reqs, qdepth):
+        t0 = time.perf_counter()
+        feats = [r[0] for r in reqs]
+        futs: List[ServeFuture] = [r[1] for r in reqs]
+        try:
+            with self._span("serve_tick", tick=self._tick, records=len(reqs)):
+                n = len(feats)
+                bucket = self.ladder.bucket_for(n)
+                if bucket is None:        # can't happen: take <= ladder.max
+                    bucket = self.ladder.add(n)
+                x = self._form_batch(feats, bucket)
+                t_formed = time.perf_counter()
+                y = self._backend.eval(x, tick=self._tick)
+                y = jax.tree.map(np.asarray, y)        # host sync + gather
+        except Exception as e:
+            # the failure belongs to THIS tick's callers only: surface
+            # it on each future and keep the dispatcher serving
+            log.exception("serving tick %d failed (%d requests)",
+                          self._tick, len(futs))
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        t_done = time.perf_counter()
+        for i, fut in enumerate(futs):
+            fut.bucket = bucket
+            fut.latency_s = t_done - fut._t_submit
+            fut.set_result(jax.tree.map(lambda a: a[i], y))
+        if self.telemetry is not None:
+            try:
+                wall = t_done - t0
+                self.telemetry.record(
+                    "inference", step=self._tick, wall_s=wall,
+                    data_wait_s=t_formed - t0, device_s=t_done - t_formed,
+                    records=n, records_per_s=n / max(wall, 1e-9),
+                    queue_depth=qdepth, queue_capacity=self.queue_capacity,
+                    bucket=bucket, batch_fill=n / bucket,
+                    pad_waste=(bucket - n) / bucket,
+                    request_latency_s=[round(f.latency_s, 6) for f in futs])
+            except Exception:     # results are already delivered --
+                log.exception(    # never let telemetry kill the dispatcher
+                    "serving telemetry record failed (tick %d)", self._tick)
+
+    # ----- lifecycle -------------------------------------------------------- #
+    def refresh_params(self):
+        """Re-replicate device-resident weights after mutating the
+        model (sharded / round-robin layouts cache them on device)."""
+        refresh = getattr(self._backend, "refresh_params", None)
+        if refresh is not None:
+            refresh()
+        return self
+
+    def close(self, timeout: Optional[float] = 10.0):
+        """Stop accepting requests, drain the queue, join the
+        dispatcher.  Idempotent."""
+        with self._lock:
+            self._running = False
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+        self._dispatcher.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -11,8 +11,10 @@ Output: step-time percentiles, the data-wait fraction of wall time, the
 device-busy fraction from the xplane witness, MFU from the compiled
 step's ``cost_analysis`` flops, watchdog findings, model-health
 numerics (grad-norm trajectory, worst-layer table, first non-finite
-step, anomalies -- when a ``HealthMonitor`` fed the run), host-span
-totals, and the top-N HLO ops by device time.
+step, anomalies -- when a ``HealthMonitor`` fed the run), serving
+metrics (request-latency percentiles, queue-depth trajectory, bucket
+histogram and pad waste -- when ``kind: "inference"`` events are
+present), host-span totals, and the top-N HLO ops by device time.
 
     python tools/obs_report.py runs/resnet50 [--xplane DIR] [--format json]
 
@@ -211,6 +213,55 @@ def _communication_section(steps, other):
     return sec
 
 
+def _serving_section(other):
+    """Summarize ``kind: "inference"`` events -- the Predictor's batch
+    path and the ServingEngine's coalescing ticks: per-request latency
+    percentiles, queue-depth trajectory, bucket histogram and the
+    pad-waste fraction (padded rows the bucket ladder spent to keep the
+    executable set closed).  None for runs without inference events."""
+    inf = [e for e in other if e.get("kind") == "inference"]
+    if not inf:
+        return None
+    requests = sum(int(e.get("records", 0)) for e in inf)
+    busy = sum(e.get("wall_s", 0.0) for e in inf)
+    sec = {"ticks": len(inf), "requests": requests,
+           "requests_per_s": (requests / busy) if busy > 0 else None}
+    lats = sorted(l for e in inf for l in (e.get("request_latency_s") or [])
+                  if _finite(l))
+    if lats:
+        sec["latency_s_p50"] = percentile(lats, 50)
+        sec["latency_s_p95"] = percentile(lats, 95)
+        sec["latency_s_p99"] = percentile(lats, 99)
+    depths = [(e.get("step"), e["queue_depth"])
+              for e in inf if "queue_depth" in e]
+    if depths:
+        d = sorted(x for _, x in depths)
+        sec["queue_depth_p50"] = percentile(d, 50)
+        sec["queue_depth_p90"] = percentile(d, 90)
+        caps = [e.get("queue_capacity") for e in inf
+                if e.get("queue_capacity")]
+        sec["queue_capacity"] = max(caps) if caps else None
+        stride = max(1, len(depths) // 40)    # <= ~40 trajectory points
+        sec["queue_depth_trajectory"] = [
+            {"step": s, "depth": x} for s, x in depths[::stride]]
+    bucketed = [e for e in inf if e.get("bucket")]
+    if bucketed:
+        hist = {}
+        for e in bucketed:
+            b = int(e["bucket"])
+            hist[b] = hist.get(b, 0) + 1
+        sec["bucket_histogram"] = {str(b): hist[b] for b in sorted(hist)}
+        rows = sum(int(e["bucket"]) for e in bucketed)
+        real = sum(int(e.get("records", 0)) for e in bucketed)
+        if rows:
+            sec["pad_waste_fraction"] = (rows - real) / rows
+        fills = sorted(e["batch_fill"] for e in bucketed
+                       if _finite(e.get("batch_fill")))
+        if fills:
+            sec["batch_fill_p50"] = percentile(fills, 50)
+    return sec
+
+
 def build_report(run_dir, xplane_dir=None, top=10):
     jsonl = os.path.join(run_dir, "telemetry.jsonl")
     if not os.path.isfile(jsonl):
@@ -285,6 +336,9 @@ def build_report(run_dir, xplane_dir=None, top=10):
     comm = _communication_section(steps, other)
     if comm:
         rep["communication"] = comm
+    serving = _serving_section(other)
+    if serving:
+        rep["serving"] = serving
 
     rep["host_spans"] = span_totals(os.path.join(run_dir, "trace.json"))
 
@@ -400,6 +454,32 @@ def format_report(rep):
                 f"{_r(cm.get('ef_residual_norm_last'))}"
                 + (f" (max {cm['ef_residual_norm_max']:.4g})"
                    if cm.get("ef_residual_norm_max") is not None else ""))
+    sv = rep.get("serving")
+    if sv:
+        line = f"serving: {sv['ticks']} ticks / {sv['requests']} requests"
+        if sv.get("requests_per_s") is not None:
+            line += f" ({sv['requests_per_s']:.1f} req/s while serving)"
+        out.append(line)
+        if sv.get("latency_s_p50") is not None:
+            out.append(
+                f"request latency p50/p95/p99: "
+                f"{_fmt_s(sv['latency_s_p50'])} / "
+                f"{_fmt_s(sv.get('latency_s_p95'))} / "
+                f"{_fmt_s(sv.get('latency_s_p99'))}")
+        if sv.get("bucket_histogram"):
+            line = "buckets: " + ", ".join(
+                f"{b} x{c}" for b, c in sv["bucket_histogram"].items())
+            if sv.get("pad_waste_fraction") is not None:
+                line += f"   pad waste {sv['pad_waste_fraction']:.1%}"
+            if sv.get("batch_fill_p50") is not None:
+                line += f"   fill p50 {sv['batch_fill_p50']:.0%}"
+            out.append(line)
+        if sv.get("queue_depth_p50") is not None:
+            cap = sv.get("queue_capacity")
+            out.append(
+                f"serving queue depth p50/p90: {sv['queue_depth_p50']}/"
+                f"{sv['queue_depth_p90']}"
+                + (f" (capacity {cap})" if cap is not None else ""))
     wd = rep.get("watchdogs") or {}
     if wd.get("recompile_steps"):
         out.append("RECOMPILES after warmup at steps: "
