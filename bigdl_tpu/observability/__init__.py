@@ -15,6 +15,11 @@ Four layers over the one shared driver loop:
   (per-layer grad norms, update ratios, non-finite counts) with a
   warn/dump/halt anomaly policy and re-executable incident bundles
   (``health.py``).
+- ``BlockingStepTimer`` / ``TimingAuditor`` -- trusted timing:
+  ``block_until_ready``-fenced per-step measurement (the only basis
+  MFU math may use) and triangulated trust verdicts
+  (``trusted`` / ``suspect:async_dispatch`` / ``invalid:*``) stamped
+  on bench records and telemetry streams (``profiling.py``).
 
 ``tools/obs_report.py`` merges a run's JSONL + xplane trace into one
 report; the event schema is documented in ``docs/observability.md``.
@@ -24,6 +29,8 @@ from bigdl_tpu.observability.health import (HealthMonitor, dump_incident,
                                             global_grad_norm, layer_labels,
                                             load_incident,
                                             per_layer_grad_norms)
+from bigdl_tpu.observability.profiling import (BlockingStepTimer,
+                                               TimingAuditor)
 from bigdl_tpu.observability.spans import SpanTracer, span
 from bigdl_tpu.observability.telemetry import (StepTelemetry,
                                                device_memory_stats,
@@ -40,4 +47,5 @@ __all__ = [
     "HealthMonitor", "backend_compile_count", "device_memory_stats",
     "peak_flops", "layer_labels", "per_layer_grad_norms",
     "global_grad_norm", "dump_incident", "load_incident",
+    "BlockingStepTimer", "TimingAuditor",
 ]

@@ -45,8 +45,9 @@ SCHEMA_VERSION = 1
 
 #: event kinds that must survive a crash on the NEXT line: flushed AND
 #: fsynced to disk the moment they are recorded (a run that blows up
-#: right after a health anomaly must leave the evidence on disk)
-DURABLE_KINDS = frozenset({"health", "anomaly"})
+#: right after a health anomaly must leave the evidence on disk; a
+#: timing-audit verdict is the line a perf claim stands on)
+DURABLE_KINDS = frozenset({"health", "anomaly", "timing_audit"})
 
 log = logging.getLogger("bigdl_tpu.observability")
 
@@ -142,6 +143,7 @@ class StepTelemetry:
         from bigdl_tpu.utils.config import compilation_cache_status
         self._cache_status = compilation_cache_status()
         self._cost = None
+        self._timing = None
         self._wrote_header = False
         self._closed = False
         # a ServingEngine records inference events from its dispatcher
@@ -203,10 +205,39 @@ class StepTelemetry:
                 # hit/miss note for the run report: a warm cache means the
                 # big XLA compiles were (probably) skipped this run
                 fields["compilation_cache"] = self._cache_status
+            if self._timing is not None:
+                # the run's timing discipline (set_timing_mode): under
+                # "blocking", step_blocked_s is the trust basis for any
+                # MFU derived from this run's events
+                fields["timing"] = self._timing
             if self._cost:
                 fields["cost"] = self._cost
             fields.update(extra)
             return self.record("header", **fields)
+
+    def set_timing_mode(self, mode, basis="step_blocked_s"):
+        """Stamp the run's timing discipline on the header:
+        ``timing: {"mode": "blocking", "trust_basis": "step_blocked_s"}``.
+        Drivers call this when ``set_blocking_timing(True)`` is active,
+        BEFORE the lazy header write; if the header already went out
+        (e.g. ``attach_cost`` wrote it first), a standalone
+        ``kind: "timing"`` event records the mode instead -- obs_report
+        reads both (docs/observability.md, Profiling & trusted timing).
+        """
+        timing = {"mode": mode, "trust_basis": basis}
+        with self._write_lock:
+            if self._timing == timing:
+                return None
+            self._timing = timing
+            if self._wrote_header:
+                return self.record("timing", timing=timing)
+        return None
+
+    @property
+    def cost(self):
+        """The attached compiled-step cost block (``attach_cost``), or
+        None -- the flops source the end-of-run timing audit reads."""
+        return self._cost
 
     # ----- step cadence ---------------------------------------------------- #
     def step_begin(self, step):

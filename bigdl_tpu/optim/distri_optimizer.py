@@ -606,6 +606,13 @@ class DistriOptimizer(BaseOptimizer):
 
         if self.telemetry is not None:
             self.telemetry.recompile_watchdog.watch(step)
+            if getattr(self, "blocking_timing", False):
+                # before attach_cost's lazy header write, so the header
+                # itself carries the run's timing discipline; the shared
+                # driver loop then fences every dispatch (the loss is an
+                # output of the one sharded XLA program, so blocking on
+                # it fences the whole dp step incl. collectives)
+                self.telemetry.set_timing_mode("blocking")
             # real sharded arrays (one extra transfer of the first batch,
             # once at startup): the lowering's avals must carry the
             # GLOBAL shapes/shardings _shard_batch assembles, which

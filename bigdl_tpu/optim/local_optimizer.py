@@ -73,6 +73,7 @@ class BaseOptimizer:
         self.health_monitor = None
         self.grad_transform = None
         self.sync_every = 1
+        self.blocking_timing = False
         #: host-side counters: data_wait_s vs device_s per step (the
         #: reference's Metrics accumulators, optim/Metrics.scala:31)
         self.metrics = Metrics()
@@ -175,6 +176,23 @@ class BaseOptimizer:
             from bigdl_tpu.observability.health import HealthMonitor
             monitor = HealthMonitor(**kw)
         self.health_monitor = monitor
+        return self
+
+    def set_blocking_timing(self, enabled=True):
+        """Serial-dependency step timing (docs/observability.md,
+        "Profiling & trusted timing"): fence every dispatch with
+        ``jax.block_until_ready`` and stamp ``step_blocked_s`` -- the
+        fenced dispatch-to-outputs-ready time -- on every step event.
+        ``step_blocked_s`` is the ONLY number the MFU math in
+        ``tools/obs_report.py`` and ``bench.py`` publishes; un-fenced
+        wall clocks measure dispatch, not execution (the BENCH_r02
+        2.74-"MFU" async-dispatch artifact).  The fence defeats the
+        async pipelining ``set_sync_every`` exists to exploit, so this
+        is a MEASUREMENT mode for bench legs and timing audits, not a
+        production throughput default.  At the end of the run a
+        ``kind: "timing_audit"`` event records the ``TimingAuditor``
+        trust verdict for the run's blocked timing."""
+        self.blocking_timing = bool(enabled)
         return self
 
     def set_grad_transform(self, fn):
@@ -595,6 +613,16 @@ class BaseOptimizer:
                      and health_cb is not None)
         sp = tel.span if tel is not None else \
             (lambda name, **kw: contextlib.nullcontext())
+        timer = None
+        if getattr(self, "blocking_timing", False):
+            # trusted-timing mode (set_blocking_timing): every dispatch
+            # is block_until_ready-fenced and step_blocked_s becomes the
+            # step event's published timing basis
+            from bigdl_tpu.observability.profiling import BlockingStepTimer
+            timer = BlockingStepTimer()
+            if tel is not None:
+                tel.set_timing_mode("blocking")   # no-op if already set
+        step_blocked = None
 
         def point_sync(reason):
             """Force a loss sync outside the cadence (validation/
@@ -619,7 +647,13 @@ class BaseOptimizer:
                 if tel is not None:   # open the no-compile watchdog window
                     tel.step_begin(state["neval"])
                 with sp("dispatch", step=state["neval"]):
+                    if timer is not None:
+                        timer.begin()
                     loss_dev = dispatch(dev)
+                    if timer is not None:
+                        # fence: the loss is an output of the step's one
+                        # XLA program, so its readiness is the step's
+                        step_blocked = timer.end(loss_dev)
                 n = records_of(batch)
                 qdepth = queue_stats() if queue_stats is not None else None
                 t_fetch = time.perf_counter()
@@ -659,6 +693,8 @@ class BaseOptimizer:
                          "device_s": device_s, "loss": loss, "records": n,
                          "records_per_s": state["throughput"],
                          "sync_skew": sync_skew}
+                if timer is not None:
+                    event["step_blocked_s"] = step_blocked
                 if qdepth is not None:
                     event["queue_depth"], event["queue_capacity"] = qdepth
                 if event_fields:
@@ -727,6 +763,18 @@ class BaseOptimizer:
                 # drain: the run's final loss lands in driver_state even
                 # when the last steps deferred their sync
                 point_sync("drain")
+            if timer is not None and timer.samples and tel is not None:
+                # end-of-run trust verdict for the blocked timing (no
+                # trace witness or dispatch chain in a training loop --
+                # the audit covers platform + MFU plausibility)
+                from bigdl_tpu.observability.profiling import TimingAuditor
+                from bigdl_tpu.observability.telemetry import peak_flops
+                dev0 = jax.devices()[0]
+                tel.record("timing_audit", **TimingAuditor().audit(
+                    platform=dev0.platform,
+                    step_blocked_s=timer.p50(),
+                    flops_per_step=(tel.cost or {}).get("flops_per_step"),
+                    peak_flops=peak_flops(dev0)))
         finally:
             shutdown = getattr(self.dataset, "shutdown", None)
             if callable(shutdown):
@@ -762,6 +810,10 @@ class LocalOptimizer(BaseOptimizer):
 
         if self.telemetry is not None:
             self.telemetry.recompile_watchdog.watch(step)
+            if self.blocking_timing:
+                # before attach_cost's lazy header write, so the header
+                # itself carries the run's timing discipline
+                self.telemetry.set_timing_mode("blocking")
             # shape/dtype specs only -- lowering for cost_analysis needs
             # avals, not a device copy of the batch
             spec = lambda a: jax.ShapeDtypeStruct(

@@ -411,6 +411,12 @@ class StrategyOptimizer(BaseOptimizer):
 
         if self.telemetry is not None:
             self.telemetry.recompile_watchdog.watch(step)
+            if getattr(self, "blocking_timing", False):
+                # before attach_cost's lazy header write, so the header
+                # itself carries the run's timing discipline; the shared
+                # driver loop fences each dispatch on the strategy
+                # step's loss output (one shard_map program per step)
+                self.telemetry.set_timing_mode("blocking")
             # placed arrays (one extra transfer, once at startup): the
             # strategy's `place` encodes per-leaf shardings the lowering
             # needs and plain shape specs cannot express

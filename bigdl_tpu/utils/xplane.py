@@ -9,9 +9,10 @@ XSpace fields the readers touch (the twin of the hand-rolled Event
 encoder in ``visualization/tensorboard.py`` -- no TF dependency on the
 read side either).
 
-Both public readers (``device_busy``, ``op_breakdown``) return None --
-never raise -- on a missing/empty/corrupt trace dir, so report tooling
-can always call them unconditionally.
+All public readers (``device_busy``, ``op_breakdown``,
+``device_attribution``) return None -- never raise -- on a
+missing/empty/corrupt trace dir, so report tooling can always call
+them unconditionally.
 """
 
 import glob
@@ -162,10 +163,15 @@ def _parse_xspace(data):
 def _iter_device_planes(trace_dir):
     """Yield every device (TPU/XLA) plane in the trace's xplane files.
 
-    Yields nothing (so both public readers return None) for a None /
+    Yields nothing (so the public readers return None) for a None /
     nonexistent / empty trace dir; a corrupt xplane file is skipped
-    rather than raised.
+    rather than raised.  A list/tuple of already-parsed planes (from
+    ``load_device_planes``) passes through unchanged, so one decode can
+    feed all three readers.
     """
+    if isinstance(trace_dir, (list, tuple)):
+        yield from trace_dir
+        return
     if not trace_dir or not os.path.isdir(str(trace_dir)):
         return
     for path in glob.glob(os.path.join(str(trace_dir), "**", "*.xplane.pb"),
@@ -179,6 +185,15 @@ def _iter_device_planes(trace_dir):
             name = plane.name.lower()
             if "tpu" in name or "device" in name or "xla" in name:
                 yield plane
+
+
+def load_device_planes(trace_dir):
+    """Decode the trace ONCE: returns the parsed device planes as a
+    list that every reader (``device_busy`` / ``op_breakdown`` /
+    ``device_attribution``) accepts in place of the directory -- report
+    tooling that wants all three summaries pays one proto decode, not
+    three."""
+    return list(_iter_device_planes(trace_dir))
 
 
 def device_busy(trace_dir):
@@ -217,6 +232,46 @@ def device_busy(trace_dir):
     return best
 
 
+#: HLO opcode categories that are cross-device communication, not local
+#: compute (the attribution split ``device_attribution`` reports).
+#: Start/done pairs cover the async-collective HLO spellings.
+COLLECTIVE_CATEGORIES = frozenset({
+    "all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+    "collective-permute", "collective-broadcast",
+    "all-reduce-start", "all-reduce-done",
+    "all-gather-start", "all-gather-done",
+    "all-to-all-start", "all-to-all-done",
+    "reduce-scatter-start", "reduce-scatter-done",
+    "collective-permute-start", "collective-permute-done",
+    "send", "recv", "send-done", "recv-done",
+})
+
+
+def _op_category(op_name):
+    """HLO opcode category of an op name: ``"%all-reduce.9 = f32[...]
+    all-reduce(%g)"`` -> ``"all-reduce"`` (falls back to the name stem
+    for non-HLO event names)."""
+    m = re.search(r"= \S+ ([a-z][a-z0-9_-]*)\(", op_name)
+    return m.group(1) if m else op_name.split(".")[0].lstrip("%")
+
+
+def _op_line(plane):
+    """The plane's op-level accounting line: "XLA Ops" (serialized,
+    non-overlapping) when present, else the busiest line that is not an
+    async (in-flight, overlapping) line; None when the plane has no
+    usable line."""
+    busiest_line, busiest = None, 0
+    for line in plane.lines:
+        if line.name == "XLA Ops":
+            return line
+        if "async" in line.name.lower():
+            continue
+        line_busy = sum(ev.duration_ps for ev in line.events)
+        if line_busy > busiest:
+            busiest, busiest_line = line_busy, line
+    return busiest_line
+
+
 def op_breakdown(trace_dir, top=30):
     """Aggregate device-plane event time by op name and opcode category.
 
@@ -231,19 +286,7 @@ def op_breakdown(trace_dir, top=30):
     best = None
     for plane in _iter_device_planes(trace_dir):
         meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
-        # the op-level accounting line is "XLA Ops" (serialized,
-        # non-overlapping); fall back to the busiest line that is
-        # not an async (in-flight, overlapping) line
-        busiest_line, busiest = None, 0
-        for line in plane.lines:
-            if line.name == "XLA Ops":
-                busiest_line = line
-                break
-            if "async" in line.name.lower():
-                continue
-            line_busy = sum(ev.duration_ps for ev in line.events)
-            if line_busy > busiest:
-                busiest, busiest_line = line_busy, line
+        busiest_line = _op_line(plane)
         if busiest_line is None:
             continue
         by_op, by_cat = {}, {}
@@ -251,8 +294,7 @@ def op_breakdown(trace_dir, top=30):
             op = meta.get(ev.metadata_id, str(ev.metadata_id))
             sec, cnt = by_op.get(op, (0, 0))
             by_op[op] = (sec + ev.duration_ps, cnt + 1)
-            m = re.search(r"= \S+ ([a-z][a-z0-9_-]*)\(", op)
-            cat = m.group(1) if m else op.split(".")[0].lstrip("%")
+            cat = _op_category(op)
             sec, cnt = by_cat.get(cat, (0, 0))
             by_cat[cat] = (sec + ev.duration_ps, cnt + 1)
         total = sum(s for s, _ in by_op.values())
@@ -268,5 +310,78 @@ def op_breakdown(trace_dir, top=30):
                         "pct": round(100.0 * s / total, 2), "count": c}
                        for op, (s, c) in ops]}
         if best is None or rec["total_sec"] > best["total_sec"]:
+            best = rec
+    return best
+
+
+def device_attribution(trace_dir, top=10):
+    """Compute vs collective vs idle device-time attribution.
+
+    Over the busiest device plane's op-level line (serialized,
+    non-overlapping -- see ``_op_line``):
+
+    - ``span_sec``: the line's envelope (first op start -> last op end);
+    - ``busy_sec``: summed op durations, split into ``compute_sec`` and
+      ``collective_sec`` by HLO opcode category
+      (``COLLECTIVE_CATEGORIES``);
+    - ``idle_sec`` = span - busy: time the device spent waiting (host
+      dispatch gaps, input stalls) inside the traced window;
+    - the ``*_fraction`` triple is each part over the span, so the
+      three fractions sum to 1;
+    - ``ops``: the top-N ops by device time, each tagged with its
+      ``flavor`` (``"compute"`` | ``"collective"``).
+
+    Returns None (never raises) when no device plane exists -- same
+    contract as the other readers.
+    """
+    best = None
+    for plane in _iter_device_planes(trace_dir):
+        meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+        line = _op_line(plane)
+        if line is None or not line.events:
+            continue
+        lo = hi = None
+        busy = collective = 0
+        by_op = {}
+        for ev in line.events:
+            start = ev.offset_ps
+            end = start + ev.duration_ps
+            lo = start if lo is None else min(lo, start)
+            hi = end if hi is None else max(hi, end)
+            busy += ev.duration_ps
+            op = meta.get(ev.metadata_id, str(ev.metadata_id))
+            is_coll = _op_category(op) in COLLECTIVE_CATEGORIES
+            if is_coll:
+                collective += ev.duration_ps
+            sec, cnt, _ = by_op.get(op, (0, 0, is_coll))
+            by_op[op] = (sec + ev.duration_ps, cnt + 1, is_coll)
+        span = hi - lo
+        if not busy or not span:
+            continue
+        # the "XLA Ops" line is serialized, but the busiest-line
+        # FALLBACK can carry overlapping events: summed durations then
+        # exceed the envelope.  Widen the span to the busy total so the
+        # three fractions still partition it (idle reads 0, honestly:
+        # overlap means the device was never observed waiting)
+        span = max(span, busy)
+        compute = busy - collective
+        idle = span - busy
+        ops = sorted(by_op.items(), key=lambda kv: -kv[1][0])[:top]
+        rec = {
+            "plane": plane.name,
+            "span_sec": span / 1e12,
+            "busy_sec": busy / 1e12,
+            "compute_sec": compute / 1e12,
+            "collective_sec": collective / 1e12,
+            "idle_sec": idle / 1e12,
+            "compute_fraction": round(compute / span, 4),
+            "collective_fraction": round(collective / span, 4),
+            "idle_fraction": round(idle / span, 4),
+            "ops": [{"name": op, "sec": s / 1e12,
+                     "pct": round(100.0 * s / busy, 2), "count": c,
+                     "flavor": "collective" if coll else "compute"}
+                    for op, (s, c, coll) in ops],
+        }
+        if best is None or rec["busy_sec"] > best["busy_sec"]:
             best = rec
     return best

@@ -1,11 +1,19 @@
-"""Regenerate ``synthetic.xplane.pb`` -- a tiny hand-encoded XSpace.
+"""Regenerate the synthetic xplane fixtures -- tiny hand-encoded XSpaces.
 
-One fake TPU plane with three lines ("XLA Modules", "XLA Ops",
-"Async XLA Ops") plus an ignorable host plane, exercising everything
-``utils/xplane.py`` reads: metadata-resolved op names, line timestamp
-alignment, async-line exclusion, and the map<int64, XEventMetadata>
-entries.  Encoded by hand (same wire-format helpers as the pure-python
-decoder it tests against), so regeneration needs no tensorflow:
+``synthetic.xplane.pb``: one fake TPU plane with three lines
+("XLA Modules", "XLA Ops", "Async XLA Ops") plus an ignorable host
+plane, exercising everything ``utils/xplane.py`` reads:
+metadata-resolved op names, line timestamp alignment, async-line
+exclusion, and the map<int64, XEventMetadata> entries.
+
+``synthetic_multi.xplane.pb``: TWO fake TPU planes (multi-chip) whose
+op lines mix compute ops, collective ops (all-reduce / all-gather) and
+idle gaps -- the ``device_attribution`` compute/collective/idle split
+and busiest-plane selection are pinned against its exact numbers
+(``MULTI_OPS_0`` below).
+
+Encoded by hand (same wire-format helpers as the pure-python decoder
+they test against), so regeneration needs no tensorflow:
 
     python tests/fixtures/gen_xplane_fixture.py
 """
@@ -90,10 +98,53 @@ def build():
     return _blob(1, tpu) + _blob(1, host)
 
 
+#: the multi-chip fixture's busiest plane (picoseconds) -- what the
+#: device_attribution test asserts: over the 0..10 us envelope, busy =
+#: 8.5 us of which collective (all-reduce + all-gather) = 3.5 us,
+#: compute (fusion + convolution) = 5.0 us, idle = 1.5 us.
+MULTI_OPS_0 = [   # (metadata_id, offset_ps, duration_ps) on "XLA Ops"
+    (1, 0, 3_000_000),             # fusion          compute     3.0 us
+    (2, 3_500_000, 2_000_000),     # all-reduce      collective  2.0 us
+    (3, 6_000_000, 2_000_000),     # convolution     compute     2.0 us
+    (4, 8_500_000, 1_500_000),     # all-gather      collective  1.5 us
+]
+#: the second chip: less busy, so attribution must pick plane 0
+MULTI_OPS_1 = [(1, 0, 2_000_000)]
+MULTI_METADATA = [
+    (1, "%fusion.11 = bf16[256,512]{1,0} fusion(%a, %b), kind=kLoop"),
+    (2, "%all-reduce.21 = bf16[4096]{0} all-reduce(%grad)"),
+    (3, "%convolution.5 = bf16[64,112,112,64]{3,2,1,0} "
+        "convolution(%x, %w)"),
+    (4, "%all-gather.13 = bf16[8192]{0} all-gather(%w)"),
+    (5, "jit_train_step"),
+]
+
+
+def build_multi():
+    tpu0 = plane(
+        "/device:TPU:0 SyntheticMulti",
+        [
+            line("XLA Modules", 2000, [event(5, 0, 10_000_000)]),
+            line("XLA Ops", 2000, [event(*e) for e in MULTI_OPS_0]),
+            # in-flight collective spans overlap compute; must be
+            # excluded from every busy/attribution accounting
+            line("Async XLA Ops", 2000, [event(2, 0, 40_000_000)]),
+        ],
+        MULTI_METADATA)
+    tpu1 = plane(
+        "/device:TPU:1 SyntheticMulti",
+        [line("XLA Ops", 2000, [event(*e) for e in MULTI_OPS_1])],
+        MULTI_METADATA)
+    host = plane("/host:CPU", [line("python", 2000, [event(5, 0, 500)])],
+                 [(5, "jit_train_step")])
+    return _blob(1, tpu0) + _blob(1, tpu1) + _blob(1, host)
+
+
 if __name__ == "__main__":
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "synthetic.xplane.pb")
-    data = build()
-    with open(out, "wb") as f:
-        f.write(data)
-    print(f"wrote {out} ({len(data)} bytes)")
+    base = os.path.dirname(os.path.abspath(__file__))
+    for name, data in (("synthetic.xplane.pb", build()),
+                       ("synthetic_multi.xplane.pb", build_multi())):
+        out = os.path.join(base, name)
+        with open(out, "wb") as f:
+            f.write(data)
+        print(f"wrote {out} ({len(data)} bytes)")

@@ -36,6 +36,11 @@ class TestBenchContract:
         rec = _last_json(proc.stdout)
         assert rec["vs_baseline"] == 0.0
         assert rec["extra"]["failures"], rec
+        # the probe's outcome is recorded honestly (ISSUE 6): a hung
+        # probe reads "timeout", never a silently killed run
+        assert rec["probe_result"] == "timeout"
+        assert rec["extra"]["probe_sec"] is not None
+        assert rec["trust"].startswith("invalid")
 
     def test_hang_mid_sweep_salvages_completed_leg(self):
         """A child that completes one sweep leg then wedges (big-batch
@@ -51,6 +56,7 @@ class TestBenchContract:
         assert rec["value"] == 1234.0, rec
         assert rec["vs_baseline"] == 0.5
         assert "salvaged" in rec["extra"], rec
+        assert rec["probe_result"] == "tpu"
 
     def test_crash_mid_sweep_salvages_completed_leg(self):
         """A child that crashes (rc != 0) after a completed leg is
@@ -65,6 +71,26 @@ class TestBenchContract:
         rec = _last_json(proc.stdout)
         assert rec["value"] == 1234.0, rec
         assert "rc=3" in rec["extra"]["salvaged"], rec
+
+    def test_deviceless_probe_and_fallback_record(self):
+        """ISSUE-6 acceptance: on a deviceless box the probe answers in
+        seconds (not the old 240 s), the CPU fallback runs, and the
+        emitted record is COMPLETE -- trust verdict, probe outcome,
+        blocked timing and compilation-cache state all present."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run([sys.executable, BENCH], env=env,
+                              capture_output=True, text=True, timeout=900)
+        rec = _last_json(proc.stdout)
+        assert rec["probe_result"] == "cpu"
+        assert rec["extra"]["probe_sec"] <= 60       # "seconds, not 240 s"
+        assert rec["trust"] == "invalid:off_tpu"     # honest CPU verdict
+        assert rec["extra"]["probe"] == "cpu→cpu"
+        assert rec["extra"]["sec_per_step_blocked"] > 0
+        assert rec["extra"]["timing_audit"]["published"]["basis"] == \
+            "step_blocked_s"
+        assert rec["extra"]["compilation_cache"] is not None
+        assert rec["vs_baseline"] == 0.0             # CPU can't claim MFU
 
     def test_kill_mid_probe_leaves_json(self):
         """SIGTERM at any moment (the driver's timeout) leaves the last

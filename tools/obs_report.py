@@ -9,12 +9,16 @@ Inputs (produced by ``StepTelemetry``, see docs/observability.md):
 
 Output: step-time percentiles, the data-wait fraction of wall time, the
 device-busy fraction from the xplane witness, MFU from the compiled
-step's ``cost_analysis`` flops, watchdog findings, model-health
-numerics (grad-norm trajectory, worst-layer table, first non-finite
-step, anomalies -- when a ``HealthMonitor`` fed the run), serving
-metrics (request-latency percentiles, queue-depth trajectory, bucket
-histogram and pad waste -- when ``kind: "inference"`` events are
-present), host-span totals, and the top-N HLO ops by device time.
+step's ``cost_analysis`` flops (over the BLOCKED per-step time when the
+run was fenced -- ``mfu_basis`` says which; docs/observability.md,
+"Profiling & trusted timing"), a profiling section (timing mode, the
+``timing_audit`` trust verdict, compute/collective/idle device-time
+attribution), watchdog findings, model-health numerics (grad-norm
+trajectory, worst-layer table, first non-finite step, anomalies -- when
+a ``HealthMonitor`` fed the run), serving metrics (request-latency
+percentiles, queue-depth trajectory, bucket histogram and pad waste --
+when ``kind: "inference"`` events are present), host-span totals, and
+the top-N HLO ops by device time.
 
     python tools/obs_report.py runs/resnet50 [--xplane DIR] [--format json]
 
@@ -43,6 +47,19 @@ _spec = importlib.util.spec_from_file_location(
 _xplane = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(_xplane)
 device_busy, op_breakdown = _xplane.device_busy, _xplane.op_breakdown
+device_attribution = _xplane.device_attribution
+load_device_planes = _xplane.load_device_planes
+
+# same mechanism for observability/profiling.py (it has no top-level jax
+# import by design): its nearest-rank percentile is THE one definition,
+# shared with BlockingStepTimer's summaries and bench.py's serve
+# percentiles, so a bench record and its run report can never disagree
+_pspec = importlib.util.spec_from_file_location(
+    "_obs_profiling",
+    os.path.join(REPO, "bigdl_tpu", "observability", "profiling.py"))
+_profiling = importlib.util.module_from_spec(_pspec)
+_pspec.loader.exec_module(_profiling)
+percentile = _profiling.percentile
 
 
 def load_events(jsonl_path):
@@ -70,15 +87,6 @@ def load_events(jsonl_path):
             else:
                 other.append(ev)
     return header, steps, other
-
-
-def percentile(sorted_vals, q):
-    """Nearest-rank percentile over a pre-sorted list."""
-    if not sorted_vals:
-        return None
-    idx = min(len(sorted_vals) - 1,
-              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
 
 
 def load_trace_events(trace_path):
@@ -262,6 +270,41 @@ def _serving_section(other):
     return sec
 
 
+def _profiling_section(header, blocked, other, planes, top=10):
+    """Summarize the trusted-timing evidence (docs/observability.md,
+    "Profiling & trusted timing"): the blocked per-step percentiles
+    (``blocked`` is the sorted list build_report already extracted --
+    computed once, reported in both sections), the run's timing mode,
+    the ``timing_audit`` trust verdict, and the trace-derived
+    device-time attribution (compute vs collective vs idle fractions,
+    top ops; ``planes`` is the once-decoded trace from
+    ``load_device_planes``).  None for runs with none of these."""
+    sec = {}
+    if blocked:
+        sec["steps_timed"] = len(blocked)
+        sec["step_blocked_s_p50"] = percentile(blocked, 50)
+        sec["step_blocked_s_p90"] = percentile(blocked, 90)
+    timing = (header or {}).get("timing")
+    for ev in other:   # a late set_timing_mode records a standalone event
+        if ev.get("kind") == "timing" and ev.get("timing"):
+            timing = ev["timing"]
+    if timing:
+        sec["timing_mode"] = timing.get("mode")
+        sec["trust_basis"] = timing.get("trust_basis")
+    audits = [e for e in other if e.get("kind") == "timing_audit"]
+    if audits:
+        last = audits[-1]
+        sec["trust"] = last.get("trust")
+        sec["published"] = last.get("published")
+        sec["estimates"] = last.get("estimates")
+        sec["checks"] = last.get("checks")
+    if planes:
+        attribution = device_attribution(planes, top=top)
+        if attribution:
+            sec["device_attribution"] = attribution
+    return sec or None
+
+
 def build_report(run_dir, xplane_dir=None, top=10):
     jsonl = os.path.join(run_dir, "telemetry.jsonl")
     if not os.path.isfile(jsonl):
@@ -269,6 +312,10 @@ def build_report(run_dir, xplane_dir=None, top=10):
     header, steps, other = load_events(jsonl)
 
     rep = {"run_dir": run_dir, "header": header, "n_steps": len(steps)}
+    # fenced per-step times, extracted ONCE: the steps block and the
+    # profiling section both report from this list
+    blocked = sorted(e["step_blocked_s"] for e in steps
+                     if "step_blocked_s" in e)
     if steps:
         walls = sorted(e["wall_s"] for e in steps)
         waits = [e.get("data_wait_s", 0.0) for e in steps]
@@ -307,17 +354,29 @@ def build_report(run_dir, xplane_dir=None, top=10):
                 "starved_fraction": sum(1 for d in depths if d == 0)
                 / len(depths),
             }
-        # MFU: flops of the compiled step over the median step's wall
-        # time.  Cost lives on the header, or on a later standalone
-        # "cost" event when attach_cost ran after the lazy header write.
+        # trusted timing (set_blocking_timing): the ONLY basis MFU
+        # below may use when present (docs/observability.md, Profiling)
+        if blocked:
+            rep["steps"]["step_blocked_s_p50"] = percentile(blocked, 50)
+            rep["steps"]["step_blocked_s_p90"] = percentile(blocked, 90)
+        # MFU: flops of the compiled step over the median step's
+        # BLOCKED time when the run was fenced (step_blocked_s), else
+        # the wall time -- mfu_basis says which, so a report can never
+        # pass off an un-fenced number as a fenced one.  Cost lives on
+        # the header, or on a later standalone "cost" event when
+        # attach_cost ran after the lazy header write.
         cost = (header or {}).get("cost") or {}
         for ev in other:
             if ev.get("kind") == "cost" and ev.get("cost"):
                 cost = ev["cost"]
         peak = (header or {}).get("peak_flops")
-        if cost.get("flops_per_step") and peak and rep["steps"]["wall_s_p50"]:
+        basis_key = "step_blocked_s" if blocked else "wall_s"
+        basis_p50 = (rep["steps"]["step_blocked_s_p50"] if blocked
+                     else rep["steps"]["wall_s_p50"])
+        if cost.get("flops_per_step") and peak and basis_p50:
             rep["steps"]["mfu_p50"] = (
-                cost["flops_per_step"] / rep["steps"]["wall_s_p50"] / peak)
+                cost["flops_per_step"] / basis_p50 / peak)
+            rep["steps"]["mfu_basis"] = basis_key
         mems = [e["memory"] for e in steps if e.get("memory")]
         if mems:
             rep["memory_last"] = mems[-1]
@@ -345,16 +404,22 @@ def build_report(run_dir, xplane_dir=None, top=10):
     if xplane_dir is None:
         cand = os.path.join(run_dir, "xplane")
         xplane_dir = cand if os.path.isdir(cand) else None
-    if xplane_dir:
-        busy = device_busy(xplane_dir)
+    planes = load_device_planes(xplane_dir) if xplane_dir else None
+    if planes:
+        # ONE proto decode feeds all three trace summaries
+        busy = device_busy(planes)
         rep["device"] = busy
         if busy and busy.get("span_sec"):
             rep["device"]["busy_fraction"] = (
                 busy["busy_event_sec"] / busy["span_sec"])
-        ops = op_breakdown(xplane_dir, top=top)
+        ops = op_breakdown(planes, top=top)
         if ops:
             rep["top_ops"] = ops["ops"][:top]
             rep["op_categories"] = ops["categories"][:top]
+    profiling = _profiling_section(header, blocked, other, planes,
+                                   top=top)
+    if profiling:
+        rep["profiling"] = profiling
     return rep
 
 
@@ -396,8 +461,40 @@ def format_report(rep):
                        f"fresh at sync points only)")
         out.append(f"loss: {s['loss_first']:.6f} -> {s['loss_last']:.6f}")
         if s.get("mfu_p50") is not None:
+            basis = s.get("mfu_basis", "wall_s")
+            basis_note = ("blocking-fenced step time"
+                          if basis == "step_blocked_s"
+                          else "UN-FENCED wall time -- not publishable")
             out.append(f"MFU @ p50 step time: {s['mfu_p50']:.2%} "
-                       f"(peak {h.get('peak_flops', 0):.0f} FLOP/s assumed)")
+                       f"(peak {h.get('peak_flops', 0):.0f} FLOP/s assumed; "
+                       f"basis: {basis_note})")
+    pf = rep.get("profiling")
+    if pf:
+        line = "profiling:"
+        if pf.get("timing_mode"):
+            line += f" timing mode {pf['timing_mode']}"
+        if pf.get("trust"):
+            line += f"   trust {pf['trust']}"
+        if line != "profiling:":
+            out.append(line)
+        if pf.get("step_blocked_s_p50") is not None:
+            out.append(
+                f"step_blocked p50/p90: {_fmt_s(pf['step_blocked_s_p50'])} "
+                f"/ {_fmt_s(pf.get('step_blocked_s_p90'))} over "
+                f"{pf.get('steps_timed')} fenced steps")
+        for c in pf.get("checks") or []:
+            out.append(f"  [audit] {c}")
+        da = pf.get("device_attribution")
+        if da:
+            out.append(
+                f"device attribution '{da['plane']}': compute "
+                f"{da['compute_fraction']:.1%} / collective "
+                f"{da['collective_fraction']:.1%} / idle "
+                f"{da['idle_fraction']:.1%} of {da['span_sec']:.4f}s span")
+            for op in da.get("ops", [])[:8]:
+                out.append(f"  {op['pct']:>6.2f}%  {op['sec']:.6f}s  "
+                           f"x{op['count']:<4} [{op['flavor']:<10}] "
+                           f"{op['name'][:70]}")
     hl = rep.get("health")
     if hl:
         def _g(v):
