@@ -60,19 +60,27 @@ _IMAGENET_CFG = {
 }
 
 
-def ResNet(depth=50, class_num=1000, remat=False, stem_s2d=False):
+def ResNet(depth=50, class_num=1000, remat=False, stem_s2d=False,
+           remat_policy=None):
     """ImageNet ResNet; input (N, 224, 224, 3)
     (reference: ResNet.scala apply with DatasetType.ImageNet).
 
     ``remat=True`` wraps every residual block in ``nn.Remat``: the train
     step recomputes block activations during backward instead of storing
     them -- a bandwidth-for-FLOPs trade for the HBM-bound TPU step
-    (docs/performance.md).  ``stem_s2d=True`` computes the 7x7/s2 stem
-    via ``nn.SpaceToDepthStem`` (identical weights, MXU-friendlier
-    shape).  Both options are numerically equivalent to the plain model
+    (docs/performance.md).  ``remat_policy`` names a
+    ``jax.checkpoint_policies`` entry forwarded to those wrappers
+    (``"dots_saveable"`` keeps matmul/conv outputs, ``"nothing_saveable"``
+    recomputes everything; None = save block inputs only) and implies
+    ``remat=True``; unknown names fail at construction with the valid
+    list.  ``stem_s2d=True`` computes the 7x7/s2 stem via
+    ``nn.SpaceToDepthStem`` (identical weights, MXU-friendlier shape).
+    All options are numerically equivalent to the plain model
     (tests test_models.py / test_conv.py)."""
     kind, layout = _IMAGENET_CFG[depth]
-    wrap = nn.Remat if remat else (lambda m: m)
+    remat = remat or remat_policy is not None
+    wrap = ((lambda m: nn.Remat(m, policy=remat_policy)) if remat
+            else (lambda m: m))
     stem_cls = ((lambda: nn.SpaceToDepthStem(
                     3, 64, 7, data_format="NHWC",
                     weight_init=MsraFiller(False)))

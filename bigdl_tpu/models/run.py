@@ -182,6 +182,18 @@ def cmd_resnet_train(args):
     opt.optimize()
 
 
+def _validate_remat_policy(args):
+    """Fail fast on an unknown --rematPolicy NAME -- before any data
+    prep or device init, with the list of valid jax.checkpoint_policies
+    names (nn.resolve_checkpoint_policy), instead of an opaque
+    AttributeError at first apply."""
+    policy = getattr(args, "remat_policy", None)
+    if policy is not None:
+        from bigdl_tpu.nn import resolve_checkpoint_policy
+        resolve_checkpoint_policy(policy)
+    return policy
+
+
 def cmd_resnet_imagenet_train(args):
     """The published ResNet-50/ImageNet recipe (reference:
     models/resnet/README.md:131-149 + TrainImageNet.scala): global batch
@@ -230,7 +242,8 @@ def cmd_resnet_imagenet_train(args):
                                  224, 224, 3, 1000)
 
     model = ResNet(depth=50, class_num=1000, remat=args.remat,
-                   stem_s2d=args.s2d)
+                   stem_s2d=args.s2d,
+                   remat_policy=_validate_remat_policy(args))
     method = optim.SGD(
         learning_rate=base_lr, momentum=0.9, dampening=0.0,
         weight_decay=1e-4,
@@ -308,6 +321,10 @@ def cmd_transformer_train(args):
 
     vocab, seq = args.vocab, args.seq_len
     x, y = synthetic_corpus(args.synth_n, seq, vocab)
+    remat_policy = _validate_remat_policy(args)
+    #: --scanLayers auto|on|off -> None|True|False (transformer_lm's
+    #: auto scans the deep configs; docs/performance.md)
+    scan = {"auto": None, "on": True, "off": False}[args.scan_layers]
     # Pallas blockwise CE on TPU for big vocabs; plain formulation
     # elsewhere (ops/cross_entropy.py)
     crit = nn.TimeDistributedCriterion(nn.FusedSoftmaxCrossEntropyCriterion())
@@ -316,6 +333,22 @@ def cmd_transformer_train(args):
         raise ValueError("pick ONE of --sp / --pp (compose them in code "
                          "via parallel.pp_tp_shardings on a 3-D mesh)")
     if args.sp > 1 or args.pp > 1:
+        if scan is True:
+            raise ValueError(
+                "--scanLayers on is incompatible with --sp/--pp: the "
+                "model-parallel engines address per-block params "
+                "(pp re-stacks blocks by STAGE); train scan-compiled "
+                "models single-device or data-parallel")
+        if args.pp > 1 and remat_policy is not None:
+            # the pp engine re-implements the block forward per stage
+            # (parallel/pp.py) and never runs TransformerLM.apply's
+            # checkpoint wrapper -- silently accepting the flag would
+            # "apply" a policy that changes nothing
+            raise ValueError(
+                "--rematPolicy has no effect under --pp: the pipeline "
+                "engine drives the blocks directly and bypasses the "
+                "model's remat wrapper; drop the flag (sp and "
+                "single-device/dp paths honor it)")
         from bigdl_tpu.utils.engine import Engine
 
         from bigdl_tpu.models.transformer import CONFIGS
@@ -348,7 +381,9 @@ def cmd_transformer_train(args):
         axis = "seq" if args.sp > 1 else "pipe"
         mesh = Engine.build_mesh((data_deg, deg), ("data", axis))
         model = transformer_lm(args.size, vocab, max_len=seq,
-                               seq_axis_name="seq" if args.sp > 1 else None)
+                               seq_axis_name="seq" if args.sp > 1 else None,
+                               scan_layers=False,
+                               remat_policy=remat_policy)
         strategy_kw = {"strategy": "sp" if args.sp > 1 else "pp",
                        "mesh": mesh}
         if args.pp > 1:
@@ -366,7 +401,8 @@ def cmd_transformer_train(args):
         opt.optimize()
         return
 
-    model = transformer_lm(args.size, vocab, max_len=seq)
+    model = transformer_lm(args.size, vocab, max_len=seq, scan_layers=scan,
+                           remat_policy=remat_policy)
     opt = _build_optimizer(args, model, _to_dataset(x, y, args.batch), None,
                            crit, optim.Adam(learning_rate=args.lr), [])
     opt.optimize()
@@ -405,6 +441,12 @@ def main(argv=None):
                               help="flat fused optimizer update")),
              ("--remat", dict(action="store_true",
                               help="rematerialise residual blocks")),
+             ("--rematPolicy", dict(default=None, dest="remat_policy",
+                                    metavar="NAME",
+                                    help="jax.checkpoint_policies name for "
+                                         "the block remat wrappers (e.g. "
+                                         "dots_saveable, nothing_saveable; "
+                                         "implies --remat)")),
              ("--s2d", dict(action="store_true",
                             help="space-to-depth 7x7 stem"))]),
         "inception-train": (cmd_inception_train, 1,
@@ -430,7 +472,20 @@ def main(argv=None):
                                 "mesh; microbatches = stages)")),
              ("--pp-schedule", dict(default="gpipe",
                                     choices=["gpipe", "1f1b"],
-                                    dest="pp_schedule"))]),
+                                    dest="pp_schedule")),
+             ("--scanLayers", dict(default="auto",
+                                   choices=["auto", "on", "off"],
+                                   dest="scan_layers",
+                                   help="compile the block stack as one "
+                                        "lax.scan (auto: on for "
+                                        "medium/large; incompatible with "
+                                        "--sp/--pp)")),
+             ("--rematPolicy", dict(default=None, dest="remat_policy",
+                                    metavar="NAME",
+                                    help="jax.checkpoint_policies name "
+                                         "applied per transformer block "
+                                         "(e.g. dots_saveable, "
+                                         "nothing_saveable)"))]),
     }
     for name, (fn, epochs, extra) in specs.items():
         p = sub.add_parser(name)

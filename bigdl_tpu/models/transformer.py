@@ -28,14 +28,30 @@ CONFIGS = {
 def transformer_lm(size: str = "tiny", vocab_size: int = 32000,
                    max_len: int = 2048,
                    seq_axis_name: Optional[str] = None,
-                   seq_mode: str = "ring") -> TransformerLM:
+                   seq_mode: str = "ring",
+                   scan_layers: Optional[bool] = None,
+                   remat_policy: Optional[str] = None) -> TransformerLM:
     """Named configs; 'tiny'/'small' fit a chip's HBM comfortably, larger
-    sizes pair with tp/pp/sp shardings."""
+    sizes pair with tp/pp/sp shardings.
+
+    ``scan_layers=None`` (the default) is AUTO: the deep configs
+    (``medium``/``large``) compile their blocks as one ``lax.scan``
+    (nn.ScanLayers -- ~layer-count-fold lower jit-compile time,
+    docs/performance.md "Step-time campaign"), the shallow ones stay
+    unrolled; pass True/False to force.  ``remat_policy`` names a
+    ``jax.checkpoint_policies`` entry applied per block during training
+    (``"nothing_saveable"``/``"dots_saveable"``/None)."""
     if size not in CONFIGS:
         raise ValueError(f"unknown size {size!r}; pick from {list(CONFIGS)}")
     hidden, heads, layers = CONFIGS[size]
+    if scan_layers is None:
+        # auto: deep configs scan; sequence-parallel models stay unrolled
+        # (the pp engine additionally re-stacks blocks by stage and is
+        # routed with an explicit scan_layers=False by the CLI)
+        scan_layers = size in ("medium", "large") and seq_axis_name is None
     return TransformerLM(vocab_size, hidden, heads, layers, max_len=max_len,
-                         seq_axis_name=seq_axis_name, seq_mode=seq_mode)
+                         seq_axis_name=seq_axis_name, seq_mode=seq_mode,
+                         scan_layers=scan_layers, remat_policy=remat_policy)
 
 
 def synthetic_corpus(n_seq: int, seq_len: int, vocab_size: int, seed=0):

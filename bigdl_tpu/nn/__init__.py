@@ -7,7 +7,9 @@ from bigdl_tpu.nn.module import Module, Container, Criterion, Identity, child_rn
 from bigdl_tpu.nn.containers import (
     Sequential, Concat, ConcatTable, ParallelTable, MapTable,
     CAddTable, CMulTable, CSubTable, CDivTable, CMaxTable, CMinTable,
-    JoinTable, SelectTable, FlattenTable, Remat,
+    JoinTable, SelectTable, FlattenTable, Remat, ScanLayers,
+    checkpoint_policy_names, resolve_checkpoint_policy,
+    stack_layer_trees, unstack_layer_trees,
 )
 from bigdl_tpu.nn.graph import Graph, Node, Input
 from bigdl_tpu.nn.linear import Linear
@@ -22,6 +24,10 @@ from bigdl_tpu.nn.pooling import (
 from bigdl_tpu.nn.normalization import (
     BatchNormalization, SpatialBatchNormalization, LayerNorm, RMSNorm,
     Dropout, SpatialCrossMapLRN, Normalize,
+)
+from bigdl_tpu.nn.attention import (
+    MultiHeadAttention, TransformerBlock, TransformerLM,
+    stack_block_params, unstack_block_params,
 )
 from bigdl_tpu.nn.activations import (
     ReLU, Tanh, Sigmoid, SoftMax, SoftMin, LogSoftMax, HardTanh, Clamp,

@@ -10,11 +10,14 @@ Layout: (N, T, D); heads split last.  bf16-friendly: softmax in fp32.
 """
 
 import math
+import re
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from bigdl_tpu.nn.containers import (ScanLayers, resolve_checkpoint_policy,
+                                     stack_layer_trees, unstack_layer_trees)
 from bigdl_tpu.nn.initialization import Xavier, Zeros
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.module import Container, Module, child_rng
@@ -67,12 +70,25 @@ class MultiHeadAttention(Module):
         #: forces the kernel in interpreter mode (CPU tests).
         self.use_flash = use_flash
 
+    @staticmethod
+    def _flash_block_ok(t):
+        """Whether T tiles into flash blocks: the kernel's call site uses
+        ``block_q = t`` for short sequences, so any sublane-aligned
+        ``t < 128`` is block-alignable (a single (t, d) VMEM tile);
+        longer sequences must tile exactly into 128-blocks.  (The old
+        ``t % 128`` test rejected EVERY short sequence even though the
+        kernel handles them -- tests/test_flash_attention.py pins the
+        short-T flash-vs-plain agreement.)"""
+        if t < 128:
+            return t % 8 == 0
+        return t % 128 == 0
+
     def _flash_ok(self, t):
         if self.use_flash == "never" or self.seq_axis_name is not None:
             return False
         if self.use_flash in ("always", "interpret"):
             return True
-        if t % 128:
+        if not self._flash_block_ok(t):
             return False
         try:
             return jax.devices()[0].platform == "tpu"
@@ -168,23 +184,52 @@ class TransformerLM(Container):
 
     The long-context flagship; pairs with sequence parallelism
     (parallel/ring_attention.py) for T beyond one chip's HBM.
+
+    ``scan_layers=True`` runs the N structurally-identical blocks as ONE
+    ``lax.scan`` over LAYER-STACKED params (``nn.ScanLayers``): XLA
+    compiles the block body once instead of N times, so jit-compile wall
+    time drops roughly N-fold at the deep configs (docs/performance.md,
+    "Step-time campaign").  Params then carry one ``"blocks"`` entry
+    (every leaf gains a leading num_layers axis) instead of
+    ``"block0"``..``"block{N-1}"``; ``stack_block_params`` /
+    ``unstack_block_params`` interconvert the two layouts, so stacked
+    and unrolled checkpoints are mutually loadable.  Initialization is
+    BIT-IDENTICAL across the two modes (per-block setup keys are derived
+    the same way, then stacked), as is the per-block dropout rng
+    derivation -- scan and unrolled runs from one seed produce the same
+    losses.
+
+    ``remat_policy`` names a ``jax.checkpoint_policies`` entry
+    (``"nothing_saveable"`` / ``"dots_saveable"`` / None = save block
+    inputs only) applied per block during training: per-scan-iteration
+    under ``scan_layers``, as a ``jax.checkpoint`` wrapper around each
+    unrolled block otherwise (no param-keying change either way).
     """
 
     def __init__(self, vocab_size, hidden_size, num_heads, num_layers,
                  max_len=2048, mlp_ratio=4, seq_axis_name=None,
-                 seq_mode="ring", name=None):
+                 seq_mode="ring", scan_layers=False, remat_policy=None,
+                 name=None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.max_len = max_len
         self.seq_axis_name = seq_axis_name
+        self.scan_layers = scan_layers
+        resolve_checkpoint_policy(remat_policy)  # unknown names fail HERE
+        self.remat_policy = remat_policy
         self.blocks = [TransformerBlock(hidden_size, num_heads, mlp_ratio,
                                         seq_axis_name=seq_axis_name,
                                         seq_mode=seq_mode)
                        for _ in range(num_layers)]
         self.ln_f = LayerNorm(hidden_size)
-        for b in self.blocks:
-            self.add(b)
+        if scan_layers:
+            self.scan = ScanLayers(self.blocks, policy=remat_policy)
+            self.add(self.scan)
+        else:
+            self.scan = None
+            for b in self.blocks:
+                self.add(b)
         self.add(self.ln_f)
 
     def setup(self, rng, input_spec):
@@ -199,9 +244,15 @@ class TransformerLM(Container):
         }
         hid_spec = jax.ShapeDtypeStruct(
             (input_spec.shape[0], input_spec.shape[1], d), jnp.float32)
-        for i, b in enumerate(self.blocks):
-            p, _ = b.setup(child_rng(rng, 3 + i), hid_spec)
-            params[f"block{i}"] = p
+        # per-block init keys are derived identically in both layouts, so
+        # scan and unrolled models from one seed start bit-identical
+        block_params = [b.setup(child_rng(rng, 3 + i), hid_spec)[0]
+                        for i, b in enumerate(self.blocks)]
+        if self.scan_layers:
+            params["blocks"] = stack_layer_trees(block_params)
+        else:
+            for i, p in enumerate(block_params):
+                params[f"block{i}"] = p
         params["ln_f"], _ = self.ln_f.setup(child_rng(rng, 99), hid_spec)
         return params, ()
 
@@ -216,9 +267,64 @@ class TransformerLM(Container):
             x = x + jnp.take(params["wpe"], pos, axis=0)[None]
         else:
             x = x + params["wpe"][:t][None]
-        for i, b in enumerate(self.blocks):
-            x, _ = b.apply(params[f"block{i}"], (), x, training=training,
-                           rng=child_rng(rng, i))
+        if self.scan_layers:
+            # one scanned block body; layer i draws fold_in(rng, i), the
+            # same per-block key derivation as the unrolled loop below
+            x, _ = self.scan.apply(params["blocks"], (), x,
+                                   training=training, rng=rng)
+        else:
+            policy = self.remat_policy
+            for i, b in enumerate(self.blocks):
+                key = child_rng(rng, i)
+                if training and policy is not None:
+                    # functional remat wrapper: same params keying, the
+                    # block's forward re-runs in backward under the policy
+                    def f(p, h, _b=b, _key=key):
+                        return _b.apply(p, (), h, training=True,
+                                        rng=_key)[0]
+                    x = jax.checkpoint(
+                        f, policy=resolve_checkpoint_policy(policy))(
+                        params[f"block{i}"], x)
+                else:
+                    x, _ = b.apply(params[f"block{i}"], (), x,
+                                   training=training, rng=key)
         x, _ = self.ln_f.apply(params["ln_f"], (), x)
         logits = x @ params["head"].astype(x.dtype).T
         return logits, state
+
+
+#: matches the unrolled per-block param keys ("block0".."block{N-1}")
+_BLOCK_KEY = re.compile(r"^block(\d+)$")
+
+
+def stack_block_params(params):
+    """Unrolled ``TransformerLM`` params (``"block{i}"`` keys) -> the
+    ``scan_layers`` layout (one ``"blocks"`` entry, every leaf stacked
+    along a new leading layer axis).  Non-block entries (wte/wpe/head/
+    ln_f) pass through unchanged; this is the checkpoint import path
+    into a scan model (docs/performance.md, "Step-time campaign")."""
+    idx = sorted(int(m.group(1)) for k in params
+                 if (m := _BLOCK_KEY.match(k)))
+    if not idx:
+        raise ValueError("no 'block{i}' entries to stack (already the "
+                         "scan layout?)")
+    if idx != list(range(len(idx))):
+        raise ValueError(f"non-contiguous block indices {idx}")
+    out = {k: v for k, v in params.items() if not _BLOCK_KEY.match(k)}
+    out["blocks"] = stack_layer_trees(
+        [params[f"block{i}"] for i in idx])
+    return out
+
+
+def unstack_block_params(params):
+    """Scan-layout ``TransformerLM`` params (stacked ``"blocks"``) ->
+    the unrolled ``"block{i}"`` keying -- the checkpoint export path
+    back to per-layer keys (what quantize/regularizer traversals and
+    per-layer resharding address)."""
+    if "blocks" not in params:
+        raise ValueError("no 'blocks' entry to unstack (already the "
+                         "unrolled layout?)")
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    for i, p in enumerate(unstack_layer_trees(params["blocks"])):
+        out[f"block{i}"] = p
+    return out

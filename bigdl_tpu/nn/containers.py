@@ -230,6 +230,67 @@ class FlattenTable(Module):
         return tuple(jax.tree.leaves(input)), state
 
 
+#: jax.checkpoint_policies entries that are FACTORIES (they take
+#: names/offload args and RETURN a policy), not policies themselves.
+#: Passing one directly to jax.checkpoint either crashes or -- worse,
+#: for the *names factories, whose closure is truthy for every
+#: primitive -- silently saves everything, disabling remat.  A string
+#: spelling can never supply the factory's arguments, so these are not
+#: valid ``policy=`` names; construct the policy and pass the CALLABLE.
+_POLICY_FACTORIES = frozenset({
+    "offload_dot_with_no_batch_dims",
+    "save_and_offload_only_these_names",
+    "save_any_names_but_these",
+    "save_anything_except_these_names",
+    "save_from_both_policies",
+    "save_only_these_names",
+})
+
+
+def checkpoint_policy_names():
+    """The valid ``jax.checkpoint_policies`` NAMES a ``policy=`` string
+    may take (``"dots_saveable"``, ``"nothing_saveable"``, ...).
+    Factory entries (``save_only_these_names(...)`` & friends) are
+    excluded: they need arguments a name cannot carry."""
+    return sorted(
+        n for n in dir(jax.checkpoint_policies)
+        if not n.startswith("_") and n not in _POLICY_FACTORIES
+        and callable(getattr(jax.checkpoint_policies, n)))
+
+
+def resolve_checkpoint_policy(policy):
+    """``None`` / a ``jax.checkpoint_policies`` NAME / a raw callable ->
+    the callable ``jax.checkpoint(policy=)`` accepts.
+
+    The one resolution seam ``Remat``, ``ScanLayers`` and the
+    ``--rematPolicy`` CLI flag all share: an unknown name fails HERE,
+    eagerly, with the list of valid policies -- not as an opaque
+    ``AttributeError`` out of ``getattr`` at first apply inside a trace.
+    ``None`` means jax.checkpoint's default (save only the wrapped
+    computation's inputs).
+    """
+    if policy is None or callable(policy):
+        return policy
+    if isinstance(policy, str):
+        if policy in _POLICY_FACTORIES:
+            raise ValueError(
+                f"{policy!r} is a policy FACTORY, not a policy: it takes "
+                f"arguments a name cannot carry (and used directly it "
+                f"would silently save everything, disabling remat) -- "
+                f"construct it yourself and pass the callable, e.g. "
+                f"policy=jax.checkpoint_policies.{policy}(...)")
+        fn = getattr(jax.checkpoint_policies, policy, None)
+        if fn is None or not callable(fn):
+            raise ValueError(
+                f"unknown checkpoint policy {policy!r}; valid "
+                f"jax.checkpoint_policies names: "
+                f"{checkpoint_policy_names()}")
+        return fn
+    raise TypeError(
+        f"policy must be None, a jax.checkpoint_policies name or a "
+        f"callable, got {type(policy).__name__}")
+
+
 class Remat(Container):
     """Rematerialise the wrapped module's activations during backward
     (``jax.checkpoint``).
@@ -255,12 +316,11 @@ class Remat(Container):
     def __init__(self, module: Module, policy=None, name=None):
         super().__init__(name)
         self.add(module)
+        resolve_checkpoint_policy(policy)   # unknown names fail HERE
         self.policy = policy
 
     def _policy(self):
-        if isinstance(self.policy, str):
-            return getattr(jax.checkpoint_policies, self.policy)
-        return self.policy
+        return resolve_checkpoint_policy(self.policy)
 
     def setup(self, rng, input_spec):
         p, s = self.modules[0].setup(rng, input_spec)
@@ -284,3 +344,117 @@ class Remat(Container):
 
         out, s = jax.checkpoint(f, policy=self._policy())(params["0"], input)
         return out, {"0": s}
+
+
+def stack_layer_trees(trees):
+    """[per-layer pytree] -> one pytree with every leaf stacked along a
+    new leading LAYER axis (layer i lives at index i of every leaf) --
+    the ``ScanLayers`` parameter layout."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *list(trees))
+
+
+def unstack_layer_trees(tree):
+    """Inverse of ``stack_layer_trees``: one stacked pytree -> the list
+    of per-layer pytrees (restoring the Container keying invariant's
+    per-child view for traversals that need it)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        raise ValueError("unstack_layer_trees: tree has no array leaves")
+    n = leaves[0].shape[0]
+    return [jax.tree.map(lambda x: x[i], tree) for i in range(n)]
+
+
+class ScanLayers(Container):
+    """Scan-compiled stack of N structurally-identical layers.
+
+    TPU-first, no reference analogue (the reference's deepest stacks are
+    unrolled Sequential chains): an N-layer transformer traced layer by
+    layer hands XLA N copies of the same block -- compile time, program
+    size and executable HBM all scale with N.  This container stacks the
+    children's params/state along a new leading LAYER axis
+    (``stack_layer_trees``) and runs ONE ``lax.scan`` over it, so XLA
+    compiles the block body once; compile wall time drops roughly
+    N-fold (docs/performance.md, "Step-time campaign").
+
+    Each scan iteration runs under ``jax.checkpoint`` during training,
+    with ``policy`` naming a ``jax.checkpoint_policies`` entry
+    (``"nothing_saveable"`` recomputes everything in backward --
+    minimum activation HBM; ``"dots_saveable"`` keeps matmul outputs;
+    ``None`` = jax.checkpoint's default, saving only each layer's
+    inputs).  Per-iteration checkpointing is what makes scan-over-layers
+    memory-sane: without it, autodiff would store every layer's full
+    internals for the backward scan.
+
+    The children must be structurally identical: same params/state
+    treedef, same leaf shapes/dtypes, and output spec == input spec (the
+    scan carry).  Layer i's parameters live at index i of every stacked
+    leaf; ``stack_layer_trees``/``unstack_layer_trees`` interconvert
+    with the unrolled per-child layout so checkpoints and generic
+    traversals (quantize, regularizers, resharding) can always recover
+    the per-layer view.  For the frozen-mask walk the whole stacked
+    subtree routes to child 0 (all layers freeze together -- slicing a
+    static mask out of a scanned carry is not expressible).
+
+    RNG: layer i receives ``fold_in(rng, i)`` -- the same derivation an
+    unrolled loop over ``child_rng(rng, i)`` uses, so scan and unrolled
+    dropout masks match.
+    """
+
+    def __init__(self, modules, policy=None, name=None):
+        super().__init__(name)
+        modules = list(modules)
+        if not modules:
+            raise ValueError("ScanLayers needs at least one module")
+        for m in modules:
+            self.add(m)
+        resolve_checkpoint_policy(policy)   # unknown names fail HERE
+        self.policy = policy
+
+    def setup(self, rng, input_spec):
+        ps, ss = [], []
+        struct = None
+        for i, m in enumerate(self.modules):
+            p, s = m.setup(child_rng(rng, i), input_spec)
+            sig = jax.tree.map(
+                lambda x: (tuple(x.shape), jnp.asarray(x).dtype), (p, s))
+            if struct is None:
+                struct = sig
+            elif sig != struct:
+                raise ValueError(
+                    f"ScanLayers children must be structurally identical; "
+                    f"child {i} ({self.modules[i].name}) differs from "
+                    f"child 0 ({self.modules[0].name})")
+            ps.append(p)
+            ss.append(s)
+        return stack_layer_trees(ps), stack_layer_trees(ss)
+
+    def output_spec(self, params, state, input_spec, training=False):
+        return input_spec     # the scan carry: output spec == input spec
+
+    def _param_child_items(self, params):
+        # the stacked subtree routes whole to child 0 (layers share
+        # frozen status; see class docstring)
+        return [(None, self.modules[0])]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        inner = self.modules[0]
+        n = len(self.modules)
+        keys = None
+        if rng is not None:
+            keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+                jnp.arange(n))
+
+        def layer(x, sliced):
+            p, s, key = sliced
+            y, new_s = inner.apply(p, s, x, training=training, rng=key)
+            return y, new_s
+
+        body = layer
+        if training:
+            # per-iteration remat: backward re-runs each layer's forward
+            # under the named policy instead of storing its internals
+            body = jax.checkpoint(
+                layer, policy=resolve_checkpoint_policy(self.policy))
+        out, new_state = jax.lax.scan(body, input, (params, state, keys),
+                                      length=n)
+        return out, new_state

@@ -143,6 +143,7 @@ class StepTelemetry:
         from bigdl_tpu.utils.config import compilation_cache_status
         self._cache_status = compilation_cache_status()
         self._cost = None
+        self._compiled_step = None
         self._timing = None
         self._wrote_header = False
         self._closed = False
@@ -212,6 +213,11 @@ class StepTelemetry:
                 fields["timing"] = self._timing
             if self._cost:
                 fields["cost"] = self._cost
+            if self._compiled_step:
+                # the lowering-text audit (attach_cost): donation
+                # coverage, dot/conv dtypes, collectives -- the
+                # obs_report "Compiled step" section reads this
+                fields["compiled_step"] = self._compiled_step
             fields.update(extra)
             return self.record("header", **fields)
 
@@ -272,17 +278,33 @@ class StepTelemetry:
         return self.record("step", **event)
 
     # ----- compiled-step cost ---------------------------------------------- #
-    def attach_cost(self, jitted, *example_args, records_per_step=None):
+    def attach_cost(self, jitted, *example_args, records_per_step=None,
+                    arg_labels=None):
         """Lower the step for ``cost_analysis`` and put the flops/bytes
         totals on the run header.  The lowering's own cost analysis is
         preferred -- it needs no backend compile, so enabling telemetry
         does not pay the train step's XLA compile twice; only when the
         lowering exposes nothing is the AOT compile consulted.  Failure
-        is never fatal -- cost is an annotation, not a dependency."""
+        is never fatal -- cost is an annotation, not a dependency.
+
+        The same lowering additionally feeds the compiled-step audit
+        (``utils/hlo.py``, docs/observability.md "Compiled step
+        audit"): per-plane buffer-donation coverage, dot/conv dtypes
+        and collective counts parsed from the lowering TEXT (still no
+        backend compile), stamped on the header as ``compiled_step``.
+        ``arg_labels`` names the step's positional args (``("params",
+        "mstate", "opt_state", ...)``) so the coverage reads per plane;
+        the drivers all pass theirs."""
         try:
             lowered = jitted.lower(*example_args)
         except Exception:
             return None
+        try:
+            from bigdl_tpu.utils import hlo
+            self._compiled_step = hlo.lowering_summary(
+                lowered, example_args, arg_labels=arg_labels)
+        except Exception:       # the audit is an annotation, like cost
+            self._compiled_step = None
         try:
             cost = _normalize_cost(lowered.cost_analysis())
         except Exception:
@@ -292,15 +314,18 @@ class StepTelemetry:
                 cost = _normalize_cost(lowered.compile().cost_analysis())
             except Exception:
                 cost = None
-        if cost is None:
+        if cost is None and self._compiled_step is None:
             return None
-        if records_per_step:
+        if cost is not None and records_per_step:
             cost["records_per_step"] = int(records_per_step)
         self._cost = cost
         if not self._wrote_header:
             self.write_header()           # header carries the cost block
         else:
-            self.record("cost", cost=cost)
+            fields = {"cost": cost}
+            if self._compiled_step is not None:
+                fields["compiled_step"] = self._compiled_step
+            self.record("cost", **fields)
         return cost
 
     # ----- spans ------------------------------------------------------------ #

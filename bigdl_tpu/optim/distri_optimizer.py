@@ -620,12 +620,17 @@ class DistriOptimizer(BaseOptimizer):
             xc, tc = self._shard_batch(first_batch, batch_sharding)
             cost_args = (params_flat, mstate, opt_state, xc, tc,
                          jax.random.key(0))
+            labels = ("params_flat", "mstate", "opt_state", "input",
+                      "target", "rng")
             if use_ef:
                 cost_args += (ef_state,)
+                labels += ("ef_residual",)
             if use_health:
                 cost_args += (jax.ShapeDtypeStruct((), jnp.bool_), seg_ids)
+                labels += ("sample", "seg_ids")
             self.telemetry.attach_cost(
-                step, *cost_args, records_per_step=global_batch)
+                step, *cost_args, records_per_step=global_batch,
+                arg_labels=labels)
 
         def stage_device(batch):
             # global sharded arrays assembled while the previous step

@@ -824,10 +824,14 @@ class LocalOptimizer(BaseOptimizer):
             tc = None if tgt is None else jax.tree.map(spec, tgt)
             cost_args = (params, mstate, opt_state, xc, tc,
                          jax.random.key(0))
+            labels = ("params", "mstate", "opt_state", "input", "target",
+                      "rng")
             if use_health:
                 cost_args += (jax.ShapeDtypeStruct((), jnp.bool_),)
+                labels += ("sample",)
             self.telemetry.attach_cost(
-                step, *cost_args, records_per_step=first_batch.size())
+                step, *cost_args, records_per_step=first_batch.size(),
+                arg_labels=labels)
 
         stats_holder = [None]         # device stats tree of the live step
 

@@ -424,7 +424,9 @@ class StrategyOptimizer(BaseOptimizer):
             yc = jax.tree.map(place, first_batch.get_target())
             self.telemetry.attach_cost(
                 step, params, opt_state, xc, yc, jax.random.key(0),
-                records_per_step=first_batch.size())
+                records_per_step=first_batch.size(),
+                arg_labels=("params", "opt_state", "input", "target",
+                            "rng"))
 
         def stage_device(batch):
             # strategy-native placement (per-leaf shardings) started while

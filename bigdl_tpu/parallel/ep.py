@@ -100,13 +100,22 @@ def make_ep_train_step(model, criterion, optim_method, mesh,
         return new_params, new_opt, task
 
     def compile_for(params):
+        from bigdl_tpu.parallel.zero import opt_state_shardings
+
         ps = ep_sharding_for_params(params, mesh, rules)
         batch_sh = NamedSharding(mesh, P(data_axis))
+        rep = NamedSharding(mesh, P())
+        # optimizer-state shardings pinned on BOTH sides (same fix as
+        # parallel/tp.py): with the opt output left to propagation,
+        # GSPMD picks an expert-sharded layout for the ROUTER's Adam
+        # moments while the donated input plane is replicated, and XLA
+        # refuses the alias at dispatch ("Expected aliased input ... to
+        # have the same size") -- the 8-device ep dryrun failure
+        opt_sh = opt_state_shardings(optim_method, params, ps, mesh)
         return jax.jit(
             step,
-            in_shardings=(ps, None, batch_sh, batch_sh,
-                          NamedSharding(mesh, P())),
-            out_shardings=(ps, None, NamedSharding(mesh, P())),
+            in_shardings=(ps, opt_sh, batch_sh, batch_sh, rep),
+            out_shardings=(ps, opt_sh, rep),
             donate_argnums=(0, 1),
         )
 

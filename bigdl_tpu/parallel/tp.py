@@ -82,15 +82,21 @@ def make_tp_train_step(model, criterion, optim_method, mesh,
         return new_params, new_opt, loss
 
     def compile_for(params):
+        from bigdl_tpu.parallel.zero import opt_state_shardings
+
         ps = sharding_for_params(params, mesh, rules)
         batch_sh = NamedSharding(mesh, P(data_axis))
-        # optimizer state: sharding left unspecified -- device_put it with
-        # param-matching shardings via init_opt_state below, and GSPMD
-        # propagates from there (each device updates only its shard).
+        rep = NamedSharding(mesh, P())
+        # optimizer-state shardings pinned on BOTH sides: with the
+        # output sharding left to propagation, GSPMD occasionally picks
+        # a different layout for a moment plane than its donated input
+        # carries, and XLA silently drops that buffer's alias -- the
+        # plane is then double-buffered (caught by tools/hlo_audit.py)
+        opt_sh = opt_state_shardings(optim_method, params, ps, mesh)
         return jax.jit(
             step,
-            in_shardings=(ps, None, batch_sh, batch_sh,
-                          NamedSharding(mesh, P())),
+            in_shardings=(ps, opt_sh, batch_sh, batch_sh, rep),
+            out_shardings=(ps, opt_sh, rep),
             donate_argnums=(0, 1),
         )
 

@@ -89,23 +89,40 @@ def stage_batch_global(tree, sharding):
     return jax.tree.map(to_global, tree)
 
 
-def shard_opt_state(optim_method, params, param_shardings, mesh):
-    """Optimizer state placed with the same shardings as its params.
-
-    Moment subtrees (momentum/velocity/...) mirror the params tree, so they
-    take the param shardings; anything else (step counters, scalars) is
-    replicated.  Shared by the tp/pp/ep engines -- the analogue of the
-    reference owning OptimMethod state per weight chunk
-    (optim/DistriOptimizer.scala:383).
-    """
+def opt_state_shardings(optim_method, params, param_shardings, mesh):
+    """The sharding TREE ``shard_opt_state`` places with: moment
+    subtrees (momentum/velocity/...) mirror the params tree and take
+    the param shardings; anything else (step counters, scalars) is
+    replicated.  Exposed separately so step builders can pin the SAME
+    tree as ``out_shardings`` -- an output whose propagated sharding
+    drifts from its donated input's loses the buffer alias (the exact
+    leak ``tools/hlo_audit.py`` gates on)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    state = optim_method.init_state(params)
+    state_eval = jax.eval_shape(optim_method.init_state, params)
     rep = NamedSharding(mesh, P())
+    param_struct = jax.tree.structure(param_shardings)
     out = {}
-    for key, val in state.items():
-        try:
-            out[key] = jax.tree.map(jax.device_put, val, param_shardings)
-        except ValueError:
-            out[key] = jax.tree.map(lambda a: jax.device_put(a, rep), val)
+    for key, val in state_eval.items():
+        # a moment subtree mirrors the params tree EXACTLY; anything
+        # else (scalar counters, stats vectors) replicates.  The
+        # structure check must be explicit: a scalar leaf is a valid
+        # tree PREFIX of the shardings dict, so a prefix-tolerant map
+        # would silently hand it the whole dict as its "sharding"
+        if jax.tree.structure(val) == param_struct:
+            out[key] = jax.tree.map(lambda _, s: s, val, param_shardings)
+        else:
+            out[key] = jax.tree.map(lambda a: rep, val)
     return out
+
+
+def shard_opt_state(optim_method, params, param_shardings, mesh):
+    """Optimizer state placed with the same shardings as its params
+    (``opt_state_shardings``).  Shared by the tp/pp/ep engines -- the
+    analogue of the reference owning OptimMethod state per weight chunk
+    (optim/DistriOptimizer.scala:383).
+    """
+    state = optim_method.init_state(params)
+    shardings = opt_state_shardings(optim_method, params,
+                                    param_shardings, mesh)
+    return jax.tree.map(jax.device_put, state, shardings)

@@ -13,11 +13,17 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
     """``jax.shard_map`` (new API) with fallback to
     ``jax.experimental.shard_map.shard_map`` (pre-0.5 jax), where the
     replication-checking flag was spelled ``check_rep`` instead of
-    ``check_vma``."""
+    ``check_vma`` and partial-manual mode named the AUTO axes
+    (``auto=``, the complement) instead of the MANUAL ones
+    (``axis_names=``)."""
     if hasattr(jax, "shard_map"):
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=check_vma, **kw)
     from jax.experimental.shard_map import shard_map as _shard_map
+    manual = kw.pop("axis_names", None)
+    if manual is not None:
+        # old spelling: the axes NOT listed stay automatic (GSPMD)
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(manual)
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=check_vma, **kw)
 

@@ -1,0 +1,334 @@
+"""Compiled-step HLO audit: what the program we hand XLA actually says.
+
+The step-time campaign (ROADMAP item 1) needs the compiled train step to
+be AUDITABLE, not just fast-feeling: an undonated parameter plane
+silently doubles peak HBM, an fp32 matmul in a step that claims bf16
+halves MXU throughput, and an unexpected collective is wire time nobody
+budgeted.  All three are visible in the program text, so this module
+extracts them:
+
+- ``lowering_summary(lowered, args)`` -- parsed from the StableHLO
+  LOWERING text (``lowered.as_text()``): per-plane buffer-donation
+  markers (``tf.aliasing_output`` / ``jax.buffer_donor`` on the entry
+  arguments), dot/conv result dtypes, and collective-op counts.  No
+  backend compile, so ``StepTelemetry.attach_cost`` can stamp this on
+  every run header for free (the "Compiled step" section of
+  tools/obs_report.py).
+
+- ``compiled_summary(compiled, args)`` -- parsed from the OPTIMIZED HLO
+  (``compiled.as_text()``): the authoritative ``input_output_alias``
+  table (which donations XLA actually honored), post-fusion dot/conv
+  dtypes, collective counts and the fusion count.  This is what the
+  lint-style gate (``tools/hlo_audit.py``) judges: it exits nonzero
+  when a large param/opt-state leaf is undonated.
+
+Both summaries share one coverage schema (``donation`` below); the
+``source`` field says which text produced it.  Entry parameters
+correspond 1:1, in order, to the flattened example-argument leaves --
+the same flatten order ``jax.tree.flatten`` uses -- which is how a
+parameter index maps back to a labeled plane and a tree path.
+
+Schema (docs/observability.md, "Compiled step audit")::
+
+    {"source": "lowering" | "compiled",
+     "donation": {label: {"leaves", "bytes", "donated_leaves",
+                          "donated_bytes", "undonated": [{path, bytes,
+                          dtype}, ...]}},
+     "dot_conv_dtypes": {"dot": {dtype: count}, "conv": {dtype: count}},
+     "collectives": {op: count},          # only ops that appear
+     "fusions": int,                      # compiled source only
+    }
+
+No jax import at module top: the parsers are pure text -> dict, so
+tools can spec-load this file the way obs_report loads xplane.py.
+"""
+
+import math
+import re
+
+#: stablehlo collective ops (lowering text) -> canonical names
+_STABLEHLO_COLLECTIVES = {
+    "stablehlo.all_reduce": "all_reduce",
+    "stablehlo.all_gather": "all_gather",
+    "stablehlo.reduce_scatter": "reduce_scatter",
+    "stablehlo.all_to_all": "all_to_all",
+    "stablehlo.collective_permute": "collective_permute",
+}
+
+#: optimized-HLO collective op spellings (incl. async -start forms)
+_HLO_COLLECTIVES = {
+    "all-reduce": "all_reduce",
+    "all-reduce-start": "all_reduce",
+    "all-gather": "all_gather",
+    "all-gather-start": "all_gather",
+    "reduce-scatter": "reduce_scatter",
+    "all-to-all": "all_to_all",
+    "collective-permute": "collective_permute",
+    "collective-permute-start": "collective_permute",
+}
+
+
+def arg_entries(example_args, arg_labels=None):
+    """Flatten the step's example arguments into the entry-parameter
+    view: ``[{label, path, shape, dtype, bytes}]`` in jax flatten order
+    (= HLO entry parameter order).  ``arg_labels`` names the top-level
+    positional args (``("params", "mstate", ...)``); unnamed tails get
+    ``arg{i}``."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    labels = list(arg_labels or ())
+    out = []
+    for i, arg in enumerate(example_args):
+        label = labels[i] if i < len(labels) else f"arg{i}"
+        leaves, _ = tree_flatten_with_path(arg)
+        for path, leaf in leaves:
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = getattr(leaf, "dtype", None)
+            try:
+                nbytes = int(math.prod(shape)) * dtype.itemsize
+            except Exception:
+                nbytes = None
+            out.append({
+                "label": label,
+                "path": label + keystr(path),
+                "shape": shape,
+                "dtype": str(dtype) if dtype is not None else None,
+                "bytes": nbytes,
+            })
+    return out
+
+
+# --------------------------------------------------------------------- #
+# text parsers
+# --------------------------------------------------------------------- #
+
+def _main_signature(text):
+    """The ``func.func public @main(...)`` argument region of an MLIR
+    lowering (one printer line), or None."""
+    m = re.search(r"func\.func public @main\((.*)$", text, re.MULTILINE)
+    return m.group(1) if m else None
+
+
+def donated_params_from_lowering(text):
+    """Entry-parameter indices carrying a donation marker in the
+    lowering text.  ``tf.aliasing_output`` = donation already resolved
+    to an output alias; ``jax.buffer_donor`` = donated, aliasing left to
+    the compiler (the shard_map path) -- both count as donated at the
+    program level."""
+    sig = _main_signature(text)
+    if sig is None:
+        return set()
+    # split the signature at each %argN; attributes for arg N live
+    # between its marker and the next one (or the result arrow)
+    marks = [(int(m.group(1)), m.start())
+             for m in re.finditer(r"%arg(\d+)\s*:", sig)]
+    donated = set()
+    for k, (idx, start) in enumerate(marks):
+        end = marks[k + 1][1] if k + 1 < len(marks) else len(sig)
+        seg = sig[start:end]
+        if "tf.aliasing_output" in seg or "jax.buffer_donor" in seg:
+            donated.add(idx)
+    return donated
+
+
+def aliased_params_from_compiled(text):
+    """Entry-parameter indices in the optimized HLO's authoritative
+    ``input_output_alias={ {out}: (param, {index}, kind), ... }``
+    table."""
+    i = text.find("input_output_alias={")
+    if i < 0:
+        return set()
+    start = text.index("{", i + len("input_output_alias="))
+    depth, j = 0, start
+    while j < len(text):
+        if text[j] == "{":
+            depth += 1
+        elif text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    block = text[start:j + 1]
+    return {int(m.group(1)) for m in re.finditer(r"\((\d+),", block)}
+
+
+def dot_conv_from_lowering(text):
+    """{op: {result dtype: count}} for stablehlo dot_general /
+    convolution ops -- the dtype the PROGRAM requests of the matmul
+    path, before any backend rewrite (an f32 here, in a step that
+    claims bf16, is a precision-policy bug, not a backend quirk)."""
+    out = {}
+    for op, key in (("stablehlo.dot_general", "dot"),
+                    ("stablehlo.convolution", "conv")):
+        counts = {}
+        for ln in text.splitlines():
+            if op not in ln:
+                continue
+            # result type is the tensor element dtype AFTER the dims
+            # ("tensor<16x32xbf16>" -> "bf16"; rank-0 "tensor<f32>")
+            m = re.search(r"->\s*tensor<[0-9x]*([a-z][a-z0-9]*)>\s*$",
+                          ln.strip())
+            dt = m.group(1) if m else "?"
+            counts[dt] = counts.get(dt, 0) + 1
+        if counts:
+            out[key] = counts
+    return out
+
+
+def dot_conv_from_compiled(text):
+    """{op: {result dtype: count}} for dot/convolution ops in the
+    optimized HLO (post-layout, post-rewrite -- what actually runs)."""
+    out = {}
+    for pat, key in ((r"= ([a-z][a-z0-9]*)\[[^\]]*\][^ ]* dot\(", "dot"),
+                     (r"= ([a-z][a-z0-9]*)\[[^\]]*\][^ ]* convolution\(",
+                      "conv")):
+        counts = {}
+        for m in re.finditer(pat, text):
+            dt = m.group(1)
+            counts[dt] = counts.get(dt, 0) + 1
+        if counts:
+            out[key] = counts
+    return out
+
+
+def collectives_from_lowering(text):
+    counts = {}
+    for op, name in _STABLEHLO_COLLECTIVES.items():
+        # the MLIR printer emits plain ops as `stablehlo.all_reduce ...`
+        # and attribute-carrying ones in generic form as
+        # `"stablehlo.all_reduce"(...` -- accept both spellings, and
+        # require a terminator so all_gather never counts all_to_all
+        n = len(re.findall(re.escape(op) + r'["\s(]', text))
+        if n:
+            counts[name] = counts.get(name, 0) + n
+    return counts
+
+
+def collectives_from_compiled(text):
+    counts = {}
+    for op, name in _HLO_COLLECTIVES.items():
+        n = len(re.findall(r" " + re.escape(op) + r"\(", text))
+        if n:
+            counts[name] = counts.get(name, 0) + n
+    return counts
+
+
+def fusions_from_compiled(text):
+    return len(re.findall(r" fusion\(", text))
+
+
+# --------------------------------------------------------------------- #
+# summaries
+# --------------------------------------------------------------------- #
+
+def _float_dtype(dt):
+    return bool(dt) and (dt.startswith("float") or dt.startswith("bfloat"))
+
+
+def _donation_coverage(entries, donated_idx, min_bytes):
+    """Fold the per-parameter donation bits into per-plane coverage.
+    ``undonated`` lists only float leaves >= ``min_bytes`` -- the
+    planes whose missing donation doubles peak HBM; scalar step
+    counters and bool flags are noise, not leaks."""
+    cov = {}
+    for i, e in enumerate(entries):
+        c = cov.setdefault(e["label"], {
+            "leaves": 0, "bytes": 0, "donated_leaves": 0,
+            "donated_bytes": 0, "undonated": []})
+        c["leaves"] += 1
+        b = e["bytes"] or 0
+        c["bytes"] += b
+        if i in donated_idx:
+            c["donated_leaves"] += 1
+            c["donated_bytes"] += b
+        elif _float_dtype(e["dtype"]) and b >= min_bytes:
+            c["undonated"].append({"path": e["path"], "bytes": b,
+                                   "dtype": e["dtype"]})
+    return cov
+
+
+def lowering_summary(lowered, example_args, arg_labels=None,
+                     min_bytes=2048):
+    """Audit a ``jitted.lower(...)`` result without compiling (the
+    cheap path ``StepTelemetry.attach_cost`` stamps on run headers)."""
+    text = lowered.as_text()
+    entries = arg_entries(example_args, arg_labels)
+    summary = {
+        "source": "lowering",
+        "donation": _donation_coverage(
+            entries, donated_params_from_lowering(text), min_bytes),
+        "dot_conv_dtypes": dot_conv_from_lowering(text),
+        "collectives": collectives_from_lowering(text),
+    }
+    return summary
+
+
+def compiled_summary(compiled, example_args, arg_labels=None,
+                     min_bytes=2048):
+    """Audit an AOT-compiled step (``lowered.compile()``): the
+    authoritative alias table plus post-optimization fusion and
+    collective counts -- what ``tools/hlo_audit.py`` gates on."""
+    text = compiled.as_text()
+    entries = arg_entries(example_args, arg_labels)
+    return {
+        "source": "compiled",
+        "donation": _donation_coverage(
+            entries, aliased_params_from_compiled(text), min_bytes),
+        "dot_conv_dtypes": dot_conv_from_compiled(text),
+        "collectives": collectives_from_compiled(text),
+        "fusions": fusions_from_compiled(text),
+    }
+
+
+def audit_step(jitted, *example_args, arg_labels=None, min_bytes=2048,
+               compile=True):
+    """Lower (and by default compile) a jitted step once and summarize
+    it.  ``compile=False`` gives the lowering-only summary."""
+    lowered = jitted.lower(*example_args)
+    if not compile:
+        return lowering_summary(lowered, example_args, arg_labels,
+                                min_bytes)
+    return compiled_summary(lowered.compile(), example_args, arg_labels,
+                            min_bytes)
+
+
+def format_summary_lines(summary, indent="  "):
+    """Human-readable lines for one audit summary (donation coverage,
+    dot/conv dtypes, collectives) -- THE one text rendering, shared by
+    ``tools/obs_report.py`` and ``tools/hlo_audit.py`` so the two
+    reports cannot drift."""
+    out = []
+    for label, cov in (summary.get("donation") or {}).items():
+        line = (f"{indent}{label:<12} {cov['donated_leaves']}/"
+                f"{cov['leaves']} leaves donated "
+                f"({cov['donated_bytes']:,} / {cov['bytes']:,} bytes)")
+        if cov.get("undonated"):
+            line += "  UNDONATED: " + ", ".join(
+                u["path"] for u in cov["undonated"][:4])
+        out.append(line)
+    for op, counts in (summary.get("dot_conv_dtypes") or {}).items():
+        out.append(f"{indent}{op} dtypes: " + ", ".join(
+            f"{dt} x{n}" for dt, n in sorted(counts.items())))
+    if summary.get("collectives"):
+        out.append(f"{indent}collectives: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(summary["collectives"].items())))
+    if "fusions" in summary:
+        out.append(f"{indent}fusions: {summary['fusions']}")
+    return out
+
+
+def undonated_planes(summary, expected=("params", "opt_state")):
+    """The gate predicate: ``[(label, [undonated leaf dicts])]`` for
+    every expected-donated plane that has a large float leaf without an
+    input/output alias (or donation marker).  Empty list = gate
+    passes."""
+    bad = []
+    for label in expected:
+        cov = summary["donation"].get(label)
+        if cov is None:
+            bad.append((label, [{"path": label, "bytes": None,
+                                 "dtype": None,
+                                 "error": "plane not in audit"}]))
+        elif cov["undonated"]:
+            bad.append((label, cov["undonated"]))
+    return bad

@@ -21,6 +21,67 @@ def _last_json(stdout):
     return json.loads(lines[-1])
 
 
+class TestBenchLMContract:
+    """ISSUE-7 pin: the BENCH_LM record carries a ``trust`` verdict,
+    per-leg ``compile_s``, and the remat-policy leg labels; every
+    published number derives from blocked-p50 and a non-trusted (CPU)
+    record is forced to ``vs_baseline: 0`` (PR 6's contract)."""
+
+    def test_lm_record_contract(self, capsys):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_t_bench", BENCH)
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        # compile probe off: the tier-1 pin covers the record contract;
+        # the probe itself is pinned by the slow acceptance test below
+        rec = bench.run_lm_bench(size="tiny", steps=2, batch=2, seq=16,
+                                 vocab=64, compile_size="off")
+        out = capsys.readouterr().out
+        assert json.loads(out.strip().splitlines()[-1]) == rec  # strict
+        assert rec["metric"] == "transformer_lm_tokens_per_sec_per_chip"
+        assert "trust" in rec
+        legs = rec["extra"]["legs"]
+        # the A/B matrix: unrolled vs scan, remat-policy legs, flash off
+        assert {"unrolled", "scan", "scan:nothing_saveable",
+                "scan:dots_saveable", "scan:no_flash"} <= set(legs)
+        for leg in legs.values():
+            assert leg["compile_s"] > 0
+            assert leg["sec_per_step_blocked"] > 0
+            assert leg["trust"]
+            # blocked-p50 is the one published basis
+            assert leg["timing_audit"]["published"]["basis"] \
+                == "step_blocked_s"
+        assert rec["extra"]["scan_loss_matches_unrolled"] is True
+        assert rec["extra"]["scan_compile_speedup"] > 0
+        # this suite runs on CPU: the verdict must be honestly off-TPU
+        # and the record cannot claim the baseline
+        if rec["extra"]["platform"] != "tpu":
+            assert rec["trust"] == "invalid:off_tpu"
+            assert rec["vs_baseline"] == 0.0
+
+
+@pytest.mark.slow
+class TestScanCompileAcceptance:
+    def test_medium_scan_compile_speedup(self):
+        """ISSUE-7 acceptance: transformer_lm('medium') jit-compile wall
+        time with scan_layers=True is >= 3x lower than unrolled on the
+        same host (measured 21.9x on the dev box; 3x is the floor under
+        CI noise).  Abstract-aval lowering only -- no params
+        materialize -- and the compilation cache is disabled around the
+        probe, so the ratio cannot be faked by a warm cache."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_t_bench2", BENCH)
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        probe = bench._lm_compile_probe("medium", 32000, 64, 1)
+        assert probe["compile_speedup"] >= 3.0, probe
+        assert probe["unrolled_compile_s"] > 0
+        assert probe["scan_compile_s"] > 0
+        assert probe["cache_disabled"] is True
+
+
 @pytest.mark.slow
 class TestBenchContract:
     def test_budget_bounds_dead_tunnel(self):

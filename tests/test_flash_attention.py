@@ -42,6 +42,65 @@ class TestFlashAttention:
                                    rtol=1e-5, atol=1e-5)
 
 
+class TestFlashBlockAlignment:
+    """ISSUE-7 satellite: 'auto' mode must accept block-alignable SHORT
+    sequences (the kernel's call site handles block_q = t for t < 128);
+    the old ``t % 128`` test rejected all of them."""
+
+    def test_short_sequences_block_alignable(self):
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+
+        ok = MultiHeadAttention._flash_block_ok
+        # sublane-aligned short sequences are flash-able now
+        assert ok(8) and ok(24) and ok(64) and ok(120)
+        # unaligned short sequences are not
+        assert not ok(7) and not ok(20) and not ok(127)
+        # long sequences still need exact 128-tiling
+        assert ok(128) and ok(256) and ok(1024)
+        assert not ok(129) and not ok(192)
+
+    def test_auto_routes_through_block_check(self, monkeypatch):
+        """_flash_ok('auto') accepts an aligned short T wherever the
+        platform check passes -- pin the predicate chain by faking the
+        platform probe."""
+        import bigdl_tpu.nn.attention as attention
+
+        mha = attention.MultiHeadAttention(32, 4, causal=True,
+                                           use_flash="auto")
+
+        class _Dev:
+            platform = "tpu"
+
+        monkeypatch.setattr(attention.jax, "devices", lambda: [_Dev()])
+        assert mha._flash_ok(24)
+        assert mha._flash_ok(256)
+        assert not mha._flash_ok(20)
+
+    def test_short_seq_flash_matches_plain_interpret(self):
+        """Numerical agreement at a short, previously-rejected T (the
+        wiring the TPU auto mode now takes), kernel in interpret mode."""
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+        from bigdl_tpu.utils.random_generator import RNG
+
+        t = 24                      # < 128, t % 8 == 0, t % 128 != 0
+        RNG.set_seed(0)
+        plain = MultiHeadAttention(32, 4, causal=True, use_flash="never")
+        plain.build(jax.ShapeDtypeStruct((2, t, 32), jnp.float32))
+        RNG.set_seed(0)
+        flash = MultiHeadAttention(32, 4, causal=True,
+                                   use_flash="interpret")
+        flash.build(jax.ShapeDtypeStruct((2, t, 32), jnp.float32))
+        x = jnp.asarray(
+            np.random.default_rng(1).standard_normal((2, t, 32)),
+            jnp.float32)
+        np.testing.assert_allclose(np.asarray(flash.forward(x)),
+                                   np.asarray(plain.forward(x)),
+                                   rtol=2e-5, atol=2e-5)
+
+
 class TestMHAFlashWiring:
     def test_mha_flash_matches_plain(self):
         """MultiHeadAttention(use_flash='interpret') must match the plain

@@ -1,0 +1,317 @@
+"""Scan-compiled transformer blocks (nn.ScanLayers) and remat-policy
+plumbing (ISSUE 7).
+
+The contract under test: a ``scan_layers=True`` TransformerLM is the
+SAME model as the unrolled one -- bit-identical init from one seed,
+loss stream and per-layer grad norms matching over multiple optimizer
+steps, checkpoints interconvertible through both save paths -- with the
+block body compiled once instead of N times.
+"""
+
+import math
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.attention import (TransformerLM, stack_block_params,
+                                    unstack_block_params)
+from bigdl_tpu.nn.containers import (ScanLayers, checkpoint_policy_names,
+                                     resolve_checkpoint_policy,
+                                     stack_layer_trees, unstack_layer_trees)
+from bigdl_tpu.utils.random_generator import RNG
+
+TINY = dict(vocab=37, hidden=32, heads=2, layers=3, seq=12, batch=4)
+
+
+def _model(scan, policy=None, seed=0):
+    RNG.set_seed(seed)
+    m = TransformerLM(TINY["vocab"], TINY["hidden"], TINY["heads"],
+                      TINY["layers"], max_len=TINY["seq"],
+                      scan_layers=scan, remat_policy=policy)
+    m.build(jax.ShapeDtypeStruct((TINY["batch"], TINY["seq"]), jnp.int32))
+    return m
+
+
+def _data(n_batches=6, seed=0):
+    r = np.random.default_rng(seed)
+    return [jnp.asarray(r.integers(0, TINY["vocab"],
+                                   (TINY["batch"], TINY["seq"])), jnp.int32)
+            for _ in range(n_batches * 2)]
+
+
+def _block_grad_norms(grads, scan):
+    """Per-block gradient L2 norms, in layer order, for either layout."""
+    g = unstack_block_params(grads) if scan else grads
+    out = []
+    for i in range(TINY["layers"]):
+        out.append(math.sqrt(sum(
+            float((l ** 2).sum())
+            for l in jax.tree.leaves(g[f"block{i}"]))))
+    return out
+
+
+_TRAIN_CACHE = {}
+
+
+def _train_cached(scan, policy=None, steps=6):
+    """Memoized (losses, norms) per (scan, policy, steps): the baseline
+    legs are shared across tests instead of recompiled per test."""
+    key = (scan, policy, steps)
+    if key not in _TRAIN_CACHE:
+        _TRAIN_CACHE[key] = _train(_model(scan=scan, policy=policy),
+                                   scan=scan, steps=steps)
+    return _TRAIN_CACHE[key]
+
+
+def _train(model, scan, steps=6, policy_rng_seed=3):
+    """``steps`` Adam steps; returns (losses, per-step block grad
+    norms).  Grads come from the same loss the update consumes."""
+    from bigdl_tpu import optim
+
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    method = optim.Adam(learning_rate=1e-3)
+    params = model.parameters()[0]
+    opt_state = method.init_state(params)
+    data = _data()
+
+    def loss_fn(p, x, y):
+        logits, _ = model.apply(p, (), x, training=True,
+                                rng=jax.random.key(policy_rng_seed))
+        return crit.apply(jax.nn.log_softmax(logits, -1), y)
+
+    vg = jax.jit(jax.value_and_grad(loss_fn))
+    update = jax.jit(method.update)
+    losses, norms = [], []
+    for s in range(steps):
+        x, y = data[2 * s], data[2 * s + 1] % TINY["vocab"]
+        loss, grads = vg(params, x, y)
+        losses.append(float(loss))
+        norms.append(_block_grad_norms(grads, scan))
+        params, opt_state = update(grads, opt_state, params)
+    return losses, norms
+
+
+class TestScanLayersUnit:
+    def test_stack_unstack_round_trip(self):
+        trees = [{"w": jnp.full((2, 3), i, jnp.float32),
+                  "b": jnp.full((3,), i, jnp.float32)} for i in range(4)]
+        stacked = stack_layer_trees(trees)
+        assert stacked["w"].shape == (4, 2, 3)
+        back = unstack_layer_trees(stacked)
+        for a, b in zip(trees, back):
+            assert np.array_equal(a["w"], b["w"])
+            assert np.array_equal(a["b"], b["b"])
+
+    def test_structurally_different_children_rejected(self):
+        s = ScanLayers([nn.Linear(8, 8), nn.Linear(8, 4)])
+        with pytest.raises(ValueError, match="structurally identical"):
+            s.setup(jax.random.key(0),
+                    jax.ShapeDtypeStruct((2, 8), jnp.float32))
+
+    def test_scan_matches_unrolled_sequential(self):
+        """Standalone ScanLayers == applying the children in sequence."""
+        RNG.set_seed(0)
+        layers = [nn.Linear(8, 8) for _ in range(3)]
+        s = ScanLayers(layers)
+        spec = jax.ShapeDtypeStruct((2, 8), jnp.float32)
+        params, state = s.setup(jax.random.key(1), spec)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 8)),
+                        jnp.float32)
+        y_scan, _ = s.apply(params, state, x, training=True)
+        y_ref = x
+        for i, p in enumerate(unstack_layer_trees(params)):
+            y_ref, _ = layers[0].apply(p, (), y_ref, training=True)
+        np.testing.assert_allclose(np.asarray(y_scan), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_unknown_policy_fails_fast_with_valid_list(self):
+        with pytest.raises(ValueError, match="dots_saveable"):
+            ScanLayers([nn.Linear(4, 4)], policy="bogus")
+        with pytest.raises(ValueError, match="valid"):
+            nn.Remat(nn.Linear(4, 4), policy="not_a_policy")
+        assert "nothing_saveable" in checkpoint_policy_names()
+        # a callable and None pass through
+        assert resolve_checkpoint_policy(None) is None
+        fn = jax.checkpoint_policies.dots_saveable
+        assert resolve_checkpoint_policy(fn) is fn
+        assert resolve_checkpoint_policy("dots_saveable") is fn
+
+    def test_policy_factories_rejected_by_name(self):
+        """Factory entries (save_only_these_names & friends) take args a
+        name cannot carry; resolved directly they'd silently save
+        everything (remat off).  They must be rejected as names and
+        excluded from the advertised list; a CONSTRUCTED factory policy
+        still passes as a callable."""
+        for name in ("save_only_these_names", "save_from_both_policies",
+                     "save_any_names_but_these"):
+            assert name not in checkpoint_policy_names()
+            with pytest.raises(ValueError, match="FACTORY"):
+                resolve_checkpoint_policy(name)
+        built = jax.checkpoint_policies.save_only_these_names("x")
+        assert resolve_checkpoint_policy(built) is built
+
+
+class TestScanVsUnrolled:
+    def test_init_bit_identical(self):
+        u = _model(scan=False)
+        s = _model(scan=True)
+        conv = stack_block_params(u.parameters()[0])
+        for a, b in zip(jax.tree.leaves(conv),
+                        jax.tree.leaves(s.parameters()[0])):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_losses_and_grad_norms_agree_over_steps(self):
+        """ISSUE-7 acceptance: same init -> losses and per-layer grad
+        norms agree to tolerance over >= 5 optimizer steps."""
+        lu, nu = _train_cached(scan=False)
+        ls, ns = _train_cached(scan=True)
+        assert len(lu) >= 5
+        np.testing.assert_allclose(lu, ls, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(nu), np.asarray(ns),
+                                   rtol=1e-3, atol=1e-6)
+
+    @pytest.mark.parametrize("policy", ["nothing_saveable",
+                                        "dots_saveable"])
+    def test_remat_policies_change_nothing_numerically(self, policy):
+        base_losses, _ = _train_cached(scan=True)
+        pol_losses, _ = _train_cached(scan=True, policy=policy)
+        np.testing.assert_allclose(base_losses, pol_losses,
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.slow
+    def test_unrolled_remat_policy_matches_plain(self):
+        plain, _ = _train_cached(scan=False)
+        remat, _ = _train_cached(scan=False, policy="dots_saveable")
+        np.testing.assert_allclose(plain, remat, rtol=1e-4, atol=1e-5)
+
+
+class TestCheckpointRoundTrip:
+    """Stacked <-> unrolled checkpoints interconvert through BOTH save
+    paths: the protobuf module format (save_module/load_module) and the
+    flat-npz weight format (save_weights/load_weights)."""
+
+    def _fwd(self, model, params):
+        x = jnp.asarray(np.random.default_rng(5).integers(
+            0, TINY["vocab"], (TINY["batch"], TINY["seq"])), jnp.int32)
+        y, _ = model.apply(params, (), x)
+        return np.asarray(y)
+
+    def test_module_format_both_directions(self):
+        from bigdl_tpu.utils import serializer
+
+        u, s = _model(scan=False, seed=0), _model(scan=True, seed=1)
+        with tempfile.TemporaryDirectory() as td:
+            # unrolled checkpoint -> scan model
+            pu = os.path.join(td, "u.bigdl")
+            serializer.save_module(u, pu)
+            loaded = serializer.load_module(pu)
+            assert not loaded.scan_layers
+            s._params = stack_block_params(loaded._params)
+            np.testing.assert_allclose(
+                self._fwd(s, s._params), self._fwd(u, u._params),
+                rtol=1e-5, atol=1e-6)
+            # scan checkpoint -> unrolled model (and scan_layers + the
+            # remat policy survive the round trip)
+            s2 = _model(scan=True, policy="dots_saveable", seed=2)
+            ps = os.path.join(td, "s.bigdl")
+            serializer.save_module(s2, ps)
+            loaded2 = serializer.load_module(ps)
+            assert loaded2.scan_layers
+            assert loaded2.remat_policy == "dots_saveable"
+            u2 = _model(scan=False, seed=3)
+            u2._params = unstack_block_params(loaded2._params)
+            np.testing.assert_allclose(
+                self._fwd(u2, u2._params), self._fwd(s2, s2._params),
+                rtol=1e-5, atol=1e-6)
+
+    def test_npz_weights_both_directions(self):
+        from bigdl_tpu.utils import serializer
+
+        u, s = _model(scan=False, seed=0), _model(scan=True, seed=1)
+        with tempfile.TemporaryDirectory() as td:
+            wu = os.path.join(td, "u.npz")
+            serializer.save_weights(u, wu)
+            u_fresh = _model(scan=False, seed=9)
+            serializer.load_weights(u_fresh, wu)
+            s._params = stack_block_params(u_fresh._params)
+            np.testing.assert_allclose(
+                self._fwd(s, s._params), self._fwd(u, u._params),
+                rtol=1e-5, atol=1e-6)
+            ws = os.path.join(td, "s.npz")
+            serializer.save_weights(s, ws)
+            s_fresh = _model(scan=True, seed=11)
+            serializer.load_weights(s_fresh, ws)
+            u.set_parameters(unstack_block_params(s_fresh._params))
+            np.testing.assert_allclose(
+                self._fwd(u, u._params), self._fwd(s, s._params),
+                rtol=1e-5, atol=1e-6)
+
+    def test_converter_errors(self):
+        u = _model(scan=False)
+        with pytest.raises(ValueError, match="blocks"):
+            unstack_block_params(u.parameters()[0])
+        s = _model(scan=True)
+        with pytest.raises(ValueError, match="block"):
+            stack_block_params(s.parameters()[0])
+
+
+class TestPlumbing:
+    def test_transformer_lm_auto_scan(self):
+        from bigdl_tpu.models.transformer import transformer_lm
+
+        assert transformer_lm("medium").scan_layers
+        assert transformer_lm("large").scan_layers
+        assert not transformer_lm("tiny").scan_layers
+        assert not transformer_lm("small").scan_layers
+        # sequence-parallel models stay unrolled under auto
+        assert not transformer_lm("medium",
+                                  seq_axis_name="seq").scan_layers
+        assert transformer_lm("tiny", scan_layers=True).scan_layers
+        m = transformer_lm("tiny", remat_policy="dots_saveable")
+        assert m.remat_policy == "dots_saveable"
+
+    def test_resnet_remat_policy(self):
+        from bigdl_tpu.models.resnet import ResNet
+
+        with pytest.raises(ValueError, match="dots_saveable"):
+            ResNet(depth=18, remat_policy="bogus")
+        m = ResNet(depth=18, remat_policy="dots_saveable")
+        remats = [c for c in m.children() if isinstance(c, nn.Remat)]
+        assert remats, "remat_policy must imply block remat wrappers"
+        assert all(r.policy == "dots_saveable" for r in remats)
+
+    def test_run_cli_rejects_unknown_policy_fast(self):
+        from bigdl_tpu.models import run as run_mod
+
+        with pytest.raises(ValueError, match="dots_saveable"):
+            run_mod.main(["transformer-train", "--synthN", "8",
+                          "--vocab", "16", "--seq-len", "8", "-b", "4",
+                          "--maxIteration", "1",
+                          "--rematPolicy", "bogus"])
+
+    def test_run_cli_rejects_scan_with_pp(self):
+        from bigdl_tpu.models import run as run_mod
+
+        with pytest.raises(ValueError, match="scanLayers"):
+            run_mod.main(["transformer-train", "--synthN", "8",
+                          "--vocab", "16", "--seq-len", "8", "-b", "4",
+                          "--pp", "2", "--scanLayers", "on",
+                          "--maxIteration", "1"])
+
+    def test_run_cli_rejects_remat_policy_with_pp(self):
+        """The pp engine drives blocks directly (parallel/pp.py) and
+        never runs the model's remat wrapper -- silently accepting the
+        flag would 'apply' a policy that changes nothing."""
+        from bigdl_tpu.models import run as run_mod
+
+        with pytest.raises(ValueError, match="no effect under --pp"):
+            run_mod.main(["transformer-train", "--synthN", "8",
+                          "--vocab", "16", "--seq-len", "8", "-b", "4",
+                          "--pp", "2", "--rematPolicy", "dots_saveable",
+                          "--maxIteration", "1"])

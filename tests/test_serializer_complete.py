@@ -234,6 +234,16 @@ EXAMPLES = {
                  lambda: (_r(2, 4), _r(2, 4))),
     "Remat": (lambda: nn.Remat(nn.Linear(4, 3), policy="dots_saveable"),
               lambda: _r(2, 4)),
+    "ScanLayers": (lambda: nn.ScanLayers(
+        [nn.Linear(4, 4), nn.Linear(4, 4)], policy="nothing_saveable"),
+        lambda: _r(2, 4)),
+    "MultiHeadAttention": (lambda: nn.MultiHeadAttention(8, 2, causal=True),
+                           lambda: _r(2, 5, 8)),
+    "TransformerBlock": (lambda: nn.TransformerBlock(8, 2),
+                         lambda: _r(2, 5, 8)),
+    "TransformerLM": (lambda: nn.TransformerLM(11, 8, 2, 2, max_len=6),
+                      lambda: np.arange(8, dtype=np.int32).reshape(2, 4)
+                      % 11),
     "SpaceToDepthStem": (lambda: nn.SpaceToDepthStem(
         3, 8, 7, weight_init=__import__(
             "bigdl_tpu.nn.initialization", fromlist=["MsraFiller"]

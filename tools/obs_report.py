@@ -61,6 +61,15 @@ _profiling = importlib.util.module_from_spec(_pspec)
 _pspec.loader.exec_module(_profiling)
 percentile = _profiling.percentile
 
+# and for utils/hlo.py (pure text->dict parsers, no jax at module top):
+# its format_summary_lines is THE one compiled-step text rendering,
+# shared with tools/hlo_audit.py
+_hspec = importlib.util.spec_from_file_location(
+    "_obs_hlo", os.path.join(REPO, "bigdl_tpu", "utils", "hlo.py"))
+_hlo = importlib.util.module_from_spec(_hspec)
+_hspec.loader.exec_module(_hlo)
+format_hlo_summary_lines = _hlo.format_summary_lines
+
 
 def load_events(jsonl_path):
     """-> (header dict or None, [step events], [other events]).
@@ -386,6 +395,18 @@ def build_report(run_dir, xplane_dir=None, top=10):
                   for e in steps if e.get("memory_growth")]
         rep["watchdogs"] = {"recompile_steps": recompiles,
                             "memory_growth": growth}
+    # compiled-step audit (attach_cost's lowering-text summary, stamped
+    # on the header -- or on a later standalone "cost" event when
+    # attach_cost ran after the lazy header write): donation coverage,
+    # dot/conv dtypes, collective counts (docs/observability.md,
+    # "Compiled step audit")
+    compiled_step = (header or {}).get("compiled_step")
+    for ev in other:
+        if ev.get("kind") == "cost" and ev.get("compiled_step"):
+            compiled_step = ev["compiled_step"]
+    if compiled_step:
+        rep["compiled_step"] = compiled_step
+
     validations = [e for e in other if e.get("kind") == "validation"]
     if validations:
         rep["validations"] = validations
@@ -495,6 +516,10 @@ def format_report(rep):
                 out.append(f"  {op['pct']:>6.2f}%  {op['sec']:.6f}s  "
                            f"x{op['count']:<4} [{op['flavor']:<10}] "
                            f"{op['name'][:70]}")
+    cs = rep.get("compiled_step")
+    if cs:
+        out.append(f"compiled step ({cs.get('source', '?')} audit):")
+        out.extend(format_hlo_summary_lines(cs))
     hl = rep.get("health")
     if hl:
         def _g(v):
