@@ -29,6 +29,24 @@ class AbstractDataSet:
     def shuffle(self):
         pass
 
+    def position_state(self):
+        """Serializable shuffle/order state for mid-epoch checkpoint
+        resume (docs/robustness.md): everything needed so that a fresh
+        ``data(train=True)`` iterator replays THIS epoch's element
+        order, and future ``shuffle()`` calls continue the same
+        shuffle-RNG stream.  ``None`` (the default) marks a source that
+        cannot restore its position -- resume then restarts the epoch
+        from the top with a warning instead of bit-matching the
+        uninterrupted run."""
+        return None
+
+    def restore_position(self, state):
+        """Restore a ``position_state()`` snapshot.  Only called with a
+        state this class (or its base) produced."""
+        raise NotImplementedError(
+            f"{type(self).__name__} produced no position_state to "
+            "restore")
+
     def transform(self, transformer: Transformer) -> "TransformedDataSet":
         return TransformedDataSet(self, transformer)
 
@@ -72,6 +90,24 @@ class LocalDataSet(AbstractDataSet):
             return gen()
         return (self._data[i] for i in range(len(self._data)))
 
+    def position_state(self):
+        """Current epoch permutation + the shuffle RNG stream position:
+        restoring both makes a fresh iterator replay this epoch's order
+        AND keeps every future reshuffle identical to the uninterrupted
+        run's."""
+        return {"kind": "local", "index": np.asarray(self._index).copy(),
+                "rng_state": self._rng.bit_generator.state}
+
+    def restore_position(self, state):
+        if state.get("kind") != "local" or \
+                len(state["index"]) != len(self._data):
+            raise ValueError(
+                f"dataset position state does not match this dataset "
+                f"({len(state.get('index', ()))} indexed elements vs "
+                f"{len(self._data)} held)")
+        self._index = np.asarray(state["index"]).copy()
+        self._rng.bit_generator.state = state["rng_state"]
+
 
 class TransformedDataSet(AbstractDataSet):
     def __init__(self, base: AbstractDataSet, transformer: Transformer):
@@ -86,6 +122,12 @@ class TransformedDataSet(AbstractDataSet):
 
     def data(self, train: bool):
         return self.transformer.apply(self.base.data(train))
+
+    def position_state(self):
+        return self.base.position_state()
+
+    def restore_position(self, state):
+        self.base.restore_position(state)
 
 
 class DistributedDataSet(LocalDataSet):

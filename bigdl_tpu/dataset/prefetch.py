@@ -369,6 +369,20 @@ class PrefetchDataSet(AbstractDataSet):
             self._live.close()
             self._live = None
 
+    def position_state(self):
+        """Delegates to the source: the pipeline itself holds no order
+        state -- workers fan out but the reorder stage + serial suffix
+        (SampleToMiniBatch) keep the BATCH stream identical to the
+        synchronous path, so "k batches consumed" pins the same source
+        position either way (docs/robustness.md, mid-epoch resume)."""
+        return self.base.position_state()
+
+    def restore_position(self, state):
+        # retire in-flight workers first: buffered elements belong to
+        # the pre-restore order
+        self.shutdown()
+        self.base.restore_position(state)
+
     def queue_stats(self) -> Optional[Tuple[int, int]]:
         """``(occupancy, capacity)`` of the live output queue, or None
         when no asynchronous stream is active.  The driver loop samples

@@ -46,8 +46,10 @@ SCHEMA_VERSION = 1
 #: event kinds that must survive a crash on the NEXT line: flushed AND
 #: fsynced to disk the moment they are recorded (a run that blows up
 #: right after a health anomaly must leave the evidence on disk; a
-#: timing-audit verdict is the line a perf claim stands on)
-DURABLE_KINDS = frozenset({"health", "anomaly", "timing_audit"})
+#: timing-audit verdict is the line a perf claim stands on; a recovery
+#: event is the record of a restart whose successor may itself die)
+DURABLE_KINDS = frozenset({"health", "anomaly", "timing_audit",
+                           "recovery"})
 
 log = logging.getLogger("bigdl_tpu.observability")
 

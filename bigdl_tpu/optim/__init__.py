@@ -23,4 +23,6 @@ from bigdl_tpu.optim.distri_optimizer import (
     DistriOptimizer, ParallelOptimizer, make_distri_train_step,
 )
 from bigdl_tpu.optim.strategy_optimizer import StrategyOptimizer
+from bigdl_tpu.optim.recovery import (ChaosKillTrigger, RunSupervisor,
+                                      parse_chaos)
 from bigdl_tpu.optim.predictor import Predictor, PredictionService, evaluate

@@ -39,7 +39,8 @@ from bigdl_tpu.ops.quantization import (CompressionSpec,
 from bigdl_tpu.optim.local_optimizer import BaseOptimizer, validate
 from bigdl_tpu.optim.optim_method import clip_by_value
 from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
-from bigdl_tpu.parallel.zero import FlatParamSpace
+from bigdl_tpu.parallel.zero import (FlatParamSpace, refit_flat_plane,
+                                     repartition_ef_residual)
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.random_generator import RNG
@@ -356,7 +357,7 @@ class DistriOptimizer(BaseOptimizer):
     _supports_sharded_checkpoint = True
 
     def _sharded_save(self, neval, params_flat, mstate, opt_state, state,
-                      ef_state=None):
+                      ef_state=None, layout=None):
         import orbax.checkpoint as ocp
 
         d = file_io.join(self.sharded_checkpoint_path, f"snap_{neval}")
@@ -367,9 +368,33 @@ class DistriOptimizer(BaseOptimizer):
             # state: dropping it on resume would replay the accumulated
             # quantization error into the wire uncompensated
             payload["ef_residual"] = ef_state
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(d, payload, force=True)
-        file_io.save(dict(state), d + ".driver")
+        # crash-safe commit protocol (docs/robustness.md) shared with
+        # the Strategy saver: file_io.write_sharded_snapshot.  The
+        # manifest additionally carries the flat-plane LAYOUT the N->M
+        # resume reads.
+        def save_dir(path):
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(path, payload, force=True)
+
+        file_io.write_sharded_snapshot(
+            d, save_dir, state,
+            manifest_meta={"layout": layout} if layout else None,
+            direct=(file_io.is_remote(self.sharded_checkpoint_path)
+                    or jax.process_count() > 1),
+            write_manifest=jax.process_index() == 0)
+
+    def _sharded_layout_mismatch(self, flat_space, n_dev):
+        """True when the pending sharded snapshot's manifest records a
+        flat-plane layout (padded size / chunk count) differing from
+        the live one -- the N->M restart path.  Manifest-less legacy
+        snapshots answer False and take the strict same-layout path."""
+        layout = (file_io.read_manifest(self._resume_sharded)
+                  or {}).get("layout")
+        if not layout:
+            return False
+        return (int(layout.get("padded_size", flat_space.padded_size))
+                != flat_space.padded_size
+                or int(layout.get("num_chunks", n_dev)) != n_dev)
 
     def _shard_batch(self, batch, sharding):
         # the staging path is shared with the sharded serving engine
@@ -459,42 +484,123 @@ class DistriOptimizer(BaseOptimizer):
                                   jnp.float32),
                 out_shardings=vec_sharding)()
 
+        def refit(a, old_padded):
+            # an N->M device-count restart, or a compression-spec change,
+            # changes the CHUNK ROUNDING of the flat plane; the layouts
+            # differ only in trailing padding (never read by the model
+            # math), so flat-plane leaves resize by zero-pad /
+            # tail-truncate (parallel/zero.refit_flat_plane).  Leaves
+            # that are not flat planes (scalar counters) pass through.
+            a = jnp.asarray(a)
+            if a.ndim >= 1 and a.shape[-1] == old_padded:
+                return refit_flat_plane(a, flat_space.padded_size,
+                                        flat_space.true_size)
+            return a
+
+        def restore_ef(ef_saved):
+            # same device count: each row is still that device's own
+            # accumulated error -- trailing pad/truncate is exact.
+            # Different count: re-partition the summed residual by
+            # global flat offset so no accumulated correction is
+            # dropped (parallel/zero.repartition_ef_residual).
+            ef_np = np.asarray(ef_saved)
+            if ef_np.shape == (n_dev, flat_space.padded_size):
+                return jax.device_put(jnp.asarray(ef_np), vec_sharding)
+            if ef_np.shape[0] == n_dev:
+                return jax.device_put(
+                    refit_flat_plane(ef_np, flat_space.padded_size,
+                                     flat_space.true_size), vec_sharding)
+            log.info(
+                "re-partitioning the EF residual plane %s -> (%d, %d) "
+                "for the new device count", ef_np.shape, n_dev,
+                flat_space.padded_size)
+            return jax.device_put(
+                jnp.asarray(repartition_ef_residual(
+                    ef_np, flat_space.true_size, n_dev,
+                    flat_space.padded_size)), vec_sharding)
+
         if getattr(self, "_resume", None):
             snap = self._resume
             # save_checkpoint nests the 3rd argument under "model_params"
             old_padded = int(np.shape(
                 snap["model_params"]["model_params_flat"])[0])
-
-            def refit(a):
-                # a compression-spec change can change the BLOCK
-                # ROUNDING of the flat plane; the layouts differ only
-                # in padding (never read by the model math), so flat-
-                # plane leaves resize by zero-pad / tail-truncate
-                a = jnp.asarray(a)
-                if a.ndim >= 1 and a.shape[-1] == old_padded \
-                        and old_padded != flat_space.padded_size:
-                    if old_padded > flat_space.padded_size:
-                        return a[..., :flat_space.padded_size]
-                    pad = [(0, 0)] * (a.ndim - 1) + \
-                        [(0, flat_space.padded_size - old_padded)]
-                    return jnp.pad(a, pad)
-                return a
-
-            params_flat = refit(snap["model_params"]["model_params_flat"])
+            params_flat = refit(snap["model_params"]["model_params_flat"],
+                                old_padded)
             mstate = jax.tree.map(jnp.asarray, snap["model_state"])
             opt_state = jax.tree.map(
-                lambda l, s: jax.device_put(refit(l), s),
+                lambda l, s: jax.device_put(refit(l, old_padded), s),
                 snap["opt_state"], opt_shardings)
             if use_ef:
                 if "ef_residual" in snap["model_params"]:
-                    ef_state = jax.device_put(
-                        refit(snap["model_params"]["ef_residual"]),
-                        vec_sharding)
+                    ef_state = restore_ef(
+                        snap["model_params"]["ef_residual"])
                 else:
                     log.warning(
                         "checkpoint snapshot has no ef_residual plane; "
                         "starting error feedback from a zero residual")
             self._apply_driver_state(snap["driver_state"])
+
+        if getattr(self, "_resume_sharded", None) and \
+                self._sharded_layout_mismatch(flat_space, n_dev):
+            # N->M data-parallel restart (docs/robustness.md): the
+            # snapshot was written under a DIFFERENT chunk layout
+            # (device count and/or block rounding).  Restore every
+            # flat-plane leaf under the SNAPSHOT's own shapes,
+            # replicated on the new mesh -- no cross-layout resharding
+            # for orbax/jax to be strict about -- then re-chunk on host:
+            # trailing-pad/truncate for params + optimizer planes,
+            # offset-preserving re-partition for the EF residual.
+            import orbax.checkpoint as ocp
+
+            d = self._resume_sharded
+            layout = (file_io.read_manifest(d) or {})["layout"]
+            old_padded = int(layout["padded_size"])
+
+            def sds(shape, dtype):
+                return jax.ShapeDtypeStruct(tuple(shape), dtype,
+                                            sharding=rep_sharding)
+
+            abstract = {
+                "params_flat": sds((old_padded,),
+                                   jnp.asarray(params_flat).dtype),
+                "mstate": jax.tree.map(
+                    lambda l: sds(l.shape, l.dtype), mstate),
+                "opt_state": jax.tree.map(
+                    lambda l: sds((old_padded,) if l.ndim >= 1
+                                  else l.shape, l.dtype), opt_state_eval),
+            }
+            ef_shape = layout.get("ef_shape")
+            if ef_shape:
+                abstract["ef_residual"] = sds(ef_shape, jnp.float32)
+            with ocp.StandardCheckpointer() as ckptr:
+                restored = ckptr.restore(d, abstract)
+            params_flat = refit(restored["params_flat"], old_padded)
+            mstate = restored["mstate"]
+            opt_state = jax.tree.map(
+                lambda l, s: jax.device_put(refit(l, old_padded), s),
+                restored["opt_state"], opt_shardings)
+            if use_ef:
+                if ef_shape:
+                    ef_state = restore_ef(restored["ef_residual"])
+                else:
+                    log.warning(
+                        "sharded snapshot %s has no ef_residual plane; "
+                        "starting error feedback from a zero residual", d)
+            elif ef_shape:
+                log.warning(
+                    "sharded snapshot %s carries an ef_residual plane "
+                    "the current grad_compression does not use; "
+                    "discarding it (error feedback restarts from zero "
+                    "if re-enabled later)", d)
+            log.info(
+                "re-chunked sharded snapshot %s: padded %d -> %d, "
+                "%s -> %d device chunks", d, old_padded,
+                flat_space.padded_size, layout.get("num_chunks", "?"),
+                n_dev)
+            self._apply_driver_state(file_io.load(d + ".driver"))
+            # consumed: a later failure-retry must re-resolve the LATEST
+            # snapshot, not replay this one
+            self._resume_sharded = None
 
         if getattr(self, "_resume_sharded", None):
             import orbax.checkpoint as ocp
@@ -575,6 +681,8 @@ class DistriOptimizer(BaseOptimizer):
             # snapshot, not replay this one
             self._resume_sharded = None
 
+        train_iter, first_batch = self._resume_data_stream(
+            train_iter, first_batch)
         params_flat = jax.device_put(params_flat, rep_sharding)
 
         mon = self.health_monitor
@@ -670,17 +778,31 @@ class DistriOptimizer(BaseOptimizer):
             nonlocal opt_state
             opt_state = self._feed_plateau(state, opt_state)
 
+        #: the flat-plane layout this run writes snapshots under --
+        #: stamped into every snapshot manifest so a restart on a
+        #: DIFFERENT device count can re-chunk instead of refusing
+        layout_meta = {
+            "padded_size": flat_space.padded_size,
+            "true_size": flat_space.true_size,
+            "num_chunks": n_dev,
+            "block_size": flat_space.block_size,
+            "ef_shape": ([n_dev, flat_space.padded_size]
+                         if use_ef else None),
+        }
+
         def checkpoint_cb(state):
             if getattr(self, "sharded_checkpoint_path", None):
                 self._sharded_save(state["neval"], params_flat, mstate,
-                                   opt_state, state, ef_state=ef_state)
+                                   opt_state, state, ef_state=ef_state,
+                                   layout=layout_meta)
             else:
                 pdict = {"model_params_flat": params_flat}
                 if use_ef:
                     pdict["ef_residual"] = ef_state
                 file_io.save_checkpoint(
                     self.checkpoint_path, state["neval"], pdict, mstate,
-                    opt_state, state)
+                    opt_state, state,
+                    manifest_meta={"layout": layout_meta})
 
         def health_cb():
             raw = jax.device_get(stats_holder[0])

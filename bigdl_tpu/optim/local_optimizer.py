@@ -24,7 +24,8 @@ from bigdl_tpu.optim.train_step import make_train_step
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.utils import file_io
-from bigdl_tpu.utils.errors import (ConfigurationError,
+from bigdl_tpu.utils.errors import (CheckpointCorruptionError,
+                                    ConfigurationError,
                                     TrainingHaltedError,
                                     UnsupportedFeatureError)
 from bigdl_tpu.utils.random_generator import RNG
@@ -78,7 +79,11 @@ class BaseOptimizer:
         #: reference's Metrics accumulators, optim/Metrics.scala:31)
         self.metrics = Metrics()
         self.driver_state: Dict = {"epoch": 1, "neval": 1,
-                                   "record_count": 0}
+                                   "record_count": 0,
+                                   "batches_consumed": 0}
+        #: mid-epoch dataset position restored from a snapshot, consumed
+        #: by _resume_data_stream at the top of the next optimize
+        self._resume_position = None
 
     # ----- builder setters (names mirror the reference) ------------------- #
     def set_end_when(self, trigger: Trigger):
@@ -129,16 +134,21 @@ class BaseOptimizer:
                 "no sharded checkpoint path: call set_sharded_checkpoint "
                 "first or pass path=")
         base = file_io.abs_local(path or self.sharded_checkpoint_path)
-        snaps = [d for d in file_io.listdir(base)
-                 if d.startswith("snap_") and d.split("_")[1].isdigit()
-                 # a crash between the orbax finalize and the driver-state
-                 # sidecar write leaves an unusable snapshot: skip it so
-                 # retry/resume falls back to the previous complete one
-                 and file_io.exists(file_io.join(base, d) + ".driver")]
-        if not snaps:
+        # verified resolution: a crash between the orbax finalize and the
+        # driver-state sidecar write leaves an unusable snapshot (skipped);
+        # a truncated / digest-mismatched one is QUARANTINED -- resume
+        # lands on the last intact snapshot or fails loudly, never loads
+        # garbage (docs/robustness.md)
+        intact, quarantined = file_io.scan_sharded_snapshots(base)
+        if not intact:
+            if quarantined:
+                raise CheckpointCorruptionError(
+                    f"every sharded snapshot under {base} failed "
+                    f"verification; quarantined: {quarantined} -- a fresh "
+                    "start here would silently discard the run (move the "
+                    "*.corrupt files away to force one)")
             return self
-        latest = max(snaps, key=lambda d: int(d.split("_")[1]))
-        self._resume_sharded = file_io.join(base, latest)
+        self._resume_sharded = intact[0]
         log.info("Resuming from sharded snapshot %s", self._resume_sharded)
         return self
 
@@ -262,14 +272,85 @@ class BaseOptimizer:
                 self.model, params_tree, self._optim_methods_map)
 
     def _apply_driver_state(self, snap_state):
-        """Restore loop counters AND the RNG stream position (so a
-        resumed run draws the same key sequence -- dropout masks etc. --
-        as the uninterrupted one)."""
+        """Restore loop counters, the RNG stream position (so a resumed
+        run draws the same key sequence -- dropout masks etc. -- as the
+        uninterrupted one) AND the mid-epoch dataset position (consumed
+        by ``_resume_data_stream`` before the loop starts)."""
         d = dict(snap_state)
         rng_state = d.pop("rng_state", None)
+        self._resume_position = d.pop("data_position", None)
+        # file_io.save numpy-ified the snapshot: loop counters come back
+        # as 0-d ndarrays, which would poison every later step event's
+        # JSON encode -- coerce scalars back to python types
+        for k, v in d.items():
+            if isinstance(v, (np.ndarray, np.generic)) and \
+                    getattr(v, "ndim", 1) == 0:
+                d[k] = v.item()
         self.driver_state.update(d)
         if rng_state is not None:
             RNG.set_state(rng_state)
+
+    def _resume_data_stream(self, train_iter, first_batch):
+        """After a resume restored the driver counters: put the dataset
+        back at the snapshot's mid-epoch position and fast-forward a
+        FRESH iterator past the batches the checkpointed steps already
+        consumed, so the post-restart sample stream is bit-identical to
+        the uninterrupted run's (docs/robustness.md).  No-op without a
+        restored position.  The drivers call this after their resume
+        blocks, before the loop; the pre-resume ``first_batch`` (drawn
+        only for shapes/model build) is discarded."""
+        pos, self._resume_position = self._resume_position, None
+        if pos is None:
+            return train_iter, first_batch
+        consumed = int(pos.get("batches_consumed", 0))
+        ds_state = pos.get("dataset")
+        if ds_state is None:
+            if consumed or pos.get("reshuffle_pending"):
+                log.warning(
+                    "snapshot carries a mid-epoch position (%d batches "
+                    "into epoch %d) but %s exposes no position_state(); "
+                    "resuming from the top of the epoch -- the resumed "
+                    "sample stream will NOT match the uninterrupted run",
+                    consumed, self.driver_state.get("epoch", 1),
+                    type(self.dataset).__name__)
+            return train_iter, first_batch
+        self.dataset.restore_position(ds_state)
+        if pos.get("reshuffle_pending"):
+            # the uninterrupted run's DEFERRED epoch-boundary reshuffle
+            # (exotic-trigger fetch path) would have run before its next
+            # fetch; replay it now that the shuffle RNG is restored
+            self.dataset.shuffle()
+        train_iter = self.dataset.data(train=True)
+        for i in range(consumed):
+            try:
+                next(train_iter)
+            except StopIteration:
+                raise CheckpointCorruptionError(
+                    f"dataset exhausted {i}/{consumed} batches into the "
+                    "mid-epoch fast-forward: the snapshot's position does "
+                    "not fit this dataset (changed size or batch "
+                    "shape?)") from None
+        log.info("resumed dataset position: epoch %d, fast-forwarded %d "
+                 "consumed batches", self.driver_state.get("epoch", 1),
+                 consumed)
+        return train_iter, next(train_iter)
+
+    def _capture_data_position(self):
+        """The mid-epoch position block stamped into every snapshot's
+        driver state: batches consumed by COMPLETED steps this epoch,
+        whether an epoch-boundary reshuffle is still pending, and the
+        dataset's own order/RNG state (None when unsupported)."""
+        # getattr-guarded: duck-typed datasets (anything with
+        # data/size/shuffle) stay supported, they just resume from the
+        # top of the epoch
+        pos_fn = getattr(self.dataset, "position_state", None)
+        return {
+            "batches_consumed": int(
+                self.driver_state.get("batches_consumed", 0)),
+            "reshuffle_pending": bool(
+                getattr(self, "_reshuffle_pending", False)),
+            "dataset": pos_fn() if callable(pos_fn) else None,
+        }
 
     def _log_learning_rates(self, opt_state, state):
         """LearningRate summary scalars: one per submodule for composite
@@ -288,13 +369,45 @@ class BaseOptimizer:
 
     def resume_from_checkpoint(self, path: Optional[str] = None):
         """Reference resume semantics: Module.load + OptimMethod.load
-        (models/lenet/Train.scala:48-69); iteration-accurate via driver state."""
-        ckpt_file = file_io.latest_checkpoint(path or self.checkpoint_path)
-        if ckpt_file is None:
+        (models/lenet/Train.scala:48-69); iteration-accurate via driver
+        state.  Verified resolution (docs/robustness.md): truncated /
+        digest-mismatched snapshots are quarantined and resume lands on
+        the newest intact one; "nothing to resume" (fresh start) is
+        distinguished from "every snapshot corrupt" (raises, listing
+        the quarantined files)."""
+        base = path or self.checkpoint_path
+        snap, quarantined = None, []
+        while True:
+            # the scan verifies newest-first and stops at the first
+            # intact candidate; after a post-verification load failure
+            # (quarantined below) the rescan resolves the next one
+            intact, q = file_io.scan_checkpoints(base)
+            quarantined.extend(q)
+            if not intact:
+                break
+            ckpt_file = intact[0]
+            try:
+                snap = file_io.load(ckpt_file)
+                break
+            except Exception:
+                # verification passed but the unpickle did not (a saver
+                # bug, not an IO truncation): same quarantine treatment
+                log.exception("snapshot %s verified but failed to load",
+                              ckpt_file)
+                quarantined.extend(file_io.quarantine_snapshot(ckpt_file))
+        if snap is None:
+            if quarantined:
+                raise CheckpointCorruptionError(
+                    f"every snapshot under {base} failed verification; "
+                    f"quarantined: {quarantined} -- a fresh start here "
+                    "would silently discard the run (move the *.corrupt "
+                    "files away to force one)")
             return self
-        snap = file_io.load(ckpt_file)
         self._resume = snap
-        log.info("Resuming from %s (state %s)", ckpt_file, snap["driver_state"])
+        self._resume_path = ckpt_file
+        ds = snap["driver_state"]
+        log.info("Resuming from %s (epoch %s, neval %s)", ckpt_file,
+                 ds.get("epoch"), ds.get("neval"))
         return self
 
     # ----- shared helpers -------------------------------------------------- #
@@ -685,6 +798,11 @@ class BaseOptimizer:
                 device_s = wall - data_wait
                 state["loss"] = loss
                 state["record_count"] += n
+                # batches consumed by COMPLETED steps this epoch -- the
+                # prefetched-but-not-dispatched next batch is NOT counted,
+                # so a snapshot's position replays it after resume
+                state["batches_consumed"] = \
+                    state.get("batches_consumed", 0) + 1
                 state["throughput"] = n / max(wall, 1e-9)
                 self.metrics.add("data_wait_s", data_wait)
                 self.metrics.add("device_s", device_s)
@@ -735,6 +853,7 @@ class BaseOptimizer:
                 if state["record_count"] >= epoch_size:
                     state["epoch"] += 1
                     state["record_count"] = 0
+                    state["batches_consumed"] = 0
                     if next_batch is None:  # fetch deferred past the reset:
                         self._reshuffle_pending = True
 
@@ -750,8 +869,12 @@ class BaseOptimizer:
                         and self.checkpoint_trigger(state)):
                     if sync_skew:
                         point_sync("checkpoint")
-                    # snapshot the RNG stream position with the counters
+                    # snapshot the RNG stream position with the counters,
+                    # and the mid-epoch dataset position (shuffle state +
+                    # consumed-batch count) so resume can fast-forward to
+                    # the exact sample-stream position
                     state["rng_state"] = RNG.get_state()
+                    state["data_position"] = self._capture_data_position()
                     with sp("checkpoint", step=state["neval"]):
                         checkpoint_cb(state)
 
@@ -799,6 +922,8 @@ class LocalOptimizer(BaseOptimizer):
             mstate = jax.tree.map(jnp.asarray, snap["model_state"])
             opt_state = jax.tree.map(jnp.asarray, snap["opt_state"])
             self._apply_driver_state(snap["driver_state"])
+        train_iter, first_batch = self._resume_data_stream(
+            train_iter, first_batch)
 
         mon = self.health_monitor
         use_health = mon is not None and mon.enabled

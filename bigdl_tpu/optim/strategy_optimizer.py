@@ -155,10 +155,21 @@ class StrategyOptimizer(BaseOptimizer):
         import orbax.checkpoint as ocp
 
         d = file_io.join(self.sharded_checkpoint_path, f"snap_{neval}")
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(d, {"params": params, "opt_state": opt_state},
-                       force=True)
-        file_io.save(dict(state), d + ".driver")
+        payload = {"params": params, "opt_state": opt_state}
+
+        def save_dir(path):
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(path, payload, force=True)
+
+        # crash-safe commit protocol shared with the dp saver
+        # (docs/robustness.md).  No layout block: the strategy-native
+        # trees re-chunk only via ROADMAP item 3's redistribution
+        # engine (N->M resume is dp-only for now).
+        file_io.write_sharded_snapshot(
+            d, save_dir, state,
+            direct=(file_io.is_remote(self.sharded_checkpoint_path)
+                    or jax.process_count() > 1),
+            write_manifest=jax.process_index() == 0)
 
     def _sharded_restore(self, params, opt_state):
         """-> (params, opt_state) restored with the PREPARED shardings
@@ -396,6 +407,8 @@ class StrategyOptimizer(BaseOptimizer):
             self._apply_driver_state(snap["driver_state"])
         if getattr(self, "_resume_sharded", None):
             params, opt_state = self._sharded_restore(params, opt_state)
+        train_iter, first_batch = self._resume_data_stream(
+            train_iter, first_batch)
 
         mon = self.health_monitor
         use_health = mon is not None and mon.enabled

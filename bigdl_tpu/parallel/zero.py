@@ -71,6 +71,58 @@ class FlatParamSpace:
             flat, (index * self.chunk_size,), (self.chunk_size,))
 
 
+def refit_flat_plane(a, padded_size, true_size=None):
+    """Re-fit a flat-plane leaf saved under one chunk layout onto
+    another (N->M data-parallel resume, or an int8 block-rounding
+    change): the layouts store the SAME ``true_size`` logical elements
+    and differ only in trailing padding -- never read by the model math
+    -- so leaves resize by zero-pad / tail-truncate on the last axis.
+    Non-flat leaves (scalars, already-fitting vectors) pass through.
+    ``true_size`` guards the truncation: shrinking below it would drop
+    real parameters, which is a layout mismatch, not a padding change.
+    """
+    a = jnp.asarray(a)
+    if a.ndim < 1 or a.shape[-1] == padded_size:
+        return a
+    if a.shape[-1] > padded_size:
+        if true_size is not None and padded_size < true_size:
+            raise ValueError(
+                f"cannot refit a {a.shape[-1]}-element flat plane onto "
+                f"padded size {padded_size} < true size {true_size}: "
+                "the target layout holds fewer parameters than the "
+                "snapshot")
+        return a[..., :padded_size]
+    pad = [(0, 0)] * (a.ndim - 1) + [(0, padded_size - a.shape[-1])]
+    return jnp.pad(a, pad)
+
+
+def repartition_ef_residual(ef, true_size, num_chunks, padded_size):
+    """Re-partition the EF-SGD error-feedback residual plane
+    (``ops/quantization.py``; one fp32 accumulated-quantization-error
+    row per device) onto a DIFFERENT device count.
+
+    Each device folds ITS row into its local gradient before
+    quantizing, so the quantity the training trajectory depends on is
+    the SUM over rows -- any row assignment preserving that sum applies
+    the same total correction.  N->M therefore: sum the old rows into
+    one global residual, drop the old layout's trailing padding
+    (gradient there is identically 0, so its residual is too), re-pad
+    to the new layout, and hand row j the slice in ITS chunk's global
+    flat offsets (zeros elsewhere) -- no accumulated correction is
+    dropped, and magnitude spreads evenly instead of piling onto one
+    device."""
+    ef = np.asarray(ef, np.float32)
+    if ef.ndim != 2:
+        raise ValueError(f"EF residual plane must be 2-D, got {ef.shape}")
+    total = ef.sum(axis=0)[:min(int(true_size), ef.shape[1])]
+    total = np.pad(total, (0, int(padded_size) - total.size))
+    out = np.zeros((int(num_chunks), int(padded_size)), np.float32)
+    chunk = int(padded_size) // int(num_chunks)
+    for j in range(int(num_chunks)):
+        out[j, j * chunk:(j + 1) * chunk] = total[j * chunk:(j + 1) * chunk]
+    return out
+
+
 def stage_batch_global(tree, sharding):
     """Host batch pytree -> global device arrays under ``sharding``.
 

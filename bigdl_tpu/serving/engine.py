@@ -166,6 +166,48 @@ class _RoundRobinEval:
 # The engine.
 # --------------------------------------------------------------------------- #
 
+
+def _tree_spec(tree):
+    """(labels, per-leaf (shape, dtype), treedef) of a weight tree --
+    the structural contract refresh_params validates against.  Reads
+    shape/dtype ATTRIBUTES only: no ``np.asarray`` on the leaves, so
+    validating gigabytes of device-resident params moves zero bytes."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    leaves_with_path, treedef = tree_flatten_with_path(tree)
+    labels = [keystr(p) for p, _ in leaves_with_path]
+
+    def dtype_of(l):
+        dt = getattr(l, "dtype", None)
+        return str(dt if dt is not None else np.result_type(l))
+
+    specs = [(tuple(np.shape(l)), dtype_of(l))
+             for _, l in leaves_with_path]
+    return labels, specs, treedef
+
+
+def _spec_mismatch(expect, got, what):
+    """First structural/shape/dtype difference between two _tree_spec
+    results as a human-readable reason, or None when they match."""
+    e_labels, e_specs, e_def = expect
+    g_labels, g_specs, g_def = got
+    if e_def != g_def:
+        missing = sorted(set(e_labels) - set(g_labels))
+        extra = sorted(set(g_labels) - set(e_labels))
+        detail = []
+        if missing:
+            detail.append(f"missing {missing[:4]}")
+        if extra:
+            detail.append(f"unexpected {extra[:4]}")
+        return (f"{what} tree structure differs"
+                + (": " + ", ".join(detail) if detail else ""))
+    for label, e, g in zip(e_labels, e_specs, g_specs):
+        if e != g:
+            return (f"{what} leaf {label}: expected shape {e[0]} "
+                    f"dtype {e[1]}, got shape {g[0]} dtype {g[1]}")
+    return None
+
+
 class ServingEngine:
     """Coalescing, bucketed, (optionally) sharded inference server.
 
@@ -206,6 +248,13 @@ class ServingEngine:
             raise ValueError(f"queue_capacity must be >= 1, got "
                              f"{queue_capacity}")
         self.model = model
+        # the serving contract frozen at construction: refresh_params
+        # validates any later weight swap against THIS tree structure +
+        # shapes BEFORE touching the device caches, so a half-written
+        # checkpoint mid-retrain raises cleanly and the engine keeps
+        # serving the old weights (docs/robustness.md)
+        self._params_spec = _tree_spec(model.parameters()[0])
+        self._mstate_spec = _tree_spec(model.state())
         if mesh is not None and int(mesh.shape[axis]) > 1:
             self._backend = _ShardedEval(model, mesh, axis, compute_dtype)
         elif round_robin and len(jax.local_devices()) > 1:
@@ -525,9 +574,43 @@ class ServingEngine:
                     "serving telemetry record failed (tick %d)", self._tick)
 
     # ----- lifecycle -------------------------------------------------------- #
-    def refresh_params(self):
-        """Re-replicate device-resident weights after mutating the
-        model (sharded / round-robin layouts cache them on device)."""
+    def refresh_params(self, params=None, mstate=None):
+        """Swap in retrained weights and re-replicate the device caches
+        (sharded / round-robin layouts hold weights on device).
+
+        With ``params`` (and optionally ``mstate``): validate the
+        incoming tree's STRUCTURE and per-leaf shapes/dtypes against
+        the serving model's, and only then ``set_parameters`` + refresh
+        -- a refresh fed from a half-written checkpoint mid-retrain
+        raises ``ValueError`` here and the engine keeps serving the old
+        weights untouched.  Without arguments (the historical spelling:
+        caller already mutated ``self.model``), the model's CURRENT
+        params are validated against the engine's construction-time
+        spec before the device caches re-replicate."""
+        if params is not None:
+            reason = _spec_mismatch(self._params_spec, _tree_spec(params),
+                                    "params")
+            if reason is None and mstate is not None:
+                reason = _spec_mismatch(self._mstate_spec,
+                                        _tree_spec(mstate), "mstate")
+            if reason is not None:
+                raise ValueError(
+                    f"refresh_params rejected the incoming weights "
+                    f"({reason}); the engine keeps serving its current "
+                    "weights -- is the source checkpoint half-written "
+                    "or from a different model?")
+            self.model.set_parameters(params)
+            if mstate is not None:
+                self.model.set_state(mstate)
+        else:
+            reason = _spec_mismatch(self._params_spec,
+                                    _tree_spec(self.model.parameters()[0]),
+                                    "params")
+            if reason is not None:
+                raise ValueError(
+                    f"refresh_params: the model's weights no longer "
+                    f"match the serving contract ({reason}); device "
+                    "caches left untouched")
         refresh = getattr(self._backend, "refresh_params", None)
         if refresh is not None:
             refresh()

@@ -24,3 +24,12 @@ class TrainingHaltedError(RuntimeError):
     failure-retry loop: restoring a checkpoint and replaying the same
     batches reproduces the same numerics blow-up, burning retry cycles
     while destroying the incident evidence window."""
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint directory EXISTS but every snapshot in it failed
+    integrity verification (truncated write, digest mismatch) and was
+    quarantined.  Distinct from "nothing to resume": silently starting
+    fresh here would throw away a run the operator believes is
+    recoverable.  The message lists the quarantined files
+    (docs/robustness.md)."""

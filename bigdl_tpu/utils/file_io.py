@@ -1,4 +1,4 @@
-"""Checkpoint persistence, local and remote.
+"""Checkpoint persistence, local and remote -- crash-safe by default.
 
 Reference: utils/File.scala:27-130 -- saveToHdfs/load route any
 ``scheme://`` path through the Hadoop FileSystem API (HDFS/S3), plain
@@ -12,22 +12,57 @@ optimMethod.<neval>).
 Format: a pickle of numpy-ified pytrees -- portable, no JVM.  (The
 protobuf bigdl.proto-compatible model format is a separate interop layer;
 see SURVEY.md section 2.6.)
+
+Crash safety (docs/robustness.md):
+
+- every snapshot writes to a TEMP name and atomically renames into
+  place, so a writer killed mid-write never shadows the previous good
+  snapshot with a truncated file;
+- each snapshot gets a sidecar MANIFEST (``<name>.manifest.json``)
+  stamping byte count + sha256 of every file it covers (and, for the
+  dp flat plane, the chunk layout metadata the N->M resume needs);
+- resume-time resolution (``scan_checkpoints`` / ``latest_checkpoint``)
+  VERIFIES candidates newest-first and quarantines failures (renamed to
+  ``*.corrupt``, evidence preserved) instead of crashing on -- or worse,
+  silently loading -- garbage;
+- checkpoint writes retry transient IO failures with bounded backoff
+  (``with_write_retries``) instead of killing the training step that
+  triggered the checkpoint callback.
 """
 
+import hashlib
+import json
+import logging
 import os
 import pickle
 import re
+import shutil
+import time
 from typing import Any
 
-import jax
 import numpy as np
 
+log = logging.getLogger("bigdl_tpu.optim")
+
 _SCHEME = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.-]*://")
+
+#: sidecar integrity manifest next to every snapshot
+MANIFEST_SUFFIX = ".manifest.json"
+#: a snapshot that failed verification is renamed, never deleted
+QUARANTINE_SUFFIX = ".corrupt"
+#: in-flight writes carry this marker until the atomic rename
+TMP_MARKER = ".tmp-"
 
 
 def _is_remote(path: str) -> bool:
     return bool(_SCHEME.match(str(path))) and not str(path).startswith(
         "file://")
+
+
+def is_remote(path: str) -> bool:
+    """True for URL-schemed (fsspec-routed) paths -- callers branch on
+    this to pick the local atomic-rename write path."""
+    return _is_remote(path)
 
 
 def _fs_for(path: str):
@@ -81,13 +116,249 @@ def join(path: str, *parts: str) -> str:
     return os.path.join(path, *parts)
 
 
+def getsize(path: str) -> int:
+    if _is_remote(path):
+        fs, p = _fs_for(path)
+        return int(fs.size(p))
+    return os.path.getsize(path)
+
+
+def isdir(path: str) -> bool:
+    if _is_remote(path):
+        fs, p = _fs_for(path)
+        return fs.isdir(p)
+    return os.path.isdir(path)
+
+
+def rename(src: str, dst: str):
+    """Atomic replace for local paths; best-effort mv for remote ones
+    (object stores have no true rename -- orbax's own commit marker is
+    the atomicity story there)."""
+    if _is_remote(src):
+        fs, s = _fs_for(src)
+        _, d = _fs_for(dst)
+        fs.mv(s, d, recursive=True)
+        return
+    os.replace(src, dst)
+
+
+def sha256_of(path: str) -> str:
+    h = hashlib.sha256()
+    with open_file(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
 def _to_numpy(tree):
+    # jax only here (lazily): everything else in this module is plain
+    # IO, usable by supervisor/report processes that never touch a
+    # backend (optim/recovery.py, tools/obs_report.py)
+    import jax
+
     return jax.tree.map(lambda x: np.asarray(x), tree)
 
 
 def save(obj: Any, path: str):
     with open_file(path, "wb") as f:
         pickle.dump(_to_numpy(obj), f)
+
+
+def atomic_save(obj: Any, path: str):
+    """``save`` through a temp name + rename: a writer killed mid-write
+    leaves only a ``*.tmp-*`` orphan, never a truncated ``path``."""
+    tmp = path + TMP_MARKER + str(os.getpid())
+    with open_file(tmp, "wb") as f:
+        pickle.dump(_to_numpy(obj), f)
+        f.flush()
+        try:
+            os.fsync(f.fileno())
+        except (OSError, AttributeError):  # remote/exotic filesystems
+            pass
+    rename(tmp, path)
+
+
+def with_write_retries(fn, what="checkpoint write", retries=None,
+                       backoff_s=0.1, sleep=time.sleep):
+    """Run ``fn()`` retrying transient IO failures (``OSError``) with
+    exponential backoff, one WARNING per retry; re-raise after the
+    budget -- a flaky remote filesystem must not kill the training step
+    that triggered the checkpoint callback (docs/robustness.md).
+    Deterministic failures (pickling errors etc.) are not retried."""
+    if retries is None:
+        retries = int(os.environ.get("BIGDL_CKPT_WRITE_RETRIES", "2"))
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt >= retries:
+                raise
+            delay = backoff_s * (2 ** attempt)
+            log.warning("%s failed (%s); retry %d/%d in %.2fs",
+                        what, e, attempt + 1, retries, delay)
+            sleep(delay)
+
+
+# --------------------------------------------------------------------------- #
+# Snapshot integrity: sidecar manifests, verification, quarantine.
+# --------------------------------------------------------------------------- #
+
+
+def _walk_files(target: str):
+    """Relative paths of every regular file under ``target`` (itself,
+    when it is a file), keyed relative to its PARENT directory -- the
+    manifest's key space, shared by files and orbax snapshot dirs."""
+    base = os.path.basename(str(target).rstrip("/"))
+    if not isdir(target):
+        return [base]
+    out = []
+    for root, _, files in os.walk(target):
+        rel_root = os.path.relpath(root, os.path.dirname(target))
+        out.extend(os.path.join(rel_root, f) for f in files)
+    return sorted(out)
+
+
+def write_snapshot_manifest(target: str, extra_files=(), meta=None):
+    """Stamp ``<target>.manifest.json``: bytes + sha256 of every file
+    the snapshot consists of (a pickle file, or an orbax directory plus
+    sidecars like ``snap_N.driver``), plus caller metadata (the dp
+    layout block the N->M resume reads).  Written atomically, AFTER the
+    snapshot itself renames into place: a manifest's presence implies
+    the files it covers were fully written."""
+    parent = os.path.dirname(str(target).rstrip("/"))
+    rels = _walk_files(target) + [os.path.basename(str(f)) for f in
+                                  extra_files]
+    files = {}
+    for rel in rels:
+        p = join(parent, rel) if parent else rel
+        if _is_remote(target) and isdir(target):
+            continue  # remote dir digests: orbax's commit marker governs
+        files[rel] = {"bytes": getsize(p), "sha256": sha256_of(p)}
+    manifest = {"schema_version": 1, "created": time.time(),
+                "kind": "dir" if isdir(target) else "file",
+                "files": files}
+    if meta:
+        manifest.update(meta)
+    mpath = str(target).rstrip("/") + MANIFEST_SUFFIX
+    tmp = mpath + TMP_MARKER + str(os.getpid())
+    with open_file(tmp, "wb") as f:
+        f.write(json.dumps(manifest, indent=1).encode())
+    rename(tmp, mpath)
+    return mpath
+
+
+def read_manifest(target: str):
+    """The parsed sidecar manifest of a snapshot path, or None (absent
+    or unparseable -- an unparseable manifest must not brick resume)."""
+    mpath = str(target).rstrip("/") + MANIFEST_SUFFIX
+    if not exists(mpath):
+        return None
+    try:
+        with open_file(mpath, "rb") as f:
+            return json.loads(f.read().decode(errors="replace"))
+    except (OSError, ValueError):
+        return None
+
+
+def verify_snapshot(target: str, legacy_load: bool = False):
+    """-> None when the snapshot passes integrity verification, else a
+    human-readable reason.  With a manifest: every covered file must
+    exist with the stamped size and sha256 (catches truncation AND
+    bit-flips).  Without one (legacy snapshot, or a crash landed
+    between the data rename and the manifest rename): ``legacy_load``
+    falls back to an unpickle attempt for pickle snapshots; directories
+    are accepted (orbax's own commit marker governs)."""
+    if not exists(target):
+        return "missing"
+    manifest = read_manifest(target)
+    if manifest is None:
+        if legacy_load and not isdir(target):
+            try:
+                load(target)
+            except Exception as e:
+                return f"no manifest and unreadable pickle ({e!r:.120})"
+        return None
+    parent = os.path.dirname(str(target).rstrip("/"))
+    for rel, rec in (manifest.get("files") or {}).items():
+        p = join(parent, rel) if parent else rel
+        if not exists(p):
+            return f"{rel}: missing"
+        size = getsize(p)
+        if size != rec.get("bytes"):
+            return f"{rel}: {size} bytes, manifest says {rec.get('bytes')}"
+        if sha256_of(p) != rec.get("sha256"):
+            return f"{rel}: sha256 mismatch"
+    return None
+
+
+def write_sharded_snapshot(d: str, save_dir, driver_state,
+                           manifest_meta=None, direct=False,
+                           write_manifest=True):
+    """The ONE crash-safe commit protocol for directory (orbax)
+    snapshots, shared by the Distri and Strategy savers
+    (docs/robustness.md).  ``save_dir(path)`` writes the snapshot
+    directory at ``path`` (the caller's orbax save closure).
+
+    Local single-host (``direct=False``): save into a temp dir, write
+    the ``.driver`` sidecar atomically, swap the temp dir into place,
+    then stamp the manifest -- a kill at any point never shadows the
+    previous snapshot with a partial one.  The swap REPLACES an
+    existing target dir (a retry after a mid-commit transient, or a
+    same-tag re-save): the stale dir is removed only once the fresh
+    temp dir is fully written beside it, so the worst crash window
+    leaves no dir at ``d`` (scan skips it, resume falls back).
+
+    Remote / multi-host (``direct=True``): save straight to ``d`` --
+    orbax's own commit marker governs atomicity there -- with the
+    manifest written only when ``write_manifest`` (callers pass
+    ``process_index() == 0``).
+
+    The whole protocol retries transient IO failures
+    (``with_write_retries``), and every step of it is retry-safe.
+    """
+    def write():
+        if direct:
+            save_dir(d)
+            save(dict(driver_state), d + ".driver")
+            if write_manifest:
+                write_snapshot_manifest(
+                    d, extra_files=(d + ".driver",), meta=manifest_meta)
+            return
+        tmp = d + TMP_MARKER + str(os.getpid())
+        save_dir(tmp)
+        atomic_save(dict(driver_state), d + ".driver")
+        if os.path.isdir(d):
+            # retrying past a successful swap, or overwriting the same
+            # tag: the replacement is complete at `tmp`, so dropping
+            # the stale dir first is safe (a crash in between leaves
+            # NO dir at d -- skipped by scan, previous snapshot wins)
+            shutil.rmtree(d)
+        rename(tmp, d)
+        write_snapshot_manifest(
+            d, extra_files=(d + ".driver",), meta=manifest_meta)
+
+    with_write_retries(write, what=f"sharded snapshot ({d})")
+    return d
+
+
+def quarantine_snapshot(target: str, sidecars=()):
+    """Rename a failed snapshot (+ its manifest and sidecars) to
+    ``*.corrupt`` -- out of resume's way, evidence preserved.  Returns
+    the quarantined paths."""
+    moved = []
+    for p in [str(target).rstrip("/"),
+              str(target).rstrip("/") + MANIFEST_SUFFIX] + \
+            [str(s) for s in sidecars]:
+        if not exists(p):
+            continue
+        try:
+            rename(p, p + QUARANTINE_SUFFIX)
+            moved.append(p + QUARANTINE_SUFFIX)
+        except OSError:  # pragma: no cover - quarantine is best-effort
+            log.warning("could not quarantine %s", p, exc_info=True)
+    if moved:
+        log.warning("quarantined corrupt snapshot: %s", moved)
+    return moved
 
 
 def abs_local(path: str) -> str:
@@ -102,29 +373,91 @@ def load(path: str) -> Any:
 
 
 def save_checkpoint(path: str, tag, model_params, model_state, opt_state,
-                    driver_state):
-    """One training snapshot (model + optimizer + loop counters), resumable."""
-    save(
-        {
-            "model_params": model_params,
-            "model_state": model_state,
-            "opt_state": opt_state,
-            "driver_state": dict(driver_state),
-        },
-        join(path, f"checkpoint.{tag}.pkl"),
-    )
+                    driver_state, manifest_meta=None):
+    """One training snapshot (model + optimizer + loop counters),
+    resumable.  Crash-safe: temp-write + atomic rename, sidecar digest
+    manifest, transient-IO retries (docs/robustness.md)."""
+    target = join(path, f"checkpoint.{tag}.pkl")
+    payload = {
+        "model_params": model_params,
+        "model_state": model_state,
+        "opt_state": opt_state,
+        "driver_state": dict(driver_state),
+    }
+
+    def write():
+        atomic_save(payload, target)
+        write_snapshot_manifest(target, meta=manifest_meta)
+
+    with_write_retries(write, what=f"checkpoint write ({target})")
+    return target
+
+
+def _ckpt_tag(name):
+    try:
+        return int(str(name).split(".")[1].split("_")[-1])
+    except (ValueError, IndexError):
+        return -1
+
+
+def scan_checkpoints(path: str):
+    """-> ([newest intact snapshot path] or [], quarantined paths).
+
+    Verifies ``checkpoint.<tag>.pkl`` candidates NEWEST-FIRST (manifest
+    digest, or an unpickle attempt for manifest-less legacy files),
+    quarantining failures on the spot, and STOPS at the first intact
+    one -- resolution costs O(newest snapshot), not O(every retained
+    snapshot's bytes), no matter how many old snapshots the run keeps.
+    Older candidates stay unverified until a later resolution actually
+    reaches them (e.g. after the newest was quarantined)."""
+    quarantined = []
+    snaps = sorted((f for f in listdir(path)
+                    if f.startswith("checkpoint.") and f.endswith(".pkl")),
+                   key=_ckpt_tag, reverse=True)
+    for name in snaps:
+        target = join(path, name)
+        reason = verify_snapshot(target, legacy_load=True)
+        if reason is None:
+            return [target], quarantined
+        log.warning("snapshot %s failed verification (%s)",
+                    target, reason)
+        quarantined.extend(quarantine_snapshot(target))
+    return [], quarantined
 
 
 def latest_checkpoint(path: str):
-    snaps = [f for f in listdir(path)
-             if f.startswith("checkpoint.") and f.endswith(".pkl")]
-    if not snaps:
-        return None
+    """Newest INTACT snapshot (corrupt ones are quarantined), or None."""
+    intact, _ = scan_checkpoints(path)
+    return intact[0] if intact else None
 
-    def tag(f):
-        try:
-            return int(f.split(".")[1])
-        except ValueError:
-            return -1
 
-    return join(path, max(snaps, key=tag))
+def scan_sharded_snapshots(path: str):
+    """Sharded (orbax) analogue of ``scan_checkpoints``: -> ([newest
+    intact ``snap_<n>`` dir] or [], quarantined paths), verifying
+    newest-first and stopping at the first intact one (older dirs stay
+    unverified until actually needed).  A usable snapshot needs its
+    ``.driver`` sidecar (a crash between the orbax finalize and the
+    sidecar write leaves it unusable -- skipped, like before) and must
+    pass manifest verification when a manifest exists (legacy
+    manifest-less dirs are accepted; orbax's commit marker governs
+    their atomicity)."""
+    quarantined = []
+    snaps = sorted(
+        (d for d in listdir(path)
+         if d.startswith("snap_") and TMP_MARKER not in d
+         and not d.endswith(QUARANTINE_SUFFIX)
+         and d.split("_")[-1].isdigit()),
+        key=lambda d: int(d.split("_")[-1]), reverse=True)
+    for name in snaps:
+        target = join(path, name)
+        driver = target + ".driver"
+        if not exists(driver):
+            continue   # unusable leftover, not corruption evidence
+        reason = verify_snapshot(target)
+        if reason is None:
+            return [target], quarantined
+        log.warning("sharded snapshot %s failed verification (%s)",
+                    target, reason)
+        quarantined.extend(quarantine_snapshot(target,
+                                               sidecars=(driver,)))
+    return [], quarantined

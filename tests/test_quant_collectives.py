@@ -514,8 +514,10 @@ class TestDriverWiring:
         # 3 steps + snapshot, then FRESH optimizers resume for 3 more
         ck = str(tmp_path / "snaps")
         _fit_distri(spec, steps=3, ckpt=ck)
+        # snapshot DIRS only: the crash-safe write also leaves .driver
+        # and .manifest.json sidecars next to each one (docs/robustness.md)
         snaps = [s for s in os.listdir(ck) if s.startswith("snap_")
-                 and not s.endswith(".driver")]
+                 and os.path.isdir(os.path.join(ck, s))]
         assert snaps, os.listdir(ck)
         # the snapshot payload carries the residual plane (orbax ocdbt
         # layout: keys live in the tree metadata, not as dir entries)

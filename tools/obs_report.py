@@ -279,6 +279,33 @@ def _serving_section(other):
     return sec
 
 
+def _recovery_section(other):
+    """Summarize ``kind: "recovery"`` events -- the RunSupervisor's
+    restart records (docs/robustness.md): one entry per restart (cause,
+    snapshot resumed from, steps replayed, backoff), plus totals.  None
+    for runs without restarts."""
+    recs = [e for e in other if e.get("kind") == "recovery"]
+    if not recs:
+        return None
+    causes = {}
+    for e in recs:
+        c = e.get("cause") or "?"
+        causes[c] = causes.get(c, 0) + 1
+    replayed = [e.get("steps_replayed") for e in recs
+                if isinstance(e.get("steps_replayed"), (int, float))]
+    sec = {
+        "restarts": len(recs),
+        "causes": causes,
+        "steps_replayed_total": int(sum(replayed)) if replayed else None,
+        "backoff_s_total": sum(e.get("backoff_s") or 0.0 for e in recs),
+        "events": [{k: e.get(k) for k in
+                    ("restart", "cause", "error", "at_step", "snapshot",
+                     "snapshot_step", "steps_replayed", "backoff_s")}
+                   for e in recs],
+    }
+    return sec
+
+
 def _profiling_section(header, blocked, other, planes, top=10):
     """Summarize the trusted-timing evidence (docs/observability.md,
     "Profiling & trusted timing"): the blocked per-step percentiles
@@ -419,6 +446,9 @@ def build_report(run_dir, xplane_dir=None, top=10):
     serving = _serving_section(other)
     if serving:
         rep["serving"] = serving
+    recovery = _recovery_section(other)
+    if recovery:
+        rep["recovery"] = recovery
 
     rep["host_spans"] = span_totals(os.path.join(run_dir, "trace.json"))
 
@@ -602,6 +632,27 @@ def format_report(rep):
                 f"serving queue depth p50/p90: {sv['queue_depth_p50']}/"
                 f"{sv['queue_depth_p90']}"
                 + (f" (capacity {cap})" if cap is not None else ""))
+    rc = rep.get("recovery")
+    if rc:
+        cause_str = ", ".join(f"{c} x{n}" for c, n in
+                              sorted(rc["causes"].items()))
+        line = f"recovery: {rc['restarts']} restart(s) ({cause_str})"
+        if rc.get("steps_replayed_total") is not None:
+            line += f"   steps replayed {rc['steps_replayed_total']}"
+        line += f"   backoff total {rc['backoff_s_total']:.2f}s"
+        out.append(line)
+        for e in rc["events"][-6:]:
+            ln = (f"  restart {e.get('restart')} [{e.get('cause')}] at "
+                  f"step {e.get('at_step')}")
+            if e.get("snapshot"):
+                ln += (f" <- {os.path.basename(str(e['snapshot']))} "
+                       f"(step {e.get('snapshot_step')}")
+                if e.get("steps_replayed") is not None:
+                    ln += f", {e['steps_replayed']} replayed"
+                ln += ")"
+            else:
+                ln += " <- scratch"
+            out.append(ln)
     wd = rep.get("watchdogs") or {}
     if wd.get("recompile_steps"):
         out.append("RECOMPILES after warmup at steps: "

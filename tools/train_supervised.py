@@ -1,0 +1,241 @@
+"""Supervised (auto-restarting) training driver + chaos drill.
+
+The command-line face of ``bigdl_tpu/optim/recovery.RunSupervisor``
+(docs/robustness.md): a SUPERVISOR process spawns the actual training
+run as a child process, watches it, and on process death (SIGKILL /
+preemption included) restarts it from the last healthy snapshot with
+capped exponential backoff -- optionally on a DIFFERENT device count
+(the dp flat plane re-chunks N->M on resume).  Every restart lands as a
+durable ``kind: "recovery"`` telemetry event in the supervisor's run
+dir, rendered by ``tools/obs_report.py`` under "Recovery".
+
+    # smoke drill: 8 host devices, SIGKILL after step 9, restart on 4
+    python -m tools.train_supervised --out /tmp/drill --devices 8 \
+        --restartDevices 4 --steps 24 --ckptEvery 4 --chaos kill:9
+
+``--chaos kill:<step>`` is DETERMINISTIC fault injection (applied to
+the first attempt only): the child SIGKILLs itself the moment that step
+completes.  The slow-tier acceptance test drives exactly this drill and
+pins the recovered loss trajectory against an uninterrupted baseline.
+
+Artifacts under ``--out``:
+
+- ``ckpt/``            -- the (crash-safe, manifest-stamped) snapshots
+- ``attempt_<i>/``     -- each attempt's telemetry.jsonl + worker.log
+                          + result.json (written on clean completion)
+- ``supervisor/``      -- the supervisor's telemetry.jsonl (header +
+                          recovery events)
+
+The workload is a small synthetic-classification MLP trained
+data-parallel (ZeRO-1) over every visible device -- a drill, not a
+benchmark; swap in a real entry point by supervising your own command
+with ``RunSupervisor.run_process``.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("--out", required=True, help="artifact root directory")
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--datasetSize", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--devices", type=int, default=8,
+                    help="host-platform device count of the first attempt")
+    ap.add_argument("--restartDevices", type=int, default=None,
+                    help="device count after a restart (default: same -- "
+                         "set lower to drill the N->M resume)")
+    ap.add_argument("--ckptEvery", type=int, default=4)
+    ap.add_argument("--sharded", action="store_true",
+                    help="sharded (orbax) snapshots instead of pickle")
+    ap.add_argument("--chaos", default=None,
+                    help="deterministic fault injection: kill:<step> "
+                         "(first attempt only)")
+    ap.add_argument("--maxRestarts", type=int, default=3)
+    ap.add_argument("--backoff", type=float, default=0.25,
+                    help="exponential backoff base (seconds)")
+    ap.add_argument("--backoffMax", type=float, default=10.0)
+    ap.add_argument("--platform", choices=("cpu", "native"), default="cpu",
+                    help="cpu: force a JAX_PLATFORMS=cpu host mesh of "
+                         "--devices (hermetic drill); native: inherit the "
+                         "environment's accelerator")
+    # internal plumbing (the supervisor spawning itself as the worker)
+    ap.add_argument("--role", choices=("supervisor", "worker"),
+                    default="supervisor", help=argparse.SUPPRESS)
+    ap.add_argument("--attempt", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    return ap.parse_args(argv)
+
+
+def worker_env(base_env, args, attempt):
+    """The child's environment: platform pin + per-attempt device count
+    (restarts may come up on FEWER devices -- the N->M drill)."""
+    env = dict(base_env)
+    # the child is spawned by FILE path (sys.path[0] = tools/); the repo
+    # root must be importable regardless of how the supervisor was run
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if args.platform == "cpu":
+        ndev = args.devices if attempt == 0 else \
+            (args.restartDevices or args.devices)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={ndev}")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+# --------------------------------------------------------------------------- #
+# Worker: one training attempt (the process the chaos drill kills).
+# --------------------------------------------------------------------------- #
+
+
+def run_worker(args):
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+    from bigdl_tpu.observability import StepTelemetry
+    from bigdl_tpu.optim.recovery import ChaosKillTrigger, parse_chaos
+    from bigdl_tpu.utils.random_generator import RNG
+
+    RNG.set_seed(args.seed)
+    rng = np.random.default_rng(args.seed)
+    x = rng.standard_normal((args.datasetSize, 12)).astype("float32")
+    w = rng.standard_normal((12, 5)).astype("float32")
+    y = np.argmax(x @ w, axis=1).astype("int32")   # learnable structure
+    ds = array_dataset(x, y, seed=args.seed) >> SampleToMiniBatch(
+        args.batch)
+    model = (nn.Sequential().add(nn.Linear(12, 32)).add(nn.ReLU())
+             .add(nn.Linear(32, 5)))
+    opt = optim.DistriOptimizer(
+        model, ds, nn.CrossEntropyCriterion(),
+        optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0))
+
+    run_dir = os.path.join(args.out, f"attempt_{args.attempt}")
+    tel = StepTelemetry(run_dir, run_name=f"attempt_{args.attempt}",
+                        trace=False)
+    opt.set_telemetry(tel)
+    ckpt = os.path.join(args.out, "ckpt")
+    trig = optim.Trigger.several_iteration(args.ckptEvery)
+    if args.sharded:
+        opt.set_sharded_checkpoint(ckpt, trig)
+        opt.resume_from_sharded_checkpoint()
+    else:
+        opt.set_checkpoint(ckpt, trig)
+        opt.resume_from_checkpoint()
+
+    end = optim.Trigger.max_iteration(args.steps)
+    chaos = parse_chaos(args.chaos)
+    if chaos is not None:
+        end = optim.Trigger.or_(ChaosKillTrigger(chaos[1]), end)
+    opt.set_end_when(end)
+    try:
+        opt.optimize()
+    finally:
+        tel.close()
+    loss = opt.driver_state.get("loss")   # absent when the resumed run
+    result = {"neval": opt.driver_state["neval"],   # had no steps left
+              "epoch": opt.driver_state["epoch"],
+              "final_loss": None if loss is None else float(loss),
+              "attempt": args.attempt}
+    with open(os.path.join(run_dir, "result.json"), "w") as f:
+        json.dump(result, f)
+    print(json.dumps(result))
+    return 0
+
+
+# --------------------------------------------------------------------------- #
+# Supervisor: spawn -> watch -> restart.
+# --------------------------------------------------------------------------- #
+
+
+def run_supervisor(args):
+    from bigdl_tpu.observability import StepTelemetry
+    from bigdl_tpu.optim.recovery import (RunSupervisor,
+                                          last_step_in_telemetry,
+                                          parse_chaos)
+
+    parse_chaos(args.chaos)            # fail fast on a typo'd drill spec
+    os.makedirs(args.out, exist_ok=True)
+    tel = StepTelemetry(os.path.join(args.out, "supervisor"),
+                        run_name="supervisor", trace=False)
+    sup = RunSupervisor(max_restarts=args.maxRestarts,
+                        backoff_base_s=args.backoff,
+                        backoff_max_s=args.backoffMax, telemetry=tel)
+    logs = []
+
+    def spawn(attempt):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--role", "worker", "--attempt", str(attempt),
+               "--out", args.out, "--steps", str(args.steps),
+               "--batch", str(args.batch),
+               "--datasetSize", str(args.datasetSize),
+               "--lr", str(args.lr), "--seed", str(args.seed),
+               "--ckptEvery", str(args.ckptEvery)]
+        if args.sharded:
+            cmd.append("--sharded")
+        if attempt == 0 and args.chaos:
+            cmd += ["--chaos", args.chaos]   # the drill kills ONCE
+        run_dir = os.path.join(args.out, f"attempt_{attempt}")
+        os.makedirs(run_dir, exist_ok=True)
+        logf = open(os.path.join(run_dir, "worker.log"), "w")
+        logs.append(logf)
+        print(f"[supervisor] attempt {attempt}: {' '.join(cmd)}",
+              file=sys.stderr)
+        return subprocess.Popen(cmd, env=worker_env(os.environ, args,
+                                                    attempt),
+                                stdout=logf, stderr=subprocess.STDOUT,
+                                cwd=REPO)
+
+    ckpt = os.path.join(args.out, "ckpt")
+    probe = lambda: last_step_in_telemetry(
+        os.path.join(args.out, f"attempt_{sup.restarts}",
+                     "telemetry.jsonl"))
+    try:
+        restarts = sup.run_process(spawn, checkpoint_path=ckpt,
+                                   probe_step=probe, sharded=args.sharded)
+        rc = 0
+    except RuntimeError as e:
+        print(f"[supervisor] giving up: {e}", file=sys.stderr)
+        restarts, rc = sup.restarts, 2
+    finally:
+        tel.close()
+        for f in logs:
+            f.close()
+    result_path = os.path.join(args.out, f"attempt_{restarts}",
+                               "result.json")
+    result = None
+    if rc == 0 and os.path.isfile(result_path):
+        with open(result_path) as f:
+            result = json.load(f)
+    print(json.dumps({"restarts": restarts, "rc": rc, "result": result,
+                      "recovery_events": sup.events}))
+    return rc
+
+
+def main(argv=None):
+    args = build_args(argv)
+    if args.role == "supervisor" and args.platform == "cpu":
+        # the supervisor itself never needs an accelerator; pin it to
+        # CPU BEFORE any jax-importing bigdl_tpu module loads
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.role == "worker":
+        return run_worker(args)
+    return run_supervisor(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
