@@ -452,14 +452,64 @@ def run_serve_bench(concurrency=None, per_client=None, hidden=None,
     rps_a = total / wall_a
 
     def _engine_leg(run_dir):
+        import threading
+        import urllib.request
+
+        from bigdl_tpu.observability.metrics import (MetricsExporter,
+                                                     MetricsRegistry,
+                                                     SloTracker)
+
         tel = StepTelemetry(run_dir, run_name="serve", trace=False)
+        # live fleet telemetry (docs/observability.md, "Live metrics &
+        # SLOs"): the same tick events feed a scrapeable registry, and
+        # the record carries the mid-run scrape as evidence that a real
+        # Prometheus poller would have seen the run live
+        registry = MetricsRegistry()
+        tel.attach_metrics(registry)
+        tracker = SloTracker(registry=registry)
+        tracker.add(name="p99_latency", kind="inference",
+                    field="request_latency_s",
+                    threshold=float(env.get("BENCH_SERVE_SLO_MS",
+                                            "250")) / 1e3,
+                    target=0.99, alerts=((5.0, 30.0, 14.4),),
+                    min_samples=20)
+        tracker.bind(tel)
+        exporter = MetricsExporter(registry, port=0,
+                                   health_sources=[tracker.health_status])
+
+        def _get(path, parse=False):
+            body = urllib.request.urlopen(exporter.url + path,
+                                          timeout=10).read().decode()
+            return json.loads(body) if parse else body
+
+        scrape = {}
+
+        def _scraper():          # polls WHILE the closed loop offers load
+            time.sleep(0.2)
+            try:
+                text = _get("/metrics")
+                scrape["serving_series"] = sum(
+                    1 for ln in text.splitlines()
+                    if ln.startswith("bigdl_serving_"))
+                scrape["queue_depth_present"] = \
+                    "bigdl_serving_queue_depth " in text
+                scrape["latency_histogram_present"] = \
+                    "bigdl_serving_request_latency_seconds_bucket" in text
+                scrape["batch_fill_present"] = \
+                    "bigdl_serving_batch_fill " in text
+                scrape["healthz"] = _get("/healthz", parse=True)["status"]
+            except Exception as e:   # recorded, not fatal: the scrape is
+                scrape["error"] = str(e)[:200]   # evidence, not the bench
         eng = ServingEngine(model, max_batch_size=max_batch,
                             max_wait_ms=max_wait_ms, telemetry=tel)
         try:
             precompiles = eng.precompile()
             before = backend_compile_count()
+            scraper = threading.Thread(target=_scraper, daemon=True)
+            scraper.start()
             outs_b, lats_b, wall_b = _closed_loop(eng.predict, xs,
                                                   concurrency, per_client)
+            scraper.join(15)
             recompiles = backend_compile_count() - before
             # identical-outputs witness: a coalesced burst, bit-compared
             # against each request served unbatched at the SAME bucket
@@ -469,12 +519,28 @@ def run_serve_bench(concurrency=None, per_client=None, hidden=None,
             bit_exact = all(
                 np.array_equal(rows[k], eng.predict_at(xs[i], f.bucket))
                 for k, (i, f) in enumerate(zip(idxs, futs)))
+            # SLO-breach drill (the ISSUE-9 acceptance): an objective no
+            # real request can meet burns its budget within one tick and
+            # /healthz flips to degraded, with the durable kind:"slo"
+            # breach event in this leg's telemetry.jsonl
+            healthz_before = _get("/healthz", parse=True)["status"]
+            tracker.add(name="injected_breach", kind="inference",
+                        field="request_latency_s", threshold=0.0,
+                        target=0.999, alerts=((5.0, 10.0, 1.0),),
+                        min_samples=1)
+            for i in range(4):
+                eng.predict(xs[i % len(xs)])
+            healthz_after = _get("/healthz", parse=True)["status"]
+            slo_drill = {"healthz_before": healthz_before,
+                         "healthz_after": healthz_after}
         finally:
             eng.close()
+            exporter.close()
             tel.close()
-        serving = _obs_report_module().build_report(run_dir).get("serving")
+        report = _obs_report_module().build_report(run_dir)
+        slo_drill["slo_events"] = (report.get("slo") or {}).get("events", 0)
         return outs_b, lats_b, wall_b, precompiles, recompiles, bit_exact, \
-            serving
+            report.get("serving"), scrape, slo_drill
 
     import contextlib
 
@@ -482,7 +548,7 @@ def run_serve_bench(concurrency=None, per_client=None, hidden=None,
         else contextlib.nullcontext(out_dir)
     with run_dir as d:
         (outs_b, lats_b, wall_b, precompiles, recompiles, bit_exact,
-         serving) = _engine_leg(d)
+         serving, live_scrape, slo_drill) = _engine_leg(d)
     rps_b = total / wall_b
     # cross-leg outputs agree to float rounding (different bucket shapes
     # pick different XLA reduction blockings; bit-exactness is the
@@ -517,6 +583,8 @@ def run_serve_bench(concurrency=None, per_client=None, hidden=None,
             "bit_exact": bool(bit_exact),
             "outputs_close": bool(outputs_close),
             "serving_report": serving,
+            "live_scrape": live_scrape,
+            "slo_drill": slo_drill,
         },
     }
     print(json.dumps(record), flush=True)

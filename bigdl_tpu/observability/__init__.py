@@ -20,6 +20,13 @@ Four layers over the one shared driver loop:
   MFU math may use) and triangulated trust verdicts
   (``trusted`` / ``suspect:async_dispatch`` / ``invalid:*``) stamped
   on bench records and telemetry streams (``profiling.py``).
+- ``MetricsRegistry`` / ``MetricsExporter`` / ``SloTracker`` -- LIVE
+  fleet telemetry: a dependency-free Counter/Gauge/Histogram registry
+  bridged from the same telemetry events, served over ``/metrics``
+  (Prometheus text) + ``/healthz`` (ok/degraded/halted) by a stdlib
+  http thread, with declarative SLO objectives under multi-window
+  burn-rate alerting feeding the warn/dump/halt policy framework
+  (``metrics.py``).
 
 ``tools/obs_report.py`` merges a run's JSONL + xplane trace into one
 report; the event schema is documented in ``docs/observability.md``.
@@ -29,6 +36,10 @@ from bigdl_tpu.observability.health import (HealthMonitor, dump_incident,
                                             global_grad_norm, layer_labels,
                                             load_incident,
                                             per_layer_grad_norms)
+from bigdl_tpu.observability.metrics import (Counter, Gauge, Histogram,
+                                             MetricsExporter,
+                                             MetricsRegistry, SloObjective,
+                                             SloTracker)
 from bigdl_tpu.observability.profiling import (BlockingStepTimer,
                                                TimingAuditor)
 from bigdl_tpu.observability.spans import SpanTracer, span
@@ -48,4 +59,6 @@ __all__ = [
     "peak_flops", "layer_labels", "per_layer_grad_norms",
     "global_grad_norm", "dump_incident", "load_incident",
     "BlockingStepTimer", "TimingAuditor",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "MetricsExporter", "SloObjective", "SloTracker",
 ]

@@ -47,9 +47,10 @@ SCHEMA_VERSION = 1
 #: fsynced to disk the moment they are recorded (a run that blows up
 #: right after a health anomaly must leave the evidence on disk; a
 #: timing-audit verdict is the line a perf claim stands on; a recovery
-#: event is the record of a restart whose successor may itself die)
+#: event is the record of a restart whose successor may itself die; an
+#: slo breach under the halt policy is about to END the run)
 DURABLE_KINDS = frozenset({"health", "anomaly", "timing_audit",
-                           "recovery"})
+                           "recovery", "slo"})
 
 log = logging.getLogger("bigdl_tpu.observability")
 
@@ -127,7 +128,8 @@ class StepTelemetry:
     """
 
     def __init__(self, out_dir, run_name="train", trace=True,
-                 recompile_warmup_steps=1, memory_window=25):
+                 recompile_warmup_steps=1, memory_window=25,
+                 metrics=None):
         os.makedirs(out_dir, exist_ok=True)
         self.out_dir = out_dir
         self.run_name = run_name
@@ -154,8 +156,52 @@ class StepTelemetry:
         # same run dir: serialize the lazy header write and the JSONL
         # appends (reentrant -- record() calls write_header())
         self._write_lock = threading.RLock()
+        # live-telemetry observers (docs/observability.md, "Live
+        # metrics & SLOs"): every recorded event is offered to each
+        self._observers = []
+        self.metrics = None
+        if metrics is not None:
+            self.attach_metrics(metrics)
 
     # ----- generic event plumbing ----------------------------------------- #
+    def add_observer(self, fn):
+        """Subscribe ``fn(event_dict)`` to every recorded event -- the
+        seam live consumers ride: a ``MetricsRegistry`` bridge turns
+        events into scrapeable series, an ``SloTracker`` classifies
+        them against objectives.  Observers run AFTER the line is on
+        disk; an observer exception is logged and swallowed EXCEPT
+        ``TrainingHaltedError`` -- that is an SLO/watchdog halt policy
+        firing, and it must propagate into the recording loop exactly
+        like a NaN finding does."""
+        self._observers.append(fn)
+        return self
+
+    def attach_metrics(self, registry):
+        """Bridge this run's events onto a live ``MetricsRegistry``
+        (``observability/metrics.py``): serving ticks, training steps,
+        health samples, anomalies and recovery events all become
+        current Prometheus series a ``MetricsExporter`` can serve.
+        Idempotent: re-attaching the registry already bridged (e.g.
+        ``metrics=`` at construction AND an explicit call) must not
+        subscribe it twice and double-count every counter."""
+        if registry is self.metrics:
+            return self
+        self.metrics = registry
+        return self.add_observer(registry.observe_event)
+
+    def _notify(self, event):
+        if not self._observers:
+            return
+        from bigdl_tpu.utils.errors import TrainingHaltedError
+        for fn in self._observers:
+            try:
+                fn(event)
+            except TrainingHaltedError:
+                raise          # a halt-policy breach ends the run
+            except Exception:
+                log.exception("telemetry observer %r failed on a %r "
+                              "event", fn, event.get("kind"))
+
     def record(self, kind, **fields):
         """Append one JSONL event (header is written lazily first).
         Health/anomaly/incident events are additionally fsynced: they
@@ -182,7 +228,12 @@ class StepTelemetry:
                     os.fsync(self._f.fileno())
                 except OSError:  # pragma: no cover - exotic filesystems
                     pass
-            return event
+        # observers run with the line already durable on disk, outside
+        # the write lock where possible (a nested write_header call
+        # still holds it -- the lock is reentrant and observers never
+        # block on telemetry)
+        self._notify(event)
+        return event
 
     def write_header(self, **extra):
         """Run-level metadata event; called lazily before the first step

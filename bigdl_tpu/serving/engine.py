@@ -530,10 +530,20 @@ class ServingEngine:
             return self.telemetry.span(name, **kw)
         return span(name, **kw)
 
+    def _executables(self):
+        """Current executable count of the shared compiled step (the
+        per-tick delta is the live recompile signal: nonzero after
+        ``precompile()`` means a shape leaked past the ladder).
+        ``CompiledEvalStep.executables()`` already owns the
+        can't-report fallback (None where jax lacks the cache API)."""
+        return self._backend.step.executables() or 0
+
     def _run_tick(self, reqs, qdepth):
         t0 = time.perf_counter()
         feats = [r[0] for r in reqs]
         futs: List[ServeFuture] = [r[1] for r in reqs]
+        execs_before = self._executables() \
+            if self.telemetry is not None else 0
         try:
             with self._span("serve_tick", tick=self._tick, records=len(reqs)):
                 n = len(feats)
@@ -561,14 +571,21 @@ class ServingEngine:
         if self.telemetry is not None:
             try:
                 wall = t_done - t0
-                self.telemetry.record(
-                    "inference", step=self._tick, wall_s=wall,
+                event = dict(
+                    step=self._tick, wall_s=wall,
                     data_wait_s=t_formed - t0, device_s=t_done - t_formed,
                     records=n, records_per_s=n / max(wall, 1e-9),
                     queue_depth=qdepth, queue_capacity=self.queue_capacity,
                     bucket=bucket, batch_fill=n / bucket,
                     pad_waste=(bucket - n) / bucket,
                     request_latency_s=[round(f.latency_s, 6) for f in futs])
+                compiles = self._executables() - execs_before
+                if compiles > 0:
+                    # a tick that compiled: after precompile() this is
+                    # a shape leak -- scrapeable live as
+                    # bigdl_serving_recompiles_total
+                    event["compiles"] = compiles
+                self.telemetry.record("inference", **event)
             except Exception:     # results are already delivered --
                 log.exception(    # never let telemetry kill the dispatcher
                     "serving telemetry record failed (tick %d)", self._tick)
@@ -594,6 +611,7 @@ class ServingEngine:
                 reason = _spec_mismatch(self._mstate_spec,
                                         _tree_spec(mstate), "mstate")
             if reason is not None:
+                self._record_refresh("rejected", reason)
                 raise ValueError(
                     f"refresh_params rejected the incoming weights "
                     f"({reason}); the engine keeps serving its current "
@@ -607,6 +625,7 @@ class ServingEngine:
                                     _tree_spec(self.model.parameters()[0]),
                                     "params")
             if reason is not None:
+                self._record_refresh("rejected", reason)
                 raise ValueError(
                     f"refresh_params: the model's weights no longer "
                     f"match the serving contract ({reason}); device "
@@ -614,7 +633,25 @@ class ServingEngine:
         refresh = getattr(self._backend, "refresh_params", None)
         if refresh is not None:
             refresh()
+        self._record_refresh("ok")
         return self
+
+    def _record_refresh(self, outcome, reason=None):
+        """Weight-swap audit trail: every refresh_params outcome (ok or
+        rejected) lands as a ``kind: "param_refresh"`` telemetry event
+        -- the live counter behind it is how a retrain loop's hot-swap
+        cadence (and its rejected half-written checkpoints) shows up on
+        a /metrics scrape."""
+        if self.telemetry is None:
+            return
+        try:
+            fields = {"tick": self._tick, "outcome": outcome,
+                      "backend": self._backend.kind}
+            if reason is not None:
+                fields["reason"] = str(reason)[:300]
+            self.telemetry.record("param_refresh", **fields)
+        except Exception:
+            log.exception("param_refresh telemetry record failed")
 
     def close(self, timeout: Optional[float] = 10.0):
         """Stop accepting requests, drain the queue, join the

@@ -27,7 +27,11 @@ class TestBenchLMContract:
     published number derives from blocked-p50 and a non-trusted (CPU)
     record is forced to ``vs_baseline: 0`` (PR 6's contract)."""
 
+    @pytest.mark.slow
     def test_lm_record_contract(self, capsys):
+        # slow tier (ISSUE-9 re-tier): the 5-leg A/B sweep is ~25s, the
+        # single heaviest tier-1 test; the record-schema surface it pins
+        # only changes when bench.py's LM leg does
         import importlib.util
 
         spec = importlib.util.spec_from_file_location("_t_bench", BENCH)

@@ -92,9 +92,14 @@ class TestWhileLoop:
 
 
 class TestTfCondImport:
+    @pytest.mark.slow
     def test_imported_tf_cond_with_branch_ops(self, tmp_path):
         """tf.cond whose branches contain real ops (not bare Switch
-        pass-throughs) must lower to lax.cond and match TF."""
+        pass-throughs) must lower to lax.cond and match TF.
+
+        Slow tier (ISSUE-9 re-tier): ~15s of TF graph-building; the
+        Switch/Merge unit tests and the tf.while import legs keep the
+        control-flow lowering tier-1."""
         tf = pytest.importorskip("tensorflow")
         g = tf.Graph()
         with g.as_default():

@@ -166,9 +166,14 @@ class TestScanVsUnrolled:
                         jax.tree.leaves(s.parameters()[0])):
             assert np.array_equal(np.asarray(a), np.asarray(b))
 
+    @pytest.mark.slow
     def test_losses_and_grad_norms_agree_over_steps(self):
         """ISSUE-7 acceptance: same init -> losses and per-layer grad
-        norms agree to tolerance over >= 5 optimizer steps."""
+        norms agree to tolerance over >= 5 optimizer steps.
+
+        Slow tier (ISSUE-9 re-tier): ~11s (6 Adam steps on both
+        paths); bit-identical init, the sequential-unit equivalence and
+        the policy-invariance pins keep scan-vs-unrolled tier-1."""
         lu, nu = _train_cached(scan=False)
         ls, ns = _train_cached(scan=True)
         assert len(lu) >= 5

@@ -1034,6 +1034,20 @@ class TestServeBenchSmoke:
         assert x["serial"]["p99_ms"] > 0
         assert x["coalesced"]["p99_ms"] > 0
         assert x["serving_report"]["requests"] >= 12
+        # ISSUE-9 acceptance: the engine was scraped over a real socket
+        # while (or right after) serving, and the injected SLO breach
+        # flipped /healthz to degraded with a durable kind:"slo" event
+        # in the leg's telemetry.jsonl
+        scrape = x["live_scrape"]
+        assert "error" not in scrape, scrape
+        assert scrape["serving_series"] > 0
+        assert scrape["queue_depth_present"] is True
+        assert scrape["latency_histogram_present"] is True
+        assert scrape["batch_fill_present"] is True
+        assert scrape["healthz"] in ("ok", "degraded")
+        drill = x["slo_drill"]
+        assert drill["healthz_after"] == "degraded"
+        assert drill["slo_events"] >= 1
 
     @pytest.mark.slow
     def test_coalescing_doubles_throughput(self):

@@ -22,10 +22,19 @@ the top-N HLO ops by device time.
 
     python tools/obs_report.py runs/resnet50 [--xplane DIR] [--format json]
 
+A ``tools/train_supervised.py`` artifact ROOT (``attempt_<i>/`` dirs +
+``supervisor/``) is accepted directly: the attempts' step events merge
+into one report (with a per-attempt summary) and the supervisor's
+``kind: "recovery"`` events feed the Recovery section -- one command
+covers the whole supervised run instead of one report per attempt.
+
 ``--format json`` emits the same dict the text renderer consumes, with
 non-finite floats mapped to null (strictly valid JSON), so CI and
 bench.py can assert on health/occupancy numbers.  The reader tolerates
 a truncated final JSONL line / undecodable bytes from a crashed run.
+A run dir whose artifacts carry ZERO events worth reporting (no steps,
+no serving/recovery/health/validation) exits nonzero: a hollow report
+silently passing in scripts is how a broken telemetry hookup hides.
 
 No jax import -- the report runs anywhere the artifacts were copied.
 """
@@ -35,6 +44,7 @@ import importlib.util
 import json
 import math
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -279,6 +289,30 @@ def _serving_section(other):
     return sec
 
 
+def _slo_section(other):
+    """Summarize ``kind: "slo"`` events -- the SloTracker's burn-rate
+    breach/resolve edges (docs/observability.md, "Live metrics &
+    SLOs"): per-objective breach counts and whether each objective is
+    still breached at end of run.  None for runs without SLO events."""
+    evs = [e for e in other if e.get("kind") == "slo"]
+    if not evs:
+        return None
+    objectives = {}
+    for e in evs:
+        name = e.get("objective") or "?"
+        rec = objectives.setdefault(
+            name, {"objective": name, "slo": e.get("slo"),
+                   "policy": e.get("policy"), "breaches": 0,
+                   "breached_at_end": False})
+        if e.get("breach"):
+            rec["breaches"] += 1
+            rec["breached_at_end"] = True
+        else:
+            rec["breached_at_end"] = False
+    return {"events": len(evs),
+            "objectives": [objectives[k] for k in sorted(objectives)]}
+
+
 def _recovery_section(other):
     """Summarize ``kind: "recovery"`` events -- the RunSupervisor's
     restart records (docs/robustness.md): one entry per restart (cause,
@@ -341,13 +375,71 @@ def _profiling_section(header, blocked, other, planes, top=10):
     return sec or None
 
 
+def supervisor_sources(run_dir):
+    """A ``tools/train_supervised.py`` artifact root's telemetry files:
+    ordered ``[(attempt_index, jsonl_path)]`` plus the supervisor's own
+    jsonl (or None)."""
+    attempts = []
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return [], None
+    for name in names:
+        m = re.fullmatch(r"attempt_(\d+)", name)
+        p = os.path.join(run_dir, name, "telemetry.jsonl")
+        if m and os.path.isfile(p):
+            attempts.append((int(m.group(1)), p))
+    attempts.sort()
+    sup = os.path.join(run_dir, "supervisor", "telemetry.jsonl")
+    return attempts, (sup if os.path.isfile(sup) else None)
+
+
+def load_supervised_run(run_dir):
+    """Merge a supervised run's attempts into one event stream:
+    -> (header, steps, other, attempts_summary).  Steps concatenate in
+    attempt order (each annotated with its ``attempt``), the
+    supervisor's recovery events ride in ``other``, and the header is
+    the first attempt's (the run's devices/cost provenance)."""
+    attempts, sup = supervisor_sources(run_dir)
+    header, steps, other, summary = None, [], [], []
+    for idx, path in attempts:
+        h, s, o = load_events(path)
+        if header is None:
+            header = h
+        for ev in s:
+            ev["attempt"] = idx
+        steps.extend(s)
+        other.extend(o)
+        summary.append({
+            "attempt": idx, "steps": len(s),
+            "first_step": s[0].get("step") if s else None,
+            "last_step": s[-1].get("step") if s else None,
+            "loss_last": s[-1].get("loss") if s else None,
+        })
+    if sup is not None:
+        _, s_steps, s_other = load_events(sup)
+        other.extend(s_other)      # the recovery events live here
+        steps.extend(s_steps)      # (a supervisor records no steps today)
+    return header, steps, other, summary
+
+
 def build_report(run_dir, xplane_dir=None, top=10):
     jsonl = os.path.join(run_dir, "telemetry.jsonl")
-    if not os.path.isfile(jsonl):
-        raise FileNotFoundError(f"no telemetry.jsonl under {run_dir}")
-    header, steps, other = load_events(jsonl)
+    attempts_summary = None
+    if os.path.isfile(jsonl):
+        header, steps, other = load_events(jsonl)
+    else:
+        # a train_supervised artifact root is a first-class run dir
+        header, steps, other, attempts_summary = \
+            load_supervised_run(run_dir)
+        if not attempts_summary and not other:
+            raise FileNotFoundError(
+                f"no telemetry.jsonl (and no attempt_<i>/ or supervisor/ "
+                f"artifacts) under {run_dir}")
 
     rep = {"run_dir": run_dir, "header": header, "n_steps": len(steps)}
+    if attempts_summary is not None:
+        rep["attempts"] = attempts_summary
     # fenced per-step times, extracted ONCE: the steps block and the
     # profiling section both report from this list
     blocked = sorted(e["step_blocked_s"] for e in steps
@@ -449,6 +541,9 @@ def build_report(run_dir, xplane_dir=None, top=10):
     recovery = _recovery_section(other)
     if recovery:
         rep["recovery"] = recovery
+    slo = _slo_section(other)
+    if slo:
+        rep["slo"] = slo
 
     rep["host_spans"] = span_totals(os.path.join(run_dir, "trace.json"))
 
@@ -491,6 +586,15 @@ def format_report(rep):
             out.append(f"compiled step: {cost['flops_per_step']:.3e} flops, "
                        f"{cost.get('bytes_accessed_per_step', 0):.3e} bytes "
                        "accessed")
+    att = rep.get("attempts")
+    if att is not None:
+        out.append(f"supervised run: {len(att)} attempt(s)")
+        for a in att:
+            loss = a.get("loss_last")
+            out.append(
+                f"  attempt {a['attempt']}: {a['steps']} steps "
+                f"({a.get('first_step')} -> {a.get('last_step')})"
+                + (f", last loss {loss:.6f}" if _finite(loss) else ""))
     s = rep.get("steps")
     if s:
         out.append(f"steps: {rep['n_steps']}  "
@@ -632,6 +736,15 @@ def format_report(rep):
                 f"serving queue depth p50/p90: {sv['queue_depth_p50']}/"
                 f"{sv['queue_depth_p90']}"
                 + (f" (capacity {cap})" if cap is not None else ""))
+    slo = rep.get("slo")
+    if slo:
+        for o in slo["objectives"]:
+            state = "STILL BREACHED at end of run" \
+                if o["breached_at_end"] else "recovered"
+            out.append(
+                f"SLO [{o['objective']}] {o.get('slo')}: "
+                f"{o['breaches']} breach(es), {state} "
+                f"(policy {o.get('policy')})")
     rc = rep.get("recovery")
     if rc:
         cause_str = ", ".join(f"{c} x{n}" for c, n in
@@ -711,7 +824,24 @@ def main(argv=None):
                     help="alias for --format json")
     args = ap.parse_args(argv)
     fmt = args.format or ("json" if args.json else "text")
-    rep = build_report(args.run_dir, xplane_dir=args.xplane, top=args.top)
+    try:
+        rep = build_report(args.run_dir, xplane_dir=args.xplane,
+                           top=args.top)
+    except FileNotFoundError as e:
+        print(f"obs_report: {e}", file=sys.stderr)
+        return 2
+    if rep["n_steps"] == 0 and not any(
+            rep.get(k) for k in ("serving", "recovery", "health",
+                                 "validations", "slo")):
+        # an empty/truncated JSONL must FAIL in scripts, not render a
+        # hollow report: zero step events and nothing else to show
+        # means the run recorded nothing (broken telemetry hookup, or
+        # the wrong directory)
+        print(f"obs_report: {args.run_dir} contains zero step events "
+              f"and no serving/recovery/health/validation events -- "
+              f"nothing to report (is this the right run dir, and was "
+              f"telemetry actually attached?)", file=sys.stderr)
+        return 2
     if fmt == "json":
         print(json.dumps(_json_safe(rep), indent=2, allow_nan=False))
     else:

@@ -63,6 +63,10 @@ def build_args(argv=None):
                     help="deterministic fault injection: kill:<step> "
                          "(first attempt only)")
     ap.add_argument("--maxRestarts", type=int, default=3)
+    ap.add_argument("--metricsPort", type=int, default=None,
+                    help="serve the supervisor's live restart/backoff "
+                         "counters on http://127.0.0.1:PORT/metrics "
+                         "(+ /healthz); 0 auto-assigns a port")
     ap.add_argument("--backoff", type=float, default=0.25,
                     help="exponential backoff base (seconds)")
     ap.add_argument("--backoffMax", type=float, default=10.0)
@@ -172,6 +176,18 @@ def run_supervisor(args):
     os.makedirs(args.out, exist_ok=True)
     tel = StepTelemetry(os.path.join(args.out, "supervisor"),
                         run_name="supervisor", trace=False)
+    exporter = None
+    if args.metricsPort is not None:
+        # live fleet telemetry for the supervisor tier: restart/backoff
+        # counters scrapeable while the drill churns
+        # (docs/observability.md, "Live metrics & SLOs")
+        from bigdl_tpu.observability.metrics import (MetricsExporter,
+                                                     MetricsRegistry)
+        registry = MetricsRegistry()
+        tel.attach_metrics(registry)
+        exporter = MetricsExporter(registry, port=args.metricsPort)
+        print(f"[supervisor] metrics at {exporter.url}/metrics",
+              file=sys.stderr)
     sup = RunSupervisor(max_restarts=args.maxRestarts,
                         backoff_base_s=args.backoff,
                         backoff_max_s=args.backoffMax, telemetry=tel)
@@ -212,6 +228,8 @@ def run_supervisor(args):
         print(f"[supervisor] giving up: {e}", file=sys.stderr)
         restarts, rc = sup.restarts, 2
     finally:
+        if exporter is not None:
+            exporter.close()
         tel.close()
         for f in logs:
             f.close()
