@@ -591,6 +591,143 @@ def run_serve_bench(concurrency=None, per_client=None, hidden=None,
     return record
 
 
+def run_serve_quant_bench(concurrency=None, per_client=None, hidden=None,
+                          max_batch=None, max_wait_ms=None, out_dir=None):
+    """A/B inference serving precision: fp32 vs int8 ``ServingEngine``
+    (ISSUE 11; docs/performance.md, "Int8 inference").
+
+    Both legs run the SAME coalescing engine, ladder and precompile
+    discipline at the same closed-loop offered load; only the serving
+    precision differs (``quantize=True`` + the accuracy-delta gate on
+    the int8 leg).  Knobs (env tier): the ``BENCH_SERVE_*`` family of
+    ``run_serve_bench`` plus ``BENCH_SERVE_INT8_AGREE`` (held-out-batch
+    top-1 agreement the gate requires, default 0.98).
+
+    Prints TWO JSON records:
+
+    - ``serving_int8_rps_ratio`` -- int8-over-fp32 requests/sec at the
+      same offered load.  No floor is promised on CPU (the int8 win is
+      MXU/memory-bandwidth bound; the whitepaper's up-to-2x is a TPU
+      number), so ``vs_baseline`` is the raw ratio: the perf gate
+      tracks it as a host-side A/B ``ratio`` metric and trips on a
+      regression against the checked-in history.
+    - ``serving_int8_model_bytes_ratio`` -- fp32-over-int8 serving-tree
+      bytes; ``vs_baseline`` is over the 3.5x acceptance floor (the
+      whitepaper's ~4x claim minus the fp32 biases/scales the scheme
+      deliberately keeps).
+
+    Both legs must report ``recompiles_after_precompile == 0`` and the
+    int8 leg's ``accuracy_gate.ok`` must be true for the record to mean
+    anything; the tier-1 smoke pins both.
+    """
+    cache_status = _honor_env_platforms()
+    import contextlib
+    import tempfile
+
+    import numpy as np
+
+    from bigdl_tpu.observability import StepTelemetry
+    from bigdl_tpu.observability.watchdogs import backend_compile_count
+    from bigdl_tpu.serving import ServingEngine
+
+    env = os.environ
+    concurrency = (int(env.get("BENCH_SERVE_CONC", "8"))
+                   if concurrency is None else concurrency)
+    per_client = (int(env.get("BENCH_SERVE_REQS", "50"))
+                  if per_client is None else per_client)
+    hidden = (int(env.get("BENCH_SERVE_HIDDEN", "512"))
+              if hidden is None else hidden)
+    max_batch = (int(env.get("BENCH_SERVE_BATCH", str(concurrency)))
+                 if max_batch is None else max_batch)
+    max_wait_ms = (float(env.get("BENCH_SERVE_WAIT_MS", "2"))
+                   if max_wait_ms is None else max_wait_ms)
+    min_agree = float(env.get("BENCH_SERVE_INT8_AGREE", "0.98"))
+
+    model = _serve_model(hidden)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((256, 16)).astype("float32")
+    total = concurrency * per_client
+    _p = _obs_report_module().percentile
+
+    def _leg(run_dir, quantize):
+        tel = StepTelemetry(run_dir, run_name="serve", trace=False)
+        kw = {}
+        if quantize:
+            kw = {"quantize": True,
+                  "accuracy_gate": {"features": xs[:64],
+                                    "min_top1_agreement": min_agree}}
+        eng = ServingEngine(model, max_batch_size=max_batch,
+                            max_wait_ms=max_wait_ms, telemetry=tel, **kw)
+        try:
+            precompiles = eng.precompile()
+            before = backend_compile_count()
+            outs, lats, wall = _closed_loop(eng.predict, xs, concurrency,
+                                            per_client)
+            recompiles = backend_compile_count() - before
+            bytes_ = eng.serving_model_bytes()
+            gate = eng._gate_detail
+        finally:
+            eng.close()
+            tel.close()
+        report = _obs_report_module().build_report(run_dir)
+        serving = {k: v for k, v in (report.get("serving") or {}).items()
+                   if k in ("ticks", "requests", "requests_per_s",
+                            "latency_s_p50", "latency_s_p99",
+                            "pad_waste_fraction", "batch_fill_p50",
+                            "quantized", "weight_dtype", "model_bytes")}
+        return {"requests_per_s": round(total / wall, 1),
+                "p50_ms": round(_p(lats, 50) * 1e3, 3),
+                "p99_ms": round(_p(lats, 99) * 1e3, 3),
+                "model_bytes": bytes_,
+                "precompiles": precompiles,
+                "recompiles_after_precompile": recompiles,
+                "serving_report": serving,
+                "accuracy_gate": gate}, outs
+
+    run_dir = tempfile.TemporaryDirectory() if out_dir is None \
+        else contextlib.nullcontext(out_dir)
+    with run_dir as d:
+        os.makedirs(os.path.join(d, "fp32"), exist_ok=True)
+        os.makedirs(os.path.join(d, "int8"), exist_ok=True)
+        leg_fp, outs_fp = _leg(os.path.join(d, "fp32"), quantize=False)
+        leg_q, outs_q = _leg(os.path.join(d, "int8"), quantize=True)
+    # cross-precision witness: int8 logits track fp32 within the quant
+    # error (the gate's agreement number is the formal check)
+    max_rel = max(
+        float(np.abs(outs_q[k][1] - outs_fp[k][1]).max())
+        for k in outs_fp) / max(
+        float(np.abs(outs_fp[k][1]).max()) for k in outs_fp)
+    ratio = leg_q["requests_per_s"] / max(leg_fp["requests_per_s"], 1e-9)
+    shared_extra = {
+        "compilation_cache": cache_status,
+        "concurrency": concurrency, "requests": total, "hidden": hidden,
+        "max_batch_size": max_batch, "max_wait_ms": max_wait_ms,
+    }
+    rec_rps = {
+        "metric": "serving_int8_rps_ratio",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(ratio, 4),   # no promised floor off-TPU
+        "extra": {**shared_extra,
+                  "fp32": leg_fp, "int8": leg_q,
+                  "logit_max_rel_delta": round(max_rel, 5)},
+    }
+    print(json.dumps(rec_rps), flush=True)
+    bytes_ratio = leg_fp["model_bytes"] / max(leg_q["model_bytes"], 1)
+    rec_bytes = {
+        "metric": "serving_int8_model_bytes_ratio",
+        "value": round(bytes_ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(bytes_ratio / 3.5, 4),   # >= 3.5x floor
+        "extra": {**shared_extra,
+                  "model_bytes_fp32": leg_fp["model_bytes"],
+                  "model_bytes_int8": leg_q["model_bytes"],
+                  "accuracy_gate": leg_q["accuracy_gate"]},
+    }
+    print(json.dumps(rec_bytes), flush=True)
+    return rec_rps, rec_bytes
+
+
 # --------------------------------------------------------------------------- #
 # Quantized-collective micro-benchmark (ISSUE 4): A/B the dp step's wire
 # formats -- fp32 vs bf16 cast vs blockwise int8 + error feedback -- on
@@ -1367,6 +1504,12 @@ def main():
         # wire-format A/B on the dp step: in-process and CPU-runnable
         # (the wire-byte accounting is exact on any device count)
         run_qcomm_bench()
+        return
+    if os.environ.get("BENCH_SERVE_INT8") or "serve-int8" in sys.argv[1:]:
+        # serving-precision A/B (fp32 vs int8 engine): in-process and
+        # CPU-runnable; the bytes ratio is exact anywhere, the rps
+        # ratio is the gateable trajectory metric
+        run_serve_quant_bench()
         return
     if os.environ.get("BENCH_SERVE") or "serve" in sys.argv[1:]:
         # serving A/B (semaphore-serial vs coalesced+bucketed):

@@ -13,6 +13,10 @@ from bigdl_tpu.nn.containers import (
 )
 from bigdl_tpu.nn.graph import Graph, Node, Input
 from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.quantized import (
+    QuantizedLinear, QuantizedSpatialConvolution, model_bytes,
+    quantize_model, quantize_params,
+)
 from bigdl_tpu.nn.conv import (
     SpatialConvolution, SpatialDilatedConvolution, SpatialFullConvolution,
     TemporalConvolution, Conv1D, SpaceToDepthStem, SpatialConvolutionMap,

@@ -108,7 +108,19 @@ class MultiHeadAttention(Module):
     def apply(self, params, state, input, *, training=False, rng=None):
         n, t, d = input.shape
         dt = input.dtype
-        qkv = input @ params["qkv_weight"].astype(dt).T + params["qkv_bias"].astype(dt)
+        if "qkv_weight_q" in params:
+            # post-training-quantized projections (nn/quantized): the
+            # fused qkv and output matmuls -- the layer's MXU work --
+            # contract in int8; attention itself stays in the activation
+            # dtype (softmax in fp32 as always)
+            from bigdl_tpu.nn.quantized import int8_matmul
+
+            qkv = (int8_matmul(input, params["qkv_weight_q"],
+                               params["qkv_scale"])
+                   + params["qkv_bias"]).astype(dt)
+        else:
+            qkv = input @ params["qkv_weight"].astype(dt).T \
+                + params["qkv_bias"].astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (n, t, self.num_heads, self.head_dim)
         if self.seq_axis_name is not None and self.seq_mode == "ulysses":
@@ -135,7 +147,14 @@ class MultiHeadAttention(Module):
             y = dot_product_attention(q.reshape(shape), k.reshape(shape),
                                       v.reshape(shape), causal=self.causal)
         y = y.reshape(n, t, d)
-        y = y @ params["out_weight"].astype(dt).T + params["out_bias"].astype(dt)
+        if "out_weight_q" in params:
+            from bigdl_tpu.nn.quantized import int8_matmul
+
+            y = (int8_matmul(y, params["out_weight_q"], params["out_scale"])
+                 + params["out_bias"]).astype(dt)
+        else:
+            y = y @ params["out_weight"].astype(dt).T \
+                + params["out_bias"].astype(dt)
         if training and self.dropout > 0 and rng is not None:
             keep = 1.0 - self.dropout
             y = jnp.where(jax.random.bernoulli(rng, keep, y.shape),
@@ -166,6 +185,13 @@ class TransformerBlock(Container):
             p, _ = m.setup(child_rng(rng, i), input_spec)
             params[key] = p
         return params, ()
+
+    def _param_child_items(self, params):
+        # params are keyed by ROLE ("ln1".."fc2"), not by child index --
+        # align accordingly so the frozen-mask and quantizer walks reach
+        # the right sublayers
+        return [("ln1", self.ln1), ("attn", self.attn), ("ln2", self.ln2),
+                ("fc1", self.fc1), ("fc2", self.fc2)]
 
     def apply(self, params, state, input, *, training=False, rng=None):
         h, _ = self.ln1.apply(params["ln1"], (), input)
@@ -255,6 +281,19 @@ class TransformerLM(Container):
                 params[f"block{i}"] = p
         params["ln_f"], _ = self.ln_f.setup(child_rng(rng, 99), hid_spec)
         return params, ()
+
+    def _param_child_items(self, params):
+        # params are keyed "block{i}" (unrolled) or "blocks" (the
+        # scan-stacked layout, routed to the ScanLayers child) plus
+        # "ln_f"; wte/wpe/head are this module's OWN leaves and align to
+        # no child (they stay fp32 under the quantizer walk)
+        items = [("ln_f", self.ln_f)]
+        if self.scan is not None:
+            items.append(("blocks", self.scan))
+        else:
+            items.extend((f"block{i}", b)
+                         for i, b in enumerate(self.blocks))
+        return items
 
     def apply(self, params, state, input, *, training=False, rng=None):
         t = input.shape[1]

@@ -89,17 +89,29 @@ class SpatialConvolution(Module):
         x = input
         if self.data_format == "NCHW":
             x = jnp.transpose(x, (0, 2, 3, 1))
-        y = lax.conv_general_dilated(
-            x,
-            params["weight"].astype(x.dtype),
-            window_strides=self.stride,
-            padding=self._padding(),
-            rhs_dilation=self.dilation,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=self.n_group,
-        )
-        if self.with_bias:
-            y = y + params["bias"].astype(y.dtype)
+        if "weight_q" in params:
+            # post-training-quantized weights (nn/quantized): int8 conv
+            # accumulation, bias in fp32, cast back to the input dtype
+            from bigdl_tpu.nn.quantized import int8_conv
+
+            y = int8_conv(x, params["weight_q"], params["scale"],
+                          stride=self.stride, padding=self._padding(),
+                          dilation=self.dilation, groups=self.n_group)
+            if self.with_bias:
+                y = y + params["bias"]
+            y = y.astype(input.dtype)
+        else:
+            y = lax.conv_general_dilated(
+                x,
+                params["weight"].astype(x.dtype),
+                window_strides=self.stride,
+                padding=self._padding(),
+                rhs_dilation=self.dilation,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=self.n_group,
+            )
+            if self.with_bias:
+                y = y + params["bias"].astype(y.dtype)
         if self.data_format == "NCHW":
             y = jnp.transpose(y, (0, 3, 1, 2))
         return y, state

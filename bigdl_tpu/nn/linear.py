@@ -50,6 +50,19 @@ class Linear(Module):
         return params, ()
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        if "weight_q" in params:
+            # post-training-quantized weights (nn/quantized.quantize_params
+            # rewrote this layer's tree): int8 contraction on the MXU,
+            # bias added in fp32 real units, result cast like the float
+            # path.  Reached through the SAME module structure, so the
+            # scan-stacked transformer layout quantizes without any
+            # module swap.
+            from bigdl_tpu.nn.quantized import int8_matmul
+
+            y = int8_matmul(input, params["weight_q"], params["scale"])
+            if self.with_bias:
+                y = y + params["bias"]
+            return y.astype(input.dtype), state
         y = input @ params["weight"].astype(input.dtype).T
         if self.with_bias:
             y = y + params["bias"].astype(input.dtype)

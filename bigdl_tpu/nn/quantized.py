@@ -11,9 +11,37 @@ quantized dynamically per tensor at run time (the reference's runtime
 min/max behaviour).  Expected wins match the reference whitepaper
 (docs/whitepaper.md:192): ~4x model size, up to ~2x inference speed,
 <1% accuracy loss.
+
+Two rewrite paths share the kernels below:
+
+- ``quantize_model(model) -> (qmodel, qparams)`` -- the SERVING path
+  (docs/performance.md, "Int8 inference").  A pure params-level rewrite:
+  matmul/conv weight leaves are replaced by ``weight_q`` (int8) +
+  ``scale`` (fp32 per output channel) pairs that the float layers'
+  quantization-aware ``apply`` consumes (``nn/linear.py``,
+  ``nn/conv.py``, ``nn/attention.py``), and the returned model is a
+  lightweight structural view holding the quantized tree -- the fp32
+  original is NOT mutated, so it keeps serving while the int8 twin
+  stages.  Because the rewrite is keyed off the module tree (via each
+  container's ``_param_child_items`` alignment), one walk covers
+  Sequential-style containers, ``Graph`` DAGs, and ``TransformerLM`` in
+  BOTH param layouts -- unrolled ``"block{i}"`` keys and the
+  scan-stacked ``"blocks"`` layout (stacked leaves quantize per layer x
+  per output channel and slice cleanly inside ``lax.scan``).
+  Embedding tables (``jnp.take`` consumers), the LM head, layernorms
+  and biases stay fp32 by default; ``select=`` narrows further.
+
+- ``quantize(model)`` -- the legacy REFERENCE path
+  (AbstractModule.quantize): mutates a Sequential-style model in place,
+  swapping ``Linear``/``SpatialConvolution`` children for their
+  ``QuantizedLinear``/``QuantizedSpatialConvolution`` twins.  This is
+  the path the protobuf serializer round-trips
+  (interop/bigdl_format.py: weights stored quantized, never
+  re-quantized on load).
 """
 
-from typing import Tuple
+import copy
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,12 +53,36 @@ from bigdl_tpu.nn.module import Container, Module
 
 
 def quantize_weights_per_channel(w, channel_axis: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Symmetric int8 per-output-channel quantization -> (w_int8, scale)."""
+    """Symmetric int8 per-output-channel quantization -> (w_int8, scale).
+
+    ``scale`` keeps the reduced axes as size-1 dims (broadcastable
+    against ``w``); the serving-path rewrite uses
+    :func:`quantize_channelwise` which squeezes them instead."""
     reduce_axes = tuple(a for a in range(w.ndim) if a != channel_axis)
     absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
     scale = jnp.maximum(absmax, 1e-8) / 127.0
     w_q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
     return w_q, scale.astype(jnp.float32)
+
+
+def quantize_channelwise(w, channel_axis: int, lead_axes: int = 0
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-output-channel int8 quantization with stacked leading axes.
+
+    ``lead_axes`` leading dims are per-instance (the scan-stacked layer
+    axis of ``nn.ScanLayers`` params): each [lead x channel] slice gets
+    its own absmax scale, so a stacked tree quantizes exactly as the N
+    per-layer trees would.  Returns ``(w_q int8, scale fp32)`` with
+    ``scale.shape = lead dims + (channels,)`` -- the squeezed layout the
+    quantization-aware applies consume.
+    """
+    assert 0 <= lead_axes <= channel_axis < w.ndim, (w.shape, channel_axis)
+    reduce_axes = tuple(a for a in range(w.ndim)
+                        if a >= lead_axes and a != channel_axis)
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    w_q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return w_q, jnp.squeeze(scale, axis=reduce_axes).astype(jnp.float32)
 
 
 def _quantize_activation(x):
@@ -39,6 +91,35 @@ def _quantize_activation(x):
     scale = jnp.maximum(jnp.max(jnp.abs(x32)), 1e-8) / 127.0
     x_q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
     return x_q, scale
+
+
+def int8_matmul(x, w_q, scale):
+    """``deq(quant(x)) @ deq(w).T`` with the contraction on the MXU in
+    int8: ``x (..., in)`` float, ``w_q (out, in)`` int8, ``scale
+    (out,)`` -- returns fp32 ``(..., out)`` (bias/cast are the
+    caller's).  Shared by ``QuantizedLinear`` and the quantization-aware
+    ``Linear``/``MultiHeadAttention`` applies."""
+    x_q, x_scale = _quantize_activation(x)
+    acc = lax.dot_general(
+        x_q, w_q,
+        (((x_q.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (scale * x_scale)
+
+
+def int8_conv(x_nhwc, w_q, scale, *, stride, padding, dilation, groups):
+    """Int8 NHWC conv: ``x`` float, ``w_q`` HWIO int8, ``scale`` (out,)
+    -> fp32 NHWC accumulation scaled back to real units."""
+    x_q, x_scale = _quantize_activation(x_nhwc)
+    acc = lax.conv_general_dilated(
+        x_q, w_q,
+        window_strides=stride,
+        padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (scale * x_scale)
 
 
 class QuantizedLinear(Module):
@@ -73,12 +154,7 @@ class QuantizedLinear(Module):
         return self._params, ()
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        x_q, x_scale = _quantize_activation(input)
-        acc = lax.dot_general(
-            x_q, params["weight_q"],
-            (((x_q.ndim - 1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32)
-        y = acc.astype(jnp.float32) * (params["scale"] * x_scale)
+        y = int8_matmul(input, params["weight_q"], params["scale"])
         if self.with_bias:
             y = y + params["bias"]
         return y.astype(input.dtype), state
@@ -114,16 +190,9 @@ class QuantizedSpatialConvolution(Module):
         x = input
         if c.data_format == "NCHW":
             x = jnp.transpose(x, (0, 2, 3, 1))
-        x_q, x_scale = _quantize_activation(x)
-        acc = lax.conv_general_dilated(
-            x_q, params["weight_q"],
-            window_strides=c.stride,
-            padding=c._padding(),
-            rhs_dilation=c.dilation,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=c.n_group,
-            preferred_element_type=jnp.int32)
-        y = acc.astype(jnp.float32) * (params["scale"] * x_scale)
+        y = int8_conv(x, params["weight_q"], params["scale"],
+                      stride=c.stride, padding=c._padding(),
+                      dilation=c.dilation, groups=c.n_group)
         if c.with_bias:
             y = y + params["bias"]
         y = y.astype(input.dtype)
@@ -139,14 +208,44 @@ def quantize(model: Module) -> Module:
     Walks Sequential-style containers (children keyed "0".."n") and swaps
     every Linear / SpatialConvolution for its int8 twin, quantizing the
     trained weights in place.  Returns the model (mutated), in eval mode.
+
+    For the non-mutating serving path (Graph / TransformerLM coverage,
+    allow/deny predicate, fp32 original kept intact) use
+    :func:`quantize_model`.
     """
     if not model.is_built():
         raise ValueError("quantize() expects a built (trained/loaded) model")
-    _quantize_children(model)
+    undo = []
+    try:
+        _quantize_children(model, undo)
+    except BaseException:
+        # the in-place rewrite must be ALL-OR-NOTHING: a failure halfway
+        # through (bad weights in one layer, an interrupt) must not
+        # leave the model half-quantized -- replay the swaps backwards
+        # so the caller keeps the exact pre-call model
+        for fn in reversed(undo):
+            fn()
+        raise
     return model.evaluate()
 
 
-def _quantize_children(module: Module):
+def _swap_child(module, i, key, q, undo):
+    # capture the params DICT itself: at undo time a nested container's
+    # temporary ``_params`` binding has already been restored, so a
+    # late ``module._params[key]`` lookup would miss the rewritten tree
+    params = module._params
+    old_child, old_params = module.modules[i], params[key]
+
+    def revert(m=module, i=i, k=key, p=params, oc=old_child, op=old_params):
+        m.modules[i] = oc
+        p[k] = op
+
+    undo.append(revert)
+    module.modules[i] = q
+    params[key] = q._params
+
+
+def _quantize_children(module: Module, undo):
     if not isinstance(module, Container):
         return
     params = module._params
@@ -154,23 +253,175 @@ def _quantize_children(module: Module):
         key = str(i)
         child_params = params.get(key) if isinstance(params, dict) else None
         if isinstance(child, Linear) and child_params:
-            q = QuantizedLinear(child, child_params)
-            module.modules[i] = q
-            params[key] = q._params
+            _swap_child(module, i, key,
+                        QuantizedLinear(child, child_params), undo)
         elif child_params and type(child) in (SpatialConvolution,
                                              SpatialDilatedConvolution):
             # dilated variant included: the int8 conv carries rhs_dilation
             # (reference: nn/quantized/SpatialDilatedConvolution.scala)
-            q = QuantizedSpatialConvolution(child, child_params)
-            module.modules[i] = q
-            params[key] = q._params
+            _swap_child(module, i, key,
+                        QuantizedSpatialConvolution(child, child_params),
+                        undo)
         elif isinstance(child, Container):
-            # push params down so nested containers rewrite their dicts
+            # push params down so nested containers rewrite their dicts;
+            # the child's own binding (None for a container inside a
+            # built parent, or its live tree if it was built standalone)
+            # is restored even when a nested rewrite raises -- the old
+            # unconditional `child._params = None` corrupted a
+            # standalone-built child's binding, and a mid-walk exception
+            # left the borrowed subtree bound
             sub_params = params.get(key) if isinstance(params, dict) else None
             if isinstance(sub_params, dict):
+                prev = child._params
                 child._params = sub_params
-                _quantize_children(child)
-                child._params = None
+                try:
+                    _quantize_children(child, undo)
+                finally:
+                    child._params = prev
+
+
+# --------------------------------------------------------------------------- #
+# The general (non-mutating) post-training quantizer: the serving path.
+# --------------------------------------------------------------------------- #
+
+#: params-key layout of a quantized MultiHeadAttention: fused qkv and
+#: output projections ride the MXU in int8; biases stay fp32
+_MHA_SITES = (("qkv_weight", "qkv_weight_q", "qkv_scale"),
+              ("out_weight", "out_weight_q", "out_scale"))
+
+
+def _quantize_linear_params(params, lead):
+    out = dict(params)
+    w_q, s = quantize_channelwise(params["weight"], lead + 0, lead)
+    del out["weight"]
+    out["weight_q"], out["scale"] = w_q, s
+    return out
+
+
+def _quantize_conv_params(params, lead):
+    out = dict(params)
+    w_q, s = quantize_channelwise(params["weight"], lead + 3, lead)
+    del out["weight"]
+    out["weight_q"], out["scale"] = w_q, s
+    return out
+
+
+def _quantize_mha_params(params, lead):
+    out = dict(params)
+    for fp_key, q_key, s_key in _MHA_SITES:
+        w_q, s = quantize_channelwise(params[fp_key], lead + 0, lead)
+        del out[fp_key]
+        out[q_key], out[s_key] = w_q, s
+    return out
+
+
+def quantize_params(model: Module, params=None,
+                    select: Optional[Callable] = None):
+    """Post-training weight quantization of a param tree -> a NEW tree.
+
+    Walks ``model``'s module structure in parallel with ``params``
+    (default: the model's own) via each container's
+    ``_param_child_items`` alignment and rewrites every quantizable
+    site's weight leaf to ``weight_q`` (int8, per-output-channel
+    symmetric) + ``scale`` (fp32).  Quantizable sites:
+
+    - ``Linear`` (weight ``(out, in)``, channel axis 0),
+    - ``SpatialConvolution`` / ``SpatialDilatedConvolution`` (HWIO,
+      channel axis 3; exact types only -- subclasses like
+      ``SpaceToDepthStem`` restructure the weight inside ``apply``),
+    - ``MultiHeadAttention`` (fused ``qkv_weight`` and ``out_weight``,
+      channel axis 0).
+
+    Everything else -- embedding tables, positional tables, the LM
+    head, layernorm gains, biases -- passes through fp32 unchanged.
+    Inside ``nn.ScanLayers`` the stacked subtree quantizes with a
+    per-layer leading axis, so scan-compiled ``TransformerLM``
+    checkpoints quantize without unstacking.
+
+    ``select(path, module) -> bool`` is the allow/deny predicate over
+    quantizable sites (path like ``"block0.fc1"`` or ``"blocks.attn"``;
+    return False to keep that site fp32).  The input tree is never
+    mutated.
+    """
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+    from bigdl_tpu.nn.containers import ScanLayers
+
+    if params is None:
+        if not model.is_built():
+            raise ValueError(
+                "quantize_params() expects a built model or an explicit "
+                "params tree")
+        params = model.parameters()[0]
+
+    def walk(m, p, path, lead):
+        if isinstance(p, dict):
+            if type(m) is Linear and "weight" in p:
+                if select is None or select(path, m):
+                    return _quantize_linear_params(p, lead)
+                return p
+            if type(m) in (SpatialConvolution, SpatialDilatedConvolution) \
+                    and "weight" in p:
+                if select is None or select(path, m):
+                    return _quantize_conv_params(p, lead)
+                return p
+            if type(m) is MultiHeadAttention and "qkv_weight" in p:
+                if select is None or select(path, m):
+                    return _quantize_mha_params(p, lead)
+                return p
+        if not isinstance(m, Container) or not isinstance(p, dict):
+            return p
+        items = m._param_child_items(p)
+        if len(items) == 1 and items[0][0] is None:
+            # the whole subtree belongs to one shared child: MapTable
+            # (shared params, same rank) or ScanLayers (layer-stacked
+            # leaves -- one more leading per-layer axis below here)
+            return walk(items[0][1], p, path,
+                        lead + (1 if isinstance(m, ScanLayers) else 0))
+        by_key = dict(items)
+        out = {}
+        for k, v in p.items():
+            child = by_key.get(k)
+            if child is None:
+                out[k] = v          # the container's OWN leaves stay fp32
+            else:
+                out[k] = walk(child, v, f"{path}.{k}" if path else k, lead)
+        return out
+
+    return walk(model, params, "", 0)
+
+
+def quantize_model(model: Module, params=None,
+                   select: Optional[Callable] = None):
+    """Post-training quantization for serving -> a NEW ``(qmodel,
+    qparams)`` pair; the fp32 original is untouched and keeps serving
+    while the int8 twin stages (docs/performance.md, "Int8 inference").
+
+    ``qparams`` is :func:`quantize_params` applied to ``params``
+    (default: the model's current weights).  ``qmodel`` is a
+    lightweight structural view of ``model`` bound to ``qparams``: the
+    module tree (and eval state) is shared -- the quantization-aware
+    ``apply`` of Linear/conv/attention consumes the int8 leaves -- but
+    the compiled-eval-step cache is NOT shared, so the int8 executables
+    never mix with (or evict) the fp32 model's.
+    """
+    if not model.is_built():
+        raise ValueError("quantize_model() expects a built model")
+    qparams = quantize_params(model, params, select)
+    qmodel = copy.copy(model)
+    qmodel._params = qparams
+    qmodel._grads = None
+    qmodel.train_mode = False
+    # each model owns its executables (validation.compiled_eval_step
+    # caches ON the instance); sharing would key int8 and fp32 steps
+    # into one bound
+    qmodel.__dict__.pop("_compiled_eval_steps", None)
+    return qmodel, qparams
+
+
+def quantized_leaf_count(params) -> int:
+    """Number of int8 leaves in a tree (0 = nothing quantized)."""
+    return sum(1 for l in jax.tree.leaves(params)
+               if getattr(l, "dtype", None) == jnp.int8)
 
 
 def model_bytes(params) -> int:

@@ -149,6 +149,7 @@ class StepTelemetry:
         self._cost = None
         self._compiled_step = None
         self._timing = None
+        self._serving_info = None
         self._wrote_header = False
         self._closed = False
         # a ServingEngine records inference events from its dispatcher
@@ -264,6 +265,11 @@ class StepTelemetry:
                 # "blocking", step_blocked_s is the trust basis for any
                 # MFU derived from this run's events
                 fields["timing"] = self._timing
+            if self._serving_info is not None:
+                # which precision serves this run (ServingEngine stamps
+                # it: quantized flag, weight dtype, model bytes) -- the
+                # obs_report Serving section reads this
+                fields["serving"] = self._serving_info
             if self._cost:
                 fields["cost"] = self._cost
             if self._compiled_step:
@@ -290,6 +296,24 @@ class StepTelemetry:
             self._timing = timing
             if self._wrote_header:
                 return self.record("timing", timing=timing)
+        return None
+
+    def set_serving_info(self, info):
+        """Stamp the serving precision block on the header:
+        ``serving: {quantized, weight_dtype, model_bytes, ...}``
+        (``ServingEngine`` calls this at construction and after every
+        successful ``refresh_params``).  If the header already went out
+        (e.g. the engine shares a run with a training driver whose
+        ``attach_cost`` wrote it first), a standalone
+        ``kind: "serving_info"`` event records it instead -- obs_report
+        reads both (docs/observability.md, "Serving telemetry")."""
+        info = dict(info)
+        with self._write_lock:
+            if self._serving_info == info:
+                return None
+            self._serving_info = info
+            if self._wrote_header:
+                return self.record("serving_info", serving=info)
         return None
 
     @property

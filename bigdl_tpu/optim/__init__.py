@@ -12,8 +12,8 @@ from bigdl_tpu.optim.regularizer import (
 from bigdl_tpu.optim.lbfgs import LBFGS, line_search_wolfe
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
-    ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss,
-    MAE, HitRatio, NDCG, TreeNNAccuracy,
+    AccuracyDeltaGate, ValidationMethod, ValidationResult, Top1Accuracy,
+    Top5Accuracy, Loss, MAE, HitRatio, NDCG, TreeNNAccuracy,
 )
 from bigdl_tpu.optim.train_step import make_train_step, make_eval_step
 from bigdl_tpu.optim.local_optimizer import (

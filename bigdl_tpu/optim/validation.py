@@ -141,6 +141,103 @@ def compiled_eval_step(model, compute_dtype=None) -> CompiledEvalStep:
     return fn
 
 
+class AccuracyDeltaGate:
+    """fp32-vs-quantized divergence check on a held-out batch -- the
+    honesty gate of the int8 serving path (docs/performance.md, "Int8
+    inference").
+
+    The whitepaper's claim for the int8 backend is <1% accuracy loss;
+    this gate makes that a PRECONDITION of serving instead of a hope: a
+    candidate eval step (int8) is compared against the reference step
+    (fp32) on one held-out batch, and a swap whose divergence exceeds
+    the configured tolerance is REFUSED -- ``ServingEngine(quantize=...,
+    accuracy_gate=...)`` routes the refusal through the
+    ``param_refresh`` rejected-with-reason audit path, so the engine
+    keeps serving the previous weights and the rejection is a durable,
+    scrapeable event.
+
+    Checks (any configured to ``None`` is skipped):
+
+    - ``min_top1_agreement``: fraction of batch rows whose argmax class
+      matches between the two steps (labels not needed);
+    - ``max_top1_accuracy_drop``: with ``labels``, the int8 top-1
+      accuracy may trail fp32 by at most this much (the whitepaper's
+      <1% framing -- default gate when labels are supplied);
+    - ``max_logit_rmse``: RMSE between the two logit tensors, for
+      regression-style outputs where argmax is meaningless.
+
+    ``check(ref_eval, cand_eval)`` takes two callables ``x -> output``
+    already bound to their params (the engine binds its fp32 model and
+    its int8 backend) and returns ``(ok, detail)`` where ``detail`` is
+    a JSON-safe dict (stamped on the refresh audit event).  Multi-output
+    models gate on the FIRST output leaf.
+    """
+
+    def __init__(self, features, labels=None, *, min_top1_agreement=0.99,
+                 max_top1_accuracy_drop=0.01, max_logit_rmse=None):
+        self.features = features
+        self.labels = None if labels is None else np.asarray(labels)
+        self.min_top1_agreement = min_top1_agreement
+        self.max_top1_accuracy_drop = max_top1_accuracy_drop
+        self.max_logit_rmse = max_logit_rmse
+        if min_top1_agreement is None and max_logit_rmse is None and \
+                (labels is None or max_top1_accuracy_drop is None):
+            raise ValueError(
+                "AccuracyDeltaGate with every tolerance disabled gates "
+                "nothing: set min_top1_agreement, max_logit_rmse, or "
+                "labels + max_top1_accuracy_drop")
+
+    @staticmethod
+    def _logits(out):
+        import jax
+
+        leaves = jax.tree.leaves(out)
+        return np.asarray(leaves[0])
+
+    def check(self, ref_eval, cand_eval):
+        """-> (ok, detail).  ``detail["reason"]`` names the first failed
+        tolerance when not ok."""
+        ref = self._logits(ref_eval(self.features))
+        cand = self._logits(cand_eval(self.features))
+        n = ref.shape[0]
+        detail = {"batch": int(n)}
+        delta = cand.astype(np.float64) - ref.astype(np.float64)
+        detail["logit_rmse"] = float(np.sqrt(np.mean(delta ** 2)))
+        detail["logit_max_abs_delta"] = float(np.abs(delta).max())
+        ref_top1 = np.argmax(ref.reshape(n, -1), axis=-1)
+        cand_top1 = np.argmax(cand.reshape(n, -1), axis=-1)
+        detail["top1_agreement"] = float(np.mean(ref_top1 == cand_top1))
+        if self.labels is not None:
+            labels = self.labels.reshape(-1).astype(ref_top1.dtype)
+            detail["top1_accuracy_ref"] = float(np.mean(ref_top1 == labels))
+            detail["top1_accuracy_candidate"] = \
+                float(np.mean(cand_top1 == labels))
+            detail["top1_accuracy_drop"] = round(
+                detail["top1_accuracy_ref"]
+                - detail["top1_accuracy_candidate"], 6)
+        reason = None
+        if self.min_top1_agreement is not None and \
+                detail["top1_agreement"] < self.min_top1_agreement:
+            reason = (f"top-1 agreement {detail['top1_agreement']:.4f} < "
+                      f"required {self.min_top1_agreement} on the "
+                      f"{n}-sample held-out batch")
+        elif self.labels is not None and \
+                self.max_top1_accuracy_drop is not None and \
+                detail["top1_accuracy_drop"] > self.max_top1_accuracy_drop:
+            reason = (f"top-1 accuracy drop {detail['top1_accuracy_drop']:.4f}"
+                      f" > allowed {self.max_top1_accuracy_drop} "
+                      f"(fp32 {detail['top1_accuracy_ref']:.4f} -> "
+                      f"candidate {detail['top1_accuracy_candidate']:.4f})")
+        elif self.max_logit_rmse is not None and \
+                detail["logit_rmse"] > self.max_logit_rmse:
+            reason = (f"logit RMSE {detail['logit_rmse']:.6g} > allowed "
+                      f"{self.max_logit_rmse}")
+        detail["ok"] = reason is None
+        if reason is not None:
+            detail["reason"] = reason
+        return detail["ok"], detail
+
+
 class ValidationResult:
     """Mergeable (numerator, denominator) pair (reference: AccuracyResult)."""
 

@@ -8,9 +8,15 @@ reference's ``optim/PredictionService.scala`` instance pool).
   round-robin as the fallback.
 - ``BucketLadder`` -- the shape ladder (batch and, for sequence
   models, length buckets).
+- ``ServingEngine(quantize=True, accuracy_gate=...)`` -- the int8
+  serving path: the model's post-training-quantized twin serves on the
+  same machinery, fp32 checkpoints quantize at ``refresh_params`` swap
+  time, and an ``optim.validation.AccuracyDeltaGate`` rejects swaps
+  whose fp32-vs-int8 divergence exceeds tolerance.
 
-See docs/performance.md ("Inference serving") and docs/observability.md
-(extended ``kind: "inference"`` event schema).
+See docs/performance.md ("Inference serving", "Int8 inference") and
+docs/observability.md (extended ``kind: "inference"`` event schema,
+serving-precision header stamp).
 """
 
 from bigdl_tpu.serving.buckets import BucketLadder

@@ -71,6 +71,7 @@ class _LocalEval:
 
     kind = "local"
     align = 1
+    replicas = 1
 
     def __init__(self, model, compute_dtype=None):
         self.model = model
@@ -101,6 +102,7 @@ class _ShardedEval:
         self.mesh = mesh
         self.axis = axis
         self.align = int(mesh.shape[axis])
+        self.replicas = int(mesh.shape[axis])
         self.step = compiled_eval_step(model, compute_dtype)
         self._batch_sharding = NamedSharding(mesh, P(axis))
         self._rep = NamedSharding(mesh, P())
@@ -138,6 +140,7 @@ class _RoundRobinEval:
     def __init__(self, model, devices=None, compute_dtype=None):
         self.model = model
         self.devices = list(devices) if devices else jax.local_devices()
+        self.replicas = len(self.devices)
         self.step = compiled_eval_step(model, compute_dtype)
         self.refresh_params()
 
@@ -227,6 +230,24 @@ class ServingEngine:
     tick's requests -- the exception is set on each of its futures (so
     every affected caller sees it) and the dispatcher keeps serving
     subsequent traffic.
+
+    ``quantize=True`` serves the model's int8 post-training-quantized
+    twin (``nn.quantized.quantize_model``) instead of the fp32 original
+    on the SAME layout/ladder/precompile machinery: ~4x smaller device
+    weights, int8 MXU matmuls, zero steady-state recompiles.  The fp32
+    model object stays untouched and remains the refresh contract:
+    ``refresh_params`` takes fp32 checkpoints and quantizes them at swap
+    time (on the sharded mesh the staged replica tree is the int8
+    payload+scales -- the blockwise-int8 wire stance of the PR 4
+    collectives applied to the weight gather, EQuARX-style -- with the
+    moved bytes recorded on the ``param_refresh`` audit event).  Pass a
+    callable to use it as the quantizer's allow/deny ``select``
+    predicate.  ``accuracy_gate`` (an
+    ``optim.validation.AccuracyDeltaGate``, or a dict of its kwargs)
+    compares fp32-vs-int8 outputs on a held-out batch at construction
+    AND at every refresh: a swap whose divergence exceeds the tolerance
+    is rejected through the ``param_refresh`` rejected-with-reason path
+    and the engine keeps serving its current weights.
     """
 
     def __init__(self, model, max_batch_size: int = 32,
@@ -237,7 +258,8 @@ class ServingEngine:
                  feature_padding: Optional[PaddingParam] = None,
                  compute_dtype=None, mesh=None, axis: str = "data",
                  round_robin: bool = False, telemetry=None,
-                 max_executables: Optional[int] = None):
+                 max_executables: Optional[int] = None,
+                 quantize=False, accuracy_gate=None):
         if not model.is_built():
             raise ValueError("build the model (or train it) before serving")
         if max_batch_size < 1:
@@ -248,20 +270,41 @@ class ServingEngine:
             raise ValueError(f"queue_capacity must be >= 1, got "
                              f"{queue_capacity}")
         self.model = model
+        self._compute_dtype = compute_dtype
         # the serving contract frozen at construction: refresh_params
         # validates any later weight swap against THIS tree structure +
         # shapes BEFORE touching the device caches, so a half-written
         # checkpoint mid-retrain raises cleanly and the engine keeps
-        # serving the old weights (docs/robustness.md)
+        # serving the old weights (docs/robustness.md).  The contract is
+        # always the FP32 tree -- a quantized engine still swaps fp32
+        # checkpoints in, quantizing them itself at staging time.
         self._params_spec = _tree_spec(model.parameters()[0])
         self._mstate_spec = _tree_spec(model.state())
+        self._quantized = bool(quantize)
+        self._qselect = quantize if callable(quantize) else None
+        if accuracy_gate is not None and not self._quantized:
+            raise ValueError(
+                "accuracy_gate compares the fp32 model against its int8 "
+                "twin; it needs quantize=... to have a candidate to gate")
+        self._gate = self._make_gate(accuracy_gate)
+        if self._quantized:
+            from bigdl_tpu.nn.quantized import quantize_model
+
+            # the int8 serving twin: same module tree, quantized params,
+            # its own compiled-step cache; self.model stays fp32
+            self._qmodel, _ = quantize_model(model, select=self._qselect)
+            serve_model = self._qmodel
+        else:
+            self._qmodel = None
+            serve_model = model
         if mesh is not None and int(mesh.shape[axis]) > 1:
-            self._backend = _ShardedEval(model, mesh, axis, compute_dtype)
+            self._backend = _ShardedEval(serve_model, mesh, axis,
+                                         compute_dtype)
         elif round_robin and len(jax.local_devices()) > 1:
-            self._backend = _RoundRobinEval(model,
+            self._backend = _RoundRobinEval(serve_model,
                                             compute_dtype=compute_dtype)
         else:
-            self._backend = _LocalEval(model, compute_dtype)
+            self._backend = _LocalEval(serve_model, compute_dtype)
         align = self._backend.align
         self.max_batch_size = -(-int(max_batch_size) // align) * align
         self.max_wait_s = float(max_wait_ms) / 1e3
@@ -299,6 +342,22 @@ class ServingEngine:
         self._not_full = threading.Condition(self._lock)
         self._running = True
         self._tick = 0
+        self._gate_detail = None
+        if self._gate is not None:
+            # the INITIAL quantization must clear the same bar a later
+            # hot-swap would: a model this quantizer damages beyond
+            # tolerance never starts serving int8 at all
+            ok, detail = self._check_accuracy(model.parameters()[0],
+                                              model.state())
+            self._gate_detail = detail
+            if not ok:
+                self._record_refresh("rejected", detail.get("reason"),
+                                     accuracy_gate=detail)
+                raise ValueError(
+                    f"accuracy gate refused the initial int8 "
+                    f"quantization ({detail.get('reason')}); serve fp32 "
+                    f"or relax the gate tolerances")
+        self._stamp_serving_info()
         self._dispatcher = threading.Thread(
             target=self._loop, name="bigdl-serving-dispatcher", daemon=True)
         self._dispatcher.start()
@@ -590,6 +649,93 @@ class ServingEngine:
                 log.exception(    # never let telemetry kill the dispatcher
                     "serving telemetry record failed (tick %d)", self._tick)
 
+    # ----- int8 path: gate + staging helpers -------------------------------- #
+    @property
+    def quantized(self) -> bool:
+        """Whether this engine serves the int8 twin (the precision that
+        actually answers requests -- stamped on the telemetry header)."""
+        return self._quantized
+
+    def serving_model_bytes(self) -> int:
+        """Bytes of the weight tree the backend serves from (the int8
+        payload+scales tree when quantized, the fp32 tree otherwise)."""
+        from bigdl_tpu.nn.quantized import model_bytes
+
+        src = self._qmodel if self._quantized else self.model
+        return model_bytes(src.parameters()[0])
+
+    @staticmethod
+    def _make_gate(accuracy_gate):
+        if accuracy_gate is None:
+            return None
+        from bigdl_tpu.optim.validation import AccuracyDeltaGate
+
+        if isinstance(accuracy_gate, AccuracyDeltaGate):
+            return accuracy_gate
+        if isinstance(accuracy_gate, dict):
+            return AccuracyDeltaGate(**accuracy_gate)
+        raise ValueError(
+            f"accuracy_gate must be an AccuracyDeltaGate or a dict of "
+            f"its kwargs, got {type(accuracy_gate).__name__}")
+
+    def _gate_eval(self, step, params, mstate):
+        """Bind ``step`` into the gate's ``x -> logits`` callable.  The
+        held-out batch is padded to its ladder bucket (and the result
+        sliced back), so the int8 side reuses a precompiled executable
+        where possible -- gate evals run at swap time, never on the
+        request path."""
+        def run(x):
+            x = jax.tree.map(np.asarray, x)
+            n = jax.tree.leaves(x)[0].shape[0]
+            bucket = self.ladder.bucket_for(n)
+            xb = x if bucket is None or bucket == n \
+                else pad_batch_axis(x, bucket)
+            y = step(params, mstate, xb)
+            return jax.tree.map(lambda a: np.asarray(a)[:n], y)
+        return run
+
+    def _check_accuracy(self, fp_params, fp_mstate, qparams=None):
+        """fp32-vs-int8 gate on a CANDIDATE weight set (nothing is
+        committed here): quantize ``fp_params`` unless the int8 tree is
+        supplied, run both eval steps on the held-out batch, return
+        ``(ok, detail)``."""
+        if qparams is None:
+            from bigdl_tpu.nn.quantized import quantize_params
+
+            qparams = quantize_params(self.model, fp_params, self._qselect)
+        from bigdl_tpu.optim.validation import compiled_eval_step
+
+        ref_step = compiled_eval_step(self.model, self._compute_dtype)
+        ok, detail = self._gate.check(
+            self._gate_eval(ref_step, fp_params, fp_mstate),
+            self._gate_eval(self._backend.step, qparams, fp_mstate))
+        return ok, detail
+
+    def _stamp_serving_info(self):
+        """Satellite of the int8 path: the telemetry header (or a
+        standalone ``serving_info`` event when the header already went
+        out) states which precision served this run -- quantized flag,
+        weight dtype, serving-tree bytes (and the fp32 bytes it
+        replaced), backend layout (docs/observability.md, "Serving
+        telemetry")."""
+        if self.telemetry is None:
+            return
+        from bigdl_tpu.nn.quantized import model_bytes
+
+        info = {"quantized": self._quantized,
+                "weight_dtype": "int8" if self._quantized else "float32",
+                "model_bytes": self.serving_model_bytes(),
+                "backend": self._backend.kind,
+                "replicas": self._backend.replicas}
+        if self._quantized:
+            info["model_bytes_fp32"] = model_bytes(self.model.parameters()[0])
+        if self._gate_detail is not None:
+            info["accuracy_gate"] = self._gate_detail
+        try:
+            self.telemetry.set_serving_info(info)
+        except Exception:
+            log.exception("serving_info telemetry stamp failed")
+
     # ----- lifecycle -------------------------------------------------------- #
     def refresh_params(self, params=None, mstate=None):
         """Swap in retrained weights and re-replicate the device caches
@@ -603,8 +749,19 @@ class ServingEngine:
         weights untouched.  Without arguments (the historical spelling:
         caller already mutated ``self.model``), the model's CURRENT
         params are validated against the engine's construction-time
-        spec before the device caches re-replicate."""
-        if params is not None:
+        spec before the device caches re-replicate.
+
+        On a quantized engine the incoming checkpoint is ALWAYS fp32
+        (the training side's tree): it is quantized here at swap time,
+        gated by ``accuracy_gate`` (a failing gate rejects the swap
+        through the same rejected-with-reason audit path and the old
+        weights keep serving), and the tree staged onto the devices is
+        the int8 payload+scales -- the ``param_refresh`` event records
+        ``model_bytes`` and the replica-staging ``wire_bytes`` it moved
+        in that blockwise-int8 wire stance (docs/performance.md, "Int8
+        inference")."""
+        incoming = params is not None
+        if incoming:
             reason = _spec_mismatch(self._params_spec, _tree_spec(params),
                                     "params")
             if reason is None and mstate is not None:
@@ -617,12 +774,9 @@ class ServingEngine:
                     f"({reason}); the engine keeps serving its current "
                     "weights -- is the source checkpoint half-written "
                     "or from a different model?")
-            self.model.set_parameters(params)
-            if mstate is not None:
-                self.model.set_state(mstate)
         else:
-            reason = _spec_mismatch(self._params_spec,
-                                    _tree_spec(self.model.parameters()[0]),
+            params = self.model.parameters()[0]
+            reason = _spec_mismatch(self._params_spec, _tree_spec(params),
                                     "params")
             if reason is not None:
                 self._record_refresh("rejected", reason)
@@ -630,23 +784,68 @@ class ServingEngine:
                     f"refresh_params: the model's weights no longer "
                     f"match the serving contract ({reason}); device "
                     "caches left untouched")
+        from bigdl_tpu.nn.quantized import model_bytes
+
+        qparams, gate_detail, audit = None, None, {}
+        if self._quantized:
+            from bigdl_tpu.nn.quantized import quantize_params
+
+            # stage WITHOUT committing: quantize the candidate, gate it,
+            # and only then touch the models / device caches
+            qparams = quantize_params(self.model, params, self._qselect)
+            stage_mstate = mstate if mstate is not None \
+                else self.model.state()
+            if self._gate is not None:
+                ok, gate_detail = self._check_accuracy(params, stage_mstate,
+                                                       qparams)
+                if not ok:
+                    reason = ("accuracy gate: "
+                              + gate_detail.get("reason", "failed"))
+                    self._record_refresh("rejected", reason,
+                                         accuracy_gate=gate_detail)
+                    raise ValueError(
+                        f"refresh_params rejected the incoming weights "
+                        f"({reason}); the engine keeps serving its "
+                        "current weights")
+                self._gate_detail = gate_detail
+            audit["model_bytes"] = model_bytes(qparams)
+            audit["quantized"] = True
+        else:
+            audit["model_bytes"] = model_bytes(params)
+        # bytes the swap stages onto devices: one serving tree per
+        # replica (mesh size for sharded, device count for round-robin)
+        audit["wire_bytes"] = audit["model_bytes"] * self._backend.replicas
+        if incoming:
+            self.model.set_parameters(params)
+            if mstate is not None:
+                self.model.set_state(mstate)
+        if qparams is not None:
+            self._qmodel.set_parameters(qparams)
+            # the twin shares the eval state tree; re-sync in case the
+            # refresh (or the caller, in the no-arg spelling) moved it
+            self._qmodel.set_state(self.model.state())
         refresh = getattr(self._backend, "refresh_params", None)
         if refresh is not None:
             refresh()
-        self._record_refresh("ok")
+        if gate_detail is not None:
+            audit["accuracy_gate"] = gate_detail
+        self._record_refresh("ok", **audit)
+        self._stamp_serving_info()
         return self
 
-    def _record_refresh(self, outcome, reason=None):
+    def _record_refresh(self, outcome, reason=None, **extra):
         """Weight-swap audit trail: every refresh_params outcome (ok or
         rejected) lands as a ``kind: "param_refresh"`` telemetry event
         -- the live counter behind it is how a retrain loop's hot-swap
         cadence (and its rejected half-written checkpoints) shows up on
-        a /metrics scrape."""
+        a /metrics scrape.  ``extra`` carries the int8 staging evidence:
+        ``model_bytes`` / ``wire_bytes`` of the staged tree, the
+        ``quantized`` stamp and the ``accuracy_gate`` detail."""
         if self.telemetry is None:
             return
         try:
             fields = {"tick": self._tick, "outcome": outcome,
-                      "backend": self._backend.kind}
+                      "backend": self._backend.kind, **extra}
             if reason is not None:
                 fields["reason"] = str(reason)[:300]
             self.telemetry.record("param_refresh", **fields)

@@ -304,3 +304,78 @@ class TestObsReportHollowRuns:
         assert obs.main([str(run)]) == 0
         out = capsys.readouterr().out
         assert "SLO [p99_latency]" in out and "recovered" in out
+
+
+def _serve_record(value, metric="serving_int8_rps_ratio"):
+    """The BENCH_SERVE_INT8 A/B shape: a host-side ratio -- no platform
+    claim, no per-step timing claim -- so the timing taxonomy does not
+    apply and the gate classes it ``ratio``."""
+    return {"metric": metric, "value": value, "unit": "x",
+            "vs_baseline": value,
+            "extra": {"concurrency": 8, "requests": 400,
+                      "fp32": {"requests_per_s": 9000.0, "p99_ms": 1.5,
+                               "recompiles_after_precompile": 0},
+                      "int8": {"requests_per_s": 9000.0 * value,
+                               "p99_ms": 1.7,
+                               "recompiles_after_precompile": 0,
+                               "accuracy_gate": {"ok": True}}}}
+
+
+class TestServeInt8Records:
+    """ISSUE-11 satellite: the BENCH_SERVE int8 A/B's req/s metric rides
+    the trusted trajectory as a ``ratio`` record, so an int8 serving
+    regression trips the gate exactly like an MFU regression."""
+
+    def test_serve_ab_classes_as_ratio_and_sets_baseline(self, gate,
+                                                         tmp_path):
+        assert gate.classify_trust(_serve_record(1.0)) == "ratio"
+        d = _bench_dir(tmp_path, {
+            "BENCH_r06.json": _wrapper([_serve_record(1.01)], n=6),
+        })
+        traj = gate.build_trajectory(d)
+        entries = traj["metrics"]["serving_int8_rps_ratio"]
+        assert entries[0]["trust"] == "ratio"
+        assert entries[0]["baseline_eligible"] is True
+        assert gate.main(["--dir", d]) == 0
+
+    def test_int8_rps_regression_trips_the_gate(self, gate, tmp_path,
+                                                capsys):
+        d = _bench_dir(tmp_path, {
+            "BENCH_r06.json": _wrapper([_serve_record(1.0)], n=6),
+            "BENCH_r07.json": _wrapper([_serve_record(0.6)], n=7),
+        })
+        rc = gate.main(["--dir", d])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "serving_int8_rps_ratio" in out and "gate: FAIL" in out
+        # and a --check candidate regressing the serve baseline fails too
+        (tmp_path / "h2").mkdir()
+        d2 = _bench_dir(tmp_path / "h2", {
+            "BENCH_r06.json": _wrapper([_serve_record(1.0)], n=6)})
+        cand = tmp_path / "BENCH_cand.json"
+        cand.write_text(json.dumps(_serve_record(0.5)))
+        assert gate.main(["--dir", d2, "--check", str(cand)]) == 1
+        cand.write_text(json.dumps(_serve_record(0.99)))
+        assert gate.main(["--dir", d2, "--check", str(cand)]) == 0
+
+    def test_checked_in_r06_is_baseline_eligible(self, gate):
+        """The REAL checked-in BENCH_r06.json: both int8 A/B metrics
+        enter the trajectory as baseline-eligible ratio records, and
+        gating it as a fresh candidate (the CI spelling from the
+        acceptance criteria) passes."""
+        path = os.path.join(REPO, "BENCH_r06.json")
+        assert os.path.exists(path), "BENCH_r06.json must be checked in"
+        records, note = gate.load_bench_file(path)
+        assert note is None
+        metrics = {r["metric"] for r in records}
+        assert {"serving_int8_rps_ratio",
+                "serving_int8_model_bytes_ratio"} <= metrics
+        for r in records:
+            assert gate.classify_trust(r) == "ratio"
+        traj = gate.build_trajectory(REPO)
+        for m in ("serving_int8_rps_ratio",
+                  "serving_int8_model_bytes_ratio"):
+            assert any(e["baseline_eligible"]
+                       for e in traj["metrics"][m]), m
+        assert gate.main(["--dir", REPO, "--check", path,
+                          "--require-trusted"]) == 0

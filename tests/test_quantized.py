@@ -2,6 +2,7 @@
 small accuracy loss)."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -208,3 +209,131 @@ def test_module_quantize_method():
     assert out is m
     assert not m.train_mode
     assert isinstance(m.modules[0], QuantizedLinear)
+
+
+class TestQuantizeExceptionSafety:
+    """ISSUE-11 satellite: the in-place rewrite is all-or-nothing and
+    never corrupts a child's param binding (`nn/quantized.py` used to
+    reset a nested container's ``_params`` to None unconditionally and
+    left the borrowed subtree bound when the walk raised midway)."""
+
+    def _nested(self):
+        inner = nn.Sequential().add(nn.Linear(8, 8)).add(nn.ReLU())
+        outer = (nn.Sequential().add(nn.Linear(6, 8)).add(inner)
+                 .add(nn.Linear(8, 4)))
+        outer.build(jax.ShapeDtypeStruct((2, 6), jnp.float32))
+        return outer, inner
+
+    def test_midwalk_failure_rolls_back_every_swap(self, monkeypatch):
+        from bigdl_tpu.nn import quantized as qz
+
+        outer, inner = self._nested()
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 6)),
+                        jnp.float32)
+        ref = np.asarray(outer.forward(x))
+        orig_cls, calls = qz.QuantizedLinear, []
+
+        class Boom(Exception):
+            pass
+
+        def failing(*a, **kw):
+            calls.append(1)
+            if len(calls) == 3:      # the LAST linear: earlier swaps done
+                raise Boom()
+            return orig_cls(*a, **kw)
+
+        monkeypatch.setattr(qz, "QuantizedLinear", failing)
+        with pytest.raises(Boom):
+            qz.quantize(outer)
+        # every already-performed swap was rolled back...
+        assert type(outer.modules[0]) is nn.Linear
+        assert type(inner.modules[0]) is nn.Linear
+        assert "weight" in outer._params["0"]
+        assert "weight" in outer._params["1"]["0"]
+        # ...the nested child's binding is untouched...
+        assert inner._params is None and not inner.is_built()
+        # ...and the model still serves its exact pre-call outputs
+        np.testing.assert_array_equal(ref, np.asarray(outer.forward(x)))
+
+    def test_standalone_built_child_binding_survives(self):
+        from bigdl_tpu.nn.quantized import quantize
+
+        inner = nn.Sequential().add(nn.Linear(8, 8)).add(nn.ReLU())
+        inner.build(jax.ShapeDtypeStruct((2, 8), jnp.float32))
+        own_tree = inner._params
+        assert own_tree is not None
+        outer = nn.Sequential().add(nn.Linear(6, 8)).add(inner)
+        outer.build(jax.ShapeDtypeStruct((2, 6), jnp.float32))
+        quantize(outer)
+        # the old code nulled the standalone binding after the walk
+        assert inner._params is own_tree
+        assert inner.is_built()
+        # the PARENT's copy of the nested subtree is quantized
+        assert outer._params["1"]["0"]["weight_q"].dtype == jnp.int8
+
+
+class TestProtoRoundTripBitIdentical:
+    """ISSUE-11 satellite: the registered protobuf paths
+    (interop/bigdl_format.py QuantizedLinear/QuantizedSpatialConvolution)
+    round-trip the int8 payloads and scales BIT-identically -- weights
+    are stored quantized and never re-quantized on load (reference:
+    nn/quantized/QuantSerializer.scala)."""
+
+    def test_qlinear_bits(self, tmp_path):
+        from bigdl_tpu.nn.quantized import quantize
+        from bigdl_tpu.utils.serializer import load_module
+
+        m = nn.Sequential().add(nn.Linear(12, 5))
+        m.build(jax.ShapeDtypeStruct((2, 12), jnp.float32))
+        quantize(m)
+        p = str(tmp_path / "qlin.bigdl")
+        m.save(p)
+        back = load_module(p)
+        w0, s0 = m._params["0"]["weight_q"], m._params["0"]["scale"]
+        w1, s1 = back._params["0"]["weight_q"], back._params["0"]["scale"]
+        assert w1.dtype == jnp.int8
+        np.testing.assert_array_equal(np.asarray(w0), np.asarray(w1))
+        np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
+        np.testing.assert_array_equal(np.asarray(m._params["0"]["bias"]),
+                                      np.asarray(back._params["0"]["bias"]))
+
+    def test_qconv_bits(self, tmp_path):
+        from bigdl_tpu.nn.quantized import quantize
+        from bigdl_tpu.utils.serializer import load_module
+
+        m = nn.Sequential().add(
+            nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1))
+        m.build(jax.ShapeDtypeStruct((2, 6, 6, 3), jnp.float32))
+        quantize(m)
+        p = str(tmp_path / "qconv.bigdl")
+        m.save(p)
+        back = load_module(p)
+        w0, s0 = m._params["0"]["weight_q"], m._params["0"]["scale"]
+        w1, s1 = back._params["0"]["weight_q"], back._params["0"]["scale"]
+        assert w1.dtype == jnp.int8
+        np.testing.assert_array_equal(np.asarray(w0), np.asarray(w1))
+        np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
+
+    def test_standalone_quantized_layers_round_trip(self, tmp_path):
+        """The exported classes round-trip OUTSIDE a container too (the
+        dir(nn) completeness sweep's path, now that bigdl_tpu.nn
+        exports them)."""
+        from bigdl_tpu.nn.module import Module
+
+        rng = np.random.default_rng(3)
+        m = nn.QuantizedLinear(
+            output_size=5,
+            weight_q=rng.integers(-127, 128, (5, 12)).astype(np.int8),
+            scale=np.abs(rng.standard_normal(5)).astype(np.float32) / 100
+            + 1e-4,
+            bias=rng.standard_normal(5).astype(np.float32))
+        x = jnp.asarray(rng.standard_normal((2, 12)), jnp.float32)
+        y = np.asarray(m.forward(x))
+        p = str(tmp_path / "alone.bigdl")
+        m.save(p)
+        back = Module.load(p)
+        np.testing.assert_array_equal(
+            np.asarray(m._params["weight_q"]),
+            np.asarray(back._params["weight_q"]))
+        np.testing.assert_allclose(y, np.asarray(back.forward(x)),
+                                   rtol=1e-6, atol=1e-7)

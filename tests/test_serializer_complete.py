@@ -126,6 +126,22 @@ EXAMPLES = {
     "Highway": (lambda: nn.Highway(4), lambda: _r(2, 4)),
     "LayerNorm": (lambda: nn.LayerNorm(4), lambda: _r(2, 4)),
     "Linear": (lambda: nn.Linear(4, 3), lambda: _r(2, 4)),
+    # int8 quantized twins (reference: nn/quantized/QuantSerializer.scala;
+    # the pre-quantized-array constructors ARE the deserialization path)
+    "QuantizedLinear": (
+        lambda: nn.QuantizedLinear(
+            output_size=3,
+            weight_q=np.asarray(_ri(3, 4, high=127)) - 63,
+            scale=np.abs(np.asarray(_r(3))) / 127.0 + 1e-4,
+            bias=np.asarray(_r(3))),
+        lambda: _r(2, 4)),
+    "QuantizedSpatialConvolution": (
+        lambda: nn.QuantizedSpatialConvolution(
+            nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+            weight_q=np.asarray(_ri(3, 3, 3, 4, high=127)) - 63,
+            scale=np.abs(np.asarray(_r(4))) / 127.0 + 1e-4,
+            bias=np.asarray(_r(4))),
+        IMG),
     "LocallyConnected1D": (lambda: nn.LocallyConnected1D(5, 4, 3, 2), SEQ),
     "LocallyConnected2D": (
         lambda: nn.LocallyConnected2D(3, 6, 6, 4, 3, 3), IMG),

@@ -240,13 +240,20 @@ def _communication_section(steps, other):
     return sec
 
 
-def _serving_section(other):
+def _serving_section(other, header=None):
     """Summarize ``kind: "inference"`` events -- the Predictor's batch
     path and the ServingEngine's coalescing ticks: per-request latency
     percentiles, queue-depth trajectory, bucket histogram and the
     pad-waste fraction (padded rows the bucket ladder spent to keep the
-    executable set closed).  None for runs without inference events."""
+    executable set closed).  The header's ``serving`` block (or a later
+    standalone ``serving_info`` event) adds WHICH precision served the
+    run: ``quantized`` flag, weight dtype, model bytes.  None for runs
+    without inference events."""
     inf = [e for e in other if e.get("kind") == "inference"]
+    info = (header or {}).get("serving")
+    for e in other:
+        if e.get("kind") == "serving_info" and e.get("serving"):
+            info = e["serving"]
     if not inf:
         return None
     requests = sum(int(e.get("records", 0)) for e in inf)
@@ -286,6 +293,26 @@ def _serving_section(other):
                        if _finite(e.get("batch_fill")))
         if fills:
             sec["batch_fill_p50"] = percentile(fills, 50)
+    if info:
+        for k in ("quantized", "weight_dtype", "model_bytes",
+                  "model_bytes_fp32", "backend", "replicas"):
+            if info.get(k) is not None:
+                sec[k] = info[k]
+        if info.get("accuracy_gate"):
+            sec["accuracy_gate"] = info["accuracy_gate"]
+    # weight-swap audit: every refresh outcome, with the rejections'
+    # reasons -- a run that served through a bad-checkpoint window shows
+    # it here
+    refreshes = [e for e in other if e.get("kind") == "param_refresh"]
+    if refreshes:
+        sec["param_refreshes"] = {
+            "ok": sum(1 for e in refreshes if e.get("outcome") == "ok"),
+            "rejected": sum(1 for e in refreshes
+                            if e.get("outcome") == "rejected")}
+        reasons = [e.get("reason") for e in refreshes
+                   if e.get("outcome") == "rejected" and e.get("reason")]
+        if reasons:
+            sec["param_refreshes"]["rejection_reasons"] = reasons[-4:]
     return sec
 
 
@@ -535,7 +562,7 @@ def build_report(run_dir, xplane_dir=None, top=10):
     comm = _communication_section(steps, other)
     if comm:
         rep["communication"] = comm
-    serving = _serving_section(other)
+    serving = _serving_section(other, header)
     if serving:
         rep["serving"] = serving
     recovery = _recovery_section(other)
@@ -716,6 +743,32 @@ def format_report(rep):
         if sv.get("requests_per_s") is not None:
             line += f" ({sv['requests_per_s']:.1f} req/s while serving)"
         out.append(line)
+        if sv.get("weight_dtype"):
+            line = (f"serving precision: {sv['weight_dtype']}"
+                    + (" (quantized)" if sv.get("quantized") else ""))
+            if sv.get("model_bytes") is not None:
+                line += f", model {sv['model_bytes'] / 1e6:.2f} MB"
+                if sv.get("model_bytes_fp32"):
+                    ratio = sv["model_bytes_fp32"] / sv["model_bytes"]
+                    line += (f" (fp32 {sv['model_bytes_fp32'] / 1e6:.2f} MB,"
+                             f" {ratio:.1f}x)")
+            out.append(line)
+            gate = sv.get("accuracy_gate")
+            if gate:
+                out.append(
+                    f"accuracy gate: "
+                    f"{'ok' if gate.get('ok') else 'FAILED'}"
+                    + (f", top-1 agreement {gate['top1_agreement']:.4f}"
+                       if gate.get("top1_agreement") is not None else "")
+                    + (f", logit rmse {gate['logit_rmse']:.4g}"
+                       if gate.get("logit_rmse") is not None else ""))
+        pr = sv.get("param_refreshes")
+        if pr:
+            line = (f"param refreshes: {pr['ok']} ok / "
+                    f"{pr['rejected']} rejected")
+            for r in pr.get("rejection_reasons", []):
+                line += f"\n  rejected: {r}"
+            out.append(line)
         if sv.get("latency_s_p50") is not None:
             out.append(
                 f"request latency p50/p95/p99: "
