@@ -397,6 +397,8 @@ class MetricsRegistry:
             self._observe_anomaly(event)
         elif kind == "recovery":
             self._observe_recovery(event)
+        elif kind == "reshard":
+            self._observe_reshard(event)
         elif kind == "slo":
             self._observe_slo(event)
         elif kind == "param_refresh":
@@ -536,6 +538,26 @@ class MetricsRegistry:
             self.counter(f"{p}_recovery_steps_replayed_total",
                          "steps re-run after restarts") \
                 .inc(event["steps_replayed"])
+
+    def _observe_reshard(self, event):
+        """Cross-layout redistributions (parallel/reshard.py): how
+        often checkpoints move between mesh layouts, and how many host
+        bytes/seconds each move costs -- the elastic-restart and
+        layout-aware-serving-refresh audit series."""
+        p = self.prefix
+        self.counter(f"{p}_reshard_total",
+                     "checkpoint redistributions, by src/dst layout",
+                     labelnames=("src", "dst")) \
+            .inc(src=str(event.get("src", "?")),
+                 dst=str(event.get("dst", "?")))
+        if event.get("host_bytes"):
+            self.counter(f"{p}_reshard_host_bytes_total",
+                         "host bytes moved by redistributions") \
+                .inc(event["host_bytes"])
+        if event.get("wall_s"):
+            self.counter(f"{p}_reshard_seconds_total",
+                         "wall seconds spent redistributing") \
+                .inc(event["wall_s"])
 
     # -- slo tier ------------------------------------------------------------- #
     def _observe_slo(self, event):

@@ -48,9 +48,11 @@ SCHEMA_VERSION = 1
 #: right after a health anomaly must leave the evidence on disk; a
 #: timing-audit verdict is the line a perf claim stands on; a recovery
 #: event is the record of a restart whose successor may itself die; an
-#: slo breach under the halt policy is about to END the run)
+#: slo breach under the halt policy is about to END the run; a reshard
+#: event is the audit trail of a cross-layout restore whose run may
+#: die before its first step)
 DURABLE_KINDS = frozenset({"health", "anomaly", "timing_audit",
-                           "recovery", "slo"})
+                           "recovery", "slo", "reshard"})
 
 log = logging.getLogger("bigdl_tpu.observability")
 

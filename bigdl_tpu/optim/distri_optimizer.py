@@ -39,6 +39,7 @@ from bigdl_tpu.ops.quantization import (CompressionSpec,
 from bigdl_tpu.optim.local_optimizer import BaseOptimizer, validate
 from bigdl_tpu.optim.optim_method import clip_by_value
 from bigdl_tpu.optim.train_step import _cast_params, _cast_tree
+from bigdl_tpu.parallel.reshard import LayoutSpec, redistribute
 from bigdl_tpu.parallel.zero import (FlatParamSpace, refit_flat_plane,
                                      repartition_ef_residual)
 from bigdl_tpu.utils import file_io
@@ -519,25 +520,71 @@ class DistriOptimizer(BaseOptimizer):
                     ef_np, flat_space.true_size, n_dev,
                     flat_space.padded_size)), vec_sharding)
 
+        #: the flat-plane layout this run writes snapshots under (and
+        #: the REDISTRIBUTION TARGET of any cross-layout resume) --
+        #: stamped into every snapshot manifest so a restart on a
+        #: different device count can re-chunk instead of refusing
+        live_layout = LayoutSpec.dp(
+            n_dev, flat_space.padded_size, flat_space.true_size,
+            flat_space.block_size,
+            ef_shape=([n_dev, flat_space.padded_size] if use_ef
+                      else None),
+            axis=self.axis)
+
         if getattr(self, "_resume", None):
             snap = self._resume
             # save_checkpoint nests the 3rd argument under "model_params"
             old_padded = int(np.shape(
                 snap["model_params"]["model_params_flat"])[0])
-            params_flat = refit(snap["model_params"]["model_params_flat"],
-                                old_padded)
-            mstate = jax.tree.map(jnp.asarray, snap["model_state"])
-            opt_state = jax.tree.map(
-                lambda l, s: jax.device_put(refit(l, old_padded), s),
-                snap["opt_state"], opt_shardings)
-            if use_ef:
+            src_layout = LayoutSpec.from_manifest(
+                (file_io.read_manifest(getattr(self, "_resume_path", None)
+                                       or "") or {}).get("layout"))
+            if src_layout is not None and src_layout != live_layout:
+                # restore-under-own-layout, then redistribute
+                # (parallel/reshard.py): the pickle payload is already
+                # host arrays in the snapshot's own chunk layout; the
+                # redistribution emits the durable kind:"reshard" event
+                payload = {"params_flat":
+                           snap["model_params"]["model_params_flat"],
+                           "opt_state": snap["opt_state"]}
                 if "ef_residual" in snap["model_params"]:
-                    ef_state = restore_ef(
-                        snap["model_params"]["ef_residual"])
-                else:
-                    log.warning(
-                        "checkpoint snapshot has no ef_residual plane; "
-                        "starting error feedback from a zero residual")
+                    payload["ef_residual"] = \
+                        snap["model_params"]["ef_residual"]
+                payload = redistribute(payload, src_layout, live_layout,
+                                       telemetry=self.telemetry,
+                                       what="dp-resume(pickle)")
+                params_flat = payload["params_flat"]
+                opt_state = jax.tree.map(
+                    lambda l, s: jax.device_put(jnp.asarray(l), s),
+                    payload["opt_state"], opt_shardings)
+                if use_ef:
+                    if "ef_residual" in payload:
+                        ef_state = jax.device_put(
+                            jnp.asarray(payload["ef_residual"]),
+                            vec_sharding)
+                    else:
+                        log.warning(
+                            "checkpoint snapshot has no ef_residual "
+                            "plane; starting error feedback from a "
+                            "zero residual")
+            else:
+                # same layout, or a legacy manifest-less snapshot: the
+                # shape-observing refit walk (exact for same-layout)
+                params_flat = refit(
+                    snap["model_params"]["model_params_flat"], old_padded)
+                opt_state = jax.tree.map(
+                    lambda l, s: jax.device_put(refit(l, old_padded), s),
+                    snap["opt_state"], opt_shardings)
+                if use_ef:
+                    if "ef_residual" in snap["model_params"]:
+                        ef_state = restore_ef(
+                            snap["model_params"]["ef_residual"])
+                    else:
+                        log.warning(
+                            "checkpoint snapshot has no ef_residual "
+                            "plane; starting error feedback from a "
+                            "zero residual")
+            mstate = jax.tree.map(jnp.asarray, snap["model_state"])
             self._apply_driver_state(snap["driver_state"])
 
         if getattr(self, "_resume_sharded", None) and \
@@ -574,14 +621,23 @@ class DistriOptimizer(BaseOptimizer):
                 abstract["ef_residual"] = sds(ef_shape, jnp.float32)
             with ocp.StandardCheckpointer() as ckptr:
                 restored = ckptr.restore(d, abstract)
-            params_flat = refit(restored["params_flat"], old_padded)
+            # restore-under-own-layout done; redistribute onto the live
+            # chunk layout (parallel/reshard.py -- subsumes the PR 8
+            # refit/re-partition closures and emits the durable
+            # kind:"reshard" audit event)
+            src_layout = LayoutSpec.from_manifest(layout)
+            restored = redistribute(restored, src_layout, live_layout,
+                                    telemetry=self.telemetry,
+                                    what="dp-resume(sharded)")
+            params_flat = restored["params_flat"]
             mstate = restored["mstate"]
             opt_state = jax.tree.map(
-                lambda l, s: jax.device_put(refit(l, old_padded), s),
+                lambda l, s: jax.device_put(jnp.asarray(l), s),
                 restored["opt_state"], opt_shardings)
             if use_ef:
                 if ef_shape:
-                    ef_state = restore_ef(restored["ef_residual"])
+                    ef_state = jax.device_put(
+                        jnp.asarray(restored["ef_residual"]), vec_sharding)
                 else:
                     log.warning(
                         "sharded snapshot %s has no ef_residual plane; "
@@ -778,17 +834,10 @@ class DistriOptimizer(BaseOptimizer):
             nonlocal opt_state
             opt_state = self._feed_plateau(state, opt_state)
 
-        #: the flat-plane layout this run writes snapshots under --
-        #: stamped into every snapshot manifest so a restart on a
-        #: DIFFERENT device count can re-chunk instead of refusing
-        layout_meta = {
-            "padded_size": flat_space.padded_size,
-            "true_size": flat_space.true_size,
-            "num_chunks": n_dev,
-            "block_size": flat_space.block_size,
-            "ef_shape": ([n_dev, flat_space.padded_size]
-                         if use_ef else None),
-        }
+        #: the manifest ``layout`` block this run stamps on every
+        #: snapshot (LayoutSpec superset of PR 8's dp-only keys, so
+        #: older readers of padded_size/num_chunks keep working)
+        layout_meta = live_layout.to_manifest()
 
         def checkpoint_cb(state):
             if getattr(self, "sharded_checkpoint_path", None):

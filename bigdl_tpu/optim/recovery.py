@@ -74,6 +74,25 @@ def parse_chaos(spec):
         "training process the moment step <step> completes)")
 
 
+def parse_restart_strategy(spec):
+    """``--restartStrategy tp:<degree>`` -> ``("tp", degree)``; None
+    passes through.  The restarted attempts of a supervised run then
+    come up with a DIFFERENT tensor-parallel degree and resume through
+    the redistribution engine (parallel/reshard.py; the dp analogue is
+    ``--restartDevices``, which re-chunks the flat plane).  A typo'd
+    spec is a configuration error, not a silent same-layout restart."""
+    if spec in (None, ""):
+        return None
+    parts = str(spec).split(":")
+    if len(parts) == 2 and parts[0] == "tp" and parts[1].isdigit() \
+            and int(parts[1]) >= 1:
+        return ("tp", int(parts[1]))
+    raise ConfigurationError(
+        f"unknown restart strategy {spec!r}; expected tp:<degree> "
+        "(restart the tp workload on that tensor-parallel degree; for "
+        "dp device-count changes use --restartDevices)")
+
+
 class ChaosKillTrigger(Trigger):
     """Deterministic fault injection: SIGKILL this process the moment
     step ``kill_after_step`` COMPLETES (counters updated, the step's

@@ -34,6 +34,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.optim.local_optimizer import BaseOptimizer, validate
+from bigdl_tpu.parallel.reshard import (LayoutSpec, convert_shapes,
+                                        detect_block_layout,
+                                        read_snapshot_layout, redistribute)
 from bigdl_tpu.utils import file_io
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.random_generator import RNG
@@ -151,6 +154,39 @@ class StrategyOptimizer(BaseOptimizer):
     #: pp-stage-stacked)
     _supports_sharded_checkpoint = True
 
+    def _layout_spec(self, params):
+        """The ``LayoutSpec`` describing this run's strategy-native
+        trees -- stamped into every snapshot manifest (``layout``
+        block) so a restart on a DIFFERENT mesh can redistribute
+        instead of refusing (parallel/reshard.py; docs/robustness.md,
+        "Portable resharding")."""
+        mesh_axes = {a: int(self.mesh.shape[a])
+                     for a in self.mesh.axis_names}
+        kw = self.strategy_kw
+        if self.strategy == "pp":
+            import bigdl_tpu.nn as nn_pkg
+            pipe_axis = kw.get("pipe_axis", "pipe")
+            spec = LayoutSpec.pp(
+                mesh_axes, int(self.mesh.shape[pipe_axis]), pipe_axis,
+                kw.get("tensor_parallel", False))
+            if isinstance(self.model, nn_pkg.Sequential):
+                # heterogeneous GPipe engine: per-stage subtrees, not
+                # the stage-stacked transformer layout -- self-described
+                # so a cross-layout resume can refuse legibly
+                spec.plane["het"] = True
+            return spec
+        if self.strategy == "tp":
+            from bigdl_tpu.parallel.tp import TRANSFORMER_TP_RULES
+            return LayoutSpec.tp(
+                mesh_axes, rules=kw.get("rules", TRANSFORMER_TP_RULES),
+                block_layout=detect_block_layout(params))
+        if self.strategy == "ep":
+            from bigdl_tpu.parallel.ep import MOE_EP_RULES
+            return LayoutSpec.ep(mesh_axes,
+                                 rules=kw.get("rules", MOE_EP_RULES))
+        return LayoutSpec.sp(mesh_axes, kw.get("seq_axis", "seq"),
+                             block_layout=detect_block_layout(params))
+
     def _sharded_save(self, neval, params, opt_state, state):
         import orbax.checkpoint as ocp
 
@@ -162,28 +198,66 @@ class StrategyOptimizer(BaseOptimizer):
                 ckptr.save(path, payload, force=True)
 
         # crash-safe commit protocol shared with the dp saver
-        # (docs/robustness.md).  No layout block: the strategy-native
-        # trees re-chunk only via ROADMAP item 3's redistribution
-        # engine (N->M resume is dp-only for now).
+        # (docs/robustness.md).  The manifest's layout block makes the
+        # snapshot SELF-DESCRIBING: strategy kind, mesh degrees,
+        # per-plane spec -- what the cross-mesh resume and the serving
+        # refresh read (parallel/reshard.py).
         file_io.write_sharded_snapshot(
             d, save_dir, state,
+            manifest_meta={"layout": self._layout_spec(params)
+                           .to_manifest()},
             direct=(file_io.is_remote(self.sharded_checkpoint_path)
                     or jax.process_count() > 1),
             write_manifest=jax.process_index() == 0)
 
     def _sharded_restore(self, params, opt_state):
-        """-> (params, opt_state) restored with the PREPARED shardings
-        (the abstract tree comes from the live strategy layout, so shards
-        land where the mesh expects them)."""
+        """-> (params, opt_state) restored onto the live strategy
+        layout.  Same layout (or a legacy layout-less snapshot): the
+        abstract tree comes from the live layout, shards land where the
+        mesh expects them.  DIFFERENT layout (tp degree change, pp
+        stage re-cut, scan<->unrolled): restore under the snapshot's
+        OWN logical shapes replicated -- no cross-layout resharding
+        strictness to trip -- then ``redistribute`` onto the live
+        structure and place (docs/robustness.md, "Portable
+        resharding")."""
         import orbax.checkpoint as ocp
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         d = self._resume_sharded
-        abstract = jax.tree.map(
-            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
-                                           sharding=l.sharding),
-            {"params": params, "opt_state": opt_state})
-        with ocp.StandardCheckpointer() as ckptr:
-            restored = ckptr.restore(d, abstract)
+        live = {"params": params, "opt_state": opt_state}
+        src = read_snapshot_layout(d)
+        dst = self._layout_spec(params)
+        if src is not None and src != dst:
+            from bigdl_tpu.utils.errors import UnsupportedFeatureError
+            if src.plane.get("het") or dst.plane.get("het"):
+                raise UnsupportedFeatureError(
+                    f"snapshot {d} was written under layout "
+                    f"{src.describe()} and this run uses "
+                    f"{dst.describe()}: the heterogeneous Sequential "
+                    "pipeline's per-stage subtrees cannot be re-cut; "
+                    "resume on the original mesh")
+            rep = NamedSharding(self.mesh, P())
+            abstract = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                               sharding=rep),
+                convert_shapes(live, dst, src))
+            with ocp.StandardCheckpointer() as ckptr:
+                restored = ckptr.restore(d, abstract)
+            restored = redistribute(restored, src, dst,
+                                    telemetry=self.telemetry,
+                                    what=f"{self.strategy}-resume")
+            restored = jax.tree.map(
+                lambda l, s: jax.device_put(l, s.sharding),
+                restored, live)
+            log.info("resharded snapshot %s: %s -> %s", d,
+                     src.describe(), dst.describe())
+        else:
+            abstract = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                               sharding=l.sharding),
+                live)
+            with ocp.StandardCheckpointer() as ckptr:
+                restored = ckptr.restore(d, abstract)
         self._apply_driver_state(file_io.load(d + ".driver"))
         # consumed: a later failure-retry must re-resolve the LATEST
         # snapshot, not replay this one
@@ -398,12 +472,24 @@ class StrategyOptimizer(BaseOptimizer):
 
         if getattr(self, "_resume", None):
             snap = self._resume
+            saved = {"params": snap["model_params"],
+                     "opt_state": snap["opt_state"]}
+            src = read_snapshot_layout(getattr(self, "_resume_path", None)
+                                       or "")
+            dst = self._layout_spec(params)
+            if src is not None and src != dst:
+                # restore-under-own-layout already happened (the pickle
+                # payload is host arrays); redistribute onto the live
+                # strategy structure (parallel/reshard.py), then place
+                saved = redistribute(saved, src, dst,
+                                     telemetry=self.telemetry,
+                                     what=f"{self.strategy}-resume")
             params = jax.tree.map(
                 lambda l, s: jax.device_put(jnp.asarray(l), s.sharding),
-                snap["model_params"], params)
+                saved["params"], params)
             opt_state = jax.tree.map(
                 lambda l, s: jax.device_put(jnp.asarray(l), s.sharding),
-                snap["opt_state"], opt_state)
+                saved["opt_state"], opt_state)
             self._apply_driver_state(snap["driver_state"])
         if getattr(self, "_resume_sharded", None):
             params, opt_state = self._sharded_restore(params, opt_state)
@@ -478,9 +564,13 @@ class StrategyOptimizer(BaseOptimizer):
             if getattr(self, "sharded_checkpoint_path", None):
                 self._sharded_save(state["neval"], params, opt_state, state)
             else:
+                # pickle snapshots are self-describing too: the layout
+                # block makes a cross-mesh resume redistributable
                 file_io.save_checkpoint(
                     self.checkpoint_path, state["neval"],
-                    params, (), opt_state, state)
+                    params, (), opt_state, state,
+                    manifest_meta={"layout": self._layout_spec(params)
+                                   .to_manifest()})
 
         def health_cb():
             # the probe threads the stats through the optimizer state;

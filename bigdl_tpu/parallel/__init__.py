@@ -1,1 +1,4 @@
 from bigdl_tpu.parallel.zero import FlatParamSpace
+from bigdl_tpu.parallel.reshard import (LayoutSpec, redistribute,
+                                        read_snapshot_layout,
+                                        to_model_layout)

@@ -1,0 +1,566 @@
+"""Portable resharding: one saved layout in, any target layout out.
+
+ROADMAP item 3.  A checkpoint is only as elastic as its layout is
+portable: the reference gets this for free from Spark lineage (BigDL,
+arxiv 1804.05839 section 3 -- state lives in RDDs, any executor count
+re-materializes it), and the dp slice of our TPU rebuild got it in PR 8
+(the flat plane re-chunks N->M).  The tp/pp/ep strategy snapshots were
+still welded to the mesh they were written on, and the serving engine
+assumed the training and serving layouts match.  This module is the
+redistribution layer that unwelds them, in the family of
+memory-efficient array redistribution through portable collectives
+(arxiv 2112.01075): the heavy lifting happens on HOST trees restored
+under the snapshot's OWN layout (replicated logical arrays -- no
+cross-layout resharding strictness for orbax/old-jax to trip), as pure
+structural transformations; device placement afterwards is the caller's
+ordinary ``device_put`` onto its live shardings.
+
+Two pieces:
+
+- ``LayoutSpec``: a JSON-able description of how a saved tree is laid
+  out -- strategy kind, mesh axes/degrees, per-plane partition spec --
+  stamped into every sharded-snapshot manifest (``layout`` block,
+  extending PR 8's dp-only block, whose legacy spelling still parses).
+- ``redistribute(tree, src, dst)``: maps a host tree between layouts:
+  dp N->M chunk-layout resize (``zero.refit_flat_plane`` /
+  ``zero.repartition_ef_residual`` walks, subsuming the PR 8 closures),
+  pp stage re-cutting (stage-stacked <-> per-block trees, the
+  ``stack_block_params``/``unstack_block_params`` interconversion
+  generalized to any mirrored subtree, e.g. Adam moments), scan <->
+  unrolled block-layout conversion, and tp/ep/sp <-> replicated (the
+  logical tree is identical; the conversion is a layout *statement*, so
+  serving can accept any of them).  Every redistribution emits a
+  durable ``kind: "reshard"`` telemetry event (src/dst layout, planes
+  moved, host bytes, wall seconds) -- the audit trail behind an elastic
+  restart or a cross-layout serving refresh (docs/robustness.md,
+  "Portable resharding").
+
+No jax import at module top: a supervisor or report process can parse
+``LayoutSpec`` manifests without an accelerator backend; the tree
+transformations import jax lazily.
+"""
+
+import dataclasses
+import logging
+import re
+import time
+from typing import Any, Dict, Optional
+
+log = logging.getLogger("bigdl_tpu.parallel")
+
+#: layout kinds a LayoutSpec may carry.  "replicated" is the serving /
+#: single-device layout: the model's own tree, whole on every device.
+LAYOUT_KINDS = ("dp", "tp", "pp", "sp", "ep", "replicated")
+
+#: transformer block-keying layouts (nn.attention): per-block
+#: ``block{i}`` entries vs one stacked ``blocks`` entry (scan_layers)
+BLOCK_LAYOUTS = ("unrolled", "scan")
+
+_BLOCK_KEY = re.compile(r"^block(\d+)$")
+
+#: manifest keys that are LayoutSpec structure, not per-plane detail
+_SPEC_KEYS = ("kind", "mesh_axes", "block_layout")
+
+
+def _jsonable(v):
+    """Tuples -> lists (deep), so a spec built in python compares equal
+    to the same spec round-tripped through a JSON manifest."""
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, list):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    return v
+
+
+@dataclasses.dataclass
+class LayoutSpec:
+    """How a saved param/opt-state tree is laid out.
+
+    ``kind``       -- one of ``LAYOUT_KINDS``.
+    ``mesh_axes``  -- axis name -> degree of the mesh the layout was
+                      built for (``{"data": 2, "model": 4}``).
+    ``plane``      -- kind-specific per-plane partition spec:
+                      dp: ``padded_size/true_size/num_chunks/block_size/
+                      ef_shape`` (PR 8's block, verbatim);
+                      tp/ep: the path-regex ``rules`` and the sharded
+                      ``axis``; pp: ``n_stages/pipe_axis/
+                      tensor_parallel``.
+    ``block_layout`` -- transformer block keying of the tree
+                      (``"unrolled"`` / ``"scan"``), or None when the
+                      model family has no block keying.
+
+    Serializes to the snapshot manifest's ``layout`` block via
+    ``to_manifest`` (plane keys flattened to the top level, so PR 8's
+    dp-only readers keep working) and parses back via
+    ``from_manifest`` (a legacy kind-less dp block still loads).
+    """
+
+    kind: str
+    mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    plane: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    block_layout: Optional[str] = None
+
+    def __post_init__(self):
+        if self.kind not in LAYOUT_KINDS:
+            raise ValueError(f"unknown layout kind {self.kind!r}; "
+                             f"expected one of {LAYOUT_KINDS}")
+        if self.block_layout is not None \
+                and self.block_layout not in BLOCK_LAYOUTS:
+            raise ValueError(
+                f"unknown block_layout {self.block_layout!r}; expected "
+                f"one of {BLOCK_LAYOUTS} or None")
+        self.mesh_axes = {str(k): int(v) for k, v in
+                          (self.mesh_axes or {}).items()}
+        self.plane = _jsonable(dict(self.plane or {}))
+
+    # ----- constructors ---------------------------------------------------- #
+    @classmethod
+    def dp(cls, num_chunks, padded_size, true_size, block_size=1,
+           ef_shape=None, axis="data"):
+        """The ZeRO-1 flat-plane layout (PR 8's manifest block)."""
+        return cls("dp", {axis: int(num_chunks)},
+                   {"padded_size": int(padded_size),
+                    "true_size": int(true_size),
+                    "num_chunks": int(num_chunks),
+                    "block_size": int(block_size),
+                    "ef_shape": (None if ef_shape is None
+                                 else [int(s) for s in ef_shape])})
+
+    @classmethod
+    def tp(cls, mesh_axes, axis="model", rules=None, block_layout=None):
+        plane = {"axis": axis}
+        if rules is not None:
+            plane["rules"] = [[p, list(d)] for p, d in rules]
+        return cls("tp", mesh_axes, plane, block_layout)
+
+    @classmethod
+    def ep(cls, mesh_axes, axis="expert", rules=None):
+        plane = {"axis": axis}
+        if rules is not None:
+            plane["rules"] = [[p, list(d)] for p, d in rules]
+        return cls("ep", mesh_axes, plane)
+
+    @classmethod
+    def pp(cls, mesh_axes, n_stages, pipe_axis="pipe",
+           tensor_parallel=False):
+        return cls("pp", mesh_axes,
+                   {"n_stages": int(n_stages), "pipe_axis": pipe_axis,
+                    "tensor_parallel": bool(tensor_parallel)})
+
+    @classmethod
+    def sp(cls, mesh_axes, seq_axis="seq", block_layout=None):
+        return cls("sp", mesh_axes, {"axis": seq_axis}, block_layout)
+
+    @classmethod
+    def replicated(cls, block_layout=None):
+        return cls("replicated", {}, {}, block_layout)
+
+    @classmethod
+    def for_model(cls, model):
+        """The ``replicated`` layout of a built model's OWN tree --
+        what a serving engine or a single-device resume wants --
+        detecting the transformer block keying from the params."""
+        return cls.replicated(
+            block_layout=detect_block_layout(model.parameters()[0]))
+
+    # ----- manifest round trip --------------------------------------------- #
+    def to_manifest(self) -> dict:
+        out = {"kind": self.kind}
+        if self.mesh_axes:
+            out["mesh_axes"] = dict(self.mesh_axes)
+        if self.block_layout is not None:
+            out["block_layout"] = self.block_layout
+        out.update(self.plane)
+        return out
+
+    @classmethod
+    def from_manifest(cls, block) -> Optional["LayoutSpec"]:
+        """Parse a manifest ``layout`` block; None passes through.  A
+        legacy PR 8 block (no ``kind`` -- only the dp saver stamped
+        one) parses as dp."""
+        if not block:
+            return None
+        d = dict(block)
+        kind = d.pop("kind", "dp")
+        mesh_axes = d.pop("mesh_axes", None) or {}
+        block_layout = d.pop("block_layout", None)
+        if kind == "dp" and not mesh_axes and "num_chunks" in d:
+            mesh_axes = {"data": int(d["num_chunks"])}
+        return cls(kind, mesh_axes, d, block_layout)
+
+    @classmethod
+    def coerce(cls, spec) -> "LayoutSpec":
+        if isinstance(spec, cls):
+            return spec
+        if isinstance(spec, dict):
+            out = cls.from_manifest(spec)
+            if out is not None:
+                return out
+        raise ValueError(f"cannot interpret {spec!r} as a LayoutSpec")
+
+    # ----- accessors -------------------------------------------------------- #
+    def degree(self, axis, default=1) -> int:
+        return int(self.mesh_axes.get(axis, default))
+
+    @property
+    def n_stages(self):
+        return int(self.plane["n_stages"]) if "n_stages" in self.plane \
+            else None
+
+    def describe(self) -> str:
+        """Short human label: ``tp[data=2,model=4]``, ``dp[data=8]``."""
+        axes = ",".join(f"{k}={v}" for k, v in sorted(self.mesh_axes.items()))
+        extra = ""
+        if self.kind == "pp" and self.n_stages is not None:
+            extra = f"/stages={self.n_stages}"
+        if self.block_layout == "scan":
+            extra += "/scan"
+        return f"{self.kind}[{axes}]{extra}" if axes \
+            else f"{self.kind}{extra}"
+
+    def __eq__(self, other):
+        if not isinstance(other, LayoutSpec):
+            return NotImplemented
+        return (self.kind == other.kind
+                and self.mesh_axes == other.mesh_axes
+                and _jsonable(self.plane) == _jsonable(other.plane)
+                and self.block_layout == other.block_layout)
+
+
+def detect_block_layout(params) -> Optional[str]:
+    """``"scan"`` / ``"unrolled"`` / None from a params tree's keying
+    (the TransformerLM layouts ``stack_block_params`` interconverts)."""
+    if not isinstance(params, dict):
+        return None
+    if "blocks" in params:
+        return "scan"
+    if any(_BLOCK_KEY.match(k) for k in params):
+        return "unrolled"
+    return None
+
+
+def read_snapshot_layout(path) -> Optional[LayoutSpec]:
+    """The LayoutSpec stamped into a snapshot's sidecar manifest, or
+    None (legacy manifest-less snapshot, or a pre-PR-12 strategy
+    snapshot that recorded no layout)."""
+    from bigdl_tpu.utils import file_io
+
+    manifest = file_io.read_manifest(path) or {}
+    return LayoutSpec.from_manifest(manifest.get("layout"))
+
+
+# --------------------------------------------------------------------------- #
+# Structural conversions (pure; operate on host / abstract trees).
+# --------------------------------------------------------------------------- #
+
+
+def _is_pp_tree(t) -> bool:
+    return isinstance(t, dict) and set(t) == {"embed", "stages", "tail"}
+
+
+def _has_block_keys(t) -> bool:
+    return isinstance(t, dict) and ("blocks" in t
+                                    or any(_BLOCK_KEY.match(k) for k in t))
+
+
+def pp_tree_to_blocks(pp_tree):
+    """Stage-stacked pp params (``{embed, stages, tail}``,
+    ``parallel/pp.stack_stage_params`` layout) -> the plain per-block
+    TransformerLM tree, as a PURE tree transformation (no model object
+    needed -- it also applies to optimizer-moment subtrees that mirror
+    the params).  Inverse of ``blocks_to_pp_tree``."""
+    import jax
+
+    stages = pp_tree["stages"]
+    lps = len(stages)
+    n_stages = int(jax.tree.leaves(stages["layer0"])[0].shape[0])
+    out = {"wte": pp_tree["embed"]["wte"], "wpe": pp_tree["embed"]["wpe"],
+           "ln_f": pp_tree["tail"]["ln_f"], "head": pp_tree["tail"]["head"]}
+    for s in range(n_stages):
+        for j in range(lps):
+            out[f"block{s * lps + j}"] = jax.tree.map(
+                lambda a, _s=s: a[_s], stages[f"layer{j}"])
+    return out
+
+
+def blocks_to_pp_tree(tree, n_stages):
+    """Plain per-block TransformerLM tree -> the ``n_stages``
+    stage-stacked pp layout (``parallel/pp.stack_stage_params``
+    semantics, model-free).  The block count must divide evenly into
+    the stages -- anything else is a re-cut the pipeline engine cannot
+    address."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = sorted(int(m.group(1)) for k in tree
+                 if (m := _BLOCK_KEY.match(k)))
+    if not idx or idx != list(range(len(idx))):
+        raise ValueError(
+            f"cannot stage-stack: expected contiguous block0..blockN "
+            f"entries, got {sorted(k for k in tree)[:8]}")
+    n_layers = len(idx)
+    n_stages = int(n_stages)
+    if n_layers % n_stages:
+        raise ValueError(
+            f"cannot re-cut {n_layers} blocks into {n_stages} pipeline "
+            f"stages: block count must divide evenly")
+    lps = n_layers // n_stages
+    stages = {}
+    for j in range(lps):
+        per_stage = [tree[f"block{s * lps + j}"] for s in range(n_stages)]
+        stages[f"layer{j}"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *per_stage)
+    return {
+        "embed": {"wte": tree["wte"], "wpe": tree["wpe"]},
+        "stages": stages,
+        "tail": {"ln_f": tree["ln_f"], "head": tree["head"]},
+    }
+
+
+def _walk_dicts(tree, fn):
+    """Apply ``fn`` to every dict node top-down; when ``fn`` returns a
+    replacement (non-None), recursion stops for that subtree."""
+    if isinstance(tree, dict):
+        replaced = fn(tree)
+        if replaced is not None:
+            return replaced
+        return {k: _walk_dicts(v, fn) for k, v in tree.items()}
+    return tree
+
+
+def _reblock(tree, src_bl, dst_bl):
+    """scan <-> unrolled transformer block keying, applied to every
+    subtree that carries block keys (params AND mirrored moments)."""
+    if src_bl == dst_bl or src_bl is None or dst_bl is None:
+        return tree
+    from bigdl_tpu.nn.attention import (stack_block_params,
+                                        unstack_block_params)
+
+    def convert(d):
+        if dst_bl == "unrolled" and "blocks" in d:
+            return unstack_block_params(d)
+        if dst_bl == "scan" and any(_BLOCK_KEY.match(k) for k in d):
+            return stack_block_params(d)
+        return None
+
+    return _walk_dicts(tree, convert)
+
+
+def _restage(tree, src, dst):
+    """pp stage re-cutting / pp <-> model-tree restructuring, applied
+    recursively so optimizer-state dicts whose values mirror the params
+    tree convert too."""
+    src_pp = src.kind == "pp"
+    dst_pp = dst.kind == "pp"
+    if not src_pp and not dst_pp:
+        return tree
+
+    def convert(d):
+        if src_pp and _is_pp_tree(d):
+            blocks = pp_tree_to_blocks(d)
+            return blocks_to_pp_tree(blocks, dst.n_stages) if dst_pp \
+                else blocks
+        if not src_pp and dst_pp and _has_block_keys(d):
+            return blocks_to_pp_tree(d, dst.n_stages)
+        return None
+
+    return _walk_dicts(tree, convert)
+
+
+def _convert_dp(tree, src, dst):
+    """dp -> dp chunk-layout resize: flat planes pad/truncate their
+    trailing padding (``zero.refit_flat_plane``); the EF-SGD residual
+    plane re-partitions by global flat offset
+    (``zero.repartition_ef_residual``); everything else (scalars,
+    mstate leaves) passes through."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.parallel.zero import (refit_flat_plane,
+                                         repartition_ef_residual)
+
+    if int(src.plane["true_size"]) != int(dst.plane["true_size"]):
+        raise ValueError(
+            f"dp layouts hold different parameter counts "
+            f"({src.plane['true_size']} vs {dst.plane['true_size']}): "
+            "this is a different model, not a chunk-layout change")
+    src_padded = int(src.plane["padded_size"])
+    dst_padded = int(dst.plane["padded_size"])
+    true = int(dst.plane["true_size"])
+    src_ef = src.plane.get("ef_shape")
+    dst_ef = dst.plane.get("ef_shape")
+
+    def fix(a):
+        a = jnp.asarray(a)
+        if src_ef and dst_ef and a.ndim == 2 \
+                and tuple(a.shape) == tuple(src_ef):
+            if a.shape[0] == int(dst.plane["num_chunks"]):
+                # same device count (a block-rounding-only change):
+                # each row is still that device's own accumulated
+                # error -- trailing pad/truncate keeps rows verbatim
+                # (exact), matching the PR 8 restore semantics
+                return refit_flat_plane(a, dst_padded, true)
+            return jnp.asarray(repartition_ef_residual(
+                a, true, int(dst.plane["num_chunks"]), dst_padded))
+        if a.ndim >= 1 and a.shape[-1] == src_padded:
+            return refit_flat_plane(a, dst_padded, true)
+        return a
+
+    return jax.tree.map(fix, tree)
+
+
+def _convert(tree, src, dst):
+    if src.kind == "dp" or dst.kind == "dp":
+        if src.kind == dst.kind == "dp":
+            return _convert_dp(tree, src, dst)
+        raise ValueError(
+            f"cannot redistribute {src.kind} -> {dst.kind} directly: "
+            "the dp layout is a FLAT plane; convert through the model "
+            "tree with flat_to_tree/tree_to_flat (they need the "
+            "model's tree as the unravel template)")
+    out = _restage(tree, src, dst)
+    # pp trees are unrolled by construction on both sides of _restage
+    src_bl = "unrolled" if src.kind == "pp" else src.block_layout
+    dst_bl = "unrolled" if dst.kind == "pp" else dst.block_layout
+    return _reblock(out, src_bl, dst_bl)
+
+
+def convert_shapes(tree, src, dst):
+    """``redistribute`` on SHAPES only (``jax.eval_shape``): what a
+    caller uses to derive the snapshot-native abstract tree for an
+    orbax restore from its live tree (dst -> src direction).  dp
+    layouts are excluded (the residual re-partition is a host numpy
+    op); their shapes are directly computable from the plane spec."""
+    import jax
+
+    return jax.eval_shape(lambda t: _convert(t, src, dst), tree)
+
+
+def flat_to_tree(flat, layout, tree_template):
+    """dp flat plane -> the model's own params tree.  ``tree_template``
+    supplies the unravel bijection (the model's built params);
+    ``layout`` guards that the plane actually holds this model."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.parallel.zero import FlatParamSpace
+
+    layout = LayoutSpec.coerce(layout)
+    space = FlatParamSpace(tree_template, 1)
+    true = int(layout.plane.get("true_size", space.true_size))
+    if true != space.true_size:
+        raise ValueError(
+            f"dp flat plane holds {true} parameters but the target "
+            f"model tree holds {space.true_size}: different model")
+    flat = jnp.asarray(flat)
+    if flat.shape[-1] < space.true_size:
+        raise ValueError(
+            f"flat plane of {flat.shape[-1]} elements cannot fill a "
+            f"{space.true_size}-parameter tree")
+    return space.unflatten(
+        jnp.pad(flat, (0, max(0, space.padded_size - flat.size))))
+
+
+def tree_to_flat(tree, layout):
+    """Model params tree -> a dp flat plane under ``layout``'s chunk
+    rounding (the inverse of ``flat_to_tree``)."""
+    from bigdl_tpu.parallel.zero import FlatParamSpace
+
+    layout = LayoutSpec.coerce(layout)
+    space = FlatParamSpace(tree, int(layout.plane["num_chunks"]),
+                           int(layout.plane.get("block_size", 1)))
+    if space.padded_size != int(layout.plane["padded_size"]):
+        raise ValueError(
+            f"tree flattens to padded size {space.padded_size}, layout "
+            f"says {layout.plane['padded_size']}: different model or "
+            "block rounding")
+    return space.flatten(tree)
+
+
+# --------------------------------------------------------------------------- #
+# The engine: redistribute + audit event.
+# --------------------------------------------------------------------------- #
+
+
+def _tree_stats(tree):
+    import jax
+
+    leaves = [l for l in jax.tree.leaves(tree)
+              if hasattr(l, "nbytes")]
+    return len(leaves), int(sum(int(l.nbytes) for l in leaves))
+
+
+def record_reshard_event(telemetry, src, dst, what, planes, host_bytes,
+                         wall_s):
+    """Emit the durable ``kind: "reshard"`` audit event (None telemetry
+    is a no-op; a failing record must never fail the restore that
+    triggered it)."""
+    if telemetry is None:
+        return None
+    try:
+        return telemetry.record(
+            "reshard", src=src.describe(), dst=dst.describe(),
+            src_layout=src.to_manifest(), dst_layout=dst.to_manifest(),
+            what=what, planes=planes, host_bytes=host_bytes,
+            wall_s=round(float(wall_s), 6))
+    except Exception:
+        log.exception("reshard telemetry record failed")
+        return None
+
+
+def redistribute(tree, src, dst, telemetry=None, what="params"):
+    """Map a host tree saved under layout ``src`` onto layout ``dst``.
+
+    The tree must be fully addressable on this process (host numpy
+    arrays, or replicated/single-device jax arrays) -- the
+    restore-under-own-layout contract: callers first restore the
+    snapshot with its OWN logical shapes replicated, then redistribute,
+    then ``device_put`` onto the live shardings.  Covered conversions:
+
+    - dp -> dp: N->M chunk-layout resize (trailing-pad/truncate flat
+      planes; offset-preserving EF-residual re-partition);
+    - pp -> pp: stage re-cutting (4-stage stacked -> 2-stage stacked);
+    - pp <-> tp/ep/sp/replicated: stage-stacked <-> per-block trees;
+    - scan <-> unrolled transformer block keying (``block_layout``);
+    - tp/ep/sp <-> replicated: the logical tree is identical -- the
+      call is then an audited identity (device placement is the
+      caller's ``device_put``).
+
+    Identical layouts return the tree untouched with no event; any
+    actual redistribution emits a durable ``kind: "reshard"`` telemetry
+    event (src/dst, planes moved, host bytes, wall seconds).
+    """
+    src = LayoutSpec.coerce(src)
+    dst = LayoutSpec.coerce(dst)
+    if src == dst:
+        return tree
+    t0 = time.perf_counter()
+    out = _convert(tree, src, dst)
+    wall = time.perf_counter() - t0
+    planes, host_bytes = _tree_stats(out)
+    log.info("resharded %s: %s -> %s (%d planes, %d host bytes, %.3fs)",
+             what, src.describe(), dst.describe(), planes, host_bytes,
+             wall)
+    record_reshard_event(telemetry, src, dst, what, planes, host_bytes,
+                         wall)
+    return out
+
+
+def to_model_layout(params, src_layout, model, telemetry=None,
+                    what="params"):
+    """Any snapshot params -> the built ``model``'s own (replicated)
+    tree layout: the serving-refresh path.  ``src_layout`` may be a
+    LayoutSpec or a manifest dict; dp flat planes unravel through the
+    model's tree template, strategy/pp/scan trees restructure via
+    ``redistribute``."""
+    src = LayoutSpec.coerce(src_layout)
+    dst = LayoutSpec.for_model(model)
+    if src.kind == "dp":
+        t0 = time.perf_counter()
+        out = flat_to_tree(params, src, model.parameters()[0])
+        planes, host_bytes = _tree_stats(out)
+        record_reshard_event(telemetry, src, dst, what, planes,
+                             host_bytes, time.perf_counter() - t0)
+        return out
+    return redistribute(params, src, dst, telemetry=telemetry, what=what)

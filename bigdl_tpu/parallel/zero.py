@@ -80,6 +80,10 @@ def refit_flat_plane(a, padded_size, true_size=None):
     Non-flat leaves (scalars, already-fitting vectors) pass through.
     ``true_size`` guards the truncation: shrinking below it would drop
     real parameters, which is a layout mismatch, not a padding change.
+
+    Resume paths reach this through ``parallel/reshard.redistribute``
+    (the general layout engine, which also emits the ``reshard`` audit
+    event); this stays the one definition of the tail-refit math.
     """
     a = jnp.asarray(a)
     if a.ndim < 1 or a.shape[-1] == padded_size:

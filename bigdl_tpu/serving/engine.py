@@ -191,19 +191,39 @@ def _tree_spec(tree):
 
 def _spec_mismatch(expect, got, what):
     """First structural/shape/dtype difference between two _tree_spec
-    results as a human-readable reason, or None when they match."""
+    results as a human-readable reason, or None when they match.
+
+    Always names the FIRST mismatched tree path with both sides'
+    shapes/dtypes (where each side has that leaf at all): the
+    half-written-checkpoint drill's operator needs to know WHICH plane
+    broke, not just that one did (docs/robustness.md, "Serving
+    survives a bad refresh")."""
     e_labels, e_specs, e_def = expect
     g_labels, g_specs, g_def = got
     if e_def != g_def:
-        missing = sorted(set(e_labels) - set(g_labels))
-        extra = sorted(set(g_labels) - set(e_labels))
-        detail = []
-        if missing:
-            detail.append(f"missing {missing[:4]}")
-        if extra:
-            detail.append(f"unexpected {extra[:4]}")
-        return (f"{what} tree structure differs"
-                + (": " + ", ".join(detail) if detail else ""))
+        e_map = dict(zip(e_labels, e_specs))
+        g_map = dict(zip(g_labels, g_specs))
+        for label in e_labels:          # first contract leaf not offered
+            if label not in g_map:
+                e = e_map[label]
+                return (f"{what} tree structure differs at {label}: "
+                        f"serving contract expects shape {e[0]} dtype "
+                        f"{e[1]}, leaf missing from the incoming tree")
+        for label in g_labels:          # first offered leaf not expected
+            if label not in e_map:
+                g = g_map[label]
+                return (f"{what} tree structure differs at {label}: "
+                        f"incoming tree carries an unexpected leaf "
+                        f"(shape {g[0]} dtype {g[1]}) the serving "
+                        f"contract has no plane for")
+        for label, e in zip(e_labels, e_specs):   # same leaves, reshaped
+            g = g_map.get(label)
+            if g is not None and e != g:
+                return (f"{what} leaf {label}: expected shape {e[0]} "
+                        f"dtype {e[1]}, got shape {g[0]} dtype {g[1]}")
+        return (f"{what} tree structure differs: same leaves, "
+                f"different nesting (first leaf "
+                f"{e_labels[0] if e_labels else '<empty tree>'})")
     for label, e, g in zip(e_labels, e_specs, g_specs):
         if e != g:
             return (f"{what} leaf {label}: expected shape {e[0]} "
@@ -737,7 +757,94 @@ class ServingEngine:
             log.exception("serving_info telemetry stamp failed")
 
     # ----- lifecycle -------------------------------------------------------- #
-    def refresh_params(self, params=None, mstate=None):
+    def refresh_from_snapshot(self, path):
+        """Hot-swap weights straight from a TRAINING checkpoint written
+        under ANY layout this stack trains (docs/robustness.md,
+        "Portable resharding"): resolve the snapshot, read its manifest
+        ``layout`` block, load the weights replicated on host under the
+        snapshot's OWN layout, redistribute them onto the serving
+        model's tree (``parallel/reshard.to_model_layout`` -- dp flat
+        planes unravel, pp stage-stacked trees unstack, scan/unrolled
+        block keyings interconvert, tp/ep trees pass through), and run
+        the ordinary ``refresh_params`` -- structure check and
+        ``accuracy_gate`` still in front, old weights keep serving on
+        any rejection.
+
+        ``path`` may be a snapshot itself (``checkpoint.<tag>.pkl`` /
+        ``snap_<n>`` dir) or a checkpoint DIRECTORY, in which case the
+        newest intact snapshot is resolved (corrupt ones quarantined,
+        exactly like training resume)."""
+        from bigdl_tpu.parallel.reshard import read_snapshot_layout
+
+        p = self._resolve_snapshot(path)
+        src = read_snapshot_layout(p)
+        params, mstate = self._load_snapshot_weights(p, src)
+        return self.refresh_params(params, mstate, src_layout=src)
+
+    @staticmethod
+    def _resolve_snapshot(path):
+        """A concrete snapshot path from a file/dir/checkpoint-root
+        (newest intact wins; every-candidate-corrupt raises)."""
+        import os
+
+        from bigdl_tpu.utils import file_io
+
+        base = os.path.basename(str(path).rstrip("/"))
+        if file_io.isdir(path) and not base.startswith("snap_"):
+            intact, quarantined = file_io.scan_sharded_snapshots(path)
+            if not intact:
+                intact, q2 = file_io.scan_checkpoints(path)
+                quarantined += q2
+            if not intact:
+                raise ValueError(
+                    f"no intact snapshot under {path}"
+                    + (f" (quarantined: {quarantined})" if quarantined
+                       else ""))
+            return intact[0]
+        return path
+
+    def _load_snapshot_weights(self, p, src_layout):
+        """-> (params, mstate) of a snapshot, replicated on host under
+        its OWN layout (the restore-under-own-layout contract the
+        redistribution engine expects).  dp flat planes come back as
+        the flat vector (``src_layout`` tells refresh_params to
+        unravel); strategy snapshots as their native trees."""
+        from bigdl_tpu.utils import file_io
+
+        def clean_state(mstate):
+            import jax
+            return mstate if mstate is not None \
+                and jax.tree.leaves(mstate) else None
+
+        if not file_io.isdir(p):                   # pickle snapshot
+            payload = file_io.load(p)
+            mp = payload["model_params"]
+            if isinstance(mp, dict) and "model_params_flat" in mp:
+                return (mp["model_params_flat"],
+                        clean_state(payload.get("model_state")))
+            return mp, clean_state(payload.get("model_state"))
+        import orbax.checkpoint as ocp                  # sharded (orbax)
+
+        with ocp.StandardCheckpointer() as ckptr:
+            # no abstract tree: the snapshot's own structure/shapes ARE
+            # the contract here (restore-under-own-layout); arrays come
+            # back whole on the local device, host-addressable
+            restored = ckptr.restore(p)
+        # re-materialize as UNCOMMITTED arrays (host round trip): a
+        # committed orbax-restored array -- or a raw numpy leaf -- keys
+        # the serving jit cache differently than the init-time weights
+        # it replaces and would force one spurious recompile on the
+        # first post-swap tick (the zero-steady-state-recompile pin)
+        import jax.numpy as jnp
+
+        restored = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)),
+                                restored)
+        if "params_flat" in restored:              # dp flat-plane payload
+            return restored["params_flat"], clean_state(
+                restored.get("mstate"))
+        return restored["params"], None
+
+    def refresh_params(self, params=None, mstate=None, src_layout=None):
         """Swap in retrained weights and re-replicate the device caches
         (sharded / round-robin layouts hold weights on device).
 
@@ -759,8 +866,28 @@ class ServingEngine:
         the int8 payload+scales -- the ``param_refresh`` event records
         ``model_bytes`` and the replica-staging ``wire_bytes`` it moved
         in that blockwise-int8 wire stance (docs/performance.md, "Int8
-        inference")."""
+        inference").
+
+        ``src_layout`` (a ``parallel.reshard.LayoutSpec`` or its
+        manifest dict) names the layout the incoming ``params`` were
+        SAVED under when it differs from the serving model's own tree:
+        the weights are first redistributed onto the serving layout
+        (``to_model_layout`` -- emitting the durable ``kind:"reshard"``
+        audit event), and only then hit the structure check and the
+        accuracy gate, so a tp/pp/dp training checkpoint hot-swaps into
+        a replicated (or sharded-mesh) serving engine with the exact
+        same guards in front."""
         incoming = params is not None
+        if src_layout is not None:
+            if not incoming:
+                raise ValueError(
+                    "src_layout describes an INCOMING params tree; "
+                    "pass params= alongside it")
+            from bigdl_tpu.parallel.reshard import to_model_layout
+
+            params = to_model_layout(params, src_layout, self.model,
+                                     telemetry=self.telemetry,
+                                     what="serving-refresh")
         if incoming:
             reason = _spec_mismatch(self._params_spec, _tree_spec(params),
                                     "params")

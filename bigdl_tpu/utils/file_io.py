@@ -19,8 +19,12 @@ Crash safety (docs/robustness.md):
   place, so a writer killed mid-write never shadows the previous good
   snapshot with a truncated file;
 - each snapshot gets a sidecar MANIFEST (``<name>.manifest.json``)
-  stamping byte count + sha256 of every file it covers (and, for the
-  dp flat plane, the chunk layout metadata the N->M resume needs);
+  stamping byte count + sha256 of every file it covers, plus the
+  ``layout`` block (``parallel/reshard.LayoutSpec``: strategy kind,
+  mesh axes/degrees, per-plane partition spec) that makes every
+  snapshot SELF-DESCRIBING -- what a resume on a different mesh or a
+  layout-aware serving refresh redistributes from (docs/robustness.md,
+  "Portable resharding");
 - resume-time resolution (``scan_checkpoints`` / ``latest_checkpoint``)
   VERIFIES candidates newest-first and quarantines failures (renamed to
   ``*.corrupt``, evidence preserved) instead of crashing on -- or worse,

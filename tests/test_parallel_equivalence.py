@@ -22,8 +22,12 @@ from bigdl_tpu.utils.random_generator import RNG
 
 requires_modern_jax = pytest.mark.skipif(
     not hasattr(jax, "shard_map"),
-    reason="old-jax compat fallback lacks the donation/resharding "
-           "semantics this test depends on")
+    reason="old-jax (pre-0.5) SPMD partitioner cannot lower the 3-D "
+           "manual(data,pipe)+auto(model) composition (PartitionId "
+           "UNIMPLEMENTED) -- a genuine shard_map gap, auto-re-enables "
+           "on new jax; the resume-resharding-strictness skips this "
+           "marker used to cover are retired (ISSUE 12: restore under "
+           "the snapshot's own layout, then redistribute)")
 
 
 pytestmark = pytest.mark.skipif(
@@ -176,9 +180,12 @@ class Test3DComposition:
 
 
 class TestEPEquivalence:
-    # old-jax (pre-0.5, utils/compat.py fallback) lacks the donation/
-    # resharding semantics this path depends on; auto-re-enables on new jax
-    @requires_modern_jax
+    # the old-jax skip is retired: PR 7's opt_state_shardings pin fixed
+    # the ep donation-alias failure this used to hit, and the step now
+    # passes on the compat fallback too.  Slow tier like its tp/pp
+    # siblings (heavy MoE shard_map compile); the tier-1 ep gate is
+    # test_strategy_facade's test_ep_facade_loss_matches.
+    @pytest.mark.slow
     def test_one_step_matches_single_device(self):
         from bigdl_tpu.parallel.ep import (ep_shard_params,
                                            init_ep_opt_state,

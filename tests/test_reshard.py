@@ -1,0 +1,712 @@
+"""Portable resharding (ISSUE 12): LayoutSpec manifests, redistribution
+round trips, cross-mesh checkpoint resume, the reshard audit event, and
+the layout-aware serving refresh.
+
+The property-style pins: layout A -> layout B -> layout A is
+BIT-IDENTICAL for params, Adam moments and the int8-EF residual plane,
+across dp/tp/pp layouts at 1/2/4/8 chunks/stages/degrees.  The heavy
+end-to-end legs (pp re-cut resume, the tp SIGKILL drill) ride the slow
+tier; tier-1 keeps the tp cross-degree resume and the serving-refresh
+acceptance.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+from bigdl_tpu.nn.attention import TransformerLM, stack_block_params
+from bigdl_tpu.optim import Optimizer, Trigger
+from bigdl_tpu.parallel.reshard import (LayoutSpec, blocks_to_pp_tree,
+                                        detect_block_layout, flat_to_tree,
+                                        pp_tree_to_blocks,
+                                        read_snapshot_layout, redistribute,
+                                        to_model_layout, tree_to_flat)
+from bigdl_tpu.parallel.zero import FlatParamSpace, repartition_ef_residual
+from bigdl_tpu.utils.random_generator import RNG
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    return jax.sharding.Mesh(devs, names)
+
+
+def _tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _lm_data(rng, batch, seqlen, vocab=64):
+    x = rng.integers(0, vocab, (batch, seqlen)).astype(np.int32)
+    y = rng.integers(0, vocab, (batch, seqlen)).astype(np.int32)
+    return x, y
+
+
+def _load_obs_report():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_resh_obs", os.path.join(REPO, "tools", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------- #
+# LayoutSpec: manifest format.
+# --------------------------------------------------------------------------- #
+
+
+class TestLayoutSpec:
+    def test_manifest_round_trip(self):
+        specs = [
+            LayoutSpec.dp(8, 128, 117, 4, ef_shape=(8, 128)),
+            LayoutSpec.tp({"data": 2, "model": 4},
+                          rules=[("qkv_weight", ("model", None))],
+                          block_layout="unrolled"),
+            LayoutSpec.pp({"data": 2, "pipe": 4}, 4),
+            LayoutSpec.replicated(block_layout="scan"),
+        ]
+        for spec in specs:
+            wire = json.loads(json.dumps(spec.to_manifest()))
+            assert LayoutSpec.from_manifest(wire) == spec
+
+    def test_legacy_dp_block_parses(self):
+        """PR 8 stamped a kind-less dp-only block; it must keep
+        loading."""
+        legacy = {"padded_size": 104, "true_size": 98, "num_chunks": 8,
+                  "block_size": 4, "ef_shape": [8, 104]}
+        spec = LayoutSpec.from_manifest(legacy)
+        assert spec.kind == "dp"
+        assert spec.degree("data") == 8
+        assert spec.plane["padded_size"] == 104
+        # and the new spelling is a SUPERSET of the old keys, so PR 8
+        # readers (padded_size/num_chunks at top level) keep working
+        new = LayoutSpec.dp(8, 104, 98, 4, ef_shape=(8, 104)).to_manifest()
+        for k in legacy:
+            assert new[k] == legacy[k], k
+
+    def test_rejects_unknown_kind_and_garbage(self):
+        with pytest.raises(ValueError, match="unknown layout kind"):
+            LayoutSpec("diagonal", {}, {})
+        with pytest.raises(ValueError, match="unknown block_layout"):
+            LayoutSpec.replicated(block_layout="zigzag")
+        with pytest.raises(ValueError, match="LayoutSpec"):
+            LayoutSpec.coerce(42)
+        assert LayoutSpec.from_manifest(None) is None
+
+    def test_describe_and_detect(self):
+        assert LayoutSpec.dp(8, 128, 117).describe() == "dp[data=8]"
+        assert "stages=4" in LayoutSpec.pp({"pipe": 4}, 4).describe()
+        assert detect_block_layout({"blocks": 1, "wte": 2}) == "scan"
+        assert detect_block_layout({"block0": 1, "wte": 2}) == "unrolled"
+        assert detect_block_layout({"fc1": 1}) is None
+
+
+# --------------------------------------------------------------------------- #
+# Redistribution round trips (the property pins).
+# --------------------------------------------------------------------------- #
+
+
+def _dp_payload(rng, tree, space, with_ef=True):
+    """A dp snapshot payload of ``tree`` under ``space``'s layout: flat
+    params, Adam-style moments, step counter, and a CANONICAL EF
+    residual plane (row j nonzero only in chunk j's global offsets --
+    the form every repartition produces, so round trips are
+    bit-identical)."""
+    flat = space.flatten(tree)
+    payload = {"params_flat": flat,
+               "opt_state": {"m": flat * 0.1, "v": flat * 0.01,
+                             "step": jnp.asarray(3)}}
+    if with_ef:
+        raw = rng.standard_normal(
+            (space.num_chunks, space.padded_size)).astype(np.float32)
+        payload["ef_residual"] = jnp.asarray(repartition_ef_residual(
+            raw, space.true_size, space.num_chunks, space.padded_size))
+    return payload
+
+
+def _dp_spec(space, with_ef=True):
+    return LayoutSpec.dp(
+        space.num_chunks, space.padded_size, space.true_size,
+        space.block_size,
+        ef_shape=(space.num_chunks, space.padded_size) if with_ef
+        else None)
+
+
+class TestDpRoundTrips:
+    @pytest.mark.parametrize("n_a,n_b", [(1, 2), (2, 4), (4, 8), (8, 1),
+                                         (8, 2)])
+    def test_a_b_a_bit_identical(self, n_a, n_b):
+        """dp chunks A -> B -> A: params, Adam moments AND the int8-EF
+        residual plane come back bit-identical."""
+        rng = np.random.default_rng(n_a * 10 + n_b)
+        tree = {"w": rng.standard_normal((13, 7)).astype(np.float32)}
+        sa = FlatParamSpace(tree, n_a, block_size=4)
+        sb = FlatParamSpace(tree, n_b, block_size=4)
+        payload = _dp_payload(rng, tree, sa)
+        a, b = _dp_spec(sa), _dp_spec(sb)
+        there = redistribute(payload, a, b)
+        assert np.shape(there["params_flat"])[-1] == sb.padded_size
+        assert np.shape(there["ef_residual"]) == (n_b, sb.padded_size)
+        back = redistribute(there, b, a)
+        _tree_equal(back, payload)
+
+    def test_ef_total_correction_preserved(self):
+        """Arbitrary (non-canonical) residual rows: the quantity
+        training depends on -- the SUM over rows at each offset --
+        survives any re-partition exactly."""
+        rng = np.random.default_rng(0)
+        tree = {"w": rng.standard_normal((13, 7)).astype(np.float32)}
+        s8, s2 = FlatParamSpace(tree, 8), FlatParamSpace(tree, 2)
+        ef = rng.standard_normal((8, s8.padded_size)).astype(np.float32)
+        ef[:, s8.true_size:] = 0
+        out = redistribute(
+            {"ef_residual": jnp.asarray(ef)},
+            _dp_spec(s8), _dp_spec(s2))["ef_residual"]
+        np.testing.assert_array_equal(
+            np.asarray(out).sum(0)[:s8.true_size],
+            ef.sum(0)[:s8.true_size])
+
+    def test_block_rounding_change(self):
+        """A compression-spec change (block 1 -> 256) changes only the
+        trailing padding; round trip is bit-identical."""
+        rng = np.random.default_rng(1)
+        tree = {"w": rng.standard_normal((33, 5)).astype(np.float32)}
+        s1 = FlatParamSpace(tree, 4, block_size=1)
+        s256 = FlatParamSpace(tree, 4, block_size=256)
+        payload = _dp_payload(rng, tree, s1, with_ef=False)
+        a, b = _dp_spec(s1, False), _dp_spec(s256, False)
+        back = redistribute(redistribute(payload, a, b), b, a)
+        _tree_equal(back, payload)
+
+    def test_different_model_refused(self):
+        a = LayoutSpec.dp(4, 128, 96)
+        b = LayoutSpec.dp(2, 64, 50)
+        with pytest.raises(ValueError, match="different model"):
+            redistribute({"params_flat": jnp.zeros(128)}, a, b)
+
+    def test_dp_to_tp_direct_refused(self):
+        with pytest.raises(ValueError, match="flat_to_tree"):
+            redistribute({"x": jnp.zeros(4)}, LayoutSpec.dp(1, 4, 4),
+                         LayoutSpec.tp({"model": 2}))
+
+
+def _block_tree(rng, n_layers, width=4):
+    tree = {"wte": rng.standard_normal((9, width)).astype(np.float32),
+            "wpe": rng.standard_normal((5, width)).astype(np.float32),
+            "ln_f": {"g": np.ones(width, np.float32)},
+            "head": rng.standard_normal((9, width)).astype(np.float32)}
+    for i in range(n_layers):
+        tree[f"block{i}"] = {
+            "fc": rng.standard_normal((width, width)).astype(np.float32)}
+    return tree
+
+
+class TestStructuralRoundTrips:
+    @pytest.mark.parametrize("n_a,n_b", [(4, 2), (4, 1), (8, 2), (2, 8)])
+    def test_pp_recut_a_b_a_bit_identical(self, n_a, n_b):
+        """pp stage counts A -> B -> A, params and mirrored Adam-style
+        moments both bit-identical."""
+        rng = np.random.default_rng(n_a + n_b)
+        pp = blocks_to_pp_tree(_block_tree(rng, 8), n_a)
+        payload = {"params": pp,
+                   "opt_state": {"m": jax.tree.map(lambda a: a * 0.1, pp),
+                                 "step": jnp.asarray(5)}}
+        a = LayoutSpec.pp({"pipe": n_a}, n_a)
+        b = LayoutSpec.pp({"pipe": n_b}, n_b)
+        there = redistribute(payload, a, b)
+        lead = jax.tree.leaves(there["params"]["stages"])[0].shape[0]
+        assert lead == n_b
+        back = redistribute(there, b, a)
+        _tree_equal(back, payload)
+
+    def test_pp_to_model_tree_and_back(self):
+        rng = np.random.default_rng(2)
+        blocks = _block_tree(rng, 4)
+        pp = blocks_to_pp_tree(blocks, 4)
+        rep = LayoutSpec.replicated(block_layout="unrolled")
+        s4 = LayoutSpec.pp({"pipe": 4}, 4)
+        flat = redistribute(pp, s4, rep)
+        assert "block3" in flat and "stages" not in flat
+        _tree_equal(flat, blocks)
+        _tree_equal(redistribute(flat, rep, s4), pp)
+
+    def test_pp_uneven_recut_refused(self):
+        pp = blocks_to_pp_tree(_block_tree(np.random.default_rng(0), 4), 4)
+        with pytest.raises(ValueError, match="divide evenly"):
+            redistribute(pp, LayoutSpec.pp({"pipe": 4}, 4),
+                         LayoutSpec.pp({"pipe": 3}, 3))
+
+    def test_scan_unrolled_round_trip(self):
+        rng = np.random.default_rng(3)
+        blocks = _block_tree(rng, 4)
+        scan = stack_block_params(blocks)
+        s = LayoutSpec.replicated(block_layout="scan")
+        u = LayoutSpec.replicated(block_layout="unrolled")
+        un = redistribute(scan, s, u)
+        assert "block3" in un and "blocks" not in un
+        _tree_equal(un, blocks)
+        _tree_equal(redistribute(un, u, s), scan)
+
+    def test_tp_round_trip_is_identity(self):
+        """tp trees are the model's own logical tree: degree changes
+        are a layout statement, values bit-identical."""
+        rng = np.random.default_rng(4)
+        tree = _block_tree(rng, 2)
+        a = LayoutSpec.tp({"data": 2, "model": 4},
+                          block_layout="unrolled")
+        b = LayoutSpec.tp({"data": 4, "model": 2},
+                          block_layout="unrolled")
+        _tree_equal(redistribute(redistribute(tree, a, b), b, a), tree)
+
+    def test_flat_tree_round_trip(self):
+        rng = np.random.default_rng(5)
+        tree = {"w": rng.standard_normal((11, 3)).astype(np.float32),
+                "b": rng.standard_normal((3,)).astype(np.float32)}
+        space = FlatParamSpace(tree, 4, block_size=8)
+        spec = _dp_spec(space, with_ef=False)
+        flat = tree_to_flat(tree, spec)
+        assert flat.shape == (space.padded_size,)
+        _tree_equal(flat_to_tree(flat, spec, tree), tree)
+        wrong = {"w": np.zeros((2, 2), np.float32)}
+        with pytest.raises(ValueError, match="different model"):
+            flat_to_tree(flat, spec, wrong)
+
+    def test_identity_returns_tree_untouched(self):
+        tree = {"w": jnp.zeros(3)}
+        spec = LayoutSpec.tp({"model": 2})
+        assert redistribute(tree, spec, spec) is tree
+
+
+# --------------------------------------------------------------------------- #
+# The reshard audit event: durable, bridged, rendered.
+# --------------------------------------------------------------------------- #
+
+
+class TestReshardEvent:
+    def test_event_durable_and_schema(self, tmp_path):
+        from bigdl_tpu.observability import StepTelemetry
+        from bigdl_tpu.observability.telemetry import DURABLE_KINDS
+
+        assert "reshard" in DURABLE_KINDS
+        run = str(tmp_path / "run")
+        tel = StepTelemetry(run, trace=False)
+        rng = np.random.default_rng(0)
+        pp = blocks_to_pp_tree(_block_tree(rng, 4), 4)
+        redistribute(pp, LayoutSpec.pp({"pipe": 4}, 4),
+                     LayoutSpec.pp({"pipe": 2}, 2), telemetry=tel,
+                     what="unit")
+        tel.close()
+        evs = [json.loads(ln) for ln in
+               open(os.path.join(run, "telemetry.jsonl"))]
+        resh = [e for e in evs if e.get("kind") == "reshard"]
+        assert len(resh) == 1
+        e = resh[0]
+        for key in ("src", "dst", "src_layout", "dst_layout", "what",
+                    "planes", "host_bytes", "wall_s"):
+            assert key in e, key
+        assert e["src"] == "pp[pipe=4]/stages=4"
+        assert e["planes"] > 0 and e["host_bytes"] > 0
+        assert LayoutSpec.from_manifest(e["dst_layout"]).n_stages == 2
+
+    def test_identity_emits_no_event(self, tmp_path):
+        from bigdl_tpu.observability import StepTelemetry
+
+        run = str(tmp_path / "run")
+        tel = StepTelemetry(run, trace=False)
+        spec = LayoutSpec.tp({"model": 2})
+        redistribute({"w": jnp.zeros(3)}, spec, spec, telemetry=tel)
+        tel.close()
+        assert not any('"reshard"' in ln for ln in
+                       open(os.path.join(run, "telemetry.jsonl")))
+
+    def test_metrics_bridge(self):
+        from bigdl_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.observe_event({"kind": "reshard", "src": "tp[model=4]",
+                           "dst": "replicated", "what": "serving-refresh",
+                           "planes": 12, "host_bytes": 4096,
+                           "wall_s": 0.25})
+        total = reg.get("bigdl_reshard_total")
+        assert total.value(src="tp[model=4]", dst="replicated") == 1
+        assert reg.get("bigdl_reshard_host_bytes_total").value() == 4096
+        assert reg.get("bigdl_reshard_seconds_total").value() == 0.25
+
+    def test_obs_report_renders_reshard(self, tmp_path):
+        from bigdl_tpu.observability import StepTelemetry
+
+        run = str(tmp_path / "run")
+        tel = StepTelemetry(run, trace=False)
+        rng = np.random.default_rng(0)
+        pp = blocks_to_pp_tree(_block_tree(rng, 4), 4)
+        redistribute(pp, LayoutSpec.pp({"pipe": 4}, 4),
+                     LayoutSpec.pp({"pipe": 2}, 2), telemetry=tel,
+                     what="drill")
+        tel.close()
+        mod = _load_obs_report()
+        rep = mod.build_report(run)
+        sec = rep["recovery"]
+        assert sec["restarts"] == 0
+        assert sec["reshards"][0]["what"] == "drill"
+        text = mod.format_report(rep)
+        assert "reshard [drill]: pp[pipe=4]/stages=4 -> " \
+               "pp[pipe=2]/stages=2" in text
+        # restart-free runs must not print a bogus "0 restart(s)" line
+        assert "0 restart(s)" not in text
+        json.dumps(mod._json_safe(rep), allow_nan=False)
+
+
+# --------------------------------------------------------------------------- #
+# Cross-mesh resume (end to end).
+# --------------------------------------------------------------------------- #
+
+
+def _fresh_tp(x, y, crit, mesh, seed=21):
+    RNG.set_seed(seed)
+    m = TransformerLM(64, 32, 4, 2, max_len=32)
+    ds = array_dataset(x, y) >> SampleToMiniBatch(x.shape[0])
+    return m, Optimizer(m, ds, crit, optim.SGD(
+        learning_rate=0.1, momentum=0.9, dampening=0.0),
+        strategy="tp", mesh=mesh)
+
+
+class TestCrossMeshResume:
+    def test_tp_degree_change_sharded_resume(self, tmp_path):
+        """A tp=4 sharded snapshot resumes on tp=2 (restore under the
+        snapshot's OWN layout replicated, then redistribute) and lands
+        on the same trajectory as the uninterrupted tp=4 run."""
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 8, 16)
+        mesh4 = _mesh((2, 4), ("data", "model"))
+        mesh2 = _mesh((4, 2), ("data", "model"))
+
+        m2, straight = _fresh_tp(x, y, crit, mesh4)
+        straight.set_end_when(Trigger.max_iteration(2))
+        straight.optimize()
+
+        _, first = _fresh_tp(x, y, crit, mesh4)
+        first.set_end_when(Trigger.max_iteration(1))
+        first.set_sharded_checkpoint(str(tmp_path),
+                                     Trigger.several_iteration(1))
+        first.optimize()
+        # satellite: the strategy snapshot is now SELF-DESCRIBING
+        snap = [d for d in os.listdir(tmp_path)
+                if d.startswith("snap_") and os.path.isdir(tmp_path / d)]
+        layout = read_snapshot_layout(str(tmp_path / snap[0]))
+        assert layout.kind == "tp"
+        assert layout.mesh_axes == {"data": 2, "model": 4}
+        assert layout.plane.get("rules")
+
+        mr, resumed = _fresh_tp(x, y, crit, mesh2)
+        resumed.set_end_when(Trigger.max_iteration(2))
+        resumed.set_sharded_checkpoint(str(tmp_path),
+                                       Trigger.several_iteration(1))
+        resumed.resume_from_sharded_checkpoint()
+        resumed.optimize()
+        for a, b in zip(jax.tree.leaves(m2._params),
+                        jax.tree.leaves(mr._params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.slow
+    def test_pp_recut_pickle_resume(self, tmp_path):
+        """A 4-stage pp PICKLE snapshot (layout-stamped manifest)
+        resumes as a 2-stage run via the redistribution engine."""
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 8, 16)
+
+        def fresh(mesh):
+            RNG.set_seed(11)
+            m = TransformerLM(64, 32, 4, num_layers=4, max_len=32)
+            ds = array_dataset(x, y) >> SampleToMiniBatch(8)
+            return m, Optimizer(m, ds, crit, optim.SGD(
+                learning_rate=0.1, momentum=0.9, dampening=0.0),
+                strategy="pp", mesh=mesh, n_microbatches=2)
+
+        m2, straight = fresh(_mesh((2, 4), ("data", "pipe")))
+        straight.set_end_when(Trigger.max_iteration(2))
+        straight.optimize()
+
+        _, first = fresh(_mesh((2, 4), ("data", "pipe")))
+        first.set_end_when(Trigger.max_iteration(1))
+        first.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        first.optimize()
+        ckpt = [f for f in os.listdir(tmp_path)
+                if f.startswith("checkpoint.") and f.endswith(".pkl")]
+        layout = read_snapshot_layout(str(tmp_path / ckpt[0]))
+        assert layout.kind == "pp" and layout.n_stages == 4
+
+        mr, resumed = fresh(_mesh((4, 2), ("data", "pipe")))
+        resumed.set_end_when(Trigger.max_iteration(2))
+        resumed.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        resumed.resume_from_checkpoint()
+        resumed.optimize()
+        for a, b in zip(jax.tree.leaves(m2._params),
+                        jax.tree.leaves(mr._params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# Layout-aware serving refresh (the acceptance pin).
+# --------------------------------------------------------------------------- #
+
+
+class TestServingLayoutAware:
+    def test_tp_snapshot_into_gated_replicated_engine(self, tmp_path):
+        """ISSUE-12 acceptance: a tp-sharded training checkpoint
+        hot-swaps into a replicated serving engine -- structure check
+        and AccuracyDeltaGate still in front, zero steady-state
+        recompiles after the swap."""
+        from bigdl_tpu.optim.validation import AccuracyDeltaGate
+        from bigdl_tpu.serving import ServingEngine
+
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        rng = np.random.default_rng(0)
+        x, y = _lm_data(rng, 8, 16)
+        _, opt = _fresh_tp(x, y, crit, _mesh((2, 4), ("data", "model")),
+                           seed=5)
+        opt.set_end_when(Trigger.max_iteration(2))
+        opt.set_sharded_checkpoint(str(tmp_path),
+                                   Trigger.several_iteration(2))
+        opt.optimize()
+
+        RNG.set_seed(5)
+        serve_model = TransformerLM(64, 32, 4, 2, max_len=32)
+        serve_model.build(jax.ShapeDtypeStruct((1, 16), jnp.int32))
+        # logit-RMSE gate: a tiny barely-trained LM's top-1 flips too
+        # easily under int8 for an agreement gate to be a stable pin;
+        # the RMSE tolerance still fails loudly on a broken swap
+        gate = AccuracyDeltaGate(x[:4], min_top1_agreement=None,
+                                 max_top1_accuracy_drop=None,
+                                 max_logit_rmse=1.0)
+        eng = ServingEngine(serve_model, max_batch_size=4,
+                            max_wait_ms=1.0, quantize=True,
+                            accuracy_gate=gate)
+        try:
+            eng.precompile(example_feature=x[0])
+            before = np.asarray(eng.predict(x[0]))
+            execs0 = eng._executables()
+            eng.refresh_from_snapshot(str(tmp_path))
+            after = np.asarray(eng.predict(x[0]))
+            _ = eng.predict(x[1])
+            assert not np.array_equal(before, after)
+            assert eng._executables() - execs0 == 0, \
+                "the swap must not recompile steady-state serving"
+            # the gate actually ran on the swapped weights
+            assert eng._gate_detail is not None
+            assert "logit_rmse" in json.dumps(eng._gate_detail)
+        finally:
+            eng.close()
+
+    def test_pp_and_dp_and_scan_trees_accepted(self):
+        """refresh_params(src_layout=) redistributes pp-stacked, dp
+        flat and scan-stacked checkpoints onto the serving tree before
+        the structure check."""
+        from bigdl_tpu.serving import ServingEngine
+
+        RNG.set_seed(9)
+        model = TransformerLM(64, 32, 4, 2, max_len=32)
+        model.build(jax.ShapeDtypeStruct((1, 16), jnp.int32))
+        params = jax.tree.map(np.asarray, model.parameters()[0])
+        eng = ServingEngine(model, max_batch_size=4, max_wait_ms=1.0)
+        try:
+            scaled = jax.tree.map(lambda a: a * 0.5, params)
+            # pp stage-stacked
+            pp = blocks_to_pp_tree(scaled, 2)
+            eng.refresh_params(pp, src_layout=LayoutSpec.pp({"pipe": 2}, 2))
+            _tree_equal(model.parameters()[0], scaled)
+            # dp flat plane
+            space = FlatParamSpace(params, 4)
+            flat = space.flatten(jax.tree.map(lambda a: a * 0.25, params))
+            eng.refresh_params(flat, src_layout=_dp_spec(space, False))
+            _tree_equal(model.parameters()[0],
+                        jax.tree.map(lambda a: a * 0.25, params))
+            # scan-stacked block keying
+            scan = stack_block_params(scaled)
+            eng.refresh_params(
+                scan,
+                src_layout=LayoutSpec.tp({"model": 2},
+                                         block_layout="scan"))
+            _tree_equal(model.parameters()[0], scaled)
+            with pytest.raises(ValueError, match="pass params="):
+                eng.refresh_params(src_layout=LayoutSpec.tp({"model": 2}))
+        finally:
+            eng.close()
+
+    def test_refresh_from_pickle_checkpoint_dir(self, tmp_path):
+        """A dp (flat-plane) pickle checkpoint directory refreshes a
+        serving engine: newest intact snapshot resolved, flat plane
+        unraveled through the model tree."""
+        from bigdl_tpu.serving import ServingEngine
+
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((64, 12)).astype(np.float32)
+        y = rng.integers(0, 5, 64).astype(np.int32)
+        RNG.set_seed(7)
+        model = (nn.Sequential().add(nn.Linear(12, 16)).add(nn.ReLU())
+                 .add(nn.Linear(16, 5)))
+        ds = array_dataset(x, y) >> SampleToMiniBatch(32)
+        opt = optim.DistriOptimizer(
+            model, ds, nn.CrossEntropyCriterion(),
+            optim.SGD(learning_rate=0.1))
+        opt.set_end_when(Trigger.max_iteration(2))
+        opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        opt.optimize()
+
+        RNG.set_seed(7)
+        serve_model = (nn.Sequential().add(nn.Linear(12, 16))
+                       .add(nn.ReLU()).add(nn.Linear(16, 5)))
+        serve_model.build(jax.ShapeDtypeStruct((1, 12), np.float32))
+        eng = ServingEngine(serve_model, max_batch_size=4,
+                            max_wait_ms=1.0)
+        try:
+            before = np.asarray(eng.predict(x[0]))
+            eng.refresh_from_snapshot(str(tmp_path))
+            after = np.asarray(eng.predict(x[0]))
+            assert not np.array_equal(before, after)
+            # the engine now serves the TRAINED weights
+            _tree_equal(serve_model.parameters()[0],
+                        model.parameters()[0])
+        finally:
+            eng.close()
+
+    def test_mismatch_error_names_first_path(self):
+        """Satellite: structure-check failures name the first
+        mismatched tree path and both shapes/dtypes."""
+        from bigdl_tpu.serving import ServingEngine
+
+        RNG.set_seed(1)
+        model = (nn.Sequential().add(nn.Linear(4, 3))
+                 .add(nn.Linear(3, 2)))
+        model.build(jax.ShapeDtypeStruct((1, 4), np.float32))
+        eng = ServingEngine(model, max_batch_size=2, max_wait_ms=1.0)
+        try:
+            good = jax.tree.map(np.asarray, model.parameters()[0])
+            last = sorted(good)[-1]
+            missing = {k: v for k, v in good.items() if k != last}
+            with pytest.raises(ValueError) as ei:
+                eng.refresh_params(missing)
+            msg = str(ei.value)
+            assert f"['{last}']" in msg \
+                and "missing from the incoming" in msg
+            assert "float32" in msg       # the contract side's dtype
+            reshaped = dict(good)
+            reshaped[last] = jax.tree.map(
+                lambda a: np.zeros((9,) + a.shape, a.dtype), good[last])
+            with pytest.raises(ValueError) as ei:
+                eng.refresh_params(reshaped)
+            msg = str(ei.value)
+            assert f"['{last}']" in msg and "expected shape" in msg \
+                and "got shape" in msg
+        finally:
+            eng.close()
+
+
+# --------------------------------------------------------------------------- #
+# Slow tier: the elastic-tp SIGKILL drill (ISSUE 12 acceptance).
+# --------------------------------------------------------------------------- #
+
+
+def _cli(out, *extra):
+    cmd = [sys.executable, "-m", "tools.train_supervised", "--out", out,
+           "--steps", "12", "--batch", "64", "--datasetSize", "256",
+           "--backoff", "0.05"] + list(extra)
+    return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=420)
+
+
+def _step_losses(run_dir):
+    out = {}
+    p = os.path.join(run_dir, "telemetry.jsonl")
+    if not os.path.isfile(p):
+        return out
+    for ln in open(p, errors="replace"):
+        try:
+            ev = json.loads(ln)
+        except ValueError:
+            continue
+        if ev.get("kind") == "step":
+            out[int(ev["step"])] = float(ev["loss"])
+    return out
+
+
+@pytest.mark.slow
+class TestElasticTpDrill:
+    def test_kill_tp4_restart_tp2_matches_baseline(self, tmp_path):
+        """ISSUE-12 acceptance: SIGKILL a tp=4 run mid-epoch; it
+        auto-restarts as tp=2 from the last intact snapshot and every
+        attempt's per-step loss stays within 5e-5 of the uninterrupted
+        tp=4 baseline (the PR 8 dp bar)."""
+        base_out = str(tmp_path / "base")
+        r = _cli(base_out, "--strategy", "tp", "--devices", "8",
+                 "--tpDegree", "4", "--ckptEvery", "100")
+        assert r.returncode == 0, r.stderr[-2000:]
+        base = _step_losses(os.path.join(base_out, "attempt_0"))
+        assert sorted(base) == list(range(1, 13))
+
+        drill_out = str(tmp_path / "drill")
+        r = _cli(drill_out, "--strategy", "tp", "--devices", "8",
+                 "--tpDegree", "4", "--restartStrategy", "tp:2",
+                 "--ckptEvery", "3", "--chaos", "kill:5", "--sharded")
+        assert r.returncode == 0, r.stderr[-2000:]
+        summary = json.loads(r.stdout.strip().splitlines()[-1])
+        assert summary["restarts"] == 1
+        assert summary["recovery_events"][0]["cause"] == "process_death"
+
+        merged = {}
+        for att in sorted(os.listdir(drill_out)):
+            if not att.startswith("attempt_"):
+                continue
+            losses = _step_losses(os.path.join(drill_out, att))
+            for s, loss in losses.items():
+                assert abs(loss - base[s]) < 5e-5, (att, s, loss, base[s])
+            merged.update(losses)
+        assert sorted(merged) == list(range(1, 13))
+
+        # the restarted attempt's telemetry carries the durable reshard
+        # audit event (tp[...model=4] -> tp[...model=2])
+        resh = [json.loads(ln) for ln in
+                open(os.path.join(drill_out, "attempt_1",
+                                  "telemetry.jsonl"), errors="replace")
+                if '"reshard"' in ln]
+        assert resh and resh[0]["src"].startswith("tp[")
+        assert "model=2" in resh[0]["dst"]
+
+        # and the merged run report renders both recovery AND reshard
+        mod = _load_obs_report()
+        text = mod.format_report(mod.build_report(drill_out))
+        assert "recovery: 1 restart(s) (process_death x1)" in text
+        assert "reshard [tp-resume]" in text
+
+
+class TestRestartStrategyParse:
+    def test_restart_strategy_typo_fails_fast(self):
+        from bigdl_tpu.optim.recovery import parse_restart_strategy
+        from bigdl_tpu.utils.errors import ConfigurationError
+
+        assert parse_restart_strategy(None) is None
+        assert parse_restart_strategy("") is None
+        assert parse_restart_strategy("tp:2") == ("tp", 2)
+        with pytest.raises(ConfigurationError, match="restart strategy"):
+            parse_restart_strategy("tp:fast")
+        with pytest.raises(ConfigurationError, match="restart strategy"):
+            parse_restart_strategy("pp:2")

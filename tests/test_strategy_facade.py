@@ -21,10 +21,12 @@ from bigdl_tpu.nn.attention import TransformerLM
 from bigdl_tpu.optim import Optimizer, StrategyOptimizer, Trigger
 from bigdl_tpu.utils.random_generator import RNG
 
-requires_modern_jax = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="old-jax compat fallback lacks the donation/resharding "
-           "semantics this test depends on")
+# the requires_modern_jax skips this file carried are RETIRED (ISSUE
+# 12): the ep donation-alias failure was fixed by PR 7's
+# opt_state_shardings pin, and checkpoint resume restores under the
+# snapshot's own layout before redistributing (parallel/reshard.py),
+# so there is no cross-layout resharding strictness left to trip on
+# the old-jax compat fallback.
 
 
 
@@ -115,9 +117,6 @@ class TestStrategyFacade:
         # finalize() folded the stage-stacked params back into the model
         assert "block3" in model._params
 
-    # old-jax (pre-0.5, utils/compat.py fallback) lacks the donation/
-    # resharding semantics this path depends on; auto-re-enables on new jax
-    @requires_modern_jax
     def test_ep_facade_loss_matches(self):
         from bigdl_tpu.nn.moe import MoETransformerLM
         RNG.set_seed(0)
@@ -155,9 +154,6 @@ class TestStrategyFacade:
         from bigdl_tpu.utils import file_io
         assert file_io.latest_checkpoint(str(tmp_path)) is not None
 
-    # old-jax (pre-0.5, utils/compat.py fallback) lacks the donation/
-    # resharding semantics this path depends on; auto-re-enables on new jax
-    @requires_modern_jax
     def test_checkpoint_resume_bit_exact(self, tmp_path):
         """2 steps straight == 1 step + checkpoint + resume + 1 step."""
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
@@ -312,9 +308,6 @@ class TestStrategyFacade:
         assert isinstance(opt, DistriOptimizer)
         assert opt.sync_bn and opt.mesh is mesh
 
-    # old-jax (pre-0.5, utils/compat.py fallback) lacks the donation/
-    # resharding semantics this path depends on; auto-re-enables on new jax
-    @requires_modern_jax
     def test_sharded_checkpoint_resume_bit_exact(self, tmp_path):
         """Orbax sharded snapshots of the strategy-native (tp-sharded)
         trees: 2 steps straight == 1 step + sharded snap + resume + 1."""

@@ -343,10 +343,14 @@ def _slo_section(other):
 def _recovery_section(other):
     """Summarize ``kind: "recovery"`` events -- the RunSupervisor's
     restart records (docs/robustness.md): one entry per restart (cause,
-    snapshot resumed from, steps replayed, backoff), plus totals.  None
-    for runs without restarts."""
+    snapshot resumed from, steps replayed, backoff), plus totals --
+    and ``kind: "reshard"`` events (the cross-layout redistributions an
+    elastic restart or a layout-aware serving refresh performed:
+    src/dst layout, planes moved, host bytes, wall seconds).  None for
+    runs with neither."""
     recs = [e for e in other if e.get("kind") == "recovery"]
-    if not recs:
+    resh = [e for e in other if e.get("kind") == "reshard"]
+    if not recs and not resh:
         return None
     causes = {}
     for e in recs:
@@ -364,6 +368,11 @@ def _recovery_section(other):
                      "snapshot_step", "steps_replayed", "backoff_s")}
                    for e in recs],
     }
+    if resh:
+        sec["reshards"] = [{k: e.get(k) for k in
+                            ("src", "dst", "what", "planes",
+                             "host_bytes", "wall_s")}
+                           for e in resh]
     return sec
 
 
@@ -800,6 +809,13 @@ def format_report(rep):
                 f"(policy {o.get('policy')})")
     rc = rep.get("recovery")
     if rc:
+        for e in rc.get("reshards", [])[-6:]:
+            mb = (e.get("host_bytes") or 0) / 1e6
+            out.append(
+                f"reshard [{e.get('what')}]: {e.get('src')} -> "
+                f"{e.get('dst')} ({e.get('planes')} planes, "
+                f"{mb:.1f} MB host, {e.get('wall_s', 0):.3f}s)")
+    if rc and rc.get("restarts"):
         cause_str = ", ".join(f"{c} x{n}" for c, n in
                               sorted(rc["causes"].items()))
         line = f"recovery: {rc['restarts']} restart(s) ({cause_str})"

@@ -56,6 +56,18 @@ def build_args(argv=None):
     ap.add_argument("--restartDevices", type=int, default=None,
                     help="device count after a restart (default: same -- "
                          "set lower to drill the N->M resume)")
+    ap.add_argument("--strategy", choices=("dp", "tp"), default="dp",
+                    help="workload: dp = ZeRO-1 MLP (the PR 8 drill); "
+                         "tp = tensor-parallel TransformerLM over a "
+                         "(data, model) mesh")
+    ap.add_argument("--tpDegree", type=int, default=4,
+                    help="tensor-parallel degree of the first attempt "
+                         "(--strategy tp; must divide --devices)")
+    ap.add_argument("--restartStrategy", default=None,
+                    help="layout after a restart, e.g. tp:2 -- the "
+                         "resumed attempts come up on a DIFFERENT tp "
+                         "degree and resume through the redistribution "
+                         "engine (parallel/reshard.py)")
     ap.add_argument("--ckptEvery", type=int, default=4)
     ap.add_argument("--sharded", action="store_true",
                     help="sharded (orbax) snapshots instead of pickle")
@@ -105,17 +117,10 @@ def worker_env(base_env, args, attempt):
 # --------------------------------------------------------------------------- #
 
 
-def run_worker(args):
+def _build_dp(args, nn, optim, array_dataset, SampleToMiniBatch):
+    """The PR 8 drill workload: a ZeRO-1 MLP over every visible device."""
     import numpy as np
 
-    import bigdl_tpu.nn as nn
-    from bigdl_tpu import optim
-    from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
-    from bigdl_tpu.observability import StepTelemetry
-    from bigdl_tpu.optim.recovery import ChaosKillTrigger, parse_chaos
-    from bigdl_tpu.utils.random_generator import RNG
-
-    RNG.set_seed(args.seed)
     rng = np.random.default_rng(args.seed)
     x = rng.standard_normal((args.datasetSize, 12)).astype("float32")
     w = rng.standard_normal((12, 5)).astype("float32")
@@ -124,9 +129,56 @@ def run_worker(args):
         args.batch)
     model = (nn.Sequential().add(nn.Linear(12, 32)).add(nn.ReLU())
              .add(nn.Linear(32, 5)))
-    opt = optim.DistriOptimizer(
+    return optim.DistriOptimizer(
         model, ds, nn.CrossEntropyCriterion(),
         optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0))
+
+
+def _build_tp(args, nn, optim, array_dataset, SampleToMiniBatch):
+    """The elastic-tp drill workload: a tensor-parallel TransformerLM
+    over a (data, model) mesh of every visible device.  ``--tpDegree``
+    sizes the model axis; restarts may come up on a DIFFERENT degree
+    (``--restartStrategy tp:<d>``) and resume through the
+    redistribution engine (docs/robustness.md, "Portable
+    resharding")."""
+    import numpy as np
+
+    import jax
+    from bigdl_tpu.nn.attention import TransformerLM
+
+    ndev = jax.device_count()
+    tp = int(args.tpDegree)
+    if ndev % tp:
+        raise SystemExit(
+            f"--tpDegree {tp} does not divide the {ndev} visible devices")
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()).reshape(ndev // tp, tp),
+        ("data", "model"))
+    vocab, seq = 32, 16
+    rng = np.random.default_rng(args.seed)
+    x = rng.integers(0, vocab, (args.datasetSize, seq)).astype("int32")
+    y = np.roll(x, -1, axis=1).astype("int32")     # learnable structure
+    ds = array_dataset(x, y, seed=args.seed) >> SampleToMiniBatch(
+        args.batch)
+    model = TransformerLM(vocab, 32, 4, num_layers=2, max_len=seq)
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+    return optim.Optimizer(
+        model, ds, crit,
+        optim.SGD(learning_rate=args.lr, momentum=0.9, dampening=0.0),
+        strategy="tp", mesh=mesh)
+
+
+def run_worker(args):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.dataset import SampleToMiniBatch, array_dataset
+    from bigdl_tpu.observability import StepTelemetry
+    from bigdl_tpu.optim.recovery import ChaosKillTrigger, parse_chaos
+    from bigdl_tpu.utils.random_generator import RNG
+
+    RNG.set_seed(args.seed)
+    build = _build_tp if args.strategy == "tp" else _build_dp
+    opt = build(args, nn, optim, array_dataset, SampleToMiniBatch)
 
     run_dir = os.path.join(args.out, f"attempt_{args.attempt}")
     tel = StepTelemetry(run_dir, run_name=f"attempt_{args.attempt}",
@@ -170,9 +222,15 @@ def run_supervisor(args):
     from bigdl_tpu.observability import StepTelemetry
     from bigdl_tpu.optim.recovery import (RunSupervisor,
                                           last_step_in_telemetry,
-                                          parse_chaos)
+                                          parse_chaos,
+                                          parse_restart_strategy)
 
     parse_chaos(args.chaos)            # fail fast on a typo'd drill spec
+    restart_layout = parse_restart_strategy(args.restartStrategy)
+    if restart_layout is not None and args.strategy != "tp":
+        raise SystemExit(
+            "--restartStrategy tp:<d> needs --strategy tp (dp restarts "
+            "resize with --restartDevices)")
     os.makedirs(args.out, exist_ok=True)
     tel = StepTelemetry(os.path.join(args.out, "supervisor"),
                         run_name="supervisor", trace=False)
@@ -200,7 +258,12 @@ def run_supervisor(args):
                "--batch", str(args.batch),
                "--datasetSize", str(args.datasetSize),
                "--lr", str(args.lr), "--seed", str(args.seed),
-               "--ckptEvery", str(args.ckptEvery)]
+               "--ckptEvery", str(args.ckptEvery),
+               "--strategy", args.strategy]
+        if args.strategy == "tp":
+            degree = args.tpDegree if attempt == 0 or \
+                restart_layout is None else restart_layout[1]
+            cmd += ["--tpDegree", str(degree)]
         if args.sharded:
             cmd.append("--sharded")
         if attempt == 0 and args.chaos:
