@@ -30,3 +30,34 @@ def get_id_pairs(folder):
     """-> (user, item) id pairs + ratings, 1-based ids preserved."""
     data = read_data_sets(folder)
     return data[:, :2], data[:, 2]
+
+
+def write_ratings(folder, n_users=30, n_items=40, n=600, seed=0):
+    """A miniature, deterministic ``ratings.dat`` in the ml-1m layout
+    (the second-workload drill's dataset: the rating carries learnable
+    user/item structure, so a few supervised steps visibly move the
+    model).  Existing files are overwritten; returns the folder."""
+    os.makedirs(folder, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    users = rng.integers(1, n_users + 1, n)
+    items = rng.integers(1, n_items + 1, n)
+    # deterministic structure + a little noise: rating in 1..5
+    ratings = ((users * 3 + items * 7) % 5) + 1
+    flip = rng.random(n) < 0.05
+    ratings = np.where(flip, rng.integers(1, 6, n), ratings)
+    ts = 978300000 + np.arange(n)
+    with open(os.path.join(folder, "ratings.dat"), "w") as f:
+        for u, i, r, t in zip(users, items, ratings, ts):
+            f.write(f"{u}::{i}::{r}::{t}\n")
+    return folder
+
+
+def to_id_features(pairs, n_users):
+    """(user, item) 1-based id pairs -> dense ``(N, 2)`` float32 id
+    features over ONE shared id space (items offset past the users):
+    the input shape ``nn.sparse.sparse_recommender`` consumes
+    (``DenseToSparse`` re-sparsifies inside the jitted step, so zero
+    rows -- serving-bucket padding -- contribute nothing)."""
+    pairs = np.asarray(pairs)
+    return np.stack([pairs[:, 0], n_users + pairs[:, 1]],
+                    axis=1).astype(np.float32)

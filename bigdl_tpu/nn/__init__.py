@@ -52,7 +52,7 @@ from bigdl_tpu.nn.recurrent import (
 from bigdl_tpu.nn.tree import BinaryTreeLSTM
 from bigdl_tpu.nn.sparse import (
     SparseTensor, DenseToSparse, LookupTableSparse, SparseLinear,
-    sparse_join, sparse_stack,
+    sparse_join, sparse_stack, sparse_recommender,
 )
 from bigdl_tpu.nn.detection import (
     PriorBox, Anchor, Proposal, Nms, NormalizeScale,

@@ -263,3 +263,26 @@ class SparseLinear(Linear):
 class _DenseSpec:
     def __init__(self, shape):
         self.shape = shape
+
+
+def sparse_recommender(n_ids, n_classes=5, embed_dim=16, hidden=32):
+    """The MovieLens recommender of the second-workload drill
+    (docs/robustness.md, "Continuous deployment"): dense ``(N, 2)``
+    1-based id features (``dataset.movielens.to_id_pairs`` /
+    ``to_id_features``) re-sparsify INSIDE the jitted step
+    (``DenseToSparse``, static capacity), sum user+item embeddings
+    (``LookupTableSparse``) and classify the rating -- so the whole
+    model is this module's sparse path end-to-end, servable through
+    ``ServingEngine`` with ordinary batch-bucket padding (a padded
+    zero row has no valid sparse entries and contributes nothing).
+
+    ``n_ids``: the shared id space size (``n_users + n_items``)."""
+    from bigdl_tpu.nn.activations import ReLU
+    from bigdl_tpu.nn.containers import Sequential
+
+    return (Sequential()
+            .add(DenseToSparse())
+            .add(LookupTableSparse(n_ids, embed_dim, combiner="sum"))
+            .add(Linear(embed_dim, hidden))
+            .add(ReLU())
+            .add(Linear(hidden, n_classes)))

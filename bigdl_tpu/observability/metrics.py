@@ -385,8 +385,14 @@ class MetricsRegistry:
         kind = event.get("kind")
         if kind == "header":
             self._note_cost((event.get("cost") or {}), event)
+            if event.get("serving"):
+                self._observe_serving_info(event["serving"])
         elif kind == "cost":
             self._note_cost((event.get("cost") or {}), None)
+        elif kind == "serving_info":
+            self._observe_serving_info(event.get("serving") or {})
+        elif kind == "deploy":
+            self._observe_deploy(event)
         elif kind == "step":
             self._observe_step(event)
         elif kind == "inference":
@@ -498,6 +504,40 @@ class MetricsRegistry:
                          "XLA compiles inside serving ticks (nonzero "
                          "after precompile = a shape leak)") \
                 .inc(event["compiles"])
+
+    def _observe_serving_info(self, info):
+        """Which model version a replica serves, as the Prometheus
+        version-info idiom: ``bigdl_serving_version_info{version,
+        digest}`` is 1 for the currently-served version and 0 for every
+        version this process served before -- a scrape (or a PromQL
+        join) can always answer "which checkpoint is live?"."""
+        if info.get("version") is None:
+            return
+        g = self.gauge(f"{self.prefix}_serving_version_info",
+                       "1 on the currently-served model version",
+                       labelnames=("version", "digest"))
+        # zero the predecessors AND raise the new version under ONE
+        # lock acquisition (render() scrapes under the same lock): a
+        # scrape must never observe the all-zero in-between state
+        with g._lock:
+            for child in g._children.values():
+                child[0] = 0.0
+            g._child({"version": str(info["version"]),
+                      "digest": str(info.get("digest") or "")})[0] = 1.0
+
+    # -- deploy tier ----------------------------------------------------------- #
+    def _observe_deploy(self, event):
+        """Staged-rollout verdicts (serving/deploy.py): one counter per
+        (stage, verdict) so a fleet dashboard sees cutovers, rejections
+        and rollbacks as they land."""
+        self.counter(f"{self.prefix}_deploy_total",
+                     "deploy stage verdicts, by stage and outcome",
+                     labelnames=("stage", "outcome")) \
+            .inc(stage=str(event.get("stage", "?")),
+                 outcome=str(event.get("verdict", "?")))
+        if event.get("stage") == "rollback":
+            self.counter(f"{self.prefix}_deploy_rollbacks_total",
+                         "automatic/operator rollbacks").inc()
 
     # -- health / anomalies --------------------------------------------------- #
     def _observe_health(self, event):

@@ -50,9 +50,11 @@ SCHEMA_VERSION = 1
 #: event is the record of a restart whose successor may itself die; an
 #: slo breach under the halt policy is about to END the run; a reshard
 #: event is the audit trail of a cross-layout restore whose run may
-#: die before its first step)
+#: die before its first step; a deploy event is the stage/rollback
+#: verdict of a live version swap -- the line the chaos drill audits
+#: after SIGKILLing the server mid-cutover)
 DURABLE_KINDS = frozenset({"health", "anomaly", "timing_audit",
-                           "recovery", "slo", "reshard"})
+                           "recovery", "slo", "reshard", "deploy"})
 
 log = logging.getLogger("bigdl_tpu.observability")
 

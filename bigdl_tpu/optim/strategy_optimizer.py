@@ -182,8 +182,10 @@ class StrategyOptimizer(BaseOptimizer):
                 block_layout=detect_block_layout(params))
         if self.strategy == "ep":
             from bigdl_tpu.parallel.ep import MOE_EP_RULES
+            from bigdl_tpu.parallel.reshard import detect_num_experts
             return LayoutSpec.ep(mesh_axes,
-                                 rules=kw.get("rules", MOE_EP_RULES))
+                                 rules=kw.get("rules", MOE_EP_RULES),
+                                 num_experts=detect_num_experts(params))
         return LayoutSpec.sp(mesh_axes, kw.get("seq_axis", "seq"),
                              block_layout=detect_block_layout(params))
 

@@ -194,11 +194,17 @@ class AccuracyDeltaGate:
         leaves = jax.tree.leaves(out)
         return np.asarray(leaves[0])
 
-    def check(self, ref_eval, cand_eval):
-        """-> (ok, detail).  ``detail["reason"]`` names the first failed
-        tolerance when not ok."""
-        ref = self._logits(ref_eval(self.features))
-        cand = self._logits(cand_eval(self.features))
+    @staticmethod
+    def compare(ref, cand, labels=None):
+        """THE one divergence definition: logit RMSE / max-abs-delta /
+        top-1 agreement (+ labeled accuracies) of a candidate logit
+        batch against a reference one, as a JSON-safe detail dict.
+        ``check`` applies this gate's tolerances to it; the deploy
+        shadow path (``serving/deploy.py``) accumulates the same
+        metrics per mirrored tick, so a shadow verdict and a swap-time
+        gate verdict can never disagree about what "divergence" means."""
+        ref = np.asarray(ref)
+        cand = np.asarray(cand)
         n = ref.shape[0]
         detail = {"batch": int(n)}
         delta = cand.astype(np.float64) - ref.astype(np.float64)
@@ -207,14 +213,23 @@ class AccuracyDeltaGate:
         ref_top1 = np.argmax(ref.reshape(n, -1), axis=-1)
         cand_top1 = np.argmax(cand.reshape(n, -1), axis=-1)
         detail["top1_agreement"] = float(np.mean(ref_top1 == cand_top1))
-        if self.labels is not None:
-            labels = self.labels.reshape(-1).astype(ref_top1.dtype)
+        if labels is not None:
+            labels = np.asarray(labels).reshape(-1).astype(ref_top1.dtype)
             detail["top1_accuracy_ref"] = float(np.mean(ref_top1 == labels))
             detail["top1_accuracy_candidate"] = \
                 float(np.mean(cand_top1 == labels))
             detail["top1_accuracy_drop"] = round(
                 detail["top1_accuracy_ref"]
                 - detail["top1_accuracy_candidate"], 6)
+        return detail
+
+    def check(self, ref_eval, cand_eval):
+        """-> (ok, detail).  ``detail["reason"]`` names the first failed
+        tolerance when not ok."""
+        ref = self._logits(ref_eval(self.features))
+        cand = self._logits(cand_eval(self.features))
+        n = ref.shape[0]
+        detail = self.compare(ref, cand, self.labels)
         reason = None
         if self.min_top1_agreement is not None and \
                 detail["top1_agreement"] < self.min_top1_agreement:

@@ -136,10 +136,14 @@ class LayoutSpec:
         return cls("tp", mesh_axes, plane, block_layout)
 
     @classmethod
-    def ep(cls, mesh_axes, axis="expert", rules=None):
+    def ep(cls, mesh_axes, axis="expert", rules=None, num_experts=None):
         plane = {"axis": axis}
         if rules is not None:
             plane["rules"] = [[p, list(d)] for p, d in rules]
+        if num_experts is not None:
+            # the expert-count the tree's stacked leading dims hold --
+            # what an ep -> ep expert-count re-cut converts between
+            plane["num_experts"] = int(num_experts)
         return cls("ep", mesh_axes, plane)
 
     @classmethod
@@ -319,6 +323,122 @@ def blocks_to_pp_tree(tree, n_stages):
     }
 
 
+def detect_num_experts(params) -> Optional[int]:
+    """The expert count of the first MoE-shaped subtree in ``params``
+    (``nn/moe.py`` keying: ``gate (D, E)`` beside expert-stacked
+    ``w1 (E, D, F)``), or None for expert-free models -- what the ep
+    layout stamp records so an expert-count re-cut knows both sides."""
+    found = []
+
+    def look(d):
+        if _is_moe_node(d) and not found:
+            found.append(int(d["gate"].shape[-1]))
+        return None
+
+    _walk_dicts(params, look)
+    return found[0] if found else None
+
+
+def _is_moe_node(d) -> bool:
+    """An ``nn/moe.py``-shaped params dict (or an optimizer-moment
+    subtree mirroring one): a 2-D router ``gate`` whose logits dim
+    matches the leading expert-stacked dim of a 3-D ``w1``."""
+    if not isinstance(d, dict) or not {"gate", "w1", "w2"} <= set(d):
+        return False
+    gate, w1 = d.get("gate"), d.get("w1")
+    return (getattr(gate, "ndim", 0) == 2 and getattr(w1, "ndim", 0) == 3
+            and gate.shape[-1] == w1.shape[0])
+
+
+def _reexpert(tree, src_e, dst_e):
+    """ep -> ep expert-count re-cut, applied to every MoE-shaped
+    subtree (params AND mirrored Adam moments): the expert-stacked
+    leading dims re-cut like pp stages, and the router's gate logits
+    plane re-sizes to match the new expert count.
+
+    - GROW (``dst_e = k * src_e``): each expert splits into ``k``
+      consecutive bit-identical replicas (expert ``i`` -> rows
+      ``k*i .. k*i+k-1``) and the gate grows a logit column per
+      replica (copied, so the router's preference order is preserved;
+      with top-k routing the replicas then share their ancestor's
+      traffic -- a warm-start re-cut, the MoE upcycling stance).
+    - SHRINK (``src_e = k * dst_e``): the exact inverse -- each
+      consecutive group of ``k`` experts must be BIT-IDENTICAL (i.e.
+      an earlier grow that training has not yet diverged) and merges
+      back to its first member.  Genuinely distinct experts cannot be
+      merged and raise instead of silently averaging information away.
+
+    Grow -> shrink is therefore bit-identical (the A->B->A property
+    pin, like the dp/pp/tp conversions)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    src_e, dst_e = int(src_e), int(dst_e)
+    if src_e == dst_e:
+        return tree
+    if dst_e % src_e and src_e % dst_e:
+        raise ValueError(
+            f"cannot re-cut {src_e} experts into {dst_e}: expert counts "
+            f"must divide evenly (grow k-for-1 or merge k-to-1)")
+
+    def grow(d, k):
+        out = dict(d)
+        for key, a in d.items():
+            if not hasattr(a, "shape"):
+                continue
+            if key == "gate":
+                out[key] = jnp.repeat(jnp.asarray(a), k, axis=-1)
+            elif a.ndim >= 1 and a.shape[0] == src_e:
+                out[key] = jnp.repeat(jnp.asarray(a), k, axis=0)
+        return out
+
+    def _concrete(a):
+        """Host numpy view of a leaf, or None under an abstract trace
+        (``convert_shapes``) -- where the replica-identity check is
+        meaningless and only the shapes matter."""
+        try:
+            return np.asarray(a)
+        except Exception:
+            return None
+
+    def shrink(d, k):
+        out = dict(d)
+        for key, a in d.items():
+            if not hasattr(a, "shape"):
+                continue
+            if key == "gate":
+                g = jnp.reshape(jnp.asarray(a),
+                                tuple(a.shape[:-1]) + (dst_e, k))
+                gc = _concrete(g)
+                if gc is not None and not (gc == gc[..., :1]).all():
+                    raise ValueError(
+                        f"cannot merge {src_e} experts into {dst_e}: "
+                        f"gate logit columns of a replica group differ "
+                        f"-- these are genuinely distinct experts, not "
+                        f"an undiverged grow")
+                out[key] = g[..., 0]
+            elif a.ndim >= 1 and a.shape[0] == src_e:
+                g = jnp.reshape(jnp.asarray(a), (dst_e, k) + a.shape[1:])
+                gc = _concrete(g)
+                if gc is not None and not (gc == gc[:, :1]).all():
+                    raise ValueError(
+                        f"cannot merge {src_e} experts into {dst_e}: "
+                        f"expert plane {key!r} differs within a replica "
+                        f"group -- these are genuinely distinct "
+                        f"experts, not an undiverged grow")
+                out[key] = g[:, 0]
+        return out
+
+    def convert(d):
+        if not _is_moe_node(d) or d["gate"].shape[-1] != src_e:
+            return None
+        return grow(d, dst_e // src_e) if dst_e > src_e \
+            else shrink(d, src_e // dst_e)
+
+    return _walk_dicts(tree, convert)
+
+
 def _walk_dicts(tree, fn):
     """Apply ``fn`` to every dict node top-down; when ``fn`` returns a
     replacement (non-None), recursion stops for that subtree."""
@@ -420,6 +540,11 @@ def _convert(tree, src, dst):
             "the dp layout is a FLAT plane; convert through the model "
             "tree with flat_to_tree/tree_to_flat (they need the "
             "model's tree as the unravel template)")
+    if src.kind == "ep" and dst.kind == "ep":
+        se = src.plane.get("num_experts")
+        de = dst.plane.get("num_experts")
+        if se is not None and de is not None and int(se) != int(de):
+            tree = _reexpert(tree, se, de)
     out = _restage(tree, src, dst)
     # pp trees are unrolled by construction on both sides of _restage
     src_bl = "unrolled" if src.kind == "pp" else src.block_layout
@@ -522,6 +647,10 @@ def redistribute(tree, src, dst, telemetry=None, what="params"):
       planes; offset-preserving EF-residual re-partition);
     - pp -> pp: stage re-cutting (4-stage stacked -> 2-stage stacked);
     - pp <-> tp/ep/sp/replicated: stage-stacked <-> per-block trees;
+    - ep -> ep expert-count re-cut (``num_experts`` in both planes):
+      expert-stacked leading dims split k-for-1 / merge k-to-1 with
+      the router's gate logits plane re-sized to match
+      (``_reexpert`` -- grow->shrink is bit-identical);
     - scan <-> unrolled transformer block keying (``block_layout``);
     - tp/ep/sp <-> replicated: the logical tree is identical -- the
       call is then an audited identity (device placement is the

@@ -14,12 +14,22 @@ reference's ``optim/PredictionService.scala`` instance pool).
   time, and an ``optim.validation.AccuracyDeltaGate`` rejects swaps
   whose fp32-vs-int8 divergence exceeds tolerance.
 
-See docs/performance.md ("Inference serving", "Int8 inference") and
-docs/observability.md (extended ``kind: "inference"`` event schema,
-serving-precision header stamp).
+- ``ModelRegistry`` / ``RolloutController`` (``serving/deploy.py``) --
+  the train->serve loop closed: versioned hot-swap with shadow/canary
+  staged exposure, atomic cutover, automatic rollback to the retained
+  previous version, durable ``kind: "deploy"`` audit events.
+
+See docs/performance.md ("Inference serving", "Int8 inference"),
+docs/robustness.md ("Continuous deployment") and docs/observability.md
+(extended ``kind: "inference"`` event schema, serving-precision +
+version header stamp, the ``deploy`` event schema).
 """
 
 from bigdl_tpu.serving.buckets import BucketLadder
+from bigdl_tpu.serving.deploy import (ModelRegistry, ModelVersion,
+                                      RolloutController, snapshot_digest)
 from bigdl_tpu.serving.engine import ServeFuture, ServingEngine
 
-__all__ = ["BucketLadder", "ServeFuture", "ServingEngine"]
+__all__ = ["BucketLadder", "ModelRegistry", "ModelVersion",
+           "RolloutController", "ServeFuture", "ServingEngine",
+           "snapshot_digest"]

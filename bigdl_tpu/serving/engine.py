@@ -77,7 +77,25 @@ class _LocalEval:
         self.model = model
         self.step = compiled_eval_step(model, compute_dtype)
 
-    def eval(self, x, tick=0):
+    def stage(self, params, mstate):
+        # uncommitted jnp leaves, like init-time weights: a numpy tree
+        # would key the jit cache differently and force one spurious
+        # recompile on the first tick that serves it
+        import jax.numpy as jnp
+
+        return (jax.tree.map(jnp.asarray, params), mstate)
+
+    def install(self, staged):
+        # the local layout serves from the model's own tree (the engine
+        # points the model at the staged params); nothing device-side
+        pass
+
+    def capture(self):
+        return (self.model.parameters()[0], self.model.state())
+
+    def eval(self, x, tick=0, weights=None):
+        if weights is not None:
+            return self.step(weights[0], weights[1], x)
         params, mstate = self.model.parameters()[0], self.model.state()
         return self.step(params, mstate, x)
 
@@ -109,18 +127,31 @@ class _ShardedEval:
         self.refresh_params()
 
     def refresh_params(self):
-        self._params = jax.device_put(self.model.parameters()[0], self._rep)
-        mstate = self.model.state()
-        self._mstate = mstate if not jax.tree.leaves(mstate) else \
+        self.install(self.stage(self.model.parameters()[0],
+                                self.model.state()))
+
+    def stage(self, params, mstate):
+        staged_p = jax.device_put(params, self._rep)
+        staged_m = mstate if not jax.tree.leaves(mstate) else \
             jax.device_put(mstate, self._rep)
+        return (staged_p, staged_m)
+
+    def install(self, staged):
+        # one tuple unpack = the atomic pointer swap a cutover rides on
+        self._params, self._mstate = staged
+
+    def capture(self):
+        return (self._params, self._mstate)
 
     def _stage(self, x):
         from bigdl_tpu.parallel.zero import stage_batch_global
 
         return stage_batch_global(x, self._batch_sharding)
 
-    def eval(self, x, tick=0):
-        return self.step(self._params, self._mstate, self._stage(x))
+    def eval(self, x, tick=0, weights=None):
+        params, mstate = weights if weights is not None \
+            else (self._params, self._mstate)
+        return self.step(params, mstate, self._stage(x))
 
     def precompile(self, sample_spec, buckets):
         return self.step.precompile(self._params, self._mstate, sample_spec,
@@ -146,13 +177,22 @@ class _RoundRobinEval:
 
     def refresh_params(self):
         # per-device replicas (the "clone pool"), remade on demand
-        params, mstate = self.model.parameters()[0], self.model.state()
-        self._replicas = [jax.device_put((params, mstate), d)
-                          for d in self.devices]
+        self.install(self.stage(self.model.parameters()[0],
+                                self.model.state()))
 
-    def eval(self, x, tick=0):
+    def stage(self, params, mstate):
+        return [jax.device_put((params, mstate), d) for d in self.devices]
+
+    def install(self, staged):
+        self._replicas = staged        # one list swap = atomic cutover
+
+    def capture(self):
+        return self._replicas
+
+    def eval(self, x, tick=0, weights=None):
         dev = self.devices[tick % len(self.devices)]
-        params, mstate = self._replicas[tick % len(self.devices)]
+        replicas = weights if weights is not None else self._replicas
+        params, mstate = replicas[tick % len(self.devices)]
         return self.step(params, mstate, jax.device_put(x, dev))
 
     def precompile(self, sample_spec, buckets):
@@ -363,6 +403,20 @@ class ServingEngine:
         self._running = True
         self._tick = 0
         self._gate_detail = None
+        # staged-exposure seams (serving/deploy.py): a canary routes a
+        # traffic fraction's ticks onto a staged candidate's weights; a
+        # shadow mirrors a fraction of ticks (batch + live outputs) to
+        # an off-request-path observer.  Written by the rollout
+        # controller's thread, read once per tick by the dispatcher --
+        # single-attribute assignment keeps each swap atomic.
+        self._canary = None           # (staged handle, fraction, version)
+        self._canary_acc = 0.0
+        self._canary_ticks = 0        # ticks served on the candidate
+        self._canary_rows = 0         # real rows served on the candidate
+        self._canary_failures = 0     # candidate ticks that raised
+        self._shadow = None           # (fn, fraction)
+        self._shadow_acc = 0.0
+        self._version_info = None     # {"version", "digest"} when deployed
         if self._gate is not None:
             # the INITIAL quantization must clear the same bar a later
             # hot-swap would: a model this quantizer damages beyond
@@ -623,6 +677,18 @@ class ServingEngine:
         futs: List[ServeFuture] = [r[1] for r in reqs]
         execs_before = self._executables() \
             if self.telemetry is not None else 0
+        # canary routing decided up front (error-diffusion accumulator:
+        # a fraction f serves ~f of ticks on the candidate, spread
+        # evenly, deterministically); the canary tuple is read ONCE so
+        # a concurrent set_canary(None) cannot tear this tick
+        canary = self._canary
+        on_canary = False
+        if canary is not None:
+            self._canary_acc += canary[1]
+            if self._canary_acc >= 1.0 - 1e-9:
+                self._canary_acc -= 1.0
+                on_canary = True
+        reached_eval = False
         try:
             with self._span("serve_tick", tick=self._tick, records=len(reqs)):
                 n = len(feats)
@@ -631,13 +697,26 @@ class ServingEngine:
                     bucket = self.ladder.add(n)
                 x = self._form_batch(feats, bucket)
                 t_formed = time.perf_counter()
-                y = self._backend.eval(x, tick=self._tick)
+                reached_eval = True
+                # weights= passed only on canary ticks: callers (and
+                # tests) may substitute eval callables that predate
+                # the override kwarg
+                y = self._backend.eval(
+                    x, tick=self._tick,
+                    weights=canary[0]["staged"]) if on_canary \
+                    else self._backend.eval(x, tick=self._tick)
                 y = jax.tree.map(np.asarray, y)        # host sync + gather
         except Exception as e:
             # the failure belongs to THIS tick's callers only: surface
             # it on each future and keep the dispatcher serving
             log.exception("serving tick %d failed (%d requests)",
                           self._tick, len(futs))
+            if on_canary and reached_eval:
+                # a crashing candidate EVAL is canary evidence (the
+                # rollout controller's rejection trigger); a malformed
+                # request failing batch formation is the client's
+                # fault on any tick and must not veto the rollout
+                self._canary_failures += 1
             for fut in futs:
                 if not fut.done():
                     fut.set_exception(e)
@@ -647,6 +726,23 @@ class ServingEngine:
             fut.bucket = bucket
             fut.latency_s = t_done - fut._t_submit
             fut.set_result(jax.tree.map(lambda a: a[i], y))
+        if on_canary:
+            self._canary_ticks += 1
+            self._canary_rows += n
+        # shadow mirroring AFTER the results are delivered: the
+        # observer gets the tick's padded batch + live outputs and must
+        # only enqueue (the candidate eval runs on the controller's
+        # shadow worker, never on the request path)
+        shadow = self._shadow
+        if shadow is not None:
+            self._shadow_acc += shadow[1]
+            if self._shadow_acc >= 1.0 - 1e-9:
+                self._shadow_acc -= 1.0
+                try:
+                    shadow[0](x, y, bucket, n, self._tick)
+                except Exception:
+                    log.exception("shadow observer failed (tick %d)",
+                                  self._tick)
         if self.telemetry is not None:
             try:
                 wall = t_done - t0
@@ -658,6 +754,11 @@ class ServingEngine:
                     bucket=bucket, batch_fill=n / bucket,
                     pad_waste=(bucket - n) / bucket,
                     request_latency_s=[round(f.latency_s, 6) for f in futs])
+                if on_canary:
+                    # which ticks rode the candidate: the per-version
+                    # SLO cut of the canary window reads this
+                    event["canary"] = True
+                    event["canary_version"] = canary[2]
                 compiles = self._executables() - execs_before
                 if compiles > 0:
                     # a tick that compiled: after precompile() this is
@@ -747,6 +848,11 @@ class ServingEngine:
                 "model_bytes": self.serving_model_bytes(),
                 "backend": self._backend.kind,
                 "replicas": self._backend.replicas}
+        if self._version_info is not None:
+            # WHICH checkpoint this replica serves: version id + the
+            # snapshot's manifest digest (set_serving_version)
+            info["version"] = self._version_info["version"]
+            info["digest"] = self._version_info["digest"]
         if self._quantized:
             info["model_bytes_fp32"] = model_bytes(self.model.parameters()[0])
         if self._gate_detail is not None:
@@ -755,6 +861,183 @@ class ServingEngine:
             self.telemetry.set_serving_info(info)
         except Exception:
             log.exception("serving_info telemetry stamp failed")
+
+    # ----- staged deployment surface (serving/deploy.py) --------------------- #
+    def stage_weights(self, params, mstate=None, src_layout=None):
+        """Validate + device-stage a CANDIDATE weight set WITHOUT
+        committing anything: the engine keeps serving its current
+        weights while the candidate's device buffers sit staged beside
+        them.  Returns an opaque staged handle the rollout machinery
+        threads through shadow evaluation (``eval_staged``), canary
+        routing (``set_canary``) and the eventual atomic
+        ``commit_staged`` -- or retains for a pointer-swap rollback.
+
+        Same front door as ``refresh_params``: ``src_layout``
+        redistributes a cross-layout checkpoint onto the serving tree
+        first, then the structure/shape contract check runs -- a
+        half-written checkpoint raises here, before any staging.  On a
+        quantized engine the candidate is quantized ONCE at staging
+        (the handle carries the int8 payload+scales); a later commit or
+        rollback of this handle never re-quantizes or re-stages."""
+        if src_layout is not None:
+            from bigdl_tpu.parallel.reshard import to_model_layout
+
+            params = to_model_layout(params, src_layout, self.model,
+                                     telemetry=self.telemetry,
+                                     what="deploy-stage")
+        reason = self._validate_incoming(params, mstate)
+        if reason is not None:
+            raise ValueError(
+                f"stage_weights rejected the candidate ({reason}); "
+                f"nothing was staged -- is the source checkpoint "
+                f"half-written or from a different model?")
+        from bigdl_tpu.nn.quantized import model_bytes
+        import jax.numpy as jnp
+
+        # normalize to UNCOMMITTED jnp leaves here, so the tree a later
+        # commit points the model at keys the jit cache exactly like
+        # the init-time weights it replaces (a raw-numpy checkpoint
+        # tree would force one spurious recompile on the first
+        # post-cutover tick -- the zero-steady-state-recompile pin)
+        params = jax.tree.map(jnp.asarray, params)
+        if mstate is not None:
+            mstate = jax.tree.map(jnp.asarray, mstate)
+        stage_mstate = mstate if mstate is not None else self.model.state()
+        qparams = None
+        if self._quantized:
+            from bigdl_tpu.nn.quantized import quantize_params
+
+            qparams = quantize_params(self.model, params, self._qselect)
+        serve_tree = qparams if qparams is not None else params
+        return {"params": params, "mstate": mstate, "qparams": qparams,
+                "staged": self._backend.stage(serve_tree, stage_mstate),
+                "model_bytes": model_bytes(serve_tree),
+                "quantized": self._quantized}
+
+    def capture_staged(self):
+        """The CURRENTLY serving weights as a staged handle -- what a
+        rollout controller retains before a cutover so rollback is a
+        pointer swap back to live device buffers, never a re-quantize
+        or a re-stage."""
+        from bigdl_tpu.nn.quantized import model_bytes
+
+        qparams = self._qmodel.parameters()[0] if self._quantized else None
+        serve_tree = qparams if qparams is not None \
+            else self.model.parameters()[0]
+        # the CURRENT model state rides the handle: a rollback must
+        # restore it too, or a stateful model (BatchNorm running
+        # stats) would serve previous params mixed with the rejected
+        # candidate's state -- not the bit-for-bit re-serve promised
+        return {"params": self.model.parameters()[0],
+                "mstate": self.model.state(), "qparams": qparams,
+                "staged": self._backend.capture(),
+                "model_bytes": model_bytes(serve_tree),
+                "quantized": self._quantized}
+
+    def commit_staged(self, handle, version=None, digest=None):
+        """The atomic cutover: point the engine at an already-staged
+        handle.  The serving-visible swap is ONE attribute assignment
+        (the backend's committed weights pointer / the served model's
+        params dict), so a tick observes either the old weights or the
+        new ones, never a torn mix -- and because the handle's device
+        buffers already exist, this is equally the ROLLBACK primitive:
+        committing a retained previous handle re-serves it bit-for-bit
+        with no re-quantize, no re-stage, no gate.
+
+        No gate runs here by design -- staged-exposure verdicts
+        (shadow comparison, canary SLO + accuracy gate) belong to the
+        rollout controller BEFORE it commits
+        (docs/robustness.md, "Continuous deployment")."""
+        if handle.get("quantized") != self._quantized:
+            raise ValueError(
+                "staged handle precision does not match this engine "
+                "(was it staged on a different engine?)")
+        if handle["qparams"] is not None:
+            self._qmodel.set_parameters(handle["qparams"])
+        self.model.set_parameters(handle["params"])
+        if handle.get("mstate") is not None:
+            self.model.set_state(handle["mstate"])
+            if self._qmodel is not None:
+                self._qmodel.set_state(handle["mstate"])
+        self._backend.install(handle["staged"])
+        if version is not None:
+            self.set_serving_version(version, digest)
+        audit = {"model_bytes": handle.get("model_bytes"), "staged": True}
+        if self._quantized:
+            audit["quantized"] = True
+        self._record_refresh("ok", **audit)
+        self._stamp_serving_info()
+        return self
+
+    def eval_staged(self, handle, x, tick=0):
+        """Run the serving eval step on a STAGED handle's weights --
+        the shadow-evaluation path: same compiled executables as live
+        traffic (identical shapes and placement, so zero new compiles
+        for ladder-shaped batches), candidate outputs, nothing
+        committed.  Runs on the caller's thread: keep it off the
+        dispatcher (the shadow observer enqueues; a worker evals)."""
+        y = self._backend.eval(x, tick=tick, weights=handle["staged"])
+        return jax.tree.map(np.asarray, y)
+
+    def set_canary(self, handle, fraction=0.1, version=None):
+        """Route ``fraction`` of ticks onto a staged candidate's
+        weights (error-diffused, so the fraction holds over any
+        window); ``set_canary(None)`` ends the canary.  Stats reset on
+        every call -- ``canary_stats()`` reads the current window."""
+        if handle is not None and not 0.0 < float(fraction) <= 1.0:
+            raise ValueError(
+                f"canary fraction must be in (0, 1], got {fraction}")
+        self._canary_acc = 0.0
+        self._canary_ticks = 0
+        self._canary_rows = 0
+        self._canary_failures = 0
+        self._canary = None if handle is None \
+            else (handle, float(fraction), version)
+        return self
+
+    def canary_stats(self):
+        """``{"ticks", "rows", "failures"}`` of the current canary
+        window (since the last ``set_canary``)."""
+        return {"ticks": self._canary_ticks, "rows": self._canary_rows,
+                "failures": self._canary_failures}
+
+    def set_shadow(self, fn, fraction=1.0):
+        """Mirror ``fraction`` of ticks to ``fn(x_padded, y_live,
+        bucket, n_real, tick)`` AFTER their results are delivered.
+        The observer runs on the dispatcher thread and must only
+        enqueue -- evaluate the candidate elsewhere (``eval_staged``).
+        ``set_shadow(None)`` stops mirroring; observer exceptions are
+        logged and swallowed (shadowing is best-effort, live traffic
+        is not)."""
+        if fn is not None and not 0.0 < float(fraction) <= 1.0:
+            raise ValueError(
+                f"shadow fraction must be in (0, 1], got {fraction}")
+        self._shadow_acc = 0.0
+        self._shadow = None if fn is None else (fn, float(fraction))
+        return self
+
+    def set_serving_version(self, version, digest=None):
+        """Stamp WHICH model version this engine is serving: carried on
+        the telemetry header's ``serving`` block (or a standalone
+        ``serving_info`` event), every ``param_refresh`` audit event,
+        and -- through the metrics bridge -- the
+        ``bigdl_serving_version_info`` gauge, so an operator can always
+        answer "which checkpoint is this replica serving?"."""
+        self._version_info = {"version": int(version),
+                             "digest": None if digest is None
+                             else str(digest)}
+        self._stamp_serving_info()
+        return self
+
+    def _validate_incoming(self, params, mstate):
+        """First structure/shape/dtype mismatch of an incoming weight
+        set against the construction-time serving contract, or None."""
+        reason = _spec_mismatch(self._params_spec, _tree_spec(params),
+                                "params")
+        if reason is None and mstate is not None:
+            reason = _spec_mismatch(self._mstate_spec, _tree_spec(mstate),
+                                    "mstate")
+        return reason
 
     # ----- lifecycle -------------------------------------------------------- #
     def refresh_from_snapshot(self, path):
@@ -817,11 +1100,18 @@ class ServingEngine:
                 and jax.tree.leaves(mstate) else None
 
         if not file_io.isdir(p):                   # pickle snapshot
+            import jax.numpy as jnp
+
             payload = file_io.load(p)
             mp = payload["model_params"]
             if isinstance(mp, dict) and "model_params_flat" in mp:
-                return (mp["model_params_flat"],
-                        clean_state(payload.get("model_state")))
+                mp = mp["model_params_flat"]
+            # uncommitted jnp leaves, exactly like the orbax branch
+            # below: file_io.load hands back raw numpy, which keys the
+            # serving jit cache differently than init-time weights and
+            # would force one spurious recompile per bucket on the
+            # first post-swap ticks
+            mp = jax.tree.map(jnp.asarray, mp)
             return mp, clean_state(payload.get("model_state"))
         import orbax.checkpoint as ocp                  # sharded (orbax)
 
@@ -889,11 +1179,7 @@ class ServingEngine:
                                      telemetry=self.telemetry,
                                      what="serving-refresh")
         if incoming:
-            reason = _spec_mismatch(self._params_spec, _tree_spec(params),
-                                    "params")
-            if reason is None and mstate is not None:
-                reason = _spec_mismatch(self._mstate_spec,
-                                        _tree_spec(mstate), "mstate")
+            reason = self._validate_incoming(params, mstate)
             if reason is not None:
                 self._record_refresh("rejected", reason)
                 raise ValueError(
@@ -973,6 +1259,9 @@ class ServingEngine:
         try:
             fields = {"tick": self._tick, "outcome": outcome,
                       "backend": self._backend.kind, **extra}
+            if self._version_info is not None:
+                fields.setdefault("version", self._version_info["version"])
+                fields.setdefault("digest", self._version_info["digest"])
             if reason is not None:
                 fields["reason"] = str(reason)[:300]
             self.telemetry.record("param_refresh", **fields)

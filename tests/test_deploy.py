@@ -1,0 +1,831 @@
+"""Versioned hot-swap deployment (ISSUE 13): ModelRegistry + the
+engine's staging/canary/shadow seams + RolloutController's
+shadow -> canary -> atomic cutover -> rollback walk, the durable
+``kind: "deploy"`` audit events (metrics bridge + obs_report render),
+and the slow-tier chaos drill / live-loop demo through
+``tools/serve_live.py``."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.observability import StepTelemetry
+from bigdl_tpu.observability.metrics import MetricsRegistry
+from bigdl_tpu.observability.telemetry import DURABLE_KINDS
+from bigdl_tpu.serving import (ModelRegistry, RolloutController,
+                               ServingEngine, snapshot_digest)
+from bigdl_tpu.serving.deploy import (DEPLOY_EVENT_KEYS, ModelVersion,
+                                      parse_deploy_chaos)
+from bigdl_tpu.utils import file_io
+from bigdl_tpu.utils.errors import ConfigurationError
+from bigdl_tpu.utils.random_generator import RNG
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp(seed=0, hidden=16):
+    RNG.set_seed(seed)
+    m = (nn.Sequential().add(nn.Linear(8, hidden)).add(nn.ReLU())
+         .add(nn.Linear(hidden, 4)))
+    m.build(jax.ShapeDtypeStruct((2, 8), jnp.float32))
+    return m
+
+
+def _xs(n=64, seed=0):
+    return np.random.default_rng(seed).standard_normal((n, 8)) \
+        .astype("float32")
+
+
+def _write_snapshot(ckpt_dir, params, tag=4):
+    """A crash-safe, manifest-stamped pickle snapshot in the training
+    checkpoint spelling."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    target = os.path.join(ckpt_dir, f"checkpoint.{tag}.pkl")
+    file_io.atomic_save({"model_params": params, "model_state": None},
+                        target)
+    file_io.write_snapshot_manifest(target)
+    return target
+
+
+def _events(d, kind=None):
+    path = os.path.join(str(d), "telemetry.jsonl")
+    evs = [json.loads(l) for l in open(path)]
+    return evs if kind is None else [e for e in evs if e["kind"] == kind]
+
+
+# --------------------------------------------------------------------------- #
+# Units: chaos spec, digest, registry.
+# --------------------------------------------------------------------------- #
+
+
+class TestDeployUnits:
+    def test_parse_deploy_chaos(self):
+        assert parse_deploy_chaos(None) is None
+        assert parse_deploy_chaos("") is None
+        assert parse_deploy_chaos("kill:cutover:2") == ("kill", "cutover", 2)
+        for bad in ("kill:cutover", "kill:cutover:0", "kill:step:3",
+                    "cutover:1", "kill:cutover:x"):
+            with pytest.raises(ConfigurationError):
+                parse_deploy_chaos(bad)
+
+    def test_snapshot_digest_stable_and_none_without_manifest(self, tmp_path):
+        m = _mlp()
+        p = _write_snapshot(str(tmp_path), m.parameters()[0])
+        d1, d2 = snapshot_digest(p), snapshot_digest(p)
+        assert d1 == d2 and len(d1) == 16
+        bare = os.path.join(str(tmp_path), "checkpoint.9.pkl")
+        file_io.atomic_save({"model_params": m.parameters()[0]}, bare)
+        assert snapshot_digest(bare) is None
+        # different content -> different digest
+        other = _write_snapshot(
+            str(tmp_path / "b"),
+            jax.tree.map(lambda a: a * 2, m.parameters()[0]))
+        assert snapshot_digest(other) != d1
+
+    def test_registry_ids_promote_retention_rollback(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "registry.json"))
+        v1 = reg.register({"h": 1})
+        v2 = reg.register({"h": 2})
+        assert (v1.version, v2.version) == (1, 2)
+        reg.promote(1)
+        reg.promote(2)
+        assert reg.live.version == 2 and reg.previous.version == 1
+        # the previous version RETAINS its staged handle (the rollback
+        # target); promoting a third drops the oldest's
+        v3 = reg.register({"h": 3})
+        reg.promote(3)
+        assert reg.previous.version == 2
+        assert reg.previous.handle == {"h": 2}
+        assert reg.get(1).handle is None and reg.get(1).stage == "retired"
+        now, bad = reg.rollback()
+        assert now.version == 2 and now.stage == "live"
+        assert bad.version == 3 and bad.stage == "rolled_back"
+        assert bad.handle is None
+        with pytest.raises(RuntimeError, match="without a retained"):
+            reg.rollback()               # previous was consumed
+
+    def test_registry_persistence_round_trip(self, tmp_path):
+        path = str(tmp_path / "registry.json")
+        reg = ModelRegistry(path)
+        reg.register({"h": 1}, path="/snap/a", digest="d1")
+        reg.promote(1)
+        reg.register({"h": 2}, path="/snap/b", digest="d2",
+                     layout={"kind": "tp"})
+        reg.promote(2)
+        # a fresh process: identities + pointers survive, handles do not
+        re2 = ModelRegistry(path)
+        assert re2.live.version == 2 and re2.previous.version == 1
+        assert re2.live.digest == "d2" and re2.live.path == "/snap/b"
+        assert re2.live.layout == {"kind": "tp"}
+        assert re2.live.handle is None
+        assert re2.known_digests() == {"d1", "d2"}
+        # no temp litter from the atomic persists
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+    def test_registry_mark_validates_stage(self, tmp_path):
+        reg = ModelRegistry()
+        reg.register(None)
+        with pytest.raises(ValueError, match="unknown version stage"):
+            reg.mark(1, "bogus")
+        with pytest.raises(KeyError):
+            reg.mark(99, "rejected")
+
+    def test_version_manifest_round_trip(self):
+        v = ModelVersion(3, path="/p", digest="d", layout={"kind": "dp"},
+                         stage="live")
+        assert ModelVersion.from_manifest(v.to_manifest()).describe() \
+            == v.describe()
+
+
+# --------------------------------------------------------------------------- #
+# Engine staging seams.
+# --------------------------------------------------------------------------- #
+
+
+class TestEngineStaging:
+    def test_stage_commit_capture_rollback_bit_identical(self):
+        m = _mlp()
+        xs = _xs()
+        with ServingEngine(m, max_batch_size=4, max_wait_ms=1.0) as eng:
+            eng.precompile()
+            y0 = np.asarray(eng.predict_at(xs[0], 4))
+            live = eng.capture_staged()
+            cand = jax.tree.map(lambda a: a * 0.5, m.parameters()[0])
+            h = eng.stage_weights(cand)
+            # staging committed NOTHING
+            np.testing.assert_array_equal(
+                y0, np.asarray(eng.predict_at(xs[0], 4)))
+            yc = eng.eval_staged(h, np.repeat(xs[:1], 4, 0))
+            eng.commit_staged(h, version=2)
+            np.testing.assert_allclose(
+                np.asarray(eng.predict_at(xs[0], 4)),
+                np.asarray(yc)[0], rtol=1e-6)
+            # rollback = committing the RETAINED handle, bit-for-bit
+            eng.commit_staged(live, version=1)
+            np.testing.assert_array_equal(
+                y0, np.asarray(eng.predict_at(xs[0], 4)))
+
+    def test_stage_weights_rejects_before_staging(self):
+        m = _mlp()
+        with ServingEngine(m, max_batch_size=4, max_wait_ms=1.0) as eng:
+            bad = dict(m.parameters()[0])
+            bad["0"] = {"weight": np.zeros((3, 3), np.float32),
+                        "bias": bad["0"]["bias"]}
+            with pytest.raises(ValueError, match="stage_weights rejected"):
+                eng.stage_weights(bad)
+
+    def test_commit_refuses_cross_precision_handle(self):
+        m = _mlp()
+        with ServingEngine(m, max_batch_size=4, max_wait_ms=1.0) as eng:
+            h = eng.capture_staged()
+            h = {**h, "quantized": True}
+            with pytest.raises(ValueError, match="precision"):
+                eng.commit_staged(h)
+
+    def test_staged_numpy_checkpoint_zero_recompiles(self, tmp_path):
+        """The PR 12 lesson applied to staging: a raw-numpy checkpoint
+        tree staged + committed must NOT key the jit cache differently
+        than the init weights (zero new executables)."""
+        m = _mlp()
+        xs = _xs()
+        with ServingEngine(m, max_batch_size=4, max_wait_ms=1.0) as eng:
+            eng.precompile()
+            for b in (1, 2, 4):
+                eng.predict_at(xs[0], b)
+            execs0 = eng._executables()
+            cand = jax.tree.map(lambda a: np.asarray(a) * 1.01,
+                                m.parameters()[0])
+            h = eng.stage_weights(cand)        # numpy tree in
+            eng.eval_staged(h, np.repeat(xs[:1], 4, 0))
+            eng.commit_staged(h, version=2)
+            for b in (1, 2, 4):
+                eng.predict_at(xs[0], b)
+            assert eng._executables() - execs0 == 0
+
+    def test_stateful_rollback_restores_model_state(self):
+        """``capture_staged`` carries the model STATE too: rolling back
+        a stateful model (BatchNorm running stats) must not serve
+        previous params mixed with the rejected candidate's state."""
+        RNG.set_seed(2)
+        m = (nn.Sequential().add(nn.Linear(8, 16))
+             .add(nn.BatchNormalization(16)).add(nn.Linear(16, 4)))
+        m.build(jax.ShapeDtypeStruct((2, 8), jnp.float32))
+        xs = _xs()
+        with ServingEngine(m, max_batch_size=4, max_wait_ms=1.0) as eng:
+            eng.precompile()
+            y0 = np.asarray(eng.predict_at(xs[0], 4))
+            live = eng.capture_staged()
+            assert live["mstate"] is not None
+            # candidate: same params, SHIFTED running stats
+            cand_state = jax.tree.map(lambda a: np.asarray(a) + 1.0,
+                                      m.state())
+            h = eng.stage_weights(m.parameters()[0], mstate=cand_state)
+            eng.commit_staged(h, version=2)
+            assert not np.array_equal(
+                y0, np.asarray(eng.predict_at(xs[0], 4)))
+            eng.commit_staged(live, version=1)      # rollback
+            np.testing.assert_array_equal(
+                y0, np.asarray(eng.predict_at(xs[0], 4)))
+
+    def test_canary_fraction_routes_and_stamps_ticks(self, tmp_path):
+        m = _mlp()
+        xs = _xs()
+        tel = StepTelemetry(str(tmp_path), run_name="serve", trace=False)
+        with ServingEngine(m, max_batch_size=1, max_wait_ms=0.5,
+                           telemetry=tel) as eng:
+            eng.precompile()
+            cand = jax.tree.map(lambda a: a * 0.5, m.parameters()[0])
+            h = eng.stage_weights(cand)
+            eng.set_canary(h, 0.5, version=7)
+            outs = [np.asarray(eng.predict(xs[0])) for _ in range(8)]
+        tel.close()
+        # error diffusion at 0.5: exactly half the ticks rode the
+        # candidate (every second one), and their events say so
+        ticks = _events(tmp_path, "inference")
+        canaried = [e for e in ticks if e.get("canary")]
+        assert len(ticks) == 8
+        assert len(canaried) == 4
+        assert all(e["canary_version"] == 7 for e in canaried)
+        assert eng.canary_stats()["ticks"] == 4
+        # the two weight sets really served: two distinct outputs
+        assert len({o.tobytes() for o in outs}) == 2
+
+    def test_canary_fraction_validated(self):
+        m = _mlp()
+        with ServingEngine(m, max_batch_size=2, max_wait_ms=0.5) as eng:
+            with pytest.raises(ValueError, match="fraction"):
+                eng.set_canary(eng.capture_staged(), 1.5)
+            with pytest.raises(ValueError, match="fraction"):
+                eng.set_shadow(lambda *a: None, 0.0)
+
+    def test_shadow_mirrors_after_results_and_swallow_errors(self):
+        m = _mlp()
+        xs = _xs()
+        seen = []
+        with ServingEngine(m, max_batch_size=4, max_wait_ms=0.5) as eng:
+            eng.precompile()
+
+            def observer(x, y, bucket, n, tick):
+                seen.append((np.asarray(x).shape, n, bucket))
+                raise RuntimeError("observer bug")   # must be swallowed
+
+            eng.set_shadow(observer, 1.0)
+            y = eng.predict(xs[0])          # still served despite the raise
+            assert y is not None
+            eng.set_shadow(None)
+            eng.predict(xs[1])
+        assert len(seen) == 1
+        shape, n, bucket = seen[0]
+        assert n == 1 and shape[0] == bucket   # PADDED batch mirrored
+
+    def test_serving_version_stamps_events_and_metrics(self, tmp_path):
+        m = _mlp()
+        metrics = MetricsRegistry()
+        tel = StepTelemetry(str(tmp_path), run_name="serve", trace=False,
+                            metrics=metrics)
+        with ServingEngine(m, max_batch_size=2, max_wait_ms=0.5,
+                           telemetry=tel) as eng:
+            tel.write_header()
+            eng.set_serving_version(3, "abc123")
+            eng.refresh_params(jax.tree.map(lambda a: a * 1.01,
+                                            m.parameters()[0]))
+        tel.close()
+        infos = _events(tmp_path, "serving_info")
+        assert infos and infos[-1]["serving"]["version"] == 3
+        assert infos[-1]["serving"]["digest"] == "abc123"
+        refreshes = _events(tmp_path, "param_refresh")
+        assert refreshes and refreshes[-1]["version"] == 3
+        rendered = metrics.render()
+        assert 'bigdl_serving_version_info{version="3",digest="abc123"} 1' \
+            in rendered
+
+    def test_version_info_gauge_zeroes_old_versions(self):
+        reg = MetricsRegistry()
+        reg.observe_event({"kind": "serving_info",
+                           "serving": {"version": 1, "digest": "a"}})
+        reg.observe_event({"kind": "serving_info",
+                           "serving": {"version": 2, "digest": "b"}})
+        text = reg.render()
+        assert 'version="1",digest="a"} 0' in text
+        assert 'version="2",digest="b"} 1' in text
+
+
+# --------------------------------------------------------------------------- #
+# The rollout controller.
+# --------------------------------------------------------------------------- #
+
+
+def _serving_stack(tmp_path, model=None, **ctl_kw):
+    model = model or _mlp()
+    metrics = MetricsRegistry()
+    tel = StepTelemetry(str(tmp_path / "serve"), run_name="serve",
+                        trace=False, metrics=metrics)
+    eng = ServingEngine(model, max_batch_size=4, max_wait_ms=1.0,
+                        telemetry=tel)
+    eng.precompile()
+    reg = ModelRegistry(str(tmp_path / "registry.json"))
+    kw = dict(shadow_fraction=1.0, shadow_min_rows=8,
+              min_top1_agreement=0.5, canary_fraction=0.5,
+              canary_min_ticks=3, stage_timeout_s=30.0)
+    kw.update(ctl_kw)
+    ctl = RolloutController(eng, reg, str(tmp_path / "ckpt"),
+                            telemetry=tel, **kw)
+    return model, metrics, tel, eng, reg, ctl
+
+
+def _traffic(eng, xs, stop, stats):
+    i = 0
+    while not stop.is_set():
+        try:
+            eng.predict(xs[i % len(xs)], timeout=10.0)
+            stats["ok"] += 1
+        except Exception:
+            if not stop.is_set():
+                stats["fail"] += 1
+        i += 1
+
+
+class TestRolloutController:
+    def test_full_walk_promotes_then_rejects_poison(self, tmp_path):
+        """The tier-1 core of the chaos drill: under live traffic a
+        healthy candidate walks shadow -> canary -> cutover while a
+        poisoned one is caught in shadow -- zero failed requests, zero
+        steady-state recompiles, the whole trail durable."""
+        model, metrics, tel, eng, reg, ctl = _serving_stack(tmp_path)
+        execs0 = eng._executables()
+        ctl.baseline()
+        xs = _xs()
+        stop, stats = threading.Event(), {"ok": 0, "fail": 0}
+        t = threading.Thread(target=_traffic, args=(eng, xs, stop, stats),
+                             daemon=True)
+        t.start()
+        try:
+            p = model.parameters()[0]
+            healthy = _write_snapshot(
+                str(tmp_path / "ckpt"),
+                jax.tree.map(lambda a: np.asarray(a) * 1.01, p), tag=4)
+            v = ctl.poll_once()
+            assert v.stage == "live" and v.version == 2
+            assert reg.live.version == 2
+            assert reg.previous.version == 1
+            assert reg.previous.handle is not None
+            assert ctl.poll_once() is None       # same digest: seen
+            bad = jax.tree.map(
+                lambda a: -np.asarray(a)
+                + np.random.default_rng(3).standard_normal(a.shape)
+                .astype("float32") * 5, p)
+            _write_snapshot(str(tmp_path / "ckpt"), bad, tag=8)
+            v3 = ctl.poll_once()
+            assert v3.stage == "rejected"
+            assert reg.live.version == 2         # unharmed
+        finally:
+            stop.set()
+            t.join(5)
+            eng.close()
+            tel.close()
+        assert stats["fail"] == 0 and stats["ok"] > 10
+        assert eng._executables() - execs0 == 0
+        stages = [(e["version"], e["stage"], e["verdict"])
+                  for e in _events(tmp_path / "serve", "deploy")]
+        assert (2, "shadow", "ok") in stages
+        assert (2, "canary", "ok") in stages
+        assert (2, "cutover", "ok") in stages
+        assert (2, "live", "ok") in stages
+        assert (3, "shadow", "rejected") in stages
+        assert metrics.counter(
+            "bigdl_deploy_total", labelnames=("stage", "outcome")) \
+            .value(stage="live", outcome="ok") == 2.0
+
+    def test_deploy_event_schema_and_durability(self, tmp_path):
+        assert "deploy" in DURABLE_KINDS
+        model, metrics, tel, eng, reg, ctl = _serving_stack(tmp_path)
+        try:
+            ctl.baseline()
+        finally:
+            eng.close()
+            tel.close()
+        ev = _events(tmp_path / "serve", "deploy")[0]
+        for k in DEPLOY_EVENT_KEYS[:3]:     # reason only when present
+            assert k in ev, k
+
+    def test_canary_health_degradation_rejects(self, tmp_path):
+        """A health source going degraded during canary (an SLO burn,
+        a watchdog anomaly) rejects the candidate."""
+        health = {"status": "ok", "reasons": []}
+        model, metrics, tel, eng, reg, ctl = _serving_stack(
+            tmp_path, health_sources=[lambda: dict(health)])
+        ctl.baseline()
+        xs = _xs()
+        stop, stats = threading.Event(), {"ok": 0, "fail": 0}
+        t = threading.Thread(target=_traffic, args=(eng, xs, stop, stats),
+                             daemon=True)
+        t.start()
+        try:
+            health["status"] = "degraded"
+            health["reasons"] = [{"reason": "slo:latency",
+                                  "status": "degraded"}]
+            _write_snapshot(
+                str(tmp_path / "ckpt"),
+                jax.tree.map(lambda a: np.asarray(a) * 1.01,
+                             model.parameters()[0]))
+            v = ctl.poll_once()
+            assert v.stage == "rejected"
+        finally:
+            stop.set()
+            t.join(5)
+            eng.close()
+            tel.close()
+        canary = [e for e in _events(tmp_path / "serve", "deploy")
+                  if e["stage"] == "canary"]
+        assert canary and canary[0]["verdict"] == "rejected"
+        assert "degraded" in canary[0]["reason"]
+
+    def test_post_cutover_watch_auto_rollback(self, tmp_path):
+        """A burning SLO inside the post-cutover watch window rolls the
+        fleet back to the RETAINED previous version -- pointer swap,
+        bit-for-bit, durable rollback event, rendered by obs_report."""
+        health = {"status": "ok", "reasons": []}
+        clock = {"t": 0.0}
+        model, metrics, tel, eng, reg, ctl = _serving_stack(
+            tmp_path, health_sources=[lambda: dict(health)],
+            post_cutover_watch_s=10.0, clock=lambda: clock["t"])
+        ctl.baseline()
+        xs = _xs()
+        stop, stats = threading.Event(), {"ok": 0, "fail": 0}
+        t = threading.Thread(target=_traffic, args=(eng, xs, stop, stats),
+                             daemon=True)
+        t.start()
+        try:
+            y1 = np.asarray(eng.predict_at(xs[0], 4))
+            _write_snapshot(
+                str(tmp_path / "ckpt"),
+                jax.tree.map(lambda a: np.asarray(a) * 1.01,
+                             model.parameters()[0]))
+            v = ctl.poll_once()
+            assert v.stage == "live"
+            assert ctl.check_watch() is None     # healthy: no rollback
+            health["status"] = "degraded"
+            health["reasons"] = [{"reason": "slo:latency",
+                                  "status": "degraded"}]
+            clock["t"] += 1.0                    # still inside the window
+            back = ctl.check_watch()
+            assert back is not None and back.version == 1
+            assert reg.live.version == 1
+            assert reg.get(v.version).stage == "rolled_back"
+            # bit-for-bit: the retained v1 buffers serve again
+            np.testing.assert_array_equal(
+                y1, np.asarray(eng.predict_at(xs[0], 4)))
+            # outside the window nothing fires even while degraded
+            assert ctl.check_watch() is None
+        finally:
+            stop.set()
+            t.join(5)
+            eng.close()
+            tel.close()
+        assert stats["fail"] == 0
+        deploys = _events(tmp_path / "serve", "deploy")
+        rb = [e for e in deploys if e["stage"] == "rollback"]
+        assert rb and rb[0]["verdict"] == "rolled_back"
+        assert rb[0]["rolled_back_to"] == 1
+        # obs_report renders the trail and the post-rollback live version
+        from tools.obs_report import build_report
+        rep = build_report(str(tmp_path / "serve"))
+        dep = rep["serving"]["deploys"]
+        assert dep["rollbacks"] == 1 and dep["live_version"] == 1
+        assert metrics.counter("bigdl_deploy_rollbacks_total").value() \
+            == 1.0
+
+    def test_rejected_candidate_retries_after_cooldown(self, tmp_path):
+        """A transient rejection (here: a degraded health source during
+        canary) must not blacklist the trainer's newest snapshot
+        forever: after ``reject_cooldown_s`` the same digest is walked
+        again -- and promotes once the transient clears."""
+        health = {"status": "ok", "reasons": []}
+        clock = {"t": 100.0}
+        model, metrics, tel, eng, reg, ctl = _serving_stack(
+            tmp_path, health_sources=[lambda: dict(health)],
+            reject_cooldown_s=60.0, clock=lambda: clock["t"])
+        ctl.baseline()
+        xs = _xs()
+        stop, stats = threading.Event(), {"ok": 0, "fail": 0}
+        t = threading.Thread(target=_traffic, args=(eng, xs, stop, stats),
+                             daemon=True)
+        t.start()
+        try:
+            health["status"] = "degraded"
+            _write_snapshot(
+                str(tmp_path / "ckpt"),
+                jax.tree.map(lambda a: np.asarray(a) * 1.01,
+                             model.parameters()[0]))
+            v = ctl.poll_once()
+            assert v.stage == "rejected"
+            health["status"] = "ok"
+            assert ctl.poll_once() is None          # cooling down
+            clock["t"] += 61.0
+            v2 = ctl.poll_once()                    # retried, fresh id
+            assert v2 is not None and v2.stage == "live"
+            assert v2.version > v.version
+        finally:
+            stop.set()
+            t.join(5)
+            eng.close()
+            tel.close()
+
+    def test_rollback_without_previous_raises(self, tmp_path):
+        model, metrics, tel, eng, reg, ctl = _serving_stack(tmp_path)
+        try:
+            ctl.baseline()
+            with pytest.raises(RuntimeError, match="retained"):
+                ctl.rollback("nope")
+        finally:
+            eng.close()
+            tel.close()
+
+    def test_shadow_timeout_rejects_unverified(self, tmp_path):
+        """No traffic -> no shadow evidence -> the candidate is
+        REJECTED, not promoted on faith."""
+        clock = {"t": 0.0}
+
+        def fake_clock():
+            clock["t"] += 1.0        # each poll of the deadline ages 1s
+            return clock["t"]
+
+        model, metrics, tel, eng, reg, ctl = _serving_stack(
+            tmp_path, stage_timeout_s=5.0, clock=fake_clock,
+            sleep=lambda s: None)
+        try:
+            ctl.baseline()
+            _write_snapshot(
+                str(tmp_path / "ckpt"),
+                jax.tree.map(lambda a: np.asarray(a) * 1.01,
+                             model.parameters()[0]))
+            v = ctl.poll_once()
+            assert v.stage == "rejected"
+        finally:
+            eng.close()
+            tel.close()
+        shadow = [e for e in _events(tmp_path / "serve", "deploy")
+                  if e["stage"] == "shadow"]
+        assert "timed out" in shadow[0]["reason"]
+
+    def test_resume_restages_live_version_bit_for_bit(self, tmp_path):
+        """The restart path: a FRESH engine + controller resumes the
+        persisted registry's live version from its verified snapshot
+        and serves identical logits."""
+        model, metrics, tel, eng, reg, ctl = _serving_stack(tmp_path)
+        xs = _xs()
+        stop, stats = threading.Event(), {"ok": 0, "fail": 0}
+        t = threading.Thread(target=_traffic, args=(eng, xs, stop, stats),
+                             daemon=True)
+        t.start()
+        try:
+            ctl.baseline()
+            _write_snapshot(
+                str(tmp_path / "ckpt"),
+                jax.tree.map(lambda a: np.asarray(a) * 1.01,
+                             model.parameters()[0]))
+            v = ctl.poll_once()
+            assert v.stage == "live"
+            y_live = np.asarray(eng.predict_at(xs[0], 4))
+        finally:
+            stop.set()
+            t.join(5)
+            eng.close()
+            tel.close()
+        # "restart": everything rebuilt from disk state
+        model2 = _mlp()
+        tel2 = StepTelemetry(str(tmp_path / "serve2"), run_name="serve2",
+                             trace=False)
+        eng2 = ServingEngine(model2, max_batch_size=4, max_wait_ms=1.0,
+                             telemetry=tel2)
+        eng2.precompile()
+        reg2 = ModelRegistry(str(tmp_path / "registry.json"))
+        ctl2 = RolloutController(eng2, reg2, str(tmp_path / "ckpt"),
+                                 telemetry=tel2)
+        try:
+            live = ctl2.resume()
+            assert live.version == v.version
+            np.testing.assert_array_equal(
+                y_live, np.asarray(eng2.predict_at(xs[0], 4)))
+            # the already-live snapshot is in the seen set: no re-deploy
+            assert ctl2.poll_once() is None
+        finally:
+            eng2.close()
+            tel2.close()
+        resumes = [e for e in _events(tmp_path / "serve2", "deploy")
+                   if e["stage"] == "resume"]
+        assert resumes and resumes[0]["version"] == v.version
+
+    def test_resume_refuses_digest_imposter(self, tmp_path):
+        model, metrics, tel, eng, reg, ctl = _serving_stack(tmp_path)
+        stop = threading.Event()
+        xs = _xs()
+        stats = {"ok": 0, "fail": 0}
+        t = threading.Thread(target=_traffic, args=(eng, xs, stop, stats),
+                             daemon=True)
+        t.start()
+        try:
+            ctl.baseline()
+            snap = _write_snapshot(
+                str(tmp_path / "ckpt"),
+                jax.tree.map(lambda a: np.asarray(a) * 1.01,
+                             model.parameters()[0]))
+            assert ctl.poll_once().stage == "live"
+        finally:
+            stop.set()
+            t.join(5)
+            eng.close()
+            tel.close()
+        # the snapshot is silently replaced after the registry recorded
+        # its digest: resume must refuse to serve the imposter
+        file_io.atomic_save(
+            {"model_params": jax.tree.map(lambda a: a * 9,
+                                          _mlp().parameters()[0]),
+             "model_state": None}, snap)
+        file_io.write_snapshot_manifest(snap)
+        model2 = _mlp()
+        eng2 = ServingEngine(model2, max_batch_size=4, max_wait_ms=1.0)
+        reg2 = ModelRegistry(str(tmp_path / "registry.json"))
+        ctl2 = RolloutController(eng2, reg2, str(tmp_path / "ckpt"))
+        try:
+            with pytest.raises(RuntimeError, match="imposter"):
+                ctl2.resume()
+        finally:
+            eng2.close()
+
+    def test_quantized_rollback_never_requantizes(self, tmp_path,
+                                                  monkeypatch):
+        """The retained-buffers contract on the int8 engine: rollback
+        commits the RETAINED int8 payload+scales -- quantize_params
+        runs once per staging, never again at commit/rollback time."""
+        import bigdl_tpu.nn.quantized as q
+
+        model = _mlp(hidden=64, seed=6)
+        xs = _xs()
+        calls = {"n": 0}
+        real = q.quantize_params
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(q, "quantize_params", counting)
+        with ServingEngine(model, max_batch_size=4, max_wait_ms=1.0,
+                           quantize=True) as eng:
+            eng.precompile()
+            live = eng.capture_staged()
+            assert live["qparams"] is not None
+            h = eng.stage_weights(
+                jax.tree.map(lambda a: np.asarray(a) * 1.01,
+                             model.parameters()[0]))
+            staged_calls = calls["n"]
+            assert staged_calls >= 1
+            y_live = np.asarray(eng.predict_at(xs[0], 4))
+            eng.commit_staged(h, version=2)
+            eng.commit_staged(live, version=1)      # rollback
+            np.testing.assert_array_equal(
+                y_live, np.asarray(eng.predict_at(xs[0], 4)))
+            assert calls["n"] == staged_calls       # zero re-quantizes
+
+
+# --------------------------------------------------------------------------- #
+# Slow tier: the serve_live chaos drill + live-loop demo.
+# --------------------------------------------------------------------------- #
+
+
+def _serve_live(out, *extra, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run(
+        [sys.executable, "-m", "tools.serve_live", "--out", str(out),
+         "--shadowRows", "8", "--canaryTicks", "3", *extra],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _result(out):
+    with open(os.path.join(str(out), "result.json")) as f:
+        return json.load(f)
+
+
+@pytest.mark.slow
+class TestServeLiveDrills:
+    @pytest.mark.parametrize("workload", ["transformer", "movielens"])
+    def test_live_loop_promotes_healthy_candidates(self, tmp_path,
+                                                   workload):
+        """ISSUE-13 acceptance (live-loop demo): a supervised trainer
+        writes snapshots while the engine serves; the rollout promotes
+        a healthy candidate through shadow -> canary -> full cutover
+        with zero failed requests and zero steady-state recompiles."""
+        r = _serve_live(tmp_path, "--workload", workload, "--steps", "12",
+                        "--ckptEvery", "6")
+        assert r.returncode == 0, r.stderr[-2000:]
+        res = _result(tmp_path)
+        assert res["client"]["failed"] == 0
+        assert res["client"]["ok"] > 100
+        assert res["compiles_after_precompile"] == 0
+        stages = [(d["version"], d["stage"], d["verdict"])
+                  for d in res["deploys"]]
+        live = [v for v, s, ok in stages if s == "live" and ok == "ok"]
+        assert res["live_version"] == max(live)
+        assert res["live_version"] >= 2          # at least one cutover
+        v = res["live_version"]
+        assert (v, "shadow", "ok") in stages
+        assert (v, "canary", "ok") in stages
+        assert (v, "cutover", "ok") in stages
+
+    def test_poisoned_candidate_caught_and_rejected(self, tmp_path):
+        """ISSUE-13 acceptance (chaos drill, leg 1): an
+        outlier-poisoned candidate is caught in shadow, the live
+        version keeps serving bit-for-bit, zero user requests fail,
+        and the verdict is durable + rendered by obs_report."""
+        r = _serve_live(tmp_path, "--steps", "12", "--ckptEvery", "6",
+                        "--poison")
+        assert r.returncode == 0, r.stderr[-2000:]
+        res = _result(tmp_path)
+        assert res["client"]["failed"] == 0
+        assert res["compiles_after_precompile"] == 0
+        rejected = [d for d in res["deploys"]
+                    if d["verdict"] == "rejected"]
+        assert rejected, res["deploys"]
+        assert any(d["stage"] in ("shadow", "canary") for d in rejected)
+        # the poisoned version never went live
+        poisoned_v = rejected[-1]["version"]
+        assert res["live_version"] != poisoned_v
+        # live version unharmed: every live_history probe of the final
+        # version is identical (the engine's weights never tore)
+        hist = [json.loads(l)
+                for l in open(tmp_path / "live_history.jsonl")]
+        final = [h["probe"] for h in hist
+                 if h["version"] == res["live_version"]]
+        assert len(set(final)) == 1
+        # obs_report renders the rejection
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        rep = subprocess.run(
+            [sys.executable, "tools/obs_report.py",
+             os.path.join(str(tmp_path), "serve"), "--format", "json"],
+            env=env, cwd=REPO, capture_output=True, text=True)
+        assert rep.returncode == 0, rep.stderr
+        dep = json.loads(rep.stdout)["serving"]["deploys"]
+        assert dep["rejected"] >= 1
+        assert dep["live_version"] == res["live_version"]
+
+    def test_sigkill_mid_cutover_previous_serves_bit_for_bit(self,
+                                                             tmp_path):
+        """ISSUE-13 acceptance (chaos drill, leg 2): SIGKILL injected
+        mid-cutover (device buffers swapped, registry NOT committed)
+        -- the restarted server resolves the durable registry and
+        serves the last COMMITTED version bit-for-bit, with zero
+        failed requests in the surviving runs."""
+        # phase 1: promote v2 cleanly and record its probe digest
+        r1 = _serve_live(tmp_path, "--steps", "6", "--ckptEvery", "6")
+        assert r1.returncode == 0, r1.stderr[-2000:]
+        res1 = _result(tmp_path)
+        committed = res1["live_version"]
+        assert committed == 2
+        hist = [json.loads(l)
+                for l in open(tmp_path / "live_history.jsonl")]
+        committed_probe = [h["probe"] for h in hist
+                           if h["version"] == committed][-1]
+        # phase 2: new snapshots arrive; the process is SIGKILLed at
+        # the midpoint of its next cutover
+        r2 = _serve_live(tmp_path, "--steps", "12", "--ckptEvery", "12",
+                         "--chaos", "kill:cutover:1")
+        assert r2.returncode == -9, (r2.returncode, r2.stderr[-2000:])
+        assert os.path.exists(tmp_path / "chaos_fired.json")
+        reg_state = json.load(open(tmp_path / "registry.json"))
+        assert reg_state["live"] == committed   # the cutover never landed
+        # the deploy audit trail survived the SIGKILL durably: the
+        # interrupted cutover's fsynced event is on disk in the killed
+        # run's (rotated) serve dir
+        evs = [json.loads(l) for l in
+               open(tmp_path / "serve_r1" / "telemetry.jsonl",
+                    errors="replace") if l.strip()]
+        cut = [e for e in evs if e.get("kind") == "deploy"
+               and e.get("stage") == "cutover"]
+        assert cut, "mid-cutover deploy event lost"
+        # phase 3: restart; must resume the committed version and serve
+        # it bit-for-bit
+        r3 = _serve_live(tmp_path, "--noTrainer", "--idleRounds", "3")
+        assert r3.returncode == 0, r3.stderr[-2000:]
+        res3 = _result(tmp_path)
+        assert res3["resumed"] is True
+        assert res3["client"]["failed"] == 0
+        hist = [json.loads(l)
+                for l in open(tmp_path / "live_history.jsonl")]
+        resumed_probe = [h["probe"] for h in hist
+                         if h["version"] == committed][-1]
+        assert resumed_probe == committed_probe, \
+            "the restarted server does not serve the committed version " \
+            "bit-for-bit"

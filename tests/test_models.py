@@ -120,14 +120,16 @@ class TestVision:
         y = model.forward(jnp.zeros((2, 32, 32, 3)))
         assert y.shape == (2, 10)
 
-    def test_vgg16_param_count(self):
+    @pytest.mark.slow      # ISSUE-13 re-tier (~6s); tier-1 siblings:
+    def test_vgg16_param_count(self):   # vgg_cifar shapes + resnet50 count
         model = Vgg16(class_num=1000)
         model.build(jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32))
         n_params = sum(p.size for p in jax.tree.leaves(model.parameters()[0]))
         # torchvision vgg16: 138.358M
         assert abs(n_params - 138.358e6) / 138.358e6 < 0.01, n_params
 
-    def test_inception_v1_shapes(self):
+    @pytest.mark.slow      # ISSUE-13 re-tier (~16s); tier-1 siblings:
+    def test_inception_v1_shapes(self):   # resnet/vgg shape tests above
         model = InceptionV1NoAuxClassifier(class_num=100)
         y = model.forward(jnp.zeros((1, 224, 224, 3)))
         assert y.shape == (1, 100)

@@ -143,7 +143,9 @@ class TestStepTelemetrySchema:
 
 
 class TestObsReportCLI:
+    @pytest.mark.slow      # ISSUE-13 re-tier (~8s); the tier-1 CLI
     def test_report_merges_jsonl_and_xplane(self, run):
+        # smoke of both report formats lives in test_health.py
         xdir = os.path.join(run["dir"], "xplane")
         os.makedirs(xdir, exist_ok=True)
         shutil.copy(FIXTURE_XPLANE, os.path.join(xdir, "host.xplane.pb"))

@@ -387,7 +387,10 @@ def _fresh_tp(x, y, crit, mesh, seed=21):
 
 
 class TestCrossMeshResume:
+    @pytest.mark.slow      # ISSUE-13 re-tier (~9s); tier-1 siblings:
     def test_tp_degree_change_sharded_resume(self, tmp_path):
+        # TestServingLayoutAware's tp->replicated swap + the facade's
+        # tp resume tests keep the redistribution engine tier-1
         """A tp=4 sharded snapshot resumes on tp=2 (restore under the
         snapshot's OWN layout replicated, then redistribute) and lands
         on the same trajectory as the uninterrupted tp=4 run."""
@@ -710,3 +713,97 @@ class TestRestartStrategyParse:
             parse_restart_strategy("tp:fast")
         with pytest.raises(ConfigurationError, match="restart strategy"):
             parse_restart_strategy("pp:2")
+
+
+# --------------------------------------------------------------------------- #
+# ep expert-count re-cut (ISSUE 13 satellite: ROADMAP item 3's still-open
+# half) -- expert-stacked leading dims re-cut with the router's gate
+# logits plane re-sized to match, A->B->A bit-identical like dp/pp/tp.
+# --------------------------------------------------------------------------- #
+
+
+class TestExpertRecut:
+    def _moe_lm(self, experts=4, seed=0):
+        from bigdl_tpu.nn.moe import MoETransformerLM
+
+        RNG.set_seed(seed)
+        m = MoETransformerLM(32, 16, 2, 2, num_experts=experts, k=2,
+                             max_len=8)
+        m.build(jax.ShapeDtypeStruct((2, 8), jnp.int32))
+        return m
+
+    def test_detect_and_stamp_num_experts(self):
+        from bigdl_tpu.parallel.reshard import detect_num_experts
+
+        m = self._moe_lm(experts=4)
+        assert detect_num_experts(m.parameters()[0]) == 4
+        assert detect_num_experts({"w": np.zeros((2, 2))}) is None
+        spec = LayoutSpec.ep({"expert": 2}, num_experts=4)
+        assert LayoutSpec.from_manifest(spec.to_manifest()) == spec
+        assert spec.plane["num_experts"] == 4
+
+    def test_grow_shrink_bit_identical_params_and_moments(self):
+        """The A->B->A property pin: 4 -> 8 -> 4 experts is
+        bit-identical for params AND mirrored Adam-moment subtrees,
+        with the gate logits plane re-sized both ways."""
+        m = self._moe_lm(experts=4)
+        p = m.parameters()[0]
+        A = LayoutSpec.ep({"expert": 2}, num_experts=4)
+        B = LayoutSpec.ep({"expert": 4}, num_experts=8)
+        grown = redistribute(p, A, B)
+        gb = grown["block0"]["moe"]
+        assert gb["w1"].shape[0] == 8 and gb["gate"].shape[-1] == 8
+        assert gb["b2"].shape[0] == 8
+        # replica groups are consecutive copies of their ancestor
+        np.testing.assert_array_equal(
+            np.asarray(gb["w1"][0]), np.asarray(gb["w1"][1]))
+        np.testing.assert_array_equal(
+            np.asarray(gb["gate"][:, 2]),
+            np.asarray(p["block0"]["moe"]["gate"][:, 1]))
+        _tree_equal(p, redistribute(grown, B, A))
+        moments = {"m": jax.tree.map(lambda a: a * 0.1, p),
+                   "v": jax.tree.map(lambda a: a * 0.2, p)}
+        gm = redistribute(moments, A, B)
+        assert gm["v"]["block1"]["moe"]["w2"].shape[0] == 8
+        _tree_equal(moments, redistribute(gm, B, A))
+
+    def test_shapes_only_conversion_both_directions(self):
+        """``convert_shapes`` (the orbax abstract-tree derivation)
+        covers the expert re-cut in both directions without touching
+        data."""
+        from bigdl_tpu.parallel.reshard import convert_shapes
+
+        m = self._moe_lm(experts=4)
+        p = m.parameters()[0]
+        A = LayoutSpec.ep({"expert": 2}, num_experts=4)
+        B = LayoutSpec.ep({"expert": 4}, num_experts=8)
+        sh = convert_shapes(p, A, B)
+        assert sh["block0"]["moe"]["w1"].shape[0] == 8
+        back = convert_shapes(redistribute(p, A, B), B, A)
+        assert back["block0"]["moe"]["gate"].shape == \
+            tuple(p["block0"]["moe"]["gate"].shape)
+
+    def test_distinct_experts_refuse_merge_and_non_divisible(self):
+        m = self._moe_lm(experts=4)
+        p = m.parameters()[0]
+        with pytest.raises(ValueError, match="genuinely distinct"):
+            redistribute(p, LayoutSpec.ep({}, num_experts=4),
+                         LayoutSpec.ep({}, num_experts=2))
+        with pytest.raises(ValueError, match="divide evenly"):
+            redistribute(p, LayoutSpec.ep({}, num_experts=4),
+                         LayoutSpec.ep({}, num_experts=6))
+
+    def test_grown_model_still_runs_and_layout_stamped(self):
+        """A grown tree loads into a model built at the new expert
+        count (the warm-start re-cut), and the ep facade stamps
+        ``num_experts`` into its layout spec."""
+        m4 = self._moe_lm(experts=4, seed=1)
+        p8 = redistribute(m4.parameters()[0],
+                          LayoutSpec.ep({}, num_experts=4),
+                          LayoutSpec.ep({}, num_experts=8))
+        m8 = self._moe_lm(experts=8, seed=1)
+        m8.set_parameters(p8)
+        x = np.random.default_rng(0).integers(0, 32, (2, 8)).astype("int32")
+        y, st = m8.apply(p8, m8._state, jnp.asarray(x), training=False)
+        assert y.shape == (2, 8, 32)
+        assert np.isfinite(np.asarray(y)).all()

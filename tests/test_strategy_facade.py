@@ -308,7 +308,9 @@ class TestStrategyFacade:
         assert isinstance(opt, DistriOptimizer)
         assert opt.sync_bn and opt.mesh is mesh
 
+    @pytest.mark.slow      # ISSUE-13 re-tier (~7s); tier-1 sibling:
     def test_sharded_checkpoint_resume_bit_exact(self, tmp_path):
+        # the pickle checkpoint_resume_bit_exact stays tier-1
         """Orbax sharded snapshots of the strategy-native (tp-sharded)
         trees: 2 steps straight == 1 step + sharded snap + resume + 1."""
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())

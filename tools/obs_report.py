@@ -255,7 +255,18 @@ def _serving_section(other, header=None):
         if e.get("kind") == "serving_info" and e.get("serving"):
             info = e["serving"]
     if not inf:
-        return None
+        # a deploy-only artifact (rollout loop audited, ticks recorded
+        # elsewhere) still reports: the deploy trail is serving evidence
+        deploy_only = _deploy_block(other)
+        if deploy_only is None:
+            return None
+        sec = {"ticks": 0, "requests": 0, "deploys": deploy_only}
+        if info:
+            for k in ("quantized", "weight_dtype", "backend",
+                      "version", "digest"):
+                if info.get(k) is not None:
+                    sec[k] = info[k]
+        return sec
     requests = sum(int(e.get("records", 0)) for e in inf)
     busy = sum(e.get("wall_s", 0.0) for e in inf)
     sec = {"ticks": len(inf), "requests": requests,
@@ -295,7 +306,8 @@ def _serving_section(other, header=None):
             sec["batch_fill_p50"] = percentile(fills, 50)
     if info:
         for k in ("quantized", "weight_dtype", "model_bytes",
-                  "model_bytes_fp32", "backend", "replicas"):
+                  "model_bytes_fp32", "backend", "replicas",
+                  "version", "digest"):
             if info.get(k) is not None:
                 sec[k] = info[k]
         if info.get("accuracy_gate"):
@@ -313,7 +325,49 @@ def _serving_section(other, header=None):
                    if e.get("outcome") == "rejected" and e.get("reason")]
         if reasons:
             sec["param_refreshes"]["rejection_reasons"] = reasons[-4:]
+    # continuous deployment: the staged-rollout audit trail
+    # (serving/deploy.py, docs/robustness.md "Continuous deployment")
+    dep = _deploy_block(other)
+    if dep is not None:
+        sec["deploys"] = dep
     return sec
+
+
+def _deploy_block(other):
+    """Summarize ``kind: "deploy"`` events, or None without any."""
+    deploys = [e for e in other if e.get("kind") == "deploy"]
+    if not deploys:
+        return None
+    last_live = None
+    for e in deploys:
+        if e.get("stage") in ("live", "resume") \
+                and e.get("verdict") == "ok":
+            last_live = {"version": e.get("version"),
+                         "digest": e.get("digest")}
+        elif e.get("stage") == "rollback" \
+                and e.get("rolled_back_to") is not None:
+            # a rollback makes the RETAINED previous version live again
+            last_live = {"version": e.get("rolled_back_to"),
+                         "digest": None}
+    dep = {
+        "events": len(deploys),
+        "cutovers": sum(1 for e in deploys
+                        if e.get("stage") == "live"
+                        and e.get("verdict") == "ok"),
+        "rejected": sum(1 for e in deploys
+                        if e.get("verdict") == "rejected"),
+        "rollbacks": sum(1 for e in deploys
+                         if e.get("stage") == "rollback"),
+        "trail": [{k: e.get(k) for k in
+                   ("version", "stage", "verdict", "reason",
+                    "digest", "top1_agreement", "rolled_back_to")
+                   if e.get(k) is not None}
+                  for e in deploys[-10:]],
+    }
+    if last_live is not None:
+        dep["live_version"] = last_live.get("version")
+        dep["live_digest"] = last_live.get("digest")
+    return dep
 
 
 def _slo_section(other):
@@ -752,6 +806,29 @@ def format_report(rep):
         if sv.get("requests_per_s") is not None:
             line += f" ({sv['requests_per_s']:.1f} req/s while serving)"
         out.append(line)
+        if sv.get("version") is not None:
+            out.append(
+                f"serving version: v{sv['version']}"
+                + (f" (digest {sv['digest']})" if sv.get("digest")
+                   else ""))
+        dep = sv.get("deploys")
+        if dep:
+            line = (f"deploys: {dep['cutovers']} cutover(s), "
+                    f"{dep['rejected']} rejected, "
+                    f"{dep['rollbacks']} rollback(s)")
+            if dep.get("live_version") is not None:
+                line += f"   live v{dep['live_version']}"
+            out.append(line)
+            for e in dep.get("trail", [])[-6:]:
+                ln = (f"  v{e.get('version')} {e.get('stage')}: "
+                      f"{e.get('verdict')}")
+                if e.get("top1_agreement") is not None:
+                    ln += f" (agreement {e['top1_agreement']:.4f})"
+                if e.get("rolled_back_to") is not None:
+                    ln += f" -> v{e['rolled_back_to']}"
+                if e.get("reason"):
+                    ln += f" -- {str(e['reason'])[:80]}"
+                out.append(ln)
         if sv.get("weight_dtype"):
             line = (f"serving precision: {sv['weight_dtype']}"
                     + (" (quantized)" if sv.get("quantized") else ""))
