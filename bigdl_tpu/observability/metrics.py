@@ -117,21 +117,31 @@ class _Metric:
             child = self._children[key] = self._new_child()
         return child
 
-    def _series_name(self, key, suffix=""):
-        if not self.labelnames:
+    def _series_name(self, key, suffix="", extra=()):
+        """``extra`` label pairs come FIRST: the scoped-view injection
+        (``render_scoped``: one /metrics port, N registries, a
+        ``replica=`` label) without touching the child keys."""
+        pairs = [f'{n}="{_escape_label(v)}"' for n, v in extra]
+        pairs += [f'{n}="{_escape_label(v)}"'
+                  for n, v in zip(self.labelnames, key)]
+        if not pairs:
             return self.name + suffix
-        pairs = ",".join(f'{n}="{_escape_label(v)}"'
-                         for n, v in zip(self.labelnames, key))
-        return f"{self.name}{suffix}{{{pairs}}}"
+        return f"{self.name}{suffix}{{{','.join(pairs)}}}"
+
+    def render_series(self, extra=()):
+        """Just the sample lines (no HELP/TYPE headers) -- what a
+        scoped multi-registry render groups under ONE family header."""
+        with self._lock:
+            return [line for key in sorted(self._children)
+                    for line in self._render_child(
+                        key, self._children[key], extra)]
 
     def render(self):
         lines = []
         if self.help:
             lines.append(f"# HELP {self.name} {self.help}")
         lines.append(f"# TYPE {self.name} {self.type}")
-        with self._lock:
-            for key in sorted(self._children):
-                lines.extend(self._render_child(key, self._children[key]))
+        lines.extend(self.render_series())
         return lines
 
 
@@ -154,8 +164,9 @@ class Counter(_Metric):
         with self._lock:
             return self._child(labels)[0]
 
-    def _render_child(self, key, child):
-        return [f"{self._series_name(key)} {_fmt(child[0])}"]
+    def _render_child(self, key, child, extra=()):
+        return [f"{self._series_name(key, extra=extra)} "
+                f"{_fmt(child[0])}"]
 
 
 class Gauge(_Metric):
@@ -181,8 +192,9 @@ class Gauge(_Metric):
         with self._lock:
             return self._child(labels)[0]
 
-    def _render_child(self, key, child):
-        return [f"{self._series_name(key)} {_fmt(child[0])}"]
+    def _render_child(self, key, child, extra=()):
+        return [f"{self._series_name(key, extra=extra)} "
+                f"{_fmt(child[0])}"]
 
 
 class Histogram(_Metric):
@@ -241,23 +253,25 @@ class Histogram(_Metric):
             samples = sorted(self._child(labels)["reservoir"])
         return percentile(samples, q)
 
-    def _bucket_series(self, key, le):
+    def _bucket_series(self, key, le, extra=()):
         # the le label joins the child's own labels in one brace set
-        pairs = [f'{n}="{_escape_label(v)}"'
-                 for n, v in zip(self.labelnames, key)]
+        pairs = [f'{n}="{_escape_label(v)}"' for n, v in extra]
+        pairs += [f'{n}="{_escape_label(v)}"'
+                  for n, v in zip(self.labelnames, key)]
         pairs.append(f'le="{le}"')
         return f"{self.name}_bucket{{{','.join(pairs)}}}"
 
-    def _render_child(self, key, child):
+    def _render_child(self, key, child, extra=()):
         lines, cum = [], 0
         for b, n in zip(self.buckets, child["counts"]):
             cum += n
-            lines.append(f"{self._bucket_series(key, _fmt(b))} {cum}")
+            lines.append(
+                f"{self._bucket_series(key, _fmt(b), extra)} {cum}")
         cum += child["counts"][-1]
-        lines.append(f"{self._bucket_series(key, '+Inf')} {cum}")
-        lines.append(f"{self._series_name(key, '_sum')} "
+        lines.append(f"{self._bucket_series(key, '+Inf', extra)} {cum}")
+        lines.append(f"{self._series_name(key, '_sum', extra)} "
                      f"{_fmt(child['sum'])}")
-        lines.append(f"{self._series_name(key, '_count')} "
+        lines.append(f"{self._series_name(key, '_count', extra)} "
                      f"{child['count']}")
         return lines
 
@@ -393,6 +407,8 @@ class MetricsRegistry:
             self._observe_serving_info(event.get("serving") or {})
         elif kind == "deploy":
             self._observe_deploy(event)
+        elif kind == "fleet":
+            self._observe_fleet(event)
         elif kind == "step":
             self._observe_step(event)
         elif kind == "inference":
@@ -539,6 +555,47 @@ class MetricsRegistry:
             self.counter(f"{self.prefix}_deploy_rollbacks_total",
                          "automatic/operator rollbacks").inc()
 
+    # -- fleet tier ------------------------------------------------------------ #
+    def _observe_fleet(self, event):
+        """Replica lifecycle + breaker edges + supervisor restarts
+        (serving/fleet.py).  The request-path counters
+        (requests/retries/hedges/sheds) are updated DIRECTLY by the
+        fleet -- they are not telemetry events -- so the bridge only
+        owns the durable-event-backed series; neither side double
+        counts."""
+        p = self.prefix
+        what = event.get("event")
+        rid = str(event.get("replica", "?"))
+        if what == "breaker":
+            self.counter(f"{p}_fleet_breaker_transitions_total",
+                         "circuit-breaker state edges, by replica and "
+                         "target state",
+                         labelnames=("replica", "to")) \
+                .inc(replica=rid, to=str(event.get("to", "?")))
+        elif what == "state":
+            g = self.gauge(f"{p}_fleet_replica_state",
+                           "1 on each replica's current lifecycle "
+                           "state", labelnames=("replica", "state"))
+            # one-hot per replica, zeroed + set under ONE lock like the
+            # serving version-info gauge: a scrape never sees two
+            # states (or none) active for a replica
+            with g._lock:
+                for key, child in g._children.items():
+                    if key[0] == rid:
+                        child[0] = 0.0
+                g._child({"replica": rid,
+                          "state": str(event.get("state", "?"))})[0] = 1.0
+            if event.get("state") == "dead":
+                self.counter(f"{p}_fleet_replica_deaths_total",
+                             "replica processes observed dead, by "
+                             "replica", labelnames=("replica",)) \
+                    .inc(replica=rid)
+        elif what == "restart":
+            self.counter(f"{p}_fleet_restarts_total",
+                         "supervisor restarts of dead replicas, by "
+                         "replica", labelnames=("replica",)) \
+                .inc(replica=rid)
+
     # -- health / anomalies --------------------------------------------------- #
     def _observe_health(self, event):
         p = self.prefix
@@ -618,6 +675,46 @@ class MetricsRegistry:
         self.set_health(f"slo:{obj}", status)
 
 
+def render_scoped(registries, label="replica"):
+    """N registries on ONE Prometheus page: every series from
+    ``registries[scope]`` gets ``label="scope"`` injected, and families
+    sharing a metric name across registries merge under one HELP/TYPE
+    header (the text format requires each family to appear once).
+
+    This is how N serving replicas in one process share one /metrics
+    port with a ``replica=`` label instead of N ports
+    (docs/observability.md, "Live metrics & SLOs").  A name registered
+    with a different TYPE in two registries cannot merge -- the later
+    one is skipped with a warning rather than emitting an invalid
+    page."""
+    families = {}
+    for scope in sorted(registries, key=str):
+        reg = registries[scope]
+        with reg._lock:
+            metrics = sorted(reg._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            fam = families.get(m.name)
+            if fam is None:
+                fam = families[m.name] = {"type": m.type, "help": m.help,
+                                          "members": []}
+            elif fam["type"] != m.type:
+                log.warning(
+                    "scoped render: metric %s is a %s in scope %r but "
+                    "a %s elsewhere; skipping the conflicting series",
+                    m.name, m.type, scope, fam["type"])
+                continue
+            fam["members"].append((scope, m))
+    lines = []
+    for name in sorted(families):
+        fam = families[name]
+        if fam["help"]:
+            lines.append(f"# HELP {name} {fam['help']}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for scope, m in fam["members"]:
+            lines.extend(m.render_series(extra=((label, str(scope)),)))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 # --------------------------------------------------------------------------- #
 # The exporter: /metrics + /healthz over a real socket.
 # --------------------------------------------------------------------------- #
@@ -640,13 +737,24 @@ class MetricsExporter:
     are handled on the server thread(s), read the registry under its
     own locks, and any handler error answers 500 instead of raising
     into the serving/training process.
+
+    ``registry`` may instead be a DICT of label-scoped registries
+    (``{"0": reg0, "1": reg1}``): one port serves all of them with a
+    ``scope_label`` (default ``replica``) injected into every series
+    (``render_scoped``), and ``/healthz`` aggregates worst-of across
+    the scopes (ok < degraded < halted) with each reason prefixed by
+    its scope -- N replicas in one process, one scrape endpoint.
+    ``add_registry`` grows the scoped view live.
     """
 
     def __init__(self, registry, port=0, host="127.0.0.1",
-                 health_sources=()):
+                 health_sources=(), scope_label="replica"):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.registry = registry
+        self.scope_label = str(scope_label)
+        self._scoped = isinstance(registry, dict)
+        self.registries = dict(registry) if self._scoped else None
         self.health_sources = list(health_sources)
         self._t0 = time.time()
         exporter = self
@@ -659,7 +767,7 @@ class MetricsExporter:
                 try:
                     path = self.path.split("?", 1)[0]
                     if path == "/metrics":
-                        body = exporter.registry.render().encode()
+                        body = exporter.render().encode()
                         self.send_response(200)
                         self.send_header(
                             "Content-Type",
@@ -706,9 +814,45 @@ class MetricsExporter:
         self.health_sources.append(fn)
         return self
 
+    def add_registry(self, scope, registry):
+        """Grow a SCOPED exporter live (a replica restarted with a
+        fresh registry, a late-joining replica)."""
+        if not self._scoped:
+            raise ValueError(
+                "add_registry needs a scoped exporter (construct with "
+                "a dict of registries)")
+        # copy-on-write: server threads iterate self.registries in
+        # render_scoped/_aggregate_health without a lock -- an in-place
+        # insert would race them into "dict changed size during
+        # iteration" (a failed scrape exactly when topology changes)
+        self.registries = {**self.registries, str(scope): registry}
+        return self
+
+    def render(self):
+        if self._scoped:
+            return render_scoped(self.registries, self.scope_label)
+        return self.registry.render()
+
+    def _aggregate_health(self):
+        """Worst-of across the (possibly scoped) registries."""
+        if not self._scoped:
+            agg = self.registry.health()
+            return agg["status"], list(agg["reasons"])
+        status, reasons = "ok", []
+        for scope in sorted(self.registries, key=str):
+            agg = self.registries[scope].health()
+            s = agg["status"]
+            if HEALTH_STATUSES.index(s) > HEALTH_STATUSES.index(status):
+                status = s
+            for r in agg["reasons"]:
+                reasons.append(
+                    {"reason": f"{self.scope_label}={scope}: "
+                               f"{r['reason']}",
+                     "status": r["status"]})
+        return status, reasons
+
     def healthz(self):
-        agg = self.registry.health()
-        status, reasons = agg["status"], list(agg["reasons"])
+        status, reasons = self._aggregate_health()
         for src in self.health_sources:
             try:
                 extra = src()

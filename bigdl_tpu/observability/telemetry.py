@@ -52,9 +52,13 @@ SCHEMA_VERSION = 1
 #: event is the audit trail of a cross-layout restore whose run may
 #: die before its first step; a deploy event is the stage/rollback
 #: verdict of a live version swap -- the line the chaos drill audits
-#: after SIGKILLing the server mid-cutover)
+#: after SIGKILLing the server mid-cutover; a fleet event is a replica
+#: lifecycle/breaker edge whose process may be SIGKILLed the next
+#: instant -- the breaker open->half_open->closed trail the fleet
+#: drill audits post-mortem)
 DURABLE_KINDS = frozenset({"health", "anomaly", "timing_audit",
-                           "recovery", "slo", "reshard", "deploy"})
+                           "recovery", "slo", "reshard", "deploy",
+                           "fleet"})
 
 log = logging.getLogger("bigdl_tpu.observability")
 

@@ -93,6 +93,28 @@ def parse_restart_strategy(spec):
         "dp device-count changes use --restartDevices)")
 
 
+def capped_backoff(restarts, base_s, max_s, jitter=0.0, rng=None):
+    """``min(max_s, base_s * 2**restarts)``, optionally jittered by a
+    uniform factor in ``[1 - jitter, 1 + jitter]``.
+
+    The jitter is applied AFTER the cap on purpose: N replicas killed
+    by one event (a host reboot, a preemption sweep) otherwise restart
+    in lockstep at exactly the capped backoff -- a thundering herd
+    hitting the same checkpoint dir / registry file on every retry
+    round.  ``rng`` is injectable (``random.Random(seed)``) so drills
+    and tests are deterministic; None uses the module-level
+    ``random``."""
+    if not 0.0 <= float(jitter) <= 1.0:
+        raise ConfigurationError(
+            f"backoff jitter must be a fraction in [0, 1], got {jitter}")
+    b = min(float(max_s), float(base_s) * (2 ** max(0, int(restarts))))
+    if jitter:
+        import random as _random
+        r = (rng or _random).random()
+        b *= 1.0 + float(jitter) * (2.0 * r - 1.0)
+    return b
+
+
 class ChaosKillTrigger(Trigger):
     """Deterministic fault injection: SIGKILL this process the moment
     step ``kill_after_step`` COMPLETES (counters updated, the step's
@@ -143,7 +165,10 @@ class RunSupervisor:
 
     Each restart emits a durable ``kind: "recovery"`` telemetry event
     (cause, snapshot used, steps replayed, backoff) and sleeps
-    ``min(backoff_max_s, backoff_base_s * 2**restarts)``.  The budget is
+    ``min(backoff_max_s, backoff_base_s * 2**restarts)``, optionally
+    de-synchronized by ``jitter`` (a uniform ``[1-j, 1+j]`` factor,
+    ``rng`` injectable -- see ``capped_backoff`` for why a fleet needs
+    this).  The budget is
     ``max_restarts``; additionally, two CONSECUTIVE failures with the
     identical (cause, step) signature stop the loop early -- that is a
     deterministic replay (e.g. a numerics blow-up the watchdogs halted),
@@ -154,13 +179,18 @@ class RunSupervisor:
 
     def __init__(self, max_restarts=3, backoff_base_s=0.5,
                  backoff_max_s=30.0, telemetry=None, stop_on_repeat=True,
-                 sleep=time.sleep):
+                 sleep=time.sleep, jitter=0.0, rng=None):
         if int(max_restarts) < 0:
             raise ConfigurationError(
                 f"max_restarts must be >= 0, got {max_restarts}")
+        if not 0.0 <= float(jitter) <= 1.0:
+            raise ConfigurationError(
+                f"jitter must be a fraction in [0, 1], got {jitter}")
         self.max_restarts = int(max_restarts)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
+        self.jitter = float(jitter)
+        self.rng = rng                # injectable (random.Random(seed))
         self.telemetry = telemetry
         self.stop_on_repeat = bool(stop_on_repeat)
         self._sleep = sleep
@@ -168,8 +198,12 @@ class RunSupervisor:
         self.events = []              # recovery events emitted this run
 
     def backoff_s(self, restarts):
-        return min(self.backoff_max_s,
-                   self.backoff_base_s * (2 ** max(0, int(restarts))))
+        """Capped exponential backoff, de-synchronized by ``jitter``
+        (``capped_backoff``): a fleet of supervisors restarted by one
+        event must not hammer the shared checkpoint dir in lockstep."""
+        return capped_backoff(restarts, self.backoff_base_s,
+                              self.backoff_max_s, jitter=self.jitter,
+                              rng=self.rng)
 
     # ----- event plumbing --------------------------------------------------- #
     def _emit(self, cause, error, at_step, snapshot, backoff_s):

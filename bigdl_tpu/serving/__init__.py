@@ -18,18 +18,36 @@ reference's ``optim/PredictionService.scala`` instance pool).
   the train->serve loop closed: versioned hot-swap with shadow/canary
   staged exposure, atomic cutover, automatic rollback to the retained
   previous version, durable ``kind: "deploy"`` audit events.
+- ``ServingFleet`` (``serving/fleet.py``) -- N replicas (in-process
+  engines and/or ``serving/worker.py`` subprocess workers) behind
+  health-aware least-loaded routing with per-replica circuit breakers,
+  deadline-budgeted retries, tail-latency hedging and load shedding;
+  ``FleetSupervisor`` restarts dead workers from the registry's
+  committed version, and the ``RolloutController`` performs ROLLING
+  deploys across a fleet (drain -> gate -> commit -> undrain, one
+  replica at a time).
 
 See docs/performance.md ("Inference serving", "Int8 inference"),
-docs/robustness.md ("Continuous deployment") and docs/observability.md
-(extended ``kind: "inference"`` event schema, serving-precision +
-version header stamp, the ``deploy`` event schema).
+docs/robustness.md ("Continuous deployment", "Serving fleets") and
+docs/observability.md (extended ``kind: "inference"`` event schema,
+serving-precision + version header stamp, the ``deploy``/``fleet``
+event schemas).
 """
 
 from bigdl_tpu.serving.buckets import BucketLadder
 from bigdl_tpu.serving.deploy import (ModelRegistry, ModelVersion,
                                       RolloutController, snapshot_digest)
-from bigdl_tpu.serving.engine import ServeFuture, ServingEngine
+from bigdl_tpu.serving.engine import (EngineDraining, ServeFuture,
+                                      ServingEngine)
+from bigdl_tpu.serving.fleet import (CircuitBreaker, FleetOverloadedError,
+                                     FleetSupervisor,
+                                     FleetUnavailableError,
+                                     InProcessReplica, ServingFleet,
+                                     SubprocessReplica)
 
-__all__ = ["BucketLadder", "ModelRegistry", "ModelVersion",
-           "RolloutController", "ServeFuture", "ServingEngine",
+__all__ = ["BucketLadder", "CircuitBreaker", "EngineDraining",
+           "FleetOverloadedError", "FleetSupervisor",
+           "FleetUnavailableError", "InProcessReplica", "ModelRegistry",
+           "ModelVersion", "RolloutController", "ServeFuture",
+           "ServingEngine", "ServingFleet", "SubprocessReplica",
            "snapshot_digest"]

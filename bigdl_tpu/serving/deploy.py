@@ -93,6 +93,31 @@ def parse_deploy_chaos(spec):
         "(SIGKILL the serving process mid-way through its n-th cutover)")
 
 
+def parse_fleet_chaos(spec):
+    """``--chaos kill:replica:<i>@<tick>`` -> ``("kill", i, tick)``;
+    None passes through.  The fleet drill's fault injection
+    (``tools/serve_fleet.py``): SIGKILL replica ``i``'s worker process
+    once the closed-loop clients have completed ``tick`` requests --
+    the retries must absorb it, the breaker must open, and the
+    supervisor must bring the replica back on the committed version.
+    A typo'd spec is a configuration error, not a silently-skipped
+    drill."""
+    if spec in (None, ""):
+        return None
+    from bigdl_tpu.utils.errors import ConfigurationError
+
+    parts = str(spec).split(":")
+    if len(parts) == 3 and parts[0] == "kill" and parts[1] == "replica":
+        tail = parts[2].split("@")
+        if len(tail) == 2 and tail[0].isdigit() and tail[1].isdigit() \
+                and int(tail[1]) >= 1:
+            return ("kill", int(tail[0]), int(tail[1]))
+    raise ConfigurationError(
+        f"unknown fleet chaos spec {spec!r}; expected "
+        "kill:replica:<i>@<tick> (SIGKILL replica i's worker once the "
+        "clients have completed <tick> requests)")
+
+
 def snapshot_digest(path):
     """A short stable digest of a snapshot's sidecar manifest (the
     per-file sha256 map), or None for a manifest-less legacy snapshot.
@@ -398,11 +423,22 @@ class RolloutController:
                  canary_fraction=0.25, canary_min_ticks=4,
                  accuracy_gate=None, health_sources=(),
                  stage_timeout_s=60.0, post_cutover_watch_s=0.0,
-                 reject_cooldown_s=300.0,
+                 reject_cooldown_s=300.0, drain_timeout_s=10.0,
+                 replica_gate=None,
                  clock=time.monotonic, sleep=time.sleep, chaos=None):
         from bigdl_tpu.optim.validation import AccuracyDeltaGate
 
         self.engine = engine
+        # fleet mode (serving/fleet.py): shadow/canary run on the
+        # fleet's exposure replica, and the cutover becomes a ROLLING
+        # deploy -- drain one replica, per-replica gate, commit,
+        # undrain, proceed -- so the fleet never has zero serving
+        # capacity and a failing gate rolls back only the replicas
+        # already touched.  ``replica_gate(rid, fleet, handle) ->
+        # (ok, reason)`` overrides the fleet's default probe gate.
+        self._fleet = bool(getattr(engine, "is_fleet", False))
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.replica_gate = replica_gate
         self.registry = registry
         self.checkpoint_dir = checkpoint_dir
         self.telemetry = telemetry
@@ -499,8 +535,9 @@ class RolloutController:
                 f"snapshot {live.path} no longer matches registry live "
                 f"version v{live.version} (digest {digest} != "
                 f"{live.digest}); refusing to serve an imposter")
-        live.handle = self.engine.stage_weights(params, mstate,
-                                                src_layout=src)
+        live.handle = self.engine.stage_weights(
+            params, mstate, src_layout=src,
+            **({"path": live.path} if self._fleet else {}))
         self.engine.commit_staged(live.handle, version=live.version,
                                   digest=live.digest)
         self._emit(live, "resume", "ok")
@@ -588,8 +625,9 @@ class RolloutController:
             digest = snapshot_digest(path)
         try:
             params, mstate, src = self._load(path)
-            handle = self.engine.stage_weights(params, mstate,
-                                               src_layout=src)
+            handle = self.engine.stage_weights(
+                params, mstate, src_layout=src,
+                **({"path": path} if self._fleet else {}))
         except Exception as e:
             v = self.registry.register(
                 None, path=path, digest=digest)
@@ -606,17 +644,24 @@ class RolloutController:
         self._emit(v, "shadow", "ok" if ok else "rejected",
                    reason=reason, **stats)
         if not ok:
-            self.registry.mark(v.version, "rejected")
+            self._reject(v, handle)
             return v
 
         ok, stats, reason = self._run_canary(v, handle)
         self._emit(v, "canary", "ok" if ok else "rejected",
                    reason=reason, **stats)
         if not ok:
-            self.registry.mark(v.version, "rejected")
+            self._reject(v, handle)
             return v
 
         return self._cutover(v, handle)
+
+    def _reject(self, v, handle):
+        if self._fleet:
+            # drop the candidate's staged buffers fleet-wide (the
+            # subprocess workers' token stores are bounded, not infinite)
+            self.engine.release_staged(handle)
+        self.registry.mark(v.version, "rejected")
 
     def _run_shadow(self, v, handle):
         """Mirror live traffic to the candidate off the request path;
@@ -749,7 +794,10 @@ class RolloutController:
         """The atomic promotion: deploy event -> ONE pointer swap on
         the engine -> chaos hook (the drill dies HERE: buffers swapped,
         registry not yet committed -- a restart must still resolve the
-        previous version) -> durable registry commit -> live event."""
+        previous version) -> durable registry commit -> live event.
+        On a fleet this becomes the ROLLING deploy instead."""
+        if self._fleet:
+            return self._rolling_cutover(v, handle)
         self._emit(v, "cutover", "ok")
         self.engine.commit_staged(handle, version=v.version,
                                   digest=v.digest)
@@ -757,6 +805,195 @@ class RolloutController:
             self.chaos("cutover", v)
         self.registry.promote(v.version)
         self._emit(v, "live", "ok")
+        if self.post_cutover_watch_s > 0:
+            self._watch_until = self.clock() + self.post_cutover_watch_s
+        return v
+
+    def _replica_gate(self, rid, handle):
+        if self.replica_gate is not None:
+            return self.replica_gate(rid, self.engine, handle)
+        return self.engine.gate_replica(rid, handle)
+
+    def _rolling_cutover(self, v, handle):
+        """Fleet mode's cutover: replica-by-replica drain -> gate ->
+        commit -> undrain, so the fleet never has zero serving capacity
+        and the UNTOUCHED replicas keep serving the old version
+        mid-roll.  A failing per-replica gate rolls back ONLY the
+        replicas already cut over (pointer swaps to the pre-roll
+        capture) and rejects the candidate; a replica that died
+        mid-roll is skipped (the supervisor restarts it from the
+        registry, which will then name the promoted version).
+
+        The chaos hook fires after each per-replica commit with the
+        registry still uncommitted -- the fleet drill's sharpest
+        point."""
+        fleet = self.engine
+        live = self.registry.live
+        prev = fleet.capture_staged()
+        prev_per = prev.get("per_replica") or {}
+        per = handle.get("per_replica") or {}
+        touched = []
+
+        def roll_back(reason):
+            for rid in reversed(touched):
+                try:
+                    prev_h = prev_per.get(rid)
+                    if prev_h is not None:
+                        fleet.commit_replica(
+                            rid, prev_h,
+                            version=live.version if live else None,
+                            digest=live.digest if live else None)
+                    elif live is not None and live.path is not None:
+                        # no pre-roll capture (the replica restarted
+                        # mid-roll and was caught up onto the now-
+                        # rejected candidate): restore from the live
+                        # version's snapshot instead of stranding it
+                        rep = fleet._by_id(rid)
+                        fresh = rep.stage(path=live.path)
+                        rep.commit(fresh, version=live.version,
+                                   digest=live.digest)
+                    else:
+                        log.warning(
+                            "rollback: no pre-roll capture for replica "
+                            "%s and the live version has no snapshot; "
+                            "its next restart reconciles it", rid)
+                except Exception:
+                    log.exception("rolling rollback of replica %s "
+                                  "failed", rid)
+            fleet.release_staged(handle)
+            self.registry.mark(v.version, "rejected")
+            self._emit(v, "rollback", "rolled_back", reason=reason,
+                       rolled_back_to=live.version if live else None,
+                       replicas=list(touched))
+
+        for rid in sorted(per):
+            rep = fleet._by_id(rid)
+            if rep.state in ("dead", "closed"):
+                # it missed the roll; boot-from-registry catches it up
+                self._emit(v, "cutover", "ok", replica=rid,
+                           reason="replica dead mid-roll; will boot "
+                                  "from the registry's committed "
+                                  "version")
+                continue
+            try:
+                drained = fleet.drain_replica(
+                    rid, timeout=self.drain_timeout_s)
+                ok, reason = self._replica_gate(rid, handle)
+            except Exception as e:
+                ok, drained, reason = False, False, f"replica gate " \
+                    f"raised: {e}"
+            if not ok:
+                # a replica that DIED here (vs. one whose gate judged
+                # the candidate bad) is not the candidate's fault --
+                # skip it like the commit path does, don't reject the
+                # rollout fleet-wide
+                alive = True
+                try:
+                    alive = rep.alive()
+                except Exception:
+                    alive = False
+                if not alive:
+                    fleet.mark_dead(rep,
+                                    reason=f"died mid-drain/gate: "
+                                           f"{reason}")
+                    self._emit(v, "cutover", "ok", replica=rid,
+                               reason="replica died mid-drain/gate; "
+                                      "will boot from the registry")
+                    continue
+                try:
+                    fleet.undrain_replica(rid)
+                except Exception:
+                    log.exception("undrain of replica %s failed", rid)
+                self._emit(v, "cutover", "rejected", replica=rid,
+                           reason=f"per-replica gate: {reason}")
+                roll_back(f"per-replica gate failed on replica {rid} "
+                          f"({reason}); {len(touched)} touched "
+                          f"replica(s) rolled back, the rest never "
+                          f"left the old version")
+                return v
+            try:
+                fleet.commit_replica(rid, per[rid], version=v.version,
+                                     digest=v.digest)
+            except Exception as e:
+                if not rep.alive():
+                    # the process died under us: not the candidate's
+                    # fault -- skip it, keep rolling
+                    fleet.mark_dead(rep, reason=f"died mid-cutover: {e}")
+                    self._emit(v, "cutover", "ok", replica=rid,
+                               reason="replica died mid-commit; will "
+                                      "boot from the registry")
+                    continue
+                # a worker RESTARTED between staging and this commit
+                # lost its staged token: catch it up from the snapshot
+                # path (one extra stage, off the request path) before
+                # giving up on the whole candidate
+                caught_up = False
+                if v.path is not None:
+                    try:
+                        fresh = rep.stage(path=v.path)
+                        fleet.commit_replica(rid, fresh,
+                                             version=v.version,
+                                             digest=v.digest)
+                        per[rid] = fresh
+                        caught_up = True
+                    except Exception:
+                        log.exception("catch-up re-stage of replica %s "
+                                      "failed", rid)
+                if not caught_up:
+                    try:
+                        fleet.undrain_replica(rid)
+                    except Exception:
+                        pass
+                    self._emit(v, "cutover", "rejected", replica=rid,
+                               reason=f"commit failed: {e}")
+                    roll_back(f"commit failed on replica {rid} ({e})")
+                    return v
+            if self.chaos is not None:
+                self.chaos("cutover", v)
+            try:
+                fleet.undrain_replica(rid)
+            except Exception as e:
+                # died between commit and undrain: the commit landed --
+                # mark dead and keep rolling (a restart boots from the
+                # registry, the post-promote reconcile catches an early
+                # rebirth)
+                log.exception("undrain of replica %s failed", rid)
+                if not rep.alive():
+                    fleet.mark_dead(rep,
+                                    reason=f"died mid-undrain: {e}")
+            self._emit(v, "cutover", "ok", replica=rid,
+                       drained=drained)
+            touched.append(rid)
+        if not touched:
+            self.registry.mark(v.version, "rejected")
+            self._emit(v, "cutover", "rejected",
+                       reason="no live replica accepted the candidate")
+            return v
+        self.registry.promote(v.version)
+        # reconcile replicas that missed the roll: one that died
+        # mid-roll and was RESTARTED by the supervisor before this
+        # promote landed booted the registry's OLD version and would
+        # silently serve it forever -- catch any such stragglers up
+        # from the promoted snapshot (idempotent on a replica that
+        # already booted the new version)
+        if v.path is not None:
+            for rid in fleet.replica_ids():
+                rep = fleet._by_id(rid)
+                if rid in touched or rep.state != "serving":
+                    continue
+                try:
+                    fresh = rep.stage(path=v.path)
+                    rep.commit(fresh, version=v.version,
+                               digest=v.digest)
+                    self._emit(v, "cutover", "ok", replica=rid,
+                               reason="post-promote catch-up (replica "
+                                      "missed the roll)")
+                    touched.append(rid)
+                except Exception:
+                    log.exception("post-promote catch-up of replica %s "
+                                  "failed (its next restart boots the "
+                                  "promoted version)", rid)
+        self._emit(v, "live", "ok", replicas=touched)
         if self.post_cutover_watch_s > 0:
             self._watch_until = self.clock() + self.post_cutover_watch_s
         return v

@@ -51,6 +51,15 @@ from bigdl_tpu.serving.buckets import (BucketLadder, ladder_or_default,
 log = logging.getLogger("bigdl_tpu.serving")
 
 
+class EngineDraining(RuntimeError):
+    """``submit()`` refused because the engine is draining: it stopped
+    ADMITTING requests (``drain()``) while the dispatcher finishes the
+    queue it already accepted.  The typed error lets a fleet router
+    distinguish "this replica is mid-deploy, pick another" from a real
+    serving failure -- a drained replica is healthy, just closed for
+    business until ``undrain()``."""
+
+
 class ServeFuture(Future):
     """Per-request handle: ``result(timeout)`` plus, once served, the
     ``bucket`` the request rode in and its end-to-end ``latency_s``."""
@@ -402,6 +411,15 @@ class ServingEngine:
         self._not_full = threading.Condition(self._lock)
         self._running = True
         self._tick = 0
+        self._served = 0
+        # drain seam (serving/fleet.py rolling deploys): _draining stops
+        # ADMISSION only; the dispatcher keeps ticking until every
+        # already-accepted future is resolved.  _in_tick counts requests
+        # claimed off the queue but not yet resolved, so drain() can
+        # wait for true quiescence (queue empty AND no tick in flight).
+        self._draining = False
+        self._in_tick = 0
+        self._idle = threading.Condition(self._lock)
         self._gate_detail = None
         # staged-exposure seams (serving/deploy.py): a canary routes a
         # traffic fraction's ticks onto a staged candidate's weights; a
@@ -450,7 +468,12 @@ class ServingEngine:
         with self._lock:
             if not self._running:
                 raise RuntimeError("ServingEngine is closed")
-            while self._running and \
+            if self._draining:
+                raise EngineDraining(
+                    "ServingEngine is draining (admission closed until "
+                    "undrain()); already-accepted requests will still "
+                    "be served")
+            while self._running and not self._draining and \
                     len(self._pending) >= self.queue_capacity:
                 remaining = None if deadline is None \
                     else deadline - time.perf_counter()
@@ -461,6 +484,12 @@ class ServingEngine:
                 self._not_full.wait(timeout=remaining)
             if not self._running:
                 raise RuntimeError("ServingEngine is closed")
+            if self._draining:
+                # drain began while this caller waited on a full queue:
+                # admission is closed now, whatever space opened up
+                raise EngineDraining(
+                    "ServingEngine began draining while this submit "
+                    "waited for queue space; request not accepted")
             self._pending.append((feature, fut))
             self._not_empty.notify()
         return fut
@@ -624,12 +653,18 @@ class ServingEngine:
         while True:
             with self._lock:
                 while self._running and not self._pending:
+                    self._idle.notify_all()   # quiescent: drain() waiters
                     self._not_empty.wait()
                 if not self._running and not self._pending:
+                    self._idle.notify_all()
                     return
-                # deadline anchored on the OLDEST pending request
+                # deadline anchored on the OLDEST pending request; a
+                # draining engine dispatches immediately -- no new
+                # requests can arrive, so waiting out max_wait_ms for a
+                # fuller batch only delays the drain
                 deadline = self._pending[0][1]._t_submit + self.max_wait_s
-                while self._running and len(self._pending) < fill:
+                while self._running and not self._draining \
+                        and len(self._pending) < fill:
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0:
                         break
@@ -637,16 +672,24 @@ class ServingEngine:
                 take = min(self.max_batch_size, len(self._pending))
                 reqs = [self._pending.popleft() for _ in range(take)]
                 qdepth = len(self._pending)
+                self._in_tick += len(reqs)
                 self._not_full.notify_all()
             # claim each future (PENDING -> RUNNING) so a caller's
             # cancel() can no longer race the result-setting below --
             # set_result on a CANCELLED future raises InvalidStateError,
             # which would kill the dispatcher thread and hang the engine
-            reqs = [r for r in reqs if r[1].set_running_or_notify_cancel()]
-            if not reqs:
-                continue
-            self._tick += 1
-            self._run_tick(reqs, qdepth)
+            claimed = [r for r in reqs
+                       if r[1].set_running_or_notify_cancel()]
+            try:
+                if claimed:
+                    self._tick += 1
+                    self._run_tick(claimed, qdepth)
+            finally:
+                with self._lock:
+                    self._in_tick -= len(reqs)
+                    self._served += len(claimed)
+                    if not self._pending and not self._in_tick:
+                        self._idle.notify_all()
 
     def _form_batch(self, features, bucket):
         samples = [f if isinstance(f, Sample) else Sample(f)
@@ -1267,6 +1310,64 @@ class ServingEngine:
             self.telemetry.record("param_refresh", **fields)
         except Exception:
             log.exception("param_refresh telemetry record failed")
+
+    @property
+    def draining(self) -> bool:
+        """True while admission is closed (``drain()`` .. ``undrain()``)."""
+        return self._draining
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Gracefully quiesce WITHOUT closing: stop admitting (a new
+        ``submit`` raises the typed ``EngineDraining``), let the
+        dispatcher finish its in-flight tick and serve every
+        already-queued request, and return once the engine is idle.
+
+        The contract the fleet's rolling deploys ride on
+        (docs/robustness.md, "Serving fleets"): NO accepted future is
+        ever dropped -- every request admitted before ``drain()`` was
+        called resolves normally (result or its tick's exception).
+        Returns True when fully drained; False when ``timeout`` seconds
+        passed with work still in flight (the engine KEEPS draining --
+        call again to keep waiting, or ``undrain()`` to reopen).
+        Idempotent; ``undrain()`` reopens admission."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        with self._lock:
+            self._draining = True
+            # wake the dispatcher out of its batch-fill wait AND any
+            # submitter blocked on a full queue (it must see the drain
+            # and raise instead of being admitted late)
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+            while self._pending or self._in_tick:
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._idle.wait(timeout=remaining)
+        return True
+
+    def undrain(self):
+        """Reopen admission after a ``drain()`` (the rolling deploy's
+        per-replica drain -> cutover -> undrain step)."""
+        with self._lock:
+            self._draining = False
+            self._not_full.notify_all()
+        return self
+
+    def stats(self):
+        """Live engine occupancy -- the health/load signal a fleet
+        router balances on: pending queue depth, requests claimed by
+        the in-flight tick, lifetime ticks/requests served, and the
+        drain flag."""
+        with self._lock:
+            return {"pending": len(self._pending),
+                    "in_tick": self._in_tick,
+                    "draining": self._draining,
+                    "running": self._running,
+                    "ticks": self._tick,
+                    "served": self._served,
+                    "queue_capacity": self.queue_capacity}
 
     def close(self, timeout: Optional[float] = 10.0):
         """Stop accepting requests, drain the queue, join the

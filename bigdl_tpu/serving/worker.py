@@ -1,0 +1,360 @@
+"""Subprocess serving replica: a ``ServingEngine`` behind a small
+length-prefixed socket protocol.
+
+A fleet replica that lives in its own PROCESS is a real failure domain:
+a crash is a process death the supervisor observes (SIGKILL included),
+not an exception a try/except can paper over -- the explicit rebuild of
+the worker-failure tolerance BigDL inherited from Spark task
+re-execution (arxiv 1804.05839 section 3).  ``ReplicaServer`` wraps one
+engine; ``serving/fleet.py``'s ``SubprocessReplica`` is the client side
+and ``tools/serve_fleet.py`` the CLI that spawns workers.
+
+Protocol (loopback-only, trusted -- the peer is a process this operator
+spawned on this host): each message is a 4-byte big-endian length
+followed by a pickled payload.  Requests are ``{"op": ..., **kwargs}``;
+responses ``{"ok": True, "result": ...}`` or ``{"ok": False, "error":
+..., "error_type": ...}``.  Ops:
+
+- ``predict``  {feature, timeout}   -> output tree (numpy leaves)
+- ``probe``    {features, bucket}   -> sha256 digest of the unbatched
+  reference outputs (``predict_at``) -- the bit-for-bit serving
+  fingerprint the rejoin drill compares across processes
+- ``health``   {}                   -> {status, draining, version,
+  stats, pid}
+- ``drain``    {timeout} / ``undrain`` {}
+- ``capture``  {}                   -> token for the LIVE weights
+- ``stage``    {path}               -> token for a snapshot staged
+  beside the serving weights (nothing committed)
+- ``gate``     {token}              -> (ok, reason): the staged
+  candidate evaluated on the worker's probe batch, outputs must be
+  finite
+- ``commit``   {token, version, digest} -- the atomic pointer swap
+- ``release``  {token} / ``set_version`` {version, digest} / ``stop``
+
+Deploy verbs run under one server-side lock (they mutate staging
+state); predict traffic is served concurrently by the threading server
+and stays lock-free.
+
+No jax at module top: the FRAMING half (``send_msg``/``recv_msg``) is
+imported by the fleet router, which may live in a supervisor process
+with no accelerator.
+"""
+
+import hashlib
+import logging
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+log = logging.getLogger("bigdl_tpu.serving")
+
+#: refuse absurd frames instead of allocating them (a corrupt length
+#: prefix must not OOM the worker)
+MAX_MESSAGE_BYTES = 1 << 28
+
+
+def send_msg(sock, obj):
+    """One length-prefixed pickled message."""
+    data = pickle.dumps(obj)
+    if len(data) > MAX_MESSAGE_BYTES:
+        raise ValueError(f"message of {len(data)} bytes exceeds the "
+                         f"{MAX_MESSAGE_BYTES}-byte frame cap")
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError(
+                f"peer closed mid-message ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return buf
+
+
+def recv_msg(sock):
+    """The matching read: length prefix, then exactly that many bytes."""
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if n > MAX_MESSAGE_BYTES:
+        raise ValueError(f"frame of {n} bytes exceeds the "
+                         f"{MAX_MESSAGE_BYTES}-byte cap (corrupt prefix?)")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def call(host, port, op, rpc_timeout=30.0, **kwargs):
+    """One request/response round trip on a fresh connection (loopback
+    connections are cheap; a connection per request keeps the protocol
+    trivially correct under concurrency).  ``rpc_timeout`` bounds the
+    socket (the payload may carry its own engine-level ``timeout``
+    field).  Raises ``ReplicaCallError`` when the worker answered an
+    error; ``ConnectionError``/``OSError`` when it is unreachable
+    (dead)."""
+    with socket.create_connection((host, int(port)),
+                                  timeout=rpc_timeout) as s:
+        s.settimeout(rpc_timeout)
+        send_msg(s, {"op": op, **kwargs})
+        resp = recv_msg(s)
+    if not isinstance(resp, dict) or not resp.get("ok"):
+        err = (resp or {}).get("error", "malformed response")
+        raise ReplicaCallError(
+            f"{op} failed on worker {host}:{port}: {err}",
+            error_type=(resp or {}).get("error_type"))
+    return resp.get("result")
+
+
+class ReplicaCallError(RuntimeError):
+    """The worker answered, but the op failed there (its error text
+    rides along) -- distinct from a dead/unreachable worker.
+    ``error_type`` carries the worker-side exception's class name so a
+    router can recognize typed refusals (e.g. ``EngineDraining``)
+    across the socket."""
+
+    def __init__(self, message, error_type=None):
+        super().__init__(message)
+        self.error_type = error_type
+
+
+def gate_staged(engine, handle, probe_features, probe_bucket=None):
+    """THE per-replica deploy gate: the staged candidate's outputs on
+    the probe batch must be finite on the REAL rows (``[:n]`` -- a
+    bucket-padding row's garbage is not the candidate's fault).  One
+    implementation shared by ``fleet.InProcessReplica.gate`` and the
+    worker's ``gate`` op, so the two replica kinds can never disagree
+    about the same candidate."""
+    import numpy as np
+
+    import jax
+
+    if probe_features is None:
+        return True, "no probe features configured"
+    n = len(probe_features)
+    bucket = int(probe_bucket) if probe_bucket else \
+        (engine.ladder.bucket_for(n) or n)
+    x = engine._form_batch(list(probe_features), bucket)
+    y = engine.eval_staged(handle, x)
+    bad = sum(1 for l in jax.tree.leaves(y)
+              if not np.all(np.isfinite(np.asarray(l)[:n])))
+    if bad:
+        return False, (f"staged candidate produced non-finite outputs "
+                       f"on the probe batch ({bad} leaf/leaves)")
+    return True, None
+
+
+def probe_digest(engine, probe_features, bucket):
+    """Bit-for-bit serving fingerprint: each probe row through the
+    UNBATCHED reference path (``predict_at`` at one fixed bucket, where
+    logits are bit-exact), every OUTPUT LEAF hashed (a multi-output
+    model returns a tree) -- two processes serving the same committed
+    version produce the same digest."""
+    import numpy as np
+
+    import jax
+
+    h = hashlib.sha256()
+    for r in probe_features:
+        for leaf in jax.tree.leaves(engine.predict_at(r, bucket)):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def boot_from_registry(engine, registry_path):
+    """Point a fresh worker at the fleet's COMMITTED version: read the
+    durable registry, refuse a digest imposter, stage+commit the live
+    version's snapshot.  Returns the served (version, digest), or None
+    when the registry has no live snapshot yet (the worker then serves
+    its deterministic boot weights, which the baseline version IS)."""
+    if registry_path is None or not os.path.exists(str(registry_path)):
+        return None
+    from bigdl_tpu.serving.deploy import ModelRegistry, snapshot_digest
+
+    reg = ModelRegistry(str(registry_path))
+    live = reg.live
+    if live is None or live.path is None:
+        return None
+    digest = snapshot_digest(live.path)
+    if live.digest is not None and digest != live.digest:
+        raise RuntimeError(
+            f"snapshot {live.path} does not match the registry's live "
+            f"version v{live.version} (digest {digest} != {live.digest});"
+            f" refusing to boot a replica on an imposter")
+    engine.refresh_from_snapshot(live.path)
+    engine.set_serving_version(live.version, live.digest)
+    return live.version, live.digest
+
+
+class ReplicaServer:
+    """One engine served over the socket protocol.
+
+    >>> srv = ReplicaServer(engine, port=0, probe_features=x[:4])
+    >>> srv.port                       # the auto-assigned port
+    >>> srv.serve_forever()            # or srv.start() for a thread
+
+    ``probe_features`` feed the ``gate`` op (per-replica deploy gate:
+    the staged candidate's outputs on this batch must be finite) and
+    the ``probe`` digest.  ``max_handles`` bounds the token store so a
+    long-lived worker cannot leak staged device buffers (oldest
+    released first)."""
+
+    def __init__(self, engine, host="127.0.0.1", port=0,
+                 probe_features=None, probe_bucket=None, max_handles=8):
+        self.engine = engine
+        self.probe_features = probe_features
+        self.probe_bucket = int(probe_bucket) if probe_bucket \
+            else (len(probe_features) if probe_features is not None else 1)
+        self.max_handles = int(max_handles)
+        self._handles = {}
+        self._next_token = 0
+        self._deploy_lock = threading.Lock()
+        server = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = recv_msg(self.request)
+                except Exception:
+                    return                     # half-open scanner etc.
+                try:
+                    result = server._dispatch(req)
+                    resp = {"ok": True, "result": result}
+                except Exception as e:         # the error crosses the
+                    log.exception("replica op %r failed",   # wire, the
+                                  req.get("op"))            # worker lives
+                    resp = {"ok": False, "error": str(e)[:500],
+                            "error_type": type(e).__name__}
+                try:
+                    send_msg(self.request, resp)
+                except Exception:
+                    pass                       # client hung up
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, int(port)), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = None
+
+    # ----- op dispatch ------------------------------------------------------- #
+    def _dispatch(self, req):
+        op = req.get("op")
+        fn = getattr(self, f"_op_{op}", None)
+        if fn is None:
+            raise ValueError(f"unknown op {op!r}")
+        return fn(req)
+
+    def _op_predict(self, req):
+        import jax
+        import numpy as np
+
+        y = self.engine.predict(req["feature"],
+                                timeout=req.get("timeout"))
+        return jax.tree.map(np.asarray, y)
+
+    def _op_probe(self, req):
+        feats = req.get("features")
+        if feats is None:
+            feats = self.probe_features
+        if feats is None:
+            raise ValueError("no probe features configured on this worker")
+        return probe_digest(self.engine, feats,
+                            int(req.get("bucket") or self.probe_bucket))
+
+    def _op_health(self, req):
+        return {"status": "draining" if self.engine.draining else "ok",
+                "draining": self.engine.draining,
+                "version": self.engine._version_info,
+                "stats": self.engine.stats(),
+                "pid": os.getpid()}
+
+    def _op_drain(self, req):
+        return self.engine.drain(timeout=req.get("timeout"))
+
+    def _op_undrain(self, req):
+        self.engine.undrain()
+        return True
+
+    def _op_set_version(self, req):
+        self.engine.set_serving_version(req["version"], req.get("digest"))
+        return True
+
+    def _put_handle(self, handle):
+        self._next_token += 1
+        token = f"h{self._next_token}"
+        self._handles[token] = handle
+        while len(self._handles) > self.max_handles:
+            evicted = next(iter(self._handles))
+            del self._handles[evicted]
+            log.warning("replica handle store full: released oldest "
+                        "staged handle %s", evicted)
+        return token
+
+    def _op_capture(self, req):
+        with self._deploy_lock:
+            return self._put_handle(self.engine.capture_staged())
+
+    def _op_stage(self, req):
+        from bigdl_tpu.parallel.reshard import read_snapshot_layout
+        from bigdl_tpu.serving.engine import ServingEngine
+
+        with self._deploy_lock:
+            p = ServingEngine._resolve_snapshot(req["path"])
+            src = read_snapshot_layout(p)
+            params, mstate = self.engine._load_snapshot_weights(p, src)
+            handle = self.engine.stage_weights(params, mstate,
+                                               src_layout=src)
+            return self._put_handle(handle)
+
+    def _handle_of(self, req):
+        token = req.get("token")
+        handle = self._handles.get(token)
+        if handle is None:
+            raise KeyError(
+                f"unknown staged-handle token {token!r} (released, "
+                f"evicted, or from before a worker restart)")
+        return handle
+
+    def _op_gate(self, req):
+        with self._deploy_lock:
+            handle = self._handle_of(req)
+            return gate_staged(self.engine, handle, self.probe_features,
+                               self.probe_bucket)
+
+    def _op_commit(self, req):
+        with self._deploy_lock:
+            handle = self._handle_of(req)
+            self.engine.commit_staged(handle, version=req.get("version"),
+                                      digest=req.get("digest"))
+            return True
+
+    def _op_release(self, req):
+        with self._deploy_lock:
+            self._handles.pop(req.get("token"), None)
+            return True
+
+    def _op_stop(self, req):
+        threading.Thread(target=self._server.shutdown,
+                         daemon=True).start()
+        return True
+
+    # ----- lifecycle --------------------------------------------------------- #
+    def start(self):
+        """Serve from a daemon thread (the CLI worker uses
+        ``serve_forever`` on its main thread instead)."""
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="bigdl-replica-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._server.serve_forever()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(5)

@@ -663,6 +663,83 @@ class TestRolloutController:
         finally:
             eng2.close()
 
+    def test_resume_races_concurrent_checkpoint_write(self, tmp_path):
+        """ISSUE 14 satellite: a NEW snapshot landing in the checkpoint
+        dir WHILE resume() is re-staging the registry's live version
+        must neither double-promote nor wedge the controller.  Resume
+        comes back on the COMMITTED version (never the mid-scan
+        arrival); the ordinary poll then walks the new snapshot through
+        the staged rollout exactly once."""
+        model, metrics, tel, eng, reg, ctl = _serving_stack(tmp_path)
+        ctl.baseline()
+        xs = _xs()
+        stop, stats = threading.Event(), {"ok": 0, "fail": 0}
+        t = threading.Thread(target=_traffic, args=(eng, xs, stop, stats),
+                             daemon=True)
+        t.start()
+        try:
+            p = model.parameters()[0]
+            _write_snapshot(
+                str(tmp_path / "ckpt"),
+                jax.tree.map(lambda a: np.asarray(a) * 1.01, p), tag=4)
+            assert ctl.poll_once().stage == "live"
+        finally:
+            stop.set()
+            t.join(5)
+            eng.close()
+            tel.close()
+
+        # a fresh process resumes; the trainer drops checkpoint.8 at the
+        # sharpest point -- mid-way through resume's snapshot load
+        model2 = _mlp()
+        tel2 = StepTelemetry(str(tmp_path / "serve2"), trace=False)
+        eng2 = ServingEngine(model2, max_batch_size=4, max_wait_ms=1.0,
+                             telemetry=tel2)
+        eng2.precompile()
+        reg2 = ModelRegistry(str(tmp_path / "registry.json"))
+        ctl2 = RolloutController(
+            eng2, reg2, str(tmp_path / "ckpt"), telemetry=tel2,
+            shadow_fraction=1.0, shadow_min_rows=8,
+            min_top1_agreement=0.5, canary_fraction=0.5,
+            canary_min_ticks=3, stage_timeout_s=30.0)
+        cand = jax.tree.map(lambda a: np.asarray(a) * 1.02,
+                            model2.parameters()[0])
+        orig_load, wrote = ctl2._load, {}
+
+        def racing_load(path):
+            if not wrote:
+                wrote["p"] = _write_snapshot(str(tmp_path / "ckpt"),
+                                             cand, tag=8)
+            return orig_load(path)
+
+        ctl2._load = racing_load
+        live = ctl2.resume()
+        assert wrote, "the race hook never fired"
+        # resume landed on the COMMITTED v2, not the mid-scan arrival
+        assert live.version == 2 and reg2.live.version == 2
+        # ...and the new snapshot is walked ONCE by the ordinary poll
+        stop2, stats2 = threading.Event(), {"ok": 0, "fail": 0}
+        t2 = threading.Thread(target=_traffic,
+                              args=(eng2, xs, stop2, stats2), daemon=True)
+        t2.start()
+        try:
+            v = ctl2.poll_once()
+            assert v is not None and v.stage == "live" and v.version == 3
+            assert ctl2.poll_once() is None      # seen: no double-promote
+            assert ctl2.poll_once() is None
+        finally:
+            stop2.set()
+            t2.join(5)
+            eng2.close()
+            tel2.close()
+        digest = snapshot_digest(wrote["p"])
+        entries = [d for d in reg2.describe()["versions"]
+                   if d["digest"] == digest]
+        assert len(entries) == 1                 # one registry entry
+        lives = [e for e in _events(tmp_path / "serve2", "deploy")
+                 if e["stage"] == "live" and e["version"] == 3]
+        assert len(lives) == 1                   # one live event
+
     def test_quantized_rollback_never_requantizes(self, tmp_path,
                                                   monkeypatch):
         """The retained-buffers contract on the int8 engine: rollback

@@ -1,0 +1,1173 @@
+"""Fleet-grade serving (ISSUE 14): ``ServingFleet`` health-aware
+routing with per-replica circuit breakers, deadline-budgeted retries,
+hedging and load shedding; the engine's graceful ``drain`` seam; the
+``RunSupervisor``/``capped_backoff`` jitter; the label-scoped
+``MetricsExporter``; the ``serving/worker.py`` socket protocol; the
+``FleetSupervisor`` restart loop; ``RolloutController``'s rolling
+fleet deploys; and the slow-tier ``tools/serve_fleet.py`` chaos
+drill."""
+
+import importlib.util
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.observability import StepTelemetry
+from bigdl_tpu.observability.metrics import (MetricsExporter,
+                                             MetricsRegistry,
+                                             render_scoped)
+from bigdl_tpu.observability.telemetry import DURABLE_KINDS
+from bigdl_tpu.optim.recovery import RunSupervisor, capped_backoff
+from bigdl_tpu.serving import (CircuitBreaker, EngineDraining,
+                               FleetOverloadedError, FleetSupervisor,
+                               FleetUnavailableError, InProcessReplica,
+                               ModelRegistry, RolloutController,
+                               ServingEngine, ServingFleet)
+from bigdl_tpu.serving.deploy import parse_fleet_chaos
+from bigdl_tpu.serving.fleet import Replica
+from bigdl_tpu.serving.worker import (ReplicaCallError, ReplicaServer,
+                                      call, probe_digest)
+from bigdl_tpu.utils import file_io
+from bigdl_tpu.utils.errors import ConfigurationError
+from bigdl_tpu.utils.random_generator import RNG
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp(seed=0, hidden=16):
+    RNG.set_seed(seed)
+    m = (nn.Sequential().add(nn.Linear(8, hidden)).add(nn.ReLU())
+         .add(nn.Linear(hidden, 4)))
+    m.build(jax.ShapeDtypeStruct((2, 8), jnp.float32))
+    return m
+
+
+def _xs(n=64, seed=0):
+    return np.random.default_rng(seed).standard_normal((n, 8)) \
+        .astype("float32")
+
+
+def _engine(seed=0, telemetry=None, **kw):
+    eng = ServingEngine(_mlp(seed), max_batch_size=4, max_wait_ms=1.0,
+                        telemetry=telemetry, **kw)
+    eng.precompile(example_feature=_xs(2)[0])
+    return eng
+
+
+def _fleet(n=3, telemetry=None, metrics=None, **kw):
+    engines = [_engine(telemetry=telemetry if i == 0 else None)
+               for i in range(n)]
+    kw.setdefault("retry_backoff_s", 0.003)
+    kw.setdefault("retry_backoff_max_s", 0.02)
+    fleet = ServingFleet([InProcessReplica(e) for e in engines],
+                         telemetry=telemetry, metrics=metrics, **kw)
+    return fleet, engines
+
+
+def _events(d, kind=None):
+    path = os.path.join(str(d), "telemetry.jsonl")
+    evs = [json.loads(l) for l in open(path)]
+    return evs if kind is None else [e for e in evs if e["kind"] == kind]
+
+
+def _write_snapshot(ckpt_dir, params, tag=4):
+    os.makedirs(ckpt_dir, exist_ok=True)
+    target = os.path.join(ckpt_dir, f"checkpoint.{tag}.pkl")
+    file_io.atomic_save({"model_params": params, "model_state": None},
+                        target)
+    file_io.write_snapshot_manifest(target)
+    return target
+
+
+def _load_obs_report():
+    spec = importlib.util.spec_from_file_location(
+        "_fleet_obs", os.path.join(REPO, "tools", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------- #
+# Circuit breaker.
+# --------------------------------------------------------------------------- #
+
+
+class TestCircuitBreaker:
+    def _clocked(self, **kw):
+        t = {"now": 0.0}
+        transitions = []
+        br = CircuitBreaker(clock=lambda: t["now"],
+                            on_transition=lambda f, to: transitions
+                            .append((f, to)), **kw)
+        return br, t, transitions
+
+    def test_opens_on_consecutive_failures_only(self):
+        br, t, trans = self._clocked(failure_threshold=3)
+        for _ in range(2):
+            assert br.acquire()
+            br.record_failure()
+        assert br.acquire()
+        br.record_success()            # the streak resets
+        for _ in range(2):
+            assert br.acquire()
+            br.record_failure()
+        assert br.state == "closed"
+        assert br.acquire()
+        br.record_failure()            # third CONSECUTIVE -> open
+        assert br.state == "open"
+        assert not br.acquire()
+        assert trans == [("closed", "open")]
+
+    def test_half_open_probe_recovery(self):
+        br, t, trans = self._clocked(failure_threshold=1,
+                                     reset_timeout_s=5.0)
+        assert br.acquire()
+        br.record_failure()
+        assert br.state == "open" and not br.acquire()
+        t["now"] = 5.1                 # reset window elapsed
+        assert br.acquire()            # the half-open probe
+        assert br.state == "half_open"
+        assert not br.acquire()        # only ONE concurrent probe
+        br.record_success()
+        assert br.state == "closed" and br.acquire()
+        assert trans == [("closed", "open"), ("open", "half_open"),
+                         ("half_open", "closed")]
+
+    def test_half_open_probe_failure_reopens(self):
+        br, t, _ = self._clocked(failure_threshold=1, reset_timeout_s=1.0)
+        br.acquire()
+        br.record_failure()
+        t["now"] = 1.5
+        assert br.acquire()
+        br.record_failure()
+        assert br.state == "open" and not br.acquire()
+        t["now"] = 2.0                 # timer restarted at the refailure
+        assert not br.acquire()
+        t["now"] = 2.6
+        assert br.acquire()
+
+    def test_cancel_releases_probe_without_judging(self):
+        br, t, _ = self._clocked(failure_threshold=1, reset_timeout_s=1.0)
+        br.acquire()
+        br.record_failure()
+        t["now"] = 1.5
+        assert br.acquire() and not br.acquire()
+        br.record_cancel()             # abandoned hedge: slot freed,
+        assert br.state == "half_open"  # state unjudged
+        assert br.acquire()
+
+    def test_force_open_and_reset(self):
+        br, t, trans = self._clocked(failure_threshold=3)
+        br.force_open()
+        assert br.state == "open" and not br.acquire()
+        br.reset()
+        assert br.state == "closed" and br.acquire()
+        assert trans == [("closed", "open"), ("open", "closed")]
+
+    def test_validates_threshold(self):
+        with pytest.raises(ValueError, match="failure_threshold"):
+            CircuitBreaker(failure_threshold=0)
+
+
+# --------------------------------------------------------------------------- #
+# Backoff jitter (RunSupervisor satellite).
+# --------------------------------------------------------------------------- #
+
+
+class TestBackoffJitter:
+    def test_capped_backoff_no_jitter_is_the_old_formula(self):
+        assert capped_backoff(0, 0.5, 30.0) == 0.5
+        assert capped_backoff(3, 0.5, 30.0) == 4.0
+        assert capped_backoff(10, 0.5, 30.0) == 30.0
+
+    def test_jitter_bounds_and_determinism(self):
+        rng = random.Random(7)
+        vals = [capped_backoff(2, 0.5, 30.0, jitter=0.5, rng=rng)
+                for _ in range(50)]
+        assert all(2.0 * 0.5 <= v <= 2.0 * 1.5 for v in vals)
+        assert len(set(round(v, 9) for v in vals)) > 10  # actually varies
+        # injectable rng -> reproducible
+        rng2 = random.Random(7)
+        assert vals == [capped_backoff(2, 0.5, 30.0, jitter=0.5,
+                                       rng=rng2) for _ in range(50)]
+
+    def test_jitter_applied_after_cap(self):
+        # N supervisors pinned AT the cap still spread out -- the whole
+        # point (thundering herd against one checkpoint dir)
+        vals = {round(capped_backoff(10, 0.5, 2.0, jitter=0.5,
+                                     rng=random.Random(s)), 6)
+                for s in range(8)}
+        assert len(vals) == 8
+        assert all(1.0 <= v <= 3.0 for v in vals)
+
+    def test_invalid_jitter_rejected(self):
+        with pytest.raises(ConfigurationError, match="jitter"):
+            capped_backoff(0, 0.5, 30.0, jitter=1.5)
+        with pytest.raises(ConfigurationError, match="jitter"):
+            RunSupervisor(jitter=-0.1)
+
+    def test_supervisor_sleeps_jittered_backoff(self):
+        """The restart loop actually SLEEPS the jittered value (pinned
+        with an injected rng + sleep): two replicas' supervisors with
+        different rng seeds restart at different times."""
+        def run_one(seed):
+            slept = []
+            sup = RunSupervisor(max_restarts=2, backoff_base_s=1.0,
+                                backoff_max_s=8.0, jitter=0.5,
+                                rng=random.Random(seed),
+                                sleep=slept.append, stop_on_repeat=False)
+
+            class FakeOpt:
+                checkpoint_path = None
+                sharded_checkpoint_path = None
+                driver_state = {"neval": 1}
+                calls = 0
+
+                def optimize(self):
+                    FakeOpt.calls += 1
+                    if FakeOpt.calls < 3:
+                        raise RuntimeError("transient")
+
+            FakeOpt.calls = 0
+            sup.run(lambda attempt: FakeOpt())
+            return slept
+
+        a, b = run_one(0), run_one(1)
+        assert len(a) == len(b) == 2
+        assert a != b                             # de-synchronized
+        for slept in (a, b):
+            assert 0.5 <= slept[0] <= 1.5         # base 1.0 +/- 50%
+            assert 1.0 <= slept[1] <= 3.0         # base 2.0 +/- 50%
+
+
+# --------------------------------------------------------------------------- #
+# Engine drain seam.
+# --------------------------------------------------------------------------- #
+
+
+class TestEngineDrain:
+    def test_no_accepted_future_is_ever_dropped(self):
+        """The drain contract: every request admitted before drain()
+        resolves with a real result; admission after raises the typed
+        error; undrain reopens."""
+        eng = ServingEngine(_mlp(), max_batch_size=4, max_wait_ms=500.0)
+        eng.precompile(example_feature=_xs(2)[0])
+        xs = _xs(16)
+        try:
+            # max_wait 500ms: these sit PENDING when drain begins
+            futs = [eng.submit(xs[i]) for i in range(6)]
+            assert eng.drain(timeout=30.0) is True
+            assert eng.draining
+            for f in futs:
+                assert np.asarray(f.result(1.0)).shape == (4,)
+            with pytest.raises(EngineDraining):
+                eng.submit(xs[0])
+            with pytest.raises(EngineDraining):
+                eng.predict(xs[0])
+            eng.undrain()
+            assert not eng.draining
+            assert np.asarray(eng.predict(xs[0], timeout=10.0)).shape \
+                == (4,)
+        finally:
+            eng.close()
+
+    def test_drain_idle_engine_is_immediate_and_idempotent(self):
+        eng = _engine()
+        try:
+            t0 = time.perf_counter()
+            assert eng.drain(timeout=5.0) is True
+            assert eng.drain(timeout=5.0) is True
+            assert time.perf_counter() - t0 < 1.0
+            eng.undrain()
+        finally:
+            eng.close()
+
+    def test_submitter_blocked_on_full_queue_sees_the_drain(self):
+        eng = ServingEngine(_mlp(), max_batch_size=1, max_wait_ms=1.0,
+                            queue_capacity=1)
+        eng.precompile(example_feature=_xs(2)[0])
+        xs = _xs(4)
+        orig = eng._backend.eval
+        release = threading.Event()
+
+        def slow(*a, **kw):
+            release.wait(5.0)
+            return orig(*a, **kw)
+
+        eng._backend.eval = slow
+        try:
+            first = eng.submit(xs[0])          # occupies the tick
+            time.sleep(0.05)
+            second = eng.submit(xs[1])         # fills capacity 1
+            errs = []
+
+            def blocked_submit():
+                try:
+                    eng.submit(xs[2], timeout=10.0)
+                except Exception as e:
+                    errs.append(e)
+
+            t = threading.Thread(target=blocked_submit, daemon=True)
+            t.start()
+            time.sleep(0.05)
+            drained = threading.Thread(
+                target=lambda: eng.drain(timeout=10.0), daemon=True)
+            drained.start()
+            time.sleep(0.05)
+            release.set()
+            t.join(5.0)
+            drained.join(5.0)
+            assert len(errs) == 1 and isinstance(errs[0], EngineDraining)
+            # the two ACCEPTED requests still resolved
+            assert first.result(5.0) is not None
+            assert second.result(5.0) is not None
+        finally:
+            release.set()
+            eng._backend.eval = orig
+            eng.close()
+
+    def test_stats_surface(self):
+        eng = _engine()
+        try:
+            s = eng.stats()
+            for k in ("pending", "in_tick", "draining", "running",
+                      "ticks", "served", "queue_capacity"):
+                assert k in s, k
+            assert s["pending"] == 0 and s["running"] is True
+            eng.predict(_xs(2)[0], timeout=10.0)
+            assert eng.stats()["served"] >= 1
+        finally:
+            eng.close()
+
+
+# --------------------------------------------------------------------------- #
+# Fleet routing: retries, breakers, shedding, hedging.
+# --------------------------------------------------------------------------- #
+
+
+def _poison(engine):
+    """Make an engine's every tick raise; returns the undo."""
+    backend = engine._backend
+    orig = backend.eval
+
+    def bad(*a, **kw):
+        raise RuntimeError("poisoned replica")
+
+    backend.eval = bad
+    return lambda: setattr(backend, "eval", orig)
+
+
+class TestFleetRouting:
+    def test_retries_absorb_a_failing_replica(self, tmp_path):
+        tel = StepTelemetry(str(tmp_path), trace=False)
+        reg = MetricsRegistry()
+        tel.attach_metrics(reg)
+        fleet, engines = _fleet(3, telemetry=tel, metrics=reg,
+                                breaker_reset_s=0.2)
+        xs = _xs()
+        heal = _poison(engines[0])
+        try:
+            for i in range(25):
+                fleet.predict(xs[i % len(xs)], timeout=15.0)
+            c = fleet.counters()
+            assert c["ok"] == 25 and c["failed"] == 0
+            assert c["retries"] >= 1
+            bad = fleet.replicas[0]
+            assert bad.breaker.state == "open"
+            assert bad.failed >= 1
+            # heal -> the half-open probe re-closes the breaker
+            heal()
+            deadline = time.time() + 10.0
+            while bad.breaker.state != "closed" and time.time() < deadline:
+                fleet.predict(xs[0], timeout=15.0)
+                time.sleep(0.02)
+            assert bad.breaker.state == "closed"
+        finally:
+            heal()
+            fleet.close()
+            tel.close()
+        # the breaker's full open -> half_open -> closed walk is
+        # DURABLE in telemetry (the drill's post-mortem evidence)
+        assert "fleet" in DURABLE_KINDS
+        trail = [(e.get("from"), e.get("to"))
+                 for e in _events(tmp_path, "fleet")
+                 if e.get("event") == "breaker" and e.get("replica") == 0]
+        assert ("closed", "open") in trail
+        assert ("open", "half_open") in trail
+        assert ("half_open", "closed") in trail
+        # ...and bridged to the live transition counter
+        ctr = reg.get("bigdl_fleet_breaker_transitions_total")
+        assert ctr.value(replica="0", to="open") >= 1
+        assert ctr.value(replica="0", to="closed") >= 1
+
+    def test_every_replica_failing_raises_unavailable(self):
+        fleet, engines = _fleet(2, retry_limit=2)
+        heals = [_poison(e) for e in engines]
+        try:
+            with pytest.raises(FleetUnavailableError,
+                               match="failed attempt"):
+                fleet.predict(_xs(2)[0], timeout=5.0)
+            assert fleet.counters()["failed"] == 1
+        finally:
+            for h in heals:
+                h()
+            fleet.close()
+
+    def test_least_loaded_routing_skips_draining(self):
+        fleet, engines = _fleet(3)
+        try:
+            fleet.drain_replica(0, timeout=5.0)
+            fleet.drain_replica(1, timeout=5.0)
+            xs = _xs(8)
+            for i in range(8):
+                fleet.predict(xs[i], timeout=10.0)
+            # only replica 2 was admittable
+            assert fleet.replicas[2].served == 8
+            assert fleet.replicas[0].served == 0
+            assert fleet.replicas[1].served == 0
+            fleet.undrain_replica(0)
+            fleet.undrain_replica(1)
+        finally:
+            fleet.close()
+
+    def test_admission_limit_sheds_fast(self):
+        fleet, engines = _fleet(2, admission_limit=1)
+        backend = engines[0]._backend
+        orig = backend.eval
+        release = threading.Event()
+
+        def slow(*a, **kw):
+            release.wait(5.0)
+            return orig(*a, **kw)
+
+        backend.eval = slow
+        engines[1]._backend.eval = slow
+        try:
+            results = []
+            t = threading.Thread(
+                target=lambda: results.append(
+                    fleet.predict(_xs(2)[0], timeout=10.0)), daemon=True)
+            t.start()
+            time.sleep(0.1)                  # the slot is occupied
+            t0 = time.perf_counter()
+            with pytest.raises(FleetOverloadedError, match="shed"):
+                fleet.predict(_xs(2)[1], timeout=10.0)
+            assert time.perf_counter() - t0 < 0.5   # FAST rejection
+            assert fleet.counters()["shed"] == 1
+            release.set()
+            t.join(5.0)
+            assert len(results) == 1
+        finally:
+            release.set()
+            backend.eval = orig
+            fleet.close()
+
+    def test_hedge_second_replica_wins_the_tail(self):
+        fleet, engines = _fleet(2, hedge=True, hedge_min_delay_s=0.03,
+                                hedge_min_samples=5)
+        for _ in range(10):                 # calibrate the p99
+            fleet._note_latency(0.005)
+        backend = engines[0]._backend
+        orig = backend.eval
+        release = threading.Event()
+
+        def straggler(*a, **kw):
+            release.wait(3.0)               # one stuck tick
+            return orig(*a, **kw)
+
+        backend.eval = straggler
+        try:
+            t0 = time.perf_counter()
+            y = fleet.predict(_xs(2)[0], timeout=10.0)
+            took = time.perf_counter() - t0
+            assert np.asarray(y).shape == (4,)
+            assert took < 2.0               # did NOT wait out the straggler
+            c = fleet.counters()
+            assert c["hedges"] >= 1 and c["hedge_wins"] >= 1
+            assert c["failed"] == 0
+        finally:
+            release.set()
+            backend.eval = orig
+            fleet.close()
+
+    def test_hedge_disabled_and_uncalibrated_never_hedges(self):
+        fleet, _ = _fleet(2)                 # hedge=False
+        try:
+            assert fleet._hedge_delay() is None
+        finally:
+            fleet.close()
+        fleet, _ = _fleet(2, hedge=True, hedge_min_samples=50)
+        try:
+            assert fleet._hedge_delay() is None   # uncalibrated
+            for _ in range(50):
+                fleet._note_latency(0.01)
+            assert fleet._hedge_delay() is not None
+        finally:
+            fleet.close()
+
+    def test_drain_refusal_is_not_a_breaker_failure(self):
+        """EngineDraining is 'pick another replica', not a failure
+        verdict: a replica drained behind the router's back (its
+        lifecycle still says serving) must not have its breaker opened
+        by the refusals -- with breaker_failures=1, ONE miscounted
+        refusal would open it."""
+        fleet, engines = _fleet(2, breaker_failures=1)
+        try:
+            engines[0].drain(timeout=5.0)   # engine-level drain only:
+            #                                 fleet state stays serving
+            xs = _xs(6)
+            for i in range(6):
+                fleet.predict(xs[i], timeout=10.0)
+            c = fleet.counters()
+            assert c["ok"] == 6 and c["failed"] == 0
+            assert fleet.replicas[0].breaker.state == "closed"
+            assert fleet.replicas[0].failed == 0
+        finally:
+            fleet.close()
+
+    def test_commit_staged_skips_a_failing_replica(self):
+        """The whole-fleet rollback path: one replica whose commit
+        fails (restarted worker, evicted token) is skipped, the REST
+        of the fleet still lands on the target version."""
+        fleet, engines = _fleet(3)
+        try:
+            xs = _xs(2)
+            y_old = np.asarray(engines[0].predict_at(xs[0], 4))
+            cand = jax.tree.map(lambda a: np.asarray(a) * 0.5,
+                                engines[0].model.parameters()[0])
+            h = fleet.stage_weights(params=cand)
+            broken = fleet._by_id(1)
+            broken.commit = lambda *a, **kw: (_ for _ in ()).throw(
+                RuntimeError("token evicted"))
+            fleet.commit_staged(h, version=2)       # must NOT raise
+            for rid in (0, 2):
+                assert not np.array_equal(
+                    y_old,
+                    np.asarray(fleet._by_id(rid).engine
+                               .predict_at(xs[0], 4)))
+            # every replica failing DOES raise
+            for rep in fleet.replicas:
+                rep.commit = lambda *a, **kw: (_ for _ in ()).throw(
+                    RuntimeError("all broken"))
+            with pytest.raises(RuntimeError, match="every replica"):
+                fleet.commit_staged(h, version=3)
+        finally:
+            fleet.close()
+
+    def test_gate_ignores_padding_rows(self):
+        """The shared gate (worker.gate_staged) judges only the REAL
+        probe rows: padding garbage is not the candidate's fault, and
+        both replica kinds run the same implementation."""
+        from bigdl_tpu.serving.worker import gate_staged
+
+        eng = _engine()
+        try:
+            xs = _xs(2)
+            h = eng.stage_weights(eng.model.parameters()[0])
+            ok, reason = gate_staged(eng, h, xs[:2], probe_bucket=4)
+            assert ok, reason                 # 2 real rows in bucket 4
+            bad = jax.tree.map(lambda a: np.asarray(a) * np.nan,
+                               eng.model.parameters()[0])
+            import jax.numpy as jnp
+            hb = {**h, "staged": eng._backend.stage(
+                jax.tree.map(jnp.asarray, bad), eng.model.state())}
+            ok, reason = gate_staged(eng, hb, xs[:2], probe_bucket=4)
+            assert not ok and "non-finite" in reason
+        finally:
+            eng.close()
+
+    def test_fleet_validates_inputs(self):
+        with pytest.raises(ValueError, match="at least one replica"):
+            ServingFleet([])
+        eng = _engine()
+        try:
+            with pytest.raises(ValueError, match="admission_limit"):
+                ServingFleet([InProcessReplica(eng)], admission_limit=0)
+        finally:
+            eng.close()
+        assert parse_fleet_chaos(None) is None
+        assert parse_fleet_chaos("kill:replica:1@40") == ("kill", 1, 40)
+        for bad in ("kill:replica:1", "kill:replica:x@3",
+                    "kill:replica:1@0", "kill:cutover:2", "replica:1@2"):
+            with pytest.raises(ConfigurationError):
+                parse_fleet_chaos(bad)
+
+
+# --------------------------------------------------------------------------- #
+# Scoped metrics exporter (satellite).
+# --------------------------------------------------------------------------- #
+
+
+class TestScopedExporter:
+    def test_render_scoped_merges_families_under_one_header(self):
+        r0, r1 = MetricsRegistry(), MetricsRegistry()
+        r0.counter("bigdl_serving_ticks_total", "ticks").inc(3)
+        r1.counter("bigdl_serving_ticks_total", "ticks").inc(5)
+        r1.histogram("bigdl_lat_seconds", "lat",
+                     buckets=(0.1, 1.0)).observe(0.05)
+        text = render_scoped({"0": r0, "1": r1})
+        assert text.count("# TYPE bigdl_serving_ticks_total counter") == 1
+        assert 'bigdl_serving_ticks_total{replica="0"} 3' in text
+        assert 'bigdl_serving_ticks_total{replica="1"} 5' in text
+        assert 'bigdl_lat_seconds_bucket{replica="1",le="0.1"} 1' in text
+
+    def test_type_conflict_skipped_not_invalid(self):
+        r0, r1 = MetricsRegistry(), MetricsRegistry()
+        r0.counter("bigdl_thing", "t").inc()
+        r1.gauge("bigdl_thing", "t").set(2)
+        text = render_scoped({"a": r0, "b": r1})
+        assert text.count("# TYPE bigdl_thing") == 1
+        assert 'bigdl_thing{replica="a"} 1' in text
+        assert 'bigdl_thing{replica="b"}' not in text
+
+    def test_one_port_many_replicas_and_worst_of_healthz(self):
+        regs = {str(i): MetricsRegistry() for i in range(3)}
+        for i, r in regs.items():
+            r.counter("bigdl_fleet_requests_total", "req",
+                      labelnames=("outcome",)).inc(int(i) + 1,
+                                                   outcome="ok")
+        with MetricsExporter(regs, port=0) as exp:
+            body = urllib.request.urlopen(
+                exp.url + "/metrics", timeout=10).read().decode()
+            for i in range(3):
+                assert (f'bigdl_fleet_requests_total{{replica="{i}",'
+                        f'outcome="ok"}} {i + 1}') in body
+            assert body.count("# TYPE bigdl_fleet_requests_total") == 1
+            # healthz: worst-of, reasons scoped
+            h = json.loads(urllib.request.urlopen(
+                exp.url + "/healthz", timeout=10).read())
+            assert h["status"] == "ok"
+            regs["1"].set_health("watchdog:recompile", "degraded")
+            h = json.loads(urllib.request.urlopen(
+                exp.url + "/healthz", timeout=10).read())
+            assert h["status"] == "degraded"
+            assert any(r["reason"].startswith("replica=1:")
+                       for r in h["reasons"])
+            regs["2"].set_health("slo:latency", "halted")
+            req = urllib.request.Request(exp.url + "/healthz")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 503     # halted answers 503
+            # live growth
+            r3 = MetricsRegistry()
+            r3.gauge("bigdl_new", "n").set(1)
+            exp.add_registry("3", r3)
+            body = urllib.request.urlopen(
+                exp.url + "/metrics", timeout=10).read().decode()
+            assert 'bigdl_new{replica="3"} 1' in body
+
+    def test_single_registry_exporter_unchanged(self):
+        reg = MetricsRegistry()
+        reg.counter("bigdl_x_total", "x").inc()
+        with MetricsExporter(reg, port=0) as exp:
+            body = urllib.request.urlopen(
+                exp.url + "/metrics", timeout=10).read().decode()
+            assert "bigdl_x_total 1" in body
+            with pytest.raises(ValueError, match="scoped exporter"):
+                exp.add_registry("0", reg)
+
+    def test_bridge_maps_fleet_events(self):
+        reg = MetricsRegistry()
+        reg.observe_event({"kind": "fleet", "event": "breaker",
+                           "replica": 2, "from": "closed", "to": "open"})
+        reg.observe_event({"kind": "fleet", "event": "state",
+                           "replica": 2, "state": "dead"})
+        reg.observe_event({"kind": "fleet", "event": "state",
+                           "replica": 2, "state": "serving"})
+        reg.observe_event({"kind": "fleet", "event": "restart",
+                           "replica": 2, "restart": 1})
+        assert reg.get("bigdl_fleet_breaker_transitions_total") \
+            .value(replica="2", to="open") == 1
+        g = reg.get("bigdl_fleet_replica_state")
+        assert g.value(replica="2", state="serving") == 1
+        assert g.value(replica="2", state="dead") == 0    # one-hot
+        assert reg.get("bigdl_fleet_replica_deaths_total") \
+            .value(replica="2") == 1
+        assert reg.get("bigdl_fleet_restarts_total").value(replica="2") \
+            == 1
+
+
+# --------------------------------------------------------------------------- #
+# Worker socket protocol (in-process server: port-0, no subprocess).
+# --------------------------------------------------------------------------- #
+
+
+class TestWorkerProtocol:
+    def test_predict_health_drain_deploy_round_trip(self, tmp_path):
+        xs = _xs()
+        eng = _engine()
+        srv = ReplicaServer(eng, port=0, probe_features=xs[:4],
+                            probe_bucket=4).start()
+        try:
+            y = call("127.0.0.1", srv.port, "predict", feature=xs[0],
+                     timeout=10.0)
+            np.testing.assert_array_equal(
+                np.asarray(y), np.asarray(eng.predict_at(xs[0], 1)))
+            h = call("127.0.0.1", srv.port, "health")
+            assert h["status"] == "ok" and h["pid"] == os.getpid()
+            assert h["stats"]["served"] >= 1
+            # drain over the wire
+            assert call("127.0.0.1", srv.port, "drain", timeout=5.0)
+            assert call("127.0.0.1", srv.port, "health")["draining"]
+            call("127.0.0.1", srv.port, "undrain")
+            # capture -> stage -> gate -> commit -> rollback, by token
+            y0 = np.asarray(eng.predict_at(xs[0], 4))
+            live_tok = call("127.0.0.1", srv.port, "capture")
+            snap = _write_snapshot(
+                str(tmp_path), jax.tree.map(lambda a: np.asarray(a) * 0.5,
+                                            eng.model.parameters()[0]))
+            tok = call("127.0.0.1", srv.port, "stage", path=snap)
+            ok, reason = call("127.0.0.1", srv.port, "gate", token=tok)
+            assert ok, reason
+            np.testing.assert_array_equal(              # nothing committed
+                y0, np.asarray(eng.predict_at(xs[0], 4)))
+            call("127.0.0.1", srv.port, "commit", token=tok, version=2)
+            assert not np.array_equal(
+                y0, np.asarray(eng.predict_at(xs[0], 4)))
+            call("127.0.0.1", srv.port, "commit", token=live_tok,
+                 version=1)
+            np.testing.assert_array_equal(              # bit-for-bit back
+                y0, np.asarray(eng.predict_at(xs[0], 4)))
+            # probe digest: the wire answer equals the local one
+            assert call("127.0.0.1", srv.port, "probe") \
+                == probe_digest(eng, xs[:4], 4)
+        finally:
+            srv.close()
+            eng.close()
+
+    def test_errors_cross_the_wire_typed(self):
+        eng = _engine()
+        srv = ReplicaServer(eng, port=0).start()
+        try:
+            with pytest.raises(ReplicaCallError, match="unknown op"):
+                call("127.0.0.1", srv.port, "bogus")
+            with pytest.raises(ReplicaCallError, match="token"):
+                call("127.0.0.1", srv.port, "commit", token="nope")
+            with pytest.raises(ReplicaCallError, match="probe"):
+                call("127.0.0.1", srv.port, "probe")   # none configured
+        finally:
+            srv.close()
+            eng.close()
+
+    def test_handle_store_is_bounded(self, tmp_path):
+        eng = _engine()
+        srv = ReplicaServer(eng, port=0, max_handles=2).start()
+        try:
+            snap = _write_snapshot(str(tmp_path),
+                                   eng.model.parameters()[0])
+            toks = [call("127.0.0.1", srv.port, "stage", path=snap)
+                    for _ in range(4)]
+            assert len(srv._handles) == 2
+            with pytest.raises(ReplicaCallError, match="token"):
+                call("127.0.0.1", srv.port, "commit", token=toks[0])
+            call("127.0.0.1", srv.port, "commit", token=toks[-1])
+        finally:
+            srv.close()
+            eng.close()
+
+
+# --------------------------------------------------------------------------- #
+# Fleet supervisor (injected clock, stub subprocess replicas).
+# --------------------------------------------------------------------------- #
+
+
+class _StubWorker(Replica):
+    """A 'subprocess' replica the tests can kill and resurrect without
+    spawning a process."""
+
+    kind = "subprocess"
+
+    class _Proc:
+        def __init__(self, rc=None):
+            self.rc = rc
+            self.pid = 12345
+
+        def poll(self):
+            return self.rc
+
+    def __init__(self, rid=None, fail_respawns=0):
+        super().__init__(rid)
+        self.proc = self._Proc()
+        self.respawns = []
+        self.fail_respawns = fail_respawns
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def die(self, rc=-9):
+        self.proc.rc = rc
+
+    def respawn(self, attempt):
+        self.respawns.append(attempt)
+        if len(self.respawns) <= self.fail_respawns:
+            raise RuntimeError("boot failed")
+        self.proc = self._Proc()
+
+    def submit(self, feature, timeout=None, admit_timeout=None):
+        raise ConnectionRefusedError("stub")
+
+    def close(self):
+        pass
+
+
+class TestFleetSupervisor:
+    def _stack(self, tmp_path, **sup_kw):
+        tel = StepTelemetry(str(tmp_path), trace=False)
+        eng = _engine(telemetry=None)
+        stub = _StubWorker()
+        fleet = ServingFleet([InProcessReplica(eng), stub],
+                             telemetry=tel)
+        t = {"now": 0.0}
+        sup_kw.setdefault("jitter", 0.0)
+        sup = FleetSupervisor(fleet, clock=lambda: t["now"],
+                              backoff_base_s=1.0, backoff_max_s=8.0,
+                              **sup_kw)
+        return tel, eng, stub, fleet, t, sup
+
+    def test_death_restart_rejoin_cycle(self, tmp_path):
+        tel, eng, stub, fleet, t, sup = self._stack(tmp_path)
+        try:
+            assert stub.state == "serving"
+            stub.die(rc=-9)
+            assert sup.check() == []            # detected, backoff armed
+            assert stub.state == "dead"
+            assert stub.breaker.state == "open"  # stop routing NOW
+            t["now"] = 0.5
+            assert sup.check() == []            # not due yet
+            t["now"] = 1.1
+            assert sup.check() == [stub.rid]    # restarted + rejoined
+            assert stub.state == "serving"
+            assert stub.breaker.state == "closed"
+            assert stub.respawns == [1]
+            assert sup.events[0]["cause"] == "process_death"
+            assert sup.events[0]["backoff_s"] == pytest.approx(1.0)
+        finally:
+            fleet.close()
+            tel.close()
+        evs = _events(tmp_path, "fleet")
+        states = [(e.get("replica"), e.get("state")) for e in evs
+                  if e.get("event") == "state"]
+        assert (1, "dead") in states
+        assert (1, "serving") in states
+        assert any(e.get("event") == "restart" and e.get("replica") == 1
+                   for e in evs)
+
+    def test_restart_budget_closes_the_replica(self, tmp_path):
+        tel, eng, stub, fleet, t, sup = self._stack(
+            tmp_path, max_restarts=2)
+        stub.fail_respawns = 99                 # never boots again
+        try:
+            stub.die()
+            sup.check()
+            for i in range(6):
+                t["now"] += 20.0
+                sup.check()
+            assert stub.state == "closed"       # budget exhausted
+            assert len(stub.respawns) == 2
+            # the fleet keeps serving on the survivor
+            y = fleet.predict(_xs(2)[0], timeout=10.0)
+            assert np.asarray(y).shape == (4,)
+        finally:
+            fleet.close()
+            tel.close()
+
+    def test_backoff_jitter_spreads_restart_times(self, tmp_path):
+        tel, eng, stub, fleet, t, sup = self._stack(
+            tmp_path, jitter=0.5, rng=random.Random(3))
+        try:
+            vals = {round(sup.backoff_s(2), 6) for _ in range(6)}
+            assert len(vals) > 1
+            assert all(2.0 <= v <= 6.0 for v in vals)
+        finally:
+            fleet.close()
+            tel.close()
+
+
+# --------------------------------------------------------------------------- #
+# Rolling fleet deploys (RolloutController fleet mode).
+# --------------------------------------------------------------------------- #
+
+
+def _fleet_stack(tmp_path, n=3, **ctl_kw):
+    tel = StepTelemetry(os.path.join(str(tmp_path), "serve"),
+                        run_name="serve", trace=False)
+    fleet, engines = _fleet(n, telemetry=tel,
+                            probe_features=_xs(4), probe_bucket=4)
+    registry = ModelRegistry(os.path.join(str(tmp_path),
+                                          "registry.json"))
+    ctl_kw.setdefault("shadow_fraction", 1.0)
+    ctl_kw.setdefault("shadow_min_rows", 8)
+    ctl_kw.setdefault("min_top1_agreement", None)
+    ctl_kw.setdefault("max_logit_rmse", 100.0)
+    ctl_kw.setdefault("canary_fraction", 0.5)
+    ctl_kw.setdefault("canary_min_ticks", 2)
+    ctl_kw.setdefault("stage_timeout_s", 30.0)
+    ctl = RolloutController(fleet, registry,
+                            os.path.join(str(tmp_path), "ckpt"),
+                            telemetry=tel, **ctl_kw)
+    return tel, fleet, engines, registry, ctl
+
+
+def _traffic(fleet, stop, stats):
+    xs = _xs()
+    rng = np.random.default_rng(1)
+    while not stop.is_set():
+        try:
+            fleet.predict(xs[int(rng.integers(0, len(xs)))],
+                          timeout=15.0)
+            stats["ok"] += 1
+        except Exception:
+            if not stop.is_set():
+                stats["failed"] += 1
+
+
+class TestRollingDeploy:
+    def test_rolling_promote_under_traffic_zero_failures(self, tmp_path):
+        tel, fleet, engines, registry, ctl = _fleet_stack(tmp_path)
+        stop, stats = threading.Event(), {"ok": 0, "failed": 0}
+        threads = [threading.Thread(target=_traffic,
+                                    args=(fleet, stop, stats),
+                                    daemon=True) for _ in range(2)]
+        try:
+            ctl.baseline()
+            for t in threads:
+                t.start()
+            cand = jax.tree.map(lambda a: np.asarray(a) * 0.5,
+                                engines[0].model.parameters()[0])
+            _write_snapshot(os.path.join(str(tmp_path), "ckpt"), cand)
+            time.sleep(0.2)
+            v = ctl.poll_once()
+            assert v is not None and v.stage == "live"
+            assert registry.live.version == v.version
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5)
+        try:
+            assert stats["failed"] == 0 and stats["ok"] > 0
+            # every replica serves the candidate, bit-identically
+            xs = _xs(2)
+            ys = [np.asarray(e.predict_at(xs[0], 4)) for e in engines]
+            assert np.array_equal(ys[0], ys[1])
+            assert np.array_equal(ys[1], ys[2])
+            # the roll was per-replica: one cutover event per replica
+            cuts = [e for e in ctl.events if e["stage"] == "cutover"]
+            assert sorted(e.get("replica") for e in cuts) == [0, 1, 2]
+            assert all(e["verdict"] == "ok" for e in cuts)
+        finally:
+            fleet.close()
+            tel.close()
+        evs = _events(tmp_path / "serve", "deploy")
+        assert any(e["stage"] == "cutover" and e.get("replica") == 2
+                   for e in evs)
+
+    def test_failing_replica_gate_rolls_back_only_touched(self, tmp_path):
+        """The per-replica rollback pin: the gate fails on replica 1
+        AFTER replica 0 was cut over; mid-roll, the UNTOUCHED replica 2
+        must still be serving the old version (witnessed from inside
+        the failing gate), and afterwards every replica is back on the
+        old weights bit-for-bit with the candidate rejected."""
+        xs = _xs(2)
+        observed = {}
+
+        def gate(rid, fleet, handle):
+            if rid != 1:
+                return fleet.gate_replica(rid, handle)
+            # mid-roll: replica 0 is already on the candidate, replica
+            # 2 still serves the OLD version
+            observed["r0"] = np.asarray(
+                fleet._by_id(0).engine.predict_at(xs[0], 4))
+            observed["r2"] = np.asarray(
+                fleet._by_id(2).engine.predict_at(xs[0], 4))
+            return False, "injected failing gate"
+
+        tel, fleet, engines, registry, ctl = _fleet_stack(
+            tmp_path, replica_gate=gate)
+        stop, stats = threading.Event(), {"ok": 0, "failed": 0}
+        t = threading.Thread(target=_traffic, args=(fleet, stop, stats),
+                             daemon=True)
+        try:
+            ctl.baseline()
+            y_old = np.asarray(engines[0].predict_at(xs[0], 4))
+            t.start()
+            cand = jax.tree.map(lambda a: np.asarray(a) * 0.5,
+                                engines[0].model.parameters()[0])
+            _write_snapshot(os.path.join(str(tmp_path), "ckpt"), cand)
+            time.sleep(0.2)
+            v = ctl.poll_once()
+            assert v is not None and v.stage == "rejected"
+        finally:
+            stop.set()
+            t.join(5)
+        try:
+            assert stats["failed"] == 0
+            # the gate witnessed the mid-roll split: touched replica 0
+            # on the candidate, untouched replica 2 on the old version
+            assert not np.array_equal(observed["r0"], y_old)
+            np.testing.assert_array_equal(observed["r2"], y_old)
+            # rollback: every replica back on the old weights
+            for e in engines:
+                np.testing.assert_array_equal(
+                    y_old, np.asarray(e.predict_at(xs[0], 4)))
+            assert registry.live.version == 1     # baseline still live
+            cuts = {e.get("replica"): e["verdict"] for e in ctl.events
+                    if e["stage"] == "cutover"}
+            assert cuts[0] == "ok" and cuts[1] == "rejected"
+            assert 2 not in cuts                  # never touched
+            rb = [e for e in ctl.events if e["stage"] == "rollback"]
+            assert len(rb) == 1 and rb[0]["replicas"] == [0]
+        finally:
+            fleet.close()
+            tel.close()
+
+    def test_fleet_resume_recommits_on_every_replica(self, tmp_path):
+        tel, fleet, engines, registry, ctl = _fleet_stack(tmp_path)
+        try:
+            ctl.baseline()
+            cand = jax.tree.map(lambda a: np.asarray(a) * 0.5,
+                                engines[0].model.parameters()[0])
+            snap = _write_snapshot(os.path.join(str(tmp_path), "ckpt"),
+                                   cand)
+            # promote without traffic: shadow/canary satisfied by a
+            # quick burst
+            stop, stats = threading.Event(), {"ok": 0, "failed": 0}
+            t = threading.Thread(target=_traffic,
+                                 args=(fleet, stop, stats), daemon=True)
+            t.start()
+            v = ctl.poll_once()
+            stop.set()
+            t.join(5)
+            assert v.stage == "live"
+            y_live = np.asarray(engines[0].predict_at(_xs(2)[0], 4))
+        finally:
+            fleet.close()
+            tel.close()
+        # a fresh "process": new engines, new fleet, same registry
+        tel2 = StepTelemetry(os.path.join(str(tmp_path), "serve2"),
+                             trace=False)
+        fleet2, engines2 = _fleet(3, telemetry=tel2)
+        try:
+            registry2 = ModelRegistry(os.path.join(str(tmp_path),
+                                                   "registry.json"))
+            ctl2 = RolloutController(
+                fleet2, registry2, os.path.join(str(tmp_path), "ckpt"),
+                telemetry=tel2)
+            live = ctl2.resume()
+            assert live.version == 2
+            for e in engines2:
+                np.testing.assert_array_equal(
+                    y_live, np.asarray(e.predict_at(_xs(2)[0], 4)))
+        finally:
+            fleet2.close()
+            tel2.close()
+
+    def test_obs_report_fleet_section(self, tmp_path):
+        tel, fleet, engines, registry, ctl = _fleet_stack(tmp_path)
+        # replica 0 is the least-loaded first pick under sequential
+        # traffic: poisoning IT guarantees failures -> retries -> an
+        # open breaker in the artifact
+        heal = _poison(engines[0])
+        try:
+            ctl.baseline()
+            xs = _xs(8)
+            for i in range(8):
+                fleet.predict(xs[i], timeout=15.0)
+            heal()
+        finally:
+            heal()
+            fleet.close()
+            tel.close()
+        mod = _load_obs_report()
+        rep = mod.build_report(os.path.join(str(tmp_path), "serve"))
+        fl = rep.get("fleet")
+        assert fl is not None
+        assert len(fl["replicas"]) == 3
+        assert fl["requests"]["ok"] == 8
+        assert fl["requests"]["failed"] == 0
+        assert any(t["to"] == "open" for t in fl["breaker_transitions"])
+        text = mod.format_report(rep)
+        assert "fleet: 3 replica(s)" in text
+        assert "requests ok 8 / failed 0" in text
+        # a fleet-only artifact is not a hollow run
+        assert mod.main([os.path.join(str(tmp_path), "serve")]) == 0
+
+
+# --------------------------------------------------------------------------- #
+# Slow tier: the real subprocess drills (tools/serve_fleet.py).
+# --------------------------------------------------------------------------- #
+
+
+def _run_drill(out, extra, timeout=560):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.serve_fleet", "--out", str(out),
+         "--steps", "12", "--ckptEvery", "6", "--clients", "2"] + extra,
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+    return proc
+
+
+@pytest.mark.slow
+class TestServeFleetDrills:
+    def test_sigkill_replica_rejoins_committed_version(self, tmp_path):
+        """THE acceptance drill: 3 replicas under closed-loop load,
+        SIGKILL one -> zero failed client requests, the supervisor
+        restarts it from the registry's committed version, and it
+        rejoins bit-for-bit (probe digests equal)."""
+        out = tmp_path / "drill"
+        proc = _run_drill(out, ["--replicas", "3",
+                                "--chaos", "kill:replica:1@40"])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        result = json.load(open(out / "result.json"))
+        assert result["client"]["failed"] == 0
+        assert result["client"]["ok"] > 0
+        assert result["compiles_after_precompile"] == 0
+        assert result["chaos"]["replica"] == 1
+        assert result["rejoined"]["probe"] \
+            == result["rejoined"]["driver_probe"]
+        assert result["rejoined"]["version"]["version"] \
+            == result["live_version"]
+        assert result["probes_match"] is True
+        assert len(result["supervisor_restarts"]) >= 1
+        # the kill and restart are durable in the fleet event trail
+        evs = _events(result["serve_dir"], "fleet")
+        assert any(e.get("event") == "state" and e.get("state") == "dead"
+                   and e.get("replica") == 1 for e in evs)
+        assert any(e.get("event") == "restart" and e.get("replica") == 1
+                   for e in evs)
+        assert any(e.get("event") == "breaker" and e.get("to") == "open"
+                   for e in evs)
+
+    def test_rolling_deploy_with_failing_gate_cli(self, tmp_path):
+        """The rolling-rollback leg over REAL subprocess workers: the
+        injected per-replica gate rejects on replica 1, the fleet rolls
+        back the touched replicas, every replica keeps serving the OLD
+        version (digests equal across processes), zero failed client
+        requests."""
+        out = tmp_path / "gate"
+        proc = _run_drill(out, ["--replicas", "2", "--failGate", "1"])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        result = json.load(open(out / "result.json"))
+        assert result["client"]["failed"] == 0
+        assert result["probes_match"] is True      # all on one version
+        assert result["live_version"] == 1          # baseline kept
+        rejected = [d for d in result["deploys"]
+                    if d["verdict"] == "rejected"
+                    and d["stage"] == "cutover"]
+        assert rejected and rejected[0]["replica"] == 1
+        assert any(d["stage"] == "rollback" for d in result["deploys"])

@@ -370,6 +370,51 @@ def _deploy_block(other):
     return dep
 
 
+def _fleet_section(other):
+    """Summarize ``kind: "fleet"`` events -- the ServingFleet's
+    replica lifecycle/breaker edges, supervisor restarts and the final
+    request-counter stats event (docs/robustness.md, "Serving
+    fleets"): per-replica last state + death counts, the breaker
+    transition trail, and ok/failed/shed/retries/hedges totals.  None
+    for runs without fleet events."""
+    evs = [e for e in other if e.get("kind") == "fleet"]
+    if not evs:
+        return None
+    replicas, transitions, restarts, stats = {}, [], 0, None
+    for e in evs:
+        rid = e.get("replica")
+        what = e.get("event")
+        if what == "state" and rid is not None:
+            rec = replicas.setdefault(str(rid), {"replica": rid})
+            rec["state"] = e.get("state")
+            if e.get("state") == "dead":
+                rec["deaths"] = rec.get("deaths", 0) + 1
+                if e.get("reason"):
+                    rec["last_death_reason"] = e["reason"]
+        elif what == "breaker" and rid is not None:
+            transitions.append({"replica": rid, "from": e.get("from"),
+                                "to": e.get("to")})
+            replicas.setdefault(str(rid), {"replica": rid})["breaker"] \
+                = e.get("to")
+        elif what == "restart":
+            restarts += 1
+            if rid is not None:
+                rec = replicas.setdefault(str(rid), {"replica": rid})
+                rec["restarts"] = rec.get("restarts", 0) + 1
+        elif what == "stats":
+            stats = {k: e[k] for k in ("ok", "failed", "shed", "retries",
+                                       "hedges", "hedge_wins")
+                     if e.get(k) is not None}
+    sec = {"events": len(evs),
+           "replicas": [replicas[k] for k in sorted(replicas)],
+           "breaker_transitions": transitions[-12:],
+           "breaker_transitions_total": len(transitions),
+           "restarts": restarts}
+    if stats is not None:
+        sec["requests"] = stats
+    return sec
+
+
 def _slo_section(other):
     """Summarize ``kind: "slo"`` events -- the SloTracker's burn-rate
     breach/resolve edges (docs/observability.md, "Live metrics &
@@ -628,6 +673,9 @@ def build_report(run_dir, xplane_dir=None, top=10):
     serving = _serving_section(other, header)
     if serving:
         rep["serving"] = serving
+    fleet = _fleet_section(other)
+    if fleet:
+        rep["fleet"] = fleet
     recovery = _recovery_section(other)
     if recovery:
         rep["recovery"] = recovery
@@ -875,6 +923,34 @@ def format_report(rep):
                 f"serving queue depth p50/p90: {sv['queue_depth_p50']}/"
                 f"{sv['queue_depth_p90']}"
                 + (f" (capacity {cap})" if cap is not None else ""))
+    fl = rep.get("fleet")
+    if fl:
+        line = f"fleet: {len(fl['replicas'])} replica(s)"
+        req = fl.get("requests")
+        if req:
+            line += (f"   requests ok {req.get('ok', 0)} / failed "
+                     f"{req.get('failed', 0)} / shed "
+                     f"{req.get('shed', 0)}")
+            extras = [f"{k} {req[k]}" for k in
+                      ("retries", "hedges", "hedge_wins") if req.get(k)]
+            if extras:
+                line += "   (" + ", ".join(extras) + ")"
+        out.append(line)
+        for r in fl["replicas"]:
+            ln = (f"  replica {r.get('replica')}: {r.get('state', '?')}"
+                  + (f", breaker {r['breaker']}" if r.get("breaker")
+                     else ""))
+            if r.get("deaths"):
+                ln += (f", died x{r['deaths']}"
+                       + (f" ({r['last_death_reason']})"
+                          if r.get("last_death_reason") else ""))
+            if r.get("restarts"):
+                ln += f", restarted x{r['restarts']}"
+            out.append(ln)
+        if fl.get("breaker_transitions"):
+            out.append("  breaker trail: " + ", ".join(
+                f"r{t.get('replica')} {t.get('from')}->{t.get('to')}"
+                for t in fl["breaker_transitions"][-8:]))
     slo = rep.get("slo")
     if slo:
         for o in slo["objectives"]:
@@ -978,7 +1054,7 @@ def main(argv=None):
         return 2
     if rep["n_steps"] == 0 and not any(
             rep.get(k) for k in ("serving", "recovery", "health",
-                                 "validations", "slo")):
+                                 "validations", "slo", "fleet")):
         # an empty/truncated JSONL must FAIL in scripts, not render a
         # hollow report: zero step events and nothing else to show
         # means the run recorded nothing (broken telemetry hookup, or
