@@ -105,8 +105,10 @@ class MultiHeadAttention(Module):
             "out_bias": jnp.zeros((d,), jnp.float32),
         }, ()
 
-    def apply(self, params, state, input, *, training=False, rng=None):
-        n, t, d = input.shape
+    def _project_qkv(self, params, input):
+        """Fused qkv projection; ONE implementation for the full-sequence
+        and cached (prefill/decode) paths, so the int8 branch covers
+        generation with no second code path."""
         dt = input.dtype
         if "qkv_weight_q" in params:
             # post-training-quantized projections (nn/quantized): the
@@ -115,12 +117,137 @@ class MultiHeadAttention(Module):
             # dtype (softmax in fp32 as always)
             from bigdl_tpu.nn.quantized import int8_matmul
 
-            qkv = (int8_matmul(input, params["qkv_weight_q"],
-                               params["qkv_scale"])
-                   + params["qkv_bias"]).astype(dt)
-        else:
-            qkv = input @ params["qkv_weight"].astype(dt).T \
-                + params["qkv_bias"].astype(dt)
+            return (int8_matmul(input, params["qkv_weight_q"],
+                                params["qkv_scale"])
+                    + params["qkv_bias"]).astype(dt)
+        return input @ params["qkv_weight"].astype(dt).T \
+            + params["qkv_bias"].astype(dt)
+
+    def _project_out(self, params, y, dt):
+        if "out_weight_q" in params:
+            from bigdl_tpu.nn.quantized import int8_matmul
+
+            return (int8_matmul(y, params["out_weight_q"],
+                                params["out_scale"])
+                    + params["out_bias"]).astype(dt)
+        return y @ params["out_weight"].astype(dt).T \
+            + params["out_bias"].astype(dt)
+
+    # ----- KV-cache decode mode -------------------------------------------- #
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """Per-layer K/V buffers for autoregressive decode: fixed-shape
+        ``(batch, max_len, heads, head_dim)`` zero tensors the cached
+        ``apply`` fills with ``dynamic_update_slice`` writes.  Fixed
+        shapes are the whole point -- every decode step reuses ONE
+        compiled executable regardless of how many tokens are live
+        (docs/performance.md, "Generation serving")."""
+        shape = (batch, int(max_len), self.num_heads, self.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def _flash_decode_ok(self, max_len):
+        if self.use_flash == "never" or self.seq_axis_name is not None:
+            return False
+        # the decode kernel tiles the cache with block_k = min(128,
+        # max_len): a cache at or under 128 is one block, a longer one
+        # must tile exactly -- this gates the FORCED modes too, or an
+        # unaligned decode_max_len would trip the kernel's assert on
+        # every tick instead of quietly taking the plain path
+        if max_len > 128 and max_len % 128:
+            return False
+        if self.use_flash in ("always", "interpret"):
+            return True
+        try:
+            return jax.devices()[0].platform == "tpu"
+        except Exception:
+            return False
+
+    def _apply_cached(self, params, input, cache, pos):
+        """Incremental attention against a K/V cache.
+
+        Two shapes, one contract (returns ``(y, new_cache)``):
+
+        - PREFILL (``pos is None``): ``input`` is the whole (padded)
+          prompt ``(N, T, D)``; K/V are written at positions ``[0, T)``
+          and attention is plain causal over the prompt itself --
+          identical math to the full-sequence path, so prefill logits
+          ARE full-forward logits.
+        - DECODE (``pos`` an ``(N,)`` int vector): ``input`` is ONE
+          token per row ``(N, 1, D)``; row ``i``'s K/V land at
+          ``pos[i]`` (a per-row ``dynamic_update_slice``) and attention
+          masks ``kpos <= pos[i]``, so stale positions beyond the
+          frontier -- a previous occupant's K/V, or prompt padding not
+          yet overwritten -- are invisible until the decode write that
+          replaces them makes them real.  Rows only ever write their
+          OWN cache row, which is what lets a slot scheduler run
+          inactive slots as harmless garbage instead of recompiling.
+        """
+        n, t, d = input.shape
+        dt = input.dtype
+        qkv = self._project_qkv(params, input)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (n, t, self.num_heads, self.head_dim)
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        cdt = cache["k"].dtype
+        if pos is None:                                   # prefill
+            max_len = cache["k"].shape[1]
+            if t > max_len:
+                raise ValueError(
+                    f"prompt length {t} exceeds the cache's max_len "
+                    f"{max_len}")
+            new_cache = {"k": cache["k"].at[:, :t].set(k.astype(cdt)),
+                         "v": cache["v"].at[:, :t].set(v.astype(cdt))}
+            # forced flash modes bypass _flash_ok's block gate, but a
+            # prompt rung that doesn't tile (e.g. an unaligned
+            # decode_max_len on the ladder) would trip the kernel's
+            # shape assert on every prefill -- take the plain path
+            if self._flash_ok(t) and self._flash_block_ok(t):
+                from bigdl_tpu.ops.flash_attention import flash_attention
+
+                bq = t if t < 128 else 128
+                y = flash_attention(q, k, v, causal=self.causal,
+                                    block_q=bq, block_k=bq,
+                                    interpret=self.use_flash == "interpret")
+            else:
+                y = dot_product_attention(q, k, v, causal=self.causal)
+        else:                                             # one-token step
+            if t != 1:
+                raise ValueError(
+                    f"decode steps take one token per row, got T={t}")
+            pos = jnp.asarray(pos, jnp.int32)
+            write = jax.vmap(
+                lambda c, new, p: jax.lax.dynamic_update_slice(
+                    c, new, (p, 0, 0)))
+            new_cache = {"k": write(cache["k"], k.astype(cdt), pos),
+                         "v": write(cache["v"], v.astype(cdt), pos)}
+            max_len = cache["k"].shape[1]
+            if self._flash_decode_ok(max_len):
+                from bigdl_tpu.ops.flash_attention import \
+                    flash_decode_attention
+
+                y = flash_decode_attention(
+                    q, new_cache["k"].astype(dt), new_cache["v"].astype(dt),
+                    pos, interpret=self.use_flash == "interpret")
+            else:
+                # scores (N, H, 1, max_len); the position mask broadcasts
+                # over heads and the single query row
+                mask = (jnp.arange(max_len)[None, :]
+                        <= pos[:, None])[:, None, None, :]
+                y = dot_product_attention(q, new_cache["k"].astype(dt),
+                                          new_cache["v"].astype(dt),
+                                          mask=mask)
+        y = y.reshape(n, t, d)
+        return self._project_out(params, y, dt), new_cache
+
+    def apply(self, params, state, input, *, training=False, rng=None,
+              cache=None, pos=None):
+        if cache is not None:
+            # decode mode returns (output, updated_cache) -- the cache
+            # takes the state slot (these eval-mode paths carry no
+            # module state); see _apply_cached
+            return self._apply_cached(params, input, cache, pos)
+        n, t, d = input.shape
+        dt = input.dtype
+        qkv = self._project_qkv(params, input)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (n, t, self.num_heads, self.head_dim)
         if self.seq_axis_name is not None and self.seq_mode == "ulysses":
@@ -146,15 +273,7 @@ class MultiHeadAttention(Module):
         else:
             y = dot_product_attention(q.reshape(shape), k.reshape(shape),
                                       v.reshape(shape), causal=self.causal)
-        y = y.reshape(n, t, d)
-        if "out_weight_q" in params:
-            from bigdl_tpu.nn.quantized import int8_matmul
-
-            y = (int8_matmul(y, params["out_weight_q"], params["out_scale"])
-                 + params["out_bias"]).astype(dt)
-        else:
-            y = y @ params["out_weight"].astype(dt).T \
-                + params["out_bias"].astype(dt)
+        y = self._project_out(params, y.reshape(n, t, d), dt)
         if training and self.dropout > 0 and rng is not None:
             keep = 1.0 - self.dropout
             y = jnp.where(jax.random.bernoulli(rng, keep, y.shape),
@@ -193,7 +312,24 @@ class TransformerBlock(Container):
         return [("ln1", self.ln1), ("attn", self.attn), ("ln2", self.ln2),
                 ("fc1", self.fc1), ("fc2", self.fc2)]
 
-    def apply(self, params, state, input, *, training=False, rng=None):
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """This block's K/V decode cache (the attention sublayer's)."""
+        return self.attn.init_cache(batch, max_len, dtype)
+
+    def apply(self, params, state, input, *, training=False, rng=None,
+              cache=None, pos=None):
+        if cache is not None:
+            # cached prefill/decode: eval-mode block, returns
+            # (out, new_cache) like MultiHeadAttention's cached apply
+            h, _ = self.ln1.apply(params["ln1"], (), input)
+            a, new_cache = self.attn.apply(params["attn"], (), h,
+                                           cache=cache, pos=pos)
+            x = input + a
+            h, _ = self.ln2.apply(params["ln2"], (), x)
+            h, _ = self.fc1.apply(params["fc1"], (), h)
+            h = jax.nn.gelu(h)
+            h, _ = self.fc2.apply(params["fc2"], (), h)
+            return x + h, new_cache
         h, _ = self.ln1.apply(params["ln1"], (), input)
         a, _ = self.attn.apply(params["attn"], (), h, training=training,
                                rng=child_rng(rng, 0))
@@ -295,7 +431,76 @@ class TransformerLM(Container):
                          for i, b in enumerate(self.blocks))
         return items
 
-    def apply(self, params, state, input, *, training=False, rng=None):
+    # ----- KV-cache decode mode -------------------------------------------- #
+    def init_cache(self, batch: int, max_len: Optional[int] = None,
+                   dtype=jnp.float32):
+        """Per-layer K/V decode buffers in THIS model's param layout:
+        unrolled models return ``{"block{i}": {"k", "v"}}``;
+        ``scan_layers`` models return ``{"blocks": {"k", "v"}}`` with
+        every leaf gaining a leading layer axis (``stack_layer_trees``,
+        the same convention the params use), so the decode loop scans
+        layers exactly like the forward does.  ``max_len`` caps how far
+        a sequence can ever grow (prompt + generated tokens) and is the
+        fixed time extent of every buffer; it defaults to the model's
+        ``max_len`` but serving usually passes something smaller --
+        cache bytes scale linearly with it."""
+        max_len = self.max_len if max_len is None else int(max_len)
+        if max_len > self.max_len:
+            raise ValueError(
+                f"cache max_len {max_len} exceeds the model's positional "
+                f"table ({self.max_len})")
+        per_block = [b.init_cache(batch, max_len, dtype)
+                     for b in self.blocks]
+        if self.scan_layers:
+            return {"blocks": stack_layer_trees(per_block)}
+        return {f"block{i}": c for i, c in enumerate(per_block)}
+
+    def _apply_cached(self, params, input, cache, pos):
+        """Prefill (``pos=None``: whole padded prompt, K/V written at
+        ``[0, T)``) or single-token decode (``pos`` (N,): one token per
+        row at per-row positions).  Returns ``(logits, new_cache)``.
+        Ragged prompts ride the prefill contract: pad the prompt batch
+        to one length, prefill once, and read each row's logits at its
+        TRUE ``length - 1`` -- padding positions hold garbage K/V that
+        the decode frontier mask keeps invisible until the step that
+        overwrites them (see MultiHeadAttention._apply_cached)."""
+        if self.seq_axis_name is not None:
+            raise ValueError("cached decode runs on a replicated model; "
+                             "sequence-parallel serving is not a thing "
+                             "(shard the BATCH axis instead)")
+        t = input.shape[1]
+        x = jnp.take(params["wte"], input.astype(jnp.int32), axis=0)
+        if pos is None:
+            x = x + params["wpe"][:t][None]
+        else:
+            pos = jnp.asarray(pos, jnp.int32)
+            # jnp.take clips out-of-range rows; an inactive slot's
+            # clamped position writes only into its own dead cache row
+            x = x + jnp.take(params["wpe"], pos, axis=0)[:, None, :]
+        if self.scan_layers:
+            inner = self.blocks[0]
+
+            def body(h, sliced):
+                p, c = sliced
+                y, nc = inner.apply(p, (), h, cache=c, pos=pos)
+                return y, nc
+
+            x, stacked = jax.lax.scan(
+                body, x, (params["blocks"], cache["blocks"]))
+            new_cache = {"blocks": stacked}
+        else:
+            new_cache = {}
+            for i, b in enumerate(self.blocks):
+                x, nc = b.apply(params[f"block{i}"], (), x,
+                                cache=cache[f"block{i}"], pos=pos)
+                new_cache[f"block{i}"] = nc
+        x, _ = self.ln_f.apply(params["ln_f"], (), x)
+        return x @ params["head"].astype(x.dtype).T, new_cache
+
+    def apply(self, params, state, input, *, training=False, rng=None,
+              cache=None, pos=None):
+        if cache is not None:
+            return self._apply_cached(params, input, cache, pos)
         t = input.shape[1]
         x = jnp.take(params["wte"], input.astype(jnp.int32), axis=0)
         if self.seq_axis_name is not None:

@@ -515,6 +515,29 @@ class MetricsRegistry:
                              "end-to-end request latency")
         for v in event.get("request_latency_s") or []:
             lat.observe(v)
+        # generation ticks (serving/generation.py) additionally stamp
+        # tick_kind ("prefill"/"decode"), tokens emitted and slot
+        # occupancy -- the live tokens/s + slot-utilization signals
+        if event.get("tokens"):
+            self.counter(f"{p}_serving_tokens_total",
+                         "generated tokens, by tick kind",
+                         labelnames=("kind",)) \
+                .inc(event["tokens"],
+                     kind=str(event.get("tick_kind") or "decode"))
+        if event.get("slots_total"):
+            self.gauge(f"{p}_serving_slot_fill",
+                       "occupied decode slots / slot pool size") \
+                .set((event.get("slots_active") or 0)
+                     / event["slots_total"])
+        if event.get("generate_latency_s"):
+            glat = self.histogram(
+                f"{p}_serving_generate_latency_seconds",
+                "end-to-end generation latency (submit -> last token); "
+                "its own family so second-scale generations never "
+                "pollute the predict latency series an SLO is tuned "
+                "against")
+            for v in event["generate_latency_s"]:
+                glat.observe(v)
         if event.get("compiles"):
             self.counter(f"{p}_serving_recompiles_total",
                          "XLA compiles inside serving ticks (nonzero "

@@ -92,3 +92,86 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
         interpret=interpret,
     )(qb, kb, vb)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                   scale: float):
+    """q_len=1 decode step: one query row against a K/V cache, masked
+    at the per-row frontier ``kpos <= pos``.  The k-loop's trip count is
+    DYNAMIC -- ``ceil((pos + 1) / block_k)`` -- so a short sequence in a
+    long cache reads only the blocks its mask can see: the O(1)-per-
+    token work the cache exists to buy, not O(max_len)."""
+    d = q_ref.shape[-1]
+    p = pos_ref[0]
+    q = q_ref[:].astype(jnp.float32) * scale          # (1, d)
+    nk = (p + block_k) // block_k                     # blocks with kpos <= p
+
+    def body(j, carry):
+        acc, m, l = carry
+        kblk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = q @ kblk.T                                # (1, block_k)
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = kpos <= p
+        s = jnp.where(mask, s, -jnp.inf)
+        bm = jnp.max(s, axis=1)
+        new_m = jnp.maximum(m, bm)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        pr = jnp.where(mask, jnp.exp(s - safe_m[:, None]), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * corr + jnp.sum(pr, axis=1)
+        acc = acc * corr[:, None] + pr @ vblk
+        return acc, new_m, l
+
+    acc0 = jnp.zeros((1, d), jnp.float32)
+    m0 = jnp.full((1,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((1,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def flash_decode_attention(q, k, v, pos, block_k: int = 128,
+                           interpret: bool = False):
+    """Single-token decode attention: ``q (B, 1, H, D)`` against a K/V
+    cache ``k, v (B, T, H, D)`` with per-row frontier positions ``pos
+    (B,)`` (row ``i`` attends ``kpos <= pos[i]``) -> ``(B, 1, H, D)``.
+
+    The decode-shaped sibling of :func:`flash_attention`: same online
+    softmax, but the grid is one program per (batch, head) row and the
+    query block is a single row, so the kernel streams cache blocks
+    through VMEM without ever materialising a score matrix.  T must be
+    a multiple of ``block_k`` (the cache allocator picks aligned
+    ``max_len``).  ``interpret=True`` runs on CPU for tests; the (1, d)
+    query tile is below the fp32 sublane minimum on real TPUs, where
+    Mosaic pads it -- fine for a memory-bound op.
+    """
+    b, t1, h, d = q.shape
+    tk = k.shape[1]
+    assert t1 == 1, f"decode takes one query token per row, got {t1}"
+    block_k = min(block_k, tk)
+    assert tk % block_k == 0, (tk, block_k)
+    scale = 1.0 / math.sqrt(d)
+
+    def to_bh(x, t):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qb, kb, vb = to_bh(q, 1), to_bh(k, tk), to_bh(v, tk)
+    # one frontier per (batch, head) program: repeat rows across heads
+    pos_bh = jnp.repeat(jnp.asarray(pos, jnp.int32), h).reshape(b * h, 1)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_k=block_k, scale=scale),
+        grid=(b * h,),
+        in_specs=[
+            pl.BlockSpec((None, 1), lambda bh: (bh, 0)),
+            pl.BlockSpec((None, 1, d), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda bh: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, 1, d), lambda bh: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        interpret=interpret,
+    )(pos_bh, qb, kb, vb)
+    return out.reshape(b, h, 1, d).transpose(0, 2, 1, 3)

@@ -14,6 +14,13 @@ reference's ``optim/PredictionService.scala`` instance pool).
   time, and an ``optim.validation.AccuracyDeltaGate`` rejects swaps
   whose fp32-vs-int8 divergence exceeds tolerance.
 
+- ``ServingEngine.generate()`` (``serving/generation.py``) --
+  autoregressive generation: KV-cache prefill/decode steps compiled
+  once (cache donated in place), a slot-based continuous-batching
+  scheduler (sequences join/leave a fixed decode-slot pool mid-flight
+  with zero steady-state recompiles), per-request
+  ``max_new_tokens``/EOS stops, and streaming ``GenerateFuture``
+  handles that yield tokens as decode ticks complete.
 - ``ModelRegistry`` / ``RolloutController`` (``serving/deploy.py``) --
   the train->serve loop closed: versioned hot-swap with shadow/canary
   staged exposure, atomic cutover, automatic rollback to the retained
@@ -44,10 +51,12 @@ from bigdl_tpu.serving.fleet import (CircuitBreaker, FleetOverloadedError,
                                      FleetUnavailableError,
                                      InProcessReplica, ServingFleet,
                                      SubprocessReplica)
+from bigdl_tpu.serving.generation import (GenerateFuture,
+                                          GenerateScheduler)
 
 __all__ = ["BucketLadder", "CircuitBreaker", "EngineDraining",
            "FleetOverloadedError", "FleetSupervisor",
-           "FleetUnavailableError", "InProcessReplica", "ModelRegistry",
-           "ModelVersion", "RolloutController", "ServeFuture",
-           "ServingEngine", "ServingFleet", "SubprocessReplica",
-           "snapshot_digest"]
+           "FleetUnavailableError", "GenerateFuture", "GenerateScheduler",
+           "InProcessReplica", "ModelRegistry", "ModelVersion",
+           "RolloutController", "ServeFuture", "ServingEngine",
+           "ServingFleet", "SubprocessReplica", "snapshot_digest"]

@@ -328,7 +328,10 @@ class ServingEngine:
                  compute_dtype=None, mesh=None, axis: str = "data",
                  round_robin: bool = False, telemetry=None,
                  max_executables: Optional[int] = None,
-                 quantize=False, accuracy_gate=None):
+                 quantize=False, accuracy_gate=None,
+                 decode_slots: Optional[int] = None,
+                 decode_max_len: Optional[int] = None,
+                 prompt_ladder: Optional[BucketLadder] = None):
         if not model.is_built():
             raise ValueError("build the model (or train it) before serving")
         if max_batch_size < 1:
@@ -435,6 +438,24 @@ class ServingEngine:
         self._shadow = None           # (fn, fraction)
         self._shadow_acc = 0.0
         self._version_info = None     # {"version", "digest"} when deployed
+        # autoregressive generation (serving/generation.py): a slot
+        # pool this size decodes with KV caches behind ``generate()``.
+        # None = AUTO (8 slots when the served model has a decode mode,
+        # off otherwise); 0 disables explicitly.  The scheduler is
+        # built lazily on first use, and in AUTO mode precompile()
+        # leaves generation alone until a generate() arrives -- an
+        # engine that only ever predicts must not pay the generation
+        # cache allocation + prefill-ladder warmup for a verb nobody
+        # calls.  Pass decode_slots explicitly to warm generation in
+        # precompile() (the zero-steady-state-recompile contract).
+        self._decode_explicit = decode_slots is not None
+        if decode_slots is None:
+            decode_slots = 8 if hasattr(model, "init_cache") else 0
+        self.decode_slots = int(decode_slots)
+        self.decode_max_len = decode_max_len
+        self._prompt_ladder = prompt_ladder
+        self._gen = None
+        self._gen_lock = threading.Lock()
         if self._gate is not None:
             # the INITIAL quantization must clear the same bar a later
             # hot-swap would: a model this quantizer damages beyond
@@ -540,7 +561,14 @@ class ServingEngine:
         cancelled entry left in ``_pending`` would keep counting toward
         capacity / tick fill / the oldest-request deadline until a tick
         drained it, blocking the very retry the caller is about to
-        make."""
+        make.  A ``GenerateFuture`` routes to ITS queue -- the
+        generation scheduler's, not the predict deque."""
+        from bigdl_tpu.serving.generation import GenerateFuture
+
+        if isinstance(fut, GenerateFuture):
+            if self._gen is not None:
+                self._gen._abandon(fut)
+            return
         if not fut.cancel():         # already claimed by a tick (or done)
             return
         with self._lock:
@@ -549,6 +577,72 @@ class ServingEngine:
                     self._pending.remove(entry)
                     self._not_full.notify()
                     break
+
+    # ----- autoregressive generation (serving/generation.py) ----------------- #
+    def _generation(self):
+        """The lazily-built generation scheduler (slot pool + compiled
+        prefill/decode steps).  Serves the SAME model the eval path
+        serves: on a quantized engine that is the int8 twin, so
+        generation rides the identical ``AccuracyDeltaGate``-guarded
+        weight set every refresh_params swap validates."""
+        if self._gen is None:
+            with self._gen_lock:
+                if self._gen is None:
+                    if self.decode_slots < 1:
+                        raise ValueError(
+                            "generation is disabled on this engine "
+                            "(decode_slots=0); construct with "
+                            "decode_slots >= 1")
+                    from bigdl_tpu.serving.generation import \
+                        GenerateScheduler
+
+                    serve_model = self._qmodel if self._quantized \
+                        else self.model
+                    self._gen = GenerateScheduler(
+                        serve_model, slots=self.decode_slots,
+                        max_len=self.decode_max_len,
+                        prompt_ladder=self._prompt_ladder,
+                        queue_capacity=self.queue_capacity,
+                        telemetry=self.telemetry,
+                        admission_check=self._gen_admission_check)
+        return self._gen
+
+    def _gen_admission_check(self):
+        """Runs under the SCHEDULER's lock right before a generation
+        enqueues: the engine-side lifecycle re-check that closes the
+        race where drain() observes an idle scheduler between
+        generate()'s early check and the actual enqueue."""
+        if not self._running:
+            raise RuntimeError("ServingEngine is closed")
+        if self._draining:
+            raise EngineDraining(
+                "ServingEngine began draining while this generate "
+                "was being admitted; request not accepted")
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None):
+        """Autoregressive generation: enqueue a prompt (1-D token ids)
+        onto the continuous-batching decode scheduler; returns a
+        streaming ``GenerateFuture`` (``.stream()`` yields tokens as
+        decode ticks complete, ``.result()`` returns the full list).
+        Generation stops at ``eos_id`` (included in the output) or
+        after ``max_new_tokens``.  Decoding is greedy.
+
+        Admission honors the engine's lifecycle exactly like
+        ``submit``: a draining engine raises ``EngineDraining``, a
+        closed one ``RuntimeError``; ``timeout`` bounds the wait for a
+        queue slot."""
+        with self._lock:
+            if not self._running:
+                raise RuntimeError("ServingEngine is closed")
+            if self._draining:
+                raise EngineDraining(
+                    "ServingEngine is draining (admission closed until "
+                    "undrain()); in-flight generations still complete")
+        return self._generation().submit(prompt,
+                                         max_new_tokens=max_new_tokens,
+                                         eos_id=eos_id, timeout=timeout)
 
     def predict_at(self, feature, bucket: int):
         """UNBATCHED reference predict: this one request, padded to
@@ -626,10 +720,20 @@ class ServingEngine:
                     f"{self._backend.align} (sharded predict splits the "
                     f"batch axis evenly)")
         self._fit_bound(len(buckets))
+        # generation's shape set (decode step + prefill rungs) warms
+        # alongside the eval ladder, so one precompile() closes BOTH
+        # executable sets before traffic; AUTO-mode engines warm it
+        # only once generation is actually in use (see __init__)
+        gen_compiles = 0
+        if self.decode_slots > 0 \
+                and (self._decode_explicit or self._gen is not None) \
+                and hasattr(self._qmodel if self._quantized
+                            else self.model, "init_cache"):
+            gen_compiles = self._generation().precompile()
         if self.length_ladder is None:
-            return self._backend.precompile(spec, buckets)
+            return self._backend.precompile(spec, buckets) + gen_compiles
 
-        total = 0
+        total = gen_compiles
         for rung in self.length_ladder:
             # the same walker pad_length_axis uses under traffic, on
             # sample-rank spec leaves (batched=False): identical leaf
@@ -891,6 +995,8 @@ class ServingEngine:
                 "model_bytes": self.serving_model_bytes(),
                 "backend": self._backend.kind,
                 "replicas": self._backend.replicas}
+        if self.decode_slots > 0:
+            info["decode_slots"] = self.decode_slots
         if self._version_info is not None:
             # WHICH checkpoint this replica serves: version id + the
             # snapshot's manifest digest (set_serving_version)
@@ -1345,6 +1451,13 @@ class ServingEngine:
                 if remaining is not None and remaining <= 0:
                     return False
                 self._idle.wait(timeout=remaining)
+        if self._gen is not None:
+            # in-flight generations are accepted work too: the no-
+            # accepted-future-ever-dropped contract covers them, so the
+            # drain waits for every live sequence to finish decoding
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.perf_counter())
+            return self._gen.drain(timeout=remaining)
         return True
 
     def undrain(self):
@@ -1361,13 +1474,16 @@ class ServingEngine:
         the in-flight tick, lifetime ticks/requests served, and the
         drain flag."""
         with self._lock:
-            return {"pending": len(self._pending),
-                    "in_tick": self._in_tick,
-                    "draining": self._draining,
-                    "running": self._running,
-                    "ticks": self._tick,
-                    "served": self._served,
-                    "queue_capacity": self.queue_capacity}
+            stats = {"pending": len(self._pending),
+                     "in_tick": self._in_tick,
+                     "draining": self._draining,
+                     "running": self._running,
+                     "ticks": self._tick,
+                     "served": self._served,
+                     "queue_capacity": self.queue_capacity}
+        if self._gen is not None:
+            stats["generate"] = self._gen.stats()
+        return stats
 
     def close(self, timeout: Optional[float] = 10.0):
         """Stop accepting requests, drain the queue, join the
@@ -1377,6 +1493,8 @@ class ServingEngine:
             self._not_empty.notify_all()
             self._not_full.notify_all()
         self._dispatcher.join(timeout)
+        if self._gen is not None:
+            self._gen.close(timeout)
 
     def __enter__(self):
         return self

@@ -22,7 +22,12 @@ the serving tier:
   loser is cancelled/abandoned) -- the classic tail-latency move.
   Admission is bounded: past ``admission_limit`` in-flight requests the
   fleet sheds with a fast ``FleetOverloadedError`` (the 503) instead of
-  collapsing under a backlog it can never drain.
+  collapsing under a backlog it can never drain.  ``generate()`` routes
+  autoregressive generation requests through the same admission/
+  routing/breaker/retry machinery onto the replicas' decode-slot
+  schedulers -- with hedging OFF by design (see the method docstring:
+  a multi-token request holds a decode slot for its lifetime, so
+  duplication double-books the scarcest serving resource).
 - Replicas come in two kinds behind one verb set: ``InProcessReplica``
   (an engine in this process) and ``SubprocessReplica`` (a
   ``serving/worker.py`` process spoken to over the length-prefixed
@@ -243,6 +248,14 @@ class InProcessReplica(Replica):
         t = admit_timeout if admit_timeout is not None else timeout
         return self.engine.submit(feature, timeout=t)
 
+    def submit_generate(self, req, timeout=None, admit_timeout=None):
+        # req: {"prompt", "max_new_tokens", "eos_id"}; returns the
+        # engine's streaming GenerateFuture (result() -> token list)
+        t = admit_timeout if admit_timeout is not None else timeout
+        return self.engine.generate(
+            req["prompt"], max_new_tokens=req.get("max_new_tokens", 16),
+            eos_id=req.get("eos_id"), timeout=t)
+
     def abandon(self, fut):
         if hasattr(fut, "_t_submit"):          # a ServeFuture: free its
             self.engine._abandon(fut)          # queue slot too
@@ -358,6 +371,21 @@ class SubprocessReplica(Replica):
         return self._executor.submit(
             self._call, "predict", rpc_timeout=rpc, feature=feature,
             timeout=timeout)
+
+    def submit_generate(self, req, timeout=None, admit_timeout=None):
+        # one RPC per whole generation: the worker's engine streams
+        # internally, the socket answers with the finished token list
+        if self._executor is None:
+            raise RuntimeError("SubprocessReplica needs the fleet's "
+                               "executor (register it with a "
+                               "ServingFleet first)")
+        rpc = self.request_timeout_s if timeout is None \
+            else float(timeout) + 5.0
+        return self._executor.submit(
+            self._call, "generate", rpc_timeout=rpc,
+            prompt=[int(t) for t in req["prompt"]],
+            max_new_tokens=int(req.get("max_new_tokens", 16)),
+            eos_id=req.get("eos_id"), timeout=timeout)
 
     def abandon(self, fut):
         fut.cancel()          # a running RPC finishes on the worker and
@@ -599,6 +627,32 @@ class ServingFleet:
         hedge) -> result.  Raises ``FleetOverloadedError`` on shed,
         ``FleetUnavailableError`` when the deadline/retry budget runs
         out without a result."""
+        return self._request(feature, timeout, op="submit",
+                             hedge_ok=True)
+
+    def generate(self, prompt, max_new_tokens=16, eos_id=None,
+                 timeout=None):
+        """One GENERATION request through the fleet: same admission
+        window, least-loaded routing, breakers and deadline-budgeted
+        retries as ``predict`` (a failed/dead replica's request re-runs
+        from the prompt on a sibling -- greedy decoding makes the retry
+        idempotent), returning the generated token-id list.
+
+        Hedging is DISABLED for generation even when the fleet hedges
+        predicts, deliberately: a multi-token request occupies a decode
+        slot for its entire lifetime, so a hedge would double-book the
+        fleet's scarcest serving resource -- two replicas each burning
+        a slot for hundreds of ticks -- to shave one request's tail,
+        and the loser's work cannot be abandoned mid-stream the way a
+        single pending predict RPC can (the worker decodes the whole
+        sequence regardless).  Tail tolerance for generation comes from
+        retry-on-failure plus more slots, not duplication."""
+        return self._request(
+            {"prompt": prompt, "max_new_tokens": int(max_new_tokens),
+             "eos_id": eos_id},
+            timeout, op="submit_generate", hedge_ok=False)
+
+    def _request(self, feature, timeout, op, hedge_ok):
         if self._closed:
             raise RuntimeError("ServingFleet is closed")
         budget = self.default_timeout_s if timeout is None \
@@ -619,7 +673,7 @@ class ServingFleet:
                 f"requests in flight); shedding instead of queueing -- "
                 f"retry with backoff")
         try:
-            y = self._serve(feature, deadline)
+            y = self._serve(feature, deadline, op=op, hedge_ok=hedge_ok)
         except Exception:
             with self._lock:
                 self._counters["failed"] += 1
@@ -666,14 +720,14 @@ class ServingFleet:
         return isinstance(err, EngineDraining) or \
             getattr(err, "error_type", None) == "EngineDraining"
 
-    def _launch(self, rep, feature, remaining):
+    def _launch(self, rep, feature, remaining, op="submit"):
         with self._lock:
             rep.inflight += 1
         if self._m is not None:
             self._m["inflight"].set(rep.inflight, replica=str(rep.rid))
         t0 = self.clock()
         try:
-            fut = rep.submit(
+            fut = getattr(rep, op)(
                 feature, timeout=remaining,
                 admit_timeout=min(remaining, self.submit_timeout_s))
         except Exception as e:
@@ -686,10 +740,10 @@ class ServingFleet:
                 rep.breaker.record_failure()
             raise
         fut.add_done_callback(
-            lambda f, _r=rep, _t=t0: self._finish(_r, f, _t))
+            lambda f, _r=rep, _t=t0, _op=op: self._finish(_r, f, _t, _op))
         return fut
 
-    def _finish(self, rep, fut, t0):
+    def _finish(self, rep, fut, t0, op="submit"):
         with self._lock:
             rep.inflight = max(0, rep.inflight - 1)
         if self._m is not None:
@@ -705,7 +759,12 @@ class ServingFleet:
         if err is None:
             rep.served += 1
             rep.breaker.record_success()
-            self._note_latency(self.clock() - t0)
+            if op == "submit":
+                # ONLY predict latencies calibrate the hedge reservoir:
+                # a multi-token generation is seconds where a predict is
+                # milliseconds, and one mixed p99 would push the predict
+                # hedge trigger past every request deadline
+                self._note_latency(self.clock() - t0)
         elif self._drain_refusal(err):
             rep.breaker.record_cancel()
         else:
@@ -736,7 +795,7 @@ class ServingFleet:
         if b > 0:
             self.sleep(b)
 
-    def _serve(self, feature, deadline):
+    def _serve(self, feature, deadline, op="submit", hedge_ok=True):
         from concurrent.futures import FIRST_COMPLETED
         from concurrent.futures import wait as future_wait
 
@@ -769,7 +828,7 @@ class ServingFleet:
                 continue
             futs = {}
             try:
-                fut = self._launch(rep, feature, remaining)
+                fut = self._launch(rep, feature, remaining, op=op)
                 futs[fut] = rep
             except Exception as e:
                 last_err = e
@@ -783,8 +842,9 @@ class ServingFleet:
             hedged = False
             primary = fut
             # ONE percentile derivation per attempt, not one per wait
-            # iteration (sorting the reservoir on the hot path)
-            delay = self._hedge_delay()
+            # iteration (sorting the reservoir on the hot path);
+            # hedge_ok=False (generation) never arms the hedge timer
+            delay = self._hedge_delay() if hedge_ok else None
             while futs:
                 remaining = deadline - self.clock()
                 if remaining <= 0:
@@ -827,7 +887,7 @@ class ServingFleet:
                     if second is not None:
                         try:
                             f2 = self._launch(second, feature,
-                                              remaining)
+                                              remaining, op=op)
                             futs[f2] = second
                             self._count("hedges")
                         except Exception as e:

@@ -1,0 +1,634 @@
+"""Autoregressive generation serving: compiled KV-cache decode steps
+and a slot-based continuous-batching scheduler.
+
+The serving stack could only run FULL forwards: serving a transformer
+token-by-token meant re-running the O(L^2) forward over the whole
+prefix for every new token.  This module restructures the computation
+so the compiler sees O(1) incremental work per token (the TVM lesson,
+arxiv 1802.04799): the model layer's KV cache (``TransformerLM
+.init_cache`` / ``apply(cache=, pos=)``, nn/attention.py) turns a
+decode step into one token's projections plus a masked attention read
+over fixed-shape buffers, and this module turns THAT into a serving
+loop with a closed executable set:
+
+- ``generate_steps(model)`` -- the jitted (prefill, decode) pair,
+  compiled once per model and cached on the instance like
+  ``optim.validation.compiled_eval_step``.  Both steps DONATE the slot
+  cache, so XLA updates the K/V buffers in place instead of copying
+  ``slots x max_len`` of cache every tick.
+- ``GenerateScheduler`` -- continuous batching over a fixed pool of
+  decode slots: prefill ticks admit waiting prompts into free slots
+  (batch-bucketed and prompt-length-bucketed through the same
+  ``BucketLadder`` machinery the eval path uses, so the compiled-shape
+  set is closed and warmable); decode ticks advance EVERY occupied
+  slot one token in a single fixed-shape step.  Sequences join and
+  leave slots mid-flight without recompiling anything: the cache
+  batch axis never changes, and a vacated row is simply garbage the
+  per-row frontier mask keeps invisible until the next occupant's
+  prefill overwrites it.  Row ``slots`` (one past the pool) is a TRASH
+  slot: prefill padding rows scatter their K/V there, so a
+  partially-filled prefill bucket can never corrupt a live sequence.
+- ``GenerateFuture`` -- the streaming per-request handle: tokens are
+  pushed as ticks complete (``stream()`` yields them live);
+  ``result()`` waits for EOS / ``max_new_tokens`` and returns the full
+  generated list.
+
+Every tick lands as a ``kind:"inference"`` telemetry event stamped
+with ``tick_kind`` ("prefill"/"decode"), ``tokens`` emitted, and slot
+occupancy -- the fields behind ``bigdl_serving_tokens_total`` and the
+slot-utilization gauge (docs/observability.md, "Serving telemetry").
+Decoding is greedy (argmax in-jit, so only token ids cross the
+host boundary each tick); sampling policies can layer on later
+without touching the scheduler.
+"""
+
+import collections
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future, TimeoutError as FutureTimeoutError
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.observability.spans import span
+from bigdl_tpu.serving.buckets import BucketLadder
+
+log = logging.getLogger("bigdl_tpu.serving")
+
+
+def _scatter_rows(slot_leaf, frag_leaf, slot_ids, t):
+    """Write a prefill fragment's rows into the slot cache at
+    ``slot_ids``, first ``t`` positions.  K/V leaves are ``(batch,
+    max_len, heads, head_dim)`` -- the batch axis sits at ``ndim - 4``,
+    which also lands on the right axis for the scan-stacked layout's
+    extra leading layer dim."""
+    if slot_leaf.ndim == 4:
+        return slot_leaf.at[slot_ids, :t].set(frag_leaf)
+    return slot_leaf.at[:, slot_ids, :t].set(frag_leaf)
+
+
+def generate_steps(model, cache_dtype=jnp.float32):
+    """The jitted ``(prefill, decode)`` pair for ``model``, compiled
+    once per (model, cache dtype) and cached on the instance (same
+    lifetime story as ``compiled_eval_step``: dropping the model drops
+    its executables).
+
+    - ``prefill(params, slot_cache, tokens (B, T), lengths (B,),
+      slot_ids (B,)) -> (first_tokens (B,), new_slot_cache)``: one
+      ragged-prompt prefill -- runs the cached forward over the padded
+      prompt batch, scatters the K/V fragment into the slot cache rows
+      named by ``slot_ids``, and reads each row's first generated
+      token at its TRUE ``length - 1`` (padding rows point at the
+      trash slot and are discarded).
+    - ``decode(params, slot_cache, tokens (S,), pos (S,)) ->
+      (next_tokens (S,), new_slot_cache)``: one fixed-shape step over
+      the whole pool.
+
+    Both donate the slot cache (argument 1): steady-state decode moves
+    one token's activations, not the cache.
+    """
+    cache = model.__dict__.setdefault("_compiled_generate_steps", {})
+    key = np.dtype(cache_dtype).name
+    fns = cache.get(key)
+    if fns is not None:
+        return fns
+
+    def prefill(params, slot_cache, tokens, lengths, slot_ids):
+        n, t = tokens.shape
+        local = model.init_cache(n, t, cache_dtype)
+        logits, frag = model.apply(params, (), tokens, cache=local)
+        idx = jnp.clip(lengths.astype(jnp.int32) - 1, 0, t - 1)
+        row = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1)[:, 0]
+        first = jnp.argmax(row, axis=-1).astype(jnp.int32)
+        new = jax.tree.map(
+            lambda sc, fr: _scatter_rows(sc, fr, slot_ids, t),
+            slot_cache, frag)
+        return first, new
+
+    def decode(params, slot_cache, tokens, pos):
+        logits, new = model.apply(params, (), tokens[:, None],
+                                  cache=slot_cache, pos=pos)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return nxt, new
+
+    fns = (jax.jit(prefill, donate_argnums=(1,)),
+           jax.jit(decode, donate_argnums=(1,)))
+    cache[key] = fns
+    return fns
+
+
+class GenerateFuture(Future):
+    """Per-request generation handle.  ``result(timeout)`` returns the
+    full generated token list (EOS included when hit); ``stream()``
+    yields tokens LIVE as decode ticks complete.  Once finished,
+    ``finish_reason`` ("eos" / "length"), ``prompt_len`` and the
+    end-to-end ``latency_s`` are set."""
+
+    def __init__(self, prompt_len: int, max_new_tokens: int,
+                 eos_id: Optional[int]):
+        super().__init__()
+        self.prompt_len = int(prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.finish_reason: Optional[str] = None
+        self.latency_s: Optional[float] = None
+        self._t_submit = time.perf_counter()
+        self._stream: "queue.Queue" = queue.Queue()
+        #: set by GenerateScheduler._abandon on a CLAIMED request: the
+        #: dispatcher evicts the sequence at the next tick boundary
+        self._abandoned = False
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield generated token ids as they are produced.  ``timeout``
+        bounds the WHOLE stream; a tick that errors re-raises here."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        while True:
+            remaining = None if deadline is None \
+                else deadline - time.perf_counter()
+            if remaining is not None and remaining <= 0:
+                raise FutureTimeoutError(
+                    f"token stream timed out after {timeout}s")
+            try:
+                item = self._stream.get(timeout=remaining)
+            except queue.Empty:
+                raise FutureTimeoutError(
+                    f"token stream timed out after {timeout}s") from None
+            if item is None:                      # completion sentinel
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+
+class _Slot:
+    """One occupied decode slot: the request's future, its token tally
+    and the cache frontier (``pos`` = where the NEXT token's K/V will
+    be written; ``last`` = the token that decode step feeds in)."""
+
+    __slots__ = ("fut", "tokens", "last", "pos")
+
+    def __init__(self, fut, first_token, pos):
+        self.fut = fut
+        self.tokens = [first_token]
+        self.last = first_token
+        self.pos = pos
+
+
+class GenerateScheduler:
+    """Slot-based continuous batching over one model's KV cache.
+
+    ``slots`` decode slots plus one trash row share a single
+    fixed-shape cache (``model.init_cache(slots + 1, max_len)``).  The
+    dispatcher thread alternates: a PREFILL tick admits up to
+    ``len(free slots)`` waiting prompts (batch padded to the slot
+    ladder, prompts padded to the prompt-length ladder), a DECODE tick
+    advances every occupied slot one token.  Finished sequences free
+    their slot immediately -- the next prefill reuses it without any
+    recompile, because nothing about the compiled shapes depends on
+    WHICH slots are live (the acceptance contract: zero new compiles
+    after ``precompile()`` across a mixed-length closed-loop workload,
+    pinned in tests/test_decode.py).
+
+    ``params_fn`` is read once per tick, so an engine-level
+    ``refresh_params`` hot-swap takes effect on the next tick; a
+    sequence mid-flight finishes with its earlier tokens' K/V from the
+    old weights (documented in docs/performance.md -- the alternative,
+    draining generation for every swap, is a worse availability
+    trade).
+    """
+
+    def __init__(self, model, slots: int = 8, max_len: Optional[int] = None,
+                 prompt_ladder: Optional[BucketLadder] = None,
+                 queue_capacity: int = 1024, cache_dtype=jnp.float32,
+                 telemetry=None, params_fn=None, admission_check=None,
+                 name: str = "generate"):
+        if not hasattr(model, "init_cache"):
+            raise TypeError(
+                f"{type(model).__name__} has no init_cache(): generation "
+                f"needs a KV-cache decode mode (TransformerLM has one)")
+        if slots < 1:
+            raise ValueError(f"need at least 1 decode slot, got {slots}")
+        self.model = model
+        self.slots = int(slots)
+        model_max = getattr(model, "max_len", None)
+        self.max_len = int(model_max if max_len is None
+                           else min(max_len, model_max or max_len))
+        self.queue_capacity = int(queue_capacity)
+        self.telemetry = telemetry
+        #: optional callable run under THIS scheduler's lock right
+        #: before a request enqueues (raising refuses admission): the
+        #: owning engine injects its draining/closed check here, so an
+        #: engine.drain() that observed an idle scheduler can never
+        #: race a generate() that already passed the engine-side check
+        self._admission_check = admission_check
+        self._params = params_fn or (lambda: model.parameters()[0])
+        # prompt lengths round up this ladder (rung = the padded prefill
+        # T); a COPY like the engine's batch ladder, so growth stays ours
+        self.prompt_ladder = prompt_ladder.copy() \
+            if prompt_ladder is not None \
+            else BucketLadder(self.max_len,
+                              min_size=min(8, self.max_len))
+        if self.prompt_ladder.max > self.max_len:
+            raise ValueError(
+                f"prompt ladder's largest rung {self.prompt_ladder.max} "
+                f"exceeds the cache max_len {self.max_len}")
+        # admission counts round up this one (prefill batch rungs)
+        self.batch_ladder = BucketLadder(self.slots)
+        self._prefill_fn, self._decode_fn = generate_steps(model,
+                                                           cache_dtype)
+        #: slot pool + 1 trash row (prefill padding rows scatter there)
+        self._trash = self.slots
+        self._cache_dtype = cache_dtype
+        self._cache = model.init_cache(self.slots + 1, self.max_len,
+                                       cache_dtype)
+        self._slots = [None] * self.slots
+        self._free = collections.deque(range(self.slots))
+        self._pending = collections.deque()
+        # requests popped off the queue but not yet slotted (or failed):
+        # the engine predict path's _in_tick equivalent, so drain() can
+        # wait for TRUE quiescence instead of missing a request that is
+        # mid-prefill between queue-pop and slot assignment
+        self._in_flight = 0
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
+        self._running = True
+        self._tick = 0
+        self._served = 0
+        self._tokens_out = 0
+        self._dispatcher = threading.Thread(
+            target=self._loop, name=f"bigdl-serving-{name}", daemon=True)
+        self._dispatcher.start()
+
+    # ----- request surface -------------------------------------------------- #
+    def submit(self, prompt, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               timeout: Optional[float] = None) -> GenerateFuture:
+        """Enqueue one prompt (1-D int token ids); returns the
+        streaming future.  Blocks when ``queue_capacity`` requests are
+        pending (``timeout`` bounds the wait, like engine.submit)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the cache max_len "
+                f"{self.max_len}; raise decode_max_len or trim the "
+                f"request")
+        fut = GenerateFuture(prompt.size, max_new_tokens, eos_id)
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        with self._lock:
+            if not self._running:
+                raise RuntimeError("generation scheduler is closed")
+            while self._running and \
+                    len(self._pending) >= self.queue_capacity:
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    raise FutureTimeoutError(
+                        f"generate submit timed out after {timeout}s: "
+                        f"queue full ({self.queue_capacity} pending)")
+                self._not_full.wait(timeout=remaining)
+            if not self._running:
+                raise RuntimeError("generation scheduler is closed")
+            if self._admission_check is not None:
+                self._admission_check()
+            self._pending.append((prompt, fut))
+            self._work.notify()
+        return fut
+
+    def _active(self):
+        return [(i, s) for i, s in enumerate(self._slots) if s is not None]
+
+    def stats(self):
+        with self._lock:
+            active = len(self._active())
+            return {"pending": len(self._pending),
+                    "in_flight": self._in_flight,
+                    "slots": self.slots, "slots_active": active,
+                    "ticks": self._tick, "served": self._served,
+                    "tokens": self._tokens_out,
+                    "running": self._running}
+
+    # ----- warmup ----------------------------------------------------------- #
+    def precompile(self) -> int:
+        """Compile the whole generation shape set before traffic: the
+        one decode executable plus every (admission rung x prompt-length
+        rung) prefill.  Warmup runs on DUMMY caches (zeros_like the
+        real one -- identical shapes key identical executables) so the
+        live cache is never donated away.  Returns backend compiles
+        performed."""
+        from bigdl_tpu.observability.watchdogs import backend_compile_count
+
+        params = self._params()
+        before = backend_compile_count()
+        dummy = jax.tree.map(jnp.zeros_like, self._cache)
+        s = self.slots + 1
+        nxt, dummy = self._decode_fn(params, dummy,
+                                     np.zeros((s,), np.int32),
+                                     np.zeros((s,), np.int32))
+        jax.block_until_ready(nxt)
+        for b in self.batch_ladder:
+            for t in self.prompt_ladder:
+                first, dummy = self._prefill_fn(
+                    params, dummy, np.zeros((int(b), int(t)), np.int32),
+                    np.ones((int(b),), np.int32),
+                    np.full((int(b),), self._trash, np.int32))
+                jax.block_until_ready(first)
+        return backend_compile_count() - before
+
+    # ----- dispatcher ------------------------------------------------------- #
+    def _loop(self):
+        while True:
+            with self._lock:
+                while self._running and not self._pending \
+                        and not self._active():
+                    self._idle.notify_all()
+                    self._work.wait()
+                if not self._running and not self._pending \
+                        and not self._active():
+                    self._idle.notify_all()
+                    return
+                admit = []
+                if self._pending and self._free:
+                    take = min(len(self._free), len(self._pending))
+                    admit = [self._pending.popleft() for _ in range(take)]
+                    self._in_flight += len(admit)
+                    self._not_full.notify_all()
+                qdepth = len(self._pending)
+            try:
+                # a cancelled future's prompt is dropped here (its slot
+                # was never assigned); claiming moves PENDING->RUNNING
+                # so result-setting can't race a caller's cancel().  A
+                # dropped future still gets the stream sentinel -- a
+                # consumer blocked in stream() must see the end, not
+                # hang on a request nobody will ever decode
+                claimed = []
+                for p, f in admit:
+                    if f.set_running_or_notify_cancel():
+                        claimed.append((p, f))
+                    else:
+                        f._stream.put(None)
+                self._sweep_abandoned()
+                if claimed:
+                    # by the time _run_prefill returns, every claimed
+                    # request is slotted (visible to _active) or failed
+                    self._run_prefill(claimed, qdepth)
+                if self._active():
+                    self._run_decode(qdepth)
+            except Exception:
+                # defensive: per-tick failures are already surfaced on
+                # the affected futures; this keeps an unexpected
+                # scheduler bug from silently killing the dispatcher
+                log.exception("generation scheduler tick failed")
+            finally:
+                with self._lock:
+                    self._in_flight -= len(admit)
+                    if not self._pending and not self._in_flight \
+                            and not self._active():
+                        self._idle.notify_all()
+
+    def _compiles(self):
+        if self.telemetry is None:
+            return None
+        from bigdl_tpu.observability.watchdogs import backend_compile_count
+
+        return backend_compile_count()
+
+    def _run_prefill(self, reqs, qdepth):
+        t0 = time.perf_counter()
+        execs_before = self._compiles()
+        n = len(reqs)
+        bucket = self.batch_ladder.bucket_for(n) or self.batch_ladder.add(n)
+        longest = max(int(p.size) for p, _ in reqs)
+        t_pad = self.prompt_ladder.bucket_for(longest) \
+            or self.prompt_ladder.add(longest)
+        tokens = np.zeros((bucket, t_pad), np.int32)
+        lengths = np.ones((bucket,), np.int32)
+        slot_ids = np.full((bucket,), self._trash, np.int32)
+        slots = []
+        with self._lock:
+            for i, (p, _f) in enumerate(reqs):
+                tokens[i, : p.size] = p
+                lengths[i] = p.size
+                slot_ids[i] = self._free.popleft()
+                slots.append(slot_ids[i])
+        try:
+            with span("generate_prefill", tick=self._tick, records=n):
+                first, self._cache = self._prefill_fn(
+                    self._params(), self._cache, tokens, lengths, slot_ids)
+                first = np.asarray(first)            # host sync
+        except Exception as e:
+            log.exception("prefill tick failed (%d prompts)", n)
+            self._tick_failed(e, [f for _p, f in reqs], slots)
+            return
+        done_lat = []
+        for i, (p, f) in enumerate(reqs):
+            slot = _Slot(f, int(first[i]), pos=int(p.size))
+            self._slots[slots[i]] = slot
+            self._deliver(slots[i], slot, done_lat)
+        self._tick += 1
+        self._record_tick("prefill", t0, records=n, tokens=n,
+                          bucket=int(bucket), prompt_bucket=int(t_pad),
+                          qdepth=qdepth, execs_before=execs_before,
+                          latencies=done_lat)
+
+    def _run_decode(self, qdepth):
+        t0 = time.perf_counter()
+        execs_before = self._compiles()
+        s = self.slots + 1
+        tokens = np.zeros((s,), np.int32)
+        pos = np.zeros((s,), np.int32)
+        active = self._active()
+        for i, slot in active:
+            tokens[i] = slot.last
+            pos[i] = slot.pos
+        try:
+            with span("generate_decode", tick=self._tick,
+                      records=len(active)):
+                nxt, self._cache = self._decode_fn(
+                    self._params(), self._cache, tokens, pos)
+                nxt = np.asarray(nxt)                # host sync
+        except Exception as e:
+            log.exception("decode tick failed (%d slots)", len(active))
+            self._tick_failed(e, [], [])
+            return
+        done_lat = []
+        for i, slot in active:
+            slot.pos += 1
+            slot.last = int(nxt[i])
+            slot.tokens.append(slot.last)
+            self._deliver(i, slot, done_lat)
+        self._tick += 1
+        self._record_tick("decode", t0, records=0, tokens=len(active),
+                          qdepth=qdepth, execs_before=execs_before,
+                          latencies=done_lat, slots_before=len(active))
+
+    def _tick_failed(self, e, futs, extra_free):
+        """A failed tick is a POOL loss, not just this tick's: both
+        compiled steps DONATE the slot cache, and jax invalidates
+        donated buffers at call time -- after a runtime failure
+        ``self._cache`` points at deleted arrays, so every live
+        sequence's K/V is gone with it.  Fail the tick's own futures
+        AND every still-active slot honestly, then reallocate a fresh
+        zero cache so the scheduler keeps serving NEW prompts instead
+        of raising 'Array has been deleted' forever."""
+        failed = list(futs)
+        freed = list(extra_free)
+        for i, slot in self._active():
+            failed.append(slot.fut)
+            self._slots[i] = None
+            freed.append(i)
+        with self._lock:
+            self._free.extend(freed)
+        self._cache = self.model.init_cache(self.slots + 1, self.max_len,
+                                            self._cache_dtype)
+        for f in failed:
+            if not f.done():
+                f._stream.put(e)
+                f._stream.put(None)
+                f.set_exception(e)
+
+    def _abandon(self, fut):
+        """Give up on a generation nobody will read (the sibling of
+        ``ServingEngine._abandon``).  Still pending: cancel, free its
+        queue slot now, end the stream.  Already CLAIMED: mark it for
+        eviction -- the dispatcher frees the decode slot at the next
+        tick boundary (``_sweep_abandoned``) instead of decoding the
+        rest of ``max_new_tokens`` into a slot nobody reads, which is
+        what lets a fleet deadline-retry on a sibling without
+        double-booking decode slots for the whole sequence."""
+        if not fut.cancel():         # already decoding (or done)
+            fut._abandoned = True
+            return
+        fut._stream.put(None)
+        with self._lock:
+            for entry in self._pending:
+                if entry[1] is fut:
+                    self._pending.remove(entry)
+                    self._not_full.notify()
+                    break
+
+    def _sweep_abandoned(self):
+        """Evict abandoned mid-flight sequences: free the slot and
+        resolve the future with the tokens decoded so far (a PARTIAL
+        result, ``finish_reason: "abandoned"`` -- a success as far as
+        replica health accounting goes: the replica worked, the caller
+        left)."""
+        for i, slot in self._active():
+            fut = slot.fut
+            if not fut._abandoned or fut.done():
+                continue
+            self._slots[i] = None
+            with self._lock:
+                self._free.append(i)
+            fut.finish_reason = "abandoned"
+            fut.latency_s = time.perf_counter() - fut._t_submit
+            fut._stream.put(None)
+            fut.set_result(list(slot.tokens))
+
+    def _deliver(self, index, slot, done_lat):
+        """Stream the slot's newest token; complete + free the slot on
+        EOS or the request's token budget."""
+        fut = slot.fut
+        tok = slot.tokens[-1]
+        fut._stream.put(tok)
+        reason = None
+        if fut.eos_id is not None and tok == fut.eos_id:
+            reason = "eos"
+        elif len(slot.tokens) >= fut.max_new_tokens:
+            reason = "length"
+        if reason is None:
+            return
+        self._slots[index] = None
+        with self._lock:
+            self._free.append(index)
+        fut.finish_reason = reason
+        fut.latency_s = time.perf_counter() - fut._t_submit
+        done_lat.append(fut.latency_s)
+        self._served += 1
+        fut._stream.put(None)
+        fut.set_result(list(slot.tokens))
+
+    def _record_tick(self, kind, t0, records, tokens, qdepth,
+                     execs_before, latencies, bucket=None,
+                     prompt_bucket=None, slots_before=None):
+        self._tokens_out += tokens
+        if self.telemetry is None:
+            return
+        try:
+            wall = time.perf_counter() - t0
+            active = slots_before if slots_before is not None \
+                else len(self._active())
+            event = dict(step=self._tick, wall_s=wall, tick_kind=kind,
+                         records=records, tokens=tokens,
+                         tokens_per_s=tokens / max(wall, 1e-9),
+                         slots_active=active, slots_total=self.slots,
+                         queue_depth=qdepth,
+                         queue_capacity=self.queue_capacity)
+            if bucket is not None:
+                event["bucket"] = bucket
+                event["batch_fill"] = records / bucket
+                event["pad_waste"] = (bucket - records) / bucket
+            if prompt_bucket is not None:
+                event["prompt_bucket"] = prompt_bucket
+            if latencies:
+                # a DISTINCT field from predict's request_latency_s: a
+                # multi-token generation is seconds where a predict is
+                # milliseconds, and one mixed series would burn any
+                # predict-tuned latency SLO (and its canary auto-
+                # rollback) on perfectly healthy generate traffic
+                event["generate_latency_s"] = [round(l, 6)
+                                               for l in latencies]
+            after = self._compiles()
+            if after is not None and after - execs_before > 0:
+                # nonzero after precompile() = a generation shape leak
+                event["compiles"] = after - execs_before
+            self.telemetry.record("inference", **event)
+        except Exception:
+            log.exception("generation telemetry record failed (tick %d)",
+                          self._tick)
+
+    # ----- lifecycle -------------------------------------------------------- #
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until no generation work is pending or mid-flight.
+        ADMISSION gating belongs to the owning engine (its ``drain()``
+        closes ``generate()`` before calling this); returns False when
+        ``timeout`` passes with sequences still decoding."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        with self._lock:
+            self._work.notify_all()
+            while self._pending or self._in_flight or self._active():
+                remaining = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._idle.wait(timeout=remaining)
+        return True
+
+    def close(self, timeout: Optional[float] = 10.0):
+        with self._lock:
+            self._running = False
+            self._work.notify_all()
+            self._not_full.notify_all()
+        self._dispatcher.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -16,6 +16,11 @@ responses ``{"ok": True, "result": ...}`` or ``{"ok": False, "error":
 ..., "error_type": ...}``.  Ops:
 
 - ``predict``  {feature, timeout}   -> output tree (numpy leaves)
+- ``generate`` {prompt, max_new_tokens, eos_id, timeout} -> generated
+  token-id list (the engine's continuous-batching decode slots;
+  tokens stream WITHIN the worker, the socket answers once the
+  sequence finishes -- per-token streaming over this one-shot
+  framing would need a protocol change)
 - ``probe``    {features, bucket}   -> sha256 digest of the unbatched
   reference outputs (``predict_at``) -- the bit-for-bit serving
   fingerprint the rejoin drill compares across processes
@@ -253,6 +258,32 @@ class ReplicaServer:
         y = self.engine.predict(req["feature"],
                                 timeout=req.get("timeout"))
         return jax.tree.map(np.asarray, y)
+
+    def _op_generate(self, req):
+        # ONE budget for the whole call (queue admission and the token
+        # wait draw it down together, like engine.predict), and a
+        # timed-out request is abandoned: still-pending, it leaves the
+        # queue now; already decoding, the scheduler evicts it at the
+        # next tick boundary -- either way no decode slot keeps
+        # streaming tokens nobody reads while a fleet retry re-runs
+        # the prompt on a sibling
+        import time
+        from concurrent.futures import TimeoutError as FutureTimeoutError
+
+        timeout = req.get("timeout")
+        t0 = time.perf_counter()
+        fut = self.engine.generate(
+            req["prompt"],
+            max_new_tokens=int(req.get("max_new_tokens", 16)),
+            eos_id=req.get("eos_id"), timeout=timeout)
+        remaining = None if timeout is None \
+            else max(0.0, timeout - (time.perf_counter() - t0))
+        try:
+            toks = fut.result(remaining)
+        except FutureTimeoutError:
+            self.engine._abandon(fut)    # frees its generation queue slot
+            raise
+        return [int(t) for t in toks]
 
     def _op_probe(self, req):
         feats = req.get("features")

@@ -1,0 +1,551 @@
+"""ISSUE 15: autoregressive generation serving -- KV-cache decode,
+prefill/decode split, continuous batching.
+
+Pins, per the acceptance criteria:
+
+- cached single-step decode logits match the full-context forward
+  within 1e-4 across BOTH block layouts (unrolled and scan-stacked),
+  with causal masking honest at every position (garbage beyond the
+  frontier is invisible);
+- ragged-prompt prefill: one padded prefill call serves rows of
+  different true lengths, each row's first token read at its own
+  ``length - 1``;
+- a full generate loop spanning multiple admission/prompt buckets
+  performs ZERO steady-state compiles after ``precompile()`` (the
+  ``compiles`` tick stamp stays absent and the backend counter is
+  flat);
+- int8: ``ServingEngine(quantize=True)`` serves generation through the
+  same ``AccuracyDeltaGate``, and fp32-vs-int8 top-1 agreement on
+  GENERATED tokens is pinned;
+- the ``generate`` verb works over the worker socket protocol and
+  through ``ServingFleet`` routing/retries, with hedging disabled for
+  multi-token requests.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.attention import TransformerLM
+from bigdl_tpu.observability.watchdogs import backend_compile_count
+from bigdl_tpu.serving import (BucketLadder, EngineDraining,
+                               InProcessReplica, ServingEngine,
+                               ServingFleet)
+
+VOCAB = 50
+
+
+def _lm(layers=2, max_len=48, scan=False, vocab=VOCAB, hidden=32, key=0):
+    m = TransformerLM(vocab_size=vocab, hidden_size=hidden, num_heads=4,
+                      num_layers=layers, max_len=max_len,
+                      scan_layers=scan)
+    # explicit key: the int8 agreement pins depend on THESE weights,
+    # not on whatever the global RNG stream happens to hold mid-run
+    m.build(jax.ShapeDtypeStruct((2, 16), jnp.int32),
+            rng=jax.random.PRNGKey(key))
+    return m
+
+
+def _greedy_reference(m, prompt, n_new):
+    """Greedy generation by FULL forward recompute -- the ground truth
+    the cached serving path must reproduce token for token."""
+    params = m.parameters()[0]
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n_new):
+        logits, _ = m.apply(params, (),
+                            jnp.asarray([toks], jnp.int32))
+        nxt = int(np.argmax(np.asarray(logits)[0, -1]))
+        toks.append(nxt)
+        out.append(nxt)
+    return out
+
+
+class TestDecodeAgreement:
+    """Cached decode is a restructuring of the forward, not an
+    approximation: logits agree with the full-context forward."""
+
+    @pytest.mark.parametrize("scan", [False, True])
+    def test_cached_steps_match_full_forward(self, scan):
+        m = _lm(layers=3, scan=scan)
+        params = m.parameters()[0]
+        toks = np.random.default_rng(0).integers(
+            0, VOCAB, size=(2, 16)).astype(np.int32)
+        full = np.asarray(m.apply(params, (), jnp.asarray(toks))[0])
+
+        cache = m.init_cache(2, 24)
+        pre, cache = m.apply(params, (), jnp.asarray(toks[:, :8]),
+                             cache=cache)
+        # prefill logits ARE full-forward logits (identical math)
+        assert np.max(np.abs(np.asarray(pre) - full[:, :8])) < 1e-4
+        for t in range(8, 16):
+            pos = jnp.full((2,), t, jnp.int32)
+            lg, cache = m.apply(params, (), jnp.asarray(toks[:, t:t + 1]),
+                                cache=cache, pos=pos)
+            # the cached single-step logits at EVERY position
+            assert np.max(np.abs(np.asarray(lg)[:, 0] - full[:, t])) \
+                < 1e-4, f"position {t} diverged"
+
+    @pytest.mark.parametrize("scan", [False, True])
+    def test_layouts_agree_with_each_other(self, scan):
+        """The two cache layouts decode the same stream from the same
+        per-block weights (stack/unstack round trip)."""
+        from bigdl_tpu.nn.attention import stack_block_params
+
+        m_u = _lm(layers=3, scan=False)
+        m_s = _lm(layers=3, scan=True)
+        m_s.set_parameters(stack_block_params(m_u.parameters()[0]))
+        prompt = np.random.default_rng(1).integers(
+            0, VOCAB, size=6).astype(np.int32)
+        assert _greedy_reference(m_u, prompt, 6) == \
+            _greedy_reference(m_s, prompt, 6)
+
+    def test_causal_masking_at_every_position(self):
+        """Garbage beyond the decode frontier -- a previous occupant's
+        K/V, prompt padding -- must be invisible: poisoning every cache
+        position past ``pos`` changes nothing."""
+        m = _lm(layers=2)
+        params = m.parameters()[0]
+        toks = np.random.default_rng(2).integers(
+            0, VOCAB, size=(1, 8)).astype(np.int32)
+        cache = m.init_cache(1, 20)
+        _, cache = m.apply(params, (), jnp.asarray(toks), cache=cache)
+        for t in range(8, 12):
+            pos = jnp.full((1,), t, jnp.int32)
+            tok = jnp.asarray([[3]], jnp.int32)
+            lg, new_cache = m.apply(params, (), tok, cache=cache, pos=pos)
+            poisoned = jax.tree.map(
+                lambda c: c.at[..., t + 1:, :, :].set(1e4), cache)
+            lg2, _ = m.apply(params, (), tok, cache=poisoned, pos=pos)
+            np.testing.assert_array_equal(np.asarray(lg), np.asarray(lg2))
+            cache = new_cache
+
+    def test_flash_decode_matches_plain(self):
+        """The q_len=1 Pallas kernel (interpret mode on CPU) agrees
+        with masked plain attention, including at frontier 0."""
+        from bigdl_tpu.nn.attention import dot_product_attention
+        from bigdl_tpu.ops.flash_attention import flash_decode_attention
+
+        rng = np.random.default_rng(3)
+        b, t, h, d = 3, 16, 2, 8
+        q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+        pos = jnp.asarray([0, 7, 15], jnp.int32)
+        y = flash_decode_attention(q, k, v, pos, block_k=8,
+                                   interpret=True)
+        mask = (jnp.arange(t)[None, :] <= pos[:, None])[:, None, None, :]
+        ref = dot_product_attention(q, k, v, mask=mask)
+        assert float(jnp.max(jnp.abs(y - ref))) < 1e-5
+
+    def test_mha_decode_flash_interpret_path(self):
+        """MultiHeadAttention's cached apply routes through the flash
+        decode kernel under use_flash='interpret' and agrees with the
+        plain path."""
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.standard_normal((2, 1, 32)), jnp.float32)
+        outs = {}
+        for mode in ("never", "interpret"):
+            mha = MultiHeadAttention(32, 4, causal=True, use_flash=mode)
+            p, _ = mha.setup(jax.random.PRNGKey(0),
+                             jax.ShapeDtypeStruct((2, 8, 32), jnp.float32))
+            cache = mha.init_cache(2, 16)
+            pre = jnp.asarray(rng.standard_normal((2, 8, 32)), jnp.float32) \
+                if mode == "never" else outs["prefill_x"]
+            outs.setdefault("prefill_x", pre)
+            _, cache = mha.apply(p, (), outs["prefill_x"], cache=cache)
+            y, _ = mha.apply(p, (), x, cache=cache,
+                             pos=jnp.asarray([8, 8], jnp.int32))
+            outs[mode] = np.asarray(y)
+        assert np.max(np.abs(outs["never"] - outs["interpret"])) < 1e-5
+
+
+class TestRaggedPrefill:
+    def test_ragged_prompts_one_prefill_call(self):
+        """Rows of true lengths 3 and 9 share one padded prefill; each
+        row's first generated token comes from ITS ``length - 1``
+        logits, and the whole continuation matches the per-row
+        full-recompute reference."""
+        from bigdl_tpu.serving.generation import generate_steps
+
+        m = _lm(layers=2, max_len=32)
+        params = m.parameters()[0]
+        rng = np.random.default_rng(5)
+        p_short = rng.integers(0, VOCAB, size=3).astype(np.int32)
+        p_long = rng.integers(0, VOCAB, size=9).astype(np.int32)
+        ref_short = _greedy_reference(m, p_short, 4)
+        ref_long = _greedy_reference(m, p_long, 4)
+
+        prefill, decode = generate_steps(m)
+        cache = m.init_cache(3, 16)          # 2 rows + a trash row
+        tokens = np.zeros((2, 12), np.int32)
+        tokens[0, :3] = p_short
+        tokens[1, :9] = p_long
+        first, cache = prefill(params, cache, tokens,
+                               np.array([3, 9], np.int32),
+                               np.array([0, 1], np.int32))
+        first = np.asarray(first)
+        assert [int(first[0]), int(first[1])] == [ref_short[0],
+                                                  ref_long[0]]
+        got = [[int(first[0])], [int(first[1])]]
+        last = np.array([first[0], first[1], 0], np.int32)
+        pos = np.array([3, 9, 0], np.int32)
+        for _ in range(3):
+            nxt, cache = decode(params, cache, last, pos)
+            nxt = np.asarray(nxt)
+            got[0].append(int(nxt[0]))
+            got[1].append(int(nxt[1]))
+            last = nxt.astype(np.int32)
+            pos = pos + 1
+        assert got[0] == ref_short and got[1] == ref_long
+
+
+class TestGenerateServing:
+    """The engine's continuous-batching generate() verb."""
+
+    def test_generate_matches_reference_and_streams(self):
+        m = _lm(layers=2, max_len=48)
+        prompt = np.random.default_rng(6).integers(
+            0, VOCAB, size=7).astype(np.int32)
+        ref = _greedy_reference(m, prompt, 6)
+        with ServingEngine(m, decode_slots=2, decode_max_len=32) as eng:
+            fut = eng.generate(prompt, max_new_tokens=6)
+            streamed = list(fut.stream(60))
+            assert fut.result(5) == ref
+            assert streamed == ref
+            assert fut.finish_reason == "length"
+            assert fut.prompt_len == 7 and fut.latency_s > 0
+
+    def test_eos_stops_early(self):
+        m = _lm(layers=2, max_len=48)
+        prompt = np.random.default_rng(7).integers(
+            0, VOCAB, size=5).astype(np.int32)
+        ref = _greedy_reference(m, prompt, 8)
+        eos = ref[2]                       # greedy is deterministic
+        with ServingEngine(m, decode_slots=2, decode_max_len=32) as eng:
+            fut = eng.generate(prompt, max_new_tokens=8, eos_id=eos)
+            out = fut.result(60)
+            assert out == ref[:3]          # eos included, then stop
+            assert fut.finish_reason == "eos"
+
+    def test_zero_recompiles_across_mixed_buckets(self):
+        """THE acceptance pin: precompile() closes the generation
+        executable set; a closed-loop workload spanning multiple
+        admission counts AND prompt-length rungs -- sequences joining
+        and leaving slots mid-flight -- performs zero backend compiles,
+        and no tick event carries the ``compiles`` stamp."""
+        import tempfile
+
+        from bigdl_tpu.observability import StepTelemetry
+
+        m = _lm(layers=2, max_len=48)
+        rng = np.random.default_rng(8)
+        with tempfile.TemporaryDirectory() as d:
+            tel = StepTelemetry(d, run_name="gen", trace=False)
+            eng = ServingEngine(
+                m, decode_slots=2, decode_max_len=32,
+                prompt_ladder=BucketLadder(16, min_size=8),
+                telemetry=tel)
+            try:
+                eng.precompile(
+                    example_feature=np.zeros((16,), np.int32))
+                before = backend_compile_count()
+                # wave 1: both length rungs, staggered max_new so slots
+                # free at different ticks; wave 2 joins mid-flight
+                futs = [eng.generate(rng.integers(0, VOCAB, size=n),
+                                     max_new_tokens=k)
+                        for n, k in ((5, 3), (12, 7), (9, 2))]
+                time.sleep(0.05)
+                futs += [eng.generate(rng.integers(0, VOCAB, size=n),
+                                      max_new_tokens=k)
+                         for n, k in ((15, 4), (3, 6))]
+                outs = [f.result(120) for f in futs]
+                assert [len(o) for o in outs] == [3, 7, 2, 4, 6]
+                assert backend_compile_count() - before == 0
+            finally:
+                eng.close()
+                tel.close()
+            events = [json.loads(ln) for ln in
+                      open(os.path.join(d, "telemetry.jsonl"))]
+            ticks = [e for e in events if e.get("kind") == "inference"]
+            assert ticks, "generation must emit inference tick events"
+            assert not any(e.get("compiles") for e in ticks)
+
+    def test_tick_telemetry_and_metrics_bridge(self):
+        """Satellite pins: tick events stamp tick_kind / tokens / slot
+        occupancy; the registry bridges bigdl_serving_tokens_total and
+        the slot-fill gauge; obs_report's Serving section reports
+        tokens/s and mean slot fill."""
+        import importlib.util
+        import tempfile
+
+        from bigdl_tpu.observability import StepTelemetry
+        from bigdl_tpu.observability.metrics import MetricsRegistry
+
+        m = _lm(layers=2, max_len=48)
+        with tempfile.TemporaryDirectory() as d:
+            tel = StepTelemetry(d, run_name="gen", trace=False)
+            reg = MetricsRegistry()
+            tel.attach_metrics(reg)
+            with ServingEngine(m, decode_slots=2, decode_max_len=32,
+                               telemetry=tel) as eng:
+                futs = [eng.generate(
+                    np.random.default_rng(i).integers(0, VOCAB, size=4),
+                    max_new_tokens=5) for i in range(2)]
+                [f.result(60) for f in futs]
+            tel.close()
+            events = [json.loads(ln) for ln in
+                      open(os.path.join(d, "telemetry.jsonl"))]
+            ticks = [e for e in events if e.get("tick_kind")]
+            kinds = {e["tick_kind"] for e in ticks}
+            assert kinds == {"prefill", "decode"}
+            for e in ticks:
+                assert e["slots_total"] == 2
+                assert 0 <= e["slots_active"] <= 2
+                assert e["tokens"] >= 1
+            decode_ticks = [e for e in ticks
+                            if e["tick_kind"] == "decode"]
+            # prefill admits the requests; decode ticks emit the rest
+            assert sum(e["tokens"] for e in ticks) == 10
+            assert any(e["slots_active"] == 2 for e in decode_ticks)
+            # completion latencies ride their OWN field (+ histogram):
+            # second-scale generations must never pollute the predict
+            # latency series an SLO is tuned against
+            assert any(e.get("generate_latency_s") for e in ticks)
+            assert not any(e.get("request_latency_s") for e in ticks)
+            text = reg.render()
+            assert 'bigdl_serving_tokens_total{kind="decode"}' in text
+            assert 'bigdl_serving_tokens_total{kind="prefill"}' in text
+            assert "bigdl_serving_slot_fill" in text
+            assert "bigdl_serving_generate_latency_seconds_bucket" in text
+            spec = importlib.util.spec_from_file_location(
+                "_t_obs_decode", os.path.join(
+                    os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    "tools", "obs_report.py"))
+            obs = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(obs)
+            gen = obs.build_report(d)["serving"]["generate"]
+            assert gen["tokens"] == 10
+            assert gen["tokens_per_s"] > 0
+            assert 0 < gen["slot_fill_mean"] <= 1.0
+
+    def test_tick_failure_resets_the_pool_and_keeps_serving(self):
+        """Both compiled steps DONATE the cache, so a runtime tick
+        failure invalidates the whole pool: the tick's futures fail
+        honestly, the cache reallocates, and NEW requests serve
+        normally afterwards (no 'Array has been deleted' forever)."""
+        m = _lm(layers=2, max_len=48)
+        ref = _greedy_reference(m, [1, 2, 3], 4)
+        with ServingEngine(m, decode_slots=2, decode_max_len=32) as eng:
+            sched = eng._generation()
+            good = sched._prefill_fn
+
+            def boom(*a, **k):
+                raise RuntimeError("injected tick failure")
+
+            sched._prefill_fn = boom
+            fut = eng.generate([1, 2, 3], max_new_tokens=4)
+            with pytest.raises(RuntimeError, match="injected"):
+                fut.result(30)
+            sched._prefill_fn = good
+            assert eng.generate([1, 2, 3],
+                                max_new_tokens=4).result(60) == ref
+
+    def test_abandon_frees_generation_queue_slot(self):
+        """An abandoned (timed-out) pending generation leaves the
+        scheduler's queue immediately and its stream ends, instead of
+        counting against capacity until an admission drains it."""
+        m = _lm(layers=2, max_len=48)
+        with ServingEngine(m, decode_slots=1, decode_max_len=32) as eng:
+            sched = eng._generation()
+            real_decode = sched._decode_fn
+
+            def slow_decode(*a, **k):
+                time.sleep(0.05)
+                return real_decode(*a, **k)
+
+            sched._decode_fn = slow_decode
+            first = eng.generate([1, 2, 3], max_new_tokens=8)
+            time.sleep(0.1)            # first occupies the only slot
+            second = eng.generate([4, 5], max_new_tokens=2)
+            eng._abandon(second)
+            assert second.cancelled()
+            assert list(second.stream(5)) == []   # sentinel delivered
+            with sched._lock:
+                assert not any(e[1] is second for e in sched._pending)
+            assert len(first.result(60)) == 8     # unaffected
+
+    def test_abandon_evicts_midflight_sequence(self):
+        """Abandoning an already-decoding sequence frees its slot at
+        the next tick boundary with a PARTIAL result -- the slot must
+        not keep decoding max_new_tokens for a caller who left (the
+        fleet deadline-retry double-booking case)."""
+        m = _lm(layers=2, max_len=48)
+        with ServingEngine(m, decode_slots=1, decode_max_len=40) as eng:
+            sched = eng._generation()
+            real_decode = sched._decode_fn
+
+            def slow_decode(*a, **k):
+                time.sleep(0.05)
+                return real_decode(*a, **k)
+
+            sched._decode_fn = slow_decode
+            fut = eng.generate([1, 2, 3], max_new_tokens=30)
+            stream = fut.stream(30)
+            next(stream)                   # mid-flight for sure
+            eng._abandon(fut)
+            partial = fut.result(30)
+            assert fut.finish_reason == "abandoned"
+            assert 1 <= len(partial) < 30
+            assert list(stream) == partial[1:]   # stream ended too
+            # the slot is free again: a new request serves promptly
+            assert len(eng.generate([4, 5],
+                                    max_new_tokens=2).result(30)) == 2
+            assert sched.stats()["slots_active"] == 0
+
+    def test_draining_refuses_generation(self):
+        m = _lm(layers=2, max_len=48)
+        with ServingEngine(m, decode_slots=1, decode_max_len=32) as eng:
+            eng.drain(5)
+            with pytest.raises(EngineDraining):
+                eng.generate([1, 2, 3], max_new_tokens=2)
+            eng.undrain()
+            assert len(eng.generate([1, 2, 3],
+                                    max_new_tokens=2).result(60)) == 2
+
+    def test_request_validation(self):
+        m = _lm(layers=2, max_len=48)
+        with ServingEngine(m, decode_slots=1, decode_max_len=16) as eng:
+            with pytest.raises(ValueError, match="max_len"):
+                eng.generate(np.arange(12), max_new_tokens=8)
+            with pytest.raises(ValueError, match="at least one token"):
+                eng.generate([], max_new_tokens=2)
+            with pytest.raises(ValueError, match="max_new_tokens"):
+                eng.generate([1], max_new_tokens=0)
+        # generation disabled: the knob exists but the verb refuses
+        eng = ServingEngine(m, decode_slots=0)
+        try:
+            with pytest.raises(ValueError, match="decode_slots"):
+                eng.generate([1, 2])
+        finally:
+            eng.close()
+
+
+class TestInt8Generation:
+    """ISSUE-15 int8 satellite: the quantized engine serves generation
+    through the decode-mode int8 attention path, gated by the same
+    AccuracyDeltaGate, with pinned fp32-vs-int8 token agreement."""
+
+    @staticmethod
+    def _confident_lm():
+        # damp the residual branches so logits are embedding-dominated:
+        # argmax margins then dwarf the int8 noise in the block matmuls
+        m = _lm(layers=2, max_len=48, vocab=64)
+        p = m.parameters()[0]
+        for k in list(p):
+            if k.startswith("block"):
+                p[k] = jax.tree.map(lambda a: a * 0.2, p[k])
+        p["head"] = p["head"] * 4.0
+        m.set_parameters(p)
+        return m
+
+    def test_int8_generate_through_the_gate(self):
+        m = self._confident_lm()
+        feats = np.random.default_rng(0).integers(
+            0, 64, size=(8, 16)).astype(np.int32)
+        e32 = ServingEngine(m, decode_slots=2, decode_max_len=40)
+        e8 = ServingEngine(m, decode_slots=2, decode_max_len=40,
+                           quantize=True,
+                           accuracy_gate={"features": feats,
+                                          "min_top1_agreement": 0.9})
+        try:
+            assert e8.quantized
+            assert e8._gate_detail["ok"]
+            # the decode path really contracts int8: the served twin's
+            # attention params carry the quantized projections
+            qp = e8._qmodel.parameters()[0]
+            blk = qp["block0"] if "block0" in qp else qp["blocks"]
+            assert "qkv_weight_q" in blk["attn"]
+            rng = np.random.default_rng(1)
+            agree, n = 0, 0
+            for _ in range(6):
+                prompt = rng.integers(0, 64, size=10).astype(np.int32)
+                a = e32.generate(prompt, max_new_tokens=10).result(60)
+                b = e8.generate(prompt, max_new_tokens=10).result(60)
+                agree += sum(x == y for x, y in zip(a, b))
+                n += len(a)
+            # the pinned fp32-vs-int8 top-1 agreement on GENERATED
+            # tokens (trajectory-level, so any divergence compounds --
+            # 1.0 measured on this fixed-key confident config)
+            assert agree / n >= 0.9, f"token agreement {agree / n:.3f}"
+        finally:
+            e32.close()
+            e8.close()
+
+    def test_gate_refusal_blocks_int8_generation(self):
+        """A gate the quantizer cannot clear refuses the ENGINE, so
+        generation never serves damaging weights (same contract as the
+        eval path)."""
+        m = _lm(layers=2, max_len=48, vocab=64)  # key-0 unscaled: 0.875
+        feats = np.random.default_rng(0).integers(
+            0, 64, size=(8, 16)).astype(np.int32)
+        with pytest.raises(ValueError, match="accuracy gate"):
+            ServingEngine(m, decode_slots=2, decode_max_len=40,
+                          quantize=True,
+                          accuracy_gate={"features": feats,
+                                         "min_top1_agreement": 0.95})
+
+
+class TestWorkerFleetGenerate:
+    """The generate verb across the socket protocol and the fleet."""
+
+    def test_worker_generate_op(self):
+        from bigdl_tpu.serving.worker import ReplicaServer, call
+
+        m = _lm(layers=2, max_len=48)
+        prompt = [1, 2, 3, 4]
+        ref = _greedy_reference(m, prompt, 5)
+        with ServingEngine(m, decode_slots=2, decode_max_len=32) as eng:
+            srv = ReplicaServer(eng, port=0).start()
+            try:
+                out = call("127.0.0.1", srv.port, "generate",
+                           prompt=prompt, max_new_tokens=5)
+                assert out == ref
+            finally:
+                srv.close()
+
+    def test_fleet_generate_routes_retries_and_never_hedges(self):
+        m = _lm(layers=2, max_len=48)
+        prompt = np.asarray([5, 6, 7], np.int32)
+        ref = _greedy_reference(m, prompt, 4)
+        e1 = ServingEngine(m, decode_slots=2, decode_max_len=32)
+        e2 = ServingEngine(m, decode_slots=2, decode_max_len=32)
+        # hedge=True fleet-wide: generation must still never hedge
+        fleet = ServingFleet([InProcessReplica(e1, rid=0),
+                              InProcessReplica(e2, rid=1)],
+                             hedge=True, hedge_min_samples=1,
+                             hedge_min_delay_s=0.0)
+        try:
+            for _ in range(4):
+                assert fleet.generate(prompt, max_new_tokens=4,
+                                      timeout=60) == ref
+            # kill one replica: the request fails there and retries on
+            # the sibling (idempotent: greedy re-runs from the prompt)
+            e1.close()
+            for _ in range(4):
+                assert fleet.generate(prompt, max_new_tokens=4,
+                                      timeout=60) == ref
+            counters = fleet.counters()
+            assert counters["ok"] == 8 and counters["failed"] == 0
+            assert counters["hedges"] == 0      # disabled by design
+        finally:
+            fleet.close()

@@ -379,3 +379,77 @@ class TestServeInt8Records:
                        for e in traj["metrics"][m]), m
         assert gate.main(["--dir", REPO, "--check", path,
                           "--require-trusted"]) == 0
+
+
+def _decode_record(value):
+    """The BENCH_DECODE A/B shape: cached-over-uncached tokens/sec --
+    a host-side ratio (no platform / per-step timing claim), so the
+    gate classes it ``ratio`` and it rides the trusted trajectory."""
+    return {"metric": "serving_decode_tokens_ratio", "value": value,
+            "unit": "x", "vs_baseline": value / 3.0,
+            "extra": {"prompt_len": 512, "new_tokens": 128,
+                      "uncached": {"tokens_per_s": 12.0},
+                      "cached": {"tokens_per_s": 12.0 * value,
+                                 "recompiles_after_warm": 0},
+                      "greedy_tokens_match": True}}
+
+
+class TestDecodeRecords:
+    """ISSUE-15 satellite: the BENCH_DECODE KV-cache A/B's tokens/sec
+    metric is baseline-eligible ``ratio``, a synthetic regression trips
+    rc 1, and the checked-in BENCH_r07.json passes the CI spelling."""
+
+    def test_decode_ratio_classes_and_sets_baseline(self, gate, tmp_path):
+        assert gate.classify_trust(_decode_record(10.0)) == "ratio"
+        d = _bench_dir(tmp_path, {
+            "BENCH_r07.json": _wrapper([_decode_record(10.0)], n=7),
+        })
+        traj = gate.build_trajectory(d)
+        entries = traj["metrics"]["serving_decode_tokens_ratio"]
+        assert entries[0]["trust"] == "ratio"
+        assert entries[0]["baseline_eligible"] is True
+        assert gate.main(["--dir", d]) == 0
+
+    def test_decode_regression_trips_the_gate(self, gate, tmp_path,
+                                              capsys):
+        d = _bench_dir(tmp_path, {
+            "BENCH_r07.json": _wrapper([_decode_record(10.0)], n=7),
+            "BENCH_r08.json": _wrapper([_decode_record(5.0)], n=8),
+        })
+        rc = gate.main(["--dir", d])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "serving_decode_tokens_ratio" in out and "gate: FAIL" in out
+        # the CI spelling: a --check candidate regressing the baseline
+        (tmp_path / "h2").mkdir()
+        d2 = _bench_dir(tmp_path / "h2", {
+            "BENCH_r07.json": _wrapper([_decode_record(10.0)], n=7)})
+        cand = tmp_path / "BENCH_cand.json"
+        cand.write_text(json.dumps(_decode_record(4.0)))
+        assert gate.main(["--dir", d2, "--check", str(cand),
+                          "--require-trusted"]) == 1
+        cand.write_text(json.dumps(_decode_record(9.9)))
+        assert gate.main(["--dir", d2, "--check", str(cand),
+                          "--require-trusted"]) == 0
+
+    def test_checked_in_r07_is_baseline_eligible(self, gate):
+        """The REAL checked-in BENCH_r07.json: the decode ratio enters
+        the trajectory baseline-eligible, clears the >= 3x acceptance
+        bar, and gating it as a fresh candidate passes."""
+        path = os.path.join(REPO, "BENCH_r07.json")
+        assert os.path.exists(path), "BENCH_r07.json must be checked in"
+        records, note = gate.load_bench_file(path)
+        assert note is None
+        recs = [r for r in records
+                if r["metric"] == "serving_decode_tokens_ratio"]
+        assert recs, "BENCH_r07.json must carry the decode ratio record"
+        for r in recs:
+            assert gate.classify_trust(r) == "ratio"
+            assert r["value"] >= 3.0            # the ISSUE-15 target
+            assert r["extra"]["greedy_tokens_match"] is True
+            assert r["extra"]["cached"]["recompiles_after_warm"] == 0
+        traj = gate.build_trajectory(REPO)
+        assert any(e["baseline_eligible"] for e in
+                   traj["metrics"]["serving_decode_tokens_ratio"])
+        assert gate.main(["--dir", REPO, "--check", path,
+                          "--require-trusted"]) == 0

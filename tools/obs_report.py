@@ -267,29 +267,36 @@ def _serving_section(other, header=None):
                 if info.get(k) is not None:
                     sec[k] = info[k]
         return sec
-    requests = sum(int(e.get("records", 0)) for e in inf)
-    busy = sum(e.get("wall_s", 0.0) for e in inf)
-    sec = {"ticks": len(inf), "requests": requests,
+    # generation ticks (tick_kind set) report through their own block
+    # below: folding second-scale decode ticks / slot-admission buckets
+    # into the predict aggregates would corrupt every figure an
+    # operator compares across runs (the same segregation reasoning as
+    # generate_latency_s vs request_latency_s)
+    pred = [e for e in inf if not e.get("tick_kind")]
+    requests = sum(int(e.get("records", 0)) for e in pred)
+    busy = sum(e.get("wall_s", 0.0) for e in pred)
+    sec = {"ticks": len(pred), "requests": requests,
            "requests_per_s": (requests / busy) if busy > 0 else None}
-    lats = sorted(l for e in inf for l in (e.get("request_latency_s") or [])
+    lats = sorted(l for e in pred
+                  for l in (e.get("request_latency_s") or [])
                   if _finite(l))
     if lats:
         sec["latency_s_p50"] = percentile(lats, 50)
         sec["latency_s_p95"] = percentile(lats, 95)
         sec["latency_s_p99"] = percentile(lats, 99)
     depths = [(e.get("step"), e["queue_depth"])
-              for e in inf if "queue_depth" in e]
+              for e in pred if "queue_depth" in e]
     if depths:
         d = sorted(x for _, x in depths)
         sec["queue_depth_p50"] = percentile(d, 50)
         sec["queue_depth_p90"] = percentile(d, 90)
-        caps = [e.get("queue_capacity") for e in inf
+        caps = [e.get("queue_capacity") for e in pred
                 if e.get("queue_capacity")]
         sec["queue_capacity"] = max(caps) if caps else None
         stride = max(1, len(depths) // 40)    # <= ~40 trajectory points
         sec["queue_depth_trajectory"] = [
             {"step": s, "depth": x} for s, x in depths[::stride]]
-    bucketed = [e for e in inf if e.get("bucket")]
+    bucketed = [e for e in pred if e.get("bucket")]
     if bucketed:
         hist = {}
         for e in bucketed:
@@ -304,6 +311,43 @@ def _serving_section(other, header=None):
                        if _finite(e.get("batch_fill")))
         if fills:
             sec["batch_fill_p50"] = percentile(fills, 50)
+    # autoregressive generation ticks (serving/generation.py): the
+    # tick_kind stamp splits prefill/decode, ``tokens`` accumulates the
+    # emitted stream, and slot occupancy averages into the utilization
+    # figure an operator sizes the slot pool by
+    gen = [e for e in inf if e.get("tick_kind")]
+    if gen:
+        toks = sum(int(e.get("tokens", 0) or 0) for e in gen)
+        # the rendered figure is "tok/s WHILE DECODING": decode ticks
+        # only, so prefill-heavy runs don't dilute the number an
+        # operator compares against the bench's per-leg decode rate
+        dec = [e for e in gen if e["tick_kind"] == "decode"]
+        dtoks = sum(int(e.get("tokens", 0) or 0) for e in dec)
+        dwall = sum(e.get("wall_s", 0.0) for e in dec
+                    if _finite(e.get("wall_s")))
+        block = {"prefill_ticks": sum(1 for e in gen
+                                      if e["tick_kind"] == "prefill"),
+                 "decode_ticks": len(dec),
+                 "requests": sum(int(e.get("records", 0) or 0)
+                                 for e in gen
+                                 if e["tick_kind"] == "prefill"),
+                 "tokens": toks,
+                 "tokens_per_s": (dtoks / dwall) if dwall > 0 else None}
+        fills = [e["slots_active"] / e["slots_total"] for e in gen
+                 if e.get("slots_total") and e["tick_kind"] == "decode"
+                 and _finite(e.get("slots_active"))]
+        if fills:
+            block["slot_fill_mean"] = sum(fills) / len(fills)
+        glats = sorted(l for e in gen
+                       for l in (e.get("generate_latency_s") or [])
+                       if _finite(l))
+        if glats:
+            block["latency_s_p50"] = percentile(glats, 50)
+            block["latency_s_p99"] = percentile(glats, 99)
+        slots = [e.get("slots_total") for e in gen if e.get("slots_total")]
+        if slots:
+            block["slots"] = max(slots)
+        sec["generate"] = block
     if info:
         for k in ("quantized", "weight_dtype", "model_bytes",
                   "model_bytes_fp32", "backend", "replicas",
@@ -923,6 +967,23 @@ def format_report(rep):
                 f"serving queue depth p50/p90: {sv['queue_depth_p50']}/"
                 f"{sv['queue_depth_p90']}"
                 + (f" (capacity {cap})" if cap is not None else ""))
+        gen = sv.get("generate")
+        if gen:
+            line = (f"generation: {gen['tokens']} tokens over "
+                    f"{gen['prefill_ticks']} prefill / "
+                    f"{gen['decode_ticks']} decode ticks")
+            if gen.get("tokens_per_s") is not None:
+                line += f" ({gen['tokens_per_s']:.1f} tok/s while decoding)"
+            if gen.get("slot_fill_mean") is not None:
+                line += (f"   slot fill {gen['slot_fill_mean']:.0%}"
+                         + (f" of {gen['slots']}" if gen.get("slots")
+                            else ""))
+            out.append(line)
+            if gen.get("latency_s_p50") is not None:
+                out.append(
+                    f"generation latency p50/p99: "
+                    f"{_fmt_s(gen['latency_s_p50'])} / "
+                    f"{_fmt_s(gen.get('latency_s_p99'))}")
     fl = rep.get("fleet")
     if fl:
         line = f"fleet: {len(fl['replicas'])} replica(s)"
