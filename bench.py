@@ -42,6 +42,33 @@ import sys
 import time
 
 
+def _tracing_manifest():
+    """The request-tracing config block (sample rate, always_sample)
+    from ``observability/tracing.py``, spec-loaded by path so this
+    harness keeps working without jax installed."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bigdl_tpu", "observability", "tracing.py")
+    spec = importlib.util.spec_from_file_location("_bench_tracing", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.tracing_manifest()
+
+
+def emit_record(record):
+    """Print one bench record with the tracing manifest stamped into
+    ``extra``: tools/perf_gate.py refuses a number measured with
+    always-sample tracing (every request paid forced span flushes the
+    production path doesn't), and the manifest is what lets it tell."""
+    extra = record.setdefault("extra", {})
+    try:
+        extra.setdefault("tracing", _tracing_manifest())
+    except Exception:
+        pass          # an unreadable manifest must never kill a bench
+    print(json.dumps(record), flush=True)
+    return record
+
+
 # single source of truth for the model-variant flag vocabulary shared by
 # the sweep suffix syntax here, tools/perf_ab.py and tools/tpu_evidence.py:
 # (kwarg name, suffix letter, env var giving the suffix-less default)
@@ -212,7 +239,7 @@ def run_pipeline_bench(latency_s=None, steps=None, batch=None,
                          "queue": pre.get("prefetch_queue")},
         },
     }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return record
 
 
@@ -338,7 +365,7 @@ def run_health_bench(stats_every=None, steps=None, batch=None,
             "monitored_loss_matches": loss_on == loss_off,
         },
     }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return record
 
 
@@ -587,7 +614,7 @@ def run_serve_bench(concurrency=None, per_client=None, hidden=None,
             "slo_drill": slo_drill,
         },
     }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return record
 
 
@@ -712,7 +739,7 @@ def run_serve_quant_bench(concurrency=None, per_client=None, hidden=None,
                   "fp32": leg_fp, "int8": leg_q,
                   "logit_max_rel_delta": round(max_rel, 5)},
     }
-    print(json.dumps(rec_rps), flush=True)
+    emit_record(rec_rps)
     bytes_ratio = leg_fp["model_bytes"] / max(leg_q["model_bytes"], 1)
     rec_bytes = {
         "metric": "serving_int8_model_bytes_ratio",
@@ -724,7 +751,7 @@ def run_serve_quant_bench(concurrency=None, per_client=None, hidden=None,
                   "model_bytes_int8": leg_q["model_bytes"],
                   "accuracy_gate": leg_q["accuracy_gate"]},
     }
-    print(json.dumps(rec_bytes), flush=True)
+    emit_record(rec_bytes)
     return rec_rps, rec_bytes
 
 
@@ -919,7 +946,7 @@ def run_decode_bench(prompt_len=None, new_tokens=None, out_dir=None):
             "continuous_batching": batching,
         },
     }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return record
 
 
@@ -1025,7 +1052,7 @@ def run_qcomm_bench(steps=None, batch=None, hidden=None, out_dir=None):
             },
         },
     }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return record
 
 
@@ -1272,7 +1299,7 @@ def run_lm_bench(size=None, steps=None, batch=None, seq=None, vocab=None,
     }
     if record["trust"] != "trusted":
         record["vs_baseline"] = 0.0   # PR 6's contract: no trust, no claim
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return record
 
 
@@ -1322,13 +1349,13 @@ def run_bench():
         except Exception as e:          # e.g. OOM at the larger batch:
             failures.append({"batch": batch, "error": repr(e)[:300], **flags})
             if records:                 # keep the failure visible in any
-                print(json.dumps(best_so_far()), flush=True)  # salvage
+                emit_record(best_so_far())  # salvage
             continue                    # keep any already-valid record
         # Print the best record after EVERY completed leg: a later leg
         # that hangs (a big-batch compile can wedge a sick tunnel) gets
         # this child killed, and the parent salvages this line instead
         # of losing the whole sweep.
-        print(json.dumps(best_so_far()), flush=True)
+        emit_record(best_so_far())
         if records[-1]["extra"]["platform"] == "cpu":
             break                      # no sweep off-TPU (smoke path)
     if not records:
@@ -1819,6 +1846,10 @@ def main():
         extra = rec.setdefault("extra", {})
         extra["probe_sec"] = probe_info["probe_sec"]
         extra["probe_result"] = probe_info["probe_result"]
+        try:
+            extra.setdefault("tracing", _tracing_manifest())
+        except Exception:
+            pass
         if cpu_fallback:
             # the honest spelling of an r04/r05-style death: the probe
             # outcome -> cpu, recorded, instead of a killed run

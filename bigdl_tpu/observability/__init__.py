@@ -42,10 +42,14 @@ from bigdl_tpu.observability.metrics import (Counter, Gauge, Histogram,
                                              SloTracker)
 from bigdl_tpu.observability.profiling import (BlockingStepTimer,
                                                TimingAuditor)
-from bigdl_tpu.observability.spans import SpanTracer, span
+from bigdl_tpu.observability.spans import (SpanTracer, read_trace_events,
+                                           span)
 from bigdl_tpu.observability.telemetry import (StepTelemetry,
                                                device_memory_stats,
                                                peak_flops)
+from bigdl_tpu.observability.tracing import (HeadSampler, RequestTrace,
+                                             TraceContext,
+                                             tracing_manifest)
 from bigdl_tpu.observability.watchdogs import (LossSpikeWatchdog,
                                                MemoryWatchdog,
                                                NonFiniteWatchdog,
@@ -61,4 +65,6 @@ __all__ = [
     "BlockingStepTimer", "TimingAuditor",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "MetricsExporter", "SloObjective", "SloTracker",
+    "TraceContext", "HeadSampler", "RequestTrace", "tracing_manifest",
+    "read_trace_events",
 ]

@@ -226,9 +226,17 @@ class Histogram(_Metric):
         from collections import deque
         return {"counts": [0] * (len(self.buckets) + 1), "sum": 0.0,
                 "count": 0,
-                "reservoir": deque(maxlen=self.reservoir_size)}
+                "reservoir": deque(maxlen=self.reservoir_size),
+                # bucket index -> (trace_id, value, unix ts): the most
+                # recent sampled request that landed in that bucket
+                "exemplars": {}}
 
-    def observe(self, value, **labels):
+    def observe(self, value, exemplar=None, **labels):
+        """Record one observation.  ``exemplar`` (a trace_id string)
+        attaches the observation to a distributed trace: the rendered
+        bucket line gains an OpenMetrics exemplar (``# {trace_id=...}
+        value ts``), which is how a dashboard jumps from "the p99
+        bucket is filling" to ONE concrete slow request's trace."""
         v = float(value)
         with self._lock:
             child = self._child(labels)
@@ -241,6 +249,8 @@ class Histogram(_Metric):
             child["sum"] += v
             child["count"] += 1
             child["reservoir"].append(v)
+            if exemplar:
+                child["exemplars"][i] = (str(exemplar), v, time.time())
 
     def count(self, **labels):
         with self._lock:
@@ -261,14 +271,25 @@ class Histogram(_Metric):
         pairs.append(f'le="{le}"')
         return f"{self.name}_bucket{{{','.join(pairs)}}}"
 
+    @staticmethod
+    def _exemplar_suffix(child, i):
+        ex = child["exemplars"].get(i)
+        if ex is None:
+            return ""
+        tid, v, ts = ex
+        return (f' # {{trace_id="{_escape_label(tid)}"}} '
+                f'{_fmt(v)} {ts:.3f}')
+
     def _render_child(self, key, child, extra=()):
         lines, cum = [], 0
-        for b, n in zip(self.buckets, child["counts"]):
+        for j, (b, n) in enumerate(zip(self.buckets, child["counts"])):
             cum += n
             lines.append(
-                f"{self._bucket_series(key, _fmt(b), extra)} {cum}")
+                f"{self._bucket_series(key, _fmt(b), extra)} {cum}"
+                f"{self._exemplar_suffix(child, j)}")
         cum += child["counts"][-1]
-        lines.append(f"{self._bucket_series(key, '+Inf', extra)} {cum}")
+        lines.append(f"{self._bucket_series(key, '+Inf', extra)} {cum}"
+                     f"{self._exemplar_suffix(child, len(self.buckets))}")
         lines.append(f"{self._series_name(key, '_sum', extra)} "
                      f"{_fmt(child['sum'])}")
         lines.append(f"{self._series_name(key, '_count', extra)} "
@@ -513,8 +534,12 @@ class MetricsRegistry:
                 .set(event["pad_waste"])
         lat = self.histogram(f"{p}_serving_request_latency_seconds",
                              "end-to-end request latency")
-        for v in event.get("request_latency_s") or []:
-            lat.observe(v)
+        # request_traces is parallel to request_latency_s (None for
+        # untraced rows): sampled requests become bucket exemplars
+        traces = event.get("request_traces") or []
+        for i, v in enumerate(event.get("request_latency_s") or []):
+            lat.observe(v, exemplar=traces[i] if i < len(traces)
+                        else None)
         # generation ticks (serving/generation.py) additionally stamp
         # tick_kind ("prefill"/"decode"), tokens emitted and slot
         # occupancy -- the live tokens/s + slot-utilization signals
@@ -536,8 +561,24 @@ class MetricsRegistry:
                 "its own family so second-scale generations never "
                 "pollute the predict latency series an SLO is tuned "
                 "against")
-            for v in event["generate_latency_s"]:
-                glat.observe(v)
+            gtraces = event.get("generate_traces") or []
+            for i, v in enumerate(event["generate_latency_s"]):
+                glat.observe(v, exemplar=gtraces[i]
+                             if i < len(gtraces) else None)
+            # the segregated split (serving/generation.py): queue wait
+            # for a free decode slot vs actual prefill+decode time --
+            # one merged series reads slot starvation as slow decode
+            for fam, field, doc in (
+                    ("generate_queue_wait", "generate_queue_wait_s",
+                     "generation time queued waiting for a decode slot"),
+                    ("generate_decode", "generate_decode_s",
+                     "generation time actually prefilling/decoding")):
+                vals = event.get(field)
+                if vals:
+                    h = self.histogram(f"{p}_serving_{fam}_seconds", doc)
+                    for i, v in enumerate(vals):
+                        h.observe(v, exemplar=gtraces[i]
+                                  if i < len(gtraces) else None)
         if event.get("compiles"):
             self.counter(f"{p}_serving_recompiles_total",
                          "XLA compiles inside serving ticks (nonzero "

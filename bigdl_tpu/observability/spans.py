@@ -46,6 +46,29 @@ def span(name, **args):
     return tracer.span(name, **args)
 
 
+def read_trace_events(trace_path):
+    """Chrome-trace events from either container format: the streamed
+    JSON array (possibly unterminated after a crash -- repaired here,
+    as Perfetto does by spec) or the object form with a
+    ``traceEvents`` key.  None when the file is missing or beyond
+    repair.  The ONE shared reader: ``tools/obs_report.py`` and
+    ``tools/trace_report.py`` both spec-load it from here instead of
+    each carrying its own copy of the repair."""
+    try:
+        with open(trace_path, errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        try:   # unterminated streamed array from a crashed run
+            doc = json.loads(text.rstrip().rstrip(",") + "]")
+        except ValueError:
+            return None
+    return doc if isinstance(doc, list) else doc.get("traceEvents")
+
+
 class SpanTracer:
     """Streaming chrome-trace JSON writer for host-side stage spans.
 
@@ -111,6 +134,22 @@ class SpanTracer:
             if args:
                 ev["args"] = args
             self._emit(ev)
+
+    def complete_at(self, name, wall_ts, dur_s, **args):
+        """Record a complete ("X") event whose timing is GIVEN rather
+        than measured: ``wall_ts`` (epoch seconds) + ``dur_s``.  The
+        distributed-tracing mirror uses this -- request spans are
+        timed by the serving stack in wall-clock terms and replayed
+        into the chrome trace, anchored on the tracer's recorded
+        wall-clock origin so they line up with live ``span()`` events
+        in the same Perfetto tab."""
+        ev = {"name": name, "ph": "X",
+              "ts": (wall_ts - self._origin_wall) * 1e6,
+              "dur": dur_s * 1e6,
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
 
     def instant(self, name, **args):
         """Record a zero-duration marker (chrome-trace "i" event)."""

@@ -148,6 +148,12 @@ class StepTelemetry:
         self._f = open(self.jsonl_path, "w")
         self.tracer = SpanTracer(os.path.join(out_dir, "trace.json")) \
             if trace else None
+        # distributed request-trace spans (docs/observability.md,
+        # "Request tracing"): opened lazily on the first record_trace
+        # so runs without serving traces leave no empty artifact
+        self.traces_path = os.path.join(out_dir, "traces.jsonl")
+        self._traces_f = None
+        self._traces_lock = threading.Lock()
         self.recompile_watchdog = RecompileWatchdog(recompile_warmup_steps)
         self.memory_watchdog = MemoryWatchdog(memory_window)
         # sampled at construction -- BEFORE this run's own compiles land
@@ -413,6 +419,40 @@ class StepTelemetry:
             self.record("cost", **fields)
         return cost
 
+    # ----- distributed request traces --------------------------------------- #
+    def record_trace(self, name, ctx, t_wall, dur_s, status="ok",
+                     **fields):
+        """Append one request-trace span record to ``traces.jsonl``.
+
+        ``ctx`` is a ``tracing.TraceContext`` (span identity),
+        ``t_wall``/``dur_s`` the span's wall-clock start and duration.
+        JSONL by design: a SIGKILLed process loses at most the line
+        being written -- every flushed span of a dead worker is still
+        stitchable by ``tools/trace_report.py``.  When a chrome tracer
+        is attached the span is mirrored into ``trace.json`` too, so
+        one Perfetto tab shows request spans next to host stages.
+        """
+        rec = {"trace": ctx.trace_id, "span": ctx.span_id,
+               "parent": ctx.parent_id, "name": name,
+               "ts": round(float(t_wall), 6),
+               "dur_s": round(float(dur_s), 6), "status": status,
+               "process": self.run_name, "pid": os.getpid()}
+        if fields:
+            rec.update(fields)
+        with self._traces_lock:
+            if self._closed:
+                return None
+            if self._traces_f is None:
+                self._traces_f = open(self.traces_path, "w")
+            self._traces_f.write(json.dumps(rec, default=str) + "\n")
+            self._traces_f.flush()
+        if self.tracer is not None:
+            args = {"trace": ctx.trace_id, "status": status}
+            if fields:
+                args.update(fields)
+            self.tracer.complete_at(name, t_wall, dur_s, **args)
+        return rec
+
     # ----- spans ------------------------------------------------------------ #
     def span(self, name, **args):
         import contextlib
@@ -426,6 +466,9 @@ class StepTelemetry:
         with self._write_lock:   # same shared-owner ordering as record():
             if not self._closed:     # a finally-path flush after another
                 self._f.flush()      # owner's close() must not raise
+        with self._traces_lock:
+            if self._traces_f is not None and not self._traces_f.closed:
+                self._traces_f.flush()
         if self.tracer is not None:
             self.tracer.flush()
 
@@ -442,6 +485,14 @@ class StepTelemetry:
             except OSError:  # pragma: no cover - exotic filesystems
                 pass
             self._f.close()
+        with self._traces_lock:
+            if self._traces_f is not None and not self._traces_f.closed:
+                self._traces_f.flush()
+                try:
+                    os.fsync(self._traces_f.fileno())
+                except OSError:  # pragma: no cover - exotic filesystems
+                    pass
+                self._traces_f.close()
         if self.tracer is not None:
             self.tracer.close()           # deactivates + terminates JSON
 

@@ -69,6 +69,7 @@ class ServeFuture(Future):
         self.bucket: Optional[int] = None
         self.latency_s: Optional[float] = None
         self._t_submit = time.perf_counter()
+        self._trace = None           # sampled TraceContext, or None
 
 
 # --------------------------------------------------------------------------- #
@@ -476,14 +477,18 @@ class ServingEngine:
         self._dispatcher.start()
 
     # ----- request surface -------------------------------------------------- #
-    def submit(self, feature,
-               timeout: Optional[float] = None) -> ServeFuture:
+    def submit(self, feature, timeout: Optional[float] = None,
+               trace=None) -> ServeFuture:
         """Enqueue one activity (array tree or ``Sample``); returns a
         future.  Blocks when ``queue_capacity`` requests are pending;
         with ``timeout``, a queue still full after that many seconds
         raises ``concurrent.futures.TimeoutError`` instead of waiting
-        for the backlog to drain."""
+        for the backlog to drain.  ``trace`` (an already-sampled
+        ``TraceContext``) rides the future: the serving tick records
+        queue-wait/device spans for it (docs/observability.md,
+        "Request tracing")."""
         fut = ServeFuture()
+        fut._trace = trace
         deadline = None if timeout is None \
             else time.perf_counter() + timeout
         with self._lock:
@@ -515,7 +520,8 @@ class ServingEngine:
             self._not_empty.notify()
         return fut
 
-    def predict(self, feature, timeout: Optional[float] = None):
+    def predict(self, feature, timeout: Optional[float] = None,
+                trace=None):
         """Blocking single-request predict (the PredictionService
         surface): submit, wait, return this request's output rows.
         ``timeout`` bounds the WHOLE call -- admission into a full
@@ -524,7 +530,7 @@ class ServingEngine:
         drops it (a timeout/retry loop must not fill the queue with
         zombie requests nobody will read)."""
         t0 = time.perf_counter()
-        fut = self.submit(feature, timeout=timeout)
+        fut = self.submit(feature, timeout=timeout, trace=trace)
         remaining = None if timeout is None \
             else max(0.0, timeout - (time.perf_counter() - t0))
         try:
@@ -621,7 +627,7 @@ class ServingEngine:
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  eos_id: Optional[int] = None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None, trace=None):
         """Autoregressive generation: enqueue a prompt (1-D token ids)
         onto the continuous-batching decode scheduler; returns a
         streaming ``GenerateFuture`` (``.stream()`` yields tokens as
@@ -642,7 +648,8 @@ class ServingEngine:
                     "undrain()); in-flight generations still complete")
         return self._generation().submit(prompt,
                                          max_new_tokens=max_new_tokens,
-                                         eos_id=eos_id, timeout=timeout)
+                                         eos_id=eos_id, timeout=timeout,
+                                         trace=trace)
 
     def predict_at(self, feature, bucket: int):
         """UNBATCHED reference predict: this one request, padded to
@@ -912,10 +919,46 @@ class ServingEngine:
                     # a shape leak -- scrapeable live as
                     # bigdl_serving_recompiles_total
                     event["compiles"] = compiles
+                traced = [f for f in futs if f._trace is not None]
+                if traced:
+                    # parallel trace-id list (null for untraced rows):
+                    # the metrics bridge zips it with request_latency_s
+                    # so latency-histogram buckets carry exemplars
+                    event["request_traces"] = [
+                        f._trace.trace_id if f._trace is not None
+                        else None for f in futs]
                 self.telemetry.record("inference", **event)
+                if traced:
+                    self._record_tick_trace(traced, t0, t_formed,
+                                            t_done, bucket)
             except Exception:     # results are already delivered --
                 log.exception(    # never let telemetry kill the dispatcher
                     "serving telemetry record failed (tick %d)", self._tick)
+
+    def _record_tick_trace(self, traced, t0, t_formed, t_done, bucket):
+        """Request-trace spans for one serving tick
+        (docs/observability.md, "Request tracing"): one
+        ``engine_request`` span per traced request (queue wait + device
+        time under its own trace_id) and ONE ``serve_tick`` span
+        carrying links to every trace riding the batch -- continuous
+        batching means N request spans share one device dispatch."""
+        emit = getattr(self.telemetry, "record_trace", None)
+        if emit is None:
+            return
+        from bigdl_tpu.observability.tracing import TraceContext
+
+        now = time.time()
+        links = []
+        for f in traced:
+            ctx = f._trace.child()
+            links.append(ctx.trace_id)
+            emit("engine_request", ctx, now - f.latency_s, f.latency_s,
+                 queue_wait_s=round(max(0.0, t0 - f._t_submit), 6),
+                 device_s=round(t_done - t_formed, 6),
+                 tick=self._tick, bucket=int(bucket))
+        emit("serve_tick", TraceContext.mint(), now - (t_done - t0),
+             t_done - t0, links=links, records=len(traced),
+             tick=self._tick, bucket=int(bucket))
 
     # ----- int8 path: gate + staging helpers -------------------------------- #
     @property

@@ -57,6 +57,8 @@ import time
 from collections import deque
 
 from bigdl_tpu.observability.profiling import percentile
+from bigdl_tpu.observability.tracing import (HeadSampler, RequestTrace,
+                                             TraceContext)
 from bigdl_tpu.optim.recovery import capped_backoff
 
 log = logging.getLogger("bigdl_tpu.serving")
@@ -242,19 +244,21 @@ class InProcessReplica(Replica):
         self.engine = engine
 
     # -- routing -- #
-    def submit(self, feature, timeout=None, admit_timeout=None):
+    def submit(self, feature, timeout=None, admit_timeout=None,
+               trace=None):
         # admit_timeout bounds QUEUE ADMISSION only; the result wait is
         # the fleet's, bounded by the request deadline (timeout)
         t = admit_timeout if admit_timeout is not None else timeout
-        return self.engine.submit(feature, timeout=t)
+        return self.engine.submit(feature, timeout=t, trace=trace)
 
-    def submit_generate(self, req, timeout=None, admit_timeout=None):
+    def submit_generate(self, req, timeout=None, admit_timeout=None,
+                        trace=None):
         # req: {"prompt", "max_new_tokens", "eos_id"}; returns the
         # engine's streaming GenerateFuture (result() -> token list)
         t = admit_timeout if admit_timeout is not None else timeout
         return self.engine.generate(
             req["prompt"], max_new_tokens=req.get("max_new_tokens", 16),
-            eos_id=req.get("eos_id"), timeout=t)
+            eos_id=req.get("eos_id"), timeout=t, trace=trace)
 
     def abandon(self, fut):
         if hasattr(fut, "_t_submit"):          # a ServeFuture: free its
@@ -357,7 +361,8 @@ class SubprocessReplica(Replica):
                            or self.request_timeout_s, **kw)
 
     # -- routing -- #
-    def submit(self, feature, timeout=None, admit_timeout=None):
+    def submit(self, feature, timeout=None, admit_timeout=None,
+               trace=None):
         # the worker-side predict gets the request's REMAINING deadline
         # (admission and result are one RPC over there -- the fleet's
         # queue-admission bound must NOT cap the whole predict); the
@@ -368,11 +373,16 @@ class SubprocessReplica(Replica):
                                "ServingFleet first)")
         rpc = self.request_timeout_s if timeout is None \
             else float(timeout) + 5.0
+        kw = {"feature": feature, "timeout": timeout}
+        if trace is not None:
+            # the versioned wire form of the trace context: an OPTIONAL
+            # request field a traceless (older) worker never reads
+            kw["trace"] = trace.to_wire()
         return self._executor.submit(
-            self._call, "predict", rpc_timeout=rpc, feature=feature,
-            timeout=timeout)
+            self._call, "predict", rpc_timeout=rpc, **kw)
 
-    def submit_generate(self, req, timeout=None, admit_timeout=None):
+    def submit_generate(self, req, timeout=None, admit_timeout=None,
+                        trace=None):
         # one RPC per whole generation: the worker's engine streams
         # internally, the socket answers with the finished token list
         if self._executor is None:
@@ -381,11 +391,13 @@ class SubprocessReplica(Replica):
                                "ServingFleet first)")
         rpc = self.request_timeout_s if timeout is None \
             else float(timeout) + 5.0
+        kw = {"prompt": [int(t) for t in req["prompt"]],
+              "max_new_tokens": int(req.get("max_new_tokens", 16)),
+              "eos_id": req.get("eos_id"), "timeout": timeout}
+        if trace is not None:
+            kw["trace"] = trace.to_wire()
         return self._executor.submit(
-            self._call, "generate", rpc_timeout=rpc,
-            prompt=[int(t) for t in req["prompt"]],
-            max_new_tokens=int(req.get("max_new_tokens", 16)),
-            eos_id=req.get("eos_id"), timeout=timeout)
+            self._call, "generate", rpc_timeout=rpc, **kw)
 
     def abandon(self, fut):
         fut.cancel()          # a running RPC finishes on the worker and
@@ -500,7 +512,8 @@ class ServingFleet:
                  hedge_min_samples=20, breaker_failures=3,
                  breaker_reset_s=2.0, probe_features=None,
                  probe_bucket=None, rng=None, clock=time.monotonic,
-                 sleep=time.sleep, executor_workers=None):
+                 sleep=time.sleep, executor_workers=None,
+                 trace_sample=None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         if int(admission_limit) < 1:
@@ -526,6 +539,13 @@ class ServingFleet:
         self.rng = rng
         self.clock = clock
         self.sleep = sleep
+        # distributed request tracing (docs/observability.md, "Request
+        # tracing"): head-sampled at the rate given (default: the
+        # BIGDL_TRACE_SAMPLE env knob), active only when telemetry can
+        # durably record the spans -- without telemetry the request
+        # path never mints a context (the no-op-cost contract)
+        self._sampler = HeadSampler(trace_sample)
+        self._tracing = telemetry is not None
         self._lock = threading.Lock()
         self._inflight_total = 0
         self._closed = False
@@ -658,6 +678,15 @@ class ServingFleet:
         budget = self.default_timeout_s if timeout is None \
             else float(timeout)
         deadline = self.clock() + budget
+        # trace root: minted HERE, before admission, so even a shed
+        # request has an identity.  The keep/drop decision is deferred
+        # to completion (RequestTrace): errors/sheds/p99 tails override
+        # an unsampled head decision and always reach traces.jsonl.
+        rt, t_req = None, 0.0
+        if self._tracing:
+            rt = RequestTrace(
+                TraceContext.mint(sampled=self._sampler.sample()))
+            t_req = time.time()
         with self._lock:
             if self._inflight_total >= self.admission_limit:
                 self._counters["shed"] += 1
@@ -668,21 +697,38 @@ class ServingFleet:
         if shed:
             self._inc("requests", outcome="shed")
             self._inc("sheds")
+            if rt is not None:
+                rt.add("fleet_request", rt.ctx, t_req, 0.0,
+                       status="shed", op=op)
+                rt.flush(self.telemetry)
             raise FleetOverloadedError(
                 f"fleet admission window full ({self.admission_limit} "
                 f"requests in flight); shedding instead of queueing -- "
                 f"retry with backoff")
         try:
-            y = self._serve(feature, deadline, op=op, hedge_ok=hedge_ok)
-        except Exception:
+            y = self._serve(feature, deadline, op=op, hedge_ok=hedge_ok,
+                            rt=rt)
+        except Exception as e:
             with self._lock:
                 self._counters["failed"] += 1
             self._inc("requests", outcome="failed")
+            if rt is not None:
+                rt.add("fleet_request", rt.ctx, t_req,
+                       time.time() - t_req,
+                       status="error:" + type(e).__name__, op=op)
+                rt.flush(self.telemetry)
             raise
         else:
             with self._lock:
                 self._counters["ok"] += 1
             self._inc("requests", outcome="ok")
+            if rt is not None:
+                dur = time.time() - t_req
+                rt.add("fleet_request", rt.ctx, t_req, dur,
+                       status="ok", op=op)
+                if op == "submit" and self._tail_latency(dur):
+                    rt.force()      # p99-tail override: keep the slow ones
+                rt.flush(self.telemetry)
             return y
         finally:
             with self._lock:
@@ -720,16 +766,26 @@ class ServingFleet:
         return isinstance(err, EngineDraining) or \
             getattr(err, "error_type", None) == "EngineDraining"
 
-    def _launch(self, rep, feature, remaining, op="submit"):
+    def _launch(self, rep, feature, remaining, op="submit",
+                trace=None):
         with self._lock:
             rep.inflight += 1
         if self._m is not None:
             self._m["inflight"].set(rep.inflight, replica=str(rep.rid))
         t0 = self.clock()
+        # the context crosses into the replica only when the head
+        # sampler kept it: a late-forced (error-path) trace keeps its
+        # fleet spans but does no remote work -- and the kwarg is
+        # omitted entirely otherwise, so replica implementations
+        # predating the trace parameter keep working untraced
+        kw = {}
+        if trace is not None and trace.sampled:
+            kw["trace"] = trace
         try:
             fut = getattr(rep, op)(
                 feature, timeout=remaining,
-                admit_timeout=min(remaining, self.submit_timeout_s))
+                admit_timeout=min(remaining, self.submit_timeout_s),
+                **kw)
         except Exception as e:
             with self._lock:
                 rep.inflight = max(0, rep.inflight - 1)
@@ -775,6 +831,16 @@ class ServingFleet:
         with self._lock:
             self._latencies.append(float(s))
 
+    def _tail_latency(self, s):
+        """True when this request's latency lands beyond the p99 of
+        the latency reservoir -- the always-sample override that keeps
+        the slow tail reconstructable even at a 1% head rate."""
+        with self._lock:
+            if len(self._latencies) < self.hedge_min_samples:
+                return False
+            samples = sorted(self._latencies)
+        return s > percentile(samples, 99.0)
+
     def _hedge_delay(self):
         """The p99-derived hedge trigger, or None while hedging is off
         / uncalibrated (fewer than ``hedge_min_samples`` latencies)."""
@@ -795,13 +861,31 @@ class ServingFleet:
         if b > 0:
             self.sleep(b)
 
-    def _serve(self, feature, deadline, op="submit", hedge_ok=True):
+    def _serve(self, feature, deadline, op="submit", hedge_ok=True,
+               rt=None):
         from concurrent.futures import FIRST_COMPLETED
         from concurrent.futures import wait as future_wait
 
         attempts = 0                  # failed rounds so far
         failed_rids = []
         last_err = None
+        # per-attempt trace spans: fut -> (child ctx, wall start,
+        # replica id, was-a-hedge).  Statuses are recorded HERE, on the
+        # request thread at the moment each outcome is decided --
+        # recording in the done-callback would race the final flush
+        # (an abandoned in-process future resolves on a later tick,
+        # possibly after the winner already returned).
+        spans = {}
+
+        def note(f, status):
+            if rt is None or f not in spans:
+                return
+            ctx, ts, rid, is_hedge = spans.pop(f)
+            kw = {"replica": rid, "op": op}
+            if is_hedge:
+                kw["hedge"] = True
+            rt.add("fleet_attempt", ctx, ts, time.time() - ts,
+                   status=status, **kw)
 
         def give_up(msg):
             raise FleetUnavailableError(
@@ -827,12 +911,21 @@ class ServingFleet:
                 self._backoff_sleep(attempts, deadline)
                 continue
             futs = {}
+            actx = rt.ctx.child() if rt is not None else None
             try:
-                fut = self._launch(rep, feature, remaining, op=op)
+                fut = self._launch(rep, feature, remaining, op=op,
+                                   trace=actx)
                 futs[fut] = rep
+                if rt is not None:
+                    spans[fut] = (actx, time.time(), rep.rid, False)
             except Exception as e:
                 last_err = e
                 failed_rids.append(rep.rid)
+                if rt is not None:
+                    now = time.time()
+                    rt.add("fleet_attempt", actx, now, 0.0,
+                           status="error:" + type(e).__name__,
+                           replica=rep.rid, op=op)
                 attempts += 1
                 if attempts > self.retry_limit:
                     give_up("request failed")
@@ -850,6 +943,7 @@ class ServingFleet:
                 if remaining <= 0:
                     for f, r in futs.items():
                         r.abandon(f)
+                        note(f, "error:deadline")
                     give_up("request deadline exhausted mid-attempt")
                 wait_s, hedge_due = remaining, False
                 if not hedged and delay is not None and delay < wait_s:
@@ -865,17 +959,25 @@ class ServingFleet:
                     for f, r in futs.items():
                         if f is not winner:
                             r.abandon(f)
+                            # the only way two futures race is a hedge:
+                            # the still-pending half of the pair is THE
+                            # one hedge_lost span of the request
+                            note(f, "hedge_lost")
                     # a hedge "win" means the second replica beat a
                     # primary that was STILL pending -- a hedge that
                     # merely outlived an already-failed primary is not
                     # a tail-latency win
                     if winner is not primary and primary in futs:
                         self._count("hedge_wins")
+                    note(winner, "ok")
                     return winner.result()
                 for f in done:             # failures/cancellations
                     r = futs.pop(f)
                     if not f.cancelled():
                         last_err = f.exception()
+                        note(f, "error:" + type(last_err).__name__)
+                    else:
+                        note(f, "cancelled")
                     failed_rids.append(r.rid)
                 if not futs:
                     break                  # whole round failed -> retry
@@ -885,14 +987,27 @@ class ServingFleet:
                         exclude=[r.rid for r in futs.values()],
                         prefer_not=failed_rids)
                     if second is not None:
+                        actx2 = rt.ctx.child() if rt is not None \
+                            else None
                         try:
                             f2 = self._launch(second, feature,
-                                              remaining, op=op)
+                                              remaining, op=op,
+                                              trace=actx2)
                             futs[f2] = second
+                            if rt is not None:
+                                spans[f2] = (actx2, time.time(),
+                                             second.rid, True)
                             self._count("hedges")
                         except Exception as e:
                             last_err = e
                             failed_rids.append(second.rid)
+                            if rt is not None:
+                                rt.add("fleet_attempt", actx2,
+                                       time.time(), 0.0,
+                                       status="error:"
+                                       + type(e).__name__,
+                                       replica=second.rid, op=op,
+                                       hedge=True)
             attempts += 1
             if attempts > self.retry_limit:
                 give_up("request failed")

@@ -137,7 +137,18 @@ class GenerateFuture(Future):
         self.eos_id = eos_id
         self.finish_reason: Optional[str] = None
         self.latency_s: Optional[float] = None
+        #: latency_s split at slot admission: time queued waiting for a
+        #: free decode slot vs time actually prefilling/decoding (one
+        #: mixed number hides queue pressure behind decode speed)
+        self.queue_wait_s: Optional[float] = None
+        self.decode_s: Optional[float] = None
         self._t_submit = time.perf_counter()
+        #: wall-clock twin of _t_submit, anchoring trace records
+        self._t_submit_wall = time.time()
+        #: perf_counter stamp when a prefill tick admitted us to a slot
+        self._t_admit: Optional[float] = None
+        #: sampled TraceContext from the submitting engine, or None
+        self._trace = None
         self._stream: "queue.Queue" = queue.Queue()
         #: set by GenerateScheduler._abandon on a CLAIMED request: the
         #: dispatcher evicts the sequence at the next tick boundary
@@ -270,7 +281,8 @@ class GenerateScheduler:
     # ----- request surface -------------------------------------------------- #
     def submit(self, prompt, max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
-               timeout: Optional[float] = None) -> GenerateFuture:
+               timeout: Optional[float] = None,
+               trace=None) -> GenerateFuture:
         """Enqueue one prompt (1-D int token ids); returns the
         streaming future.  Blocks when ``queue_capacity`` requests are
         pending (``timeout`` bounds the wait, like engine.submit)."""
@@ -287,6 +299,7 @@ class GenerateScheduler:
                 f"{self.max_len}; raise decode_max_len or trim the "
                 f"request")
         fut = GenerateFuture(prompt.size, max_new_tokens, eos_id)
+        fut._trace = trace
         deadline = None if timeout is None \
             else time.perf_counter() + timeout
         with self._lock:
@@ -409,6 +422,8 @@ class GenerateScheduler:
 
     def _run_prefill(self, reqs, qdepth):
         t0 = time.perf_counter()
+        for _p, f in reqs:
+            f._t_admit = t0          # queue wait ends at slot admission
         execs_before = self._compiles()
         n = len(reqs)
         bucket = self.batch_ladder.bucket_for(n) or self.batch_ladder.add(n)
@@ -443,7 +458,8 @@ class GenerateScheduler:
         self._record_tick("prefill", t0, records=n, tokens=n,
                           bucket=int(bucket), prompt_bucket=int(t_pad),
                           qdepth=qdepth, execs_before=execs_before,
-                          latencies=done_lat)
+                          latencies=done_lat,
+                          riders=[f for _p, f in reqs])
 
     def _run_decode(self, qdepth):
         t0 = time.perf_counter()
@@ -474,7 +490,8 @@ class GenerateScheduler:
         self._tick += 1
         self._record_tick("decode", t0, records=0, tokens=len(active),
                           qdepth=qdepth, execs_before=execs_before,
-                          latencies=done_lat, slots_before=len(active))
+                          latencies=done_lat, slots_before=len(active),
+                          riders=[slot.fut for _i, slot in active])
 
     def _tick_failed(self, e, futs, extra_free):
         """A failed tick is a POOL loss, not just this tick's: both
@@ -535,9 +552,10 @@ class GenerateScheduler:
             with self._lock:
                 self._free.append(i)
             fut.finish_reason = "abandoned"
-            fut.latency_s = time.perf_counter() - fut._t_submit
+            self._stamp_latency(fut)
             fut._stream.put(None)
             fut.set_result(list(slot.tokens))
+            self._record_request_trace(fut, len(slot.tokens))
 
     def _deliver(self, index, slot, done_lat):
         """Stream the slot's newest token; complete + free the slot on
@@ -556,15 +574,45 @@ class GenerateScheduler:
         with self._lock:
             self._free.append(index)
         fut.finish_reason = reason
-        fut.latency_s = time.perf_counter() - fut._t_submit
-        done_lat.append(fut.latency_s)
+        self._stamp_latency(fut)
+        done_lat.append(fut)
         self._served += 1
         fut._stream.put(None)
         fut.set_result(list(slot.tokens))
+        self._record_request_trace(fut, len(slot.tokens))
+
+    @staticmethod
+    def _stamp_latency(fut):
+        """Set latency_s and its queue-wait/decode split on a finished
+        future (admit stamp missing => the whole latency was a wait)."""
+        now = time.perf_counter()
+        fut.latency_s = now - fut._t_submit
+        admit = fut._t_admit if fut._t_admit is not None else now
+        fut.queue_wait_s = max(0.0, admit - fut._t_submit)
+        fut.decode_s = max(0.0, now - admit)
+
+    def _record_request_trace(self, fut, n_tokens):
+        """Completion span for one traced generation -- the decode-side
+        mirror of the fleet's root span, carrying the queue-wait vs
+        decode split and every token's tick story via the tick links."""
+        if fut._trace is None or self.telemetry is None:
+            return
+        emit = getattr(self.telemetry, "record_trace", None)
+        if emit is None:
+            return
+        try:
+            emit("generate_request", fut._trace.child(),
+                 fut._t_submit_wall, fut.latency_s or 0.0,
+                 queue_wait_s=round(fut.queue_wait_s or 0.0, 6),
+                 decode_s=round(fut.decode_s or 0.0, 6),
+                 tokens=n_tokens, finish_reason=fut.finish_reason)
+        except Exception:
+            log.exception("generation trace record failed")
 
     def _record_tick(self, kind, t0, records, tokens, qdepth,
                      execs_before, latencies, bucket=None,
-                     prompt_bucket=None, slots_before=None):
+                     prompt_bucket=None, slots_before=None,
+                     riders=None):
         self._tokens_out += tokens
         if self.telemetry is None:
             return
@@ -589,17 +637,56 @@ class GenerateScheduler:
                 # multi-token generation is seconds where a predict is
                 # milliseconds, and one mixed series would burn any
                 # predict-tuned latency SLO (and its canary auto-
-                # rollback) on perfectly healthy generate traffic
-                event["generate_latency_s"] = [round(l, 6)
-                                               for l in latencies]
+                # rollback) on perfectly healthy generate traffic.
+                # queue-wait and decode time land as SEPARATE series:
+                # one merged number read as "slow decode" when the real
+                # story was slot starvation
+                event["generate_latency_s"] = [round(f.latency_s, 6)
+                                               for f in latencies]
+                event["generate_queue_wait_s"] = [
+                    round(f.queue_wait_s or 0.0, 6) for f in latencies]
+                event["generate_decode_s"] = [
+                    round(f.decode_s or 0.0, 6) for f in latencies]
+                traces = [f._trace.trace_id if f._trace is not None
+                          else None for f in latencies]
+                if any(t is not None for t in traces):
+                    # parallel to generate_latency_s: the metrics
+                    # bridge zips the two for histogram exemplars
+                    event["generate_traces"] = traces
+            if riders:
+                tids = [f._trace.trace_id for f in riders
+                        if f._trace is not None]
+                if tids:
+                    # which traced sequences were RESIDENT this tick:
+                    # obs_report attributes slot occupancy by trace
+                    event["trace_ids"] = tids
             after = self._compiles()
             if after is not None and after - execs_before > 0:
                 # nonzero after precompile() = a generation shape leak
                 event["compiles"] = after - execs_before
             self.telemetry.record("inference", **event)
+            self._record_tick_trace(kind, wall, riders, records, tokens)
         except Exception:
             log.exception("generation telemetry record failed (tick %d)",
                           self._tick)
+
+    def _record_tick_trace(self, kind, wall, riders, records, tokens):
+        """One span per tick with links to every traced sequence that
+        rode it -- the continuous-batching shape (one tick, N resident
+        requests) is a links relationship, not parent/child, because
+        the tick belongs to ALL of them equally."""
+        emit = getattr(self.telemetry, "record_trace", None)
+        if emit is None or not riders:
+            return
+        links = [f._trace.trace_id for f in riders
+                 if f._trace is not None]
+        if not links:
+            return
+        from bigdl_tpu.observability.tracing import TraceContext
+
+        emit("%s_tick" % kind, TraceContext.mint(),
+             time.time() - wall, wall, links=links, tick=self._tick,
+             records=records, tokens=tokens)
 
     # ----- lifecycle -------------------------------------------------------- #
     def drain(self, timeout: Optional[float] = None) -> bool:

@@ -15,8 +15,13 @@ followed by a pickled payload.  Requests are ``{"op": ..., **kwargs}``;
 responses ``{"ok": True, "result": ...}`` or ``{"ok": False, "error":
 ..., "error_type": ...}``.  Ops:
 
-- ``predict``  {feature, timeout}   -> output tree (numpy leaves)
-- ``generate`` {prompt, max_new_tokens, eos_id, timeout} -> generated
+- ``predict``  {feature, timeout, trace?} -> output tree (numpy
+  leaves).  ``trace`` is the OPTIONAL versioned request-trace context
+  (``{"v": 1, "traceparent": ...}``, docs/observability.md "Request
+  tracing") -- absent from traceless clients and ignored by older
+  workers, so the field is backward-compatible in both directions
+- ``generate`` {prompt, max_new_tokens, eos_id, timeout, trace?} ->
+  generated
   token-id list (the engine's continuous-batching decode slots;
   tokens stream WITHIN the worker, the socket answers once the
   sequence finishes -- per-token streaming over this one-shot
@@ -53,6 +58,8 @@ import socket
 import socketserver
 import struct
 import threading
+
+from bigdl_tpu.observability.tracing import TraceContext
 
 log = logging.getLogger("bigdl_tpu.serving")
 
@@ -255,8 +262,13 @@ class ReplicaServer:
         import jax
         import numpy as np
 
+        # optional versioned trace field (docs/observability.md,
+        # "Request tracing"): a traceless/older client never sends it,
+        # a malformed one parses to None -- both serve untraced
+        trace = TraceContext.from_wire(req.get("trace"))
         y = self.engine.predict(req["feature"],
-                                timeout=req.get("timeout"))
+                                timeout=req.get("timeout"),
+                                trace=trace)
         return jax.tree.map(np.asarray, y)
 
     def _op_generate(self, req):
@@ -275,7 +287,8 @@ class ReplicaServer:
         fut = self.engine.generate(
             req["prompt"],
             max_new_tokens=int(req.get("max_new_tokens", 16)),
-            eos_id=req.get("eos_id"), timeout=timeout)
+            eos_id=req.get("eos_id"), timeout=timeout,
+            trace=TraceContext.from_wire(req.get("trace")))
         remaining = None if timeout is None \
             else max(0.0, timeout - (time.perf_counter() - t0))
         try:

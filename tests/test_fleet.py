@@ -816,7 +816,8 @@ class _StubWorker(Replica):
             raise RuntimeError("boot failed")
         self.proc = self._Proc()
 
-    def submit(self, feature, timeout=None, admit_timeout=None):
+    def submit(self, feature, timeout=None, admit_timeout=None,
+               trace=None):
         raise ConnectionRefusedError("stub")
 
     def close(self):

@@ -453,3 +453,50 @@ class TestDecodeRecords:
                    traj["metrics"]["serving_decode_tokens_ratio"])
         assert gate.main(["--dir", REPO, "--check", path,
                           "--require-trusted"]) == 0
+
+
+class TestTracedRecords:
+    """ISSUE-16 satellite: a bench record measured with always-sample
+    tracing enabled (BIGDL_TRACE_SAMPLE=1) carries the overhead of a
+    span write per request -- the gate must refuse it as a --check
+    candidate BEFORE trust classing, even when the record stamped its
+    own 'trusted' verdict."""
+
+    def _traced(self, value=10.0):
+        rec = _serve_record(value)
+        rec["extra"]["tracing"] = {"sample_rate": 1.0,
+                                   "always_sample": True}
+        return rec
+
+    def test_always_sample_overrides_own_trust_stamp(self, gate):
+        rec = self._traced()
+        rec["trust"] = "trusted"                 # the stamp loses
+        assert gate.classify_trust(rec) == "invalid:traced"
+        # a head-sampled run is NOT refused: 1% tracing is the
+        # production default the numbers should represent
+        ok = _serve_record(10.0)
+        ok["extra"]["tracing"] = {"sample_rate": 0.01,
+                                  "always_sample": False}
+        assert gate.classify_trust(ok) == "ratio"
+
+    def test_traced_candidate_is_refused(self, gate, tmp_path, capsys):
+        d = _bench_dir(tmp_path, {
+            "BENCH_r06.json": _wrapper([_serve_record(1.0)], n=6)})
+        cand = tmp_path / "BENCH_cand.json"
+        cand.write_text(json.dumps(self._traced(2.0)))  # even an
+        rc = gate.main(["--dir", d, "--check", str(cand)])  # improvement
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "always-sample tracing" in out
+
+    def test_traced_history_record_cannot_set_baseline(self, gate,
+                                                       tmp_path):
+        d = _bench_dir(tmp_path, {
+            "BENCH_r06.json": _wrapper([self._traced(5.0)], n=6),
+            "BENCH_r07.json": _wrapper([_serve_record(1.0)], n=7)})
+        traj = gate.build_trajectory(d)
+        entries = traj["metrics"]["serving_int8_rps_ratio"]
+        assert entries[0]["trust"] == "invalid:traced"
+        assert entries[0]["baseline_eligible"] is False
+        regs, _notes = gate.gate(traj)          # the inflated traced
+        assert not regs                         # round is NOT the bar

@@ -80,6 +80,24 @@ _hlo = importlib.util.module_from_spec(_hspec)
 _hspec.loader.exec_module(_hlo)
 format_hlo_summary_lines = _hlo.format_summary_lines
 
+# and for observability/spans.py (stdlib-only): its read_trace_events
+# is THE one crash-tolerant chrome-trace reader, shared with
+# tools/trace_report.py and the SpanTracer tests
+_sspec = importlib.util.spec_from_file_location(
+    "_obs_spans",
+    os.path.join(REPO, "bigdl_tpu", "observability", "spans.py"))
+_spans = importlib.util.module_from_spec(_sspec)
+_sspec.loader.exec_module(_spans)
+read_trace_events = _spans.read_trace_events
+
+# tools/trace_report.py stitches traces.jsonl spans into per-request
+# critical paths; the Tracing section below reuses it so the report
+# and the standalone tool can never disagree about a trace
+_tspec = importlib.util.spec_from_file_location(
+    "_obs_trace_report", os.path.join(REPO, "tools", "trace_report.py"))
+_trace_report = importlib.util.module_from_spec(_tspec)
+_tspec.loader.exec_module(_trace_report)
+
 
 def load_events(jsonl_path):
     """-> (header dict or None, [step events], [other events]).
@@ -109,22 +127,11 @@ def load_events(jsonl_path):
 
 
 def load_trace_events(trace_path):
-    """Chrome-trace events from either container format: the streamed
-    JSON array (possibly unterminated after a crash -- repaired here,
-    as Perfetto does) or the object form with a ``traceEvents`` key."""
-    try:
-        with open(trace_path) as f:
-            text = f.read()
-    except OSError:
-        return None
-    try:
-        doc = json.loads(text)
-    except ValueError:
-        try:   # unterminated streamed array from a crashed run
-            doc = json.loads(text.rstrip().rstrip(",") + "]")
-        except ValueError:
-            return None
-    return doc if isinstance(doc, list) else doc.get("traceEvents")
+    """Chrome-trace events from either container format (kept as an
+    alias: the shared implementation moved to
+    ``observability/spans.read_trace_events`` so every reader repairs
+    a crash-truncated streamed array the same way)."""
+    return read_trace_events(trace_path)
 
 
 def span_totals(trace_path):
@@ -344,6 +351,25 @@ def _serving_section(other, header=None):
         if glats:
             block["latency_s_p50"] = percentile(glats, 50)
             block["latency_s_p99"] = percentile(glats, 99)
+        # the segregated split (serving/generation.py): queue-wait
+        # p99 blowing up while decode p99 holds = slot starvation,
+        # not a slow model -- the merged latency alone can't say which
+        for field, key in (("generate_queue_wait_s", "queue_wait"),
+                           ("generate_decode_s", "decode")):
+            vals = sorted(l for e in gen for l in (e.get(field) or [])
+                          if _finite(l))
+            if vals:
+                block["%s_s_p50" % key] = percentile(vals, 50)
+                block["%s_s_p99" % key] = percentile(vals, 99)
+        # slot-occupancy attribution: which traced sequences were
+        # resident, and for how many ticks each rode the pool
+        rides = {}
+        for e in gen:
+            for tid in e.get("trace_ids") or []:
+                rides[tid] = rides.get(tid, 0) + 1
+        if rides:
+            block["traced_sequences"] = len(rides)
+            block["traced_tick_rides"] = sum(rides.values())
         slots = [e.get("slots_total") for e in gen if e.get("slots_total")]
         if slots:
             block["slots"] = max(slots)
@@ -602,6 +628,23 @@ def load_supervised_run(run_dir):
     return header, steps, other, summary
 
 
+def _tracing_section(run_dir):
+    """Distributed-tracing summary from ``traces.jsonl`` sinks under
+    the run dir (the driver's and, in a fleet artifact root, every
+    worker's): per-request critical paths stitched by trace_id via
+    tools/trace_report.py.  None for untraced runs."""
+    report = _trace_report.summarize([run_dir], limit=5)
+    if report["summary"]["records"] == 0:
+        return None
+    sec = dict(report["summary"])
+    sec["slowest"] = [
+        {"trace": c["trace"], "op": c.get("op"),
+         "status": c.get("status"), "total_s": c.get("total_s"),
+         "stages": c.get("stages") or {}, "ticks": c.get("ticks") or {}}
+        for c in report["traces"]]
+    return sec
+
+
 def build_report(run_dir, xplane_dir=None, top=10):
     jsonl = os.path.join(run_dir, "telemetry.jsonl")
     attempts_summary = None
@@ -726,6 +769,9 @@ def build_report(run_dir, xplane_dir=None, top=10):
     slo = _slo_section(other)
     if slo:
         rep["slo"] = slo
+    tracing = _tracing_section(run_dir)
+    if tracing:
+        rep["tracing"] = tracing
 
     rep["host_spans"] = span_totals(os.path.join(run_dir, "trace.json"))
 
@@ -984,6 +1030,18 @@ def format_report(rep):
                     f"generation latency p50/p99: "
                     f"{_fmt_s(gen['latency_s_p50'])} / "
                     f"{_fmt_s(gen.get('latency_s_p99'))}")
+            if gen.get("queue_wait_s_p50") is not None \
+                    or gen.get("decode_s_p50") is not None:
+                out.append(
+                    f"  split: slot-queue wait p50/p99 "
+                    f"{_fmt_s(gen.get('queue_wait_s_p50'))} / "
+                    f"{_fmt_s(gen.get('queue_wait_s_p99'))}   decode "
+                    f"p50/p99 {_fmt_s(gen.get('decode_s_p50'))} / "
+                    f"{_fmt_s(gen.get('decode_s_p99'))}")
+            if gen.get("traced_sequences"):
+                out.append(
+                    f"  traced sequences: {gen['traced_sequences']} "
+                    f"({gen['traced_tick_rides']} slot-tick rides)")
     fl = rep.get("fleet")
     if fl:
         line = f"fleet: {len(fl['replicas'])} replica(s)"
@@ -1012,6 +1070,26 @@ def format_report(rep):
             out.append("  breaker trail: " + ", ".join(
                 f"r{t.get('replica')} {t.get('from')}->{t.get('to')}"
                 for t in fl["breaker_transitions"][-8:]))
+    tr = rep.get("tracing")
+    if tr:
+        line = (f"tracing: {tr['traces']} trace(s) / {tr['records']} "
+                f"spans  ({tr['errors']} error, {tr['shed']} shed, "
+                f"{tr['retried']} ok-after-retry)")
+        if tr.get("hedged"):
+            line += (f"   hedged {tr['hedged']} (won {tr['hedge_won']},"
+                     f" hedge_lost spans {tr['hedge_lost_spans']})")
+        if tr.get("cross_process"):
+            line += f"   cross-process {tr['cross_process']}"
+        out.append(line)
+        for c in tr.get("slowest", [])[:5]:
+            ln = (f"  {c['trace'][:16]} {c.get('op')} "
+                  f"{c.get('status')} {_fmt_s(c.get('total_s'))}")
+            stages = c.get("stages") or {}
+            if stages:
+                ln += "  (" + ", ".join(
+                    f"{k.replace('_s', '')} {_fmt_s(v)}"
+                    for k, v in stages.items()) + ")"
+            out.append(ln)
     slo = rep.get("slo")
     if slo:
         for o in slo["objectives"]:
@@ -1115,7 +1193,8 @@ def main(argv=None):
         return 2
     if rep["n_steps"] == 0 and not any(
             rep.get(k) for k in ("serving", "recovery", "health",
-                                 "validations", "slo", "fleet")):
+                                 "validations", "slo", "fleet",
+                                 "tracing")):
         # an empty/truncated JSONL must FAIL in scripts, not render a
         # hollow report: zero step events and nothing else to show
         # means the run recorded nothing (broken telemetry hookup, or

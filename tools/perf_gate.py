@@ -129,10 +129,19 @@ def classify_trust(record):
     ``TimingAuditor.audit_record``; one claiming neither platform nor
     per-step timing is a host-side A/B ``ratio`` -- the taxonomy's
     device checks do not apply, and the ratio is reproducible evidence.
+
+    A bench manifest confessing always-sample tracing overrides even
+    the record's own stamp: every request paid span buffering and a
+    forced traces.jsonl flush, so the number measures tracing, not the
+    serving path (``invalid:traced``).  Records that predate the
+    manifest carry no ``tracing`` block and are unaffected.
     """
+    extra = record.get("extra", record) or {}
+    tracing = extra.get("tracing") or {}
+    if tracing.get("always_sample"):
+        return "invalid:traced"
     if record.get("trust"):
         return str(record["trust"])
-    extra = record.get("extra", record) or {}
     if extra.get("platform") is None and \
             extra.get("sec_per_step_blocked") is None and \
             extra.get("sec_per_step") is None:
@@ -210,6 +219,17 @@ def gate(trajectory, tolerance=0.05, require_trusted=False):
             history = [e for e in entries
                        if e is not cand and not e.get("candidate")
                        and e["baseline_eligible"]]
+            if cand["trust"] == "invalid:traced" \
+                    and cand.get("candidate"):
+                # unconditional: a --check candidate benched with
+                # always-sample tracing is refused outright (every
+                # request paid forced span flushes -- rerun the bench
+                # with tracing at the default sample rate)
+                regressions.append(
+                    f"{metric}: candidate ({cand['file']}) was "
+                    f"measured with always-sample tracing enabled -- "
+                    f"rerun without BIGDL_TRACE_SAMPLE=1")
+                continue
             if not cand["baseline_eligible"]:
                 msg = (f"{metric}: newest record ({cand['round']}) is "
                        f"not baseline-eligible (trust {cand['trust']}"

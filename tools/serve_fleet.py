@@ -96,6 +96,13 @@ def build_args(argv=None):
                          "drill, not hang it")
     ap.add_argument("--metricsPort", type=int, default=None,
                     help="serve /metrics + /healthz (0 auto-assigns)")
+    ap.add_argument("--traceSample", type=float, default=None,
+                    help="head-sample rate for per-request distributed "
+                         "tracing (1.0 = every request; default: the "
+                         "BIGDL_TRACE_SAMPLE env, 0.01).  Spans land in "
+                         "serve*/traces.jsonl (driver) and "
+                         "worker_<i>/traces.jsonl, stitchable with "
+                         "tools/trace_report.py")
     # internal spellings: this script spawning itself
     ap.add_argument("--role", choices=("driver", "worker"),
                     default="driver", help=argparse.SUPPRESS)
@@ -119,8 +126,24 @@ def run_worker(args):
 
     model, x, y, crit = build_workload(args)   # fixed seed: the driver's
     #                                            tree structure + weights
+    tel = None
+    if args.traceSample is not None and args.traceSample > 0:
+        # the worker-side traces.jsonl sink: engine spans for requests
+        # whose sampled context crossed the wire land HERE, in this
+        # process's artifact dir -- trace_report stitches them back to
+        # the driver's spans by trace_id
+        from bigdl_tpu.observability import StepTelemetry
+
+        wdir = os.path.join(args.out, f"worker_{args.replicaId}")
+        k = 1
+        while os.path.exists(wdir):   # a respawn keeps its predecessor's
+            wdir = os.path.join(      # trace evidence intact
+                args.out, f"worker_{args.replicaId}_r{k}")
+            k += 1
+        tel = StepTelemetry(wdir, run_name=f"worker_{args.replicaId}",
+                            trace=False)
     eng = ServingEngine(model, max_batch_size=args.maxBatch,
-                        max_wait_ms=args.maxWaitMs)
+                        max_wait_ms=args.maxWaitMs, telemetry=tel)
     eng.precompile(example_feature=x[0])
     booted = boot_from_registry(eng, args.registry)
     probe_bucket = min(4, args.maxBatch)
@@ -162,6 +185,8 @@ def make_spawn(args, rid):
                "--maxWaitMs", str(args.maxWaitMs),
                "--replicaId", str(rid), "--portFile", port_file,
                "--registry", os.path.join(args.out, "registry.json")]
+        if args.traceSample is not None:
+            cmd += ["--traceSample", str(args.traceSample)]
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -243,7 +268,8 @@ def run_driver(args):
     fleet = ServingFleet(replicas, telemetry=tel, metrics=metrics,
                          hedge=args.hedge, probe_features=probe_rows,
                          probe_bucket=probe_bucket,
-                         breaker_reset_s=1.0, retry_backoff_s=0.02)
+                         breaker_reset_s=1.0, retry_backoff_s=0.02,
+                         trace_sample=args.traceSample)
     supervisor = FleetSupervisor(fleet, max_restarts=3,
                                  backoff_base_s=0.3, backoff_max_s=5.0,
                                  jitter=0.25).start()

@@ -1,0 +1,290 @@
+"""Rebuild per-request critical paths from ``traces.jsonl`` records.
+
+``StepTelemetry.record_trace`` writes one durable JSONL line per span
+(see ``bigdl_tpu/observability/tracing.py`` and docs/observability.md,
+"Request tracing").  A request that crossed processes -- fleet driver,
+subprocess worker, engine dispatcher -- left spans in SEVERAL
+``traces.jsonl`` files, all sharing one ``trace`` id.  This tool
+stitches them back together:
+
+- group every span by trace_id across all the given run dirs / files
+  (a dir is walked, so pointing at a ``serve_fleet.py`` artifact root
+  picks up the driver's AND every worker's sink in one pass);
+- attach tick spans (``serve_tick`` / ``prefill_tick`` /
+  ``decode_tick``) to each trace their ``links`` name -- the
+  continuous-batching edge: one tick span, N request traces riding it;
+- derive the per-request critical path: fleet total, winning-attempt
+  routing, wire/RPC overhead (attempt minus the engine-side span, only
+  computable when the two sides landed in different processes and the
+  engine span exists), engine queue wait, device time, and for
+  generation the queue-wait vs decode split plus every decode tick the
+  sequence rode;
+- attribute hedges: which attempt won, how many ``hedge_lost`` spans a
+  hedged pair recorded, error/retry chains by status.
+
+    python tools/trace_report.py RUN_DIR [RUN_DIR ...] \
+        [--trace ID] [--limit N] [--format json]
+
+Crash-tolerant like every other artifact reader here: a truncated
+final line from a SIGKILLed worker is skipped, not fatal.  Exits
+nonzero when ZERO trace records are found -- a hollow report passing
+in scripts is how a dead tracing hookup hides.
+
+No jax import -- runs anywhere the artifacts were copied.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+
+#: span names emitted per-tick with ``links`` instead of a parent in
+#: the request's own trace (one tick serves many requests)
+TICK_NAMES = ("serve_tick", "prefill_tick", "decode_tick")
+
+
+def iter_trace_files(paths):
+    """Yield every ``traces.jsonl`` under the given files/dirs."""
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in sorted(os.walk(p)):
+                for fn in sorted(files):
+                    if fn == "traces.jsonl":
+                        yield os.path.join(root, fn)
+        elif os.path.exists(p):
+            yield p
+
+
+def load_records(paths):
+    """Every parseable span record from every sink, crash-tolerant."""
+    records = []
+    for path in iter_trace_files(paths):
+        try:
+            f = open(path, errors="replace")
+        except OSError:
+            continue
+        with f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue    # truncated tail of a killed process
+                if isinstance(rec, dict) and rec.get("trace"):
+                    records.append(rec)
+    return records
+
+
+def build_trace_index(records):
+    """-> {trace_id: {"spans": [...], "ticks": [...]}}.
+
+    A tick span lands under EVERY trace its ``links`` field names (and
+    never under its own trace_id -- its own id is a fresh mint that no
+    request owns)."""
+    index = {}
+    for rec in records:
+        if rec.get("name") in TICK_NAMES:
+            for tid in rec.get("links") or []:
+                index.setdefault(tid, {"spans": [], "ticks": []})
+            continue
+        index.setdefault(rec["trace"], {"spans": [], "ticks": []})
+    for rec in records:
+        if rec.get("name") in TICK_NAMES:
+            for tid in rec.get("links") or []:
+                if tid in index:
+                    index[tid]["ticks"].append(rec)
+        elif rec["trace"] in index:
+            index[rec["trace"]]["spans"].append(rec)
+    for entry in index.values():
+        entry["spans"].sort(key=lambda r: r.get("ts") or 0.0)
+        entry["ticks"].sort(key=lambda r: r.get("ts") or 0.0)
+    # drop traces we only know from tick links (their own spans were
+    # unsampled or lost with a crashed sink): nothing to report on
+    return {tid: e for tid, e in index.items() if e["spans"]}
+
+
+def _pick(spans, name, status=None):
+    out = []
+    for s in spans:
+        if s.get("name") != name:
+            continue
+        if status is not None and s.get("status") != status:
+            continue
+        out.append(s)
+    return out
+
+
+def critical_path(trace_id, entry):
+    """One trace's stitched timeline + per-stage breakdown."""
+    spans, ticks = entry["spans"], entry["ticks"]
+    root = (_pick(spans, "fleet_request") or [None])[0]
+    attempts = _pick(spans, "fleet_attempt")
+    engine = _pick(spans, "engine_request")
+    gen = _pick(spans, "generate_request")
+    cp = {
+        "trace": trace_id,
+        "op": (root or {}).get("op"),
+        "status": (root or {}).get("status"),
+        "start_ts": min((s.get("ts") or 0.0) for s in spans),
+        "total_s": (root or {}).get("dur_s"),
+        "processes": sorted({(s.get("process"), s.get("pid"))
+                             for s in spans}),
+        "spans": len(spans),
+        "attempts": [{"replica": a.get("replica"),
+                      "status": a.get("status"),
+                      "dur_s": a.get("dur_s"),
+                      "hedge": bool(a.get("hedge"))}
+                     for a in attempts],
+        "hedge_lost": sum(1 for a in attempts
+                          if a.get("status") == "hedge_lost"),
+        "errors": [a.get("status") for a in attempts
+                   if str(a.get("status", "")).startswith("error:")],
+        "ticks": {k: sum(1 for t in ticks if t.get("name") == k)
+                  for k in TICK_NAMES if any(t.get("name") == k
+                                             for t in ticks)},
+    }
+    winner = (_pick(spans, "fleet_attempt", "ok") or [None])[0]
+    if winner is not None:
+        cp["winning_attempt_s"] = winner.get("dur_s")
+        cp["hedge_won"] = bool(winner.get("hedge"))
+    stages = {}
+    if engine:
+        e = engine[-1]
+        stages["engine_queue_wait_s"] = e.get("queue_wait_s")
+        stages["engine_device_s"] = e.get("device_s")
+    if gen:
+        g = gen[-1]
+        stages["generate_queue_wait_s"] = g.get("queue_wait_s")
+        stages["generate_decode_s"] = g.get("decode_s")
+        cp["tokens"] = g.get("tokens")
+        cp["finish_reason"] = g.get("finish_reason")
+    # wire/RPC overhead: the winning attempt's time not accounted for
+    # by the engine-side span -- meaningful only cross-process (the
+    # in-process engine span overlaps the attempt almost exactly)
+    served = (gen or engine or [None])[-1]
+    if winner is not None and served is not None \
+            and winner.get("dur_s") is not None \
+            and served.get("dur_s") is not None \
+            and served.get("pid") != winner.get("pid"):
+        stages["wire_s"] = round(
+            max(0.0, winner["dur_s"] - served["dur_s"]), 6)
+    cp["stages"] = stages
+    return cp
+
+
+def summarize(paths, trace_filter=None, limit=None):
+    """The full report dict: per-trace critical paths + aggregates."""
+    records = load_records(paths)
+    index = build_trace_index(records)
+    if trace_filter:
+        index = {t: e for t, e in index.items()
+                 if t.startswith(trace_filter)}
+    traces = [critical_path(t, e) for t, e in index.items()]
+    traces.sort(key=lambda c: -(c.get("total_s") or 0.0))
+    agg = {
+        "records": len(records),
+        "traces": len(traces),
+        "errors": sum(1 for c in traces
+                      if str(c.get("status", "")).startswith("error:")),
+        "shed": sum(1 for c in traces if c.get("status") == "shed"),
+        "retried": sum(1 for c in traces if c["errors"]
+                       and c.get("status") == "ok"),
+        "hedged": sum(1 for c in traces
+                      if any(a["hedge"] for a in c["attempts"])),
+        "hedge_won": sum(1 for c in traces if c.get("hedge_won")),
+        "hedge_lost_spans": sum(c["hedge_lost"] for c in traces),
+        "cross_process": sum(1 for c in traces
+                             if len(c["processes"]) > 1),
+    }
+    if limit is not None:
+        traces = traces[:limit]
+    return {"summary": agg, "traces": traces}
+
+
+# --------------------------------------------------------------------------- #
+# Rendering.
+# --------------------------------------------------------------------------- #
+
+
+def _ms(v):
+    if v is None:
+        return "-"
+    return "%.2fms" % (float(v) * 1e3)
+
+
+def render_text(report):
+    agg = report["summary"]
+    lines = ["== Trace report ==",
+             "traces %d (spans %d): %d ok-after-retry, %d error, "
+             "%d shed; hedged %d (won %d, hedge_lost spans %d); "
+             "cross-process %d"
+             % (agg["traces"], agg["records"], agg["retried"],
+                agg["errors"], agg["shed"], agg["hedged"],
+                agg["hedge_won"], agg["hedge_lost_spans"],
+                agg["cross_process"])]
+    for cp in report["traces"]:
+        procs = "+".join(sorted({str(p) for p, _pid in cp["processes"]}))
+        head = ("-- %s  op=%s status=%s total=%s  [%s]"
+                % (cp["trace"], cp["op"], cp["status"],
+                   _ms(cp["total_s"]), procs))
+        lines.append(head)
+        for a in cp["attempts"]:
+            lines.append("   attempt replica=%s%s %s %s"
+                         % (a["replica"],
+                            " (hedge)" if a["hedge"] else "",
+                            a["status"], _ms(a["dur_s"])))
+        st = cp["stages"]
+        if st:
+            lines.append("   stages: " + "  ".join(
+                "%s=%s" % (k.replace("_s", ""), _ms(v))
+                for k, v in st.items()))
+        if cp["ticks"]:
+            lines.append("   ticks:  " + "  ".join(
+                "%s=%d" % (k, n) for k, n in sorted(cp["ticks"].items()))
+                + ("  tokens=%s" % cp["tokens"]
+                   if cp.get("tokens") is not None else ""))
+    return "\n".join(lines)
+
+
+def _sanitize(obj):
+    """Non-finite floats -> null, for strictly valid --format json."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="stitch traces.jsonl spans into per-request "
+                    "critical paths")
+    ap.add_argument("paths", nargs="+",
+                    help="run dirs (walked for traces.jsonl) or files")
+    ap.add_argument("--trace", default=None,
+                    help="only traces whose id starts with this prefix")
+    ap.add_argument("--limit", type=int, default=20,
+                    help="show the N slowest traces (default 20)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    report = summarize(args.paths, trace_filter=args.trace,
+                       limit=args.limit)
+    if report["summary"]["records"] == 0:
+        print("trace_report: no trace records found under: %s"
+              % ", ".join(args.paths), file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(_sanitize(report), indent=2, sort_keys=True))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
