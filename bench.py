@@ -950,6 +950,164 @@ def run_decode_bench(prompt_len=None, new_tokens=None, out_dir=None):
     return record
 
 
+def run_paged_kv_bench(out_dir=None):
+    """A/B the generation-cache LAYOUTS (ISSUE 17): the paged block
+    pool vs the PR 15 contiguous ``slots x max_len`` pool, serving the
+    SAME mixed-length workload at the same concurrency.
+
+    Two records, both host-side ratios (no device/timing claim -- the
+    byte and token counts are exact on any platform):
+
+    - ``serving_paged_kv_bytes_ratio``: contiguous-over-paged device
+      cache bytes.  The contiguous pool must size every slot for the
+      worst-case admissible sequence; the paged pool holds only the
+      blocks the workload's own reservations need, so the ratio is the
+      memory the block indirection gives back (target >= 2x).  The
+      extra witnesses the trade is free: ``greedy_tokens_match`` (both
+      layouts emit identical streams), ``tokens_per_s_ratio`` (paged
+      within ~10% of contiguous) and 0 recompiles after precompile on
+      BOTH legs -- including a SAMPLED stretch on the paged leg
+      (temperature/top-k riding runtime arrays, not shapes).
+    - ``serving_prefix_prefill_saved``: N streams share a system
+      prompt; the fraction of all prompt positions whose prefill
+      compute the prefix cache absorbed (hit tokens / prompt tokens).
+
+    Knobs: BENCH_PAGED_HIDDEN (128), BENCH_PAGED_LAYERS (2),
+    BENCH_PAGED_VOCAB (256), BENCH_PAGED_MAXLEN (1024, the worst-case
+    length both layouts must admit), BENCH_PAGED_NEW (64),
+    BENCH_PAGED_BLOCK (16).
+    """
+    _honor_env_platforms()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.attention import TransformerLM
+    from bigdl_tpu.observability.watchdogs import backend_compile_count
+    from bigdl_tpu.serving import BucketLadder, ServingEngine
+
+    env = os.environ
+    hidden = int(env.get("BENCH_PAGED_HIDDEN", "128"))
+    layers = int(env.get("BENCH_PAGED_LAYERS", "2"))
+    vocab = int(env.get("BENCH_PAGED_VOCAB", "256"))
+    max_len = int(env.get("BENCH_PAGED_MAXLEN", "1024"))
+    new_tokens = int(env.get("BENCH_PAGED_NEW", "64"))
+    block = int(env.get("BENCH_PAGED_BLOCK", "16"))
+    # the mixed-length workload: four concurrent streams, none close to
+    # max_len -- the realistic shape the contiguous pool overpays for
+    plens = (64, 96, 160, 256)
+    conc = len(plens)
+
+    model = TransformerLM(vocab, hidden, 4, layers, max_len=max_len)
+    model.build(jax.ShapeDtypeStruct((1, 64), jnp.int32),
+                rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, vocab, size=n).astype(np.int32)
+               for n in plens]
+    ladder = BucketLadder(max(plens), min_size=min(plens))
+    # the paged pool reserves each admission's OWN worst case
+    # (prompt + max_new), so size it for the workload, not max_len
+    kv_blocks = conc * (-(-(max(plens) + new_tokens) // block))
+
+    def _leg(kv_cache):
+        eng = ServingEngine(model, decode_slots=conc,
+                            decode_max_len=max_len, prompt_ladder=ladder,
+                            kv_cache=kv_cache, kv_block_size=block,
+                            kv_blocks=kv_blocks)
+        try:
+            sched = eng._generation()
+            precompiles = sched.precompile()
+            before = backend_compile_count()
+            t0 = time.perf_counter()
+            futs = [eng.generate(p, max_new_tokens=new_tokens)
+                    for p in prompts]
+            streams = [f.result(600) for f in futs]
+            wall = time.perf_counter() - t0
+            leg = {"cache_bytes": sched.cache_bytes(),
+                   "tokens_per_s": round(conc * new_tokens / wall, 1),
+                   "precompiles": precompiles,
+                   "recompiles_after_precompile":
+                       backend_compile_count() - before}
+            if kv_cache == "paged":
+                # sampled stretch: knobs are runtime arrays, so the
+                # same executables serve it -- recompiles must stay 0
+                sfuts = [eng.generate(prompts[i], max_new_tokens=8,
+                                      temperature=0.8, top_k=20, seed=i)
+                         for i in range(2)]
+                [f.result(600) for f in sfuts]
+                leg["recompiles_after_sampled"] = \
+                    backend_compile_count() - before
+                leg["kv"] = sched.stats()["kv"]
+        finally:
+            eng.close()
+        return leg, streams
+
+    contiguous, streams_c = _leg("contiguous")
+    paged, streams_p = _leg("paged")
+    ratio = contiguous["cache_bytes"] / max(paged["cache_bytes"], 1)
+    emit_record({
+        "metric": "serving_paged_kv_bytes_ratio",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "vs_baseline": round(ratio / 2.0, 4),       # ISSUE-17 floor: 2x
+        "extra": {
+            "hidden": hidden, "layers": layers, "vocab": vocab,
+            "max_len": max_len, "new_tokens": new_tokens,
+            "block_size": block, "kv_blocks": kv_blocks,
+            "prompt_lens": list(plens),
+            "contiguous": contiguous, "paged": paged,
+            "tokens_per_s_ratio": round(
+                paged["tokens_per_s"]
+                / max(contiguous["tokens_per_s"], 1e-9), 3),
+            "greedy_tokens_match": streams_p == streams_c,
+        },
+    })
+
+    # ----- leg (b): shared-prefix prefill compute saved ---------------- #
+    shared = rng.integers(0, vocab, size=192).astype(np.int32)
+    n_streams = 6
+    sprompts = [np.concatenate([
+        shared, rng.integers(0, vocab, size=16).astype(np.int32)])
+        for _ in range(n_streams)]
+    eng = ServingEngine(model, decode_slots=conc, decode_max_len=max_len,
+                        prompt_ladder=ladder, kv_block_size=block,
+                        kv_blocks=kv_blocks)
+    try:
+        sched = eng._generation()
+        sched.precompile()
+        # the first stream WRITES the shared blocks (prefix matching
+        # happens at admission, against already-committed blocks)...
+        first = eng.generate(sprompts[0], max_new_tokens=8)
+        first.result(600)
+        # ...and the followers, admitted after, map them refcounted
+        futs = [eng.generate(p, max_new_tokens=8) for p in sprompts[1:]]
+        [f.result(600) for f in futs]
+        hit_tokens = first.prefix_hit_tokens \
+            + sum(f.prefix_hit_tokens for f in futs)
+        prompt_tokens = sum(int(p.size) for p in sprompts)
+        kv_stats = sched.stats()["kv"]
+    finally:
+        eng.close()
+    saved = hit_tokens / prompt_tokens
+    emit_record({
+        "metric": "serving_prefix_prefill_saved",
+        "value": round(saved, 4),
+        "unit": "frac",
+        "vs_baseline": round(saved / 0.5, 4),   # floor: half the prompt
+        #                                         compute cache-absorbed
+        "extra": {
+            "streams": n_streams, "shared_prefix_len": int(shared.size),
+            "prompt_len": int(sprompts[0].size),
+            "block_size": block,
+            "prefix_hit_tokens": hit_tokens,
+            "prompt_tokens": prompt_tokens,
+            "prefix_hits": kv_stats["prefix_hits"],
+            "cow_copies": kv_stats["cow_copies"],
+        },
+    })
+
+
 # --------------------------------------------------------------------------- #
 # Quantized-collective micro-benchmark (ISSUE 4): A/B the dp step's wire
 # formats -- fp32 vs bf16 cast vs blockwise int8 + error feedback -- on
@@ -1732,6 +1890,14 @@ def main():
         # recompute): in-process and CPU-runnable; the tokens/s ratio is
         # the gateable trajectory metric (host-side, ratio stance)
         run_decode_bench()
+        # cache-LAYOUT A/B (paged block pool vs contiguous) + the
+        # shared-prefix prefill-saved leg: exact byte/token ratios
+        run_paged_kv_bench()
+        return
+    if os.environ.get("BENCH_PAGED") or "paged" in sys.argv[1:]:
+        # the paged-KV legs alone (no decode-ratio re-measurement --
+        # re-rolling that noisy ratio would churn ITS baseline)
+        run_paged_kv_bench()
         return
     if os.environ.get("BENCH_SERVE_INT8") or "serve-int8" in sys.argv[1:]:
         # serving-precision A/B (fp32 vs int8 engine): in-process and

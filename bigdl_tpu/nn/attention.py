@@ -238,6 +238,128 @@ class MultiHeadAttention(Module):
         y = y.reshape(n, t, d)
         return self._project_out(params, y, dt), new_cache
 
+    # ----- paged KV-cache decode mode --------------------------------------- #
+    def init_paged_cache(self, num_blocks: int, block_size: int,
+                         dtype=jnp.float32):
+        """Per-layer K/V BLOCK POOL for paged decode: fixed-shape
+        ``(num_blocks, block_size, heads, head_dim)`` zero tensors that
+        ``_apply_paged`` reads and writes THROUGH per-sequence block
+        tables (serving/paging.py).  Unlike ``init_cache`` the leading
+        axis is physical blocks, not slots: memory scales with tokens
+        actually resident, not ``slots x max_len`` worst case.  The
+        caller includes the trash block in ``num_blocks`` (by
+        convention the last id)."""
+        shape = (int(num_blocks), int(block_size), self.num_heads,
+                 self.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def _flash_paged_ok(self, block_size):
+        if self.use_flash == "never" or self.seq_axis_name is not None:
+            return False
+        if self.use_flash in ("always", "interpret"):
+            return True
+        # on real TPU the paged kernel walks the pool in block_size
+        # strides; tiny blocks (the useful CPU/bench sizes) are far
+        # below the 128-lane tile, so auto mode only takes the kernel
+        # when blocks themselves tile
+        if block_size % 128:
+            return False
+        try:
+            return jax.devices()[0].platform == "tpu"
+        except Exception:
+            return False
+
+    def _apply_paged(self, params, input, pool, tables, pos, lengths):
+        """Incremental attention against a paged K/V pool.  Returns
+        ``(y, new_pool)``.  ``tables`` maps each row's LOGICAL block
+        index to a physical pool block, padded with the trash block id
+        (the pool's last block), so the compiled step never sees how
+        long any sequence really is.
+
+        Two shapes, mirroring ``_apply_cached``:
+
+        - CHUNK PREFILL (``lengths`` an ``(N,)`` int vector): ``input``
+          is one fixed-size chunk per row ``(N, Tc, D)`` whose first
+          ``lengths[i]`` tokens are real and start at absolute position
+          ``pos[i]``; K/V scatter token-by-token through the table
+          (padding tokens redirect to the trash block) and attention
+          gathers the row's FULL mapped context, masked causally at
+          each token's absolute position -- so a chunk attends to all
+          previously-filled blocks (including shared prefix blocks it
+          never computed) plus its own earlier tokens.
+        - DECODE (``lengths is None``): ``input`` is one token per row
+          ``(N, 1, D)`` written at ``pos[i]``; rows whose table is all
+          trash (empty slots, rows mid-prefill) write garbage into the
+          trash block and read garbage out -- harmless by the same
+          frontier argument as the contiguous slot pool.
+        """
+        n, t, d = input.shape
+        dt = input.dtype
+        cdt = pool["k"].dtype
+        bs = pool["k"].shape[1]
+        max_blocks = tables.shape[1]
+        trash = pool["k"].shape[0] - 1
+        tables = jnp.asarray(tables, jnp.int32)
+        pos = jnp.asarray(pos, jnp.int32)
+        qkv = self._project_qkv(params, input)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (n, t, self.num_heads, self.head_dim)
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        if lengths is not None:                           # chunk prefill
+            lengths = jnp.asarray(lengths, jnp.int32)
+            gpos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+            valid = jnp.arange(t, dtype=jnp.int32)[None, :] \
+                < lengths[:, None]
+            logical = jnp.clip(gpos // bs, 0, max_blocks - 1)
+            phys = jnp.take_along_axis(tables, logical, axis=1)
+            phys = jnp.where(valid, phys, trash)
+            off = gpos % bs
+            flat = (n * t,)
+            new_pool = {
+                "k": pool["k"].at[phys.reshape(flat), off.reshape(flat)]
+                .set(k.astype(cdt).reshape(flat + shape[2:])),
+                "v": pool["v"].at[phys.reshape(flat), off.reshape(flat)]
+                .set(v.astype(cdt).reshape(flat + shape[2:]))}
+            ctx = max_blocks * bs
+            ctx_k = jnp.take(new_pool["k"], tables, axis=0).reshape(
+                n, ctx, self.num_heads, self.head_dim).astype(dt)
+            ctx_v = jnp.take(new_pool["v"], tables, axis=0).reshape(
+                n, ctx, self.num_heads, self.head_dim).astype(dt)
+            # (N, 1, Tc, ctx): key at logical position kp is visible to
+            # the chunk token at absolute position gpos iff kp <= gpos
+            mask = (jnp.arange(ctx, dtype=jnp.int32)[None, None, :]
+                    <= gpos[:, :, None])[:, None]
+            y = dot_product_attention(q, ctx_k, ctx_v, mask=mask)
+        else:                                             # one-token step
+            if t != 1:
+                raise ValueError(
+                    f"paged decode steps take one token per row, got T={t}")
+            phys = jnp.take_along_axis(
+                tables, (pos // bs)[:, None], axis=1)[:, 0]
+            off = pos % bs
+            new_pool = {
+                "k": pool["k"].at[phys, off].set(k[:, 0].astype(cdt)),
+                "v": pool["v"].at[phys, off].set(v[:, 0].astype(cdt))}
+            if self._flash_paged_ok(bs):
+                from bigdl_tpu.ops.flash_attention import \
+                    flash_paged_decode_attention
+
+                y = flash_paged_decode_attention(
+                    q, new_pool["k"].astype(dt), new_pool["v"].astype(dt),
+                    tables, pos,
+                    interpret=self.use_flash == "interpret")
+            else:
+                ctx = max_blocks * bs
+                ctx_k = jnp.take(new_pool["k"], tables, axis=0).reshape(
+                    n, ctx, self.num_heads, self.head_dim).astype(dt)
+                ctx_v = jnp.take(new_pool["v"], tables, axis=0).reshape(
+                    n, ctx, self.num_heads, self.head_dim).astype(dt)
+                mask = (jnp.arange(ctx, dtype=jnp.int32)[None, :]
+                        <= pos[:, None])[:, None, None, :]
+                y = dot_product_attention(q, ctx_k, ctx_v, mask=mask)
+        y = y.reshape(n, t, d)
+        return self._project_out(params, y, dt), new_pool
+
     def apply(self, params, state, input, *, training=False, rng=None,
               cache=None, pos=None):
         if cache is not None:
@@ -315,6 +437,24 @@ class TransformerBlock(Container):
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
         """This block's K/V decode cache (the attention sublayer's)."""
         return self.attn.init_cache(batch, max_len, dtype)
+
+    def init_paged_cache(self, num_blocks: int, block_size: int,
+                         dtype=jnp.float32):
+        """This block's paged K/V pool (the attention sublayer's)."""
+        return self.attn.init_paged_cache(num_blocks, block_size, dtype)
+
+    def apply_paged(self, params, input, pool, tables, pos, lengths=None):
+        """Paged prefill-chunk/decode through this block; returns
+        ``(out, new_pool)`` (see MultiHeadAttention._apply_paged)."""
+        h, _ = self.ln1.apply(params["ln1"], (), input)
+        a, new_pool = self.attn._apply_paged(params["attn"], h, pool,
+                                             tables, pos, lengths)
+        x = input + a
+        h, _ = self.ln2.apply(params["ln2"], (), x)
+        h, _ = self.fc1.apply(params["fc1"], (), h)
+        h = jax.nn.gelu(h)
+        h, _ = self.fc2.apply(params["fc2"], (), h)
+        return x + h, new_pool
 
     def apply(self, params, state, input, *, training=False, rng=None,
               cache=None, pos=None):
@@ -454,6 +594,68 @@ class TransformerLM(Container):
         if self.scan_layers:
             return {"blocks": stack_layer_trees(per_block)}
         return {f"block{i}": c for i, c in enumerate(per_block)}
+
+    def init_paged_cache(self, num_blocks: int, block_size: int,
+                         dtype=jnp.float32):
+        """Per-layer paged K/V pools in THIS model's param layout
+        (``"block{i}"`` unrolled / stacked ``"blocks"`` under
+        ``scan_layers``, mirroring ``init_cache``).  ``num_blocks`` is
+        the allocator's pool size; every layer gets ONE EXTRA block on
+        top -- the TRASH block, id ``num_blocks`` -- that padded table
+        entries and inactive rows write into (serving/paging.py)."""
+        per_block = [b.init_paged_cache(int(num_blocks) + 1, block_size,
+                                        dtype)
+                     for b in self.blocks]
+        if self.scan_layers:
+            return {"blocks": stack_layer_trees(per_block)}
+        return {f"block{i}": c for i, c in enumerate(per_block)}
+
+    def apply_paged(self, params, input, pool, tables, *, pos,
+                    lengths=None):
+        """Paged generation step: chunk prefill (``lengths`` given,
+        ``input`` ``(N, Tc)`` token chunks starting at absolute
+        positions ``pos``) or single-token decode (``lengths=None``,
+        ``input`` ``(N, 1)`` at per-row ``pos``).  K/V live in the
+        block pools from ``init_paged_cache`` and every row addresses
+        them through its padded block-table row -- the shapes the
+        executable sees never depend on sequence length, block
+        residency, or how a prompt was chunked.  Returns ``(logits,
+        new_pool)``."""
+        if self.seq_axis_name is not None:
+            raise ValueError("cached decode runs on a replicated model; "
+                             "sequence-parallel serving is not a thing "
+                             "(shard the BATCH axis instead)")
+        t = input.shape[1]
+        pos = jnp.asarray(pos, jnp.int32)
+        x = jnp.take(params["wte"], input.astype(jnp.int32), axis=0)
+        if lengths is not None:
+            # absolute position of each chunk token; jnp.take clips, so
+            # padding tokens past max_len just reuse the last wpe row
+            # (they write to trash and are never read)
+            gpos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+            x = x + jnp.take(params["wpe"], gpos, axis=0)
+        else:
+            x = x + jnp.take(params["wpe"], pos, axis=0)[:, None, :]
+        if self.scan_layers:
+            inner = self.blocks[0]
+
+            def body(h, sliced):
+                p, c = sliced
+                y, nc = inner.apply_paged(p, h, c, tables, pos, lengths)
+                return y, nc
+
+            x, stacked = jax.lax.scan(
+                body, x, (params["blocks"], pool["blocks"]))
+            new_pool = {"blocks": stacked}
+        else:
+            new_pool = {}
+            for i, b in enumerate(self.blocks):
+                x, nc = b.apply_paged(params[f"block{i}"], x,
+                                      pool[f"block{i}"], tables, pos,
+                                      lengths)
+                new_pool[f"block{i}"] = nc
+        x, _ = self.ln_f.apply(params["ln_f"], (), x)
+        return x @ params["head"].astype(x.dtype).T, new_pool
 
     def _apply_cached(self, params, input, cache, pos):
         """Prefill (``pos=None``: whole padded prompt, K/V written at

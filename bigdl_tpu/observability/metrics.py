@@ -579,6 +579,25 @@ class MetricsRegistry:
                     for i, v in enumerate(vals):
                         h.observe(v, exemplar=gtraces[i]
                                   if i < len(gtraces) else None)
+        # paged-KV ticks (serving/paging.py) stamp block-pool occupancy
+        # and prefix-cache hit deltas: the capacity signal ("are we
+        # about to shed?") and the sharing payoff ("what fraction of
+        # prefill compute did the cache absorb?")
+        if event.get("kv_blocks_total"):
+            occ = self.gauge(f"{p}_serving_kv_blocks",
+                             "KV block-pool occupancy, by state",
+                             labelnames=("state",))
+            for state in ("used", "cached", "free"):
+                occ.set(event.get(f"kv_blocks_{state}") or 0, state=state)
+        if event.get("prefix_hits"):
+            self.counter(f"{p}_serving_prefix_hits_total",
+                         "prompt blocks served from the prefix cache") \
+                .inc(event["prefix_hits"])
+        if event.get("prefix_hit_tokens"):
+            self.counter(f"{p}_serving_prefix_hit_tokens_total",
+                         "prompt positions whose prefill compute the "
+                         "prefix cache absorbed") \
+                .inc(event["prefix_hit_tokens"])
         if event.get("compiles"):
             self.counter(f"{p}_serving_recompiles_total",
                          "XLA compiles inside serving ticks (nonzero "

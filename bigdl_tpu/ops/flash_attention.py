@@ -175,3 +175,98 @@ def flash_decode_attention(q, k, v, pos, block_k: int = 128,
         interpret=interpret,
     )(pos_bh, qb, kb, vb)
     return out.reshape(b, h, 1, d).transpose(0, 2, 1, 3)
+
+
+def _paged_decode_kernel(pos_ref, table_ref, q_ref, k_ref, v_ref, o_ref, *,
+                         block_size: int, scale: float):
+    """Paged decode step: like ``_decode_kernel`` but the K/V blocks
+    are INDIRECT -- loop iteration ``j`` covers logical positions
+    ``[j*bs, (j+1)*bs)``, whose K/V physically live at pool block
+    ``table[j]``; the ``pl.ds`` slice start is the dynamically-loaded
+    table entry.  The trip count is still the dynamic frontier count
+    ``ceil((pos + 1) / bs)``, so a short sequence in a big pool reads
+    only the blocks it has actually mapped."""
+    d = q_ref.shape[-1]
+    bs = block_size
+    p = pos_ref[0]
+    q = q_ref[:].astype(jnp.float32) * scale          # (1, d)
+    nk = (p + bs) // bs                               # mapped, visible blocks
+
+    def body(j, carry):
+        acc, m, l = carry
+        bid = pl.load(table_ref, (pl.ds(j, 1),))[0]   # physical block id
+        kblk = k_ref[pl.ds(bid * bs, bs), :].astype(jnp.float32)
+        vblk = v_ref[pl.ds(bid * bs, bs), :].astype(jnp.float32)
+        s = q @ kblk.T                                # (1, bs)
+        kpos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (1, bs), 1)
+        mask = kpos <= p
+        s = jnp.where(mask, s, -jnp.inf)
+        bm = jnp.max(s, axis=1)
+        new_m = jnp.maximum(m, bm)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        pr = jnp.where(mask, jnp.exp(s - safe_m[:, None]), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * corr + jnp.sum(pr, axis=1)
+        acc = acc * corr[:, None] + pr @ vblk
+        return acc, new_m, l
+
+    acc0 = jnp.zeros((1, d), jnp.float32)
+    m0 = jnp.full((1,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((1,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_paged_decode_attention(q, k_pool, v_pool, tables, pos,
+                                 interpret: bool = False):
+    """Single-token decode attention through a PAGED K/V pool:
+    ``q (B, 1, H, D)`` against pools ``k_pool, v_pool (NB, bs, H, D)``
+    addressed by per-row block tables ``tables (B, max_blocks)`` with
+    frontier positions ``pos (B,)`` -> ``(B, 1, H, D)``.
+
+    The paged sibling of :func:`flash_decode_attention`: the same
+    one-program-per-(batch, head) online softmax, but K/V blocks are
+    fetched by table lookup instead of contiguous stride, so the
+    gather that the XLA fallback materialises (``(B, max_blocks*bs,
+    H, D)`` per layer per step) never exists -- each program streams
+    exactly the ``ceil((pos+1)/bs)`` blocks its row has mapped.
+    ``interpret=True`` runs on CPU for tests; on real TPU the pool
+    plane per head rides VMEM whole and tiny ``bs`` is below the
+    128-lane tile, so auto mode gates on ``bs % 128 == 0``
+    (MultiHeadAttention._flash_paged_ok) -- untuned beyond that, like
+    the contiguous decode kernel.
+    """
+    b, t1, h, d = q.shape
+    nb, bs = k_pool.shape[0], k_pool.shape[1]
+    mb = tables.shape[1]
+    assert t1 == 1, f"decode takes one query token per row, got {t1}"
+    scale = 1.0 / math.sqrt(d)
+
+    # per-head pool planes (H, NB*bs, D): physical block i occupies rows
+    # [i*bs, (i+1)*bs) so the kernel's pl.ds(bid*bs, bs) lands on it
+    def plane(x):
+        return x.transpose(2, 0, 1, 3).reshape(h, nb * bs, d)
+
+    kp, vp = plane(k_pool), plane(v_pool)
+    qh = q.transpose(0, 2, 1, 3)                      # (B, H, 1, D)
+    pos2 = jnp.asarray(pos, jnp.int32).reshape(b, 1)
+    tables = jnp.asarray(tables, jnp.int32)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, block_size=bs, scale=scale),
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec((None, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((None, mb), lambda i, j: (i, 0)),
+            pl.BlockSpec((None, None, 1, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((None, nb * bs, d), lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((None, nb * bs, d), lambda i, j: (j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, 1, d),
+                               lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        interpret=interpret,
+    )(pos2, tables, qh, kp, vp)
+    return out.transpose(0, 2, 1, 3)

@@ -21,6 +21,16 @@ reference's ``optim/PredictionService.scala`` instance pool).
   with zero steady-state recompiles), per-request
   ``max_new_tokens``/EOS stops, and streaming ``GenerateFuture``
   handles that yield tokens as decode ticks complete.
+- ``PagedGenerateScheduler`` / ``BlockAllocator``
+  (``serving/generation.py`` + ``serving/paging.py``) -- the paged KV
+  cache: a fixed device block pool with host-side block tables,
+  refcounted prefix sharing (content-hashed full blocks, LRU-cached,
+  copy-on-write on divergence), chunked prefill interleaved with
+  decode ticks, typed ``BlockPoolExhausted`` admission sheds, and
+  in-jit ``SamplingParams`` temperature/top-k/top-p sampling
+  (``serving/sampling.py``).  The engine default
+  (``kv_cache="paged"``); ``kv_cache="contiguous"`` keeps the flat
+  pool as the A/B baseline.
 - ``ModelRegistry`` / ``RolloutController`` (``serving/deploy.py``) --
   the train->serve loop closed: versioned hot-swap with shadow/canary
   staged exposure, atomic cutover, automatic rollback to the retained
@@ -52,11 +62,15 @@ from bigdl_tpu.serving.fleet import (CircuitBreaker, FleetOverloadedError,
                                      InProcessReplica, ServingFleet,
                                      SubprocessReplica)
 from bigdl_tpu.serving.generation import (GenerateFuture,
-                                          GenerateScheduler)
+                                          GenerateScheduler,
+                                          PagedGenerateScheduler)
+from bigdl_tpu.serving.paging import BlockAllocator, BlockPoolExhausted
+from bigdl_tpu.serving.sampling import SamplingParams
 
-__all__ = ["BucketLadder", "CircuitBreaker", "EngineDraining",
-           "FleetOverloadedError", "FleetSupervisor",
-           "FleetUnavailableError", "GenerateFuture", "GenerateScheduler",
-           "InProcessReplica", "ModelRegistry", "ModelVersion",
-           "RolloutController", "ServeFuture", "ServingEngine",
+__all__ = ["BlockAllocator", "BlockPoolExhausted", "BucketLadder",
+           "CircuitBreaker", "EngineDraining", "FleetOverloadedError",
+           "FleetSupervisor", "FleetUnavailableError", "GenerateFuture",
+           "GenerateScheduler", "InProcessReplica", "ModelRegistry",
+           "ModelVersion", "PagedGenerateScheduler", "RolloutController",
+           "SamplingParams", "ServeFuture", "ServingEngine",
            "ServingFleet", "SubprocessReplica", "snapshot_digest"]

@@ -332,7 +332,10 @@ class ServingEngine:
                  quantize=False, accuracy_gate=None,
                  decode_slots: Optional[int] = None,
                  decode_max_len: Optional[int] = None,
-                 prompt_ladder: Optional[BucketLadder] = None):
+                 prompt_ladder: Optional[BucketLadder] = None,
+                 kv_cache: str = "paged", kv_block_size: int = 16,
+                 kv_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None):
         if not model.is_built():
             raise ValueError("build the model (or train it) before serving")
         if max_batch_size < 1:
@@ -443,18 +446,29 @@ class ServingEngine:
         # pool this size decodes with KV caches behind ``generate()``.
         # None = AUTO (8 slots when the served model has a decode mode,
         # off otherwise); 0 disables explicitly.  The scheduler is
-        # built lazily on first use, and in AUTO mode precompile()
-        # leaves generation alone until a generate() arrives -- an
-        # engine that only ever predicts must not pay the generation
-        # cache allocation + prefill-ladder warmup for a verb nobody
-        # calls.  Pass decode_slots explicitly to warm generation in
-        # precompile() (the zero-steady-state-recompile contract).
-        self._decode_explicit = decode_slots is not None
+        # built lazily on first use, but unlike the first paged-cache
+        # cut, precompile() warms generation whenever the model has
+        # a decode mode (the zero-steady-state-recompile contract: the
+        # first generate() after precompile must not pay compiles,
+        # whether or not decode_slots was spelled out).
         if decode_slots is None:
             decode_slots = 8 if hasattr(model, "init_cache") else 0
         self.decode_slots = int(decode_slots)
         self.decode_max_len = decode_max_len
         self._prompt_ladder = prompt_ladder
+        # paged-KV knobs (serving/paging.py): "paged" virtualizes the
+        # generation cache into a block pool with prefix sharing,
+        # chunked prefill and in-jit sampling; "contiguous" keeps the
+        # PR 15 slots x max_len pool (greedy only -- the A/B baseline).
+        # Models without init_paged_cache fall back to contiguous.
+        if kv_cache not in ("paged", "contiguous"):
+            raise ValueError(
+                f"kv_cache must be 'paged' or 'contiguous', got "
+                f"{kv_cache!r}")
+        self.kv_cache = kv_cache
+        self.kv_block_size = int(kv_block_size)
+        self.kv_blocks = kv_blocks
+        self.prefill_chunk = prefill_chunk
         self._gen = None
         self._gen_lock = threading.Lock()
         if self._gate is not None:
@@ -599,18 +613,31 @@ class ServingEngine:
                             "generation is disabled on this engine "
                             "(decode_slots=0); construct with "
                             "decode_slots >= 1")
-                    from bigdl_tpu.serving.generation import \
-                        GenerateScheduler
+                    from bigdl_tpu.serving.generation import (
+                        GenerateScheduler, PagedGenerateScheduler)
 
                     serve_model = self._qmodel if self._quantized \
                         else self.model
-                    self._gen = GenerateScheduler(
-                        serve_model, slots=self.decode_slots,
-                        max_len=self.decode_max_len,
-                        prompt_ladder=self._prompt_ladder,
-                        queue_capacity=self.queue_capacity,
-                        telemetry=self.telemetry,
-                        admission_check=self._gen_admission_check)
+                    if self.kv_cache == "paged" \
+                            and hasattr(serve_model, "init_paged_cache"):
+                        self._gen = PagedGenerateScheduler(
+                            serve_model, slots=self.decode_slots,
+                            max_len=self.decode_max_len,
+                            prompt_ladder=self._prompt_ladder,
+                            queue_capacity=self.queue_capacity,
+                            telemetry=self.telemetry,
+                            admission_check=self._gen_admission_check,
+                            block_size=self.kv_block_size,
+                            num_blocks=self.kv_blocks,
+                            prefill_chunk=self.prefill_chunk)
+                    else:
+                        self._gen = GenerateScheduler(
+                            serve_model, slots=self.decode_slots,
+                            max_len=self.decode_max_len,
+                            prompt_ladder=self._prompt_ladder,
+                            queue_capacity=self.queue_capacity,
+                            telemetry=self.telemetry,
+                            admission_check=self._gen_admission_check)
         return self._gen
 
     def _gen_admission_check(self):
@@ -627,13 +654,22 @@ class ServingEngine:
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  eos_id: Optional[int] = None,
-                 timeout: Optional[float] = None, trace=None):
+                 timeout: Optional[float] = None, trace=None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: Optional[int] = None):
         """Autoregressive generation: enqueue a prompt (1-D token ids)
         onto the continuous-batching decode scheduler; returns a
         streaming ``GenerateFuture`` (``.stream()`` yields tokens as
         decode ticks complete, ``.result()`` returns the full list).
         Generation stops at ``eos_id`` (included in the output) or
-        after ``max_new_tokens``.  Decoding is greedy.
+        after ``max_new_tokens``.
+
+        Decoding is greedy by default; ``temperature > 0`` samples
+        in-jit (optionally truncated by ``top_k`` / nucleus ``top_p``),
+        with an explicit ``seed`` making the stream deterministic per
+        (seed, prompt) -- sampling needs the paged scheduler
+        (``kv_cache='paged'``, the default; the contiguous pool refuses
+        it at submission).
 
         Admission honors the engine's lifecycle exactly like
         ``submit``: a draining engine raises ``EngineDraining``, a
@@ -646,10 +682,18 @@ class ServingEngine:
                 raise EngineDraining(
                     "ServingEngine is draining (admission closed until "
                     "undrain()); in-flight generations still complete")
+        sampling = None
+        if temperature > 0.0 or top_k > 0 or top_p < 1.0 \
+                or seed is not None:
+            from bigdl_tpu.serving.sampling import SamplingParams
+
+            sampling = SamplingParams(temperature=temperature,
+                                      top_k=top_k, top_p=top_p,
+                                      seed=seed)
         return self._generation().submit(prompt,
                                          max_new_tokens=max_new_tokens,
                                          eos_id=eos_id, timeout=timeout,
-                                         trace=trace)
+                                         trace=trace, sampling=sampling)
 
     def predict_at(self, feature, bucket: int):
         """UNBATCHED reference predict: this one request, padded to
@@ -729,11 +773,13 @@ class ServingEngine:
         self._fit_bound(len(buckets))
         # generation's shape set (decode step + prefill rungs) warms
         # alongside the eval ladder, so one precompile() closes BOTH
-        # executable sets before traffic; AUTO-mode engines warm it
-        # only once generation is actually in use (see __init__)
+        # executable sets before traffic.  Warm whenever the served
+        # model HAS a decode mode: the old gate (explicit decode_slots=
+        # or a scheduler already built) silently skipped AUTO-mode
+        # engines, so their first generate() after "precompile" still
+        # paid every generation compile (tests/test_paged.py pins this)
         gen_compiles = 0
         if self.decode_slots > 0 \
-                and (self._decode_explicit or self._gen is not None) \
                 and hasattr(self._qmodel if self._quantized
                             else self.model, "init_cache"):
             gen_compiles = self._generation().precompile()
@@ -1040,6 +1086,9 @@ class ServingEngine:
                 "replicas": self._backend.replicas}
         if self.decode_slots > 0:
             info["decode_slots"] = self.decode_slots
+            info["kv_cache"] = self.kv_cache
+            if self.kv_cache == "paged":
+                info["kv_block_size"] = self.kv_block_size
         if self._version_info is not None:
             # WHICH checkpoint this replica serves: version id + the
             # snapshot's manifest digest (set_serving_version)
@@ -1053,6 +1102,17 @@ class ServingEngine:
             self.telemetry.set_serving_info(info)
         except Exception:
             log.exception("serving_info telemetry stamp failed")
+
+    def _flush_prefix_cache(self):
+        """After a weight swap lands: drop the paged scheduler's prefix
+        cache.  Cached K/V was computed under the OLD weights -- serving
+        it to a new prompt would silently mix checkpoints (live
+        sequences keep their blocks and finish mid-flight, the PR 15
+        trade)."""
+        gen = self._gen
+        flush = getattr(gen, "flush_prefix_cache", None)
+        if flush is not None:
+            flush()
 
     # ----- staged deployment surface (serving/deploy.py) --------------------- #
     def stage_weights(self, params, mstate=None, src_layout=None):
@@ -1158,6 +1218,7 @@ class ServingEngine:
         if self._quantized:
             audit["quantized"] = True
         self._record_refresh("ok", **audit)
+        self._flush_prefix_cache()
         self._stamp_serving_info()
         return self
 
@@ -1435,6 +1496,7 @@ class ServingEngine:
         if gate_detail is not None:
             audit["accuracy_gate"] = gate_detail
         self._record_refresh("ok", **audit)
+        self._flush_prefix_cache()
         self._stamp_serving_info()
         return self
 

@@ -52,6 +52,7 @@ subprocess workers needs no accelerator.
 """
 
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -253,12 +254,16 @@ class InProcessReplica(Replica):
 
     def submit_generate(self, req, timeout=None, admit_timeout=None,
                         trace=None):
-        # req: {"prompt", "max_new_tokens", "eos_id"}; returns the
-        # engine's streaming GenerateFuture (result() -> token list)
+        # req: {"prompt", "max_new_tokens", "eos_id"} plus optional
+        # sampling knobs; returns the engine's streaming GenerateFuture
+        # (result() -> token list)
         t = admit_timeout if admit_timeout is not None else timeout
         return self.engine.generate(
             req["prompt"], max_new_tokens=req.get("max_new_tokens", 16),
-            eos_id=req.get("eos_id"), timeout=t, trace=trace)
+            eos_id=req.get("eos_id"), timeout=t,
+            temperature=req.get("temperature", 0.0),
+            top_k=req.get("top_k", 0), top_p=req.get("top_p", 1.0),
+            seed=req.get("seed"), trace=trace)
 
     def abandon(self, fut):
         if hasattr(fut, "_t_submit"):          # a ServeFuture: free its
@@ -394,6 +399,14 @@ class SubprocessReplica(Replica):
         kw = {"prompt": [int(t) for t in req["prompt"]],
               "max_new_tokens": int(req.get("max_new_tokens", 16)),
               "eos_id": req.get("eos_id"), "timeout": timeout}
+        # sampling knobs ride the wire only when non-greedy, so greedy
+        # traffic against an older worker stays protocol-compatible
+        if req.get("temperature", 0.0) > 0.0 or req.get("top_k", 0) > 0 \
+                or req.get("top_p", 1.0) < 1.0 or req.get("seed") is not None:
+            kw["temperature"] = float(req.get("temperature", 0.0))
+            kw["top_k"] = int(req.get("top_k", 0))
+            kw["top_p"] = float(req.get("top_p", 1.0))
+            kw["seed"] = req.get("seed")
         if trace is not None:
             kw["trace"] = trace.to_wire()
         return self._executor.submit(
@@ -651,12 +664,21 @@ class ServingFleet:
                              hedge_ok=True)
 
     def generate(self, prompt, max_new_tokens=16, eos_id=None,
-                 timeout=None):
+                 timeout=None, temperature=0.0, top_k=0, top_p=1.0,
+                 seed=None):
         """One GENERATION request through the fleet: same admission
         window, least-loaded routing, breakers and deadline-budgeted
         retries as ``predict`` (a failed/dead replica's request re-runs
         from the prompt on a sibling -- greedy decoding makes the retry
         idempotent), returning the generated token-id list.
+
+        Sampling (``temperature`` / ``top_k`` / ``top_p`` / ``seed``)
+        rides the request: when the caller samples without pinning a
+        seed, the FLEET mints one here -- before routing -- so every
+        retry of this request replays the exact same token stream on
+        whichever replica it lands on (the scheduler's per-position
+        fold-in RNG makes the draw a pure function of (seed, position),
+        which is what keeps sampled retries idempotent too).
 
         Hedging is DISABLED for generation even when the fleet hedges
         predicts, deliberately: a multi-token request occupies a decode
@@ -667,10 +689,16 @@ class ServingFleet:
         single pending predict RPC can (the worker decodes the whole
         sequence regardless).  Tail tolerance for generation comes from
         retry-on-failure plus more slots, not duplication."""
-        return self._request(
-            {"prompt": prompt, "max_new_tokens": int(max_new_tokens),
-             "eos_id": eos_id},
-            timeout, op="submit_generate", hedge_ok=False)
+        req = {"prompt": prompt, "max_new_tokens": int(max_new_tokens),
+               "eos_id": eos_id}
+        if temperature > 0.0 or top_k > 0 or top_p < 1.0 \
+                or seed is not None:
+            if seed is None and temperature > 0.0:
+                seed = int.from_bytes(os.urandom(4), "little") & 0x7fffffff
+            req.update(temperature=float(temperature), top_k=int(top_k),
+                       top_p=float(top_p), seed=seed)
+        return self._request(req, timeout, op="submit_generate",
+                             hedge_ok=False)
 
     def _request(self, feature, timeout, op, hedge_ok):
         if self._closed:

@@ -37,13 +37,23 @@ Every tick lands as a ``kind:"inference"`` telemetry event stamped
 with ``tick_kind`` ("prefill"/"decode"), ``tokens`` emitted, and slot
 occupancy -- the fields behind ``bigdl_serving_tokens_total`` and the
 slot-utilization gauge (docs/observability.md, "Serving telemetry").
-Decoding is greedy (argmax in-jit, so only token ids cross the
-host boundary each tick); sampling policies can layer on later
-without touching the scheduler.
+In THIS scheduler decoding is greedy (argmax in-jit, so only token
+ids cross the host boundary each tick).
+
+``PagedGenerateScheduler`` (below) is the memory-scale successor: the
+same dispatcher contract, but the cache is a PAGED block pool
+addressed through per-sequence block tables (serving/paging.py) --
+prefix blocks shared across requests, long prompts prefilled in
+fixed-size chunks interleaved with decode ticks, and temperature /
+top-k / top-p sampling drawn inside the decode step
+(serving/sampling.py).  The contiguous scheduler stays as the greedy
+A/B baseline the bench compares against (docs/performance.md, "Paged
+KV cache").
 """
 
 import collections
 import logging
+import os
 import queue
 import threading
 import time
@@ -149,6 +159,12 @@ class GenerateFuture(Future):
         self._t_admit: Optional[float] = None
         #: sampled TraceContext from the submitting engine, or None
         self._trace = None
+        #: SamplingParams for this request (None = greedy argmax);
+        #: only the paged scheduler accepts non-greedy settings
+        self.sampling = None
+        #: prompt positions served straight from the prefix cache
+        #: (paged scheduler only; 0 means every position was computed)
+        self.prefix_hit_tokens = 0
         self._stream: "queue.Queue" = queue.Queue()
         #: set by GenerateScheduler._abandon on a CLAIMED request: the
         #: dispatcher evicts the sequence at the next tick boundary
@@ -251,13 +267,11 @@ class GenerateScheduler:
                 f"exceeds the cache max_len {self.max_len}")
         # admission counts round up this one (prefill batch rungs)
         self.batch_ladder = BucketLadder(self.slots)
-        self._prefill_fn, self._decode_fn = generate_steps(model,
-                                                           cache_dtype)
         #: slot pool + 1 trash row (prefill padding rows scatter there)
         self._trash = self.slots
         self._cache_dtype = cache_dtype
-        self._cache = model.init_cache(self.slots + 1, self.max_len,
-                                       cache_dtype)
+        self._setup_steps()      # compiled steps + self._cache (the
+        #                          paged subclass swaps in pool + tables)
         self._slots = [None] * self.slots
         self._free = collections.deque(range(self.slots))
         self._pending = collections.deque()
@@ -278,11 +292,35 @@ class GenerateScheduler:
             target=self._loop, name=f"bigdl-serving-{name}", daemon=True)
         self._dispatcher.start()
 
+    #: set by PagedGenerateScheduler -- the contiguous scheduler's
+    #: compiled steps only argmax, so non-greedy sampling is refused at
+    #: submit instead of silently decoding greedy
+    supports_sampling = False
+
+    def _setup_steps(self):
+        """Compile the step pair and allocate the device cache; the
+        paged subclass overrides this with the pool + allocator."""
+        self._prefill_fn, self._decode_fn = generate_steps(
+            self.model, self._cache_dtype)
+        self._cache = self.model.init_cache(self.slots + 1, self.max_len,
+                                            self._cache_dtype)
+
+    def _reset_pool(self):
+        """Reallocate the device cache after a failed (donating) tick."""
+        self._cache = self.model.init_cache(self.slots + 1, self.max_len,
+                                            self._cache_dtype)
+
+    def cache_bytes(self) -> int:
+        """Device bytes the KV cache actually holds (the bench's
+        peak-cache-bytes comparison reads this on both schedulers)."""
+        return int(sum(leaf.size * leaf.dtype.itemsize
+                       for leaf in jax.tree.leaves(self._cache)))
+
     # ----- request surface -------------------------------------------------- #
     def submit(self, prompt, max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
                timeout: Optional[float] = None,
-               trace=None) -> GenerateFuture:
+               trace=None, sampling=None) -> GenerateFuture:
         """Enqueue one prompt (1-D int token ids); returns the
         streaming future.  Blocks when ``queue_capacity`` requests are
         pending (``timeout`` bounds the wait, like engine.submit)."""
@@ -298,8 +336,15 @@ class GenerateScheduler:
                 f"({max_new_tokens}) exceeds the cache max_len "
                 f"{self.max_len}; raise decode_max_len or trim the "
                 f"request")
+        if sampling is not None and not sampling.greedy \
+                and not self.supports_sampling:
+            raise ValueError(
+                "temperature/top-k/top-p sampling needs the paged "
+                "scheduler (ServingEngine kv_cache='paged'); the "
+                "contiguous pool decodes greedy only")
         fut = GenerateFuture(prompt.size, max_new_tokens, eos_id)
         fut._trace = trace
+        fut.sampling = sampling
         deadline = None if timeout is None \
             else time.perf_counter() + timeout
         with self._lock:
@@ -503,15 +548,12 @@ class GenerateScheduler:
         zero cache so the scheduler keeps serving NEW prompts instead
         of raising 'Array has been deleted' forever."""
         failed = list(futs)
-        freed = list(extra_free)
         for i, slot in self._active():
             failed.append(slot.fut)
-            self._slots[i] = None
-            freed.append(i)
+            self._release_slot(i, slot)
         with self._lock:
-            self._free.extend(freed)
-        self._cache = self.model.init_cache(self.slots + 1, self.max_len,
-                                            self._cache_dtype)
+            self._free.extend(extra_free)
+        self._reset_pool()
         for f in failed:
             if not f.done():
                 f._stream.put(e)
@@ -548,14 +590,19 @@ class GenerateScheduler:
             fut = slot.fut
             if not fut._abandoned or fut.done():
                 continue
-            self._slots[i] = None
-            with self._lock:
-                self._free.append(i)
+            self._release_slot(i, slot)
             fut.finish_reason = "abandoned"
             self._stamp_latency(fut)
             fut._stream.put(None)
             fut.set_result(list(slot.tokens))
             self._record_request_trace(fut, len(slot.tokens))
+
+    def _release_slot(self, index, slot):
+        """Return a slot to the free pool (every eviction path funnels
+        here; the paged subclass also releases the sequence's blocks)."""
+        self._slots[index] = None
+        with self._lock:
+            self._free.append(index)
 
     def _deliver(self, index, slot, done_lat):
         """Stream the slot's newest token; complete + free the slot on
@@ -570,9 +617,7 @@ class GenerateScheduler:
             reason = "length"
         if reason is None:
             return
-        self._slots[index] = None
-        with self._lock:
-            self._free.append(index)
+        self._release_slot(index, slot)
         fut.finish_reason = reason
         self._stamp_latency(fut)
         done_lat.append(fut)
@@ -601,18 +646,24 @@ class GenerateScheduler:
         if emit is None:
             return
         try:
+            kw = {}
+            if fut.prefix_hit_tokens:
+                # how much of this request's prompt the prefix cache
+                # served -- ties a fast queue_wait/decode split to its
+                # cause in the trace story
+                kw["prefix_hit_tokens"] = fut.prefix_hit_tokens
             emit("generate_request", fut._trace.child(),
                  fut._t_submit_wall, fut.latency_s or 0.0,
                  queue_wait_s=round(fut.queue_wait_s or 0.0, 6),
                  decode_s=round(fut.decode_s or 0.0, 6),
-                 tokens=n_tokens, finish_reason=fut.finish_reason)
+                 tokens=n_tokens, finish_reason=fut.finish_reason, **kw)
         except Exception:
             log.exception("generation trace record failed")
 
     def _record_tick(self, kind, t0, records, tokens, qdepth,
                      execs_before, latencies, bucket=None,
                      prompt_bucket=None, slots_before=None,
-                     riders=None):
+                     riders=None, extra=None):
         self._tokens_out += tokens
         if self.telemetry is None:
             return
@@ -630,6 +681,11 @@ class GenerateScheduler:
                 event["bucket"] = bucket
                 event["batch_fill"] = records / bucket
                 event["pad_waste"] = (bucket - records) / bucket
+            if extra:
+                # paged-pool occupancy + prefix-hit fields (the metrics
+                # bridge turns these into bigdl_serving_kv_blocks{state}
+                # and bigdl_serving_prefix_hits_total)
+                event.update(extra)
             if prompt_bucket is not None:
                 event["prompt_bucket"] = prompt_bucket
             if latencies:
@@ -719,3 +775,439 @@ class GenerateScheduler:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+def paged_generate_steps(model, cache_dtype=jnp.float32):
+    """The jitted step triple for PAGED generation, compiled once per
+    (model, cache dtype) and cached on the instance like
+    ``generate_steps``:
+
+    - ``chunk_prefill(params, pool, tokens (B, Tc), start (B,),
+      lengths (B,), tables (B, MB), temperature, top_k, top_p, seed
+      (each (B,))) -> (first_tokens (B,), new_pool)``: one fixed-size
+      prompt chunk per row, scattered into the block pool through the
+      tables; row ``i``'s returned token is sampled from its LAST
+      valid chunk position's logits -- only meaningful for rows whose
+      chunk completes the prompt, garbage (and discarded) otherwise.
+    - ``decode(params, pool, tokens (S,), pos (S,), tables (S, MB),
+      temperature, top_k, top_p, seed (each (S,))) -> (next_tokens,
+      new_pool)``: one fixed-shape step over the whole slot pool.
+    - ``copy_block(pool, src, dst) -> new_pool``: the copy-on-write
+      primitive -- physical block ``src`` duplicated into ``dst``
+      across every layer, one executable regardless of which blocks.
+
+    Sampling runs in-jit (serving/sampling.py): the knobs are runtime
+    arrays, so greedy and sampled rows share each executable, and the
+    RNG folds on (seed, token position) -- a request replays
+    identically however it was chunked or slotted.  All three steps
+    donate the pool.
+    """
+    from bigdl_tpu.serving.sampling import sample_tokens
+
+    cache = model.__dict__.setdefault("_compiled_paged_steps", {})
+    key = np.dtype(cache_dtype).name
+    fns = cache.get(key)
+    if fns is not None:
+        return fns
+
+    def chunk_prefill(params, pool, tokens, start, lengths, tables,
+                      temperature, top_k, top_p, seed):
+        tc = tokens.shape[1]
+        logits, new = model.apply_paged(params, tokens, pool, tables,
+                                        pos=start, lengths=lengths)
+        idx = jnp.clip(lengths.astype(jnp.int32) - 1, 0, tc - 1)
+        row = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1)[:, 0]
+        # the sampled token OCCUPIES position start + lengths; folding
+        # the RNG on that position makes the draw independent of how
+        # the prompt was chunked
+        first = sample_tokens(row, temperature, top_k, top_p, seed,
+                              start + lengths)
+        return first, new
+
+    def decode(params, pool, tokens, pos, tables, temperature, top_k,
+               top_p, seed):
+        logits, new = model.apply_paged(params, tokens[:, None], pool,
+                                        tables, pos=pos)
+        nxt = sample_tokens(logits[:, 0], temperature, top_k, top_p,
+                            seed, pos + 1)
+        return nxt, new
+
+    def copy_block(pool, src, dst):
+        def cp(leaf):
+            # pool leaves are (NB, bs, H, Dh); the scan-stacked layout
+            # adds a leading layer axis -- block axis sits at ndim - 4
+            # either way (same convention as _scatter_rows)
+            if leaf.ndim == 4:
+                return leaf.at[dst].set(leaf[src])
+            return leaf.at[:, dst].set(leaf[:, src])
+        return jax.tree.map(cp, pool)
+
+    fns = (jax.jit(chunk_prefill, donate_argnums=(1,)),
+           jax.jit(decode, donate_argnums=(1,)),
+           jax.jit(copy_block, donate_argnums=(0,)))
+    cache[key] = fns
+    return fns
+
+
+class _PagedSlot:
+    """One admitted sequence in the paged scheduler.  While
+    ``consumed < len(prompt)`` the slot is PREFILLING: chunk ticks
+    advance ``consumed`` (which starts at the prefix-cache hit length,
+    not 0).  The final chunk samples the first token and flips the
+    slot to decoding, after which the fields mean exactly what
+    ``_Slot``'s do."""
+
+    __slots__ = ("fut", "prompt", "seq", "consumed", "tokens", "last",
+                 "pos", "seed")
+
+    def __init__(self, fut, prompt, seq, consumed, seed):
+        self.fut = fut
+        self.prompt = prompt
+        self.seq = seq                    # BlockAllocator sequence id
+        self.consumed = int(consumed)
+        self.tokens = []
+        self.last = None
+        self.pos = None
+        self.seed = int(seed)
+
+    @property
+    def prefilling(self):
+        return self.consumed < self.prompt.size
+
+
+class PagedGenerateScheduler(GenerateScheduler):
+    """Continuous batching over a PAGED KV cache: the dispatcher
+    contract (slots, futures, telemetry, drain/close) is inherited
+    from ``GenerateScheduler``; what changes is where K/V live and how
+    prompts arrive.
+
+    - The cache is ``model.init_paged_cache(num_blocks, block_size)``
+      -- memory scales with ``num_blocks``, not ``slots x max_len``
+      worst case -- and every sequence addresses it through a
+      ``BlockAllocator`` table (serving/paging.py).  Admission
+      RESERVES the request's worst-case block need; a pool that can't
+      hold it sheds the request with ``BlockPoolExhausted`` instead of
+      letting decode corrupt a neighbour later.
+    - Prompts whose leading full blocks hash-match an earlier request
+      map the SHARED blocks (``prefix_hit_tokens``) and skip that much
+      prefill compute and memory.
+    - A long prompt prefills in ``prefill_chunk``-token chunks, ONE
+      chunk per dispatcher iteration with a decode tick in between --
+      so an admitted 10k-token prompt delays live streams by one
+      chunk's latency per token, never head-of-line-blocks them.
+    - Decode ticks sample in-jit per the request's ``SamplingParams``
+      (greedy by default, bit-identical to the contiguous argmax).
+
+    The executable set stays closed and warmable: ONE decode shape,
+    one chunk shape per admission-batch rung, one block-copy -- zero
+    steady-state recompiles across mixed lengths, chunked prefill and
+    sampled decoding (the acceptance contract, tests/test_paged.py).
+    """
+
+    supports_sampling = True
+
+    def __init__(self, model, slots: int = 8, max_len: Optional[int] = None,
+                 prompt_ladder: Optional[BucketLadder] = None,
+                 queue_capacity: int = 1024, cache_dtype=jnp.float32,
+                 telemetry=None, params_fn=None, admission_check=None,
+                 name: str = "generate", block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None):
+        if not hasattr(model, "init_paged_cache"):
+            raise TypeError(
+                f"{type(model).__name__} has no init_paged_cache(): the "
+                f"paged scheduler needs the block-pool decode mode "
+                f"(TransformerLM has one); kv_cache='contiguous' works "
+                f"with plain init_cache models")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = int(block_size)
+        model_max = getattr(model, "max_len", None)
+        eff_max = int(model_max if max_len is None
+                      else min(max_len, model_max or max_len))
+        #: table width: enough entries to map max_len positions
+        self.max_blocks_per_seq = -(-eff_max // self.block_size)
+        #: pool size; the default matches the contiguous pool's token
+        #: capacity (slots x max_len) -- pass something smaller to
+        #: actually cap memory (the bench does; prefix sharing means a
+        #: smaller pool still holds the same traffic)
+        self.num_blocks = int(num_blocks) if num_blocks is not None \
+            else int(slots) * self.max_blocks_per_seq
+        if prefill_chunk is None:
+            prefill_chunk = min(64, eff_max)
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.prefill_chunk = int(min(prefill_chunk, eff_max))
+        # admission-tick prefix-hit deltas, stamped on the next chunk
+        # tick's telemetry event (prompt_tokens is the hit-rate
+        # denominator: positions ADMITTED, hit or not)
+        self._hits_delta = 0
+        self._hit_tokens_delta = 0
+        self._prompt_tokens_delta = 0
+        self._seq_counter = 0
+        super().__init__(model, slots=slots, max_len=max_len,
+                         prompt_ladder=prompt_ladder,
+                         queue_capacity=queue_capacity,
+                         cache_dtype=cache_dtype, telemetry=telemetry,
+                         params_fn=params_fn,
+                         admission_check=admission_check, name=name)
+
+    def _setup_steps(self):
+        from bigdl_tpu.serving.paging import BlockAllocator
+
+        self._chunk_fn, self._decode_fn, self._copy_fn = \
+            paged_generate_steps(self.model, self._cache_dtype)
+        self._cache = self.model.init_paged_cache(
+            self.num_blocks, self.block_size, self._cache_dtype)
+        self._alloc = BlockAllocator(self.num_blocks, self.block_size)
+
+    def _reset_pool(self):
+        from bigdl_tpu.serving.paging import BlockAllocator
+
+        # a failed donating tick killed the device pool, so every
+        # cached prefix block's CONTENT is gone too: fresh allocator,
+        # empty registry (the base already released live sequences)
+        self._cache = self.model.init_paged_cache(
+            self.num_blocks, self.block_size, self._cache_dtype)
+        self._alloc = BlockAllocator(self.num_blocks, self.block_size)
+
+    def flush_prefix_cache(self):
+        """Invalidate cached prefix blocks (engine weight swaps call
+        this -- K/V computed under old weights must not serve new
+        prompts)."""
+        self._alloc.flush_cached()
+
+    def stats(self):
+        st = super().stats()
+        st["kv"] = self._alloc.stats()
+        st["block_size"] = self.block_size
+        st["prefill_chunk"] = self.prefill_chunk
+        return st
+
+    # ----- warmup ----------------------------------------------------------- #
+    def precompile(self) -> int:
+        """Warm the whole paged shape set: the one decode executable,
+        one chunk-prefill per admission rung, and the COW block copy.
+        Dummy pools only -- the live pool is never donated away."""
+        from bigdl_tpu.observability.watchdogs import backend_compile_count
+
+        params = self._params()
+        before = backend_compile_count()
+        dummy = jax.tree.map(jnp.zeros_like, self._cache)
+        s = self.slots
+        mb = self.max_blocks_per_seq
+        trash = np.int32(self._alloc.trash)
+
+        def knobs(n):
+            return (np.zeros((n,), np.float32), np.zeros((n,), np.int32),
+                    np.ones((n,), np.float32), np.zeros((n,), np.int32))
+
+        nxt, dummy = self._decode_fn(
+            params, dummy, np.zeros((s,), np.int32),
+            np.zeros((s,), np.int32), np.full((s, mb), trash, np.int32),
+            *knobs(s))
+        jax.block_until_ready(nxt)
+        tc = self.prefill_chunk
+        for b in self.batch_ladder:
+            b = int(b)
+            first, dummy = self._chunk_fn(
+                params, dummy, np.zeros((b, tc), np.int32),
+                np.zeros((b,), np.int32), np.ones((b,), np.int32),
+                np.full((b, mb), trash, np.int32), *knobs(b))
+            jax.block_until_ready(first)
+        dummy = self._copy_fn(dummy, np.int32(0), np.int32(0))
+        jax.block_until_ready(jax.tree.leaves(dummy)[0])
+        return backend_compile_count() - before
+
+    # ----- dispatcher ticks -------------------------------------------------- #
+    def _release_slot(self, index, slot):
+        seq = getattr(slot, "seq", None)
+        if seq is not None:
+            self._alloc.free_sequence(seq)
+        super()._release_slot(index, slot)
+
+    def _kv_extra(self):
+        st = self._alloc.stats()
+        extra = {"kv_blocks_used": st["blocks_used"],
+                 "kv_blocks_cached": st["blocks_cached"],
+                 "kv_blocks_free": st["blocks_free"],
+                 "kv_blocks_total": st["blocks_total"]}
+        if self._prompt_tokens_delta:
+            extra["prompt_tokens"] = self._prompt_tokens_delta
+            self._prompt_tokens_delta = 0
+        if self._hits_delta or self._hit_tokens_delta:
+            extra["prefix_hits"] = self._hits_delta
+            extra["prefix_hit_tokens"] = self._hit_tokens_delta
+            self._hits_delta = 0
+            self._hit_tokens_delta = 0
+        return extra
+
+    def _run_prefill(self, reqs, qdepth):
+        """ADMISSION only (no device work): assign a slot, match the
+        prefix cache, reserve the worst-case block need.  The actual
+        prompt compute happens one chunk per dispatcher iteration in
+        ``_run_decode``, interleaved with decode ticks."""
+        from bigdl_tpu.serving.paging import BlockPoolExhausted
+
+        t0 = time.perf_counter()
+        for p, f in reqs:
+            f._t_admit = t0          # queue wait ends at slot admission
+        for p, f in reqs:
+            sp = f.sampling
+            seed = 0
+            if sp is not None and not sp.greedy:
+                seed = sp.seed if sp.seed is not None else \
+                    int.from_bytes(os.urandom(4), "little") & 0x7fffffff
+            seq = self._seq_counter
+            self._seq_counter += 1
+            with self._lock:
+                idx = self._free.popleft()
+            try:
+                cached = self._alloc.begin_sequence(
+                    seq, p.tolist(), int(p.size) + f.max_new_tokens)
+            except BlockPoolExhausted as e:
+                with self._lock:
+                    self._free.append(idx)
+                f._stream.put(e)
+                f._stream.put(None)
+                f.set_exception(e)
+                continue
+            f.prefix_hit_tokens = cached
+            self._hits_delta += cached // self.block_size
+            self._hit_tokens_delta += cached
+            self._prompt_tokens_delta += int(p.size)
+            self._slots[idx] = _PagedSlot(f, p, seq, cached, seed)
+
+    def _sampling_rows(self, n):
+        return (np.zeros((n,), np.float32), np.zeros((n,), np.int32),
+                np.ones((n,), np.float32), np.zeros((n,), np.int32))
+
+    @staticmethod
+    def _fill_sampling(arrs, r, slot):
+        sp = slot.fut.sampling
+        if sp is None or sp.greedy:
+            return
+        temp, top_k, top_p, seed = arrs
+        temp[r] = sp.temperature
+        top_k[r] = sp.top_k
+        top_p[r] = sp.top_p
+        seed[r] = slot.seed
+
+    def _run_decode(self, qdepth):
+        """One dispatcher iteration of device work: at most ONE prefill
+        chunk per currently-prefilling sequence, then one decode tick
+        over every decoding slot -- the interleave that keeps chunked
+        prefill from starving live streams."""
+        if any(s.prefilling for _i, s in self._active()):
+            self._run_chunk_tick(qdepth)
+        if any(not s.prefilling for _i, s in self._active()):
+            self._run_decode_tick(qdepth)
+
+    def _run_chunk_tick(self, qdepth):
+        t0 = time.perf_counter()
+        execs_before = self._compiles()
+        rows = [(i, s) for i, s in self._active() if s.prefilling]
+        n = len(rows)
+        bucket = self.batch_ladder.bucket_for(n) or self.batch_ladder.add(n)
+        tc = self.prefill_chunk
+        mb = self.max_blocks_per_seq
+        tokens = np.zeros((bucket, tc), np.int32)
+        start = np.zeros((bucket,), np.int32)
+        lens = np.zeros((bucket,), np.int32)
+        tables = np.full((bucket, mb), self._alloc.trash, np.int32)
+        knobs = self._sampling_rows(bucket)
+        for r, (i, s) in enumerate(rows):
+            chunk = s.prompt[s.consumed:s.consumed + tc]
+            tokens[r, :chunk.size] = chunk
+            start[r] = s.consumed
+            lens[r] = chunk.size
+            self._cow_guard(s, s.consumed, s.consumed + chunk.size - 1)
+            tables[r] = self._alloc.table_row(s.seq, mb)
+            self._fill_sampling(knobs, r, s)
+        try:
+            with span("generate_prefill", tick=self._tick, records=n):
+                first, self._cache = self._chunk_fn(
+                    self._params(), self._cache, tokens, start, lens,
+                    tables, *knobs)
+                first = np.asarray(first)            # host sync
+        except Exception as e:
+            log.exception("chunk prefill tick failed (%d prompts)", n)
+            self._tick_failed(e, [], [])
+            return
+        done_lat = []
+        emitted = 0
+        for r, (i, s) in enumerate(rows):
+            s.consumed += int(lens[r])
+            # full prompt blocks now hold real K/V: register their
+            # hashes so later admissions can share them
+            self._alloc.commit_full_blocks(s.seq, s.consumed)
+            if not s.prefilling:                     # prompt complete
+                s.last = int(first[r])
+                s.tokens = [s.last]
+                s.pos = int(s.prompt.size)
+                emitted += 1
+                self._deliver(i, s, done_lat)
+        self._tick += 1
+        self._record_tick("prefill", t0, records=n, tokens=emitted,
+                          bucket=int(bucket), prompt_bucket=tc,
+                          qdepth=qdepth, execs_before=execs_before,
+                          latencies=done_lat,
+                          riders=[s.fut for _i, s in rows],
+                          extra=self._kv_extra())
+
+    def _run_decode_tick(self, qdepth):
+        t0 = time.perf_counter()
+        execs_before = self._compiles()
+        s_n = self.slots
+        mb = self.max_blocks_per_seq
+        tokens = np.zeros((s_n,), np.int32)
+        pos = np.zeros((s_n,), np.int32)
+        tables = np.full((s_n, mb), self._alloc.trash, np.int32)
+        knobs = self._sampling_rows(s_n)
+        active = [(i, s) for i, s in self._active() if not s.prefilling]
+        for i, s in active:
+            self._cow_guard(s, s.pos, s.pos)
+            tokens[i] = s.last
+            pos[i] = s.pos
+            tables[i] = self._alloc.table_row(s.seq, mb)
+            self._fill_sampling(knobs, i, s)
+        try:
+            with span("generate_decode", tick=self._tick,
+                      records=len(active)):
+                nxt, self._cache = self._decode_fn(
+                    self._params(), self._cache, tokens, pos, tables,
+                    *knobs)
+                nxt = np.asarray(nxt)                # host sync
+        except Exception as e:
+            log.exception("decode tick failed (%d slots)", len(active))
+            self._tick_failed(e, [], [])
+            return
+        done_lat = []
+        for i, s in active:
+            s.pos += 1
+            s.last = int(nxt[i])
+            s.tokens.append(s.last)
+            self._deliver(i, s, done_lat)
+        self._tick += 1
+        self._record_tick("decode", t0, records=0, tokens=len(active),
+                          qdepth=qdepth, execs_before=execs_before,
+                          latencies=done_lat, slots_before=len(active),
+                          riders=[s.fut for _i, s in active],
+                          extra=self._kv_extra())
+
+    def _cow_guard(self, slot, first_pos, last_pos):
+        """Copy-on-write check over the blocks a write will touch.  By
+        construction writes only land in private blocks (prefix
+        matching is capped below the last prompt token), so this
+        normally just unregisters a block that was about to be shared;
+        if a shared block IS about to be written, the sequence detaches
+        onto a fresh copy first -- a refcount bug corrupts nobody."""
+        bs = self.block_size
+        for b in range(int(first_pos) // bs, int(last_pos) // bs + 1):
+            cow = self._alloc.ensure_writable(slot.seq, b * bs)
+            if cow is not None:
+                src, dst = cow
+                self._cache = self._copy_fn(self._cache, np.int32(src),
+                                            np.int32(dst))

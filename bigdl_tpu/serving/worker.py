@@ -20,12 +20,15 @@ responses ``{"ok": True, "result": ...}`` or ``{"ok": False, "error":
   (``{"v": 1, "traceparent": ...}``, docs/observability.md "Request
   tracing") -- absent from traceless clients and ignored by older
   workers, so the field is backward-compatible in both directions
-- ``generate`` {prompt, max_new_tokens, eos_id, timeout, trace?} ->
-  generated
+- ``generate`` {prompt, max_new_tokens, eos_id, timeout, trace?,
+  temperature?, top_k?, top_p?, seed?} -> generated
   token-id list (the engine's continuous-batching decode slots;
   tokens stream WITHIN the worker, the socket answers once the
   sequence finishes -- per-token streaming over this one-shot
-  framing would need a protocol change)
+  framing would need a protocol change).  The sampling knobs are
+  optional and default to greedy, so traceless/greedy clients and
+  older workers interoperate unchanged; ``seed`` rides the wire so a
+  fleet retry REPLAYS the same stream on a sibling replica
 - ``probe``    {features, bucket}   -> sha256 digest of the unbatched
   reference outputs (``predict_at``) -- the bit-for-bit serving
   fingerprint the rejoin drill compares across processes
@@ -288,6 +291,10 @@ class ReplicaServer:
             req["prompt"],
             max_new_tokens=int(req.get("max_new_tokens", 16)),
             eos_id=req.get("eos_id"), timeout=timeout,
+            temperature=float(req.get("temperature", 0.0)),
+            top_k=int(req.get("top_k", 0)),
+            top_p=float(req.get("top_p", 1.0)),
+            seed=req.get("seed"),
             trace=TraceContext.from_wire(req.get("trace")))
         remaining = None if timeout is None \
             else max(0.0, timeout - (time.perf_counter() - t0))

@@ -347,16 +347,20 @@ class TestGenerateServing:
         ref = _greedy_reference(m, [1, 2, 3], 4)
         with ServingEngine(m, decode_slots=2, decode_max_len=32) as eng:
             sched = eng._generation()
-            good = sched._prefill_fn
+            # the paged scheduler prefills in chunks (_chunk_fn); the
+            # contiguous one in a single step (_prefill_fn)
+            attr = "_chunk_fn" if hasattr(sched, "_chunk_fn") \
+                else "_prefill_fn"
+            good = getattr(sched, attr)
 
             def boom(*a, **k):
                 raise RuntimeError("injected tick failure")
 
-            sched._prefill_fn = boom
+            setattr(sched, attr, boom)
             fut = eng.generate([1, 2, 3], max_new_tokens=4)
             with pytest.raises(RuntimeError, match="injected"):
                 fut.result(30)
-            sched._prefill_fn = good
+            setattr(sched, attr, good)
             assert eng.generate([1, 2, 3],
                                 max_new_tokens=4).result(60) == ref
 

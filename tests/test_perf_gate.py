@@ -455,6 +455,71 @@ class TestDecodeRecords:
                           "--require-trusted"]) == 0
 
 
+def _paged_record(value):
+    """The BENCH_PAGED layout A/B shape: contiguous-over-paged cache
+    bytes -- exact counts, no platform/timing claim, so ``ratio``."""
+    return {"metric": "serving_paged_kv_bytes_ratio", "value": value,
+            "unit": "x", "vs_baseline": value / 2.0,
+            "extra": {"block_size": 16, "kv_blocks": 72,
+                      "contiguous": {"cache_bytes": 10485760,
+                                     "recompiles_after_precompile": 0},
+                      "paged": {"cache_bytes": int(10485760 / value),
+                                "recompiles_after_precompile": 0,
+                                "recompiles_after_sampled": 0},
+                      "greedy_tokens_match": True}}
+
+
+class TestPagedRecords:
+    """ISSUE-17 satellite: the paged-KV byte ratio and the
+    shared-prefix prefill-saved fraction are baseline-eligible
+    ``ratio`` records, a synthetic byte-ratio regression trips rc 1,
+    and the REAL checked-in BENCH_r08.json clears the acceptance
+    floors."""
+
+    def test_paged_ratio_classes_and_regression_trips(self, gate,
+                                                      tmp_path, capsys):
+        assert gate.classify_trust(_paged_record(4.0)) == "ratio"
+        d = _bench_dir(tmp_path, {
+            "BENCH_r08.json": _wrapper([_paged_record(4.0)], n=8),
+            "BENCH_r09.json": _wrapper([_paged_record(1.5)], n=9),
+        })
+        rc = gate.main(["--dir", d])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "serving_paged_kv_bytes_ratio" in out \
+            and "gate: FAIL" in out
+
+    def test_checked_in_r08_clears_the_acceptance_floors(self, gate):
+        """The REAL BENCH_r08.json: >= 2x cache-byte reduction, paged
+        tokens/s within 10% of contiguous, identical greedy streams, 0
+        recompiles after precompile (sampled stretch included), and >=
+        half the shared-prefix prompt compute cache-absorbed."""
+        path = os.path.join(REPO, "BENCH_r08.json")
+        assert os.path.exists(path), "BENCH_r08.json must be checked in"
+        records, note = gate.load_bench_file(path)
+        assert note is None
+        by_metric = {r["metric"]: r for r in records}
+        paged = by_metric["serving_paged_kv_bytes_ratio"]
+        assert gate.classify_trust(paged) == "ratio"
+        assert paged["value"] >= 2.0          # the ISSUE-17 floor
+        e = paged["extra"]
+        assert e["greedy_tokens_match"] is True
+        assert e["tokens_per_s_ratio"] >= 0.9
+        assert e["contiguous"]["recompiles_after_precompile"] == 0
+        assert e["paged"]["recompiles_after_precompile"] == 0
+        assert e["paged"]["recompiles_after_sampled"] == 0
+        saved = by_metric["serving_prefix_prefill_saved"]
+        assert gate.classify_trust(saved) == "ratio"
+        assert saved["value"] >= 0.5
+        traj = gate.build_trajectory(REPO)
+        for m in ("serving_paged_kv_bytes_ratio",
+                  "serving_prefix_prefill_saved"):
+            assert any(en["baseline_eligible"]
+                       for en in traj["metrics"][m]), m
+        assert gate.main(["--dir", REPO, "--check", path,
+                          "--require-trusted"]) == 0
+
+
 class TestTracedRecords:
     """ISSUE-16 satellite: a bench record measured with always-sample
     tracing enabled (BIGDL_TRACE_SAMPLE=1) carries the overhead of a

@@ -373,6 +373,28 @@ def _serving_section(other, header=None):
         slots = [e.get("slots_total") for e in gen if e.get("slots_total")]
         if slots:
             block["slots"] = max(slots)
+        # paged-KV occupancy (serving/paging.py): the LAST tick's pool
+        # state (a gauge, not a sum) plus the run's prefix-cache payoff
+        # -- hit tokens over total prompt positions admitted is the
+        # fraction of prefill compute the cache absorbed
+        kv = [e for e in gen if e.get("kv_blocks_total")]
+        if kv:
+            last = kv[-1]
+            block["kv_blocks"] = {
+                "total": last["kv_blocks_total"],
+                "used": last.get("kv_blocks_used", 0),
+                "cached": last.get("kv_blocks_cached", 0),
+                "free": last.get("kv_blocks_free", 0)}
+            hit_tokens = sum(int(e.get("prefix_hit_tokens", 0) or 0)
+                             for e in gen)
+            if hit_tokens:
+                block["prefix_hits"] = sum(
+                    int(e.get("prefix_hits", 0) or 0) for e in gen)
+                block["prefix_hit_tokens"] = hit_tokens
+                prompt_tokens = sum(
+                    int(e.get("prompt_tokens", 0) or 0) for e in gen)
+                if prompt_tokens > 0:
+                    block["prefix_hit_rate"] = hit_tokens / prompt_tokens
         sec["generate"] = block
     if info:
         for k in ("quantized", "weight_dtype", "model_bytes",
@@ -1042,6 +1064,19 @@ def format_report(rep):
                 out.append(
                     f"  traced sequences: {gen['traced_sequences']} "
                     f"({gen['traced_tick_rides']} slot-tick rides)")
+            kvb = gen.get("kv_blocks")
+            if kvb:
+                out.append(
+                    f"  kv blocks: {kvb['used']} used / "
+                    f"{kvb['cached']} cached / {kvb['free']} free "
+                    f"of {kvb['total']}")
+            if gen.get("prefix_hit_tokens"):
+                line = (f"  prefix cache: {gen['prefix_hit_tokens']} "
+                        f"prompt tokens served from cache "
+                        f"({gen.get('prefix_hits', 0)} blocks)")
+                if gen.get("prefix_hit_rate") is not None:
+                    line += f", hit rate {gen['prefix_hit_rate']:.0%}"
+                out.append(line)
     fl = rep.get("fleet")
     if fl:
         line = f"fleet: {len(fl['replicas'])} replica(s)"
