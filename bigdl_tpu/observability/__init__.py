@@ -20,6 +20,11 @@ Four layers over the one shared driver loop:
   MFU math may use) and triangulated trust verdicts
   (``trusted`` / ``suspect:async_dispatch`` / ``invalid:*``) stamped
   on bench records and telemetry streams (``profiling.py``).
+- ``MemoryLedger`` -- per-subsystem device-byte attribution (params /
+  fp32 twin / KV block pool / staged deploy buffers) reconciled
+  against ``device_memory_stats()`` (leaks surface as a growing
+  residual), with one-shot durable OOM forensic dumps
+  (``memory.py``; ``tools/mem_report.py`` replays the timeline).
 - ``MetricsRegistry`` / ``MetricsExporter`` / ``SloTracker`` -- LIVE
   fleet telemetry: a dependency-free Counter/Gauge/Histogram registry
   bridged from the same telemetry events, served over ``/metrics``
@@ -36,6 +41,8 @@ from bigdl_tpu.observability.health import (HealthMonitor, dump_incident,
                                             global_grad_norm, layer_labels,
                                             load_incident,
                                             per_layer_grad_norms)
+from bigdl_tpu.observability.memory import (MemoryLedger, is_oom_error,
+                                            tree_bytes)
 from bigdl_tpu.observability.metrics import (Counter, Gauge, Histogram,
                                              MetricsExporter,
                                              MetricsRegistry, SloObjective,
@@ -67,4 +74,5 @@ __all__ = [
     "MetricsExporter", "SloObjective", "SloTracker",
     "TraceContext", "HeadSampler", "RequestTrace", "tracing_manifest",
     "read_trace_events",
+    "MemoryLedger", "tree_bytes", "is_oom_error",
 ]

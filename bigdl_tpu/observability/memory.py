@@ -1,0 +1,296 @@
+"""Device-memory ledger: who owns the bytes, and how many are left.
+
+The serving stack's binding resource is device memory, not flops: the
+KV block pool, the fp32/int8 parameter twins, staged deploy buffers
+and optimizer state all compete for one fixed HBM budget, and an OOM
+kills the process with no record of what filled it.  This module makes
+the bytes attributable and the failure forensic:
+
+- ``MemoryLedger`` -- registered subsystems (``register(name, source)``)
+  each report their live bytes; ``snapshot()`` reconciles the
+  attributed total against ``device_memory_stats()`` so the LEAK shows
+  up as a growing ``residual_bytes`` row instead of an eventual OOM.
+  On backends with no allocator stats (CPU) the live/residual side is
+  None and the attribution side still works.
+- durable ``kind: "memory"`` events (``record()``) -- the scrapeable /
+  SLO-able timeline (``bigdl_memory_bytes{device,subsystem}`` and
+  ``bigdl_memory_headroom_bytes`` via the metrics bridge; an
+  ``SloObjective(kind="memory", field="headroom_fraction", op=">=")``
+  rides the standard tracker).
+- OOM forensics: ``dump(reason, ...)`` writes exactly ONE durable
+  ``kind: "memory_dump"`` event carrying the full ledger, the
+  subsystem detail (block-table occupancy) and the last N serving
+  ticks -- the line a post-mortem reads after the process died.
+  ``attach(telemetry)`` keeps the tick ring current;
+  ``ServingEngine`` wires ``BlockPoolExhausted`` into it, and
+  ``tools/mem_report.py`` replays the dump.
+
+Subsystem sources are callables returning either an int byte count or
+a dict with a ``"bytes"`` key plus free-form detail (the KV pool
+reports its reserved/active/prefix-cached/free block split this way).
+A source that raises contributes an ``{"error": ...}`` row instead of
+poisoning the snapshot -- forensics must work while things are broken.
+"""
+
+import logging
+import threading
+import time
+from collections import deque
+
+log = logging.getLogger("bigdl_tpu.observability")
+
+#: event kinds kept in the forensic tick ring (``attach``)
+_TICK_KINDS = frozenset({"step", "inference"})
+
+#: substrings that mark an exception as an allocation failure
+_OOM_MARKERS = ("resource_exhausted", "out of memory", "out_of_memory",
+                "oom", "allocation failure", "failed to allocate",
+                "blockpoolexhausted", "block pool exhausted")
+
+
+def tree_bytes(tree):
+    """Total device bytes of a pytree of arrays (shape x itemsize per
+    leaf; leaves without both contribute 0) -- the one-liner for
+    registering a param/opt-state plane with the ledger."""
+    import math
+
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            total += int(math.prod(shape)) * dtype.itemsize
+        except Exception:
+            pass
+    return total
+
+
+def is_oom_error(exc):
+    """Heuristic: does this exception look like an allocation failure
+    (XLA RESOURCE_EXHAUSTED, allocator OOM, KV pool exhaustion)?  Used
+    to decide whether a crash path should trigger a forensic dump."""
+    if exc is None:
+        return False
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(m in text for m in _OOM_MARKERS)
+
+
+class MemoryLedger:
+    """Attributes live device bytes to named subsystems and reconciles
+    the total against the allocator's own numbers.
+
+    >>> led = MemoryLedger()
+    >>> led.register("params", lambda: tree_bytes(params))
+    >>> led.register("kv_cache", scheduler_cache_source)
+    >>> led.attach(telemetry)        # tick ring + event sink
+    >>> led.record()                 # durable kind:"memory" event
+    >>> led.dump("oom", error=exc)   # once: durable kind:"memory_dump"
+
+    ``stats_fn`` defaults to ``telemetry.device_memory_stats`` (None on
+    CPU); tests inject a fake to pin reconciliation exactly.
+    """
+
+    def __init__(self, stats_fn=None, telemetry=None, last_ticks=32,
+                 max_header_devices=8):
+        if stats_fn is None:
+            from bigdl_tpu.observability.telemetry import device_memory_stats
+            stats_fn = device_memory_stats
+        self._stats_fn = stats_fn
+        self._sources = {}
+        self._lock = threading.RLock()
+        self._ticks = deque(maxlen=int(last_ticks))
+        self._dumped = False
+        self.max_devices = int(max_header_devices)
+        self.telemetry = None
+        if telemetry is not None:
+            self.attach(telemetry)
+
+    # ----- subsystem registry ------------------------------------------- #
+    def register(self, subsystem, source):
+        """Register (or replace) a subsystem's byte source: a callable
+        returning int bytes or a ``{"bytes": int, ...detail}`` dict."""
+        if not callable(source):
+            value = source
+            source = lambda: value  # noqa: E731 - constant source
+        with self._lock:
+            self._sources[str(subsystem)] = source
+        return self
+
+    def unregister(self, subsystem):
+        with self._lock:
+            self._sources.pop(str(subsystem), None)
+        return self
+
+    @property
+    def subsystems(self):
+        with self._lock:
+            return tuple(self._sources)
+
+    # ----- telemetry wiring --------------------------------------------- #
+    def attach(self, telemetry):
+        """Point the ledger at a ``StepTelemetry``: memory events are
+        recorded there, and its step/inference events feed the
+        last-N-ticks forensic ring the dump carries."""
+        self.telemetry = telemetry
+        telemetry.add_observer(self._observe)
+        return self
+
+    def _observe(self, event):
+        if event.get("kind") not in _TICK_KINDS:
+            return
+        self.note_tick(event)
+
+    def note_tick(self, event):
+        """Keep a compact copy of one serving tick / train step for the
+        forensic ring (drops bulky nested blocks, keeps counters)."""
+        compact = {}
+        for k, v in event.items():
+            if isinstance(v, (int, float, str, bool)) or v is None:
+                compact[k] = v
+        self._ticks.append(compact)
+
+    def last_ticks(self):
+        return list(self._ticks)
+
+    # ----- snapshots ----------------------------------------------------- #
+    def subsystem_snapshot(self):
+        """``{name: {"bytes": int|None, ...detail}}`` from every
+        registered source; a failing source yields an error row."""
+        with self._lock:
+            sources = dict(self._sources)
+        out = {}
+        for name, source in sources.items():
+            try:
+                rec = source()
+            except Exception as e:
+                out[name] = {"bytes": None, "error": f"{type(e).__name__}: {e}"}
+                continue
+            if isinstance(rec, dict):
+                rec = dict(rec)
+                if "bytes" in rec and rec["bytes"] is not None:
+                    rec["bytes"] = int(rec["bytes"])
+            else:
+                rec = {"bytes": int(rec) if rec is not None else None}
+            out[name] = rec
+        return out
+
+    def device_snapshot(self):
+        """Per-device allocator stats from ``stats_fn`` (bounded to
+        ``max_devices`` entries), or None where the backend exposes
+        none (CPU) -- silently, so CPU runs don't spam warnings."""
+        try:
+            stats = self._stats_fn()
+        except Exception:
+            return None, 0
+        if not stats:
+            return None, 0
+        labels = sorted(stats)
+        bounded = {d: stats[d] for d in labels[:self.max_devices]}
+        return bounded, len(labels)
+
+    def snapshot(self):
+        """One reconciled view: subsystem attribution, per-device
+        allocator truth, and the residual between them.
+
+        ``attributed_bytes + residual_bytes == live_bytes`` whenever
+        the allocator reports live bytes; a residual that grows tick
+        over tick is the leak the subsystems don't own up to.
+        """
+        subsystems = self.subsystem_snapshot()
+        attributed = sum(rec["bytes"] for rec in subsystems.values()
+                         if rec.get("bytes"))
+        devices, n_devices = self.device_snapshot()
+        live = peak = limit = None
+        if devices:
+            live = sum(r.get("bytes_in_use", 0) for r in devices.values())
+            peaks = [r["peak_bytes_in_use"] for r in devices.values()
+                     if "peak_bytes_in_use" in r]
+            peak = sum(peaks) if peaks else None
+            limits = [r["bytes_limit"] for r in devices.values()
+                      if "bytes_limit" in r]
+            limit = sum(limits) if limits else None
+        snap = {
+            "subsystems": subsystems,
+            "attributed_bytes": int(attributed),
+            "devices": devices,
+            "device_count": n_devices,
+            "live_bytes": live,
+            "peak_bytes": peak,
+            "limit_bytes": limit,
+            "residual_bytes": (live - attributed) if live is not None
+            else None,
+            "headroom_bytes": (limit - live)
+            if (limit is not None and live is not None) else None,
+        }
+        if snap["headroom_bytes"] is not None and limit:
+            snap["headroom_fraction"] = round(
+                snap["headroom_bytes"] / float(limit), 6)
+        else:
+            snap["headroom_fraction"] = None
+        return snap
+
+    # ----- event emission ------------------------------------------------ #
+    def record(self, step=None, **extra):
+        """Append one durable ``kind: "memory"`` event (the timeline
+        ``tools/mem_report.py`` and the metrics bridge consume).
+        Returns the event, or the bare snapshot when no telemetry is
+        attached."""
+        snap = self.snapshot()
+        if step is not None:
+            snap["step"] = step
+        if extra:
+            snap.update(extra)
+        if self.telemetry is None:
+            return snap
+        return self.telemetry.record("memory", **snap)
+
+    @property
+    def dumped(self):
+        """Whether the one-shot forensic dump already fired."""
+        return self._dumped
+
+    def dump(self, reason, error=None, detail=None, force=False):
+        """Emit the forensic ``kind: "memory_dump"`` event: full ledger
+        snapshot + subsystem detail (block-table occupancy rides in
+        the kv subsystem's dict) + the last N ticks.  Durable -- it is
+        fsynced before this returns, because the process is usually
+        about to die.  One-shot by default: repeated exhaustion (every
+        shed request re-raising ``BlockPoolExhausted``) must not bury
+        the first dump under hundreds of copies; ``force=True``
+        overrides for deliberate drills."""
+        with self._lock:
+            if self._dumped and not force:
+                return None
+            self._dumped = True
+        event = {
+            "reason": str(reason),
+            "ledger": self.snapshot(),
+            "last_ticks": self.last_ticks(),
+        }
+        if error is not None:
+            event["error"] = f"{type(error).__name__}: {error}" \
+                if isinstance(error, BaseException) else str(error)
+        if detail:
+            event["detail"] = detail
+        log.error("memory_dump (%s): attributed=%s live=%s residual=%s",
+                  reason, event["ledger"]["attributed_bytes"],
+                  event["ledger"]["live_bytes"],
+                  event["ledger"]["residual_bytes"])
+        if self.telemetry is None:
+            event["kind"] = "memory_dump"
+            event["ts"] = time.time()
+            return event
+        return self.telemetry.record("memory_dump", **event)
+
+    def handle_allocation_failure(self, exc, detail=None, reason=None):
+        """The crash-path hook: call with the caught allocation error
+        (engine wires ``BlockPoolExhausted`` here; drivers may wrap
+        their step in ``except Exception as e: if is_oom_error(e):
+        ledger.handle_allocation_failure(e); raise``).  Dumps once and
+        returns the dump event (None on repeats)."""
+        return self.dump(reason or type(exc).__name__, error=exc,
+                         detail=detail)

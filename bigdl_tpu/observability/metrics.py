@@ -444,6 +444,10 @@ class MetricsRegistry:
             self._observe_reshard(event)
         elif kind == "slo":
             self._observe_slo(event)
+        elif kind == "memory":
+            self._observe_memory(event)
+        elif kind == "memory_dump":
+            self._observe_memory_dump(event)
         elif kind == "param_refresh":
             self.counter(
                 f"{self.prefix}_serving_param_refresh_total",
@@ -756,6 +760,59 @@ class MetricsRegistry:
             status = "halted" if event.get("policy") == "halt" \
                 else "degraded"
         self.set_health(f"slo:{obj}", status)
+
+    # -- memory tier ----------------------------------------------------------- #
+    #: headroom fraction below which /healthz degrades (memory:headroom)
+    memory_headroom_warn_fraction = 0.1
+
+    def _observe_memory(self, event):
+        """``kind: "memory"`` ledger snapshots (observability/memory.py)
+        -> the ``bigdl_memory_bytes{device,subsystem}`` gauge family.
+        Subsystem attribution rows carry ``device="all"`` (the ledger
+        sums across devices); per-device allocator truth carries
+        ``subsystem="in_use"``; the reconciliation residual is its own
+        subsystem row so a leak is scrapeable as a growing gauge."""
+        p = self.prefix
+        g = self.gauge(f"{p}_memory_bytes",
+                       "live device bytes, by owning subsystem",
+                       labelnames=("device", "subsystem"))
+        for name, rec in (event.get("subsystems") or {}).items():
+            b = rec.get("bytes") if isinstance(rec, dict) else rec
+            if b is not None:
+                g.set(b, device="all", subsystem=name)
+        if event.get("residual_bytes") is not None:
+            g.set(event["residual_bytes"], device="all",
+                  subsystem="residual")
+        if event.get("live_bytes") is not None:
+            g.set(event["live_bytes"], device="all", subsystem="in_use")
+        for dev, rec in (event.get("devices") or {}).items():
+            if isinstance(rec, dict) and rec.get("bytes_in_use") is not None:
+                g.set(rec["bytes_in_use"], device=dev, subsystem="in_use")
+        if event.get("headroom_bytes") is not None:
+            self.gauge(f"{p}_memory_headroom_bytes",
+                       "device bytes left before the allocator limit") \
+                .set(event["headroom_bytes"])
+        frac = event.get("headroom_fraction")
+        if frac is not None:
+            self.gauge(f"{p}_memory_headroom_fraction",
+                       "headroom as a fraction of the allocator limit") \
+                .set(frac)
+            # the memory watchdog side of /healthz: burning through
+            # headroom degrades the run before the OOM kills it
+            self.set_health(
+                "memory:headroom",
+                "ok" if frac >= self.memory_headroom_warn_fraction
+                else "degraded")
+
+    def _observe_memory_dump(self, event):
+        """Forensic ``kind: "memory_dump"`` events: count them (by
+        reason) and degrade /healthz -- a process that dumped its
+        ledger hit an allocation wall even if it survived the shed."""
+        self.counter(f"{self.prefix}_memory_dumps_total",
+                     "forensic memory dumps, by reason",
+                     labelnames=("reason",)) \
+            .inc(reason=str(event.get("reason", "?")))
+        self.set_health("memory:dump", "degraded")
 
 
 def render_scoped(registries, label="replica"):

@@ -55,10 +55,12 @@ SCHEMA_VERSION = 1
 #: after SIGKILLing the server mid-cutover; a fleet event is a replica
 #: lifecycle/breaker edge whose process may be SIGKILLed the next
 #: instant -- the breaker open->half_open->closed trail the fleet
-#: drill audits post-mortem)
+#: drill audits post-mortem; a memory event is the headroom timeline
+#: an OOM'd run is judged by, and a memory_dump is the forensic ledger
+#: written precisely because the process is about to die)
 DURABLE_KINDS = frozenset({"health", "anomaly", "timing_audit",
                            "recovery", "slo", "reshard", "deploy",
-                           "fleet"})
+                           "fleet", "memory", "memory_dump"})
 
 log = logging.getLogger("bigdl_tpu.observability")
 
@@ -162,6 +164,7 @@ class StepTelemetry:
         self._cache_status = compilation_cache_status()
         self._cost = None
         self._compiled_step = None
+        self._memory_budget = None
         self._timing = None
         self._serving_info = None
         self._wrote_header = False
@@ -270,6 +273,25 @@ class StepTelemetry:
                     peak_flops=peak_flops(dev))
             except Exception:
                 pass
+            try:
+                # per-device allocator stats at run start, bounded to 8
+                # devices so a big pod doesn't bloat every header; None
+                # (CPU backends expose no memory_stats) is silently
+                # fine -- no warning spam for the common host case
+                mem = device_memory_stats()
+            except Exception:
+                mem = None
+            if mem:
+                labels = sorted(mem)
+                fields["device_memory"] = {d: mem[d] for d in labels[:8]}
+                if len(labels) > 8:
+                    fields["device_memory_devices"] = len(labels)
+            if self._memory_budget:
+                # the compiled executable's static memory budget
+                # (attach_cost + utils/hlo.memory_analysis_summary):
+                # argument/output/temp/generated bytes, the number the
+                # live MemoryLedger residual is read against
+                fields["memory_budget"] = self._memory_budget
             if self._cache_status is not None:
                 # hit/miss note for the run report: a warm cache means the
                 # big XLA compiles were (probably) skipped this run
@@ -370,7 +392,7 @@ class StepTelemetry:
 
     # ----- compiled-step cost ---------------------------------------------- #
     def attach_cost(self, jitted, *example_args, records_per_step=None,
-                    arg_labels=None):
+                    arg_labels=None, memory_budget=False):
         """Lower the step for ``cost_analysis`` and put the flops/bytes
         totals on the run header.  The lowering's own cost analysis is
         preferred -- it needs no backend compile, so enabling telemetry
@@ -385,7 +407,15 @@ class StepTelemetry:
         backend compile), stamped on the header as ``compiled_step``.
         ``arg_labels`` names the step's positional args (``("params",
         "mstate", "opt_state", ...)``) so the coverage reads per plane;
-        the drivers all pass theirs."""
+        the drivers all pass theirs.
+
+        ``memory_budget=True`` additionally AOT-compiles the step and
+        stamps its ``memory_analysis()`` (argument/output/temp/
+        generated bytes, via ``utils/hlo.memory_analysis_summary``) on
+        the header as ``memory_budget`` -- the static side of the live
+        ``MemoryLedger``.  This pays one backend compile (usually
+        served by the compilation cache); when the cost fallback
+        already compiled, the same executable is reused for free."""
         try:
             lowered = jitted.lower(*example_args)
         except Exception:
@@ -396,16 +426,30 @@ class StepTelemetry:
                 lowered, example_args, arg_labels=arg_labels)
         except Exception:       # the audit is an annotation, like cost
             self._compiled_step = None
+        compiled = None
         try:
             cost = _normalize_cost(lowered.cost_analysis())
         except Exception:
             cost = None
         if cost is None:
             try:
-                cost = _normalize_cost(lowered.compile().cost_analysis())
+                compiled = lowered.compile()
+                cost = _normalize_cost(compiled.cost_analysis())
             except Exception:
                 cost = None
-        if cost is None and self._compiled_step is None:
+        if memory_budget and compiled is None:
+            try:
+                compiled = lowered.compile()
+            except Exception:
+                compiled = None
+        if compiled is not None:
+            try:
+                from bigdl_tpu.utils import hlo
+                self._memory_budget = hlo.memory_analysis_summary(compiled)
+            except Exception:   # an annotation, never fatal
+                self._memory_budget = None
+        if cost is None and self._compiled_step is None \
+                and self._memory_budget is None:
             return None
         if cost is not None and records_per_step:
             cost["records_per_step"] = int(records_per_step)
@@ -416,6 +460,8 @@ class StepTelemetry:
             fields = {"cost": cost}
             if self._compiled_step is not None:
                 fields["compiled_step"] = self._compiled_step
+            if self._memory_budget is not None:
+                fields["memory_budget"] = self._memory_budget
             self.record("cost", **fields)
         return cost
 

@@ -213,6 +213,29 @@ class ModelRegistry:
     def previous(self):
         return None if self._previous is None else self.get(self._previous)
 
+    def retained_bytes(self, include_live=False):
+        """Device bytes of staged-version buffers the registry still
+        retains, summed from each handle's ``model_bytes`` stamp.  The
+        live version's buffers ARE the engine's serving params -- the
+        ledger's ``params`` subsystem already owns them -- so they are
+        excluded by default; what remains is the deploy tier's real
+        extra footprint (the previous version kept for rollback plus
+        any not-yet-promoted candidates).  This is the ``staged``
+        source ``ServingEngine.memory_ledger(registry=...)`` wires in
+        (observability/memory.py)."""
+        with self._lock:
+            total = 0
+            for v in self.versions:
+                if v.handle is None:
+                    continue
+                if not include_live and v.version == self._live:
+                    continue
+                b = v.handle.get("model_bytes") \
+                    if isinstance(v.handle, dict) else None
+                if b:
+                    total += int(b)
+            return total
+
     def known_digests(self):
         """Digests (and paths, for digest-less legacy snapshots) of
         every version ever registered -- the rollout watcher's

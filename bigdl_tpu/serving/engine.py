@@ -471,6 +471,7 @@ class ServingEngine:
         self.prefill_chunk = prefill_chunk
         self._gen = None
         self._gen_lock = threading.Lock()
+        self._memory_ledger = None
         if self._gate is not None:
             # the INITIAL quantization must clear the same bar a later
             # hot-swap would: a model this quantizer damages beyond
@@ -627,6 +628,7 @@ class ServingEngine:
                             queue_capacity=self.queue_capacity,
                             telemetry=self.telemetry,
                             admission_check=self._gen_admission_check,
+                            exhausted_hook=self._on_pool_exhausted,
                             block_size=self.kv_block_size,
                             num_blocks=self.kv_blocks,
                             prefill_chunk=self.prefill_chunk)
@@ -637,7 +639,8 @@ class ServingEngine:
                             prompt_ladder=self._prompt_ladder,
                             queue_capacity=self.queue_capacity,
                             telemetry=self.telemetry,
-                            admission_check=self._gen_admission_check)
+                            admission_check=self._gen_admission_check,
+                            exhausted_hook=self._on_pool_exhausted)
         return self._gen
 
     def _gen_admission_check(self):
@@ -1020,6 +1023,100 @@ class ServingEngine:
 
         src = self._qmodel if self._quantized else self.model
         return model_bytes(src.parameters()[0])
+
+    # ----- device-memory ledger (observability/memory.py) -------------------- #
+    def memory_ledger(self, registry=None):
+        """The engine's ``MemoryLedger``: params (plus the retained
+        fp32 twin on a quantized engine), the KV block pool with its
+        active/prefix-cached/free split, and -- when a deploy
+        ``ModelRegistry`` is passed -- the staged-version buffers.
+        Built lazily, attached to this engine's telemetry; call
+        ``record_memory()`` to put a snapshot on the timeline.
+        Re-calling with ``registry`` (re)binds the staged source."""
+        if self._memory_ledger is None:
+            from bigdl_tpu.observability.memory import MemoryLedger
+
+            led = MemoryLedger()
+            led.register("params", self.serving_model_bytes)
+            if self._quantized:
+                # the fp32 tree is retained for gate evals and as the
+                # refresh_params source -- real bytes, own them
+                def fp32_bytes():
+                    from bigdl_tpu.nn.quantized import model_bytes
+                    return model_bytes(self.model.parameters()[0])
+                led.register("params_fp32", fp32_bytes)
+            led.register("kv_cache", self._kv_cache_bytes)
+            if self.telemetry is not None:
+                led.attach(self.telemetry)
+            self._memory_ledger = led
+        if registry is not None:
+            self._memory_ledger.register(
+                "staged", lambda: registry.retained_bytes())
+        return self._memory_ledger
+
+    def _kv_cache_bytes(self):
+        """Ledger source for the generation KV pool: total device bytes
+        plus the allocator's block split (zero until the first
+        ``generate()`` builds the scheduler)."""
+        gen = self._gen
+        if gen is None:
+            return 0
+        rec = {"bytes": gen.cache_bytes()}
+        alloc = getattr(gen, "_alloc", None)
+        if alloc is not None:
+            st = alloc.stats()
+            total = st.get("blocks_total") or 0
+            per_block = rec["bytes"] / total if total else 0
+            rec.update(
+                blocks_total=total,
+                blocks_active=st.get("blocks_used"),
+                blocks_cached=st.get("blocks_cached"),
+                blocks_free=st.get("blocks_free"),
+                active_bytes=int(st.get("blocks_used", 0) * per_block),
+                cached_bytes=int(st.get("blocks_cached", 0) * per_block),
+                free_bytes=int(st.get("blocks_free", 0) * per_block))
+        return rec
+
+    def memory_headroom(self):
+        """The admission/autoscaling capacity signal: allocator
+        headroom (None on backends without memory stats) plus the KV
+        pool's block occupancy, which is meaningful everywhere --
+        ``BlockPoolExhausted`` sheds and autoscaler decisions cite
+        these measured numbers."""
+        snap = self.memory_ledger().snapshot()
+        out = {"headroom_bytes": snap["headroom_bytes"],
+               "headroom_fraction": snap["headroom_fraction"],
+               "attributed_bytes": snap["attributed_bytes"],
+               "live_bytes": snap["live_bytes"]}
+        gen = self._gen
+        alloc = getattr(gen, "_alloc", None) if gen is not None else None
+        if alloc is not None:
+            st = alloc.stats()
+            total = st.get("blocks_total") or 0
+            free = st.get("blocks_free", 0) + st.get("blocks_cached", 0)
+            out["kv_blocks_total"] = total
+            # cached blocks are reclaimable (LRU-evictable), so they
+            # count as admission headroom even while they hold prefixes
+            out["kv_blocks_free"] = free
+            out["kv_fill"] = round(1.0 - free / total, 6) if total else 0.0
+        return out
+
+    def record_memory(self, **extra):
+        """Snapshot the ledger onto the telemetry timeline (a durable
+        ``kind: "memory"`` event, bridged to the
+        ``bigdl_memory_bytes{device,subsystem}`` gauges)."""
+        return self.memory_ledger().record(tick=self._tick, **extra)
+
+    def _on_pool_exhausted(self, exc):
+        """Generation's ``BlockPoolExhausted`` forensics hook: dump the
+        full ledger + block occupancy + last ticks ONCE, durably,
+        before/while the shed propagates to callers."""
+        try:
+            self.memory_ledger().handle_allocation_failure(
+                exc, detail={"kv": self._kv_cache_bytes()},
+                reason="kv_block_pool_exhausted")
+        except Exception:
+            log.exception("memory forensics dump failed")
 
     @staticmethod
     def _make_gate(accuracy_gate):

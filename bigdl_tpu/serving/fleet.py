@@ -232,6 +232,12 @@ class Replica:
                 "served": self.served, "failed": self.failed,
                 "breaker": self.breaker.state if self.breaker else None}
 
+    def memory_headroom(self):
+        """This replica's ``ServingEngine.memory_headroom()`` capacity
+        signal, or None where the replica kind cannot report one (a
+        remote worker without the RPC)."""
+        return None
+
 
 class InProcessReplica(Replica):
     """A ``ServingEngine`` in this process -- the cheap replica kind
@@ -273,6 +279,9 @@ class InProcessReplica(Replica):
 
     def alive(self):
         return self.engine._running
+
+    def memory_headroom(self):
+        return self.engine.memory_headroom()
 
     # -- deploy verbs -- #
     def drain(self, timeout=None):
@@ -1059,6 +1068,40 @@ class ServingFleet:
     def counters(self):
         with self._lock:
             return dict(self._counters)
+
+    def memory_headroom(self):
+        """The fleet-wide capacity signal (future autoscaler input):
+        per-replica ``memory_headroom()`` plus aggregates -- the
+        TIGHTEST device headroom across replicas (the replica that
+        OOMs first bounds the fleet) and the SUMMED free KV blocks
+        (shed-resistant admission capacity).  Replicas that cannot
+        report (remote workers, dead processes) are skipped."""
+        per = {}
+        for r in self.replicas:
+            if r.state in ("dead", "closed"):
+                continue
+            try:
+                h = r.memory_headroom()
+            except Exception:
+                h = None
+            if h is not None:
+                per[r.rid] = h
+        agg = {"replicas": per}
+        headrooms = [h["headroom_bytes"] for h in per.values()
+                     if h.get("headroom_bytes") is not None]
+        if headrooms:
+            agg["min_headroom_bytes"] = min(headrooms)
+        fracs = [h["headroom_fraction"] for h in per.values()
+                 if h.get("headroom_fraction") is not None]
+        if fracs:
+            agg["min_headroom_fraction"] = min(fracs)
+        frees = [h["kv_blocks_free"] for h in per.values()
+                 if h.get("kv_blocks_free") is not None]
+        if frees:
+            agg["kv_blocks_free"] = sum(frees)
+            agg["kv_blocks_total"] = sum(
+                h.get("kv_blocks_total", 0) for h in per.values())
+        return agg
 
     # ----- lifecycle transitions (supervisor + deploys) ---------------------- #
     def mark_dead(self, rep, reason=None):

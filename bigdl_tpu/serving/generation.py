@@ -234,7 +234,7 @@ class GenerateScheduler:
                  prompt_ladder: Optional[BucketLadder] = None,
                  queue_capacity: int = 1024, cache_dtype=jnp.float32,
                  telemetry=None, params_fn=None, admission_check=None,
-                 name: str = "generate"):
+                 exhausted_hook=None, name: str = "generate"):
         if not hasattr(model, "init_cache"):
             raise TypeError(
                 f"{type(model).__name__} has no init_cache(): generation "
@@ -254,6 +254,11 @@ class GenerateScheduler:
         #: engine.drain() that observed an idle scheduler can never
         #: race a generate() that already passed the engine-side check
         self._admission_check = admission_check
+        #: optional callable(exc) invoked when the KV pool sheds a
+        #: request (``BlockPoolExhausted``): the owning engine points
+        #: this at its MemoryLedger's forensic dump so the first
+        #: exhaustion leaves a durable memory_dump event
+        self._exhausted_hook = exhausted_hook
         self._params = params_fn or (lambda: model.parameters()[0])
         # prompt lengths round up this ladder (rung = the padded prefill
         # T); a COPY like the engine's batch ladder, so growth stays ours
@@ -911,7 +916,8 @@ class PagedGenerateScheduler(GenerateScheduler):
                  prompt_ladder: Optional[BucketLadder] = None,
                  queue_capacity: int = 1024, cache_dtype=jnp.float32,
                  telemetry=None, params_fn=None, admission_check=None,
-                 name: str = "generate", block_size: int = 16,
+                 exhausted_hook=None, name: str = "generate",
+                 block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None):
         if not hasattr(model, "init_paged_cache"):
@@ -952,7 +958,8 @@ class PagedGenerateScheduler(GenerateScheduler):
                          queue_capacity=queue_capacity,
                          cache_dtype=cache_dtype, telemetry=telemetry,
                          params_fn=params_fn,
-                         admission_check=admission_check, name=name)
+                         admission_check=admission_check,
+                         exhausted_hook=exhausted_hook, name=name)
 
     def _setup_steps(self):
         from bigdl_tpu.serving.paging import BlockAllocator
@@ -1070,6 +1077,14 @@ class PagedGenerateScheduler(GenerateScheduler):
             except BlockPoolExhausted as e:
                 with self._lock:
                     self._free.append(idx)
+                hook = self._exhausted_hook
+                if hook is not None:
+                    # forensics BEFORE the caller sees the failure: the
+                    # dump must be on disk even if the shed cascades
+                    try:
+                        hook(e)
+                    except Exception:
+                        log.exception("exhausted_hook failed")
                 f._stream.put(e)
                 f._stream.put(None)
                 f.set_exception(e)

@@ -37,7 +37,17 @@ Schema (docs/observability.md, "Compiled step audit")::
      "dot_conv_dtypes": {"dot": {dtype: count}, "conv": {dtype: count}},
      "collectives": {op: count},          # only ops that appear
      "fusions": int,                      # compiled source only
+     "memory": {"argument_bytes", "output_bytes", "temp_bytes",
+                "alias_bytes", "generated_code_bytes",
+                "peak_bytes"},            # compiled source only, when
+                                          # the backend reports it
     }
+
+``memory`` is the executable's compiled-program memory budget
+(``compiled.memory_analysis()``, normalized by
+``memory_analysis_summary`` below): what the program will ask the
+allocator for BEFORE it runs -- the static side of the live
+``MemoryLedger`` (observability/memory.py).
 
 No jax import at module top: the parsers are pure text -> dict, so
 tools can spec-load this file the way obs_report loads xplane.py.
@@ -217,6 +227,60 @@ def fusions_from_compiled(text):
     return len(re.findall(r" fusion\(", text))
 
 
+#: ``CompiledMemoryStats`` attributes -> portable summary keys
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+
+def memory_analysis_summary(compiled_or_stats):
+    """Normalize an executable's ``memory_analysis()`` into the portable
+    ``{argument_bytes, output_bytes, temp_bytes, alias_bytes,
+    generated_code_bytes, peak_bytes}`` dict, or None where the backend
+    reports nothing (some CPU paths).  Accepts either the compiled
+    object or the stats object itself, and tolerates dict-shaped stats
+    -- THE one probe, shared by ``StepTelemetry.attach_cost``,
+    ``tools/hlo_audit.py`` and ``tools/profile_resnet.py`` so all three
+    report identical fields.
+
+    ``peak_bytes`` is the budget estimate ``arguments + outputs + temps
+    - aliased`` (aliased bytes are input buffers reused as outputs, so
+    they are not paid twice)."""
+    stats = compiled_or_stats
+    if hasattr(stats, "memory_analysis"):
+        try:
+            stats = stats.memory_analysis()
+        except Exception:
+            return None
+    if stats is None:
+        return None
+    if isinstance(stats, (list, tuple)):
+        stats = stats[0] if stats else None
+        if stats is None:
+            return None
+    out = {}
+    for attr, key in _MEMORY_FIELDS:
+        if isinstance(stats, dict):
+            v = stats.get(attr, stats.get(key))
+        else:
+            v = getattr(stats, attr, None)
+        if v is not None:
+            try:
+                out[key] = int(v)
+            except (TypeError, ValueError):
+                continue
+    if not out:
+        return None
+    peak = (out.get("argument_bytes", 0) + out.get("output_bytes", 0)
+            + out.get("temp_bytes", 0) - out.get("alias_bytes", 0))
+    out["peak_bytes"] = max(int(peak), 0)
+    return out
+
+
 # --------------------------------------------------------------------- #
 # summaries
 # --------------------------------------------------------------------- #
@@ -270,7 +334,7 @@ def compiled_summary(compiled, example_args, arg_labels=None,
     collective counts -- what ``tools/hlo_audit.py`` gates on."""
     text = compiled.as_text()
     entries = arg_entries(example_args, arg_labels)
-    return {
+    summary = {
         "source": "compiled",
         "donation": _donation_coverage(
             entries, aliased_params_from_compiled(text), min_bytes),
@@ -278,6 +342,10 @@ def compiled_summary(compiled, example_args, arg_labels=None,
         "collectives": collectives_from_compiled(text),
         "fusions": fusions_from_compiled(text),
     }
+    mem = memory_analysis_summary(compiled)
+    if mem:
+        summary["memory"] = mem
+    return summary
 
 
 def audit_step(jitted, *example_args, arg_labels=None, min_bytes=2048,
@@ -314,6 +382,16 @@ def format_summary_lines(summary, indent="  "):
             f"{k} x{v}" for k, v in sorted(summary["collectives"].items())))
     if "fusions" in summary:
         out.append(f"{indent}fusions: {summary['fusions']}")
+    mem = summary.get("memory")
+    if mem:
+        parts = [f"{key.replace('_bytes', '')} {mem[key]:,}"
+                 for key in ("argument_bytes", "output_bytes",
+                             "temp_bytes", "generated_code_bytes")
+                 if key in mem]
+        line = f"{indent}memory budget: " + " + ".join(parts)
+        if "peak_bytes" in mem:
+            line += f"  (~{mem['peak_bytes']:,} bytes peak)"
+        out.append(line)
     return out
 
 

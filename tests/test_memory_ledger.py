@@ -1,0 +1,436 @@
+"""ISSUE 18: the device-memory ledger, OOM forensics and headroom
+signals.
+
+Pins, per the acceptance criteria:
+
+- ledger reconciliation: ``attributed + residual == live`` against an
+  injected allocator, None (not zero) where the backend reports
+  nothing, failing sources degrade to error rows;
+- durable ``kind: "memory"`` events bridge to the
+  ``bigdl_memory_bytes{device,subsystem}`` gauge family, low headroom
+  and forensic dumps degrade /healthz;
+- the OOM drill: exhausting the KV block pool leaves exactly ONE
+  durable ``memory_dump`` event with a parseable ledger, and
+  ``memory_headroom()`` cites the measured block split;
+- header stamps: per-device ``device_memory`` bounded to 8 devices,
+  and ``attach_cost(memory_budget=True)`` stamps the normalized
+  ``memory_analysis()`` budget;
+- the report surface: a memory-events-only artifact is NOT a hollow
+  run for ``tools/obs_report.py``, and ``tools/mem_report.py``
+  replays the timeline + dump (exit 2 when there is nothing).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from bigdl_tpu.observability.memory import (MemoryLedger, is_oom_error,
+                                            tree_bytes)
+from bigdl_tpu.observability.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stats(live, limit, peak=None, devices=1):
+    """Fake ``device_memory_stats`` splitting live/limit over N devices."""
+    def fn():
+        per = {}
+        for i in range(devices):
+            per[f"tpu:{i}"] = {"bytes_in_use": live // devices,
+                               "peak_bytes_in_use":
+                                   (peak or live) // devices,
+                               "bytes_limit": limit // devices}
+        return per
+    return fn
+
+
+def _events(tmp_path):
+    with open(os.path.join(str(tmp_path), "telemetry.jsonl")) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+class TestLedgerReconciliation:
+    def test_attributed_plus_residual_equals_live(self):
+        led = MemoryLedger(stats_fn=_stats(1000, 2000))
+        led.register("params", lambda: 600)
+        led.register("kv_cache", lambda: {"bytes": 300, "blocks_total": 4})
+        snap = led.snapshot()
+        assert snap["attributed_bytes"] == 900
+        assert snap["live_bytes"] == 1000
+        assert snap["residual_bytes"] == 100
+        assert snap["attributed_bytes"] + snap["residual_bytes"] \
+            == snap["live_bytes"]
+        assert snap["headroom_bytes"] == 1000
+        assert snap["headroom_fraction"] == 0.5
+        # detail from dict sources survives normalization
+        assert snap["subsystems"]["kv_cache"]["blocks_total"] == 4
+
+    def test_no_allocator_stats_is_none_not_zero(self):
+        """CPU shape: attribution works, reconciliation is None --
+        a 0 here would read as 'no memory in use', which is a lie."""
+        led = MemoryLedger(stats_fn=lambda: None)
+        led.register("params", lambda: 600)
+        snap = led.snapshot()
+        assert snap["attributed_bytes"] == 600
+        assert snap["live_bytes"] is None
+        assert snap["residual_bytes"] is None
+        assert snap["headroom_bytes"] is None
+        assert snap["headroom_fraction"] is None
+
+    def test_failing_source_degrades_to_error_row(self):
+        led = MemoryLedger(stats_fn=_stats(1000, 2000))
+        led.register("params", lambda: 600)
+        led.register("broken", lambda: 1 / 0)
+        snap = led.snapshot()
+        row = snap["subsystems"]["broken"]
+        assert row["bytes"] is None
+        assert "ZeroDivisionError" in row["error"]
+        # the broken source neither poisons the others nor the total
+        assert snap["attributed_bytes"] == 600
+        assert snap["residual_bytes"] == 400
+
+    def test_constant_and_replaceable_sources(self):
+        led = MemoryLedger(stats_fn=lambda: None)
+        led.register("fixed", 42)                 # plain value is fine
+        assert led.snapshot()["subsystems"]["fixed"]["bytes"] == 42
+        led.register("fixed", 43)                 # replace, not append
+        assert led.snapshot()["subsystems"]["fixed"]["bytes"] == 43
+        led.unregister("fixed")
+        assert "fixed" not in led.subsystems
+
+    def test_tree_bytes_counts_shape_times_itemsize(self):
+        import numpy as np
+        tree = {"a": np.zeros((4, 4), np.float32),
+                "b": np.zeros((8,), np.int8), "meta": "not-an-array"}
+        assert tree_bytes(tree) == 4 * 4 * 4 + 8
+
+    def test_is_oom_error_heuristic(self):
+        from bigdl_tpu.serving.paging import BlockPoolExhausted
+        assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: out of "
+                                         "memory allocating 2.1G"))
+        assert is_oom_error(BlockPoolExhausted("need 4 blocks, 1 free"))
+        assert not is_oom_error(ValueError("bad dtype"))
+        assert not is_oom_error(None)
+
+
+class TestDurableEventsAndBridge:
+    def _tel(self, tmp_path, registry=None):
+        from bigdl_tpu.observability import StepTelemetry
+        return StepTelemetry(str(tmp_path), run_name="mem",
+                             metrics=registry, trace=False)
+
+    def test_memory_event_durable_and_gauges_render(self, tmp_path):
+        reg = MetricsRegistry()
+        tel = self._tel(tmp_path, reg)
+        led = MemoryLedger(stats_fn=_stats(1000, 2000), telemetry=tel)
+        led.register("params", lambda: 600)
+        led.record(step=3)
+        tel.close()
+        evs = [e for e in _events(tmp_path) if e["kind"] == "memory"]
+        assert len(evs) == 1 and evs[0]["step"] == 3
+        assert evs[0]["residual_bytes"] == 400
+        text = reg.render()
+        assert 'bigdl_memory_bytes{device="all",subsystem="params"} 600' \
+            in text
+        assert 'subsystem="residual"} 400' in text
+        assert 'subsystem="in_use"} 1000' in text
+        # per-device allocator truth rides the same family
+        assert 'device="tpu:0",subsystem="in_use"} 1000' in text
+        assert "bigdl_memory_headroom_bytes 1000" in text
+        assert reg.health()["status"] == "ok"     # 50% headroom
+
+    def test_low_headroom_degrades_health(self, tmp_path):
+        reg = MetricsRegistry()
+        tel = self._tel(tmp_path, reg)
+        led = MemoryLedger(stats_fn=_stats(1900, 2000), telemetry=tel)
+        led.record()                              # 5% < warn 10%
+        h = reg.health()
+        assert h["status"] == "degraded"
+        assert any(r["reason"] == "memory:headroom"
+                   for r in h["reasons"])
+        tel.close()
+
+    def test_dump_is_once_durable_and_counted(self, tmp_path):
+        reg = MetricsRegistry()
+        tel = self._tel(tmp_path, reg)
+        led = MemoryLedger(stats_fn=_stats(1000, 2000), telemetry=tel)
+        led.register("params", lambda: 600)
+        err = RuntimeError("RESOURCE_EXHAUSTED: 2.1G")
+        assert led.handle_allocation_failure(err) is not None
+        assert led.handle_allocation_failure(err) is None   # once-guard
+        assert led.dump("drill") is None
+        assert led.dump("drill", force=True) is not None    # the drill
+        tel.close()
+        dumps = [e for e in _events(tmp_path)
+                 if e["kind"] == "memory_dump"]
+        assert len(dumps) == 2                    # oom + forced drill
+        assert dumps[0]["reason"] == "RuntimeError"
+        assert "RESOURCE_EXHAUSTED" in dumps[0]["error"]
+        assert dumps[0]["ledger"]["subsystems"]["params"]["bytes"] == 600
+        assert 'bigdl_memory_dumps_total{reason="RuntimeError"} 1' \
+            in reg.render()
+        assert any(r["reason"] == "memory:dump"
+                   for r in reg.health()["reasons"])
+
+    def test_tick_ring_is_bounded_and_compact(self, tmp_path):
+        tel = self._tel(tmp_path)
+        led = MemoryLedger(stats_fn=lambda: None, telemetry=tel,
+                           last_ticks=4)
+        for i in range(10):
+            tel.record("inference", tick=i, batch=2,
+                       nested={"dropme": 1})
+        tel.record("deploy", version=1)           # not a tick kind
+        ticks = led.last_ticks()
+        assert [t["tick"] for t in ticks] == [6, 7, 8, 9]
+        assert all("nested" not in t for t in ticks)
+        assert all(t["kind"] == "inference" for t in ticks)
+        tel.close()
+
+
+class TestEngineOomDrill:
+    def _lm(self):
+        import jax
+        import jax.numpy as jnp
+        from bigdl_tpu.nn.attention import TransformerLM
+        m = TransformerLM(vocab_size=50, hidden_size=32, num_heads=4,
+                          num_layers=1, max_len=64)
+        m.build(jax.ShapeDtypeStruct((2, 16), jnp.int32),
+                rng=jax.random.PRNGKey(0))
+        return m
+
+    def test_exhaustion_dumps_exactly_once_with_parseable_ledger(
+            self, tmp_path):
+        from bigdl_tpu.observability import StepTelemetry
+        from bigdl_tpu.serving import BlockPoolExhausted, ServingEngine
+
+        m = self._lm()
+        tel = StepTelemetry(str(tmp_path), run_name="oom", trace=False)
+        # 4 blocks of 4 = 16 cache positions; prompt 12 + 16 new needs 7
+        with ServingEngine(m, decode_slots=2, decode_max_len=48,
+                           kv_block_size=4, kv_blocks=4,
+                           telemetry=tel) as eng:
+            for _ in range(2):                    # 2 sheds, 1 dump
+                fut = eng.generate(list(range(1, 13)),
+                                   max_new_tokens=16)
+                with pytest.raises(BlockPoolExhausted):
+                    fut.result(60)
+            hr = eng.memory_headroom()
+            assert hr["kv_blocks_total"] == 4
+            assert hr["kv_blocks_free"] == 4      # sheds freed cleanly
+            assert hr["kv_fill"] == 0.0
+        tel.close()
+        dumps = [e for e in _events(tmp_path)
+                 if e["kind"] == "memory_dump"]
+        assert len(dumps) == 1                    # the once-guard
+        d = dumps[0]
+        assert d["reason"] == "kv_block_pool_exhausted"
+        led = d["ledger"]
+        assert led["subsystems"]["params"]["bytes"] > 0
+        assert led["subsystems"]["kv_cache"]["blocks_total"] == 4
+        assert d["detail"]["kv"]["blocks_total"] == 4
+
+    def test_record_memory_snapshots_engine_subsystems(self, tmp_path):
+        from bigdl_tpu.observability import StepTelemetry
+        from bigdl_tpu.serving import ServingEngine
+
+        m = self._lm()
+        tel = StepTelemetry(str(tmp_path), run_name="mem", trace=False)
+        with ServingEngine(m, decode_slots=1, decode_max_len=40,
+                           kv_block_size=4, telemetry=tel) as eng:
+            eng.generate([1, 2, 3], max_new_tokens=2).result(60)
+            ev = eng.record_memory()
+            assert ev["subsystems"]["params"]["bytes"] \
+                == eng.serving_model_bytes()
+            kv = ev["subsystems"]["kv_cache"]
+            assert kv["bytes"] > 0 and kv["blocks_total"] > 0
+            assert kv["blocks_active"] + kv["blocks_cached"] \
+                + kv["blocks_free"] == kv["blocks_total"]
+        tel.close()
+        assert any(e["kind"] == "memory" for e in _events(tmp_path))
+
+
+class TestHeaderStamps:
+    def test_device_memory_bounded_to_eight(self, tmp_path, monkeypatch):
+        from bigdl_tpu.observability import telemetry as tmod
+        fake = {f"tpu:{i}": {"bytes_in_use": 10, "bytes_limit": 100}
+                for i in range(12)}
+        monkeypatch.setattr(tmod, "device_memory_stats", lambda: fake)
+        tel = tmod.StepTelemetry(str(tmp_path), run_name="hdr",
+                                 trace=False)
+        tel.write_header()
+        tel.close()
+        hdr = _events(tmp_path)[0]
+        assert hdr["kind"] == "header"
+        assert len(hdr["device_memory"]) == 8
+        assert hdr["device_memory_devices"] == 12
+
+    def test_none_stats_omit_field_silently(self, tmp_path, monkeypatch):
+        from bigdl_tpu.observability import telemetry as tmod
+        monkeypatch.setattr(tmod, "device_memory_stats", lambda: None)
+        tel = tmod.StepTelemetry(str(tmp_path), run_name="hdr",
+                                 trace=False)
+        tel.write_header()
+        tel.close()
+        hdr = _events(tmp_path)[0]
+        assert "device_memory" not in hdr
+        assert "device_memory_devices" not in hdr
+
+
+class TestMemoryBudget:
+    def test_summary_normalizes_stats_object(self):
+        from bigdl_tpu.utils import hlo
+
+        class FakeStats:
+            argument_size_in_bytes = 1000
+            output_size_in_bytes = 200
+            temp_size_in_bytes = 300
+            alias_size_in_bytes = 100
+            generated_code_size_in_bytes = 50
+
+        mem = hlo.memory_analysis_summary(FakeStats())
+        assert mem["argument_bytes"] == 1000
+        assert mem["peak_bytes"] == 1000 + 200 + 300 - 100
+        # dict-shaped and 1-list-shaped stats normalize identically
+        assert hlo.memory_analysis_summary(
+            [{"argument_size_in_bytes": 1000, "output_size_in_bytes": 200,
+              "temp_size_in_bytes": 300, "alias_size_in_bytes": 100,
+              "generated_code_size_in_bytes": 50}]) == mem
+        assert hlo.memory_analysis_summary(None) is None
+        assert hlo.memory_analysis_summary(object()) is None
+
+    def test_attach_cost_stamps_budget_on_header(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from bigdl_tpu.observability import StepTelemetry
+        from bigdl_tpu.utils import hlo
+
+        f = jax.jit(lambda a, b: a @ b)
+        x = jnp.ones((32, 32), jnp.float32)
+        tel = StepTelemetry(str(tmp_path), run_name="budget",
+                            trace=False)
+        tel.attach_cost(f, x, x, memory_budget=True)
+        tel.write_header()
+        tel.close()
+        hdr = _events(tmp_path)[0]
+        mem = hdr.get("memory_budget")
+        assert mem and mem["argument_bytes"] == 2 * 32 * 32 * 4
+        assert mem["peak_bytes"] > 0
+        # hlo_audit/profile_resnet share the exact same probe
+        c = f.lower(x, x).compile()
+        assert hlo.memory_analysis_summary(c).keys() == mem.keys()
+        assert any(ln.strip().startswith("memory budget:")
+                   for ln in hlo.format_summary_lines(
+                       hlo.compiled_summary(c, (x, x))))
+
+
+def _load(name, *path):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, *path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def obs():
+    return _load("_t_obs_mem", "tools", "obs_report.py")
+
+
+@pytest.fixture(scope="module")
+def memrep():
+    return _load("_t_mem_report", "tools", "mem_report.py")
+
+
+def _mem_run(tmp_path, n_snaps=3, dump=True, residuals=None):
+    """A crashed-serving-run shaped artifact: memory snapshots plus
+    (optionally) the forensic dump -- and NOTHING else."""
+    d = tmp_path / "run"
+    d.mkdir()
+    events = [{"kind": "header", "run": "serve", "ts": 100.0,
+               "schema_version": 1}]
+    residuals = residuals or [100] * n_snaps
+    for i in range(n_snaps):
+        events.append({
+            "kind": "memory", "ts": 100.0 + i, "tick": i,
+            "subsystems": {"params": {"bytes": 600},
+                           "kv_cache": {"bytes": 300, "blocks_total": 4,
+                                        "blocks_active": 2,
+                                        "blocks_cached": 1,
+                                        "blocks_free": 1}},
+            "attributed_bytes": 900, "live_bytes": 900 + residuals[i],
+            "residual_bytes": residuals[i], "limit_bytes": 2000,
+            "headroom_bytes": 2000 - 900 - residuals[i],
+            "headroom_fraction": (2000 - 900 - residuals[i]) / 2000.0})
+    if dump:
+        events.append({
+            "kind": "memory_dump", "ts": 100.0 + n_snaps,
+            "reason": "kv_block_pool_exhausted",
+            "error": "BlockPoolExhausted: need 7 blocks, 2 free",
+            "ledger": events[-1] | {"kind": None},
+            "detail": {"kv": {"blocks_total": 4}},
+            "last_ticks": [{"kind": "inference", "tick": n_snaps - 1}]})
+    with open(d / "telemetry.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return str(d)
+
+
+class TestObsReportMemorySection:
+    def test_memory_only_artifact_is_not_hollow(self, obs, tmp_path,
+                                                capsys):
+        d = _mem_run(tmp_path)
+        assert obs.main([d]) == 0                 # NOT exit 2
+        out = capsys.readouterr().out
+        assert "memory:" in out and "kv pool:" in out
+        assert "kv_block_pool_exhausted" in out
+        assert "mem_report" in out                # replay pointer
+
+    def test_memory_section_reconciles_and_tracks_residual(self, obs,
+                                                           tmp_path):
+        d = _mem_run(tmp_path, residuals=[100, 150, 225])
+        rep = obs.build_report(d)
+        mem = rep["memory"]
+        assert mem["snapshots"] == 3
+        last = mem["last"]
+        assert last["attributed_bytes"] + last["residual_bytes"] \
+            == last["live_bytes"]
+        assert mem["residual_first_bytes"] == 100
+        assert mem["residual_last_bytes"] == 225
+        assert len(mem["dumps"]) == 1
+
+
+class TestMemReport:
+    def test_replays_timeline_and_dump(self, memrep, tmp_path, capsys):
+        d = _mem_run(tmp_path, n_snaps=6,
+                     residuals=[100, 120, 150, 180, 220, 260])
+        assert memrep.main([d]) == 0
+        out = capsys.readouterr().out
+        assert "memory report" in out
+        assert "LEAK_SUSPECT" in out              # monotonic residual
+        assert "MEMORY DUMP [kv_block_pool_exhausted]" in out
+        assert "BlockPoolExhausted" in out
+        assert "detail.kv" in out
+
+    def test_steady_residual_no_leak_flag(self, memrep, tmp_path,
+                                          capsys):
+        d = _mem_run(tmp_path, n_snaps=5, dump=False)
+        assert memrep.main([d]) == 0
+        assert "LEAK_SUSPECT" not in capsys.readouterr().out
+
+    def test_json_roundtrip(self, memrep, tmp_path, capsys):
+        d = _mem_run(tmp_path)
+        assert memrep.main([d, "--format", "json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["snapshots"] == 3 and rep["dumps"] == 1
+        assert rep["timeline"][0]["subsystems"]["params"] == 600
+
+    def test_no_memory_events_exits_two(self, memrep, tmp_path, capsys):
+        d = tmp_path / "empty"
+        d.mkdir()
+        with open(d / "telemetry.jsonl", "w") as f:
+            f.write(json.dumps({"kind": "header", "run": "x"}) + "\n")
+        assert memrep.main([str(d)]) == 2
+        assert "no memory events" in capsys.readouterr().err
+        assert memrep.main([str(tmp_path / "nope")]) == 2

@@ -165,6 +165,40 @@ class TestGate:
         assert gate.main(["--dir", d, "--check", str(cand),
                           "--require-trusted"]) == 1
 
+    def test_peak_bytes_metric_gates_lower_is_better(self, gate,
+                                                     tmp_path, capsys):
+        """ISSUE-18 satellite: ``*_bytes``/``*_peak`` records class as
+        lower-is-better -- a synthetic regressed candidate (2x the
+        baseline's peak bytes) must trip the gate, and a within-
+        tolerance one must hold."""
+        rec = _trusted_record(1_000_000.0, metric="serving_kv_peak_bytes")
+        rec["unit"] = "bytes"
+        d = _bench_dir(tmp_path, {
+            "BENCH_r01.json": _wrapper([rec], n=1),
+        })
+        bad = dict(rec, value=2_000_000.0)
+        cand = tmp_path / "BENCH_new.json"
+        cand.write_text(json.dumps(bad))
+        assert gate.main(["--dir", d, "--check", str(cand)]) == 1
+        out = capsys.readouterr().out
+        assert "lower-is-better" in out and "REGRESSION" in out
+        cand.write_text(json.dumps(dict(rec, value=1_020_000.0)))
+        assert gate.main(["--dir", d, "--check", str(cand)]) == 0
+
+    def test_direction_classing(self, gate):
+        """Explicit direction wins; ratio/saved names stay higher even
+        when byte-flavored (``serving_paged_kv_bytes_ratio`` must not
+        invert); peak/bytes suffixes go lower."""
+        assert gate.metric_direction("serving_kv_peak_bytes") == "lower"
+        assert gate.metric_direction("decode_peak") == "lower"
+        assert gate.metric_direction(
+            "serving_paged_kv_bytes_ratio") == "higher"
+        assert gate.metric_direction(
+            "serving_prefix_prefill_saved") == "higher"
+        assert gate.metric_direction("m_imgs_per_sec") == "higher"
+        assert gate.metric_direction(
+            "whatever", {"direction": "lower"}) == "lower"
+
     def test_json_format_is_machine_readable(self, gate, tmp_path,
                                              capsys):
         d = _bench_dir(tmp_path, {

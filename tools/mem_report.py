@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Memory timeline + OOM forensics replay from a run's telemetry.jsonl.
+
+Where ``tools/obs_report.py`` gives a memory SUMMARY inside the full
+run report, this tool is the dedicated view: every ``kind: "memory"``
+ledger snapshot as one timeline row (per-subsystem bytes, live,
+residual, headroom), a leak verdict from the residual trajectory, and
+a full REPLAY of any ``kind: "memory_dump"`` forensic event -- the
+ledger table, the KV block-table occupancy and the last N serving
+ticks the dying process managed to fsync
+(``bigdl_tpu/observability/memory.py``; schemas in
+docs/observability.md, "Memory observability").
+
+    python tools/mem_report.py RUN_DIR            # text timeline
+    python tools/mem_report.py RUN_DIR --format json
+
+Exit codes: 0 rendered; 2 the run recorded no memory events at all
+(the memory analogue of obs_report's hollow-run refusal).
+
+No jax import -- runs anywhere the artifacts were copied.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+
+#: ledger keys rendered as timeline columns, in order
+_COLUMNS = ("attributed_bytes", "live_bytes", "residual_bytes",
+            "headroom_bytes")
+
+
+def load_memory_events(jsonl_path):
+    """-> ([memory events], [memory_dump events]), crash-tolerant the
+    same way obs_report reads: a truncated final line is skipped, not
+    fatal -- the dump we came for is usually the line BEFORE the one
+    the dying process lost."""
+    snaps, dumps = [], []
+    with open(jsonl_path, errors="replace") as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                ev = json.loads(ln)
+            except ValueError:
+                continue
+            kind = ev.get("kind")
+            if kind == "memory":
+                snaps.append(ev)
+            elif kind == "memory_dump":
+                dumps.append(ev)
+    return snaps, dumps
+
+
+def fmt_bytes(v):
+    if v is None:
+        return "-"
+    if abs(v) >= 1e9:
+        return f"{v / 1e9:.2f} GB"
+    if abs(v) >= 1e6:
+        return f"{v / 1e6:.2f} MB"
+    if abs(v) >= 1e3:
+        return f"{v / 1e3:.1f} kB"
+    return f"{int(v)} B"
+
+
+def residual_verdict(snaps):
+    """Leak heuristic over the residual trajectory: ``"leak_suspect"``
+    when the residual grew monotonically (within jitter) across >= 4
+    snapshots and ended above where it started, else ``"steady"``;
+    None when the run never had a reconcilable residual (CPU)."""
+    residuals = [e["residual_bytes"] for e in snaps
+                 if e.get("residual_bytes") is not None]
+    if len(residuals) < 2:
+        return None
+    grew = sum(1 for a, b in zip(residuals, residuals[1:]) if b > a)
+    if len(residuals) >= 4 and grew >= (len(residuals) - 1) * 0.75 \
+            and residuals[-1] > residuals[0]:
+        return "leak_suspect"
+    return "steady"
+
+
+def build(run_dir):
+    jsonl = os.path.join(run_dir, "telemetry.jsonl")
+    if not os.path.isfile(jsonl):
+        raise FileNotFoundError(f"no telemetry.jsonl under {run_dir}")
+    snaps, dumps = load_memory_events(jsonl)
+    rep = {"run_dir": run_dir, "snapshots": len(snaps),
+           "dumps": len(dumps)}
+    if snaps:
+        t0 = snaps[0].get("ts")
+        rows = []
+        # bound the timeline: first/last always kept, stride the middle
+        stride = max(1, math.ceil(len(snaps) / 40))
+        for i, e in enumerate(snaps):
+            if i % stride and i != len(snaps) - 1:
+                continue
+            row = {"t_s": round(e["ts"] - t0, 3)
+                   if e.get("ts") is not None and t0 is not None
+                   else None}
+            for k in ("step", "tick"):
+                if e.get(k) is not None:
+                    row[k] = e[k]
+            for k in _COLUMNS:
+                row[k] = e.get(k)
+            row["subsystems"] = {
+                name: (rec.get("bytes") if isinstance(rec, dict) else rec)
+                for name, rec in (e.get("subsystems") or {}).items()}
+            rows.append(row)
+        rep["timeline"] = rows
+        verdict = residual_verdict(snaps)
+        if verdict:
+            rep["residual_verdict"] = verdict
+    if dumps:
+        rep["dump_events"] = dumps
+    return rep
+
+
+def _render_ledger(led, out, indent="  "):
+    subs = led.get("subsystems") or {}
+    width = max([len(n) for n in subs] + [len("residual")])
+    for name in sorted(subs):
+        rec = subs[name]
+        b = rec.get("bytes") if isinstance(rec, dict) else rec
+        line = f"{indent}{name:<{width}}  {fmt_bytes(b):>10}"
+        if isinstance(rec, dict) and rec.get("blocks_total"):
+            line += (f"   [{rec.get('blocks_active', 0)} active / "
+                     f"{rec.get('blocks_cached', 0)} cached / "
+                     f"{rec.get('blocks_free', 0)} free of "
+                     f"{rec['blocks_total']} blocks]")
+        if isinstance(rec, dict) and rec.get("error"):
+            line += f"   SOURCE FAILED: {rec['error']}"
+        out.append(line)
+    if led.get("residual_bytes") is not None:
+        out.append(f"{indent}{'residual':<{width}}  "
+                   f"{fmt_bytes(led['residual_bytes']):>10}")
+    totals = (f"{indent}attributed {fmt_bytes(led.get('attributed_bytes'))}")
+    if led.get("live_bytes") is not None:
+        totals += (f"   live {fmt_bytes(led['live_bytes'])} of "
+                   f"{fmt_bytes(led.get('limit_bytes'))}   headroom "
+                   f"{fmt_bytes(led.get('headroom_bytes'))}")
+        if led.get("headroom_fraction") is not None:
+            totals += f" ({led['headroom_fraction']:.1%})"
+    else:
+        totals += "   (no allocator stats on this backend)"
+    out.append(totals)
+
+
+def format_text(rep):
+    out = [f"== memory report: {rep['run_dir']} =="]
+    rows = rep.get("timeline") or []
+    if rows:
+        out.append(f"{rep['snapshots']} snapshot(s):")
+        hdr = f"  {'t+s':>8}  {'attributed':>11} {'live':>11} " \
+              f"{'residual':>11} {'headroom':>11}  subsystems"
+        out.append(hdr)
+        for r in rows:
+            subs = " ".join(f"{n}={fmt_bytes(b)}"
+                            for n, b in sorted(r["subsystems"].items()))
+            out.append(
+                f"  {r.get('t_s', '-'):>8}  "
+                f"{fmt_bytes(r.get('attributed_bytes')):>11} "
+                f"{fmt_bytes(r.get('live_bytes')):>11} "
+                f"{fmt_bytes(r.get('residual_bytes')):>11} "
+                f"{fmt_bytes(r.get('headroom_bytes')):>11}  {subs}")
+        if rep.get("residual_verdict"):
+            flag = rep["residual_verdict"]
+            out.append(f"residual verdict: {flag.upper()}"
+                       + ("  (residual grew monotonically -- bytes no "
+                          "subsystem owns up to)" if flag == "leak_suspect"
+                          else ""))
+    for d in rep.get("dump_events") or []:
+        out.append("")
+        out.append(f"MEMORY DUMP [{d.get('reason')}]"
+                   + (f" at ts {d['ts']:.3f}" if d.get("ts") else ""))
+        if d.get("error"):
+            out.append(f"  error: {d['error']}")
+        led = d.get("ledger") or {}
+        if led:
+            _render_ledger(led, out)
+        detail = d.get("detail") or {}
+        for k, v in sorted(detail.items()):
+            out.append(f"  detail.{k}: {json.dumps(v, default=str)}")
+        ticks = d.get("last_ticks") or []
+        if ticks:
+            out.append(f"  last {len(ticks)} tick(s) before death:")
+            for t in ticks[-8:]:
+                keys = ("kind", "tick", "step", "batch", "tokens",
+                        "kv_blocks_used", "kv_blocks_cached",
+                        "kv_blocks_free")
+                frag = " ".join(f"{k}={t[k]}" for k in keys if k in t)
+                out.append(f"    {frag or json.dumps(t, default=str)}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", help="directory holding telemetry.jsonl")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+    try:
+        rep = build(args.run_dir)
+    except FileNotFoundError as e:
+        print(f"mem_report: {e}", file=sys.stderr)
+        return 2
+    if not rep["snapshots"] and not rep["dumps"]:
+        print(f"mem_report: {args.run_dir} recorded no memory events "
+              f"(no kind:\"memory\" snapshots, no memory_dump) -- was "
+              f"the MemoryLedger attached and record()ed?",
+              file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(format_text(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,8 +33,10 @@ non-finite floats mapped to null (strictly valid JSON), so CI and
 bench.py can assert on health/occupancy numbers.  The reader tolerates
 a truncated final JSONL line / undecodable bytes from a crashed run.
 A run dir whose artifacts carry ZERO events worth reporting (no steps,
-no serving/recovery/health/validation) exits nonzero: a hollow report
-silently passing in scripts is how a broken telemetry hookup hides.
+no serving/recovery/health/validation/memory) exits nonzero: a hollow
+report silently passing in scripts is how a broken telemetry hookup
+hides.  Memory events count -- the lone ``memory_dump`` a crashed run
+left behind is exactly an artifact worth reporting.
 
 No jax import -- the report runs anywhere the artifacts were copied.
 """
@@ -531,6 +533,48 @@ def _slo_section(other):
             "objectives": [objectives[k] for k in sorted(objectives)]}
 
 
+def _memory_section(other, header=None):
+    """Summarize the device-memory ledger (observability/memory.py):
+    ``kind: "memory"`` snapshots (per-subsystem attribution reconciled
+    against ``device_memory_stats()``), forensic ``memory_dump``
+    events, and the compiled-program ``memory_budget`` stamped by
+    ``attach_cost(memory_budget=True)``.  The residual trajectory is
+    the leak detector: a residual that only grows is bytes no
+    registered subsystem owns up to.  None when the run recorded none
+    of the three."""
+    snaps = [e for e in other if e.get("kind") == "memory"]
+    dumps = [e for e in other if e.get("kind") == "memory_dump"]
+    budget = (header or {}).get("memory_budget")
+    for ev in other:
+        if ev.get("kind") == "cost" and ev.get("memory_budget"):
+            budget = ev["memory_budget"]
+    if not snaps and not dumps and not budget:
+        return None
+    sec = {"snapshots": len(snaps)}
+    last = snaps[-1] if snaps \
+        else (dumps[-1].get("ledger") if dumps else None)
+    if last:
+        sec["last"] = {k: last.get(k) for k in
+                       ("subsystems", "attributed_bytes", "live_bytes",
+                        "residual_bytes", "limit_bytes",
+                        "headroom_bytes", "headroom_fraction")}
+    residuals = [e["residual_bytes"] for e in snaps
+                 if e.get("residual_bytes") is not None]
+    if residuals:
+        sec["residual_first_bytes"] = residuals[0]
+        sec["residual_last_bytes"] = residuals[-1]
+        sec["residual_max_bytes"] = max(residuals)
+    if dumps:
+        sec["dumps"] = [{"reason": d.get("reason"),
+                         "error": d.get("error"), "ts": d.get("ts"),
+                         "detail": d.get("detail"),
+                         "last_ticks": len(d.get("last_ticks") or ())}
+                        for d in dumps]
+    if budget:
+        sec["compiled_budget"] = budget
+    return sec
+
+
 def _recovery_section(other):
     """Summarize ``kind: "recovery"`` events -- the RunSupervisor's
     restart records (docs/robustness.md): one entry per restart (cause,
@@ -791,6 +835,9 @@ def build_report(run_dir, xplane_dir=None, top=10):
     slo = _slo_section(other)
     if slo:
         rep["slo"] = slo
+    memory = _memory_section(other, header)
+    if memory:
+        rep["memory"] = memory
     tracing = _tracing_section(run_dir)
     if tracing:
         rep["tracing"] = tracing
@@ -821,6 +868,19 @@ def build_report(run_dir, xplane_dir=None, top=10):
 
 def _fmt_s(v):
     return "-" if v is None else f"{v * 1e3:.2f} ms"
+
+
+def _fmt_b(v):
+    """Bytes for humans: 12_345_678 -> '12.35 MB'; None -> '-'."""
+    if v is None:
+        return "-"
+    if abs(v) >= 1e9:
+        return f"{v / 1e9:.2f} GB"
+    if abs(v) >= 1e6:
+        return f"{v / 1e6:.2f} MB"
+    if abs(v) >= 1e3:
+        return f"{v / 1e3:.1f} kB"
+    return f"{int(v)} B"
 
 
 def format_report(rep):
@@ -1134,6 +1194,51 @@ def format_report(rep):
                 f"SLO [{o['objective']}] {o.get('slo')}: "
                 f"{o['breaches']} breach(es), {state} "
                 f"(policy {o.get('policy')})")
+    mem = rep.get("memory")
+    if mem:
+        last = mem.get("last")
+        if last:
+            rows = []
+            for name in sorted(last.get("subsystems") or {}):
+                rec = last["subsystems"][name]
+                b = rec.get("bytes") if isinstance(rec, dict) else rec
+                rows.append(f"{name} {_fmt_b(b)}")
+            if last.get("residual_bytes") is not None:
+                rows.append(f"residual {_fmt_b(last['residual_bytes'])}")
+            line = "memory: " + " / ".join(rows)
+            if last.get("live_bytes") is not None:
+                line += (f"   (live {_fmt_b(last['live_bytes'])} of "
+                         f"{_fmt_b(last.get('limit_bytes'))}, headroom "
+                         f"{_fmt_b(last.get('headroom_bytes'))})")
+            out.append(line)
+            kv = (last.get("subsystems") or {}).get("kv_cache")
+            if isinstance(kv, dict) and kv.get("blocks_total"):
+                out.append(
+                    f"  kv pool: {kv.get('blocks_active', 0)} active / "
+                    f"{kv.get('blocks_cached', 0)} cached / "
+                    f"{kv.get('blocks_free', 0)} free of "
+                    f"{kv['blocks_total']} blocks")
+        if mem.get("residual_last_bytes") is not None \
+                and mem.get("snapshots", 0) > 1:
+            out.append(
+                f"  residual trajectory: "
+                f"{_fmt_b(mem['residual_first_bytes'])} -> "
+                f"{_fmt_b(mem['residual_last_bytes'])} over "
+                f"{mem['snapshots']} snapshots "
+                f"(max {_fmt_b(mem['residual_max_bytes'])})")
+        for d in mem.get("dumps", []):
+            out.append(
+                f"MEMORY DUMP [{d.get('reason')}]"
+                + (f": {d['error']}" if d.get("error") else "")
+                + f"  ({d.get('last_ticks', 0)} ticks of context; "
+                  f"replay with tools/mem_report.py)")
+        cb = mem.get("compiled_budget")
+        if cb:
+            out.append(
+                f"  compiled budget: args {_fmt_b(cb.get('argument_bytes'))}"
+                f" + out {_fmt_b(cb.get('output_bytes'))} + temp "
+                f"{_fmt_b(cb.get('temp_bytes'))} "
+                f"(~{_fmt_b(cb.get('peak_bytes'))} peak)")
     rc = rep.get("recovery")
     if rc:
         for e in rc.get("reshards", [])[-6:]:
@@ -1229,15 +1334,18 @@ def main(argv=None):
     if rep["n_steps"] == 0 and not any(
             rep.get(k) for k in ("serving", "recovery", "health",
                                  "validations", "slo", "fleet",
-                                 "tracing")):
+                                 "tracing", "memory")):
         # an empty/truncated JSONL must FAIL in scripts, not render a
         # hollow report: zero step events and nothing else to show
         # means the run recorded nothing (broken telemetry hookup, or
-        # the wrong directory)
+        # the wrong directory).  A memory-events-only artifact (the
+        # OOM dump a crashed run left behind) is NOT hollow -- it is
+        # exactly the artifact a post-mortem runs this tool on.
         print(f"obs_report: {args.run_dir} contains zero step events "
-              f"and no serving/recovery/health/validation events -- "
-              f"nothing to report (is this the right run dir, and was "
-              f"telemetry actually attached?)", file=sys.stderr)
+              f"and no serving/recovery/health/validation/memory "
+              f"events -- nothing to report (is this the right run "
+              f"dir, and was telemetry actually attached?)",
+              file=sys.stderr)
         return 2
     if fmt == "json":
         print(json.dumps(_json_safe(rep), indent=2, allow_nan=False))

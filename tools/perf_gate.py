@@ -24,7 +24,17 @@ of them into one per-metric trajectory and gates on it:
   against the best earlier one: a drop beyond ``--tolerance`` exits
   nonzero, naming the regression.  ``--check FILE`` gates candidate
   record(s) (a fresh bench run) against the checked-in history without
-  adding them to it -- the CI spelling.
+  adding them to it -- the CI spelling;
+- metrics are direction-classed: most are higher-is-better
+  (images/sec, tokens/sec, speedup ratios), but PEAK-BYTES metrics
+  (``*_bytes`` -- KV-cache or activation memory at fixed concurrency,
+  the ROADMAP item 3 bench legs) are lower-is-better: for those the
+  BEST history entry is the MINIMUM and a candidate above the
+  tolerance ceiling trips the gate.  A record may also carry an
+  explicit ``direction: "lower"|"higher"`` field, which wins over the
+  name heuristic.  (``*_ratio`` / ``*_saved`` names stay
+  higher-is-better even when they measure bytes -- the paged-KV
+  ``serving_paged_kv_bytes_ratio`` is a reduction factor.)
 
     python -m tools.perf_gate                        # gate the repo
     python -m tools.perf_gate --check BENCH_new.json # gate a candidate
@@ -151,6 +161,26 @@ def classify_trust(record):
     return TimingAuditor().audit_record(record)["trust"]
 
 
+def metric_direction(metric, record=None):
+    """``"higher"`` or ``"lower"`` -- which way this metric improves.
+
+    An explicit ``direction`` field on the record wins.  Otherwise the
+    name decides: ``*_ratio`` / ``*_saved`` are improvement factors
+    (higher), and ``*_bytes`` / ``*_peak`` are memory footprints
+    (lower) -- a KV-cache or activation-memory record regresses by
+    GROWING, unlike every throughput metric."""
+    rec_dir = (record or {}).get("direction")
+    if rec_dir in ("lower", "higher"):
+        return rec_dir
+    name = str(metric or "")
+    if name.endswith("_ratio") or name.endswith("_saved"):
+        return "higher"
+    if name.endswith("_bytes") or "_peak_bytes" in name \
+            or name.endswith("_peak"):
+        return "lower"
+    return "higher"
+
+
 def _entry(record, rnd_label, source):
     value = record.get("value")
     trust = classify_trust(record)
@@ -165,6 +195,7 @@ def _entry(record, rnd_label, source):
         "vs_baseline": record.get("vs_baseline"),
         "trust": trust,
         "superseded": superseded,
+        "direction": metric_direction(record.get("metric"), record),
         # a baseline must be a real, trusted, non-superseded number
         "baseline_eligible": (finite and not superseded
                               and trust in TRUST_BASELINE_OK),
@@ -206,13 +237,15 @@ def gate(trajectory, tolerance=0.05, require_trusted=False):
     """Evaluate the regression gate; returns (regressions, notes).
 
     Per metric: the newest baseline-eligible entry is the claim under
-    test; the BEST earlier baseline-eligible value is the bar (all the
-    repo's bench metrics are higher-is-better: images/sec, tokens/sec,
-    req/s speedups, wire-byte reduction).  A claim more than
-    ``tolerance`` below the bar is a regression.  With
-    ``require_trusted``, a candidate whose trust class is not
-    baseline-eligible fails outright -- CI for perf PRs that MUST ship
-    a trusted number."""
+    test; the BEST earlier baseline-eligible value is the bar.  For
+    higher-is-better metrics (images/sec, tokens/sec, req/s speedups,
+    wire-byte reduction ratios) best = max and a claim more than
+    ``tolerance`` BELOW it regresses; for lower-is-better peak-bytes
+    metrics (``metric_direction``) best = min and a claim more than
+    ``tolerance`` ABOVE it regresses -- memory creep trips the gate
+    exactly like an MFU drop.  With ``require_trusted``, a candidate
+    whose trust class is not baseline-eligible fails outright -- CI
+    for perf PRs that MUST ship a trusted number."""
     regressions, notes = [], []
     for metric, entries in sorted(trajectory["metrics"].items()):
         candidates = [e for e in entries if e.get("candidate")]
@@ -247,6 +280,23 @@ def gate(trajectory, tolerance=0.05, require_trusted=False):
                 notes.append(f"{metric}: first trusted record "
                              f"({cand['round']}, {cand['value']:g} "
                              f"{cand['unit'] or ''}) sets the baseline")
+                continue
+            if cand.get("direction") == "lower":
+                best = min(history, key=lambda e: e["value"])
+                ceiling = best["value"] * (1.0 + tolerance)
+                if cand["value"] > ceiling:
+                    regressions.append(
+                        f"{metric}: {cand['round']} = {cand['value']:g} "
+                        f"{cand['unit'] or ''} regresses the trusted "
+                        f"baseline {best['value']:g} ({best['round']}) "
+                        f"by {cand['value'] / best['value'] - 1:.1%} "
+                        f"growth (> {tolerance:.0%} tolerance, "
+                        f"lower-is-better)")
+                else:
+                    notes.append(
+                        f"{metric}: {cand['round']} = {cand['value']:g} "
+                        f"holds the trusted baseline {best['value']:g} "
+                        f"({best['round']}, lower-is-better)")
                 continue
             best = max(history, key=lambda e: e["value"])
             floor = best["value"] * (1.0 - tolerance)
@@ -285,6 +335,8 @@ def format_trajectory(trajectory, regressions, notes):
                 flags.append("candidate")
             if e["baseline_eligible"]:
                 flags.append("baseline-eligible")
+            if e.get("direction") == "lower":
+                flags.append("lower-is-better")
             v = "-" if e["value"] is None else f"{e['value']:g}"
             out.append(f"  {e['round']:<14} {v:>12} {e['unit'] or '':<10}"
                        f" trust={e['trust']:<22}"

@@ -6,6 +6,7 @@ Usage:  python tools/profile_resnet.py [variant ...]
 Variants: fwd fwdbwd full batch256 nocast nhwc_hlo
 """
 
+import json
 import os
 import sys
 import time
@@ -136,11 +137,13 @@ def main():
         n_transpose = txt.count(" transpose(")
         n_convert = txt.count(" convert(")
         print(f"transpose ops: {n_transpose}, convert ops: {n_convert}")
-        try:
-            mem = c.memory_analysis()
-            print("memory:", mem)
-        except Exception:
-            pass
+        from bigdl_tpu.utils import hlo as hlo_audit
+
+        mem = hlo_audit.memory_analysis_summary(c)
+        if mem:
+            # same normalized fields attach_cost stamps on telemetry
+            # headers and hlo_audit renders -- one probe, no drift
+            print("memory:", json.dumps(mem))
 
 
 if __name__ == "__main__":
