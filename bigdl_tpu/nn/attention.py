@@ -248,10 +248,48 @@ class MultiHeadAttention(Module):
         axis is physical blocks, not slots: memory scales with tokens
         actually resident, not ``slots x max_len`` worst case.  The
         caller includes the trash block in ``num_blocks`` (by
-        convention the last id)."""
+        convention the last id).
+
+        ``dtype=jnp.int8`` selects the QUANTIZED block layout: int8
+        K/V payloads plus fp32 absmax scales -- one scale per (position,
+        head) ``head_dim`` vector, i.e. the ops/quantization.py
+        blockwise format with the quantization block = ``head_dim``.
+        The scale leaves keep the payload's 4-D ``(blocks, block_size,
+        heads, 1)`` rank so every pool consumer that tree-maps by rank
+        (block copies, donation, byte accounting) handles both layouts
+        with one code path."""
         shape = (int(num_blocks), int(block_size), self.num_heads,
                  self.head_dim)
+        if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
+            sshape = shape[:-1] + (1,)
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(sshape, jnp.float32),
+                    "v_scale": jnp.zeros(sshape, jnp.float32)}
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def _paged_quant(self, x):
+        """fp K/V vectors ``(..., heads, head_dim)`` -> (int8 payload,
+        fp32 scales ``(..., heads, 1)``) through the blockwise wire
+        kernel (one absmax scale per head_dim vector; non-finite
+        vectors drop to exact zero, same contract as the wire path)."""
+        from bigdl_tpu.ops.quantization import quantize_blockwise
+
+        q8, sc = quantize_blockwise(x.reshape(-1), self.head_dim,
+                                    scale_dtype=jnp.float32)
+        return q8.reshape(x.shape), sc.reshape(x.shape[:-1] + (1,))
+
+    def _paged_dequant(self, q8, sc, dt):
+        """Inverse of ``_paged_quant`` over gathered context blocks:
+        ``(..., heads, head_dim)`` int8 + ``(..., heads, 1)`` scales ->
+        ``dt`` values."""
+        from bigdl_tpu.ops.quantization import dequantize_blockwise
+
+        lead = q8.shape[:-2]
+        flat = q8.reshape(lead + (q8.shape[-2] * q8.shape[-1],))
+        out = dequantize_blockwise(flat, sc.reshape(lead + (-1,)),
+                                   self.head_dim)
+        return out.reshape(q8.shape).astype(dt)
 
     def _flash_paged_ok(self, block_size):
         if self.use_flash == "never" or self.seq_axis_name is not None:
@@ -296,6 +334,7 @@ class MultiHeadAttention(Module):
         n, t, d = input.shape
         dt = input.dtype
         cdt = pool["k"].dtype
+        quant = "k_scale" in pool      # int8 payload + fp32 scale leaves
         bs = pool["k"].shape[1]
         max_blocks = tables.shape[1]
         trash = pool["k"].shape[0] - 1
@@ -305,6 +344,34 @@ class MultiHeadAttention(Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (n, t, self.num_heads, self.head_dim)
         q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+
+        def scatter(phys, off, kf, vf):
+            """Write one batch of K/V rows through the table: quantize
+            first on an int8 pool (payload + scales land at the same
+            (block, offset) address, so the table indirection, COW block
+            copies and prefix sharing are format-blind)."""
+            if quant:
+                kq, ksc = self._paged_quant(kf)
+                vq, vsc = self._paged_quant(vf)
+                return {"k": pool["k"].at[phys, off].set(kq),
+                        "v": pool["v"].at[phys, off].set(vq),
+                        "k_scale": pool["k_scale"].at[phys, off].set(ksc),
+                        "v_scale": pool["v_scale"].at[phys, off].set(vsc)}
+            return {"k": pool["k"].at[phys, off].set(kf.astype(cdt)),
+                    "v": pool["v"].at[phys, off].set(vf.astype(cdt))}
+
+        def gather_ctx(new_pool, name):
+            """The row's full mapped context from the pool, dequantized
+            to the compute dtype on an int8 pool."""
+            ctx = max_blocks * bs
+            raw = jnp.take(new_pool[name], tables, axis=0).reshape(
+                n, ctx, self.num_heads, self.head_dim)
+            if quant:
+                sc = jnp.take(new_pool[name + "_scale"], tables,
+                              axis=0).reshape(n, ctx, self.num_heads, 1)
+                return self._paged_dequant(raw, sc, dt)
+            return raw.astype(dt)
+
         if lengths is not None:                           # chunk prefill
             lengths = jnp.asarray(lengths, jnp.int32)
             gpos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
@@ -315,16 +382,12 @@ class MultiHeadAttention(Module):
             phys = jnp.where(valid, phys, trash)
             off = gpos % bs
             flat = (n * t,)
-            new_pool = {
-                "k": pool["k"].at[phys.reshape(flat), off.reshape(flat)]
-                .set(k.astype(cdt).reshape(flat + shape[2:])),
-                "v": pool["v"].at[phys.reshape(flat), off.reshape(flat)]
-                .set(v.astype(cdt).reshape(flat + shape[2:]))}
+            new_pool = scatter(phys.reshape(flat), off.reshape(flat),
+                               k.reshape(flat + shape[2:]),
+                               v.reshape(flat + shape[2:]))
             ctx = max_blocks * bs
-            ctx_k = jnp.take(new_pool["k"], tables, axis=0).reshape(
-                n, ctx, self.num_heads, self.head_dim).astype(dt)
-            ctx_v = jnp.take(new_pool["v"], tables, axis=0).reshape(
-                n, ctx, self.num_heads, self.head_dim).astype(dt)
+            ctx_k = gather_ctx(new_pool, "k")
+            ctx_v = gather_ctx(new_pool, "v")
             # (N, 1, Tc, ctx): key at logical position kp is visible to
             # the chunk token at absolute position gpos iff kp <= gpos
             mask = (jnp.arange(ctx, dtype=jnp.int32)[None, None, :]
@@ -337,23 +400,27 @@ class MultiHeadAttention(Module):
             phys = jnp.take_along_axis(
                 tables, (pos // bs)[:, None], axis=1)[:, 0]
             off = pos % bs
-            new_pool = {
-                "k": pool["k"].at[phys, off].set(k[:, 0].astype(cdt)),
-                "v": pool["v"].at[phys, off].set(v[:, 0].astype(cdt))}
+            new_pool = scatter(phys, off, k[:, 0], v[:, 0])
             if self._flash_paged_ok(bs):
                 from bigdl_tpu.ops.flash_attention import \
                     flash_paged_decode_attention
 
-                y = flash_paged_decode_attention(
-                    q, new_pool["k"].astype(dt), new_pool["v"].astype(dt),
-                    tables, pos,
-                    interpret=self.use_flash == "interpret")
+                if quant:
+                    y = flash_paged_decode_attention(
+                        q, new_pool["k"], new_pool["v"], tables, pos,
+                        k_scale=new_pool["k_scale"],
+                        v_scale=new_pool["v_scale"],
+                        interpret=self.use_flash == "interpret")
+                else:
+                    y = flash_paged_decode_attention(
+                        q, new_pool["k"].astype(dt),
+                        new_pool["v"].astype(dt), tables, pos,
+                        interpret=self.use_flash == "interpret")
+                y = y.astype(dt)
             else:
                 ctx = max_blocks * bs
-                ctx_k = jnp.take(new_pool["k"], tables, axis=0).reshape(
-                    n, ctx, self.num_heads, self.head_dim).astype(dt)
-                ctx_v = jnp.take(new_pool["v"], tables, axis=0).reshape(
-                    n, ctx, self.num_heads, self.head_dim).astype(dt)
+                ctx_k = gather_ctx(new_pool, "k")
+                ctx_v = gather_ctx(new_pool, "v")
                 mask = (jnp.arange(ctx, dtype=jnp.int32)[None, :]
                         <= pos[:, None])[:, None, None, :]
                 y = dot_product_attention(q, ctx_k, ctx_v, mask=mask)

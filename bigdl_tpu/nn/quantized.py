@@ -413,8 +413,13 @@ def quantize_model(model: Module, params=None,
     qmodel.train_mode = False
     # each model owns its executables (validation.compiled_eval_step
     # caches ON the instance); sharing would key int8 and fp32 steps
-    # into one bound
-    qmodel.__dict__.pop("_compiled_eval_steps", None)
+    # into one bound.  The serving step caches are worse than an
+    # eviction hazard: copy.copy shares the DICT OBJECT, and the
+    # compiled closures inside capture the fp32 original -- a shared
+    # cache would hand the twin fp32 executables outright (the
+    # speculative drafter would silently verify itself)
+    for slot in [k for k in qmodel.__dict__ if k.startswith("_compiled_")]:
+        qmodel.__dict__.pop(slot, None)
     return qmodel, qparams
 
 

@@ -602,6 +602,18 @@ class MetricsRegistry:
                          "prompt positions whose prefill compute the "
                          "prefix cache absorbed") \
                 .inc(event["prefix_hit_tokens"])
+        # speculative ticks (serving/generation.py SpeculativeScheduler)
+        # stamp drafted/accepted deltas: accepted/drafted is the live
+        # acceptance rate, and accepted+rounds bounds tokens-per-verify
+        if event.get("spec_drafted"):
+            self.counter(f"{p}_serving_spec_drafted_total",
+                         "draft tokens proposed by the speculative "
+                         "drafter") \
+                .inc(event["spec_drafted"])
+        if event.get("spec_accepted"):
+            self.counter(f"{p}_serving_spec_accepted_total",
+                         "draft tokens the fp32 verifier accepted") \
+                .inc(event["spec_accepted"])
         if event.get("compiles"):
             self.counter(f"{p}_serving_recompiles_total",
                          "XLA compiles inside serving ticks (nonzero "

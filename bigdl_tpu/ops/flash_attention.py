@@ -177,15 +177,25 @@ def flash_decode_attention(q, k, v, pos, block_k: int = 128,
     return out.reshape(b, h, 1, d).transpose(0, 2, 1, 3)
 
 
-def _paged_decode_kernel(pos_ref, table_ref, q_ref, k_ref, v_ref, o_ref, *,
-                         block_size: int, scale: float):
+def _paged_decode_kernel(pos_ref, table_ref, q_ref, k_ref, v_ref, *rest,
+                         block_size: int, scale: float, quantized: bool):
     """Paged decode step: like ``_decode_kernel`` but the K/V blocks
     are INDIRECT -- loop iteration ``j`` covers logical positions
     ``[j*bs, (j+1)*bs)``, whose K/V physically live at pool block
     ``table[j]``; the ``pl.ds`` slice start is the dynamically-loaded
     table entry.  The trip count is still the dynamic frontier count
     ``ceil((pos + 1) / bs)``, so a short sequence in a big pool reads
-    only the blocks it has actually mapped."""
+    only the blocks it has actually mapped.
+
+    ``quantized=True`` adds two scale refs (per-position-per-head fp32
+    absmax scales, one per K/V ``head_dim`` vector): each int8 block
+    dequantizes IN-KERNEL -- payload * scale right after the VMEM load,
+    so the fp32 K/V context the XLA fallback would materialise in HBM
+    never exists and the pool traffic stays at int8 width."""
+    if quantized:
+        ks_ref, vs_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
     d = q_ref.shape[-1]
     bs = block_size
     p = pos_ref[0]
@@ -197,6 +207,10 @@ def _paged_decode_kernel(pos_ref, table_ref, q_ref, k_ref, v_ref, o_ref, *,
         bid = pl.load(table_ref, (pl.ds(j, 1),))[0]   # physical block id
         kblk = k_ref[pl.ds(bid * bs, bs), :].astype(jnp.float32)
         vblk = v_ref[pl.ds(bid * bs, bs), :].astype(jnp.float32)
+        if quantized:
+            # (bs, 1) scale columns broadcast over head_dim
+            kblk = kblk * ks_ref[pl.ds(bid * bs, bs), :]
+            vblk = vblk * vs_ref[pl.ds(bid * bs, bs), :]
         s = q @ kblk.T                                # (1, bs)
         kpos = j * bs + jax.lax.broadcasted_iota(
             jnp.int32, (1, bs), 1)
@@ -220,6 +234,7 @@ def _paged_decode_kernel(pos_ref, table_ref, q_ref, k_ref, v_ref, o_ref, *,
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def flash_paged_decode_attention(q, k_pool, v_pool, tables, pos,
+                                 k_scale=None, v_scale=None,
                                  interpret: bool = False):
     """Single-token decode attention through a PAGED K/V pool:
     ``q (B, 1, H, D)`` against pools ``k_pool, v_pool (NB, bs, H, D)``
@@ -232,6 +247,11 @@ def flash_paged_decode_attention(q, k_pool, v_pool, tables, pos,
     gather that the XLA fallback materialises (``(B, max_blocks*bs,
     H, D)`` per layer per step) never exists -- each program streams
     exactly the ``ceil((pos+1)/bs)`` blocks its row has mapped.
+
+    ``k_scale``/``v_scale`` (both or neither, ``(NB, bs, H, 1)`` fp32)
+    select the INT8 pool layout: payloads are int8 and each block
+    dequantizes in-kernel against its per-position-per-head scale
+    column, so HBM<->VMEM traffic stays at the narrow width end to end.
     ``interpret=True`` runs on CPU for tests; on real TPU the pool
     plane per head rides VMEM whole and tiny ``bs`` is below the
     128-lane tile, so auto mode gates on ``bs % 128 == 0``
@@ -242,31 +262,47 @@ def flash_paged_decode_attention(q, k_pool, v_pool, tables, pos,
     nb, bs = k_pool.shape[0], k_pool.shape[1]
     mb = tables.shape[1]
     assert t1 == 1, f"decode takes one query token per row, got {t1}"
+    quantized = k_scale is not None
+    assert (v_scale is not None) == quantized, \
+        "pass both k_scale and v_scale or neither"
     scale = 1.0 / math.sqrt(d)
 
     # per-head pool planes (H, NB*bs, D): physical block i occupies rows
     # [i*bs, (i+1)*bs) so the kernel's pl.ds(bid*bs, bs) lands on it
     def plane(x):
-        return x.transpose(2, 0, 1, 3).reshape(h, nb * bs, d)
+        return x.transpose(2, 0, 1, 3).reshape(h, nb * bs, x.shape[-1])
 
     kp, vp = plane(k_pool), plane(v_pool)
     qh = q.transpose(0, 2, 1, 3)                      # (B, H, 1, D)
     pos2 = jnp.asarray(pos, jnp.int32).reshape(b, 1)
     tables = jnp.asarray(tables, jnp.int32)
 
+    in_specs = [
+        pl.BlockSpec((None, 1), lambda i, j: (i, 0)),
+        pl.BlockSpec((None, mb), lambda i, j: (i, 0)),
+        pl.BlockSpec((None, None, 1, d), lambda i, j: (i, j, 0, 0)),
+        pl.BlockSpec((None, nb * bs, d), lambda i, j: (j, 0, 0)),
+        pl.BlockSpec((None, nb * bs, d), lambda i, j: (j, 0, 0)),
+    ]
+    args = [pos2, tables, qh, kp, vp]
+    if quantized:
+        # fp32 scale planes (H, NB*bs, 1) ride beside the int8 payload
+        in_specs += [
+            pl.BlockSpec((None, nb * bs, 1), lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((None, nb * bs, 1), lambda i, j: (j, 0, 0)),
+        ]
+        args += [plane(k_scale.astype(jnp.float32)),
+                 plane(v_scale.astype(jnp.float32))]
+
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, block_size=bs, scale=scale),
+        functools.partial(_paged_decode_kernel, block_size=bs, scale=scale,
+                          quantized=quantized),
         grid=(b, h),
-        in_specs=[
-            pl.BlockSpec((None, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((None, mb), lambda i, j: (i, 0)),
-            pl.BlockSpec((None, None, 1, d), lambda i, j: (i, j, 0, 0)),
-            pl.BlockSpec((None, nb * bs, d), lambda i, j: (j, 0, 0)),
-            pl.BlockSpec((None, nb * bs, d), lambda i, j: (j, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, None, 1, d),
                                lambda i, j: (i, j, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, d),
+                                       jnp.float32 if quantized else q.dtype),
         interpret=interpret,
-    )(pos2, tables, qh, kp, vp)
+    )(*args)
     return out.transpose(0, 2, 1, 3)

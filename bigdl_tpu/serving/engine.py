@@ -38,6 +38,7 @@ from concurrent.futures import Future, TimeoutError as FutureTimeoutError
 from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.dataset.minibatch import PaddingParam, Sample, \
@@ -318,6 +319,18 @@ class ServingEngine:
     AND at every refresh: a swap whose divergence exceeds the tolerance
     is rejected through the ``param_refresh`` rejected-with-reason path
     and the engine keeps serving its current weights.
+
+    ``kv_cache_dtype="int8"`` stores the paged generation pool as int8
+    payloads plus per-(position, head) fp32 scales (~3.6x less KV
+    memory at head_dim 32; the ledger's ``kv_cache`` split reports the
+    real narrow bytes).  ``speculative=k`` decodes with the int8 twin
+    drafting ``k`` tokens per tick and ONE fp32 forward verifying them
+    -- the output stream is bit-identical to fp32-only decoding
+    (greedy and seeded sampling both), it's just emitted 1..k+1 tokens
+    per verify step.  Both need ``kv_cache='paged'``; ``accuracy_gate``
+    composes with ``speculative`` to gate the drafter the same way it
+    gates an int8 serving twin (docs/performance.md, "Generation
+    serving").
     """
 
     def __init__(self, model, max_batch_size: int = 32,
@@ -335,7 +348,9 @@ class ServingEngine:
                  prompt_ladder: Optional[BucketLadder] = None,
                  kv_cache: str = "paged", kv_block_size: int = 16,
                  kv_blocks: Optional[int] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 kv_cache_dtype: str = "fp32",
+                 speculative: int = 0):
         if not model.is_built():
             raise ValueError("build the model (or train it) before serving")
         if max_batch_size < 1:
@@ -358,21 +373,31 @@ class ServingEngine:
         self._mstate_spec = _tree_spec(model.state())
         self._quantized = bool(quantize)
         self._qselect = quantize if callable(quantize) else None
-        if accuracy_gate is not None and not self._quantized:
+        if speculative < 0:
+            raise ValueError(
+                f"speculative must be >= 0 (draft tokens per verify "
+                f"step; 0 disables), got {speculative}")
+        self.speculative = int(speculative)
+        if accuracy_gate is not None and not self._quantized \
+                and not self.speculative:
             raise ValueError(
                 "accuracy_gate compares the fp32 model against its int8 "
-                "twin; it needs quantize=... to have a candidate to gate")
+                "twin; it needs quantize=... (int8 serving) or "
+                "speculative=k (int8 drafter) to have a candidate to "
+                "gate")
         self._gate = self._make_gate(accuracy_gate)
-        if self._quantized:
+        if self._quantized or self.speculative:
             from bigdl_tpu.nn.quantized import quantize_model
 
-            # the int8 serving twin: same module tree, quantized params,
-            # its own compiled-step cache; self.model stays fp32
+            # the int8 twin: same module tree, quantized params, its
+            # own compiled-step cache; self.model stays fp32.  On a
+            # quantized engine it SERVES; with speculative=k it DRAFTS
+            # (verification always runs the fp32 original, so the
+            # generated stream stays bit-identical to fp32 decoding)
             self._qmodel, _ = quantize_model(model, select=self._qselect)
-            serve_model = self._qmodel
         else:
             self._qmodel = None
-            serve_model = model
+        serve_model = self._qmodel if self._quantized else model
         if mesh is not None and int(mesh.shape[axis]) > 1:
             self._backend = _ShardedEval(serve_model, mesh, axis,
                                          compute_dtype)
@@ -466,6 +491,27 @@ class ServingEngine:
                 f"kv_cache must be 'paged' or 'contiguous', got "
                 f"{kv_cache!r}")
         self.kv_cache = kv_cache
+        if kv_cache_dtype not in ("fp32", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'fp32' or 'int8', got "
+                f"{kv_cache_dtype!r}")
+        if kv_cache_dtype != "fp32" and kv_cache != "paged":
+            raise ValueError(
+                "int8 KV blocks live in the paged pool (per-block "
+                "payload + scale leaves); kv_cache_dtype='int8' needs "
+                "kv_cache='paged'")
+        if self.speculative and kv_cache != "paged":
+            raise ValueError(
+                "speculative decoding rides the paged block table "
+                "(drafter pool shares the verifier's allocator); "
+                "speculative=k needs kv_cache='paged'")
+        if (kv_cache_dtype != "fp32" or self.speculative) \
+                and not hasattr(model, "init_paged_cache"):
+            raise TypeError(
+                f"{type(model).__name__} has no init_paged_cache(): "
+                f"int8 KV blocks and speculative decoding need the "
+                f"paged decode mode (TransformerLM has one)")
+        self.kv_cache_dtype = kv_cache_dtype
         self.kv_block_size = int(kv_block_size)
         self.kv_blocks = kv_blocks
         self.prefill_chunk = prefill_chunk
@@ -615,23 +661,36 @@ class ServingEngine:
                             "(decode_slots=0); construct with "
                             "decode_slots >= 1")
                     from bigdl_tpu.serving.generation import (
-                        GenerateScheduler, PagedGenerateScheduler)
+                        GenerateScheduler, PagedGenerateScheduler,
+                        SpeculativeScheduler)
 
                     serve_model = self._qmodel if self._quantized \
                         else self.model
-                    if self.kv_cache == "paged" \
+                    cache_dtype = {"fp32": jnp.float32,
+                                   "int8": jnp.int8}[self.kv_cache_dtype]
+                    paged_kw = dict(
+                        slots=self.decode_slots,
+                        max_len=self.decode_max_len,
+                        prompt_ladder=self._prompt_ladder,
+                        queue_capacity=self.queue_capacity,
+                        cache_dtype=cache_dtype,
+                        telemetry=self.telemetry,
+                        admission_check=self._gen_admission_check,
+                        exhausted_hook=self._on_pool_exhausted,
+                        block_size=self.kv_block_size,
+                        num_blocks=self.kv_blocks,
+                        prefill_chunk=self.prefill_chunk)
+                    if self.speculative:
+                        # verifier = the fp32 original (the stream must
+                        # stay bit-identical to fp32 decoding), drafter
+                        # = the gated int8 twin
+                        self._gen = SpeculativeScheduler(
+                            self.model, self._qmodel,
+                            spec_k=self.speculative, **paged_kw)
+                    elif self.kv_cache == "paged" \
                             and hasattr(serve_model, "init_paged_cache"):
                         self._gen = PagedGenerateScheduler(
-                            serve_model, slots=self.decode_slots,
-                            max_len=self.decode_max_len,
-                            prompt_ladder=self._prompt_ladder,
-                            queue_capacity=self.queue_capacity,
-                            telemetry=self.telemetry,
-                            admission_check=self._gen_admission_check,
-                            exhausted_hook=self._on_pool_exhausted,
-                            block_size=self.kv_block_size,
-                            num_blocks=self.kv_blocks,
-                            prefill_chunk=self.prefill_chunk)
+                            serve_model, **paged_kw)
                     else:
                         self._gen = GenerateScheduler(
                             serve_model, slots=self.decode_slots,
@@ -1066,12 +1125,20 @@ class ServingEngine:
         if alloc is not None:
             st = alloc.stats()
             total = st.get("blocks_total") or 0
-            per_block = rec["bytes"] / total if total else 0
+            # the allocator-reported bytes behind one addressable
+            # block: measured from the device pool it fronts (payload
+            # AND scale leaves at the pool's ACTUAL storage dtype), so
+            # an int8 pool's split reports real narrow bytes instead
+            # of compute-dtype hand-math overstating it ~4x
+            per_block = st.get("bytes_per_block")
+            if per_block is None:
+                per_block = rec["bytes"] / total if total else 0
             rec.update(
                 blocks_total=total,
                 blocks_active=st.get("blocks_used"),
                 blocks_cached=st.get("blocks_cached"),
                 blocks_free=st.get("blocks_free"),
+                kv_dtype=st.get("kv_dtype"),
                 active_bytes=int(st.get("blocks_used", 0) * per_block),
                 cached_bytes=int(st.get("blocks_cached", 0) * per_block),
                 free_bytes=int(st.get("blocks_free", 0) * per_block))
@@ -1160,9 +1227,15 @@ class ServingEngine:
         from bigdl_tpu.optim.validation import compiled_eval_step
 
         ref_step = compiled_eval_step(self.model, self._compute_dtype)
+        # the int8 side: the serving backend's step on a quantized
+        # engine; on a speculative-only engine (fp32 serving, int8
+        # drafter) the backend is fp32, so the gate evals the twin's
+        # own compiled step instead
+        q_step = self._backend.step if self._quantized \
+            else compiled_eval_step(self._qmodel, self._compute_dtype)
         ok, detail = self._gate.check(
             self._gate_eval(ref_step, fp_params, fp_mstate),
-            self._gate_eval(self._backend.step, qparams, fp_mstate))
+            self._gate_eval(q_step, qparams, fp_mstate))
         return ok, detail
 
     def _stamp_serving_info(self):
@@ -1186,6 +1259,9 @@ class ServingEngine:
             info["kv_cache"] = self.kv_cache
             if self.kv_cache == "paged":
                 info["kv_block_size"] = self.kv_block_size
+                info["kv_cache_dtype"] = self.kv_cache_dtype
+            if self.speculative:
+                info["speculative"] = self.speculative
         if self._version_info is not None:
             # WHICH checkpoint this replica serves: version id + the
             # snapshot's manifest digest (set_serving_version)

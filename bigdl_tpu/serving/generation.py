@@ -962,23 +962,41 @@ class PagedGenerateScheduler(GenerateScheduler):
                          exhausted_hook=exhausted_hook, name=name)
 
     def _setup_steps(self):
-        from bigdl_tpu.serving.paging import BlockAllocator
-
         self._chunk_fn, self._decode_fn, self._copy_fn = \
             paged_generate_steps(self.model, self._cache_dtype)
         self._cache = self.model.init_paged_cache(
             self.num_blocks, self.block_size, self._cache_dtype)
-        self._alloc = BlockAllocator(self.num_blocks, self.block_size)
+        self._alloc = self._make_alloc()
 
-    def _reset_pool(self):
+    def kv_dtype(self) -> str:
+        """Short storage-dtype name of the paged pool ("fp32"/"int8"),
+        the spelling BlockAllocator namespaces prefix hashes with."""
+        name = np.dtype(self._cache_dtype).name
+        return {"float32": "fp32", "bfloat16": "bf16",
+                "float16": "fp16"}.get(name, name)
+
+    def _make_alloc(self):
+        """Build the allocator for the pool JUST allocated: it learns
+        the pool's storage dtype (prefix hashes refuse to cross
+        storage formats) and the measured device bytes behind one
+        addressable block -- every leaf, scales included -- so
+        ``stats()`` reports real narrow bytes, not compute-dtype
+        hand-math (ROADMAP item 3's rule)."""
         from bigdl_tpu.serving.paging import BlockAllocator
 
+        pool_bytes = sum(leaf.size * leaf.dtype.itemsize
+                         for leaf in jax.tree.leaves(self._cache))
+        return BlockAllocator(
+            self.num_blocks, self.block_size, kv_dtype=self.kv_dtype(),
+            bytes_per_block=int(pool_bytes) // (self.num_blocks + 1))
+
+    def _reset_pool(self):
         # a failed donating tick killed the device pool, so every
         # cached prefix block's CONTENT is gone too: fresh allocator,
         # empty registry (the base already released live sequences)
         self._cache = self.model.init_paged_cache(
             self.num_blocks, self.block_size, self._cache_dtype)
-        self._alloc = BlockAllocator(self.num_blocks, self.block_size)
+        self._alloc = self._make_alloc()
 
     def flush_prefix_cache(self):
         """Invalidate cached prefix blocks (engine weight swaps call
@@ -1147,6 +1165,7 @@ class PagedGenerateScheduler(GenerateScheduler):
                     self._params(), self._cache, tokens, start, lens,
                     tables, *knobs)
                 first = np.asarray(first)            # host sync
+                self._mirror_chunk(tokens, start, lens, tables, knobs)
         except Exception as e:
             log.exception("chunk prefill tick failed (%d prompts)", n)
             self._tick_failed(e, [], [])
@@ -1212,6 +1231,12 @@ class PagedGenerateScheduler(GenerateScheduler):
                           riders=[s.fut for _i, s in active],
                           extra=self._kv_extra())
 
+    def _mirror_chunk(self, tokens, start, lens, tables, knobs):
+        """Hook for a twin cache that must see every prompt chunk:
+        no-op here; the speculative subclass replays the chunk through
+        its drafter pool so draft decoding starts from a prefilled
+        drafter context."""
+
     def _cow_guard(self, slot, first_pos, last_pos):
         """Copy-on-write check over the blocks a write will touch.  By
         construction writes only land in private blocks (prefix
@@ -1224,5 +1249,315 @@ class PagedGenerateScheduler(GenerateScheduler):
             cow = self._alloc.ensure_writable(slot.seq, b * bs)
             if cow is not None:
                 src, dst = cow
-                self._cache = self._copy_fn(self._cache, np.int32(src),
-                                            np.int32(dst))
+                self._copy_cow_block(src, dst)
+
+    def _copy_cow_block(self, src, dst):
+        """Duplicate physical block ``src`` into ``dst`` (the
+        speculative subclass also copies the drafter pool: the shared
+        allocator's table move covers BOTH pools, so both must carry
+        the content across)."""
+        self._cache = self._copy_fn(self._cache, np.int32(src),
+                                    np.int32(dst))
+
+
+def speculative_verify_step(model, cache_dtype, k: int):
+    """The jitted VERIFY step for speculative decoding, compiled once
+    per (model, cache dtype, k) and cached on the instance.
+
+    ``verify(params, pool, last (S,), drafts (k arrays of (S,)), pos
+    (S,), tables (S, MB), temperature, top_k, top_p, seed (each (S,)))
+    -> (sampled (S, k+1), new_pool)``: row ``i`` feeds ``[last,
+    d_1 .. d_k]`` -- the newest
+    committed token plus the drafter's k guesses -- at positions
+    ``pos .. pos+k`` through the chunk-prefill path (every position's
+    K/V scattered, every position's logits returned), then samples a
+    token at EVERY position ``pos+1 .. pos+k+1`` with the same
+    ``(seed, position)``-pure sampler plain decode uses.  Column ``j``
+    of the result is therefore EXACTLY the token one fp32 decode tick
+    would have drawn at position ``pos+j+1`` given the fed prefix --
+    the property that makes greedy (and seeded-sampling) speculative
+    output bit-identical to verifier-only decoding.  Donates the pool.
+    """
+    from bigdl_tpu.serving.sampling import sample_tokens
+
+    cache = model.__dict__.setdefault("_compiled_spec_steps", {})
+    key = (np.dtype(cache_dtype).name, int(k))
+    fn = cache.get(key)
+    if fn is not None:
+        return fn
+
+    def verify(params, pool, last, drafts, pos, tables, temperature,
+               top_k, top_p, seed):
+        # assemble [last, d_1 .. d_k] IN-JIT: the tick then issues no
+        # bare jnp glue ops, so the executable set after precompile()
+        # is exactly the warmed one (the zero-recompile contract)
+        tokens = jnp.concatenate(
+            [last[:, None]] + [d[:, None] for d in drafts], axis=1)
+        k1 = tokens.shape[1]
+        logits, new = model.apply_paged(
+            params, tokens, pool, tables, pos=pos,
+            lengths=jnp.full_like(pos, k1))
+        flat = logits.reshape((-1, logits.shape[-1]))
+        positions = (pos[:, None] + 1
+                     + jnp.arange(k1, dtype=jnp.int32)[None, :])
+
+        def rep(a):
+            return jnp.repeat(a, k1)
+
+        sampled = sample_tokens(flat, rep(temperature), rep(top_k),
+                                rep(top_p), rep(seed),
+                                positions.reshape(-1))
+        return sampled.reshape(tokens.shape), new
+
+    fn = jax.jit(verify, donate_argnums=(1,))
+    cache[key] = fn
+    return fn
+
+
+class SpeculativeScheduler(PagedGenerateScheduler):
+    """Draft/verify decoding over the paged pool: per round, the int8
+    TWIN (``quantize_model``'s structural copy, PR 10 -- gated into
+    serving by the same ``AccuracyDeltaGate`` evidence) drafts
+    ``spec_k`` tokens with cheap sequential decode steps, and the fp32
+    verifier scores ALL of them in ONE chunk-shaped forward.  The
+    longest prefix of drafts that matches what the verifier itself
+    would have sampled is accepted, plus the verifier's own next token
+    (the correction on a miss, the bonus on a clean sweep) -- so one
+    fp32 forward emits between 1 and ``spec_k + 1`` tokens, and the
+    stream is EXACTLY the verifier-only stream (greedy bit-identical;
+    seeded sampling replay-stable, because acceptance compares against
+    the ``(seed, position)``-pure draw the verifier would have made).
+
+    Cache story: the drafter runs against its OWN device pool, but the
+    two pools share ONE ``BlockAllocator`` -- same geometry, same
+    block tables, so prefix hits, COW detaches and LRU evictions stay
+    single-sourced (a COW copies the block in BOTH pools; every prompt
+    chunk is mirrored into the drafter pool via ``_mirror_chunk``).
+    Rejection needs no explicit rollback: a rejected draft's K/V sits
+    BEYOND the committed frontier, causally masked until the next
+    round's scatter overwrites it (writes precede reads in the
+    compiled steps), and ``_cow_guard`` runs over the whole
+    ``pos .. pos+k`` write span first so shared blocks detach before
+    any speculative write lands.  Block tables carry
+    ``ceil((spec_k+1)/block_size)`` extra trash-padded entries so a
+    round straddling a sequence's reserved range routes its overshoot
+    writes to the trash block instead of clamping into a live one.
+
+    The executable set stays closed: the drafter's decode + chunk
+    rungs + copy, the one ``spec_verify`` shape, and the inherited
+    verifier set -- zero steady-state recompiles (pinned in
+    tests/test_speculative.py).
+    """
+
+    def __init__(self, model, draft_model, spec_k: int = 4,
+                 draft_params_fn=None, **kw):
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if not hasattr(draft_model, "init_paged_cache"):
+            raise TypeError(
+                f"{type(draft_model).__name__} has no init_paged_cache():"
+                f" the drafter must run the same paged decode mode as "
+                f"the verifier")
+        self.spec_k = int(spec_k)
+        self.draft_model = draft_model
+        self._dparams = draft_params_fn or \
+            (lambda: draft_model.parameters()[0])
+        self._spec_rounds = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        super().__init__(model, **kw)
+        # widen every table row so verify's pos..pos+k write span can
+        # overshoot a finishing sequence's reserved blocks: the extra
+        # entries are trash-padded, turning overshoot into trash-block
+        # writes rather than an index clamp into a neighbour's block
+        self.max_blocks_per_seq += -(-(self.spec_k + 1) // self.block_size)
+
+    def _setup_steps(self):
+        super()._setup_steps()
+        self._dchunk_fn, self._ddecode_fn, self._dcopy_fn = \
+            paged_generate_steps(self.draft_model, self._cache_dtype)
+        self._verify_fn = speculative_verify_step(
+            self.model, self._cache_dtype, self.spec_k)
+        self._build_drafter_pool()
+
+    def _build_drafter_pool(self):
+        self._dcache = self.draft_model.init_paged_cache(
+            self.num_blocks, self.block_size, self._cache_dtype)
+        # one addressable block is backed by BOTH pools' leaves; the
+        # allocator's byte report must say so or the ledger understates
+        # the speculative price by half
+        dbytes = sum(leaf.size * leaf.dtype.itemsize
+                     for leaf in jax.tree.leaves(self._dcache))
+        self._alloc.bytes_per_block += int(dbytes) // (self.num_blocks + 1)
+
+    def _reset_pool(self):
+        super()._reset_pool()
+        self._build_drafter_pool()
+
+    def cache_bytes(self) -> int:
+        """Verifier pool + drafter pool -- the speculative price is
+        BOTH pools resident, and hiding the drafter's share would
+        falsify the bench's peak-bytes comparison."""
+        return super().cache_bytes() + int(sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(self._dcache)))
+
+    def stats(self):
+        st = super().stats()
+        drafted = self._spec_drafted
+        st["speculative"] = {
+            "k": self.spec_k, "rounds": self._spec_rounds,
+            "drafted": drafted, "accepted": self._spec_accepted,
+            "acceptance_rate": (self._spec_accepted / drafted)
+            if drafted else None}
+        return st
+
+    def _mirror_chunk(self, tokens, start, lens, tables, knobs):
+        """Replay the verifier's prompt chunk through the drafter pool
+        (same tables -- the allocator is shared), so by the time a
+        sequence flips to decoding, the drafter has its own K/V for
+        every prompt position.  Runs inside the chunk tick's try:
+        a drafter failure is a pool loss like any other donating-step
+        failure, and ``_reset_pool`` rebuilds both pools."""
+        first, self._dcache = self._dchunk_fn(
+            self._dparams(), self._dcache, tokens, start, lens, tables,
+            *knobs)
+        jax.block_until_ready(first)      # surface errors in-tick
+
+    def _copy_cow_block(self, src, dst):
+        super()._copy_cow_block(src, dst)
+        self._dcache = self._dcopy_fn(self._dcache, np.int32(src),
+                                      np.int32(dst))
+
+    # ----- warmup ----------------------------------------------------------- #
+    def precompile(self) -> int:
+        """Warm the inherited verifier set plus the speculative
+        additions: drafter decode/chunk-rungs/copy and the one verify
+        shape.  Dummy pools only, as in the base."""
+        from bigdl_tpu.observability.watchdogs import backend_compile_count
+
+        before = backend_compile_count()
+        super().precompile()
+        dparams = self._dparams()
+        s = self.slots
+        mb = self.max_blocks_per_seq
+        trash = np.int32(self._alloc.trash)
+        tabs = np.full((s, mb), trash, np.int32)
+        knobs = self._sampling_rows(s)
+        ddummy = jax.tree.map(jnp.zeros_like, self._dcache)
+        nxt, ddummy = self._ddecode_fn(
+            dparams, ddummy, np.zeros((s,), np.int32),
+            np.zeros((s,), np.int32), tabs, *knobs)
+        jax.block_until_ready(nxt)
+        tc = self.prefill_chunk
+        for b in self.batch_ladder:
+            b = int(b)
+            first, ddummy = self._dchunk_fn(
+                dparams, ddummy, np.zeros((b, tc), np.int32),
+                np.zeros((b,), np.int32), np.ones((b,), np.int32),
+                np.full((b, mb), trash, np.int32),
+                *self._sampling_rows(b))
+            jax.block_until_ready(first)
+        ddummy = self._dcopy_fn(ddummy, np.int32(0), np.int32(0))
+        jax.block_until_ready(jax.tree.leaves(ddummy)[0])
+        vdummy = jax.tree.map(jnp.zeros_like, self._cache)
+        vt, vdummy = self._verify_fn(
+            self._params(), vdummy, np.zeros((s,), np.int32),
+            tuple(np.zeros((s,), np.int32)
+                  for _ in range(self.spec_k)),
+            np.zeros((s,), np.int32), tabs, *knobs)
+        jax.block_until_ready(vt)
+        return backend_compile_count() - before
+
+    # ----- the speculative round --------------------------------------------- #
+    def _run_decode_tick(self, qdepth):
+        """One draft/verify round over every decoding slot:
+
+        1. ``spec_k + 1`` drafter decode steps -- the first ``spec_k``
+           produce the draft tokens ``d_1 .. d_k`` (each fed back in),
+           the final one only WRITES ``d_k``'s K/V so the drafter pool
+           covers the same ``pos .. pos+k`` span the verifier writes
+           (without it, a clean-sweep round would leave the last
+           accepted draft's position forever unwritten in the drafter
+           pool, and later drafter reads would attend to garbage).
+        2. One fp32 verify over ``[last, d_1 .. d_k]`` sampling every
+           position.
+        3. Accept the longest matching draft prefix + the verifier's
+           next token; stream them through the normal ``_deliver``
+           path (EOS / token budget truncate the run mid-emission).
+        """
+        t0 = time.perf_counter()
+        execs_before = self._compiles()
+        s_n = self.slots
+        k = self.spec_k
+        mb = self.max_blocks_per_seq
+        tokens = np.zeros((s_n,), np.int32)
+        pos = np.zeros((s_n,), np.int32)
+        tables = np.full((s_n, mb), self._alloc.trash, np.int32)
+        knobs = self._sampling_rows(s_n)
+        active = [(i, s) for i, s in self._active() if not s.prefilling]
+        for i, s in active:
+            # COW the WHOLE write span up front, clamped to the
+            # sequence's reserved range (overshoot writes go to trash
+            # via the widened table padding, no block to detach there)
+            hi = min(s.pos + k,
+                     int(s.prompt.size) + s.fut.max_new_tokens - 1)
+            self._cow_guard(s, s.pos, max(s.pos, hi))
+            tokens[i] = s.last
+            pos[i] = s.pos
+            tables[i] = self._alloc.table_row(s.seq, mb)
+            self._fill_sampling(knobs, i, s)
+        try:
+            with span("generate_decode", tick=self._tick,
+                      records=len(active)):
+                drafts = []
+                cur = tokens
+                for j in range(k + 1):
+                    cur, self._dcache = self._ddecode_fn(
+                        self._dparams(), self._dcache, cur, pos + j,
+                        tables, *knobs)
+                    if j < k:
+                        drafts.append(cur)
+                vtoks, self._cache = self._verify_fn(
+                    self._params(), self._cache, tokens, tuple(drafts),
+                    pos, tables, *knobs)
+                dtoks = np.stack([np.asarray(d) for d in drafts],
+                                 axis=1)                    # host sync
+                vtoks = np.asarray(vtoks)
+        except Exception as e:
+            log.exception("speculative tick failed (%d slots)",
+                          len(active))
+            self._tick_failed(e, [], [])
+            return
+        done_lat = []
+        emitted = 0
+        drafted = accepted = 0
+        for i, s in active:
+            drafted += k
+            a = 0
+            while a < k and int(dtoks[i, a]) == int(vtoks[i, a]):
+                a += 1
+            accepted += a
+            # vtoks[i, :a] == the accepted drafts; vtoks[i, a] is the
+            # verifier's own next token (correction or bonus)
+            for j in range(a + 1):
+                s.pos += 1
+                s.last = int(vtoks[i, j])
+                s.tokens.append(s.last)
+                emitted += 1
+                self._deliver(i, s, done_lat)
+                if s.fut.done():            # EOS / budget mid-run
+                    break
+        self._spec_rounds += 1
+        self._spec_drafted += drafted
+        self._spec_accepted += accepted
+        extra = self._kv_extra()
+        extra["spec_k"] = k
+        extra["spec_drafted"] = drafted
+        extra["spec_accepted"] = accepted
+        self._tick += 1
+        self._record_tick("decode", t0, records=0, tokens=emitted,
+                          qdepth=qdepth, execs_before=execs_before,
+                          latencies=done_lat, slots_before=len(active),
+                          riders=[s.fut for _i, s in active],
+                          extra=extra)

@@ -81,13 +81,27 @@ class BlockAllocator:
     weight swap (cached K/V computed under the OLD weights must not
     serve new prompts)."""
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 kv_dtype: str = "fp32", bytes_per_block=None):
         if num_blocks < 1 or block_size < 1:
             raise ValueError(
                 f"need num_blocks >= 1 and block_size >= 1, got "
                 f"{num_blocks}/{block_size}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        #: what the device pool actually stores per position -- "fp32"
+        #: or "int8" (int8 payload + fp32 scales).  Pure metadata here
+        #: (no jax in this module): it namespaces the prefix-cache
+        #: hashes so quantized and full-precision block contents can
+        #: NEVER satisfy each other's matches, and it travels through
+        #: stats() so observability cites the real storage format.
+        self.kv_dtype = str(kv_dtype)
+        #: device bytes behind ONE addressable block across every pool
+        #: leaf (int8 payloads AND their scale tensors), measured by the
+        #: scheduler from the pool it allocated -- this module has no
+        #: jax to measure it itself.  None until a pool owner sets it.
+        self.bytes_per_block = None if bytes_per_block is None \
+            else int(bytes_per_block)
         self.trash = self.num_blocks
         self._lock = threading.Lock()
         self._free = collections.deque(range(self.num_blocks))
@@ -109,11 +123,20 @@ class BlockAllocator:
         with self._lock:
             used = len(self._ref)
             cached = len(self._cached)
+            pb = self.bytes_per_block
             return {"blocks_total": self.num_blocks,
                     "blocks_used": used,
                     "blocks_cached": cached,
                     "blocks_free": self.num_blocks - used - cached,
                     "sequences": len(self._seqs),
+                    "kv_dtype": self.kv_dtype,
+                    # allocator-reported bytes (ROADMAP item 3's rule:
+                    # obs_report and the bench cite these, never
+                    # hand-computed dtype math); None until the pool
+                    # owner measured the device tree
+                    "bytes_per_block": pb,
+                    "pool_bytes": None if pb is None
+                    else pb * self.num_blocks,
                     "prefix_hits": self.prefix_hits,
                     "prefix_hit_tokens": self.prefix_hit_tokens,
                     "cow_copies": self.cow_copies,
@@ -136,8 +159,18 @@ class BlockAllocator:
         self._ref[b] = 1
         return b
 
+    @property
+    def _hash_root(self):
+        """Root parent for every sequence's hash chain.  fp32 pools
+        keep the original ``""`` root; any narrower storage namespaces
+        its chains, so an int8 pool's registered blocks can never
+        answer an fp32 pool's match even if registries were merged or
+        serialized across processes."""
+        return "" if self.kv_dtype == "fp32" else f"kv:{self.kv_dtype}"
+
     # ----- sequence lifecycle ------------------------------------------------ #
-    def begin_sequence(self, seq_id, prompt, max_positions: int) -> int:
+    def begin_sequence(self, seq_id, prompt, max_positions: int,
+                       kv_dtype=None) -> int:
         """Admit one sequence: match its prompt's full blocks against
         the prefix cache, then RESERVE enough fresh blocks to cover
         ``max_positions`` (prompt + the whole token budget) so decode
@@ -151,7 +184,20 @@ class BlockAllocator:
         target this sequence's private blocks.
 
         On ``BlockPoolExhausted`` nothing is retained (the typed shed
-        leaves every neighbour's table untouched)."""
+        leaves every neighbour's table untouched).
+
+        ``kv_dtype`` (optional) declares the storage format the caller
+        expects its prefix hits to hold; a mismatch with this pool's
+        format is refused legibly -- an fp32 request must never read
+        int8 blocks as if they were full-precision K/V (and vice
+        versa)."""
+        if kv_dtype is not None and str(kv_dtype) != self.kv_dtype:
+            raise ValueError(
+                f"KV-dtype mismatch: this block pool stores "
+                f"{self.kv_dtype} blocks but sequence {seq_id!r} "
+                f"expects {kv_dtype}; prefix-cache contents do not "
+                f"convert across storage formats -- serve the request "
+                f"from a pool built with kv_cache_dtype={kv_dtype!r}")
         bs = self.block_size
         prompt = [int(t) for t in prompt]
         matchable = max(0, (len(prompt) - 1) // bs)   # full blocks only,
@@ -160,7 +206,7 @@ class BlockAllocator:
             if seq_id in self._seqs:
                 raise ValueError(f"sequence {seq_id!r} already admitted")
             seq = _Seq()
-            parent, matched = "", 0
+            parent, matched = self._hash_root, 0
             try:
                 for i in range(matchable):
                     h = chain_hash(parent, prompt[i * bs:(i + 1) * bs])
@@ -186,7 +232,7 @@ class BlockAllocator:
                 # MATCHED parent so pending hashes stay a pure chain
                 seq.pending = {}
                 parent = self._hash_of.get(seq.table[-1], "") \
-                    if seq.table else ""
+                    if seq.table else self._hash_root
                 for i in range(matched, matchable):
                     h = chain_hash(parent, prompt[i * bs:(i + 1) * bs])
                     seq.pending[i] = h

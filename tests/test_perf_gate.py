@@ -554,6 +554,105 @@ class TestPagedRecords:
                           "--require-trusted"]) == 0
 
 
+def _spec_record(metric, value, unit="x", **extra):
+    """The BENCH_SPEC shapes (ISSUE 19): host-side byte counts and
+    tokens-per-verify -- no platform / per-step timing claim, so the
+    gate classes all three ``ratio``."""
+    return {"metric": metric, "value": value, "unit": unit,
+            "vs_baseline": 1.0,
+            "extra": {"block_size": 16, "spec_k": 4,
+                      "greedy_tokens_match": True, **extra}}
+
+
+class TestSpecRecords:
+    """ISSUE-19 satellite: the int8-KV byte records and the
+    speculative tokens-per-verify ratio ride the trajectory as
+    baseline-eligible ``ratio`` records; ``*_kv_peak_bytes`` gates
+    lower-is-better (pool growth trips rc 1 exactly like an MFU drop);
+    the REAL checked-in BENCH_r09.json clears the acceptance floors."""
+
+    def test_directions_and_trust_classing(self, gate):
+        assert gate.metric_direction(
+            "serving_int8_kv_peak_bytes") == "lower"
+        assert gate.metric_direction(
+            "serving_int8_kv_bytes_ratio") == "higher"
+        assert gate.metric_direction(
+            "serving_spec_tokens_ratio") == "higher"
+        for rec in (_spec_record("serving_int8_kv_bytes_ratio", 3.5),
+                    _spec_record("serving_int8_kv_peak_bytes", 672768,
+                                 unit="bytes"),
+                    _spec_record("serving_spec_tokens_ratio", 4.8)):
+            assert gate.classify_trust(rec) == "ratio"
+
+    def test_kv_peak_bytes_growth_trips_the_gate(self, gate, tmp_path,
+                                                 capsys):
+        rec = _spec_record("serving_int8_kv_peak_bytes", 672768,
+                           unit="bytes")
+        d = _bench_dir(tmp_path, {
+            "BENCH_r09.json": _wrapper([rec], n=9)})
+        cand = tmp_path / "BENCH_cand.json"
+        cand.write_text(json.dumps(dict(rec, value=2 * 672768)))
+        rc = gate.main(["--dir", d, "--check", str(cand)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "lower-is-better" in out and "REGRESSION" in out
+        # shrinking the pool is an improvement, not a regression
+        cand.write_text(json.dumps(dict(rec, value=672768 // 2)))
+        assert gate.main(["--dir", d, "--check", str(cand)]) == 0
+
+    def test_spec_tokens_regression_trips_the_gate(self, gate,
+                                                   tmp_path):
+        d = _bench_dir(tmp_path, {
+            "BENCH_r09.json": _wrapper(
+                [_spec_record("serving_spec_tokens_ratio", 4.8)], n=9)})
+        cand = tmp_path / "BENCH_cand.json"
+        cand.write_text(json.dumps(
+            _spec_record("serving_spec_tokens_ratio", 2.0)))
+        assert gate.main(["--dir", d, "--check", str(cand),
+                          "--require-trusted"]) == 1
+        cand.write_text(json.dumps(
+            _spec_record("serving_spec_tokens_ratio", 4.7)))
+        assert gate.main(["--dir", d, "--check", str(cand),
+                          "--require-trusted"]) == 0
+
+    def test_checked_in_r09_clears_the_acceptance_floors(self, gate):
+        """The REAL BENCH_r09.json: >= 3x KV byte reduction at head_dim
+        32, the peak-bytes record citing the ledger's narrow count,
+        >= 1.5 tokens per verify with a bit-identical greedy stream,
+        and 0 recompiles on every leg (sampled stretch included)."""
+        path = os.path.join(REPO, "BENCH_r09.json")
+        assert os.path.exists(path), "BENCH_r09.json must be checked in"
+        records, note = gate.load_bench_file(path)
+        assert note is None
+        by_metric = {r["metric"]: r for r in records}
+        ratio = by_metric["serving_int8_kv_bytes_ratio"]
+        assert gate.classify_trust(ratio) == "ratio"
+        assert ratio["value"] >= 3.0          # the ISSUE-19 floor
+        e = ratio["extra"]
+        assert e["int8"]["kv_dtype"] == "int8"
+        assert e["fp32"]["recompiles_after_precompile"] == 0
+        assert e["int8"]["recompiles_after_precompile"] == 0
+        peak = by_metric["serving_int8_kv_peak_bytes"]
+        assert gate.metric_direction(peak["metric"], peak) == "lower"
+        assert peak["value"] == e["int8"]["kv_bytes"]
+        assert peak["value"] * 3 <= e["fp32"]["kv_bytes"]
+        spec = by_metric["serving_spec_tokens_ratio"]
+        assert gate.classify_trust(spec) == "ratio"
+        assert spec["value"] >= 1.5
+        assert spec["extra"]["greedy_tokens_match"] is True
+        assert spec["extra"]["spec"]["recompiles_after_sampled"] == 0
+        assert 0.0 <= spec["extra"]["speculative"][
+            "acceptance_rate"] <= 1.0
+        traj = gate.build_trajectory(REPO)
+        for m in ("serving_int8_kv_bytes_ratio",
+                  "serving_int8_kv_peak_bytes",
+                  "serving_spec_tokens_ratio"):
+            assert any(en["baseline_eligible"]
+                       for en in traj["metrics"][m]), m
+        assert gate.main(["--dir", REPO, "--check", path,
+                          "--require-trusted"]) == 0
+
+
 class TestTracedRecords:
     """ISSUE-16 satellite: a bench record measured with always-sample
     tracing enabled (BIGDL_TRACE_SAMPLE=1) carries the overhead of a

@@ -397,6 +397,27 @@ def _serving_section(other, header=None):
                     int(e.get("prompt_tokens", 0) or 0) for e in gen)
                 if prompt_tokens > 0:
                     block["prefix_hit_rate"] = hit_tokens / prompt_tokens
+        if info and info.get("kv_cache_dtype"):
+            block["kv_dtype"] = info["kv_cache_dtype"]
+        # speculative ticks (SpeculativeScheduler): acceptance rate =
+        # accepted/drafted, and tokens-per-verify = emitted tokens over
+        # verify rounds -- the two figures the speedup claim rests on
+        spec = [e for e in gen if e.get("spec_drafted") is not None]
+        if spec:
+            drafted = sum(int(e.get("spec_drafted", 0) or 0)
+                          for e in spec)
+            accepted = sum(int(e.get("spec_accepted", 0) or 0)
+                           for e in spec)
+            stoks = sum(int(e.get("tokens", 0) or 0) for e in spec)
+            sblock = {"k": max(int(e.get("spec_k", 0) or 0)
+                               for e in spec),
+                      "rounds": len(spec), "drafted": drafted,
+                      "accepted": accepted}
+            if drafted:
+                sblock["acceptance_rate"] = accepted / drafted
+            if spec:
+                sblock["tokens_per_verify"] = stoks / len(spec)
+            block["speculative"] = sblock
         sec["generate"] = block
     if info:
         for k in ("quantized", "weight_dtype", "model_bytes",
@@ -1129,7 +1150,20 @@ def format_report(rep):
                 out.append(
                     f"  kv blocks: {kvb['used']} used / "
                     f"{kvb['cached']} cached / {kvb['free']} free "
-                    f"of {kvb['total']}")
+                    f"of {kvb['total']}"
+                    + (f"   ({gen['kv_dtype']} blocks)"
+                       if gen.get("kv_dtype") else ""))
+            spec = gen.get("speculative")
+            if spec:
+                line = (f"  speculative: draft k={spec['k']}, "
+                        f"{spec['accepted']}/{spec['drafted']} drafts "
+                        f"accepted")
+                if spec.get("acceptance_rate") is not None:
+                    line += f" ({spec['acceptance_rate']:.0%})"
+                if spec.get("tokens_per_verify") is not None:
+                    line += (f", {spec['tokens_per_verify']:.2f} "
+                             f"tokens/verify step")
+                out.append(line)
             if gen.get("prefix_hit_tokens"):
                 line = (f"  prefix cache: {gen['prefix_hit_tokens']} "
                         f"prompt tokens served from cache "

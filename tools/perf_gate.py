@@ -168,7 +168,10 @@ def metric_direction(metric, record=None):
     name decides: ``*_ratio`` / ``*_saved`` are improvement factors
     (higher), and ``*_bytes`` / ``*_peak`` are memory footprints
     (lower) -- a KV-cache or activation-memory record regresses by
-    GROWING, unlike every throughput metric."""
+    GROWING, unlike every throughput metric.  BENCH_r09's families pin
+    both arms: ``*_kv_peak_bytes`` (int8 pool footprint, lower) and
+    ``*_spec_tokens_ratio`` (speculative tokens/s factor, higher),
+    with pins in tests/test_perf_gate.py."""
     rec_dir = (record or {}).get("direction")
     if rec_dir in ("lower", "higher"):
         return rec_dir

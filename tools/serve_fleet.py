@@ -70,6 +70,12 @@ def build_args(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--maxBatch", type=int, default=8)
     ap.add_argument("--maxWaitMs", type=float, default=1.0)
+    ap.add_argument("--kvCacheDtype", choices=("fp32", "int8"),
+                    default="fp32",
+                    help="paged KV block storage dtype on every replica")
+    ap.add_argument("--speculative", type=int, default=0,
+                    help="draft tokens per verify step (0 disables; "
+                    "the int8 twin drafts, the fp32 model verifies)")
     ap.add_argument("--clients", type=int, default=3,
                     help="closed-loop client threads")
     ap.add_argument("--hedge", action="store_true",
@@ -143,7 +149,9 @@ def run_worker(args):
         tel = StepTelemetry(wdir, run_name=f"worker_{args.replicaId}",
                             trace=False)
     eng = ServingEngine(model, max_batch_size=args.maxBatch,
-                        max_wait_ms=args.maxWaitMs, telemetry=tel)
+                        max_wait_ms=args.maxWaitMs, telemetry=tel,
+                        kv_cache_dtype=args.kvCacheDtype,
+                        speculative=args.speculative)
     eng.precompile(example_feature=x[0])
     booted = boot_from_registry(eng, args.registry)
     probe_bucket = min(4, args.maxBatch)
@@ -184,6 +192,8 @@ def make_spawn(args, rid):
                "--maxBatch", str(args.maxBatch),
                "--maxWaitMs", str(args.maxWaitMs),
                "--replicaId", str(rid), "--portFile", port_file,
+               "--kvCacheDtype", args.kvCacheDtype,
+               "--speculative", str(args.speculative),
                "--registry", os.path.join(args.out, "registry.json")]
         if args.traceSample is not None:
             cmd += ["--traceSample", str(args.traceSample)]
@@ -254,7 +264,9 @@ def run_driver(args):
               file=sys.stderr)
 
     eng0 = ServingEngine(model, max_batch_size=args.maxBatch,
-                         max_wait_ms=args.maxWaitMs, telemetry=tel)
+                         max_wait_ms=args.maxWaitMs, telemetry=tel,
+                         kv_cache_dtype=args.kvCacheDtype,
+                         speculative=args.speculative)
     eng0.precompile(example_feature=x[0])
     execs0 = eng0._executables()
     probe_rows = x[:4]
