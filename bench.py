@@ -756,6 +756,206 @@ def run_serve_quant_bench(concurrency=None, per_client=None, hidden=None,
 
 
 # --------------------------------------------------------------------------- #
+# Fleet-wire A/B (ISSUE 20): pickle connection-per-request vs the binary
+# frame protocol with persistent pooled connections, plus fp32-vs-int8
+# weight-distribution bytes through the real stage_tree wire.
+# --------------------------------------------------------------------------- #
+
+def run_wire_bench(concurrency=None, per_client=None, hidden=None,
+                   max_batch=None, max_wait_ms=None, pool_size=None):
+    """A/B the fleet transport: legacy pickle wire (connection per
+    request) vs the binary frame protocol (persistent ``WirePool``,
+    request-id multiplexing, zero-copy tensor frames) against the SAME
+    ``ServingEngine`` on loopback (ISSUE 20; docs/performance.md,
+    "Fleet transport").
+
+    Knobs (env tier): BENCH_WIRE_CONC (default 10 closed-loop clients),
+    BENCH_WIRE_REQS (default 40 requests per client), BENCH_WIRE_HIDDEN
+    (default 256), BENCH_WIRE_BATCH (default = conc), BENCH_WIRE_WAIT_MS
+    (default 1), BENCH_WIRE_POOL (default 2 pooled connections).
+
+    The default load (10 clients) is deliberately past the pickle
+    transport's knee: dialling per request against the legacy server's
+    default listen backlog (socketserver's 5) overflows the accept
+    queue, and dropped SYNs stall clients on kernel retransmit timers.
+    The pooled binary leg holds its connections open, so the same load
+    never touches the backlog -- that collapse, not codec speed, is
+    the production failure mode this transport removes (at <= 6
+    clients, where pickle's backlog survives, the two wires are within
+    noise of each other and the ratio is ~1x).
+
+    Prints TWO JSON records:
+
+    - ``fleet_wire_rps_ratio`` -- binary-over-pickle requests/sec at
+      the same offered load; ``vs_baseline`` is over the 1.3x loopback
+      acceptance floor.  Valid only when ``recompiles_after_precompile
+      == 0`` (both legs hit the same warmed executables),
+      ``pickle_fallbacks == 0`` (no array transited pickle on the
+      binary leg) and ``outputs_bit_identical`` is true (the transport
+      is a re-encoding, not an approximation) -- the tier-1 smoke pins
+      all three.
+    - ``fleet_wire_bytes_ratio`` -- fp32-over-int8 staged-weight bytes
+      MEASURED on the wire (two real ``stage_tree`` round trips of the
+      serving tree, one raw fp32, one through
+      ``transport.quantize_tree_for_wire``); ``vs_baseline`` is over
+      the 1/0.35 floor (int8 staging must cost <= 0.35x the fp32
+      bytes).  ``extra.int8_max_abs_err`` witnesses the dequantized
+      tree tracks fp32 within blockwise-int8 error.
+    """
+    cache_status = _honor_env_platforms()
+    import tempfile
+
+    import numpy as np
+
+    from bigdl_tpu.observability import StepTelemetry
+    from bigdl_tpu.observability.watchdogs import backend_compile_count
+    from bigdl_tpu.serving import ServingEngine, WireClient, WirePool
+    from bigdl_tpu.serving import worker as worker_mod
+    from bigdl_tpu.serving.transport import quantize_tree_for_wire
+    from bigdl_tpu.serving.worker import ReplicaServer
+
+    env = os.environ
+    concurrency = (int(env.get("BENCH_WIRE_CONC", "10"))
+                   if concurrency is None else concurrency)
+    per_client = (int(env.get("BENCH_WIRE_REQS", "40"))
+                  if per_client is None else per_client)
+    hidden = (int(env.get("BENCH_WIRE_HIDDEN", "256"))
+              if hidden is None else hidden)
+    max_batch = (int(env.get("BENCH_WIRE_BATCH", str(concurrency)))
+                 if max_batch is None else max_batch)
+    max_wait_ms = (float(env.get("BENCH_WIRE_WAIT_MS", "1"))
+                   if max_wait_ms is None else max_wait_ms)
+    pool_size = (int(env.get("BENCH_WIRE_POOL", "2"))
+                 if pool_size is None else pool_size)
+
+    model = _serve_model(hidden)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((256, 16)).astype("float32")
+    total = concurrency * per_client
+    _p = _obs_report_module().percentile
+
+    with tempfile.TemporaryDirectory() as d:
+        tel = StepTelemetry(d, run_name="wire", trace=False)
+        eng = ServingEngine(model, max_batch_size=max_batch,
+                            max_wait_ms=max_wait_ms, telemetry=tel)
+        try:
+            eng.precompile()
+            before = backend_compile_count()
+
+            # ---- leg A: the PR 14 pickle wire, connection per request
+            srv_p = ReplicaServer(eng, port=0, transport="pickle").start()
+            try:
+                def call_pickle(feature):
+                    return worker_mod.call("127.0.0.1", srv_p.port,
+                                           "predict", transport="pickle",
+                                           feature=feature)
+                outs_p, lats_p, wall_p = _closed_loop(
+                    call_pickle, xs, concurrency, per_client)
+            finally:
+                srv_p.close()
+
+            # ---- leg B: binary frames over a shared persistent pool
+            srv_b = ReplicaServer(eng, port=0, transport="binary").start()
+            pool = WirePool("127.0.0.1", srv_b.port, size=pool_size)
+            try:
+                def call_binary(feature):
+                    return pool.request("predict", feature=feature)
+                outs_b, lats_b, wall_b = _closed_loop(
+                    call_binary, xs, concurrency, per_client)
+                pstats = pool.stats()
+                bin_sent = pstats["bytes_sent"]
+                bin_recv = pstats["bytes_recv"]
+                fallbacks = pstats["pickle_fallbacks"]
+            finally:
+                pool.close()
+                srv_b.close()
+            recompiles = backend_compile_count() - before
+
+            # ---- weight-distribution leg: fp32 vs blockwise-int8
+            # stage_tree bytes, measured on the real wire
+            params = eng.model.parameters()[0]
+            srv_w = ReplicaServer(eng, port=0, transport="binary").start()
+            cli = WireClient("127.0.0.1", srv_w.port)
+            try:
+                tok_fp, fp32_out, _ = cli.request_ex(
+                    "stage_tree", rpc_timeout=120.0, params=params,
+                    weight_wire="fp32")
+                cli.request_ex("release", token=tok_fp)
+                qtree = quantize_tree_for_wire(params)
+                tok_q, int8_out, _ = cli.request_ex(
+                    "stage_tree", rpc_timeout=120.0, params=qtree,
+                    weight_wire="int8")
+                cli.request_ex("release", token=tok_q)
+            finally:
+                cli.close()
+                srv_w.close()
+        finally:
+            eng.close()
+            tel.close()
+
+    from bigdl_tpu.serving.transport import dequantize_wire_tree
+    import jax
+
+    deq = dequantize_wire_tree(qtree)
+    int8_err = max(float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                   for a, b in zip(jax.tree_util.tree_leaves(params),
+                                   jax.tree_util.tree_leaves(deq)))
+    bit_identical = (set(outs_p) == set(outs_b)) and all(
+        all(np.array_equal(np.asarray(pa), np.asarray(pb)) for pa, pb in
+            zip(jax.tree_util.tree_leaves(outs_p[k][1]),
+                jax.tree_util.tree_leaves(outs_b[k][1])))
+        for k in outs_p)
+
+    rps_p = total / wall_p
+    rps_b = total / wall_b
+    ratio = rps_b / max(rps_p, 1e-9)
+    shared_extra = {
+        "compilation_cache": cache_status,
+        "concurrency": concurrency, "requests": total, "hidden": hidden,
+        "max_batch_size": max_batch, "max_wait_ms": max_wait_ms,
+        "pool_size": pool_size,
+        "recompiles_after_precompile": recompiles,
+    }
+    rec_rps = {
+        "metric": "fleet_wire_rps_ratio",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(ratio / 1.3, 4),   # >= 1.3x loopback floor
+        "extra": {
+            **shared_extra,
+            "pickle": {"requests_per_s": round(rps_p, 1),
+                       "p50_ms": round(_p(lats_p, 50) * 1e3, 3),
+                       "p99_ms": round(_p(lats_p, 99) * 1e3, 3)},
+            "binary": {"requests_per_s": round(rps_b, 1),
+                       "p50_ms": round(_p(lats_b, 50) * 1e3, 3),
+                       "p99_ms": round(_p(lats_b, 99) * 1e3, 3),
+                       "bytes_sent": bin_sent, "bytes_recv": bin_recv},
+            "pickle_fallbacks": fallbacks,
+            "outputs_bit_identical": bool(bit_identical),
+            "pickle_bound_by": ("listen-backlog SYN retransmit under "
+                                "connect-per-request churn"
+                                if concurrency >= 8 else "codec + rtt"),
+        },
+    }
+    emit_record(rec_rps)
+    bytes_ratio = fp32_out / max(int8_out, 1)
+    rec_bytes = {
+        "metric": "fleet_wire_bytes_ratio",
+        "value": round(bytes_ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(bytes_ratio * 0.35, 4),   # <= 0.35x floor
+        "extra": {
+            **shared_extra,
+            "stage_bytes_fp32": fp32_out,
+            "stage_bytes_int8": int8_out,
+            "int8_max_abs_err": round(int8_err, 6),
+        },
+    }
+    emit_record(rec_bytes)
+    return rec_rps, rec_bytes
+
+
+# --------------------------------------------------------------------------- #
 # Autoregressive-decode micro-benchmark (ISSUE 15): KV-cache decode vs
 # full-recompute generation on one transformer, host-side blocked
 # timing, plus a continuous-batching leg through ServingEngine.generate.
@@ -2071,6 +2271,13 @@ def main():
         # anywhere, tokens-per-verify is the platform-independent
         # bound on the speculative speedup
         run_spec_bench()
+        return
+    if os.environ.get("BENCH_WIRE") or "wire" in sys.argv[1:]:
+        # fleet-transport A/B (pickle wire vs binary frames + pooled
+        # connections) + fp32-vs-int8 weight-distribution bytes:
+        # in-process loopback, CPU-runnable; the bytes ratio is exact
+        # anywhere, the rps ratio is the gateable trajectory metric
+        run_wire_bench()
         return
     if os.environ.get("BENCH_SERVE_INT8") or "serve-int8" in sys.argv[1:]:
         # serving-precision A/B (fp32 vs int8 engine): in-process and

@@ -694,6 +694,26 @@ class MetricsRegistry:
                          "supervisor restarts of dead replicas, by "
                          "replica", labelnames=("replica",)) \
                 .inc(replica=rid)
+        elif what == "wire":
+            # the fleet flushes per-verb wire deltas as durable events
+            # (serving/fleet.py _note_wire); counters and the RTT
+            # histogram are event-backed ONLY, so replaying a
+            # telemetry file into a fresh registry reproduces them
+            verb = str(event.get("verb", "?"))
+            c = self.counter(f"{p}_fleet_wire_bytes_total",
+                             "bytes over the fleet worker wire, by "
+                             "verb and direction",
+                             labelnames=("verb", "direction"))
+            c.inc(float(event.get("bytes_sent") or 0),
+                  verb=verb, direction="sent")
+            c.inc(float(event.get("bytes_recv") or 0),
+                  verb=verb, direction="recv")
+            h = self.histogram(f"{p}_fleet_wire_rtt_seconds",
+                               "worker RPC round-trip latency, by "
+                               "verb", labelnames=("verb",))
+            for rtt in (event.get("rtt_s") or ())[:4096]:
+                if isinstance(rtt, (int, float)):
+                    h.observe(float(rtt), verb=verb)
 
     # -- health / anomalies --------------------------------------------------- #
     def _observe_health(self, event):

@@ -43,8 +43,17 @@ reference's ``optim/PredictionService.scala`` instance pool).
   committed version, and the ``RolloutController`` performs ROLLING
   deploys across a fleet (drain -> gate -> commit -> undrain, one
   replica at a time).
+- ``serving/transport.py`` -- the fleet's binary wire: versioned
+  magic+type+length frames with typed refusals, zero-copy tensor
+  frames (``np.frombuffer`` on receive, no array transits pickle),
+  persistent ``WirePool`` connections with request-id multiplexing, a
+  digest-authed handshake (``BIGDL_RUN_TOKEN``), and blockwise-int8
+  weight distribution (``quantize_tree_for_wire``) for staging
+  traffic.  The PR 14 pickle wire survives behind
+  ``transport="pickle"``.
 
-See docs/performance.md ("Inference serving", "Int8 inference"),
+See docs/performance.md ("Inference serving", "Int8 inference",
+"Fleet transport"),
 docs/robustness.md ("Continuous deployment", "Serving fleets") and
 docs/observability.md (extended ``kind: "inference"`` event schema,
 serving-precision + version header stamp, the ``deploy``/``fleet``
@@ -66,11 +75,19 @@ from bigdl_tpu.serving.generation import (GenerateFuture,
                                           PagedGenerateScheduler)
 from bigdl_tpu.serving.paging import BlockAllocator, BlockPoolExhausted
 from bigdl_tpu.serving.sampling import SamplingParams
+from bigdl_tpu.serving.transport import (ReplicaCallError, WireAuthError,
+                                         WireClient, WireError,
+                                         WireFrameError, WirePool,
+                                         WireProtocolError,
+                                         WireVersionError)
 
 __all__ = ["BlockAllocator", "BlockPoolExhausted", "BucketLadder",
            "CircuitBreaker", "EngineDraining", "FleetOverloadedError",
            "FleetSupervisor", "FleetUnavailableError", "GenerateFuture",
            "GenerateScheduler", "InProcessReplica", "ModelRegistry",
-           "ModelVersion", "PagedGenerateScheduler", "RolloutController",
-           "SamplingParams", "ServeFuture", "ServingEngine",
-           "ServingFleet", "SubprocessReplica", "snapshot_digest"]
+           "ModelVersion", "PagedGenerateScheduler", "ReplicaCallError",
+           "RolloutController", "SamplingParams", "ServeFuture",
+           "ServingEngine", "ServingFleet", "SubprocessReplica",
+           "WireAuthError", "WireClient", "WireError", "WireFrameError",
+           "WirePool", "WireProtocolError", "WireVersionError",
+           "snapshot_digest"]

@@ -1390,6 +1390,13 @@ class ServingEngine:
         audit = {"model_bytes": handle.get("model_bytes"), "staged": True}
         if self._quantized:
             audit["quantized"] = True
+        if handle.get("wire_bytes") is not None:
+            # weights that crossed the fleet wire record what the
+            # TRANSPORT measured (int8 distribution ships ~4x fewer
+            # bytes than model_bytes claims) -- the honest number for
+            # the param_refresh trail
+            audit["wire_bytes"] = int(handle["wire_bytes"])
+            audit["weight_wire"] = handle.get("weight_wire")
         self._record_refresh("ok", **audit)
         self._flush_prefix_cache()
         self._stamp_serving_info()

@@ -333,46 +333,100 @@ class InProcessReplica(Replica):
 
 
 class SubprocessReplica(Replica):
-    """A ``serving/worker.py`` process: requests travel the
-    length-prefixed socket protocol, so this replica's crash is a
-    PROCESS death the ``FleetSupervisor`` observes and repairs.
+    """A ``serving/worker.py`` process: requests travel the worker
+    wire, so this replica's crash is a PROCESS death the
+    ``FleetSupervisor`` observes and repairs.
 
     ``spawn(attempt) -> (Popen, port)`` must return a STARTED worker
     that is ready to serve (the CLI blocks on the worker's port file);
     it is called again -- with the attempt number -- on every
-    supervisor restart."""
+    supervisor restart.
+
+    ``transport="binary"`` (default) keeps a capped
+    ``transport.WirePool`` of persistent multiplexed connections to
+    the worker (digest-auth handshake against ``token`` /
+    ``BIGDL_RUN_TOKEN``; broken connections evicted and re-dialed
+    under ``capped_backoff``); a respawned worker gets a fresh pool on
+    its new port.  ``transport="pickle"`` is the PR 14
+    connection-per-request escape hatch."""
 
     kind = "subprocess"
 
     def __init__(self, spawn, rid=None, host="127.0.0.1",
-                 request_timeout_s=30.0, executor=None):
+                 request_timeout_s=30.0, executor=None,
+                 transport="binary", token=None, pool_size=2,
+                 weight_wire="fp32"):
         super().__init__(rid)
+        if transport not in ("binary", "pickle"):
+            raise ValueError(f"unknown transport {transport!r}; "
+                             f"expected 'binary' or 'pickle'")
         self._spawn = spawn
         self.host = host
         self.request_timeout_s = float(request_timeout_s)
         self._executor = executor              # attached by the fleet
+        self.transport = transport
+        self.token = token
+        self.pool_size = int(pool_size)
+        self.weight_wire = weight_wire
+        self._wire_sink = None                 # attached by the fleet
+        self._stage_wire = {}                  # token -> (bytes, wire)
+        self._pool = None
         self.proc = None
         self.port = None
 
     def start(self, attempt=0):
         self.proc, self.port = self._spawn(attempt)
+        self._reset_pool()
         return self
 
     def respawn(self, attempt):
         if self.proc is not None and self.proc.poll() is None:
             self.proc.kill()
         self.proc, self.port = self._spawn(attempt)
+        self._reset_pool()                     # new port, new pool
         return self
 
     def alive(self):
         return self.proc is not None and self.proc.poll() is None
 
+    def _reset_pool(self):
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.close()
+
+    def _ensure_pool(self):
+        from bigdl_tpu.serving.transport import WirePool
+
+        pool = self._pool
+        if pool is None or pool.port != int(self.port):
+            self._reset_pool()
+            pool = self._pool = WirePool(self.host, self.port,
+                                         token=self.token,
+                                         size=self.pool_size,
+                                         on_wire=self._note_wire)
+        return pool
+
+    def _note_wire(self, op, rtt_s, bytes_out, bytes_in):
+        sink = self._wire_sink
+        if sink is not None:
+            try:
+                sink(self.rid, op, rtt_s, bytes_out, bytes_in)
+            except Exception:
+                log.exception("wire stats sink failed")
+
     def _call(self, op, rpc_timeout=None, **kw):
+        rpc = rpc_timeout or self.request_timeout_s
+        if self.transport == "binary":
+            result, out, inn = self._ensure_pool().request_ex(
+                op, rpc_timeout=rpc, **kw)
+            return result
         from bigdl_tpu.serving import worker
 
-        return worker.call(self.host, self.port, op,
-                           rpc_timeout=rpc_timeout
-                           or self.request_timeout_s, **kw)
+        t0 = time.perf_counter()
+        result = worker.call(self.host, self.port, op, rpc_timeout=rpc,
+                             transport="pickle", **kw)
+        self._note_wire(op, time.perf_counter() - t0, 0, 0)
+        return result
 
     # -- routing -- #
     def submit(self, feature, timeout=None, admit_timeout=None,
@@ -439,20 +493,50 @@ class SubprocessReplica(Replica):
     def capture(self):
         return self._call("capture")
 
-    def stage(self, params=None, mstate=None, src_layout=None, path=None):
-        if path is None:
+    def stage(self, params=None, mstate=None, src_layout=None, path=None,
+              weight_wire=None):
+        if path is not None:
+            return self._call("stage", path=str(path), rpc_timeout=120.0)
+        if params is None:
+            raise ValueError("stage needs a snapshot path or an "
+                             "in-memory params tree")
+        if self.transport != "binary":
             raise ValueError(
-                "a subprocess replica stages from a snapshot PATH (the "
-                "worker loads it in its own process); in-memory params "
-                "do not cross the socket")
-        return self._call("stage", path=str(path), rpc_timeout=120.0)
+                "in-memory params cross the socket only on the binary "
+                "transport (transport.quantize_tree_for_wire + raw "
+                "tensor frames); the pickle escape hatch stages from "
+                "a snapshot PATH")
+        if src_layout is not None:
+            raise ValueError(
+                "stage(params=...) ships weights already in the "
+                "serving layout; resharding snapshots cross as a PATH")
+        from bigdl_tpu.serving.transport import quantize_tree_for_wire
+
+        ww = weight_wire or self.weight_wire or "fp32"
+        tree = quantize_tree_for_wire(params) if ww == "int8" else params
+        ms = quantize_tree_for_wire(mstate) \
+            if (ww == "int8" and mstate is not None) else mstate
+        result, out, _ = self._ensure_pool().request_ex(
+            "stage_tree", rpc_timeout=120.0, params=tree, mstate=ms,
+            weight_wire=ww)
+        # the commit will stamp what ACTUALLY crossed the wire onto
+        # the worker's param_refresh audit event
+        self._stage_wire[result] = (int(out), ww)
+        if len(self._stage_wire) > 16:
+            self._stage_wire.pop(next(iter(self._stage_wire)))
+        return result
 
     def gate(self, handle, probe_features=None, probe_bucket=None):
         ok, reason = self._call("gate", token=handle)
         return bool(ok), reason
 
     def commit(self, handle, version=None, digest=None):
-        self._call("commit", token=handle, version=version, digest=digest)
+        kw = {}
+        staged = self._stage_wire.pop(handle, None)
+        if staged is not None:
+            kw["wire_bytes"], kw["weight_wire"] = staged
+        self._call("commit", token=handle, version=version,
+                   digest=digest, **kw)
 
     def release(self, handle):
         try:
@@ -475,6 +559,7 @@ class SubprocessReplica(Replica):
                 self._call("stop", rpc_timeout=2.0)
         except Exception:
             pass
+        self._reset_pool()
         if self.proc is not None and self.proc.poll() is None:
             self.proc.terminate()
             try:
@@ -535,7 +620,7 @@ class ServingFleet:
                  breaker_reset_s=2.0, probe_features=None,
                  probe_bucket=None, rng=None, clock=time.monotonic,
                  sleep=time.sleep, executor_workers=None,
-                 trace_sample=None):
+                 trace_sample=None, wire_flush_every=200):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         if int(admission_limit) < 1:
@@ -574,6 +659,15 @@ class ServingFleet:
         self._latencies = deque(maxlen=512)
         self._counters = {"ok": 0, "failed": 0, "shed": 0, "retries": 0,
                           "hedges": 0, "hedge_wins": 0}
+        # wire-traffic accounting (binary transport): per-verb deltas
+        # accumulate here and flush as durable ``wire`` fleet events
+        # every ``wire_flush_every`` RPCs (and at close) -- the
+        # metrics bridge and obs_report read THOSE, so live series and
+        # post-hoc reports agree and nothing double-counts
+        self.wire_flush_every = max(1, int(wire_flush_every))
+        self._wire_lock = threading.Lock()
+        self._wire_acc = {}
+        self._wire_unflushed = 0
         n_sub = sum(1 for r in self.replicas if r.kind == "subprocess")
         self._executor = None
         if n_sub:
@@ -593,6 +687,7 @@ class ServingFleet:
                 on_transition=self._breaker_cb(rep))
             if rep.kind == "subprocess":
                 rep._executor = self._executor
+                rep._wire_sink = self._note_wire
             if len({r.rid for r in self.replicas[:i + 1]}) != i + 1:
                 raise ValueError("duplicate replica ids")
         for rep in self.replicas:
@@ -646,6 +741,39 @@ class ServingFleet:
             self.telemetry.record("fleet", event=event, **f)
         except Exception:
             log.exception("fleet telemetry record failed (%s)", event)
+
+    def _note_wire(self, rid, verb, rtt_s, bytes_out, bytes_in):
+        """One worker RPC's wire cost, accumulated per verb.  RTT
+        samples are kept only up to the flush cadence so the event's
+        histogram contribution is complete, not sampled."""
+        with self._wire_lock:
+            d = self._wire_acc.setdefault(
+                verb, {"calls": 0, "bytes_sent": 0, "bytes_recv": 0,
+                       "rtt_s": []})
+            d["calls"] += 1
+            d["bytes_sent"] += int(bytes_out)
+            d["bytes_recv"] += int(bytes_in)
+            if len(d["rtt_s"]) < 2 * self.wire_flush_every:
+                d["rtt_s"].append(round(float(rtt_s), 6))
+            self._wire_unflushed += 1
+            if self._wire_unflushed < self.wire_flush_every:
+                return
+            acc, self._wire_acc = self._wire_acc, {}
+            self._wire_unflushed = 0
+        self._flush_wire(acc)
+
+    def _flush_wire(self, acc):
+        for verb, d in acc.items():
+            self._emit("wire", verb=verb, calls=d["calls"],
+                       bytes_sent=d["bytes_sent"],
+                       bytes_recv=d["bytes_recv"], rtt_s=d["rtt_s"])
+
+    def wire_stats(self):
+        """The UNFLUSHED per-verb wire aggregate (flushed deltas are
+        in the durable ``wire`` events)."""
+        with self._wire_lock:
+            return {v: dict(d, rtt_s=list(d["rtt_s"]))
+                    for v, d in self._wire_acc.items()}
 
     def _breaker_cb(self, rep):
         def cb(frm, to):
@@ -1189,8 +1317,11 @@ class ServingFleet:
         """Fan a candidate out: stage on every live replica (nothing
         committed anywhere).  In-process replicas stage the in-memory
         tree; subprocess replicas load+stage ``path`` in their own
-        process.  Returns the fleet handle ``{"per_replica": {rid:
-        handle}}`` the rolling cutover walks."""
+        process, or -- on the binary transport -- take the in-memory
+        tree over the wire (``weight_wire="int8"`` replicas ship the
+        blockwise-int8 payload+scales and dequantize worker-side).
+        Returns the fleet handle ``{"per_replica": {rid: handle}}``
+        the rolling cutover walks."""
         per = {}
         model_bytes = quantized = None
         for rep in self.replicas:
@@ -1324,6 +1455,10 @@ class ServingFleet:
                 return
             self._closed = True
             counters = dict(self._counters)
+        with self._wire_lock:
+            acc, self._wire_acc = self._wire_acc, {}
+            self._wire_unflushed = 0
+        self._flush_wire(acc)                  # the remainder delta
         self._emit("stats", **counters)
         for rep in self.replicas:
             try:

@@ -9,9 +9,15 @@ re-execution (arxiv 1804.05839 section 3).  ``ReplicaServer`` wraps one
 engine; ``serving/fleet.py``'s ``SubprocessReplica`` is the client side
 and ``tools/serve_fleet.py`` the CLI that spawns workers.
 
-Protocol (loopback-only, trusted -- the peer is a process this operator
-spawned on this host): each message is a 4-byte big-endian length
-followed by a pickled payload.  Requests are ``{"op": ..., **kwargs}``;
+Transport: the DEFAULT wire is the versioned binary frame protocol in
+``serving/transport.py`` -- persistent multiplexed connections, a
+digest-authed handshake (``BIGDL_RUN_TOKEN``), zero-copy tensor
+frames, typed refusals for oversize/foreign/truncated frames
+(docs/performance.md, "Fleet transport").  The PR 14 length-prefixed
+pickle wire is kept one release behind ``transport="pickle"`` as an
+escape hatch: each message is a 4-byte big-endian length followed by a
+pickled payload, one fresh loopback connection per request, trusted
+peer assumed.  Requests are ``{"op": ..., **kwargs}`` on either wire;
 responses ``{"ok": True, "result": ...}`` or ``{"ok": False, "error":
 ..., "error_type": ...}``.  Ops:
 
@@ -38,6 +44,12 @@ responses ``{"ok": True, "result": ...}`` or ``{"ok": False, "error":
 - ``capture``  {}                   -> token for the LIVE weights
 - ``stage``    {path}               -> token for a snapshot staged
   beside the serving weights (nothing committed)
+- ``stage_tree`` {params, mstate?, weight_wire?, wire_bytes?} -> token:
+  in-memory weights shipped OVER the wire (binary transport; arrays
+  ride as raw tensor frames, optionally blockwise-int8 via
+  ``transport.quantize_tree_for_wire`` -- the worker dequantizes
+  before staging, and the measured ``wire_bytes`` lands on the
+  ``param_refresh`` audit event at commit)
 - ``gate``     {token}              -> (ok, reason): the staged
   candidate evaluated on the worker's probe batch, outputs must be
   finite
@@ -63,6 +75,8 @@ import struct
 import threading
 
 from bigdl_tpu.observability.tracing import TraceContext
+from bigdl_tpu.serving.transport import (ReplicaCallError, WireFrameError,
+                                         run_token, serve_connection)
 
 log = logging.getLogger("bigdl_tpu.serving")
 
@@ -75,8 +89,8 @@ def send_msg(sock, obj):
     """One length-prefixed pickled message."""
     data = pickle.dumps(obj)
     if len(data) > MAX_MESSAGE_BYTES:
-        raise ValueError(f"message of {len(data)} bytes exceeds the "
-                         f"{MAX_MESSAGE_BYTES}-byte frame cap")
+        raise WireFrameError(f"message of {len(data)} bytes exceeds the "
+                             f"{MAX_MESSAGE_BYTES}-byte frame cap")
     sock.sendall(struct.pack(">I", len(data)) + data)
 
 
@@ -95,22 +109,35 @@ def recv_msg(sock):
     """The matching read: length prefix, then exactly that many bytes."""
     (n,) = struct.unpack(">I", _recv_exact(sock, 4))
     if n > MAX_MESSAGE_BYTES:
-        raise ValueError(f"frame of {n} bytes exceeds the "
-                         f"{MAX_MESSAGE_BYTES}-byte cap (corrupt prefix?)")
+        raise WireFrameError(f"frame of {n} bytes exceeds the "
+                             f"{MAX_MESSAGE_BYTES}-byte cap "
+                             f"(corrupt prefix?)")
     return pickle.loads(_recv_exact(sock, n))
 
 
-def call(host, port, op, rpc_timeout=30.0, **kwargs):
-    """One request/response round trip on a fresh connection (loopback
-    connections are cheap; a connection per request keeps the protocol
-    trivially correct under concurrency).  ``rpc_timeout`` bounds the
-    socket (the payload may carry its own engine-level ``timeout``
-    field).  Raises ``ReplicaCallError`` when the worker answered an
-    error; ``ConnectionError``/``OSError`` when it is unreachable
-    (dead)."""
+def call(host, port, op, rpc_timeout=30.0, transport="binary",
+         auth_token=None, **kwargs):
+    """One request/response round trip on a throwaway connection.
+
+    The default rides the binary wire (``transport.call_once``:
+    handshake + framed message on a fresh connection -- fleets keep a
+    ``WirePool`` instead, this is the tooling/test shape).
+    ``transport="pickle"`` keeps the PR 14 length-prefixed pickle wire.
+    ``rpc_timeout`` bounds the socket (the payload may carry its own
+    engine-level ``timeout`` field).  ``auth_token`` overrides the
+    ``BIGDL_RUN_TOKEN`` handshake secret (NOT the staged-handle
+    ``token=`` request field, which stays a plain kwarg).  Raises
+    ``ReplicaCallError`` when the worker answered an error;
+    ``ConnectionError``/``OSError`` when it is unreachable (dead)."""
+    if transport == "binary":
+        from bigdl_tpu.serving.transport import call_once
+
+        return call_once(host, port, op, rpc_timeout=rpc_timeout,
+                         auth_token=auth_token, **kwargs)
     with socket.create_connection((host, int(port)),
                                   timeout=rpc_timeout) as s:
         s.settimeout(rpc_timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_msg(s, {"op": op, **kwargs})
         resp = recv_msg(s)
     if not isinstance(resp, dict) or not resp.get("ok"):
@@ -119,18 +146,6 @@ def call(host, port, op, rpc_timeout=30.0, **kwargs):
             f"{op} failed on worker {host}:{port}: {err}",
             error_type=(resp or {}).get("error_type"))
     return resp.get("result")
-
-
-class ReplicaCallError(RuntimeError):
-    """The worker answered, but the op failed there (its error text
-    rides along) -- distinct from a dead/unreachable worker.
-    ``error_type`` carries the worker-side exception's class name so a
-    router can recognize typed refusals (e.g. ``EngineDraining``)
-    across the socket."""
-
-    def __init__(self, message, error_type=None):
-        super().__init__(message)
-        self.error_type = error_type
 
 
 def gate_staged(engine, handle, probe_features, probe_bucket=None):
@@ -212,11 +227,24 @@ class ReplicaServer:
     the staged candidate's outputs on this batch must be finite) and
     the ``probe`` digest.  ``max_handles`` bounds the token store so a
     long-lived worker cannot leak staged device buffers (oldest
-    released first)."""
+    released first).
+
+    ``transport="binary"`` (default) serves the versioned frame
+    protocol: persistent multiplexed connections, digest-auth
+    handshake against ``token`` (default: the ``BIGDL_RUN_TOKEN``
+    env; ``token=None`` with no env set handshakes without auth).
+    ``transport="pickle"`` keeps the PR 14 one-shot pickle wire."""
 
     def __init__(self, engine, host="127.0.0.1", port=0,
-                 probe_features=None, probe_bucket=None, max_handles=8):
+                 probe_features=None, probe_bucket=None, max_handles=8,
+                 transport="binary", token=None, max_frame_bytes=None):
+        if transport not in ("binary", "pickle"):
+            raise ValueError(f"unknown transport {transport!r}; "
+                             f"expected 'binary' or 'pickle'")
         self.engine = engine
+        self.transport = transport
+        self.token = token if token is not None else run_token()
+        self.max_frame_bytes = max_frame_bytes
         self.probe_features = probe_features
         self.probe_bucket = int(probe_bucket) if probe_bucket \
             else (len(probe_features) if probe_features is not None else 1)
@@ -228,18 +256,22 @@ class ReplicaServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                if server.transport == "binary":
+                    # handshake + per-connection message loop; each
+                    # message dispatches on its own thread
+                    serve_connection(self.request,
+                                     server._handle_request,
+                                     token=server.token,
+                                     max_frame_bytes=
+                                     server.max_frame_bytes)
+                    return
                 try:
+                    self.request.setsockopt(socket.IPPROTO_TCP,
+                                            socket.TCP_NODELAY, 1)
                     req = recv_msg(self.request)
                 except Exception:
                     return                     # half-open scanner etc.
-                try:
-                    result = server._dispatch(req)
-                    resp = {"ok": True, "result": result}
-                except Exception as e:         # the error crosses the
-                    log.exception("replica op %r failed",   # wire, the
-                                  req.get("op"))            # worker lives
-                    resp = {"ok": False, "error": str(e)[:500],
-                            "error_type": type(e).__name__}
+                resp = server._handle_request(req)
                 try:
                     send_msg(self.request, resp)
                 except Exception:
@@ -252,6 +284,17 @@ class ReplicaServer:
         self._server = Server((host, int(port)), Handler)
         self.host, self.port = self._server.server_address[:2]
         self._thread = None
+
+    def _handle_request(self, req):
+        """One request -> one response envelope; op errors cross the
+        wire typed, the worker lives."""
+        try:
+            return {"ok": True, "result": self._dispatch(req)}
+        except Exception as e:
+            log.exception("replica op %r failed",
+                          req.get("op") if isinstance(req, dict) else req)
+            return {"ok": False, "error": str(e)[:500],
+                    "error_type": type(e).__name__}
 
     # ----- op dispatch ------------------------------------------------------- #
     def _dispatch(self, req):
@@ -359,6 +402,29 @@ class ReplicaServer:
                                                src_layout=src)
             return self._put_handle(handle)
 
+    def _op_stage_tree(self, req):
+        # in-memory weights shipped over the wire (binary transport:
+        # raw tensor frames, optionally blockwise-int8 -- the client
+        # quantized with transport.quantize_tree_for_wire, we invert
+        # it here; a plain fp32 tree passes through unchanged)
+        from bigdl_tpu.serving.transport import dequantize_wire_tree
+
+        if req.get("src_layout") is not None:
+            raise ValueError(
+                "stage_tree ships weights already in the serving "
+                "layout; resharding snapshots cross as a PATH via the "
+                "stage op")
+        with self._deploy_lock:
+            params = dequantize_wire_tree(req["params"])
+            mstate = req.get("mstate")
+            if mstate is not None:
+                mstate = dequantize_wire_tree(mstate)
+            handle = self.engine.stage_weights(params, mstate)
+            handle["weight_wire"] = req.get("weight_wire") or "fp32"
+            if req.get("wire_bytes") is not None:
+                handle["wire_bytes"] = int(req["wire_bytes"])
+            return self._put_handle(handle)
+
     def _handle_of(self, req):
         token = req.get("token")
         handle = self._handles.get(token)
@@ -377,6 +443,12 @@ class ReplicaServer:
     def _op_commit(self, req):
         with self._deploy_lock:
             handle = self._handle_of(req)
+            if req.get("wire_bytes") is not None:
+                # the CLIENT measured what actually crossed the wire
+                # for this staged tree; the commit audit records it
+                handle["wire_bytes"] = int(req["wire_bytes"])
+                if req.get("weight_wire"):
+                    handle["weight_wire"] = req["weight_wire"]
             self.engine.commit_staged(handle, version=req.get("version"),
                                       digest=req.get("digest"))
             return True

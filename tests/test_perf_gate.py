@@ -698,3 +698,86 @@ class TestTracedRecords:
         assert entries[0]["baseline_eligible"] is False
         regs, _notes = gate.gate(traj)          # the inflated traced
         assert not regs                         # round is NOT the bar
+
+
+def _wire_record(metric, value, **extra):
+    """The BENCH_WIRE shapes (ISSUE 20): closed-loop req/s A/B and
+    staged-weight wire bytes -- host-side ratios with no platform /
+    per-step timing claim, so the gate classes both ``ratio``."""
+    return {"metric": metric, "value": value, "unit": "x",
+            "vs_baseline": 1.0,
+            "extra": {"concurrency": 10, "pool_size": 2,
+                      "recompiles_after_precompile": 0,
+                      "outputs_bit_identical": True, **extra}}
+
+
+class TestWireRecords:
+    """ISSUE-20 satellite: the fleet transport A/B records ride the
+    trajectory as baseline-eligible ``ratio`` records (both
+    higher-is-better -- ``fleet_wire_bytes_ratio`` is a reduction
+    factor like the paged-KV one, not a peak-bytes gauge); a
+    regressed candidate trips rc 1; the REAL checked-in BENCH_r10.json
+    clears the acceptance floors."""
+
+    def test_directions_and_trust_classing(self, gate):
+        assert gate.metric_direction("fleet_wire_rps_ratio") == "higher"
+        assert gate.metric_direction(
+            "fleet_wire_bytes_ratio") == "higher"
+        for rec in (_wire_record("fleet_wire_rps_ratio", 6.7),
+                    _wire_record("fleet_wire_bytes_ratio", 3.8)):
+            assert gate.classify_trust(rec) == "ratio"
+
+    def test_wire_regression_trips_the_gate(self, gate, tmp_path):
+        d = _bench_dir(tmp_path, {
+            "BENCH_r10.json": _wrapper(
+                [_wire_record("fleet_wire_rps_ratio", 6.7),
+                 _wire_record("fleet_wire_bytes_ratio", 3.8)], n=10)})
+        cand = tmp_path / "BENCH_cand.json"
+        # a transport that lost its throughput edge (ratio collapsed
+        # toward the pickle wire) must NOT slide through the gate
+        cand.write_text(json.dumps(
+            _wire_record("fleet_wire_rps_ratio", 1.1)))
+        assert gate.main(["--dir", d, "--check", str(cand),
+                          "--require-trusted"]) == 1
+        # ... nor an int8 staging path that quietly stopped shrinking
+        cand.write_text(json.dumps(
+            _wire_record("fleet_wire_bytes_ratio", 1.2)))
+        assert gate.main(["--dir", d, "--check", str(cand),
+                          "--require-trusted"]) == 1
+        # within-tolerance noise passes
+        cand.write_text(json.dumps(
+            _wire_record("fleet_wire_rps_ratio", 6.5)))
+        assert gate.main(["--dir", d, "--check", str(cand),
+                          "--require-trusted"]) == 0
+
+    def test_checked_in_r10_clears_the_acceptance_floors(self, gate):
+        """The REAL BENCH_r10.json: binary wire >= 1.3x pickle req/s
+        at the same closed-loop load, int8 staged weights <= 0.35x the
+        fp32 wire bytes, bit-identical outputs, zero recompiles and
+        zero pickle fallbacks on the measured legs."""
+        path = os.path.join(REPO, "BENCH_r10.json")
+        assert os.path.exists(path), "BENCH_r10.json must be checked in"
+        records, note = gate.load_bench_file(path)
+        assert note is None
+        by_metric = {r["metric"]: r for r in records}
+        rps = by_metric["fleet_wire_rps_ratio"]
+        assert gate.classify_trust(rps) == "ratio"
+        assert rps["value"] >= 1.3            # the ISSUE-20 floor
+        e = rps["extra"]
+        assert e["recompiles_after_precompile"] == 0
+        assert e["pickle_fallbacks"] == 0
+        assert e["outputs_bit_identical"] is True
+        assert e["binary"]["requests_per_s"] >= \
+            1.3 * e["pickle"]["requests_per_s"]
+        nbytes = by_metric["fleet_wire_bytes_ratio"]
+        assert gate.classify_trust(nbytes) == "ratio"
+        assert nbytes["value"] >= 1 / 0.35    # int8 <= 0.35x fp32
+        assert nbytes["extra"]["stage_bytes_int8"] * 100 <= \
+            35 * nbytes["extra"]["stage_bytes_fp32"]
+        assert nbytes["extra"]["int8_max_abs_err"] < 0.01
+        traj = gate.build_trajectory(REPO)
+        for m in ("fleet_wire_rps_ratio", "fleet_wire_bytes_ratio"):
+            assert any(en["baseline_eligible"]
+                       for en in traj["metrics"][m]), m
+        assert gate.main(["--dir", REPO, "--check", path,
+                          "--require-trusted"]) == 0

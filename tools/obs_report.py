@@ -496,6 +496,7 @@ def _fleet_section(other):
     if not evs:
         return None
     replicas, transitions, restarts, stats = {}, [], 0, None
+    wire = {}
     for e in evs:
         rid = e.get("replica")
         what = e.get("event")
@@ -520,6 +521,21 @@ def _fleet_section(other):
             stats = {k: e[k] for k in ("ok", "failed", "shed", "retries",
                                        "hedges", "hedge_wins")
                      if e.get(k) is not None}
+        elif what == "wire":
+            # per-verb wire-traffic deltas flushed by the fleet
+            # (docs/performance.md, "Fleet transport"); RTT samples
+            # are bounded per report (the fleet bounds them per flush)
+            verb = str(e.get("verb") or "?")
+            w = wire.setdefault(verb, {"verb": verb, "calls": 0,
+                                       "bytes_sent": 0, "bytes_recv": 0,
+                                       "rtt_s": []})
+            w["calls"] += int(e.get("calls") or 0)
+            w["bytes_sent"] += int(e.get("bytes_sent") or 0)
+            w["bytes_recv"] += int(e.get("bytes_recv") or 0)
+            if len(w["rtt_s"]) < 4096:
+                w["rtt_s"].extend(
+                    float(v) for v in (e.get("rtt_s") or ())
+                    if isinstance(v, (int, float)))
     sec = {"events": len(evs),
            "replicas": [replicas[k] for k in sorted(replicas)],
            "breaker_transitions": transitions[-12:],
@@ -527,6 +543,16 @@ def _fleet_section(other):
            "restarts": restarts}
     if stats is not None:
         sec["requests"] = stats
+    if wire:
+        rows = []
+        for verb in sorted(wire):
+            w = wire[verb]
+            rtts = w.pop("rtt_s")
+            if rtts:
+                w["rtt_p50_ms"] = round(1e3 * percentile(rtts, 50), 3)
+                w["rtt_p99_ms"] = round(1e3 * percentile(rtts, 99), 3)
+            rows.append(w)
+        sec["wire"] = rows
     return sec
 
 
@@ -1199,6 +1225,14 @@ def format_report(rep):
             out.append("  breaker trail: " + ", ".join(
                 f"r{t.get('replica')} {t.get('from')}->{t.get('to')}"
                 for t in fl["breaker_transitions"][-8:]))
+        for w in fl.get("wire", []):
+            ln = (f"  wire {w['verb']}: {w['calls']} call(s), "
+                  f"{_fmt_b(w['bytes_sent'])} out / "
+                  f"{_fmt_b(w['bytes_recv'])} in")
+            if w.get("rtt_p50_ms") is not None:
+                ln += (f", rtt p50 {w['rtt_p50_ms']}ms "
+                       f"p99 {w['rtt_p99_ms']}ms")
+            out.append(ln)
     tr = rep.get("tracing")
     if tr:
         line = (f"tracing: {tr['traces']} trace(s) / {tr['records']} "

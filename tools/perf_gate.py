@@ -10,10 +10,13 @@ of them into one per-metric trajectory and gates on it:
   keeps it, an older record claiming a platform is re-audited, and a
   pure host-side A/B ratio record (no platform/timing claim -- the
   BENCH_SERVE / BENCH_QCOMM / BENCH_PIPELINE speedups, the
-  BENCH_SERVE_INT8 fp32-vs-int8 serving ratios and the BENCH_DECODE
+  BENCH_SERVE_INT8 fp32-vs-int8 serving ratios, the BENCH_DECODE
   ``serving_decode_tokens_ratio`` /
   ``serving_paged_kv_bytes_ratio`` /
-  ``serving_prefix_prefill_saved``) is classed ``ratio``
+  ``serving_prefix_prefill_saved`` and the BENCH_WIRE transport A/Bs
+  ``fleet_wire_rps_ratio`` -- binary-over-pickle requests/sec at the
+  same closed-loop load -- and ``fleet_wire_bytes_ratio`` --
+  fp32-over-int8 staged-weight bytes on the wire) is classed ``ratio``
   and is baseline-eligible: an int8 serving regression trips the gate
   exactly like an MFU regression;
 - ``superseded`` records (BENCH_r02's async-dispatch artifact) and

@@ -109,6 +109,16 @@ def build_args(argv=None):
                          "serve*/traces.jsonl (driver) and "
                          "worker_<i>/traces.jsonl, stitchable with "
                          "tools/trace_report.py")
+    ap.add_argument("--transport", choices=("binary", "pickle"),
+                    default="binary",
+                    help="fleet wire protocol: the zero-copy binary "
+                         "frame protocol (serving/transport.py) or the "
+                         "legacy pickle wire")
+    ap.add_argument("--weightWire", choices=("fp32", "int8"),
+                    default="fp32",
+                    help="weight-distribution encoding for rolling "
+                         "deploys (int8 = blockwise-quantized staging "
+                         "traffic, binary transport only)")
     # internal spellings: this script spawning itself
     ap.add_argument("--role", choices=("driver", "worker"),
                     default="driver", help=argparse.SUPPRESS)
@@ -156,7 +166,8 @@ def run_worker(args):
     booted = boot_from_registry(eng, args.registry)
     probe_bucket = min(4, args.maxBatch)
     srv = ReplicaServer(eng, port=0, probe_features=x[:4],
-                        probe_bucket=probe_bucket)
+                        probe_bucket=probe_bucket,
+                        transport=args.transport)
     if args.portFile:
         tmp = args.portFile + ".tmp"
         with open(tmp, "w") as f:           # atomic: a half-written port
@@ -194,6 +205,7 @@ def make_spawn(args, rid):
                "--replicaId", str(rid), "--portFile", port_file,
                "--kvCacheDtype", args.kvCacheDtype,
                "--speculative", str(args.speculative),
+               "--transport", args.transport,
                "--registry", os.path.join(args.out, "registry.json")]
         if args.traceSample is not None:
             cmd += ["--traceSample", str(args.traceSample)]
@@ -238,6 +250,13 @@ def run_driver(args):
     from bigdl_tpu.serving.worker import probe_digest
 
     os.makedirs(args.out, exist_ok=True)
+    if args.transport == "binary" and "BIGDL_RUN_TOKEN" not in os.environ:
+        # mint the shared handshake secret BEFORE any worker spawns:
+        # the Popen env is a copy of os.environ, so every worker (and
+        # every respawn) inherits the same token as the driver's pools
+        from bigdl_tpu.serving.transport import mint_run_token
+
+        os.environ["BIGDL_RUN_TOKEN"] = mint_run_token()
     chaos = parse_fleet_chaos(args.chaos)      # fail fast on a typo
     if chaos is not None and not 1 <= chaos[1] < args.replicas:
         # fail at ARGUMENT time, not minutes in at fire time: replica 0
@@ -274,7 +293,9 @@ def run_driver(args):
 
     replicas = [InProcessReplica(eng0, rid=0)]
     for rid in range(1, args.replicas):
-        rep = SubprocessReplica(make_spawn(args, rid), rid=rid)
+        rep = SubprocessReplica(make_spawn(args, rid), rid=rid,
+                                transport=args.transport,
+                                weight_wire=args.weightWire)
         rep.start(0)
         replicas.append(rep)
     fleet = ServingFleet(replicas, telemetry=tel, metrics=metrics,
